@@ -1,0 +1,32 @@
+"""pwasm-tpu: a TPU-native framework for PAF alignment diff analysis and MSA
+consensus calling.
+
+Capabilities mirror the reference toolchain (``pafreport`` + the GapAssem MSA
+engine, see SURVEY.md): ingest minimap2 PAF+``cs`` alignments of query
+sequences against many targets, reconstruct each target from the ``cs`` diff
+string, report every indel/substitution with sequence context (homopolymers,
+methylation motifs) and codon-impact prediction, and build progressive MSAs
+with consensus calling.
+
+Architecture (TPU-first, not a translation):
+
+- ``pwasm_tpu.core``   — host data model: DNA tables, FASTA faidx reader,
+  PAF/cs/CIGAR parsing, diff-event extraction (ground truth for everything).
+- ``pwasm_tpu.align``  — gapped-sequence/MSA engine: tensorised gap
+  bookkeeping, progressive merge, consensus, clip refinement (bit-exact CPU
+  path).
+- ``pwasm_tpu.ops``    — JAX/Pallas device kernels: per-column consensus
+  vote, batched banded affine-gap DP (anti-diagonal wavefront), vectorized
+  variant-context/codon scan.
+- ``pwasm_tpu.parallel`` — ``jax.sharding`` mesh pipeline: batch-axis data
+  parallelism, depth-axis ``psum`` of pileup counts, column-axis sequence
+  parallelism.
+- ``pwasm_tpu.report`` — byte-compatible ``.dfa`` diff report, ``.mfa`` MSA,
+  ACE and contig-info writers, plus the event summary counters.
+- ``pwasm_tpu.native`` — C++ host core (fast PAF/cs/CIGAR tokenizers, FASTA
+  index, 2-bit packing) with ctypes bindings and a pure-Python fallback.
+- ``pwasm_tpu.cli``    — ``pafreport``-compatible command line front end with
+  ``--device={cpu,tpu}``.
+"""
+
+__version__ = "0.1.0"

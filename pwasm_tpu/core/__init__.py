@@ -1,0 +1,19 @@
+"""Host-side data model: DNA tables, FASTA access, PAF/cs/CIGAR parsing,
+diff-event extraction."""
+
+from pwasm_tpu.core.dna import (  # noqa: F401
+    revcomp,
+    encode,
+    decode,
+    translate_codon,
+    CODE_A,
+    CODE_C,
+    CODE_G,
+    CODE_T,
+    CODE_N,
+    CODE_GAP,
+)
+from pwasm_tpu.core.errors import PwasmError, ParseError  # noqa: F401
+from pwasm_tpu.core.paf import PafRecord, AlnInfo, parse_paf_line  # noqa: F401
+from pwasm_tpu.core.fasta import FastaFile  # noqa: F401
+from pwasm_tpu.core.events import GapData, DiffEvent, PafAlignment  # noqa: F401

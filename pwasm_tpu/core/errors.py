@@ -1,0 +1,38 @@
+"""Error model.
+
+The reference fails fast with distinct exit codes (SURVEY.md §2.5.12):
+usage/argument errors exit 1, alignment parse errors exit 3
+(pafreport.cpp:463-467), a zero-coverage MSA column exits 5
+(GapAssem.cpp:1121-1131), and generic fatal errors (GError) use the default
+exit code. We mirror those codes so scripted callers behave identically.
+"""
+
+from __future__ import annotations
+
+EXIT_USAGE = 1
+EXIT_FATAL = 1  # GError's default exit status
+EXIT_PARSE = 3
+EXIT_ZERO_COVERAGE = 5
+
+
+class PwasmError(Exception):
+    """Fatal error (the reference's GError): message + process exit code."""
+
+    exit_code = EXIT_FATAL
+
+    def __init__(self, message: str, exit_code: int | None = None):
+        super().__init__(message)
+        if exit_code is not None:
+            self.exit_code = exit_code
+
+
+class ParseError(PwasmError):
+    """Malformed alignment line (reference: PAFAlignment::parseErr, exit 3)."""
+
+    exit_code = EXIT_PARSE
+
+
+class ZeroCoverageError(PwasmError):
+    """A zero-coverage column inside an MSA (reference: ErrZeroCov, exit 5)."""
+
+    exit_code = EXIT_ZERO_COVERAGE

@@ -1,0 +1,112 @@
+"""Random-access FASTA reader (faidx-style).
+
+Equivalent capability to the reference's gclib GFastaDb/GFastaIndex/GFaSeqGet
+usage (pafreport.cpp:255,346): open a FASTA file, fetch whole records by id
+without re-scanning the file.  The index is built in one streaming pass and
+records byte offsets, so fetches are O(record size) seeks.
+
+Also provides in-memory helpers used by tests and the MSA writers.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from pwasm_tpu.core.errors import PwasmError
+
+
+@dataclass
+class _FaiEntry:
+    name: str
+    length: int  # number of sequence bytes (newlines excluded)
+    offset: int  # byte offset of first sequence byte
+    end: int     # byte offset one past the last sequence line
+
+
+class FastaFile:
+    """Indexed FASTA access by sequence id.
+
+    >>> fa = FastaFile(path)
+    >>> fa.fetch("gene1")      # -> bytes (no newlines), or None if absent
+    >>> len(fa)                # number of records
+    """
+
+    def __init__(self, path: str | os.PathLike):
+        self.path = os.fspath(path)
+        self._index: dict[str, _FaiEntry] = {}
+        self._order: list[str] = []
+        self._build_index()
+
+    def _build_index(self) -> None:
+        name = None
+        seqlen = 0
+        seq_start = 0
+        pos = 0
+        with open(self.path, "rb") as f:
+            for line in f:
+                linelen = len(line)
+                if line.startswith(b">"):
+                    if name is not None:
+                        self._add(name, seqlen, seq_start, pos)
+                    header = line[1:].strip()
+                    name = header.split(None, 1)[0].decode() if header else ""
+                    seqlen = 0
+                    seq_start = pos + linelen
+                elif name is not None:
+                    seqlen += len(line.strip())
+                pos += linelen
+            if name is not None:
+                self._add(name, seqlen, seq_start, pos)
+        if not self._index:
+            raise PwasmError(f"Error: invalid FASTA file {self.path} !")
+
+    def _add(self, name: str, seqlen: int, start: int, end: int) -> None:
+        if name not in self._index:
+            self._index[name] = _FaiEntry(name, seqlen, start, end)
+            self._order.append(name)
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._index
+
+    @property
+    def names(self) -> list[str]:
+        return list(self._order)
+
+    def length(self, name: str) -> int:
+        return self._index[name].length
+
+    def fetch(self, name: str) -> bytes | None:
+        """Fetch a full record's sequence (newlines stripped), or None."""
+        ent = self._index.get(name)
+        if ent is None:
+            return None
+        with open(self.path, "rb") as f:
+            f.seek(ent.offset)
+            raw = f.read(ent.end - ent.offset)
+        # strip ALL whitespace, matching the per-line strip() used when
+        # indexing — otherwise length() and fetch() disagree on files with
+        # trailing blanks and stray bytes later encode as phantom Ns
+        return bytes(raw.translate(None, b" \t\r\n\v\f"))
+
+    def file_size(self) -> int:
+        """Size of the FASTA file in bytes.
+
+        The reference auto-selects full-genome mode when this exceeds 120000
+        bytes (pafreport.cpp:253-262, quirk SURVEY.md §2.5.7) — by *file
+        size*, not sequence length; we preserve that contract.
+        """
+        return os.path.getsize(self.path)
+
+
+def write_fasta(path: str, records: list[tuple[str, bytes]], width: int = 60) -> None:
+    """Write records as FASTA with the given line width (test helper)."""
+    with open(path, "w") as f:
+        for name, seq in records:
+            f.write(f">{name}\n")
+            s = seq.decode() if isinstance(seq, (bytes, bytearray)) else seq
+            for i in range(0, len(s), width):
+                f.write(s[i:i + width] + "\n")

@@ -1,0 +1,16 @@
+"""Test configuration.
+
+JAX tests run on CPU with 8 virtual devices so multi-chip sharding and ICI
+collectives are exercised without TPU hardware (SURVEY.md §4: multi-chip
+tests via ``--xla_force_host_platform_device_count``).  The env vars must be
+set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,119 @@
+"""Test helpers: synthesize PAF+cs+cigar lines from explicit alignment ops.
+
+The synthesizer is an independent oracle: it builds minimap2-style records
+from a declarative op list, so extractor tests don't share logic with the
+code under test.
+
+Alignment ops (in alignment orientation, query side = the ``-r`` FASTA):
+  ("=", n)        n matching bases
+  ("*", t, q)     substitution: target base t, query base q
+  ("ins", bases)  bases present only in the target  (cs '-', cigar D)
+  ("del", n)      n query bases absent from the target (cs '+', cigar I)
+"""
+
+from __future__ import annotations
+
+from pwasm_tpu.core.dna import revcomp
+
+_COMP = {"a": "t", "c": "g", "g": "c", "t": "a", "n": "n"}
+
+
+def _comp(b: str) -> str:
+    return _COMP[b.lower()]
+
+
+def synth_alignment(q_aln: str, ops) -> tuple[str, str, str]:
+    """Apply ops to the aligned query slice; return (cs, cigar, target_seq).
+
+    ``q_aln`` is the query subsequence covered by the alignment, in
+    *alignment orientation* (i.e. already reverse-complemented for '-'
+    alignments), upper-case.
+    """
+    cs_parts = []
+    cig_parts = []
+    tseq = []
+    qpos = 0
+
+    def cig(n, op):
+        if cig_parts and cig_parts[-1][1] == op:
+            cig_parts[-1] = (cig_parts[-1][0] + n, op)
+        else:
+            cig_parts.append((n, op))
+
+    for op in ops:
+        kind = op[0]
+        if kind == "=":
+            n = op[1]
+            cs_parts.append(f":{n}")
+            tseq.append(q_aln[qpos:qpos + n])
+            qpos += n
+            cig(n, "M")
+        elif kind == "*":
+            t, q = op[1].lower(), op[2].lower()
+            assert q_aln[qpos].lower() == q, "op mismatch vs q_aln"
+            cs_parts.append(f"*{t}{q}")
+            tseq.append(t.upper())
+            qpos += 1
+            cig(1, "M")
+        elif kind == "ins":
+            bases = op[1].lower()
+            cs_parts.append("-" + bases)
+            tseq.append(bases.upper())
+            cig(len(bases), "D")
+        elif kind == "del":
+            n = op[1]
+            cs_parts.append("+" + q_aln[qpos:qpos + n].lower())
+            qpos += n
+            cig(n, "I")
+        else:
+            raise ValueError(kind)
+    assert qpos == len(q_aln), "ops must consume the whole aligned query"
+    cigar = "".join(f"{n}{c}" for n, c in cig_parts)
+    return "".join(cs_parts), cigar, "".join(tseq)
+
+
+def reverse_ops(ops):
+    """Express the same biological alignment in the opposite orientation."""
+    out = []
+    for op in reversed(ops):
+        kind = op[0]
+        if kind == "=":
+            out.append(op)
+        elif kind == "*":
+            out.append(("*", _comp(op[1]), _comp(op[2])))
+        elif kind == "ins":
+            out.append(("ins", revcomp(op[1].encode()).decode()))
+        else:
+            out.append(op)
+    return out
+
+
+def make_paf_line(q_id: str, q_seq: str, t_id: str, strand: str, ops,
+                  q_start: int = 0, q_end: int | None = None,
+                  t_start: int = 0, t_len: int | None = None,
+                  nm: int = 0, score: int = 0) -> tuple[str, str]:
+    """Build a full PAF line; returns (line, target_seq_in_aln_orientation).
+
+    ``q_start``/``q_end`` are forward-query coordinates of the aligned
+    region.  For strand '-', ``ops`` must describe the alignment of the
+    target against revcomp(query), i.e. they consume
+    revcomp(q)[qlen-q_end : qlen-q_start].
+    """
+    q_len = len(q_seq)
+    if q_end is None:
+        q_end = q_len
+    if strand == "-":
+        q_aln = revcomp(q_seq.encode()).decode()[q_len - q_end:q_len - q_start]
+    else:
+        q_aln = q_seq[q_start:q_end]
+    cs, cigar, tseq = synth_alignment(q_aln.upper(), ops)
+    t_end = t_start + len(tseq)
+    if t_len is None:
+        t_len = t_end
+    fields = [
+        q_id, str(q_len), str(q_start), str(q_end), strand,
+        t_id, str(t_len), str(t_start), str(t_end),
+        str(q_end - q_start), str(max(q_end - q_start, len(tseq))), "60",
+        f"NM:i:{nm}", f"AS:i:{score}", f"cg:Z:{cigar}", f"cs:Z:{cs}",
+    ]
+    return "\t".join(fields), tseq

@@ -1,0 +1,61 @@
+import numpy as np
+import pytest
+
+from pwasm_tpu.core import dna
+
+
+def test_revcomp_basic():
+    assert dna.revcomp(b"ACGT") == b"ACGT"
+    assert dna.revcomp(b"AACC") == b"GGTT"
+    assert dna.revcomp(b"acgtN") == b"Nacgt"
+
+
+def test_revcomp_preserves_case_and_iupac():
+    assert dna.revcomp(b"aCgT") == b"AcGt"
+    assert dna.revcomp(b"MRWSYK") == b"MRSWYK"
+    assert dna.complement(b"MRWSYKVHDB") == b"KYWSRMBDHV"
+
+
+def test_revcomp_involution():
+    rng = np.random.default_rng(0)
+    seq = rng.choice(list(b"ACGTacgtNn"), size=100).astype(np.uint8).tobytes()
+    assert dna.revcomp(dna.revcomp(seq)) == seq
+
+
+def test_encode_decode():
+    codes = dna.encode(b"ACGTNacgtn-X*")
+    assert list(codes) == [0, 1, 2, 3, 4, 0, 1, 2, 3, 4, 5, 4, 5]
+    assert dna.decode(np.array([0, 1, 2, 3, 4, 5])) == b"ACGTN-"
+
+
+@pytest.mark.parametrize("codon,aa", [
+    (b"ATG", "M"), (b"TAA", "."), (b"TAG", "."), (b"TGA", "."),
+    (b"TTT", "F"), (b"GGG", "G"), (b"NNN", "X"), (b"AT", "X"),
+    (b"atg", "M"), (b"TTR", "X"),
+])
+def test_translate_codon(codon, aa):
+    assert dna.translate_codon(codon) == aa
+
+
+def test_translate_codon_pos_and_end():
+    seq = b"ATGTAA"
+    assert dna.translate_codon(seq, 0) == "M"
+    assert dna.translate_codon(seq, 3) == "."
+    assert dna.translate_codon(seq, 5) == "X"  # reads off the end
+
+
+def test_translate_codes_matches_scalar():
+    rng = np.random.default_rng(1)
+    seq = rng.choice(list(b"ACGTN"), size=300).astype(np.uint8).tobytes()
+    codes = dna.encode(seq)
+    aas = dna.translate_codes(codes)
+    expect = [dna.translate_codon(seq, i) for i in range(0, 300, 3)]
+    assert [chr(a) for a in aas] == expect
+
+
+def test_translate_codes_batched():
+    seqs = np.stack([dna.encode(b"ATGTAA"), dna.encode(b"TTTGGG")])
+    aas = dna.translate_codes(seqs)
+    assert aas.shape == (2, 2)
+    assert bytes(aas[0]) == b"M."
+    assert bytes(aas[1]) == b"FG"

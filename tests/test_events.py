@@ -1,0 +1,213 @@
+"""Diff-event extraction tests: hand-worked cases + an independent
+apply-the-events oracle over randomized alignments in both orientations."""
+
+import numpy as np
+import pytest
+
+from pwasm_tpu.core.dna import revcomp
+from pwasm_tpu.core.errors import PwasmError
+from pwasm_tpu.core.events import extract_alignment
+from pwasm_tpu.core.paf import parse_paf_line
+
+from helpers import make_paf_line, reverse_ops
+
+Q = "ACGTACGTAC"
+
+
+def _extract(line, q_seq=Q):
+    rec = parse_paf_line(line)
+    q = q_seq.upper().encode()
+    refseq_aln = revcomp(q) if rec.alninfo.reverse else q
+    return extract_alignment(rec, refseq_aln)
+
+
+def test_forward_worked_example():
+    ops = [("=", 3), ("*", "a", "t"), ("=", 2), ("ins", "gg"),
+           ("del", 2), ("=", 2)]
+    line, tseq = make_paf_line("q", Q, "t", "+", ops)
+    aln = _extract(line)
+    assert aln.tseq == b"ACGaACggAC"
+    assert tseq == "ACGAACGGAC"
+    assert [e.evt for e in aln.tdiffs] == ["S", "I", "D"]
+    s, ins, de = aln.tdiffs
+    assert (s.rloc, s.tloc, s.evtbases, s.evtsub, s.evtlen) == (3, 3, b"A", b"T", 1)
+    assert s.tctx == b"ACGaACggA"
+    assert (ins.rloc, ins.tloc, ins.evtbases, ins.evtlen) == (6, 6, b"gg", 2)
+    assert ins.tctx == b"CGaACggA"
+    assert (de.rloc, de.tloc, de.evtbases, de.evtlen) == (6, 8, b"GT", 2)
+    assert de.tctx == b"aACggA"
+    # CIGAR-derived gap lists: target gap where the query has extra bases,
+    # query gap where the target has extra bases
+    assert [(g.pos, g.len) for g in aln.rgaps] == [(6, 2)]
+    assert [(g.pos, g.len) for g in aln.tgaps] == [(8, 2)]
+
+
+def test_reverse_worked_example():
+    ops = [("=", 4), ("*", "c", "g"), ("=", 5)]
+    line, _ = make_paf_line("q", Q, "t", "-", ops)
+    aln = _extract(line)
+    assert aln.tseq == b"GTACcTACGT"  # reconstructed in alignment orientation
+    (s,) = aln.tdiffs
+    assert (s.evt, s.rloc, s.evtbases, s.evtsub) == ("S", 5, b"G", b"C")
+    assert s.tloc == 6
+    assert s.tctx == b"CGTAgGTAC"
+
+
+def test_adjacent_substitutions_merge():
+    ops = [("=", 2), ("*", "t", "g"), ("*", "a", "t"), ("=", 6)]
+    line, _ = make_paf_line("q", Q, "t", "+", ops)
+    aln = _extract(line)
+    (s,) = aln.tdiffs
+    assert (s.evt, s.rloc, s.evtbases, s.evtsub) == ("S", 2, b"TA", b"GT")
+    assert s.evtlen == 1  # reference quirk: evtlen not updated on merge
+    # context window therefore spans evtlen=1, not 2 (SURVEY.md §2.5.5)
+    assert s.tctx == aln.tseq[0:2 + 1 + 5]
+
+
+def test_substitutions_separated_dont_merge():
+    ops = [("=", 2), ("*", "t", "g"), ("=", 1), ("*", "t", "a"), ("=", 5)]
+    line, _ = make_paf_line("q", Q, "t", "+", ops)
+    aln = _extract(line)
+    assert [e.rloc for e in aln.tdiffs] == [2, 4]
+
+
+def test_partial_alignment_offset():
+    # align only q[2:8]
+    ops = [("=", 2), ("*", "g", "a"), ("=", 3)]
+    line, _ = make_paf_line("q", Q, "t", "+", ops, q_start=2, q_end=8)
+    aln = _extract(line)
+    assert aln.offset == 2
+    (s,) = aln.tdiffs
+    assert s.rloc == 4  # forward-query coordinate
+    assert s.evtsub == Q[4].encode()
+
+
+def test_base_mismatch_fatal():
+    ops = [("=", 3), ("*", "a", "t"), ("=", 6)]
+    line, _ = make_paf_line("q", Q, "t", "+", ops)
+    line = line.replace("*at", "*ag")  # q base in cs contradicts the FASTA
+    with pytest.raises(PwasmError, match="base mismatch"):
+        _extract(line)
+
+
+def test_splice_fatal():
+    line, _ = make_paf_line("q", Q, "t", "+", [("=", 10)])
+    line = line.replace("cs:Z::10", "cs:Z::5~gt4ac:5")
+    with pytest.raises(PwasmError, match="spliced"):
+        _extract(line)
+
+
+def test_length_cross_validation():
+    line, _ = make_paf_line("q", Q, "t", "+", [("=", 10)])
+    bad = line.replace("cg:Z:10M", "cg:Z:9M")
+    with pytest.raises(PwasmError, match="length mismatch"):
+        _extract(bad)
+
+
+def test_missing_cigar_fatal():
+    line, _ = make_paf_line("q", Q, "t", "+", [("=", 10)])
+    line = "\t".join(f for f in line.split("\t") if not f.startswith("cg:Z:"))
+    with pytest.raises(PwasmError, match="cigar"):
+        _extract(line)
+
+
+# ---------------------------------------------------------------------------
+# Independent oracle: applying the reported events to the forward query must
+# reproduce the forward-orientation target, for both strands.
+# ---------------------------------------------------------------------------
+def _apply_events(q_fwd: bytes, events, q_start: int, q_end: int) -> bytes:
+    seq = bytearray(q_fwd)
+    delta = 0
+    # At a shared rloc the insertion point precedes the S/D bases, so apply
+    # S/D first while walking right-to-left.
+    for ev in sorted(events, key=lambda e: (e.rloc, 0 if e.evt == "I" else 1),
+                     reverse=True):
+        if ev.evt == "S":
+            seq[ev.rloc:ev.rloc + len(ev.evtbases)] = ev.evtbases.upper()
+        elif ev.evt == "I":
+            seq[ev.rloc:ev.rloc] = ev.evtbases.upper()
+            delta += len(ev.evtbases)
+        else:
+            del seq[ev.rloc:ev.rloc + ev.evtlen]
+            delta -= ev.evtlen
+    return bytes(seq[q_start:q_end + delta])
+
+
+def _random_ops(rng, q_aln: str):
+    ops = []
+    pos = 0
+    n = len(q_aln)
+    ops.append(("=", 3))
+    pos += 3
+    while pos < n - 6:
+        kind = rng.choice(["=", "*", "ins", "del"], p=[0.5, 0.25, 0.125, 0.125])
+        if kind == "=":
+            run = int(rng.integers(1, 8))
+            run = min(run, n - 6 - pos)
+            if run <= 0:
+                break
+            ops.append(("=", run))
+            pos += run
+        elif kind == "*":
+            qb = q_aln[pos]
+            tb = rng.choice([b for b in "ACGT" if b != qb.upper()])
+            ops.append(("*", tb, qb))
+            pos += 1
+        elif kind == "ins":
+            bases = "".join(rng.choice(list("ACGT"),
+                                       size=int(rng.integers(1, 5))))
+            ops.append(("ins", bases))
+            # guarantee separation so indels never touch the edges
+            run = min(2, n - 6 - pos)
+            if run > 0:
+                ops.append(("=", run))
+                pos += run
+        else:
+            dlen = int(rng.integers(1, min(4, n - 6 - pos) + 1))
+            ops.append(("del", dlen))
+            pos += dlen
+    ops.append(("=", n - pos))
+    return ops
+
+
+@pytest.mark.parametrize("strand", ["+", "-"])
+@pytest.mark.parametrize("seed", range(8))
+def test_random_alignments_event_oracle(strand, seed):
+    rng = np.random.default_rng(seed)
+    q = "".join(rng.choice(list("ACGT"), size=int(rng.integers(60, 160))))
+    q_start = int(rng.integers(0, 10))
+    q_end = len(q) - int(rng.integers(0, 10))
+    if strand == "-":
+        q_aln = revcomp(q.encode()).decode()[len(q) - q_end:len(q) - q_start]
+    else:
+        q_aln = q[q_start:q_end]
+    ops = _random_ops(rng, q_aln)
+    line, tseq = make_paf_line("q", q, "t", strand, ops,
+                               q_start=q_start, q_end=q_end)
+    aln = _extract(line, q)
+    # reconstructed target matches the synthesizer's target
+    assert aln.tseq.upper() == tseq.encode()
+    # events, applied in forward coordinates, reproduce the forward target
+    t_fwd = revcomp(tseq.encode()) if strand == "-" else tseq.encode()
+    got = _apply_events(q.encode(), aln.tdiffs, q_start, q_end)
+    assert got == t_fwd
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_forward_reverse_event_equivalence(seed):
+    """The same biological alignment reported via a '-' PAF line must yield
+    identical forward-coordinate events (tloc/tctx excepted, which are
+    display-orientation fields)."""
+    rng = np.random.default_rng(100 + seed)
+    q = "".join(rng.choice(list("ACGT"), size=80))
+    ops_fwd = _random_ops(rng, q)
+    line_f, tseq_f = make_paf_line("q", q, "t", "+", ops_fwd)
+    aln_f = _extract(line_f, q)
+    line_r, tseq_r = make_paf_line("q", q, "t", "-", reverse_ops(ops_fwd))
+    aln_r = _extract(line_r, q)
+    assert revcomp(tseq_r.encode()) == tseq_f.encode()
+    ev_f = [(e.evt, e.rloc, e.evtbases.upper(), e.evtsub.upper())
+            for e in aln_f.tdiffs]
+    ev_r = [(e.evt, e.rloc, e.evtbases.upper(), e.evtsub.upper())
+            for e in aln_r.tdiffs]
+    assert ev_f == ev_r

@@ -1,0 +1,43 @@
+import pytest
+
+from pwasm_tpu.core.errors import PwasmError
+from pwasm_tpu.core.fasta import FastaFile, write_fasta
+
+
+def test_fetch_multi_record(tmp_path):
+    p = tmp_path / "x.fa"
+    write_fasta(str(p), [("a", b"ACGTACGTACGT"), ("b desc", b"TTTT")], width=5)
+    fa = FastaFile(p)
+    assert len(fa) == 2
+    assert fa.names == ["a", "b"]
+    assert fa.fetch("a") == b"ACGTACGTACGT"
+    assert fa.fetch("b") == b"TTTT"
+    assert fa.fetch("missing") is None
+    assert fa.length("a") == 12
+
+
+def test_header_with_description(tmp_path):
+    p = tmp_path / "x.fa"
+    p.write_text(">seq1 some description here\nACGT\nAC\n")
+    fa = FastaFile(p)
+    assert fa.fetch("seq1") == b"ACGTAC"
+
+
+def test_empty_fasta_raises(tmp_path):
+    p = tmp_path / "empty.fa"
+    p.write_text("")
+    with pytest.raises(PwasmError, match="invalid FASTA"):
+        FastaFile(p)
+
+
+def test_crlf(tmp_path):
+    p = tmp_path / "crlf.fa"
+    p.write_bytes(b">s\r\nACGT\r\nGG\r\n")
+    fa = FastaFile(p)
+    assert fa.fetch("s") == b"ACGTGG"
+
+
+def test_file_size(tmp_path):
+    p = tmp_path / "x.fa"
+    p.write_text(">s\nACGT\n")
+    assert FastaFile(p).file_size() == 8

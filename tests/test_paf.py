@@ -1,0 +1,59 @@
+import pytest
+
+from pwasm_tpu.core.errors import PwasmError
+from pwasm_tpu.core.paf import parse_paf_line, _atoi
+
+
+def _line(tags):
+    fields = ["q", "10", "0", "10", "+", "t", "12", "0", "12",
+              "10", "12", "60", "tp:A:P", "cm:i:5", "s1:i:9"] + tags
+    return "\t".join(fields)
+
+
+def test_parse_basic():
+    rec = parse_paf_line(_line(["NM:i:3", "AS:i:17", "cg:Z:10M",
+                                "cs:Z::10"]))
+    al = rec.alninfo
+    assert (al.r_id, al.r_len, al.r_alnstart, al.r_alnend) == ("q", 10, 0, 10)
+    assert (al.t_id, al.t_len, al.t_alnstart, al.t_alnend) == ("t", 12, 0, 12)
+    assert al.reverse == 0
+    assert rec.edist == 3
+    assert rec.alnscore == 17
+    assert rec.cigar == "10M"
+    assert rec.cs == ":10"
+
+
+def test_parse_reverse_strand():
+    line = _line(["cg:Z:10M", "cs:Z::10"]).replace("\t+\t", "\t-\t")
+    assert parse_paf_line(line).alninfo.reverse == 1
+
+
+def test_parse_too_few_fields():
+    with pytest.raises(PwasmError, match="invalid PAF"):
+        parse_paf_line("a\tb\tc")
+
+
+def test_parse_duplicate_tag_semantics():
+    # Reference behavior (pafreport.cpp:492-520): each match overwrites and
+    # scanning stops only once all four tags were seen, so with AS absent a
+    # duplicate NM overwrites the first.
+    rec = parse_paf_line(_line(["NM:i:1", "NM:i:2", "cg:Z:10M", "cs:Z::10"]))
+    assert rec.edist == 2
+    # ...but once NM/AS/cg/cs have all been seen, scanning stops.
+    rec = parse_paf_line(_line(["NM:i:1", "AS:i:7", "cg:Z:10M", "cs:Z::10",
+                                "NM:i:9"]))
+    assert rec.edist == 1
+
+
+def test_parse_missing_tags():
+    rec = parse_paf_line(_line(["xx:Z:foo"]))
+    assert rec.cigar is None and rec.cs is None
+    assert rec.edist == -1 and rec.alnscore == 0
+
+
+def test_atoi():
+    assert _atoi("123") == 123
+    assert _atoi("-5") == -5
+    assert _atoi("12ab") == 12
+    assert _atoi("ab") == 0
+    assert _atoi("") == 0
