@@ -1,0 +1,11 @@
+"""Gapped-sequence / MSA engine (bit-exact CPU path).
+
+Equivalent capability set to the reference's GapAssem library (GapAssem.h,
+GapAssem.cpp): gapped-coordinate bookkeeping, gap propagation across an MSA,
+progressive pairwise->MSA merging, column voting/consensus, X-drop clip
+refinement, and the MFA/ACE/contig-info writers.  The device path
+(`pwasm_tpu.ops`) consumes the pileup tensors this layer produces.
+"""
+
+from pwasm_tpu.align.gapseq import GapSeq  # noqa: F401
+from pwasm_tpu.align.msa import Msa, MsaColumns, best_char_from_counts  # noqa: F401

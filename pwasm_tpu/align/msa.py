@@ -1,0 +1,584 @@
+"""MSA container: progressive merging, gap propagation, consensus, writers.
+
+Equivalent of the reference's GSeqAlign + MSAColumns + GAlnColumn
+(GapAssem.h:255-461, GapAssem.cpp:593-1367).  Differences in mechanism (not
+behavior):
+
+- Pileup counts are a single (columns, 6) int32 tensor built with
+  scatter-adds instead of per-column count objects — the exact tensor the
+  TPU consensus kernel consumes.
+- The per-member position walks (injectGap/removeColumn/evalClipping) use
+  prefix sums + binary search over the same monotone walk positions.
+- The consensus vote implements bestChar's stable-sort + '-'/'N' yield rule
+  (GapAssem.cpp:1048-1069, quirk SURVEY.md §2.5.10) as a closed-form rule
+  over the 6 counts.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import IO
+
+import numpy as np
+
+from pwasm_tpu.align.gapseq import FLAG_BAD_ALN, FLAG_PREPPED, GapSeq
+from pwasm_tpu.core.errors import PwasmError, ZeroCoverageError
+
+# column buckets, exactly this order (GapAssem.h:257-264)
+NUC_ORDER = b"ACGTN-"
+_BUCKET = np.full(256, 4, dtype=np.int8)  # default: N bucket
+for _i, _c in enumerate(b"ACGT"):
+    _BUCKET[_c] = _i
+    _BUCKET[_c + 32] = _i  # lowercase
+_BUCKET[ord("-")] = 5
+_BUCKET[ord("*")] = 5
+
+
+def best_char_from_counts(counts, layers: int) -> int:
+    """The consensus vote for one column.
+
+    Reference bestChar (GapAssem.cpp:1048-1069): stable-sort the six
+    buckets by count descending (initial order A,C,G,T,N,-), then while the
+    best is '-' or 'N' and tied with the next, yield to the next.  Closed
+    form: if any of A/C/G/T reaches the max count, the first of them wins;
+    else if N and '-' tie at the max, '-' wins; else whichever of N/'-' has
+    the max.  Returns the winning character code (int), or 0 if the column
+    has no layers."""
+    if layers == 0:
+        return 0
+    a, c, g, t, n, gap = (int(x) for x in counts)
+    m = max(a, c, g, t, n, gap)
+    for val, ch in ((a, ord("A")), (c, ord("C")), (g, ord("G")),
+                    (t, ord("T"))):
+        if val == m:
+            return ch
+    if n == m and gap == m:
+        return ord("-")
+    return ord("N") if n == m else ord("-")
+
+
+class MsaColumns:
+    """Column pileup: (size, 6) count tensor + live [mincol, maxcol] window
+    (reference MSAColumns, GapAssem.h:345-376).  ``layers`` counts every
+    contribution including gaps; clipped bases contribute only a witness
+    flag (GAlnColumn::addNuc clipped path, GapAssem.h:299-308)."""
+
+    def __init__(self, size: int, baseoffset: int = 0):
+        self.size = size
+        self.baseoffset = baseoffset
+        self.counts = np.zeros((size, 6), dtype=np.int32)
+        self.layers = np.zeros(size, dtype=np.int32)
+        self.has_clip = np.zeros(size, dtype=bool)
+        self.mincol = np.iinfo(np.int64).max
+        self.maxcol = 0
+
+    def update_min_max(self, minc: int, maxc: int) -> None:
+        if minc < self.mincol:
+            self.mincol = minc
+        if maxc > self.maxcol:
+            self.maxcol = maxc
+
+    def len(self) -> int:
+        return self.maxcol - self.mincol + 1
+
+    def best_char(self, col: int) -> int:
+        return best_char_from_counts(self.counts[col], int(self.layers[col]))
+
+
+class Msa:
+    """A multiple sequence alignment (reference GSeqAlign)."""
+
+    def __init__(self, s1: GapSeq | None = None, s2: GapSeq | None = None):
+        self.seqs: list[GapSeq] = []
+        self.length = 0
+        self.minoffset = 0
+        self.ng_len = 0
+        self.ng_minofs = 0
+        self.ordnum = 0
+        self.badseqs = 0
+        self.consensus = bytearray()
+        self.msacolumns: MsaColumns | None = None
+        self.refined = False
+        if s1 is not None and s2 is not None:
+            s1.msa = self
+            s2.msa = self
+            self.seqs = [s1, s2]
+            self.minoffset = min(s1.offset, s2.offset)
+            self.ng_minofs = self.minoffset
+            self.length = max(s1.end_offset(), s2.end_offset()) - self.minoffset
+            self.ng_len = max(s1.end_ng_offset(), s2.end_ng_offset()) \
+                - self.ng_minofs
+
+    def count(self) -> int:
+        return len(self.seqs)
+
+    # ---- membership / offsets ------------------------------------------
+    def add_seq(self, s: GapSeq, soffs: int, ngofs: int) -> None:
+        """(GSeqAlign::addSeq, GapAssem.cpp:694-716)"""
+        s.offset = soffs
+        s.ng_ofs = ngofs
+        s.msa = self
+        self.seqs.append(s)
+        if soffs < self.minoffset:
+            self.length += self.minoffset - soffs
+            self.minoffset = soffs
+        if ngofs < self.ng_minofs:
+            self.ng_len += self.ng_minofs - ngofs
+            self.ng_minofs = ngofs
+        if s.end_offset() - self.minoffset > self.length:
+            self.length = s.end_offset() - self.minoffset
+        if s.end_ng_offset() - self.ng_minofs > self.ng_len:
+            self.ng_len = s.end_ng_offset() - self.ng_minofs
+
+    # ---- gap propagation -----------------------------------------------
+    def _alpos_of(self, seq: GapSeq, pos: int) -> int:
+        """Layout position of seq[pos]
+        (the alpos computation, GapAssem.cpp:721-725)."""
+        return seq.offset + pos + int(np.sum(seq.gaps[:pos + 1]))
+
+    def inject_gap(self, seq: GapSeq, pos: int, xgap: int) -> None:
+        """Propagate a gap in ``seq`` at ``pos`` through every member
+        (GSeqAlign::injectGap, GapAssem.cpp:720-753)."""
+        alpos = self._alpos_of(seq, pos)
+        for s in self.seqs:
+            if s is seq:
+                spos = pos
+            else:
+                if s.offset >= alpos:
+                    s.offset += xgap
+                    continue
+                spos = s.find_walk_pos(alpos)
+                if spos >= s.seqlen:
+                    continue
+            s.add_gap(spos, xgap)
+        self.length += xgap
+
+    def remove_column(self, column: int) -> None:
+        """Delete one layout column from every member
+        (GSeqAlign::removeColumn, GapAssem.cpp:755-779)."""
+        alpos = column + self.minoffset
+        for s in self.seqs:
+            if s.offset >= alpos:
+                s.offset -= 1
+                continue
+            spos = s.find_walk_pos(alpos)
+            if spos >= s.seqlen:
+                continue
+            s.remove_base(spos)
+        self.length -= 1
+
+    def remove_base(self, seq: GapSeq, pos: int) -> None:
+        """(GSeqAlign::removeBase, GapAssem.cpp:781-812)"""
+        alpos = self._alpos_of(seq, pos)
+        for s in self.seqs:
+            if s is seq:
+                spos = pos
+            else:
+                if s.offset >= alpos:
+                    s.offset -= 1
+                    continue
+                spos = s.find_walk_pos(alpos)
+                if spos >= s.seqlen:
+                    continue
+            s.remove_base(spos)
+        self.length -= 1
+
+    # ---- merging --------------------------------------------------------
+    def add_align(self, seq: GapSeq, omsa: "Msa", oseq: GapSeq) -> bool:
+        """Merge ``omsa`` into this MSA through the shared sequence
+        ``seq``/``oseq`` (same id/length), propagating gap differences both
+        ways (GSeqAlign::addAlign, GapAssem.cpp:645-690)."""
+        if seq.seqlen != oseq.seqlen:
+            raise PwasmError(
+                f"GSeqAlign Error: invalid merge {seq.name}"
+                f"(len {seq.seqlen}) vs {oseq.name}(len {oseq.seqlen})\n")
+        if seq.revcompl != oseq.revcompl:
+            omsa.rev_complement()
+        for i in range(seq.seqlen):
+            d = seq.gap(i) - oseq.gap(i)
+            if d > 0:
+                omsa.inject_gap(oseq, i, d)
+            elif d < 0:
+                self.inject_gap(seq, i, -d)
+        for s in omsa.seqs:
+            if s is oseq:
+                continue
+            self.add_seq(s, seq.offset + s.offset - oseq.offset,
+                         seq.ng_ofs + s.ng_ofs - oseq.ng_ofs)
+        return True
+
+    def rev_complement(self) -> None:
+        """(GSeqAlign::revComplement, GapAssem.cpp:998-1004)"""
+        for s in self.seqs:
+            s.rev_complement(self.length)
+        self.seqs.sort(key=lambda s: s.offset)
+
+    def finalize(self) -> None:
+        """prepSeq every member (GSeqAlign::finalize,
+        GapAssem.cpp:1006-1012)."""
+        for s in self.seqs:
+            if len(s.seq) == 0:
+                raise PwasmError(
+                    f"Error: sequence for {s.name} not loaded!\n")
+            if not s.has_flag(FLAG_PREPPED):
+                s.prep_seq()
+
+    # ---- pileup / consensus --------------------------------------------
+    def _seq_to_columns(self, s: GapSeq, cols: MsaColumns) -> None:
+        """Pour one sequence into the column pileup (GASeq::toMSA,
+        GapAssem.cpp:551-591) — vectorized scatter-adds."""
+        if len(s.seq) == 0 or len(s.seq) != s.seqlen:
+            raise PwasmError(
+                f"GapSeq toMSA Error: invalid sequence data '{s.name}' "
+                f"(len={len(s.seq)}, seqlen={s.seqlen})\n")
+        clipL, clipR = s.clip_lr()
+        gaps = s.gaps.astype(np.int64)
+        base_cols = (s.offset - self.minoffset
+                     + np.arange(s.seqlen, dtype=np.int64) + np.cumsum(gaps))
+        idx = np.arange(s.seqlen)
+        clipped = (idx < clipL) | (idx >= s.seqlen - clipR)
+        codes = _BUCKET[np.frombuffer(bytes(s.seq), dtype=np.uint8)].astype(
+            np.int64)
+        unclipped = ~clipped
+        # nucleotides (clipped ones only set the witness flag)
+        np.add.at(cols.counts, (base_cols[unclipped], codes[unclipped]), 1)
+        np.add.at(cols.layers, base_cols[unclipped], 1)
+        cols.has_clip[base_cols[clipped]] = True
+        # gap columns before each unclipped base
+        gmask = unclipped & (gaps > 0)
+        if gmask.any():
+            gi = np.nonzero(gmask)[0]
+            gcols = np.concatenate(
+                [np.arange(base_cols[i] - gaps[i], base_cols[i])
+                 for i in gi])
+            np.add.at(cols.counts, (gcols, np.full(len(gcols), 5)), 1)
+            np.add.at(cols.layers, gcols, 1)
+        # min/max over the unclipped span: mincol includes the gap run
+        # before the first unclipped base (GapAssem.cpp:565-590)
+        if unclipped.any():
+            first = int(np.argmax(unclipped))
+            last = s.seqlen - 1 - int(np.argmax(unclipped[::-1]))
+            mincol = int(base_cols[first] - max(int(gaps[first]), 0))
+            maxcol = int(base_cols[last])
+            cols.update_min_max(mincol, maxcol)
+
+    def build_msa(self) -> None:
+        """(GSeqAlign::buildMSA, GapAssem.cpp:1088-1106)"""
+        if self.msacolumns is not None:
+            raise PwasmError("Error: cannot call buildMSA() twice!\n")
+        self.msacolumns = MsaColumns(self.length, self.minoffset)
+        for i, s in enumerate(self.seqs):
+            s.msaidx = i
+            if s.seqlen - s.clp3 - s.clp5 < 1:
+                print(f"Warning: sequence {s.name} (length {s.seqlen}) was "
+                      f"trimmed too badly ({s.clp5},{s.clp3}) -- should be "
+                      f"removed from MSA w/ {self.seqs[0].name}!",
+                      file=sys.stderr)
+                s.set_flag(FLAG_BAD_ALN)
+                self.badseqs += 1
+            self._seq_to_columns(s, self.msacolumns)
+
+    def _err_zero_cov(self, col: int) -> None:
+        """(GSeqAlign::ErrZeroCov, GapAssem.cpp:1121-1131; exit 5)"""
+        print(f"WARNING: 0 coverage column {col} "
+              f"(mincol={self.msacolumns.mincol}) found within alignment "
+              f"of {self.count()} seqs!", file=sys.stderr)
+        for s in self.seqs:
+            print(s.name, file=sys.stderr)
+        raise ZeroCoverageError(f"zero-coverage column {col}")
+
+    def refine_msa(self, remove_cons_gaps: bool = True,
+                   refine_clipping: bool = True) -> None:
+        """Consensus construction + clipping refinement driver
+        (GSeqAlign::refineMSA, GapAssem.cpp:1133-1183).  The two flags are
+        the reference's MSAColumns statics; pafreport runs with
+        remove_cons_gaps=False (SURVEY.md §2.5.8)."""
+        self.build_msa()
+        cols = self.msacolumns
+        cols_removed = 0
+        consensus = bytearray()
+        for col in range(cols.mincol, cols.maxcol + 1):
+            c = cols.best_char(col)
+            if c == 0:
+                self._err_zero_cov(col)
+            if c in (ord("-"), ord("*")):
+                if remove_cons_gaps:
+                    self.remove_column(col - cols_removed)
+                    cols_removed += 1
+                    continue
+                c = ord("*")
+            consensus.append(c)
+        self.consensus = consensus
+        for s in self.seqs:
+            if refine_clipping:
+                s.refine_clipping(bytes(self.consensus),
+                                  s.offset - self.minoffset - cols.mincol)
+            grem = s.remove_clip_gaps() if remove_cons_gaps else 0
+            if grem != 0 and refine_clipping:
+                s.refine_clipping(bytes(self.consensus),
+                                  s.offset - self.minoffset - cols.mincol,
+                                  skip_dels=True)
+        self.refined = True
+
+    # ---- clipping transaction (library capability) ---------------------
+    def eval_clipping(self, seq: GapSeq, c5: int, c3: int, clipmax: float,
+                      clipops: "AlnClipOps") -> bool:
+        """Propagate a proposed end-trim of ``seq`` to every member,
+        refusing if any member would be over-clipped
+        (GSeqAlign::evalClipping, GapAssem.cpp:823-996)."""
+        if c5 >= 0:
+            pos = seq.seqlen - c5 - 1 if seq.revcompl != 0 else c5
+            alpos = self._alpos_of(seq, pos)
+            for s in self.seqs:
+                if s is seq:
+                    if not clipops.add5(s, c5, clipmax):
+                        return False
+                    continue
+                if s.offset >= alpos:
+                    if seq.revcompl != 0:
+                        return False  # s would be clipped entirely
+                    continue
+                spos = s.find_walk_pos(alpos)
+                if spos >= s.seqlen:
+                    if seq.revcompl == 0:
+                        return False
+                    continue
+                if seq.revcompl != 0:  # trimming the right side of the msa
+                    if s.revcompl != 0:
+                        if not clipops.add5(s, s.seqlen - spos - 1, clipmax):
+                            return False
+                    else:
+                        if not clipops.add3(s, s.seqlen - spos - 1, clipmax):
+                            return False
+                else:  # trimming the left side
+                    if s.revcompl != 0:
+                        if not clipops.add3(s, spos, clipmax):
+                            return False
+                    else:
+                        if not clipops.add5(s, spos, clipmax):
+                            return False
+        if c3 >= 0:
+            pos = c3 if seq.revcompl != 0 else seq.seqlen - c3 - 1
+            alpos = self._alpos_of(seq, pos)
+            for s in self.seqs:
+                if s is seq:
+                    if not clipops.add3(s, c3, clipmax):
+                        return False
+                    continue
+                if s.offset >= alpos:
+                    if seq.revcompl == 0:
+                        return False
+                    continue
+                spos = s.find_walk_pos(alpos)
+                if spos >= s.seqlen:
+                    if seq.revcompl != 0:
+                        return False
+                    continue
+                if seq.revcompl != 0:  # trim left side
+                    if s.revcompl != 0:
+                        if not clipops.add3(s, spos, clipmax):
+                            return False
+                    else:
+                        if not clipops.add5(s, spos, clipmax):
+                            return False
+                else:  # trim right side
+                    if s.revcompl != 0:
+                        if not clipops.add5(s, s.seqlen - spos - 1, clipmax):
+                            return False
+                    else:
+                        if not clipops.add3(s, s.seqlen - spos - 1, clipmax):
+                            return False
+        return True
+
+    def apply_clipping(self, clipops: "AlnClipOps") -> None:
+        """(GSeqAlign::applyClipping, GapAssem.cpp:814-822)"""
+        for s, clp5, clp3 in clipops.ops:
+            if clp5 >= 0:
+                s.clp5 = clp5
+            if clp3 >= 0:
+                s.clp3 = clp3
+
+    # ---- output ---------------------------------------------------------
+    def print_layout(self, f: IO[str], sep: str = "") -> None:
+        """Debug layout view (GSeqAlign::print, GapAssem.cpp:1013-1037)."""
+        self.finalize()
+        width = max((len(s.name) for s in self.seqs), default=0)
+        if sep:
+            f.write(f"{'':>{width}}   " + sep * self.length + "\n")
+        for s in self.seqs:
+            orientation = "-" if s.revcompl == 1 else "+"
+            f.write(f"{s.name:>{width}} {orientation} ")
+            s.print_gapped_seq(f, self.minoffset)
+
+    def write_msa(self, f: IO[str], linelen: int = 60) -> None:
+        """Multifasta MSA (GSeqAlign::writeMSA, GapAssem.cpp:1039-1046)."""
+        self.finalize()
+        for s in self.seqs:
+            s.print_mfasta(f, linelen)
+
+    def write_ace(self, f: IO[str], name: str,
+                  remove_cons_gaps: bool = True,
+                  refine_clipping: bool = True) -> None:
+        """ACE contig output (GSeqAlign::writeACE, GapAssem.cpp:1200-1262)."""
+        if not self.refined:
+            self.refine_msa(remove_cons_gaps, refine_clipping)
+        fwd = sum(1 for s in self.seqs if s.revcompl == 0)
+        rvs = self.count() - fwd
+        cons_dir = "C" if rvs > fwd else "U"
+        f.write(f"CO {name} {len(self.consensus)} {self.count()} 0 "
+                f"{cons_dir}\n")
+        cons = self.consensus.decode("ascii", "replace")
+        for i in range(0, len(cons), 60):
+            f.write(cons[i:i + 60] + "\n")
+        f.write("\nBQ \n\n")
+        mincol = self.msacolumns.mincol
+        for s in self.seqs:
+            sc = "U" if s.revcompl == 0 else "C"
+            f.write(f"AF {s.name} {sc} "
+                    f"{s.offset - self.minoffset - mincol + 1}\n")
+        f.write("\n")
+        for s in self.seqs:
+            gapped_len = s.seqlen + s.numgaps
+            f.write(f"RD {s.name} {gapped_len} 0 0\n")
+            s.print_gapped_fasta(f)
+            clpl, clpr = s.clip_lr()
+            l, r = clpl, clpr
+            for j in range(1, r + 1):
+                clpr += int(s.gaps[s.seqlen - j])
+            for j in range(l + 1):
+                clpl += int(s.gaps[j])
+            seql = clpl + 1
+            seqr = gapped_len - clpr
+            if seqr < seql:
+                print(f"Bad trimming for {s.name} of gapped len "
+                      f"{gapped_len} ({seql}, {seqr})", file=sys.stderr)
+                seqr = seql + 1
+            f.write(f"\nQA {seql} {seqr} {seql} {seqr}\nDS \n\n")
+
+    def write_info(self, f: IO[str], name: str,
+                   remove_cons_gaps: bool = True,
+                   refine_clipping: bool = True) -> None:
+        """Contig-info output with per-seq pid and run-length alndata
+        (GSeqAlign::writeInfo, GapAssem.cpp:1264-1367).
+
+        Parity notes (we mirror the code, not the comments):
+        - the reference's comment documents alndata as '5g4d2g2-30d12g'
+          (offsets before every indel) but the code only emits the
+          '<ofs><type><len>-' form for indels longer than 2; short indels
+          emit bare type characters (GapAssem.cpp:1337-1344);
+        - ``asml``/``asmr`` carry a double '+1' (GapAssem.cpp:1305-1307),
+          so the pid comparison reads the consensus shifted one column
+          right of the sequence — pid is systematically understated
+          (usually 0 for perfect alignments)."""
+        if not self.refined:
+            self.refine_msa(remove_cons_gaps, refine_clipping)
+        cons = self.consensus.decode("ascii", "replace")
+        f.write(f">{name} {self.count()} {cons}\n")
+        mincol = self.msacolumns.mincol
+        for s in self.seqs:
+            gapped_len = s.seqlen + s.numgaps
+            seqoffset = s.offset - self.minoffset - mincol + 1
+            clpl, clpr = s.clip_lr()
+            asml = seqoffset + 1
+            asmr = asml - 1
+            pid = 0.0
+            aligned_len = 0
+            indel_ofs = 0
+            alndata: list[str] = []
+            for j in range(s.clp5, s.seqlen - s.clp3):
+                indel = int(s.gaps[j])
+                indel_type = ""
+                asmr += indel + 1
+                if indel < 0:
+                    indel_type = "d"
+                    indel = -indel
+                else:
+                    if indel > 0:
+                        indel_type = "g"
+                    else:
+                        indel_ofs += 1
+                    if (0 <= asmr - 1 < len(cons)
+                            and chr(s.seq[j]).upper()
+                            == cons[asmr - 1].upper()):
+                        pid += 1
+                    aligned_len += 1
+                if indel_type:
+                    if indel > 2:
+                        alndata.append(f"{indel_ofs}{indel_type}{indel}-")
+                    else:
+                        alndata.append(indel_type * indel)
+                    indel_ofs = 0
+            pid = (pid * 100.0) / aligned_len if aligned_len else 0.0
+            seql = clpl + 1
+            seqr = len(s.seq) - clpr
+            if seqr < seql:
+                print(f"WARNING: Bad trimming for {s.name} of gapped len "
+                      f"{gapped_len} ({seql}, {seqr})", file=sys.stderr)
+                seqr = seql + 1
+            if s.revcompl:
+                seql, seqr = seqr, seql
+            f.write(f"{s.name} {len(s.seq)} {seqoffset} {asml} {asmr} "
+                    f"{seql} {seqr} {pid:4.2f} {''.join(alndata)}\n")
+
+
+class AlnClipOps:
+    """Staged clipping transaction (reference AlnClipOps,
+    GapAssem.h:183-253): collect per-seq clip updates, refusing any that
+    exceed ``clipmax`` or leave a read under 25% of its length."""
+
+    def __init__(self):
+        self.ops: list[tuple[GapSeq, int, int]] = []
+        self.total = 0
+        self.d5 = 0
+        self.d3 = 0
+        self.q_rev = False
+
+    @staticmethod
+    def _maxovh(s: GapSeq, clipmax: float) -> int:
+        return int(clipmax) if clipmax > 1 else int(round(
+            clipmax * float(s.seqlen)))
+
+    def add5(self, s: GapSeq, clp: int, clipmax: float) -> bool:
+        if s.clp5 < clp:
+            if clipmax > 0 and clp > self._maxovh(s, clipmax):
+                return False
+            if s.seqlen - s.clp3 - clp < (s.seqlen >> 2):
+                return False
+            self.total += 10000 + clp - s.clp5
+            self.ops.append((s, clp, -1))
+        return True
+
+    def add3(self, s: GapSeq, clp: int, clipmax: float) -> bool:
+        if s.clp3 < clp:
+            if clipmax > 0 and clp > self._maxovh(s, clipmax):
+                return False
+            if s.seqlen - s.clp5 - clp < (s.seqlen >> 2):
+                return False
+            self.total += 10000 + clp - s.clp3
+            self.ops.append((s, -1, clp))
+        return True
+
+    def add(self, s: GapSeq, clp5: int, clp3: int, clipmax: float) -> bool:
+        newclp5 = -1
+        newclp3 = -1
+        addsc = 0
+        if s.clp5 < clp5:
+            if clipmax > 0 and clp5 > self._maxovh(s, clipmax):
+                return False
+            if s.seqlen - s.clp3 - clp5 < (s.seqlen >> 2):
+                return False
+            addsc += 10000 + clp5 - s.clp5
+            newclp5 = clp5
+        else:
+            clp5 = s.clp5
+        if s.clp3 < clp3:
+            if clipmax > 0 and clp3 > self._maxovh(s, clipmax):
+                return False
+            if s.seqlen - clp5 - clp3 < (s.seqlen >> 2):
+                return False
+            addsc += 10000 + clp3 - s.clp3
+            newclp3 = clp3
+        if addsc > 0:
+            self.total += addsc
+            self.ops.append((s, newclp5, newclp3))
+        return True

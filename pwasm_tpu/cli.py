@@ -1,0 +1,338 @@
+"""pafreport-compatible command line front end.
+
+Mirrors the reference driver (pafreport.cpp:175-460): flag parsing with the
+same optstring semantics, mode auto-selection by query FASTA file size,
+per-(query,target) dedup in gene mode, refseq caching with an RC copy,
+per-line diff extraction + report emission, and progressive MSA construction
+under ``-w``.  Adds ``--device={cpu,tpu}``, ``--band``, ``--batch``,
+``--motifs=FILE`` and an implemented ``-s`` summary (the reference parses
+``-s`` but never writes it, SURVEY.md §2.5.1).
+
+Usage:
+  python -m pwasm_tpu.cli <paf_with_cg_cs> -r <refseq.fa> [-s <summary.txt>]
+      [-o <diff_report.dfa>] [-w <outfile.mfa>] [-G|-F|-C|-N] [-D] [-v]
+      [-c <clipmax>] [--device=cpu|tpu] [--motifs=FILE]
+"""
+
+from __future__ import annotations
+
+import sys
+
+from pwasm_tpu.core.config import (AUTO_FULLGENOME_FASTA_BYTES, Config,
+                                   load_motifs)
+from pwasm_tpu.core.dna import revcomp
+from pwasm_tpu.core.errors import EXIT_USAGE, PwasmError
+from pwasm_tpu.core.events import extract_alignment
+from pwasm_tpu.core.fasta import FastaFile
+from pwasm_tpu.core.paf import AlnInfo, _atoi, parse_paf_line
+from pwasm_tpu.report.diff_report import Summary, print_diff_info
+
+USAGE = """Usage:
+ pafreport <paf_with_cg_cs> -r <refseq.fa> [-s <summary.txt>]
+    [-o <diff_report.dfa>][-w <outfile.mfa>] [-G|-F|-C|-N]
+    [--device=cpu|tpu] [--band=N] [--batch=N] [--motifs=FILE]
+
+   <paf_with_cg_cs> is the input PAF file with high quality query sequence(s)
+      aligned to many target sequences using minimap2 --cs
+   -r provide the fasta file with query sequence(s) (required)
+   -o write difference data for each alignment into <diff_report.dfa>
+   -s write event summary counts into <summary.txt>
+   -w write MSA as multifasta into <outfile.mfa>
+   -G gene CDS analysis mode (default for query<100K; assumes -C)
+   -F full genome alignment mode (default for query>100Kb; assumes -N)
+   -C perform codon impact analysis
+   -N skip codon impact analysis
+"""
+
+# reference optstring: "DGFCNvd:p:r:o:m:w:c:s:" — -d/-p/-m take a value but
+# are never read (quirk SURVEY.md §2.5.2)
+_BOOL_FLAGS = set("DGFCNvh")
+_VALUE_FLAGS = set("dprmowcs")
+
+
+class CliError(PwasmError):
+    exit_code = EXIT_USAGE
+
+
+def _parse_args(argv: list[str]) -> tuple[dict, list[str]]:
+    """GArgs-style parser: single-letter flags (joined or separated values)
+    plus --long=value options."""
+    opts: dict[str, str | bool] = {}
+    positional: list[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a.startswith("--"):
+            if "=" in a:
+                k, v = a[2:].split("=", 1)
+                opts[k] = v
+            else:
+                opts[a[2:]] = True
+        elif a.startswith("-") and len(a) > 1:
+            j = 1
+            while j < len(a):
+                ch = a[j]
+                if ch in _BOOL_FLAGS:
+                    opts[ch] = True
+                    j += 1
+                elif ch in _VALUE_FLAGS:
+                    if j + 1 < len(a):
+                        opts[ch] = a[j + 1:]
+                    else:
+                        i += 1
+                        if i >= len(argv):
+                            raise CliError(
+                                f"{USAGE}\nInvalid argument: -{ch}\n")
+                        opts[ch] = argv[i]
+                    j = len(a)
+                else:
+                    raise CliError(f"{USAGE}\nInvalid argument: {a}\n")
+        else:
+            positional.append(a)
+        i += 1
+    return opts, positional
+
+
+def _parse_clipmax(s: str, verbose: bool) -> float:
+    """-c parsing (pafreport.cpp:217-240)."""
+    ispercent = s.endswith("%")
+    if ispercent:
+        s = s.rstrip("%")
+    c = _atoi(s)  # GStr::asInt has C atoi semantics: "12x" parses as 12
+    if c <= 0:
+        raise PwasmError(
+            f"Error: invalid -c <clipmax> ({c}) option provided (must be "
+            "a positive integer)!\n")
+    if ispercent and c > 99:
+        raise PwasmError(
+            f"Error: invalid percent value ({c}) for -c option "
+            " (must be an integer between 1 and 99)!\n")
+    if ispercent:
+        clipmax = float(c) / 100
+        if verbose:
+            print(f"Percentual max clipping set to {c}%", file=sys.stderr)
+        return clipmax
+    if verbose:
+        print(f"Max clipping set to {c} bases", file=sys.stderr)
+    return float(c)
+
+
+def run(argv: list[str], stdout=None, stderr=None) -> int:
+    stdout = stdout or sys.stdout
+    stderr = stderr or sys.stderr
+    opts, positional = _parse_args(argv)
+    if opts.get("h"):
+        stderr.write(USAGE + "\n")
+        return EXIT_USAGE
+
+    cfg = Config()
+    cfg.debug = bool(opts.get("D"))
+    cfg.fullgenome = bool(opts.get("F"))
+    gene_cds = bool(opts.get("G"))
+    if cfg.fullgenome and gene_cds:
+        stderr.write(f"{USAGE} Error: cannot use both -G and -F!\n")
+        return EXIT_USAGE
+    force_coding = bool(opts.get("C"))
+    force_noncoding = bool(opts.get("N"))
+    if force_coding and force_noncoding:
+        stderr.write(f"{USAGE} Error: cannot use both -N and -C!\n")
+        return EXIT_USAGE
+    cfg.verbose = bool(opts.get("v")) or cfg.debug
+    cfg.gene_cds = gene_cds
+    cfg.device = str(opts.get("device", "cpu"))
+    for knob in ("band", "batch"):
+        if knob in opts:
+            val = opts[knob]
+            if val is True or not str(val).isascii() \
+                    or not str(val).isdigit():
+                raise CliError(
+                    f"{USAGE}\nInvalid --{knob} value: {val}\n")
+            setattr(cfg, knob, int(val))
+    if "motifs" in opts:
+        cfg.motifs = load_motifs(str(opts["motifs"]))
+
+    infile = positional[0] if positional else None
+    inf = sys.stdin
+    try:
+        if infile:
+            try:
+                inf = open(infile)
+            except OSError:
+                raise PwasmError(f"Cannot open input file {infile}!\n")
+        if "c" in opts:
+            cfg.clipmax = _parse_clipmax(str(opts["c"]), cfg.verbose)
+        try:
+            freport = open(str(opts["o"]), "w") if "o" in opts else stdout
+        except OSError:
+            raise PwasmError(
+                f"Cannot open file {opts['o']} for writing!\n")
+        rpath = opts.get("r")
+        if not rpath:
+            raise PwasmError("Error: query FASTA file (-r) is required!\n")
+        try:
+            qfasta = FastaFile(str(rpath))
+        except OSError:
+            raise PwasmError(f"Error: invalid FASTA file {rpath} !\n")
+        fsize = qfasta.file_size()
+        if fsize <= 0:
+            raise PwasmError(f"Error: invalid FASTA file {rpath} !\n")
+        if not cfg.fullgenome and not gene_cds \
+                and fsize > AUTO_FULLGENOME_FASTA_BYTES:
+            cfg.fullgenome = True
+        cfg.skip_codan = cfg.fullgenome or force_noncoding
+        if not cfg.skip_codan and not force_coding \
+                and fsize > AUTO_FULLGENOME_FASTA_BYTES:
+            cfg.skip_codan = True
+        fmsa = None
+        if "w" in opts:
+            if cfg.fullgenome:
+                stderr.write(
+                    f"{USAGE} Error: can only generate MSA for -G mode!\n")
+                return EXIT_USAGE
+            try:
+                fmsa = open(str(opts["w"]), "w")
+            except OSError:
+                raise PwasmError(
+                    f"Cannot open file {opts['w']} for writing!\n")
+        try:
+            fsummary = open(str(opts["s"]), "w") if "s" in opts else None
+        except OSError:
+            raise PwasmError(
+                f"Cannot open file {opts['s']} for writing!\n")
+        summary = Summary() if fsummary else None
+
+        return _main_loop(cfg, inf, freport, fmsa, fsummary, summary,
+                          qfasta, stdout, stderr)
+    except PwasmError as e:
+        stderr.write(str(e))
+        return e.exit_code
+    finally:
+        if inf is not sys.stdin:
+            inf.close()
+
+
+def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
+               qfasta: FastaFile, stdout, stderr) -> int:
+    """The per-PAF-line loop (pafreport.cpp:296-460)."""
+    from pwasm_tpu.align.gapseq import FLAG_IS_REF, GapSeq
+    from pwasm_tpu.align.msa import Msa
+
+    alnpairs: dict[str, int] = {}   # gene-mode (query~target) dedup counts
+    ref_cache: dict[str, bytes] = {}
+    refseq_id: str | None = None
+    refseq: bytes | None = None
+    refseq_rc: bytes | None = None
+    ref_gseq: GapSeq | None = None  # MSA instance of the current refseq
+    ref_msa: Msa | None = None
+    numalns = 0
+
+    for line in inf:
+        line = line.rstrip("\n")
+        if not line or line.startswith("#"):
+            continue
+        rec = parse_paf_line(line)
+        al: AlnInfo = rec.alninfo
+        if al.r_id == al.t_id:
+            if cfg.verbose:
+                print("Skipping alignment of qry seq to itself.",
+                      file=stderr)
+            continue
+        if not cfg.fullgenome:  # gene CDS mode: first q~t alignment only
+            key = f"{al.r_id}~{al.t_id}"
+            if key not in alnpairs:
+                alnpairs[key] = 0
+            else:
+                alnpairs[key] += 1
+                if alnpairs[key] == 1:
+                    print(f"Warning: alignment {al.r_id} to {al.t_id} "
+                          f"already seen, ignoring ", file=stderr)
+                continue
+        numalns += 1
+        if refseq_id is None or refseq_id != al.r_id:
+            if al.r_id in ref_cache:
+                refseq = ref_cache[al.r_id]
+            else:
+                fetched = qfasta.fetch(al.r_id)
+                if fetched is None:
+                    raise PwasmError(
+                        f"Error: could not retrieve sequence for "
+                        f"{al.r_id} !\n")
+                refseq = bytes(fetched).upper()
+                ref_cache[al.r_id] = refseq
+            refseq_rc = revcomp(refseq)
+            refseq_id = al.r_id
+            ref_gseq = None
+        if al.r_len != len(refseq):
+            raise PwasmError(
+                f"Error: ref seq len in this PAF line ({al.r_len}) differs "
+                f"from loaded sequence length({len(refseq)})!\n{line}\n")
+        refseq_aln = refseq_rc if al.reverse else refseq
+        aln = extract_alignment(rec, refseq_aln)
+        tlabel = f"{al.t_id}:{al.t_alnstart}-{al.t_alnend}" \
+            + ("-" if al.reverse else "+")
+        rlabel = al.r_id
+        if cfg.fullgenome:
+            rlabel += f":{al.r_alnstart}-{al.r_alnend}"
+        if freport is not None:
+            if len(qfasta) == 1 and not cfg.fullgenome:
+                rlabel = ""
+            print_diff_info(aln, rlabel, tlabel, freport, refseq,
+                            skip_codan=cfg.skip_codan, motifs=cfg.motifs,
+                            summary=summary)
+        if fmsa is not None:
+            taseq = GapSeq(tlabel, "", aln.tseq, offset=al.r_alnstart,
+                           revcompl=aln.reverse)
+            first_ref_aln = ref_gseq is None
+            if first_ref_aln:
+                rseq = GapSeq(al.r_id, "", refseq)
+                rseq.set_flag(FLAG_IS_REF)
+            else:
+                # bare instance of refseq for this alignment
+                rseq = GapSeq(al.r_id, "", b"", seqlen=al.r_len)
+            # once a gap, always a gap: propagate this alignment's gaps
+            for g in aln.rgaps:
+                rseq.set_gap(g.pos, g.len)
+            for g in aln.tgaps:
+                taseq.set_gap(g.pos, g.len)
+            newmsa = Msa(rseq, taseq)
+            if first_ref_aln:
+                newmsa.ordnum = numalns
+                ref_msa = newmsa
+                ref_gseq = rseq
+            else:
+                ref_gseq.msa.add_align(ref_gseq, newmsa, rseq)
+                ref_msa = ref_gseq.msa
+
+    if cfg.debug and ref_msa is not None:
+        print(f">MSA ({ref_msa.count()})", file=stderr)
+        ref_msa.print_layout(stderr, "v")
+    if fmsa is not None and ref_msa is not None:
+        ref_msa.write_msa(fmsa)
+        fmsa.close()
+    if fsummary is not None:
+        summary.write(fsummary)
+        fsummary.close()
+    if freport not in (stdout, None):
+        freport.close()
+    return 0
+
+
+def main() -> None:
+    try:
+        rc = run(sys.argv[1:])
+    except PwasmError as e:
+        sys.stderr.write(str(e))
+        rc = e.exit_code
+    except BrokenPipeError:
+        # downstream consumer (e.g. `head`) closed the pipe; exit quietly
+        # like the reference binary does on SIGPIPE
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        rc = 141  # 128 + SIGPIPE, the conventional shell status
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -54,7 +54,9 @@ class FastaFile:
                     seqlen = 0
                     seq_start = pos + linelen
                 elif name is not None:
-                    seqlen += len(line.strip())
+                    # count exactly the bytes fetch() will return (all
+                    # whitespace removed, not just line ends)
+                    seqlen += len(line.translate(None, b" \t\r\n\v\f"))
                 pos += linelen
             if name is not None:
                 self._add(name, seqlen, seq_start, pos)

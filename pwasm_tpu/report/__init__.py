@@ -1,0 +1,10 @@
+"""Report writers: the .dfa diff report, summary counters, MSA writers."""
+
+from pwasm_tpu.report.diff_report import (  # noqa: F401
+    get_ref_context,
+    hpoly_check,
+    mmotif_check,
+    predict_impact,
+    print_diff_info,
+    Summary,
+)

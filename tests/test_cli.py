@@ -1,0 +1,165 @@
+"""End-to-end CLI tests: report + MSA outputs, modes, exit codes."""
+
+import io
+import subprocess
+import sys
+
+from pwasm_tpu.cli import run
+from pwasm_tpu.core.fasta import write_fasta
+
+from helpers import make_paf_line
+
+Q = "ACGTACGTAC"
+
+
+def _mk_inputs(tmp_path, lines, qname="q", qseq=Q):
+    fa = tmp_path / "q.fa"
+    write_fasta(str(fa), [(qname, qseq.encode())])
+    paf = tmp_path / "in.paf"
+    paf.write_text("".join(l + "\n" for l in lines))
+    return str(paf), str(fa)
+
+
+def _three_alignments():
+    l1, _ = make_paf_line("q", Q, "asm1", "+",
+                          [("=", 6), ("ins", "gg"), ("=", 4)])
+    l2, _ = make_paf_line("q", Q, "asm2", "+",
+                          [("=", 2), ("del", 2), ("=", 6)])
+    l3, _ = make_paf_line("q", Q, "asm3", "-", [("=", 10)])
+    return [l1, l2, l3]
+
+
+def test_report_and_msa_end_to_end(tmp_path):
+    paf, fa = _mk_inputs(tmp_path, _three_alignments())
+    report = tmp_path / "out.dfa"
+    mfa = tmp_path / "out.mfa"
+    err = io.StringIO()
+    rc = run([paf, "-r", fa, "-o", str(report), "-w", str(mfa)],
+             stderr=err)
+    assert rc == 0
+    rep = report.read_text().splitlines()
+    assert rep[0] == ">asm1:0-12+ coverage:100.00 score=0 edit_distance=0"
+    assert rep[1].startswith("I\t7\t")
+    assert rep[2] == ">asm2:0-8+ coverage:100.00 score=0 edit_distance=0"
+    assert rep[3].startswith("D\t3\t")
+    assert rep[4] == ">asm3:0-10- coverage:100.00 score=0 edit_distance=0"
+    assert mfa.read_text() == (
+        ">q\nACGTAC--GTAC\n"
+        ">asm1:0-12+\nACGTACggGTAC\n"
+        ">asm2:0-8+\nAC--AC--GTAC\n"
+        ">asm3:0-10-\nACGTAC--GTAC\n")
+
+
+def test_gene_mode_dedup_warning(tmp_path):
+    lines = _three_alignments()
+    lines.append(lines[0])  # duplicate q~asm1
+    lines.append(lines[0])  # third occurrence: no extra warning
+    paf, fa = _mk_inputs(tmp_path, lines)
+    out = io.StringIO()
+    err = io.StringIO()
+    rc = run([paf, "-r", fa], stdout=out, stderr=err)
+    assert rc == 0
+    warnings = [l for l in err.getvalue().splitlines()
+                if "already seen" in l]
+    assert len(warnings) == 1  # warned only on the second occurrence
+    assert out.getvalue().count(">asm1") == 1
+
+
+def test_fullgenome_keeps_duplicates_and_rlabel(tmp_path):
+    lines = [_three_alignments()[0]] * 2
+    paf, fa = _mk_inputs(tmp_path, lines)
+    out = io.StringIO()
+    rc = run([paf, "-r", fa, "-F"], stdout=out, stderr=io.StringIO())
+    assert rc == 0
+    # -F: all alignments kept, rlabel prefixed, codon analysis skipped
+    body = out.getvalue()
+    assert body.count(">q:0-10--asm1:0-12+") == 2
+    assert body.splitlines()[1].endswith("\t")  # empty impact column
+
+
+def test_self_alignment_skipped(tmp_path):
+    line, _ = make_paf_line("q", Q, "q", "+", [("=", 10)])
+    paf, fa = _mk_inputs(tmp_path, [line])
+    out = io.StringIO()
+    rc = run([paf, "-r", fa, "-v"], stdout=out, stderr=io.StringIO())
+    assert rc == 0
+    assert out.getvalue() == ""
+
+
+def test_summary_output(tmp_path):
+    paf, fa = _mk_inputs(tmp_path, _three_alignments())
+    summ = tmp_path / "s.txt"
+    rc = run([paf, "-r", fa, "-s", str(summ), "-o", str(tmp_path / "r.dfa")],
+             stderr=io.StringIO())
+    assert rc == 0
+    body = summ.read_text()
+    assert "alignments\t3" in body
+    assert "insertions\t1\t2 bases" in body
+    assert "deletions\t1\t2 bases" in body
+
+
+def test_usage_errors(tmp_path):
+    paf, fa = _mk_inputs(tmp_path, _three_alignments())
+    err = io.StringIO()
+    assert run([paf, "-r", fa, "-G", "-F"], stderr=err) == 1
+    assert "cannot use both -G and -F" in err.getvalue()
+    err = io.StringIO()
+    assert run([paf, "-r", fa, "-C", "-N"], stderr=err) == 1
+    err = io.StringIO()
+    assert run([paf], stderr=err) == 1
+    assert "query FASTA file (-r) is required" in err.getvalue()
+    err = io.StringIO()
+    assert run(["/nonexistent.paf", "-r", fa], stderr=err) == 1
+    assert "Cannot open input file" in err.getvalue()
+    err = io.StringIO()
+    assert run([paf, "-r", fa, "-F", "-w", str(tmp_path / "x.mfa")],
+               stderr=err) == 1
+    assert "can only generate MSA for -G mode" in err.getvalue()
+
+
+def test_bad_clipmax(tmp_path):
+    paf, fa = _mk_inputs(tmp_path, _three_alignments())
+    err = io.StringIO()
+    assert run([paf, "-r", fa, "-c", "0"], stderr=err) == 1
+    assert "invalid -c" in err.getvalue()
+    err = io.StringIO()
+    assert run([paf, "-r", fa, "-c", "150%"], stderr=err) == 1
+
+
+def test_ref_len_mismatch_fatal(tmp_path):
+    line, _ = make_paf_line("q", Q, "asm1", "+", [("=", 10)])
+    # corrupt the query length field
+    f = line.split("\t")
+    f[1] = "11"
+    paf, fa = _mk_inputs(tmp_path, ["\t".join(f)])
+    err = io.StringIO()
+    rc = run([paf, "-r", fa], stdout=io.StringIO(), stderr=err)
+    assert rc == 1
+    assert "differs from loaded sequence length" in err.getvalue()
+
+
+def test_comment_lines_skipped(tmp_path):
+    lines = ["# a comment"] + _three_alignments()
+    paf, fa = _mk_inputs(tmp_path, lines)
+    assert run([paf, "-r", fa], stdout=io.StringIO(),
+               stderr=io.StringIO()) == 0
+
+
+def test_motifs_file(tmp_path):
+    paf, fa = _mk_inputs(tmp_path, _three_alignments())
+    mot = tmp_path / "motifs.txt"
+    mot.write_text("# custom\nACGTAC\n")
+    out = io.StringIO()
+    rc = run([paf, "-r", fa, f"--motifs={mot}"], stdout=out,
+             stderr=io.StringIO())
+    assert rc == 0
+    assert "motif ACGTAC" in out.getvalue()
+
+
+def test_subprocess_entry(tmp_path):
+    paf, fa = _mk_inputs(tmp_path, _three_alignments())
+    r = subprocess.run(
+        [sys.executable, "-m", "pwasm_tpu.cli", paf, "-r", fa],
+        capture_output=True, text=True, cwd="/root/repo")
+    assert r.returncode == 0
+    assert r.stdout.startswith(">asm1:0-12+")

@@ -1,0 +1,356 @@
+"""MSA engine tests: layout math, gap propagation, progressive merge,
+consensus voting, clip refinement, writers."""
+
+import io
+
+import numpy as np
+import pytest
+
+from pwasm_tpu.align.gapseq import GapSeq
+from pwasm_tpu.align.msa import AlnClipOps, Msa, best_char_from_counts
+from pwasm_tpu.core.errors import ZeroCoverageError
+
+
+def mk(name, seq, offset=0, **kw):
+    return GapSeq(name, "", seq, offset=offset, **kw)
+
+
+def mfa(msa):
+    buf = io.StringIO()
+    msa.write_msa(buf)
+    return buf.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# gap bookkeeping + layout walks
+# ---------------------------------------------------------------------------
+def test_set_add_gap_and_end_offset():
+    s = mk("s", b"ACGTACGT")
+    s.set_gap(2, 3)
+    assert s.numgaps == 3
+    s.set_gap(2, 1)          # set replaces
+    assert s.numgaps == 1
+    s.add_gap(5, 2)
+    assert s.numgaps == 3
+    assert s.end_offset() == 0 + 8 + 3
+
+
+def test_walk_positions_match_reference_walk():
+    s = mk("s", b"ACGTACGT", offset=3)
+    s.set_gap(2, 2)
+    s.set_gap(6, 1)
+    # reference walk: salpos starts at offset, += 1+gap each step
+    salpos = s.offset
+    expect = []
+    for j in range(s.seqlen):
+        salpos += 1 + s.gap(j)
+        expect.append(salpos)
+    assert list(s.layout_walk_positions()) == expect
+    # find_walk_pos stops at first W[j] > alpos
+    for alpos in range(0, 16):
+        j = 0
+        while j < s.seqlen and expect[j] <= alpos:
+            j += 1
+        assert s.find_walk_pos(alpos) == j
+
+
+def test_reverse_gaps_keeps_index0():
+    s = mk("s", b"ACGTA")
+    s.gaps[:] = [9, 1, 2, 3, 4]
+    s.reverse_gaps()
+    assert list(s.gaps) == [9, 4, 3, 2, 1]
+
+
+# ---------------------------------------------------------------------------
+# pairwise + inject_gap
+# ---------------------------------------------------------------------------
+def test_pairwise_layout_and_write():
+    r = mk("r", b"ACGTACGT")
+    t = mk("t", b"ACGTCGT")
+    t.set_gap(4, 1)  # gap before base 4: ACGT-CGT
+    msa = Msa(r, t)
+    assert msa.length == 8
+    out = mfa(msa)
+    assert out == ">r\nACGTACGT\n>t\nACGT-CGT\n"
+
+
+def test_inject_gap_propagates():
+    r = mk("r", b"ACGTACGT")
+    t = mk("t", b"ACGTACGT")
+    msa = Msa(r, t)
+    msa.inject_gap(r, 4, 2)
+    assert r.gap(4) == 2
+    assert t.gap(4) == 2
+    assert msa.length == 10
+    out = mfa(msa)
+    assert out == ">r\nACGT--ACGT\n>t\nACGT--ACGT\n"
+
+
+def test_inject_gap_offset_only_member():
+    r = mk("r", b"ACGTACGT")
+    t = mk("t", b"ACGT", offset=6)  # starts after the gap point
+    msa = Msa(r, t)
+    msa.inject_gap(r, 2, 1)
+    assert t.offset == 7
+    assert t.numgaps == 0
+
+
+# ---------------------------------------------------------------------------
+# progressive merge (the -w flow)
+# ---------------------------------------------------------------------------
+def test_progressive_merge_once_a_gap_always_a_gap():
+    q = b"ACGTACGTAC"
+    # aln1: target has 2bp insertion after q pos 6
+    rseq = mk("q", q)
+    rseq.set_gap(6, 2)
+    t1 = mk("asm1", b"ACGTACggGTAC")
+    msa = Msa(rseq, t1)
+    # aln2: target missing q[2:4]
+    rs2 = GapSeq("q", "", b"", seqlen=10)
+    t2 = mk("asm2", b"ACACGTAC")
+    t2.set_gap(2, 2)
+    m2 = Msa(rs2, t2)
+    msa.add_align(rseq, m2, rs2)
+    assert msa.count() == 3
+    out = mfa(msa)
+    assert out == (">q\nACGTAC--GTAC\n"
+                   ">asm1\nACGTACggGTAC\n"
+                   ">asm2\nAC--AC--GTAC\n")
+
+
+def test_progressive_merge_reverse_member():
+    q = b"ACGTACGTAC"
+    rseq = mk("q", q)
+    rseq.set_gap(6, 2)
+    t1 = mk("asm1", b"ACGTACggGTAC")
+    msa = Msa(rseq, t1)
+    # asm3: reverse-strand full-length exact match; bases stored in RC
+    # space, gaps indexed in forward space, prep_seq RCs at write time
+    from pwasm_tpu.core.dna import revcomp
+    rs3 = GapSeq("q", "", b"", seqlen=10)
+    t3 = GapSeq("asm3", "", revcomp(q), offset=0, revcompl=1)
+    m3 = Msa(rs3, t3)
+    msa.add_align(rseq, m3, rs3)
+    out = mfa(msa)
+    assert out.endswith(">asm3\nACGTAC--GTAC\n")
+
+
+# ---------------------------------------------------------------------------
+# consensus vote
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("counts,layers,expect", [
+    ([3, 1, 0, 0, 0, 0], 4, "A"),
+    ([0, 0, 0, 0, 0, 3], 3, "-"),
+    ([2, 0, 0, 0, 0, 2], 4, "A"),      # ACGT beats '-' on ties
+    ([0, 2, 0, 0, 2, 0], 4, "C"),      # ACGT beats N on ties
+    ([0, 0, 0, 0, 2, 2], 4, "-"),      # N tied with '-': '-' wins
+    ([0, 0, 0, 0, 2, 1], 3, "N"),
+    ([0, 0, 0, 0, 1, 2], 3, "-"),
+    ([1, 1, 1, 1, 0, 0], 4, "A"),      # first of ACGT wins ties
+    ([0, 2, 2, 0, 0, 0], 4, "C"),
+    ([0, 0, 0, 0, 0, 0], 0, None),     # zero coverage
+])
+def test_best_char_rule(counts, layers, expect):
+    got = best_char_from_counts(np.array(counts), layers)
+    assert got == (0 if expect is None else ord(expect))
+
+
+def test_refine_msa_consensus_simple():
+    a = mk("a", b"ACGTACGT")
+    b = mk("b", b"ACGTACGT")
+    c = mk("c", b"ACCTACGT")
+    msa = Msa(a, b)
+    msa.add_seq(c, 0, 0)
+    msa.refine_msa(remove_cons_gaps=False, refine_clipping=False)
+    assert bytes(msa.consensus) == b"ACGTACGT"
+
+
+def test_refine_msa_gap_column_kept_as_star():
+    # two seqs gap at a column, one base -> gap wins the vote
+    a = mk("a", b"ACGT")
+    b = mk("b", b"ACGT")
+    c = mk("c", b"ACXGT")  # extra base, others gap... build via inject
+    msa = Msa(a, b)
+    msa.add_seq(c, 0, 0)
+    msa.inject_gap(c, 2, 1)  # c's X column: a/b get gaps... wait
+    # inject gap in c at pos2 -> a,b,c all gap; instead use add_gap on a,b
+    # simpler direct construction below
+    a2 = mk("a", b"ACGT")
+    b2 = mk("b", b"ACGT")
+    c2 = mk("c", b"ACXGT")
+    a2.set_gap(2, 1)
+    b2.set_gap(2, 1)
+    m = Msa(a2, b2)
+    m.add_seq(c2, 0, 0)
+    m.refine_msa(remove_cons_gaps=False, refine_clipping=False)
+    assert bytes(m.consensus) == b"AC*GT"
+
+
+def test_refine_msa_remove_cons_gaps():
+    a2 = mk("a", b"ACGT")
+    b2 = mk("b", b"ACGT")
+    c2 = mk("c", b"ACXGT")
+    a2.set_gap(2, 1)
+    b2.set_gap(2, 1)
+    m = Msa(a2, b2)
+    m.add_seq(c2, 0, 0)
+    m.refine_msa(remove_cons_gaps=True, refine_clipping=False)
+    assert bytes(m.consensus) == b"ACGT"
+    # the X base was deleted from c
+    assert c2.gap(2) == -1
+    out = mfa(m)
+    assert ">c\nACGT\n" in out
+
+
+def test_zero_coverage_column_exit5():
+    a = mk("a", b"AC", offset=0)
+    b = mk("b", b"GT", offset=4)
+    msa = Msa(a, b)
+    with pytest.raises(ZeroCoverageError) as ei:
+        msa.refine_msa(remove_cons_gaps=False, refine_clipping=False)
+    assert ei.value.exit_code == 5
+
+
+# ---------------------------------------------------------------------------
+# X-drop clip refinement
+# ---------------------------------------------------------------------------
+def test_refine_clipping_recovers_matching_clip():
+    s = mk("s", b"ACGTACGT")
+    s.clp5 = 2
+    s.msa = None
+    s.refine_clipping(b"ACGTACGT", 0)
+    assert s.clp5 == 0
+
+
+def test_refine_clipping_keeps_mismatched_clip():
+    # clipped prefix disagrees with consensus: first backward search walks
+    # right to the first match, then extension can't beat it
+    s = mk("s", b"TTGTACGT")
+    s.clp5 = 2
+    s.refine_clipping(b"ACGTACGT", 0)
+    assert s.clp5 >= 2
+
+
+def test_refine_clipping_right_end():
+    s = mk("s", b"ACGTACGT")
+    s.clp3 = 3
+    s.refine_clipping(b"ACGTACGT", 0)
+    assert s.clp3 == 0
+
+
+# ---------------------------------------------------------------------------
+# clipping transaction
+# ---------------------------------------------------------------------------
+def test_eval_clipping_propagates():
+    a = mk("a", b"ACGTACGTACGTACGT")
+    b = mk("b", b"ACGTACGTACGTACGT")
+    msa = Msa(a, b)
+    ops = AlnClipOps()
+    assert msa.eval_clipping(a, 2, -1, 0.0, ops)
+    seqs = {id(s): (c5, c3) for s, c5, c3 in ops.ops}
+    assert seqs[id(a)] == (2, -1)
+    assert seqs[id(b)] == (2, -1)
+    msa.apply_clipping(ops)
+    assert a.clp5 == 2 and b.clp5 == 2
+
+
+def test_eval_clipping_rejects_over_25pct():
+    a = mk("a", b"ACGTACGTACGTACGT")   # 16bp; max clip leaves >= 4
+    b = mk("b", b"ACGTACGTACGTACGT")
+    msa = Msa(a, b)
+    ops = AlnClipOps()
+    assert not msa.eval_clipping(a, 13, -1, 0.0, ops)
+
+
+def test_eval_clipping_clipmax():
+    a = mk("a", b"ACGTACGTACGTACGT")
+    b = mk("b", b"ACGTACGTACGTACGT")
+    msa = Msa(a, b)
+    ops = AlnClipOps()
+    assert not msa.eval_clipping(a, 5, -1, 4.0, ops)   # absolute max 4
+    ops = AlnClipOps()
+    assert msa.eval_clipping(a, 4, -1, 4.0, ops)
+
+
+# ---------------------------------------------------------------------------
+# ACE / info writers
+# ---------------------------------------------------------------------------
+def _three_seq_msa():
+    a = mk("a", b"ACGTACGT")
+    b = mk("b", b"ACGTACGT")
+    c = mk("c", b"ACCTACGT")
+    msa = Msa(a, b)
+    msa.add_seq(c, 0, 0)
+    return msa
+
+
+def test_write_ace():
+    msa = _three_seq_msa()
+    buf = io.StringIO()
+    msa.write_ace(buf, "contig1", remove_cons_gaps=False,
+                  refine_clipping=False)
+    out = buf.getvalue()
+    lines = out.splitlines()
+    assert lines[0] == "CO contig1 8 3 0 U"
+    assert "ACGTACGT" in lines[1]
+    assert "AF a U 1" in out and "AF c U 1" in out
+    assert "RD a 8 0 0" in out
+    assert "QA 1 8 1 8" in out
+
+
+def test_write_info():
+    msa = _three_seq_msa()
+    buf = io.StringIO()
+    msa.write_info(buf, "contig1", remove_cons_gaps=False,
+                   refine_clipping=False)
+    out = buf.getvalue()
+    lines = out.splitlines()
+    assert lines[0] == ">contig1 3 ACGTACGT"
+    # reference quirk: asml/asmr double-increment shifts the pid comparison
+    # one column right (GapAssem.cpp:1305-1307), so even a perfect match
+    # scores 0.00 here — preserved for parity
+    assert lines[1] == "a 8 1 2 9 1 8 0.00 "
+    assert lines[3].startswith("c 8 1 2 9 1 8 0.00")
+
+
+def test_write_info_alndata_rle():
+    a = mk("a", b"ACGTACGT")
+    b = mk("b", b"ACGTACGT")
+    a.set_gap(4, 1)
+    b.set_gap(4, 1)
+    msa = Msa(a, b)
+    # gap of 1 -> bare 'g' (short-indel form, no offset prefix)
+    buf = io.StringIO()
+    msa.write_info(buf, "ctg", remove_cons_gaps=False,
+                   refine_clipping=False)
+    row = buf.getvalue().splitlines()[1]
+    assert row.split()[-1] == "g"
+    # long gap -> '<ofs>g<len>-' form
+    a2 = mk("a", b"ACGTACGT")
+    b2 = mk("b", b"ACGTACGT")
+    a2.set_gap(4, 5)
+    b2.set_gap(4, 5)
+    m2 = Msa(a2, b2)
+    buf = io.StringIO()
+    m2.write_info(buf, "ctg", remove_cons_gaps=False,
+                  refine_clipping=False)
+    row = buf.getvalue().splitlines()[1]
+    assert row.split()[-1] == "4g5-"
+
+
+def test_print_layout():
+    msa = _three_seq_msa()
+    buf = io.StringIO()
+    msa.print_layout(buf, "=")
+    out = buf.getvalue().splitlines()
+    assert out[0].endswith("=" * 8)
+    assert out[1].endswith("ACGTACGT")
+
+
+def test_mfasta_wrap_and_exact_multiple_blank_line():
+    s = mk("s", b"A" * 60)
+    buf = io.StringIO()
+    s.print_mfasta(buf, 60)
+    # exact multiple of the line length leaves the reference's trailing
+    # blank line (printMFasta quirk)
+    assert buf.getvalue() == ">s\n" + "A" * 60 + "\n\n"

@@ -1,0 +1,165 @@
+"""Diff-report layer tests: hand-computed golden rows + analysis functions.
+
+The worked example: query CDS ATGGCCTGGAAAGATCTGTACCTGA (25bp), one
+substitution inside a CCTGG motif, one 2bp deletion near a GATC motif
+causing a frame shift.
+"""
+
+import io
+
+import pytest
+
+from pwasm_tpu.core.dna import revcomp
+from pwasm_tpu.core.events import extract_alignment
+from pwasm_tpu.core.paf import parse_paf_line
+from pwasm_tpu.report.diff_report import (
+    Summary,
+    get_ref_context,
+    hpoly_check,
+    mmotif_check,
+    predict_impact,
+    print_diff_info,
+)
+
+Q = b"ATGGCCTGGAAAGATCTGTACCTGA"
+
+PAF1 = ("geneA\t25\t0\t25\t+\tasm1\t23\t0\t23\t23\t25\t60\t"
+        "NM:i:3\tAS:i:40\tcg:Z:12M2I11M\tcs:Z::6*ct:5+at:11")
+
+
+def _report(line, q=Q, skip_codan=False, rlabel="", tlabel="asm1:0-23+",
+            summary=None):
+    rec = parse_paf_line(line)
+    refseq_aln = revcomp(q) if rec.alninfo.reverse else q
+    aln = extract_alignment(rec, refseq_aln)
+    buf = io.StringIO()
+    print_diff_info(aln, rlabel, tlabel, buf, q, skip_codan=skip_codan,
+                    summary=summary)
+    return buf.getvalue()
+
+
+def test_worked_example_report():
+    out = _report(PAF1)
+    lines = out.splitlines()
+    assert lines[0] == ">asm1:0-23+ coverage:100.00 score=40 edit_distance=3"
+    assert lines[1] == ("S\t7\t3(W)\tT:C\t7\tTGGCCcGGAAA\tGGCCTGGAA\t"
+                        "motif CCTGG\tAA3|W:R")
+    assert lines[2] == ("D\t13\t5(D)\tGA:\t13\tGGAAATCTGT\tGAAAGATCT\t"
+                        "motif GATC\tframe shift DLY+:SVP+")
+
+
+def test_rlabel_header():
+    out = _report(PAF1, rlabel="geneA")
+    assert out.splitlines()[0].startswith(
+        ">geneA--asm1:0-23+ coverage:100.00")
+
+
+def test_skip_codan_empty_impact_column():
+    out = _report(PAF1, skip_codan=True)
+    # impact column present but empty -> line ends with a tab-separated
+    # status then empty field
+    assert out.splitlines()[1].endswith("motif CCTGG\t")
+
+
+def test_premature_stop_substitution():
+    # TGG (W, codon 3) -> TGA ('.'): sub at rloc 8, G->A
+    paf = ("geneA\t25\t0\t25\t+\tasm1\t25\t0\t25\t25\t25\t60\t"
+           "NM:i:1\tAS:i:44\tcg:Z:25M\tcs:Z::8*ag:16")
+    out = _report(paf)
+    row = out.splitlines()[1]
+    assert "AA3|W:.|premature stop at AA3" in row
+
+
+def test_synonymous_substitution():
+    # CTG (L, codons 16-18... rloc 15..17) -> CTA still L: sub T->A? take
+    # GCC (A) codon at 3-5 -> GCA (A): sub at rloc 5, C->A
+    paf = ("geneA\t25\t0\t25\t+\tasm1\t25\t0\t25\t25\t25\t60\t"
+           "NM:i:1\tAS:i:44\tcg:Z:25M\tcs:Z::5*ac:19")
+    out = _report(paf)
+    assert out.splitlines()[1].endswith("\tsynonymous")
+
+
+def test_insertion_premature_stop():
+    # insert TAA-forming frameshift right after codon boundary: insertion of
+    # 'ta' at rloc 12 -> downstream premature stop expected (frameshift)
+    paf = ("geneA\t25\t0\t25\t+\tasm1\t27\t0\t27\t25\t27\t60\t"
+           "NM:i:2\tAS:i:40\tcg:Z:12M2D13M\tcs:Z::12-ta:13")
+    out = _report(paf)
+    row = out.splitlines()[1]
+    assert row.startswith("I\t13\t")
+    assert ("premature stop" in row) or ("frame shift" in row)
+
+
+def test_get_ref_context_center_and_edges():
+    rctx, loc = get_ref_context(Q, 10)
+    assert rctx == Q[6:15].upper()
+    assert loc == 4
+    rctx, loc = get_ref_context(Q, 1)
+    assert rctx == Q[0:9]
+    assert loc == 1
+    rctx, loc = get_ref_context(Q, 24)
+    assert rctx == Q[16:25]
+    # reference quirk: at the right edge the shift is applied with the
+    # wrong sign (pafreport.cpp:726-728), so the local event offset comes
+    # out 0 instead of 8 — preserved for parity
+    assert loc == 0
+
+
+def test_hpoly_check():
+    #            012345678
+    rctx = b"ACAAAACGT"
+    assert hpoly_check(b"A", rctx, 4)
+    assert hpoly_check(b"AA", rctx, 4)
+    assert not hpoly_check(b"AG", rctx, 4)   # mixed bases
+    assert not hpoly_check(b"C", rctx, 4)    # no CCCC run
+    # run present but not overlapping the event position
+    assert hpoly_check(b"A", rctx, 2)
+    assert not hpoly_check(b"A", rctx, 8)    # l=2, l+4=6 < 8
+
+
+def test_mmotif_check():
+    idx, status = mmotif_check(b"GGCCTGGAA")
+    assert (idx, status) == (1, "motif CCTGG")
+    idx, status = mmotif_check(b"GAAAGATCT")
+    assert (idx, status) == (3, "motif GATC")
+    idx, status = mmotif_check(b"AAAAAAAAA")
+    assert (idx, status) == (0, "")
+    # first motif in table order wins
+    idx, status = mmotif_check(b"CCTGGGATC")
+    assert idx == 1
+
+
+def test_predict_impact_deletion_inframe():
+    # delete one full codon: no frameshift, no stop -> frame-shift text only
+    # if aa4/maa4 differ; an in-frame 3bp deletion shifts codons by one
+    from pwasm_tpu.core.events import DiffEvent
+    di = DiffEvent("D", 3, b"GAT", b"", rloc=12, tloc=12)
+    txt = predict_impact(di, Q, 9)
+    # downstream codons change (frame preserved but sequence shifted)
+    assert txt.startswith("frame shift") or txt == ""
+
+
+def test_summary_counters():
+    s = Summary()
+    _report(PAF1, summary=s)
+    assert s.alignments == 1
+    assert s.events == {"S": 1, "I": 0, "D": 1}
+    assert s.bases["D"] == 2
+    assert s.status["motif"] == 2
+    assert s.impact["frame_shift"] == 1
+    assert s.impact["nonsynonymous"] == 1
+    buf = io.StringIO()
+    s.write(buf)
+    assert "substitutions\t1" in buf.getvalue()
+
+
+def test_long_event_truncation():
+    # 15-base deletion -> evtbases displayed as [15]
+    ins = "".join("ACGT"[i % 4] for i in range(15))
+    paf = (f"geneA\t25\t0\t25\t+\tasm1\t40\t0\t40\t25\t40\t60\t"
+           f"NM:i:15\tAS:i:20\tcg:Z:12M15D13M\tcs:Z::12-{ins.lower()}:13")
+    out = _report(paf)
+    row = out.splitlines()[1]
+    assert "\t:[15]\t" in row
+    # tctx is 5 + 15 + 5 = 25 > 22 -> first5 + [len-10] + last5
+    assert "\tGGAAA[15]GATCT\t" in row
