@@ -224,9 +224,12 @@ class Msa:
                 s.prep_seq()
 
     # ---- pileup / consensus --------------------------------------------
-    def _seq_to_columns(self, s: GapSeq, cols: MsaColumns) -> None:
-        """Pour one sequence into the column pileup (GASeq::toMSA,
-        GapAssem.cpp:551-591) — vectorized scatter-adds."""
+    def _column_geometry(self, s: GapSeq):
+        """Shared layout math for the pileup builders: returns
+        (base_cols, unclipped mask, gap-run columns before unclipped
+        bases).  ``base_cols[i]`` is the layout column of base i under the
+        walk semantics (1 + gap per base; negative gaps collapse deleted
+        bases onto their neighbor's column)."""
         if len(s.seq) == 0 or len(s.seq) != s.seqlen:
             raise PwasmError(
                 f"GapSeq toMSA Error: invalid sequence data '{s.name}' "
@@ -236,21 +239,30 @@ class Msa:
         base_cols = (s.offset - self.minoffset
                      + np.arange(s.seqlen, dtype=np.int64) + np.cumsum(gaps))
         idx = np.arange(s.seqlen)
-        clipped = (idx < clipL) | (idx >= s.seqlen - clipR)
+        unclipped = ~((idx < clipL) | (idx >= s.seqlen - clipR))
+        gmask = unclipped & (gaps > 0)
+        if gmask.any():
+            gcols = np.concatenate(
+                [np.arange(base_cols[i] - gaps[i], base_cols[i])
+                 for i in np.nonzero(gmask)[0]])
+        else:
+            gcols = np.empty(0, dtype=np.int64)
+        return base_cols, unclipped, gcols
+
+    def _seq_to_columns(self, s: GapSeq, cols: MsaColumns) -> None:
+        """Pour one sequence into the column pileup (GASeq::toMSA,
+        GapAssem.cpp:551-591) — vectorized scatter-adds."""
+        base_cols, unclipped, gcols = self._column_geometry(s)
+        gaps = s.gaps.astype(np.int64)
         codes = _BUCKET[np.frombuffer(bytes(s.seq), dtype=np.uint8)].astype(
             np.int64)
-        unclipped = ~clipped
+        clipped = ~unclipped
         # nucleotides (clipped ones only set the witness flag)
         np.add.at(cols.counts, (base_cols[unclipped], codes[unclipped]), 1)
         np.add.at(cols.layers, base_cols[unclipped], 1)
         cols.has_clip[base_cols[clipped]] = True
         # gap columns before each unclipped base
-        gmask = unclipped & (gaps > 0)
-        if gmask.any():
-            gi = np.nonzero(gmask)[0]
-            gcols = np.concatenate(
-                [np.arange(base_cols[i] - gaps[i], base_cols[i])
-                 for i in gi])
+        if len(gcols):
             np.add.at(cols.counts, (gcols, np.full(len(gcols), 5)), 1)
             np.add.at(cols.layers, gcols, 1)
         # min/max over the unclipped span: mincol includes the gap run
@@ -261,6 +273,28 @@ class Msa:
             mincol = int(base_cols[first] - max(int(gaps[first]), 0))
             maxcol = int(base_cols[last])
             cols.update_min_max(mincol, maxcol)
+
+    def pileup_matrix(self) -> np.ndarray:
+        """Render the MSA as a (depth, length) int8 code matrix for the
+        device consensus path: A0 C1 G2 T3 N4, gap columns 5, and 6 (the
+        kernels' PAD_CODE) where a member contributes nothing (outside its
+        span, clipped, or a deleted base).  Device pileup counts over this
+        matrix equal the CPU column counts bit-for-bit.
+
+        Intended for pre-refine MSAs (no deleted bases).  With deleted
+        bases (negative gaps, post-refine) the cumsum layout collapses
+        dead bases onto neighboring columns; gap runs are written before
+        live bases so a live base always wins such a collision."""
+        mat = np.full((len(self.seqs), self.length), 6, dtype=np.int8)
+        for k, s in enumerate(self.seqs):
+            base_cols, unclipped, gcols = self._column_geometry(s)
+            gaps = s.gaps.astype(np.int64)
+            live = unclipped & (gaps >= 0)
+            codes = _BUCKET[np.frombuffer(bytes(s.seq), dtype=np.uint8)]
+            if len(gcols):
+                mat[k, gcols] = 5
+            mat[k, base_cols[live]] = codes[live]
+        return mat
 
     def build_msa(self) -> None:
         """(GSeqAlign::buildMSA, GapAssem.cpp:1088-1106)"""

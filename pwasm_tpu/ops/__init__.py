@@ -1,0 +1,23 @@
+"""JAX/Pallas device kernels.
+
+The three north-star kernels (SURVEY.md §7.1):
+
+- ``consensus``  — per-column ACGT/N/gap pileup counting + the reference's
+  consensus vote, as a pure-XLA path (works everywhere, vmap/pjit friendly)
+  and a Pallas TPU kernel.
+- ``banded_dp``  — batched banded affine-gap DP, anti-diagonal wavefront.
+- ``ctx_scan``   — vectorized variant-context scan: 9bp windows,
+  homopolymer/motif attribution, codon-impact LUT.
+
+All integer math end-to-end: the parity contract with the CPU engine is
+bit-exactness, not tolerance (SURVEY.md §7.3).
+"""
+
+from pwasm_tpu.ops.consensus import (  # noqa: F401
+    pileup_counts,
+    consensus_vote_counts,
+    consensus_votes,
+    consensus_pallas,
+    votes_to_chars,
+    CODE_ZERO_COV,
+)

@@ -1,0 +1,267 @@
+"""Batched banded affine-gap DP (Gotoh) — the north-star re-alignment
+kernel (SURVEY.md §0: "batched banded affine-gap DP re-alignment,
+anti-diagonal wavefront ... over packed sequences").
+
+The reference has exactly one alignment-scoring DP — the X-drop end
+refinement (GapAssem.cpp:182-349).  This kernel generalizes it: a full
+banded global aligner with affine gaps, batched over thousands of targets
+(vmap lanes), integer scoring end-to-end so CPU/TPU results are bit-exact.
+
+Formulation
+-----------
+DP matrices M (match/mismatch), Ix (gap in target, consumes query), Iy
+(gap in query, consumes target), band of width B in diagonal space:
+row ``i`` covers columns ``j = i + dlo + b`` for band index b in [0, B).
+
+Row-wavefront recurrences in band coordinates (time = query row):
+
+- ``M[i][b]  = max(M,Ix,Iy)[i-1][b] + s(q_i, t_j)``       (diagonal stays)
+- ``Ix[i][b] = max(M[i-1][b+1] - GO, Ix[i-1][b+1] - GE)`` (up shifts by 1)
+- ``Iy[i][b] = max_{k<b}(M[i][k] - GO - (b-1-k) GE)``     (left chain)
+
+The Iy chain is the only intra-row dependency; it collapses to a running
+max of ``M[i][k] + k*GE`` (a cumulative max), so every row is fully
+vectorized — no scalar inner loop, and the same closed form works inside
+the Pallas kernel as a log-step shift-max.
+
+No Ix<->Iy adjacency (a deletion directly followed by an insertion) —
+standard Gotoh; the numpy reference in tests uses the identical recurrence.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG = -(2 ** 30)  # -inf surrogate, safe against int32 underflow
+
+
+@dataclass(frozen=True)
+class ScoreParams:
+    """Integer alignment scores (penalties positive)."""
+
+    match: int = 2
+    mismatch: int = 4
+    gap_open: int = 4    # charged when a gap opens (in addition to extend)
+    gap_extend: int = 2
+
+    @property
+    def go(self) -> int:  # total cost of the first gap base
+        return self.gap_open + self.gap_extend
+
+
+def band_dlo(m: int, n: int, band: int) -> int:
+    """Static band placement: diagonal offsets j-i in [dlo, dlo+band).
+    Centers the band between the start diagonal (0) and the end diagonal
+    (n-m); raises if the band can't cover both."""
+    dlo = (n - m) // 2 - band // 2
+    if not (dlo <= 0 <= dlo + band - 1 and dlo <= n - m <= dlo + band - 1):
+        raise ValueError(
+            f"band {band} too narrow for sizes m={m}, n={n}"
+            f" (needs to cover diagonals 0 and {n - m})")
+    return dlo
+
+
+@functools.partial(jax.jit, static_argnames=("band", "params"))
+def banded_score(q: jax.Array, t: jax.Array, t_len: jax.Array,
+                 band: int = 64,
+                 params: ScoreParams = ScoreParams()) -> jax.Array:
+    """Banded global alignment score of one query vs one (padded) target.
+
+    q: (m,) int8 base codes (0..3 real bases; >=4 never matches)
+    t: (n,) int8 padded target; t_len: true target length (<= n)
+    Returns the int32 global score at cell (m, t_len), or NEG if t_len
+    falls outside the band.
+    """
+    m = q.shape[0]
+    n = t.shape[0]
+    dlo = band_dlo(m, n, band)
+    ge = params.gap_extend
+    go = params.go
+    bidx = jnp.arange(band, dtype=jnp.int32)
+
+    # ---- row 0
+    j0 = dlo + bidx
+    m0 = jnp.where(j0 == 0, 0, NEG)
+    iy0 = jnp.where((j0 >= 1) & (j0 <= n), -(go + (j0 - 1) * ge), NEG)
+    ix0 = jnp.full((band,), NEG, dtype=jnp.int32)
+
+    def row(carry, qi):
+        prev_m, prev_ix, prev_iy, i = carry
+        i = i + 1
+        j = i + dlo + bidx
+        valid = (j >= 1) & (j <= n)
+        tj = jnp.where(valid, t[jnp.clip(j - 1, 0, n - 1)], 127)
+        s = jnp.where((qi == tj) & (qi < 4),
+                      params.match, -params.mismatch)
+        diag = jnp.maximum(prev_m, jnp.maximum(prev_ix, prev_iy))
+        m_new = jnp.where(valid, diag + s, NEG)
+        up_m = jnp.concatenate([prev_m[1:], jnp.array([NEG])])
+        up_ix = jnp.concatenate([prev_ix[1:], jnp.array([NEG])])
+        ix_new = jnp.maximum(up_m - go, up_ix - ge)
+        # boundary column j == 0: only a leading target-gap is alive
+        ix_new = jnp.where(j == 0, -(go + (i - 1) * ge), ix_new)
+        ix_new = jnp.where((j < 0) | (j > n), NEG, ix_new)
+        # left chain: Iy[b] = max_{k<b} (M[b's row][k] - GO - (b-1-k) GE)
+        u = m_new + bidx * ge
+        run = jax.lax.associative_scan(jnp.maximum, u)
+        run_prev = jnp.concatenate([jnp.array([NEG]), run[:-1]])
+        iy_new = run_prev - go - (bidx - 1) * ge
+        iy_new = jnp.where(valid, iy_new, NEG)
+        return (m_new.astype(jnp.int32), ix_new.astype(jnp.int32),
+                iy_new.astype(jnp.int32), i), None
+
+    (m_f, ix_f, iy_f, _), _ = jax.lax.scan(
+        row, (m0.astype(jnp.int32), ix0, iy0.astype(jnp.int32),
+              jnp.int32(0)),
+        q.astype(jnp.int32))
+    b_end = t_len - m - dlo
+    in_band = (b_end >= 0) & (b_end < band)
+    b_end = jnp.clip(b_end, 0, band - 1)
+    best = jnp.maximum(m_f[b_end], jnp.maximum(ix_f[b_end], iy_f[b_end]))
+    return jnp.where(in_band, best, NEG).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("band", "params"))
+def banded_scores_batch(q: jax.Array, ts: jax.Array, t_lens: jax.Array,
+                        band: int = 64,
+                        params: ScoreParams = ScoreParams()) -> jax.Array:
+    """vmap over a (T, n) target batch -> (T,) int32 scores."""
+    return jax.vmap(lambda t, l: banded_score(q, t, l, band, params))(
+        ts, t_lens)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel: whole batch in one kernel, band on the lane axis,
+# targets on the sublane axis.
+# ---------------------------------------------------------------------------
+def _banded_kernel(q_ref, t_ref, tlen_ref, out_ref, *, m, n, band, dlo,
+                   match, mismatch, go, ge, block_t):
+    """One grid step aligns ``block_t`` targets against the shared query.
+
+    State: three (block_t, band) int32 wavefronts updated over m rows with
+    a fori_loop; the Iy chain is a log2(band) shift-max cumulative scan.
+    """
+    bidx = jax.lax.broadcasted_iota(jnp.int32, (block_t, band), 1)
+    q = q_ref[...]        # (1, m) int32
+    t = t_ref[...]        # (block_t, n) int32
+    neg = jnp.full((block_t, band), NEG, dtype=jnp.int32)
+
+    j0 = dlo + bidx
+    m_v = jnp.where(j0 == 0, 0, NEG)
+    iy_v = jnp.where((j0 >= 1) & (j0 <= n), -(go + (j0 - 1) * ge), NEG)
+    ix_v = neg
+
+    def row(ii, carry):
+        m_prev, ix_prev, iy_prev = carry
+        i = ii + 1
+        j = i + dlo + bidx
+        valid = (j >= 1) & (j <= n)
+        qi = jax.lax.dynamic_slice(q, (0, ii), (1, 1))[0, 0]
+        jc = jnp.clip(j - 1, 0, n - 1)
+        tj = jnp.take_along_axis(t, jc, axis=1)
+        s = jnp.where((qi == tj) & (qi < 4), match, -mismatch)
+        diag = jnp.maximum(m_prev, jnp.maximum(ix_prev, iy_prev))
+        m_new = jnp.where(valid, diag + s, NEG)
+        up_m = jnp.concatenate([m_prev[:, 1:], neg[:, :1]], axis=1)
+        up_ix = jnp.concatenate([ix_prev[:, 1:], neg[:, :1]], axis=1)
+        ix_new = jnp.maximum(up_m - go, up_ix - ge)
+        ix_new = jnp.where(j == 0, -(go + (i - 1) * ge), ix_new)
+        ix_new = jnp.where((j < 0) | (j > n), NEG, ix_new)
+        # cumulative max of m_new + b*ge along the band (log-step scan)
+        run = m_new + bidx * ge
+        sh = 1
+        while sh < band:
+            shifted = jnp.concatenate(
+                [neg[:, :sh], run[:, :-sh]], axis=1)
+            run = jnp.maximum(run, shifted)
+            sh *= 2
+        run_prev = jnp.concatenate([neg[:, :1], run[:, :-1]], axis=1)
+        iy_new = run_prev - go - (bidx - 1) * ge
+        iy_new = jnp.where(valid, iy_new, NEG)
+        return m_new, ix_new, iy_new
+
+    m_f, ix_f, iy_f = jax.lax.fori_loop(0, m, row, (m_v, ix_v, iy_v))
+    t_len = tlen_ref[...]  # (block_t, 1)
+    b_end = t_len - m - dlo
+    in_band = (b_end >= 0) & (b_end < band)
+    b_clip = jnp.clip(b_end, 0, band - 1)
+    best3 = jnp.maximum(m_f, jnp.maximum(ix_f, iy_f))
+    best = jnp.take_along_axis(best3, b_clip, axis=1)
+    out_ref[...] = jnp.where(in_band, best, NEG)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("band", "params", "block_t",
+                                    "interpret"))
+def banded_scores_pallas(q: jax.Array, ts: jax.Array, t_lens: jax.Array,
+                         band: int = 128,
+                         params: ScoreParams = ScoreParams(),
+                         block_t: int = 8,
+                         interpret: bool | None = None) -> jax.Array:
+    """Pallas banded aligner: (T, n) targets -> (T,) int32 scores.
+
+    band rides the lane axis (use multiples of 128); targets ride the
+    sublane axis in blocks of ``block_t`` per grid step.
+    """
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    m = q.shape[0]
+    T, n = ts.shape
+    dlo = band_dlo(m, n, band)
+    pad_t = (T + block_t - 1) // block_t * block_t
+    if pad_t != T:
+        ts = jnp.pad(ts, ((0, pad_t - T), (0, 0)), constant_values=127)
+        t_lens = jnp.pad(t_lens, (0, pad_t - T), constant_values=0)
+    kernel = functools.partial(
+        _banded_kernel, m=m, n=n, band=band, dlo=dlo,
+        match=params.match, mismatch=params.mismatch,
+        go=params.go, ge=params.gap_extend, block_t=block_t)
+    out = pl.pallas_call(
+        kernel,
+        grid=(pad_t // block_t,),
+        in_specs=[
+            pl.BlockSpec((1, m), lambda i: (0, 0)),
+            pl.BlockSpec((block_t, n), lambda i: (i, 0)),
+            pl.BlockSpec((block_t, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_t, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((pad_t, 1), jnp.int32),
+        interpret=interpret,
+    )(q.astype(jnp.int32)[None, :], ts.astype(jnp.int32),
+      t_lens.astype(jnp.int32)[:, None])
+    return out[:T, 0]
+
+
+# ---------------------------------------------------------------------------
+# numpy reference (full-matrix Gotoh) for cross-checking — O(mn), exact
+# ---------------------------------------------------------------------------
+def full_gotoh_score(q: np.ndarray, t: np.ndarray,
+                     params: ScoreParams = ScoreParams()) -> int:
+    """Unbanded full-matrix Gotoh global score, identical recurrence
+    (no Ix<->Iy adjacency).  Integer math; the oracle for the band tests."""
+    m, n = len(q), len(t)
+    ge, go = params.gap_extend, params.go
+    M = np.full((m + 1, n + 1), NEG, dtype=np.int64)
+    Ix = np.full((m + 1, n + 1), NEG, dtype=np.int64)
+    Iy = np.full((m + 1, n + 1), NEG, dtype=np.int64)
+    M[0, 0] = 0
+    for j in range(1, n + 1):
+        Iy[0, j] = -(go + (j - 1) * ge)
+    for i in range(1, m + 1):
+        Ix[i, 0] = -(go + (i - 1) * ge)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            s = params.match if (q[i - 1] == t[j - 1] and q[i - 1] < 4) \
+                else -params.mismatch
+            M[i, j] = max(M[i - 1, j - 1], Ix[i - 1, j - 1],
+                          Iy[i - 1, j - 1]) + s
+            Ix[i, j] = max(M[i - 1, j] - go, Ix[i - 1, j] - ge)
+            Iy[i, j] = max(M[i, j - 1] - go, Iy[i, j - 1] - ge)
+    return int(max(M[m, n], Ix[m, n], Iy[m, n]))

@@ -1,0 +1,150 @@
+"""Per-column consensus: pileup counting + the reference vote rule.
+
+Device equivalent of GAlnColumn counting (GapAssem.h:295-337) and bestChar
+(GapAssem.cpp:1048-1069).  The vote is the closed form of the reference's
+stable-sort + '-'/'N'-yield rule (see
+``pwasm_tpu.align.msa.best_char_from_counts``):
+
+- if any of A/C/G/T reaches the max count, the first of them (A<C<G<T) wins;
+- else if N and '-' tie at the max, '-' wins;
+- else whichever of N/'-' holds the max;
+- a zero-coverage column votes ``CODE_ZERO_COV`` (the CPU engine raises
+  exit-5 on those, GapAssem.cpp:1121-1131).
+
+Everything is integer: int8 base codes in, int32 counts, int8 votes out —
+bit-exact by construction against the CPU path.
+
+Base codes: A=0 C=1 G=2 T=3 N=4 gap=5; code >=6 (or negative) = no
+contribution (outside a member's span / clipped), used when pileups are
+padded to rectangular tensors.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N_CLASSES = 6
+CODE_ZERO_COV = -1
+PAD_CODE = 6  # any code >= 6 contributes nothing to the pileup
+
+
+def pileup_counts(bases: jax.Array) -> jax.Array:
+    """Count base classes per column.
+
+    bases: (..., depth, cols) integer codes; codes outside [0, 6) are
+    ignored (padding / clipped positions).
+    Returns (..., cols, 6) int32 counts.
+
+    Implemented as a one-hot contraction over the depth axis so XLA lowers
+    it onto the MXU for large pileups; float32 accumulation of 0/1 values
+    is exact below 2^24 layers, far beyond any real pileup depth.
+    """
+    oh = jax.nn.one_hot(bases, N_CLASSES, dtype=jnp.float32,
+                        axis=-1)  # (..., depth, cols, 6); invalid -> all 0
+    counts = jnp.sum(oh, axis=-3)
+    return counts.astype(jnp.int32)
+
+
+def consensus_vote_counts(counts: jax.Array) -> jax.Array:
+    """Vote per column from (..., cols, 6) counts -> (..., cols) int8 codes
+    (0..3 ACGT, 4 N, 5 gap, CODE_ZERO_COV for empty columns)."""
+    counts = counts.astype(jnp.int32)
+    acgt = counts[..., :4]
+    n = counts[..., 4]
+    gap = counts[..., 5]
+    m_acgt = jnp.max(acgt, axis=-1)
+    m_all = jnp.maximum(m_acgt, jnp.maximum(n, gap))
+    first_acgt = jnp.argmax(acgt == m_all[..., None], axis=-1)
+    acgt_wins = m_acgt == m_all
+    both_tie = (n == m_all) & (gap == m_all)
+    n_wins = (n == m_all) & ~both_tie
+    code = jnp.where(acgt_wins, first_acgt,
+                     jnp.where(n_wins, 4, 5))
+    layers = jnp.sum(counts, axis=-1)
+    return jnp.where(layers == 0, CODE_ZERO_COV, code).astype(jnp.int8)
+
+
+@jax.jit
+def consensus_votes(bases: jax.Array) -> jax.Array:
+    """Fused pileup + vote: (..., depth, cols) codes -> (..., cols) votes."""
+    return consensus_vote_counts(pileup_counts(bases))
+
+
+def votes_to_chars(votes: np.ndarray, star_gap: bool = True) -> bytes:
+    """Map vote codes to consensus characters ('*' for gap columns when
+    ``star_gap``, matching refineMSA's consensus string)."""
+    table = np.frombuffer(b"ACGTN" + (b"*" if star_gap else b"-"),
+                          dtype=np.uint8)
+    v = np.asarray(votes)
+    if (v < 0).any():
+        raise ValueError("zero-coverage column in votes")
+    return table[v.astype(np.int64)].tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+def _consensus_kernel(bases_ref, counts_ref, votes_ref):
+    """One grid step: a (depth, COL_TILE) int8 block -> per-column counts
+    and votes.  Pure VPU work: 6 masked column sums + the closed-form vote.
+    """
+    b = bases_ref[...].astype(jnp.int32)  # (depth, C)
+    counts = []
+    for k in range(N_CLASSES):
+        counts.append(jnp.sum((b == k).astype(jnp.int32), axis=0))
+    cnt = jnp.stack(counts, axis=0)  # (6, C)
+    counts_ref[...] = cnt
+    acgt = cnt[:4]
+    n = cnt[4]
+    gap = cnt[5]
+    m_acgt = jnp.max(acgt, axis=0)
+    m_all = jnp.maximum(m_acgt, jnp.maximum(n, gap))
+    first_acgt = jnp.argmax(acgt == m_all[None, :], axis=0)
+    acgt_wins = m_acgt == m_all
+    both_tie = (n == m_all) & (gap == m_all)
+    n_wins = (n == m_all) & ~both_tie
+    code = jnp.where(acgt_wins, first_acgt, jnp.where(n_wins, 4, 5))
+    layers = jnp.sum(cnt, axis=0)
+    votes_ref[...] = jnp.where(layers == 0, CODE_ZERO_COV,
+                               code)[None, :].astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("col_tile", "interpret"))
+def consensus_pallas(bases: jax.Array, col_tile: int = 512,
+                     interpret: bool | None = None):
+    """Pallas consensus over a (depth, cols) pileup.
+
+    Returns (votes int8 (cols,), counts int32 (cols, 6)).  Pads columns to
+    the tile size with PAD_CODE (those columns vote CODE_ZERO_COV and are
+    sliced off).  On non-TPU backends runs in interpreter mode.
+    """
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    depth, cols = bases.shape
+    padded = (cols + col_tile - 1) // col_tile * col_tile
+    if padded != cols:
+        bases = jnp.pad(bases, ((0, 0), (0, padded - cols)),
+                        constant_values=PAD_CODE)
+    grid = (padded // col_tile,)
+    counts, votes = pl.pallas_call(
+        lambda b, c, v: _consensus_kernel(b, c, v),
+        grid=grid,
+        in_specs=[pl.BlockSpec((depth, col_tile), lambda i: (0, i))],
+        out_specs=[
+            pl.BlockSpec((N_CLASSES, col_tile), lambda i: (0, i)),
+            pl.BlockSpec((1, col_tile), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N_CLASSES, padded), jnp.int32),
+            jax.ShapeDtypeStruct((1, padded), jnp.int32),
+        ],
+        interpret=interpret,
+    )(bases.astype(jnp.int8))
+    return (votes[0, :cols].astype(jnp.int8),
+            counts[:, :cols].T)

@@ -1,0 +1,7 @@
+"""Multi-chip sharding: mesh construction and the sharded pipeline step."""
+
+from pwasm_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    sharded_consensus,
+    make_pipeline_step,
+)

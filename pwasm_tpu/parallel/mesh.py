@@ -1,0 +1,95 @@
+"""Device-mesh pipeline (SURVEY.md §5 'distributed communication backend').
+
+The reference is single-threaded C++ (Makefile:64-66: threads commented
+out); the new framework's scaling story is SPMD over a ``jax.sharding``
+mesh with XLA collectives riding ICI:
+
+- **batch (dp)**: the (query x target) alignment batch is embarrassingly
+  parallel — targets shard across chips for the banded DP and the
+  context scan.
+- **depth (tp-analog)**: deep consensus pileups shard across chips on the
+  read-depth axis; per-column class counts are ``psum``-reduced over ICI
+  before the vote (the BASELINE north star).
+- **columns (sp-analog)**: pileup columns shard across the batch axis of
+  the mesh, so a single wide MSA also spreads over chips; votes are
+  per-column local, so no collective is needed on that axis.
+
+Multi-slice/DCN: the outer per-alignment loop is data-parallel at the
+process level; nothing in the step crosses slices.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from pwasm_tpu.ops.banded_dp import ScoreParams, banded_scores_batch
+from pwasm_tpu.ops.consensus import consensus_vote_counts, pileup_counts
+
+
+def make_mesh(n_devices: int | None = None,
+              axis_names: tuple[str, str] = ("batch", "depth")) -> Mesh:
+    """A 2-D mesh over the first ``n_devices`` devices.  The depth axis
+    gets the largest factor <= sqrt(n) so both axes are exercised."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    d = 1
+    for cand in range(int(n ** 0.5), 0, -1):
+        if n % cand == 0:
+            d = cand
+            break
+    return Mesh(np.asarray(devs).reshape(n // d, d), axis_names)
+
+
+def sharded_consensus(mesh: Mesh):
+    """Consensus with the pileup sharded (depth, cols) over the mesh:
+    local counts per shard, ``psum`` over the depth axis (ICI), local
+    votes per column shard.  Returns a jitted fn(bases (depth, cols)) ->
+    votes (cols,)."""
+
+    def block(b_local):
+        local = pileup_counts(b_local)
+        total = jax.lax.psum(local, "depth")
+        return consensus_vote_counts(total)
+
+    fn = shard_map(block, mesh=mesh,
+                   in_specs=P("depth", "batch"),
+                   out_specs=P("batch"))
+    return jax.jit(fn)
+
+
+def make_pipeline_step(mesh: Mesh, band: int = 32,
+                       params: ScoreParams = ScoreParams()):
+    """The full sharded pipeline step — the framework's 'training step'
+    equivalent: batched banded DP re-alignment over target-sharded lanes
+    plus depth-sharded consensus voting with the ICI psum.
+
+    Returns a jitted fn(q (m,), ts (T, n), t_lens (T,),
+    pileup (depth, cols)) -> (scores (T,), votes (cols,)).
+    T must divide by mesh.shape['batch']; depth by mesh 'depth' and cols
+    by mesh 'batch'.
+    """
+    s_batch = NamedSharding(mesh, P("batch", None))
+    s_lens = NamedSharding(mesh, P("batch"))
+    s_rep = NamedSharding(mesh, P())
+    s_pileup = NamedSharding(mesh, P("depth", "batch"))
+    cons = sharded_consensus(mesh)
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=(s_rep, s_batch, s_lens, s_pileup),
+        out_shardings=(s_lens, NamedSharding(mesh, P("batch"))))
+    def step(q, ts, t_lens, pileup):
+        scores = banded_scores_batch(q, ts, t_lens, band=band,
+                                     params=params)
+        votes = cons(pileup)
+        return scores, votes
+
+    return step

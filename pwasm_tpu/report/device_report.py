@@ -1,0 +1,115 @@
+"""Device-path diff analysis: batch the events of many alignments through
+the fused ctx_scan program, then assemble the same report rows as the
+scalar path (tested byte-identical).
+
+Division of labor: the device computes homopolymer/motif attribution and
+the codon-impact amino acids over the whole event batch in one XLA
+program; the host slices the 9bp context strings (O(9) per event, and
+byte-faithful for IUPAC ambiguity characters that the int8 code space
+collapses to N) and formats rows with the shared formatter.
+
+Scope limits (callers fall back to the scalar path per event when hit):
+- events longer than ``max_ev`` bases;
+- references longer than ``max_len - max_ev`` (the frameshift stop-scan
+  window must cover the whole modified suffix).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pwasm_tpu.core.config import DEFAULT_MOTIFS
+from pwasm_tpu.core.dna import encode
+from pwasm_tpu.core.errors import PwasmError
+from pwasm_tpu.ops.ctx_scan import ctx_scan, pack_events, pack_motifs
+from pwasm_tpu.report.diff_report import get_ref_context
+
+MAX_EV = 16
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def analyze_events_device(refseq: bytes, events, skip_codan: bool = False,
+                          motifs=DEFAULT_MOTIFS,
+                          max_ev: int = MAX_EV) -> list[tuple]:
+    """Analyze a batch of DiffEvents on the device.
+
+    Returns a list of (aa, aapos, rctx, status, impact) tuples in event
+    order — the same contract as ``analyze_event_host`` (and NB: like the
+    host path it upper-cases each event's ``evtbases`` in place, matching
+    printDiffInfo).  Events over ``max_ev`` bases take the scalar path
+    inline."""
+    import jax.numpy as jnp
+
+    from pwasm_tpu.report.diff_report import analyze_event_host
+
+    if not events:
+        return []
+    ref_len = len(refseq)
+    max_len = _round_up(ref_len + max_ev + 3, 256)
+    fits = [len(ev.evtbases) <= max_ev and len(ev.evtsub) <= max_ev
+            for ev in events]
+    small = [ev for ev, ok in zip(events, fits) if ok]
+    big = [ev for ev, ok in zip(events, fits) if not ok]
+    results: dict[int, tuple] = {}
+    if small:
+        packed = pack_events(small, max_ev)
+        mot_codes, mot_lens = pack_motifs(motifs)
+        out = ctx_scan(jnp.asarray(encode(refseq.upper())),
+                       jnp.int32(ref_len), packed, mot_codes, mot_lens,
+                       max_codons=max_ev // 3 + 2, max_len=max_len,
+                       skip_codan=skip_codan)
+        host = {k: np.asarray(v) for k, v in out.items()}
+        for k, ev in enumerate(small):
+            ev.evtbases = ev.evtbases.upper()
+            aa = chr(int(host["aa"][k]))
+            aapos = int(host["aapos"][k])
+            rctx, _ = get_ref_context(refseq, ev.rloc)
+            if host["hpoly"][k]:
+                status = "homopolymer"
+            elif host["motif"][k] > 0:
+                status = f"motif {motifs[int(host['motif'][k]) - 1]}"
+            else:
+                status = "[unknown]"
+            impact = ""
+            if not skip_codan:
+                impact = _impact_text(ev, k, host)
+            results[id(ev)] = (aa, aapos, rctx, status, impact)
+    for ev in big:
+        results[id(ev)] = analyze_event_host(ev, refseq, skip_codan,
+                                             motifs)
+    return [results[id(ev)] for ev in events]
+
+
+def _impact_text(ev, k: int, host: dict) -> str:
+    """Assemble predictImpact's text from the device outputs
+    (pafreport.cpp:804-883 semantics)."""
+    if ev.evt == "S":
+        if host["s_mismatch"][k]:
+            raise PwasmError(
+                "Error: modseq not matching di.evtsub !\n")
+        parts = []
+        for d in range(host["s_orig_aa"].shape[1]):
+            if not host["s_valid"][k, d]:
+                break
+            aa = chr(int(host["s_orig_aa"][k, d]))
+            maa = chr(int(host["s_new_aa"][k, d]))
+            if aa != maa:
+                aapos = int(host["s_aapos"][k, d])
+                s = f"AA{aapos}|{aa}:{maa}"
+                if maa == ".":
+                    s += f"|premature stop at AA{aapos}"
+                parts.append(s)
+        return ", ".join(parts) if parts else "synonymous"
+    stop = int(host["stop_aapos"][k])
+    if stop >= 0:
+        return f"premature stop at AA{stop}"
+    aa4 = "".join(chr(int(c)) for c, v in
+                  zip(host["aa4"][k], host["aa4_valid"][k]) if v)
+    maa4 = "".join(chr(int(c)) for c, v in
+                   zip(host["maa4"][k], host["maa4_valid"][k]) if v)
+    if aa4 and maa4:
+        return f"frame shift {aa4}+:{maa4}+"
+    return ""

@@ -201,6 +201,55 @@ def _truncate_display(data: bytes) -> bytes:
     return data
 
 
+def analyze_event_host(di: DiffEvent, refseq: bytes, skip_codan: bool,
+                       motifs=DEFAULT_MOTIFS):
+    """Scalar analysis of one event: (aa, aapos, rctx, status, impact).
+    NB: upper-cases ``di.evtbases`` in place, like the reference's
+    printDiffInfo loop head (pafreport.cpp:895)."""
+    di.evtbases = di.evtbases.upper()
+    aapos = di.rloc // 3
+    aa = translate_codon(refseq, 3 * aapos)
+    aapos += 1
+    rctx, rctxloc = get_ref_context(refseq, di.rloc)
+    status = "homopolymer" if hpoly_check(di.evtbases, rctx, rctxloc) else ""
+    r_trloc = 3 * (aapos - 2)  # start editing one codon before
+    if r_trloc < 0:
+        r_trloc = 0
+    if not status:
+        _, status = mmotif_check(rctx, motifs)
+    impact = ""
+    if not skip_codan:
+        impact = predict_impact(di, refseq, r_trloc)
+    if not status:
+        status = "[unknown]"
+    return aa, aapos, rctx, status, impact
+
+
+def format_event_row(di: DiffEvent, aa: str, aapos: int, rctx: bytes,
+                     status: str, impact: str) -> str:
+    """One TSV report row (pafreport.cpp:942-953), shared by the host and
+    device analysis paths."""
+    tcontext = di.tctx
+    if len(tcontext) > 10 + MAX_EVLEN:
+        dlen = len(tcontext) - 10
+        tcontext = (di.tctx[:5] + b"[" + str(dlen).encode() + b"]"
+                    + di.tctx[-5:])
+    evtbases = _truncate_display(di.evtbases)
+    evtsub = _truncate_display(di.evtsub)
+    tctx_s = tcontext.decode("ascii", "replace")
+    rctx_s = rctx.decode("ascii", "replace")
+    eb = evtbases.decode("ascii", "replace")
+    if di.evt == "S":
+        es = evtsub.decode("ascii", "replace")
+        mid = f"{es}:{eb}"
+    elif di.evt == "I":
+        mid = f":{eb}"
+    else:
+        mid = f"{eb}:"
+    return (f"{di.evt}\t{di.rloc + 1}\t{aapos}({aa})\t{mid}\t"
+            f"{di.tloc + 1}\t{tctx_s}\t{rctx_s}\t{status}\t{impact}\n")
+
+
 def print_diff_info(aln: PafAlignment, rlabel: str, tlabel: str, f: IO[str],
                     refseq: bytes, skip_codan: bool = False,
                     motifs=DEFAULT_MOTIFS,
@@ -221,45 +270,8 @@ def print_diff_info(aln: PafAlignment, rlabel: str, tlabel: str, f: IO[str],
     if summary is not None:
         summary.add_alignment(aln)
     for di in aln.tdiffs:
-        di.evtbases = di.evtbases.upper()
-        aapos = di.rloc // 3
-        aa = translate_codon(refseq, 3 * aapos)
-        aapos += 1
-        rctx, rctxloc = get_ref_context(refseq, di.rloc)
-        status = "homopolymer" if hpoly_check(di.evtbases, rctx, rctxloc) \
-            else ""
-        r_trloc = 3 * (aapos - 2)  # start editing one codon before
-        if r_trloc < 0:
-            r_trloc = 0
-        if not status:
-            _, status = mmotif_check(rctx, motifs)
-        impact = ""
-        if not skip_codan:
-            impact = predict_impact(di, refseq, r_trloc)
-        if not status:
-            status = "[unknown]"
-        tcontext = di.tctx
-        if len(tcontext) > 10 + MAX_EVLEN:
-            dlen = len(tcontext) - 10
-            tcontext = (di.tctx[:5] + b"[" + str(dlen).encode() + b"]"
-                        + di.tctx[-5:])
-        evtbases = _truncate_display(di.evtbases)
-        evtsub = _truncate_display(di.evtsub)
+        aa, aapos, rctx, status, impact = analyze_event_host(
+            di, refseq, skip_codan, motifs)
         if summary is not None:
             summary.add_event(di, status, impact)
-        tctx_s = tcontext.decode("ascii", "replace")
-        rctx_s = rctx.decode("ascii", "replace")
-        eb = evtbases.decode("ascii", "replace")
-        if di.evt == "S":
-            es = evtsub.decode("ascii", "replace")
-            f.write(f"S\t{di.rloc + 1}\t{aapos}({aa})\t{es}:{eb}\t"
-                    f"{di.tloc + 1}\t{tctx_s}\t{rctx_s}\t{status}\t"
-                    f"{impact}\n")
-        elif di.evt == "I":
-            f.write(f"I\t{di.rloc + 1}\t{aapos}({aa})\t:{eb}\t"
-                    f"{di.tloc + 1}\t{tctx_s}\t{rctx_s}\t{status}\t"
-                    f"{impact}\n")
-        else:
-            f.write(f"D\t{di.rloc + 1}\t{aapos}({aa})\t{eb}:\t"
-                    f"{di.tloc + 1}\t{tctx_s}\t{rctx_s}\t{status}\t"
-                    f"{impact}\n")
+        f.write(format_event_row(di, aa, aapos, rctx, status, impact))

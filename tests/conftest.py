@@ -2,15 +2,36 @@
 
 JAX tests run on CPU with 8 virtual devices so multi-chip sharding and ICI
 collectives are exercised without TPU hardware (SURVEY.md §4: multi-chip
-tests via ``--xla_force_host_platform_device_count``).  The env vars must be
-set before jax is imported anywhere.
+tests via ``--xla_force_host_platform_device_count``).
+
+This environment routes jax to a remote TPU chip through a tunnel backend
+('axon') that a sitecustomize hook registers at interpreter startup —
+*before* this file runs, with jax already imported.  Initializing that
+backend inside the test run would grab/hang on the tunnel, so we force the
+cpu platform via jax.config (env vars are too late once jax is imported)
+and drop every non-cpu backend factory.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # no tunnel for subprocesses
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    # only the tunnel backend is dangerous to initialize; 'tpu' must remain
+    # a *known* platform (pallas registers tpu lowering rules at import
+    # time) but jax_platforms=cpu keeps it uninitialized.  Private API —
+    # if a jax upgrade moves it, lose the suppression, not the test suite.
+    import jax._src.xla_bridge as _xb
+
+    getattr(_xb, "_backend_factories", {}).pop("axon", None)
+except Exception:
+    pass

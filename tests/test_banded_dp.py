@@ -1,0 +1,139 @@
+"""Banded DP kernel: exact score parity vs the full-matrix numpy Gotoh
+oracle, batch/vmap behavior, band placement, and the Pallas variant."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pwasm_tpu.core.dna import encode
+from pwasm_tpu.ops.banded_dp import (
+    NEG,
+    ScoreParams,
+    band_dlo,
+    banded_score,
+    banded_scores_batch,
+    banded_scores_pallas,
+    full_gotoh_score,
+)
+
+
+def _mutate(rng, q, n_sub, n_ind):
+    t = list(q)
+    for _ in range(n_sub):
+        p = rng.integers(0, len(t))
+        t[p] = rng.integers(0, 4)
+    for _ in range(n_ind):
+        p = int(rng.integers(1, len(t) - 1))
+        if rng.random() < 0.5:
+            t.insert(p, rng.integers(0, 4))
+        else:
+            del t[p]
+    return np.array(t, dtype=np.int8)
+
+
+def test_identical_sequences():
+    q = encode(b"ACGTACGTACGTACGT")
+    score = int(banded_score(jnp.asarray(q), jnp.asarray(q),
+                             jnp.int32(len(q)), band=16))
+    assert score == len(q) * ScoreParams().match
+
+
+def test_single_substitution():
+    q = encode(b"ACGTACGTACGTACGT")
+    t = q.copy()
+    t[5] = (t[5] + 1) % 4
+    p = ScoreParams()
+    score = int(banded_score(jnp.asarray(q), jnp.asarray(t),
+                             jnp.int32(len(t)), band=16))
+    assert score == (len(q) - 1) * p.match - p.mismatch
+
+
+def test_single_gap():
+    q = encode(b"ACGTACGTACGTACGT")
+    t = np.delete(q, 7)
+    p = ScoreParams()
+    score = int(banded_score(jnp.asarray(q), jnp.asarray(t),
+                             jnp.int32(len(t)), band=16))
+    assert score == (len(q) - 1) * p.match - p.go
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_matches_full_gotoh(seed):
+    rng = np.random.default_rng(seed)
+    m = int(rng.integers(20, 60))
+    q = rng.integers(0, 4, size=m).astype(np.int8)
+    t = _mutate(rng, q, n_sub=int(rng.integers(0, 5)),
+                n_ind=int(rng.integers(0, 4)))
+    n = len(t)
+    band = 32
+    expect = full_gotoh_score(q, t)
+    pad = np.full(n + 8, 127, dtype=np.int8)
+    pad[:n] = t
+    got = int(banded_score(jnp.asarray(q), jnp.asarray(pad),
+                           jnp.int32(n), band=band))
+    assert got == expect, (seed, m, n)
+
+
+def test_batch_vmap_matches_singles():
+    rng = np.random.default_rng(42)
+    q = rng.integers(0, 4, size=40).astype(np.int8)
+    targets = []
+    lens = []
+    n_max = 56
+    for _ in range(9):
+        t = _mutate(rng, q, rng.integers(0, 4), rng.integers(0, 3))
+        pad = np.full(n_max, 127, dtype=np.int8)
+        pad[:len(t)] = t
+        targets.append(pad)
+        lens.append(len(t))
+    ts = jnp.asarray(np.stack(targets))
+    tl = jnp.asarray(np.array(lens, dtype=np.int32))
+    batch = np.asarray(banded_scores_batch(jnp.asarray(q), ts, tl, band=32))
+    for k in range(9):
+        single = int(banded_score(jnp.asarray(q), ts[k], tl[k], band=32))
+        assert batch[k] == single
+        assert batch[k] == full_gotoh_score(q, targets[k][:lens[k]])
+
+
+def test_band_too_narrow_raises():
+    with pytest.raises(ValueError, match="band .* too narrow"):
+        band_dlo(10, 100, 8)
+
+
+def test_target_length_outside_band_is_neg():
+    q = jnp.asarray(encode(b"ACGTACGT"))
+    t = jnp.asarray(np.full(20, 127, dtype=np.int8))
+    # band 16 over (m=8, n=20) covers diagonals [-2, 13];
+    # t_len=4 implies end diagonal -4, outside the band -> NEG sentinel
+    score = int(banded_score(q, t, jnp.int32(4), band=16))
+    assert score == NEG
+
+
+def test_pallas_matches_jax():
+    rng = np.random.default_rng(7)
+    q = rng.integers(0, 4, size=48).astype(np.int8)
+    n_max = 64
+    targets, lens = [], []
+    for _ in range(12):
+        t = _mutate(rng, q, rng.integers(0, 5), rng.integers(0, 3))
+        pad = np.full(n_max, 127, dtype=np.int8)
+        pad[:len(t)] = t
+        targets.append(pad)
+        lens.append(len(t))
+    ts = jnp.asarray(np.stack(targets))
+    tl = jnp.asarray(np.array(lens, dtype=np.int32))
+    ref = np.asarray(banded_scores_batch(jnp.asarray(q), ts, tl, band=32))
+    got = np.asarray(banded_scores_pallas(jnp.asarray(q), ts, tl, band=32,
+                                          block_t=4))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_custom_score_params():
+    p = ScoreParams(match=1, mismatch=3, gap_open=5, gap_extend=1)
+    q = encode(b"ACGTACGTAC")
+    t = np.delete(q, 4)
+    got = int(banded_score(jnp.asarray(q), jnp.asarray(t),
+                           jnp.int32(len(t)), band=16, params=p))
+    assert got == full_gotoh_score(q, t, p)
+    assert got == 9 * 1 - 6

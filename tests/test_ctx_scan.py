@@ -1,0 +1,117 @@
+"""Device context/codon scan: byte parity against the scalar host
+analysis over randomized alignments, plus targeted unit checks."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pwasm_tpu.core.dna import encode, revcomp
+from pwasm_tpu.core.events import extract_alignment
+from pwasm_tpu.core.paf import parse_paf_line
+from pwasm_tpu.ops.ctx_scan import (
+    ctx_scan,
+    motif_hits,
+    pack_events,
+    pack_motifs,
+    ref_context_windows,
+)
+from pwasm_tpu.report.device_report import analyze_events_device
+from pwasm_tpu.report.diff_report import (
+    analyze_event_host,
+    format_event_row,
+    get_ref_context,
+)
+
+from helpers import make_paf_line
+from test_events import _random_ops
+
+
+def _events_for(q, line):
+    rec = parse_paf_line(line)
+    refseq_aln = revcomp(q) if rec.alninfo.reverse else q
+    return extract_alignment(rec, refseq_aln).tdiffs
+
+
+def test_ref_context_windows_match_host():
+    q = b"ATGGCCTGGAAAGATCTGTACCTGA"
+    rlocs = list(range(len(q)))
+    win, loc = ref_context_windows(jnp.asarray(encode(q)),
+                                   jnp.int32(len(q)),
+                                   jnp.asarray(np.array(rlocs)))
+    for i, r in enumerate(rlocs):
+        rctx, evtloc = get_ref_context(q, r)
+        assert bytes(b"ACGTN-"[c] for c in np.asarray(win[i])) == rctx
+        assert int(loc[i]) == evtloc, r
+
+
+def test_motif_hits_first_wins():
+    q = b"CCTGGGATC"  # contains motif 1 (CCTGG) and motif 3 (GATC)
+    win = jnp.asarray(encode(q))[None, :]
+    codes, lens = pack_motifs(("CCTGG", "CCAGG", "GATC", "GTAC"))
+    assert int(motif_hits(win, codes, lens)[0]) == 1
+    win2 = jnp.asarray(encode(b"AAAGATCAA"))[None, :]
+    assert int(motif_hits(win2, codes, lens)[0]) == 3
+    win3 = jnp.asarray(encode(b"AAAAAAAAA"))[None, :]
+    assert int(motif_hits(win3, codes, lens)[0]) == 0
+
+
+@pytest.mark.parametrize("strand", ["+", "-"])
+@pytest.mark.parametrize("seed", range(6))
+def test_device_analysis_matches_host(strand, seed):
+    rng = np.random.default_rng(200 + seed)
+    n = int(rng.integers(60, 150))
+    q = "".join(rng.choice(list("ACGT"), size=n)).encode()
+    ops = _random_ops(rng, q.decode() if strand == "+" else
+                      revcomp(q).decode())
+    line, _ = make_paf_line("q", q.decode(), "t", strand, ops)
+    events = _events_for(q, line)
+    if not events:
+        pytest.skip("no events generated")
+    import copy
+    ev_host = copy.deepcopy(events)
+    ev_dev = copy.deepcopy(events)
+    host_rows = []
+    for di in ev_host:
+        aa, aapos, rctx, status, impact = analyze_event_host(
+            di, q, skip_codan=False)
+        host_rows.append(format_event_row(di, aa, aapos, rctx, status,
+                                          impact))
+    dev = analyze_events_device(q, ev_dev, skip_codan=False)
+    dev_rows = [format_event_row(di, *res)
+                for di, res in zip(ev_dev, dev)]
+    assert dev_rows == host_rows
+
+
+def test_device_analysis_skip_codan():
+    q = b"ATGGCCTGGAAAGATCTGTACCTGA"
+    line = ("geneA\t25\t0\t25\t+\tasm1\t23\t0\t23\t23\t25\t60\t"
+            "NM:i:3\tAS:i:40\tcg:Z:12M2I11M\tcs:Z::6*ct:5+at:11")
+    events = _events_for(q, line)
+    res = analyze_events_device(q, events, skip_codan=True)
+    assert all(r[4] == "" for r in res)
+    assert res[0][3] == "motif CCTGG"
+
+
+def test_device_analysis_long_event_fallback():
+    # a 20-base deletion exceeds MAX_EV=16 -> scalar fallback, same result
+    q = bytes(b"ACGT" * 20)
+    ins = "acgt" * 5
+    line, _ = make_paf_line("q", q.decode(), "t", "+",
+                            [("=", 30), ("ins", ins), ("=", 50)])
+    events = _events_for(q, line)
+    import copy
+    ev_host = copy.deepcopy(events)
+    host = [analyze_event_host(di, q, False) for di in ev_host]
+    dev = analyze_events_device(q, events, False)
+    assert dev == host
+
+
+def test_premature_stop_parity():
+    q = b"ATGGCCTGGAAAGATCTGTACCTGA"
+    # G->A at rloc 8 turns TGG (W) into TGA (stop)
+    line = ("geneA\t25\t0\t25\t+\tasm1\t25\t0\t25\t25\t25\t60\t"
+            "NM:i:1\tAS:i:44\tcg:Z:25M\tcs:Z::8*ag:16")
+    events = _events_for(q, line)
+    res = analyze_events_device(q, events, skip_codan=False)
+    assert res[0][4] == "AA3|W:.|premature stop at AA3"

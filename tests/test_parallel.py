@@ -1,0 +1,71 @@
+"""Multi-chip sharding tests on the 8-virtual-device CPU mesh."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pwasm_tpu.ops.consensus import consensus_votes
+from pwasm_tpu.parallel.mesh import (
+    make_mesh,
+    make_pipeline_step,
+    sharded_consensus,
+)
+from pwasm_tpu.ops.banded_dp import banded_scores_batch
+
+
+def test_make_mesh_shapes():
+    mesh = make_mesh(8)
+    assert mesh.shape["batch"] * mesh.shape["depth"] == 8
+    assert mesh.shape["depth"] == 2
+    mesh4 = make_mesh(4)
+    assert dict(mesh4.shape) == {"batch": 2, "depth": 2}
+
+
+def test_sharded_consensus_matches_single():
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(0)
+    bases = rng.integers(0, 7, size=(8, 128)).astype(np.int8)
+    fn = sharded_consensus(mesh)
+    votes = np.asarray(fn(jnp.asarray(bases)))
+    np.testing.assert_array_equal(
+        votes, np.asarray(consensus_votes(jnp.asarray(bases))))
+
+
+def test_pipeline_step_matches_unsharded():
+    mesh = make_mesh(8)
+    nb = mesh.shape["batch"]
+    rng = np.random.default_rng(1)
+    m = 24
+    q = rng.integers(0, 4, size=m).astype(np.int8)
+    T = 4 * nb
+    n = 40
+    ts = np.full((T, n), 127, dtype=np.int8)
+    t_lens = np.zeros(T, dtype=np.int32)
+    for k in range(T):
+        t = list(q)
+        for _ in range(int(rng.integers(0, 3))):
+            t[int(rng.integers(0, len(t)))] = int(rng.integers(0, 4))
+        ts[k, :len(t)] = t
+        t_lens[k] = len(t)
+    pileup = rng.integers(0, 7, size=(8, 32 * nb)).astype(np.int8)
+    step = make_pipeline_step(mesh, band=32)
+    scores, votes = step(jnp.asarray(q), jnp.asarray(ts),
+                         jnp.asarray(t_lens), jnp.asarray(pileup))
+    ref_scores = np.asarray(banded_scores_batch(
+        jnp.asarray(q), jnp.asarray(ts), jnp.asarray(t_lens), band=32))
+    np.testing.assert_array_equal(np.asarray(scores), ref_scores)
+    np.testing.assert_array_equal(
+        np.asarray(votes),
+        np.asarray(consensus_votes(jnp.asarray(pileup))))
+
+
+def test_graft_entry_and_dryrun():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    scores, votes = fn(*args)
+    assert scores.shape[0] == args[1].shape[0]
+    assert votes.shape[0] == args[3].shape[1]
+    g.dryrun_multichip(len(jax.devices()))
+    g.dryrun_multichip(4)
