@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""pwasm-tpu benchmark — prints ONE JSON line for the driver.
+
+Headline config (BASELINE.md #2): batched banded affine-gap DP
+re-alignment of one bacterial-CDS-sized query (~1.5 kb) against a batch of
+Nanopore-assembly-sized targets, band 128, on one chip — measured as
+aligned target bases per second.  ``vs_baseline`` is the speedup over the
+single-core C++ banded Gotoh on the same workload (the reference is a
+single-threaded C++ program, Makefile:64-66, and publishes no numbers of
+its own — BASELINE.md).
+
+A consensus-vote parity check (CPU engine vs device kernel, bit-exact)
+runs as part of the benchmark; a mismatch fails the run.
+
+Env knobs: PWASM_BENCH_T (batch targets, default 2048),
+PWASM_BENCH_KERNEL=pallas|xla (default xla), PWASM_BENCH_CPU_T (CPU
+baseline subset, default 32).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+M = 1500          # query length (CDS-sized)
+N_PAD = M + 64    # padded target length (pad also anchors the band)
+BAND = 128
+
+
+def _workload(T: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    q = rng.integers(0, 4, size=M).astype(np.int8)
+    ts = np.full((T, N_PAD), 127, dtype=np.int8)
+    t_lens = np.zeros(T, dtype=np.int32)
+    for k in range(T):
+        t = list(q)
+        for _ in range(int(rng.integers(5, 40))):   # subs
+            t[int(rng.integers(0, len(t)))] = int(rng.integers(0, 4))
+        for _ in range(int(rng.integers(0, 8))):    # indels
+            p = int(rng.integers(1, len(t) - 1))
+            if rng.random() < 0.5:
+                t.insert(p, int(rng.integers(0, 4)))
+            else:
+                del t[p]
+        ts[k, :len(t)] = t
+        t_lens[k] = len(t)
+    return q, ts, t_lens
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from pwasm_tpu.ops.banded_dp import (ScoreParams, band_dlo,
+                                         banded_scores_batch,
+                                         banded_scores_pallas)
+    from pwasm_tpu.ops.consensus import consensus_votes
+
+    T = int(os.environ.get("PWASM_BENCH_T", "2048"))
+    cpu_T = int(os.environ.get("PWASM_BENCH_CPU_T", "32"))
+    kernel = os.environ.get("PWASM_BENCH_KERNEL", "xla")
+    params = ScoreParams()
+    q, ts, t_lens = _workload(T)
+    qd = jnp.asarray(q)
+    tsd = jnp.asarray(ts)
+    tld = jnp.asarray(t_lens)
+
+    if kernel == "pallas":
+        def run():
+            return banded_scores_pallas(qd, tsd, tld, band=BAND,
+                                        params=params)
+    else:
+        def run():
+            return banded_scores_batch(qd, tsd, tld, band=BAND,
+                                       params=params)
+
+    scores = run()
+    scores.block_until_ready()          # compile
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        scores = run()
+    scores.block_until_ready()
+    dev_dt = (time.perf_counter() - t0) / reps
+    total_bases = int(t_lens.sum())
+    bases_per_sec = total_bases / dev_dt
+
+    # ---- consensus parity gate (bit-exact device vs CPU engine)
+    from pwasm_tpu.align.msa import best_char_from_counts
+    rng = np.random.default_rng(1)
+    pileup = rng.integers(0, 7, size=(64, 512)).astype(np.int8)
+    votes = np.asarray(consensus_votes(jnp.asarray(pileup)))
+    nuc = b"ACGTN-"
+    for c in range(pileup.shape[1]):
+        counts = [(pileup[:, c] == k).sum() for k in range(6)]
+        expect = best_char_from_counts(np.array(counts), sum(counts))
+        got = 0 if votes[c] < 0 else nuc[votes[c]]
+        if got != expect:
+            print(json.dumps({"metric": "consensus_parity", "value": 0,
+                              "unit": "bool", "vs_baseline": 0}))
+            return 1
+
+    # ---- single-core C++ baseline on a subset, scaled per-base
+    from pwasm_tpu.native import banded_gotoh_batch, native_available
+    dlo = band_dlo(M, N_PAD, BAND)
+    if native_available():
+        sub = slice(0, cpu_T)
+        t0 = time.perf_counter()
+        cpu_scores = banded_gotoh_batch(q, ts[sub], t_lens[sub], BAND, dlo,
+                                        params.match, params.mismatch,
+                                        params.gap_open, params.gap_extend)
+        cpu_dt = time.perf_counter() - t0
+        cpu_bases = int(t_lens[sub].sum())
+        cpu_bases_per_sec = cpu_bases / cpu_dt
+        # score parity between the C++ baseline and the device kernel
+        if not np.array_equal(np.asarray(scores)[sub], cpu_scores):
+            print(json.dumps({"metric": "dp_parity", "value": 0,
+                              "unit": "bool", "vs_baseline": 0}))
+            return 1
+        vs_baseline = bases_per_sec / cpu_bases_per_sec
+    else:
+        vs_baseline = 0.0
+
+    print(json.dumps({
+        "metric": "aligned_bases_per_sec_per_chip",
+        "value": round(bases_per_sec, 1),
+        "unit": "bases/s",
+        "vs_baseline": round(vs_baseline, 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,6 +27,7 @@ Behavioral parity notes (SURVEY.md §2.5):
 
 from __future__ import annotations
 
+import os
 import sys
 from dataclasses import dataclass, field
 
@@ -36,6 +37,17 @@ from pwasm_tpu.core.paf import AlnInfo, PafRecord
 
 CS_ERROR = "Error parsing cs string from line: {} (cs position: {})\n"
 CIGAR_ERROR = "Error parsing cigar string from line: {} (cigar position: {})\n"
+SOFTCLIP_WARNING = ("Warning: soft clipping shouldn't be found in this "
+                    "application!")
+BASE_MISMATCH_ERROR = ("Error: base mismatch {} != qstr[{}] ({}) at line"
+                       "\n{}\n")
+SPLICE_ERROR = "Error: spliced alignments not supported! at line:\n{}\n"
+CS_OP_ERROR = "Error: unhandled event at {} in cs, line:\n{}\n"
+CIGAR_OP_ERROR = "Error: unhandled cigar_op {} (len {}) in {}\n"
+TSEQ_LEN_ERROR = ("Error: tseq alignment length mismatch ({} vs {}({}-{}))"
+                  " at line:{}\n")
+REF_LEN_ERROR = ("Error: ref alignment length mismatch ({} vs {}-{}) at "
+                 "line:{}\n")
 
 
 @dataclass
@@ -118,13 +130,25 @@ class PafAlignment:
     tseq: bytes = b""
 
 
-def extract_alignment(rec: PafRecord, refseq_aln: bytes) -> PafAlignment:
+def extract_alignment(rec: PafRecord, refseq_aln: bytes,
+                      use_native: bool | None = None) -> PafAlignment:
     """Build a PafAlignment from a parsed PAF record.
 
     ``refseq_aln`` is the query sequence in *alignment orientation*: the
     forward upper-cased query, or its reverse complement when the PAF strand
     is '-' (the caller keeps both copies, mirroring pafreport.cpp:338-362).
+
+    Dispatches to the native C++ extractor when available (parity enforced
+    by tests/test_native.py); ``use_native=False`` forces the Python path.
     """
+    if use_native is None:
+        use_native = os.environ.get("PWASM_NATIVE", "1") != "0"
+    if use_native:
+        from pwasm_tpu.native import extract_native
+
+        aln = extract_native(rec, refseq_aln)
+        if aln is not None:
+            return aln
     al = rec.alninfo
     line = rec.line
     aln = PafAlignment(alninfo=al, seqname=al.t_id, reverse=al.reverse,
@@ -167,11 +191,10 @@ def extract_alignment(rec: PafRecord, refseq_aln: bytes) -> PafAlignment:
             i += 2
             q_pos = offset + qpos
             if q_pos >= len(refseq_aln) or qch != chr(refseq_aln[q_pos]):
+                refc = chr(refseq_aln[q_pos]) \
+                    if q_pos < len(refseq_aln) else "?"
                 raise PwasmError(
-                    f"Error: base mismatch {qch} != qstr[{q_pos}] "
-                    f"({chr(refseq_aln[q_pos]) if q_pos < len(refseq_aln) else '?'})"
-                    f" at line\n{line}\n"
-                )
+                    BASE_MISMATCH_ERROR.format(qch, q_pos, refc, line))
             # merge adjacent substitutions into a single event
             if (tdiffs and tdiffs[-1].evt == "S"
                     and tdiffs[-1].rloc == q_pos - len(tdiffs[-1].evtbases)):
@@ -217,11 +240,10 @@ def extract_alignment(rec: PafRecord, refseq_aln: bytes) -> PafAlignment:
                 ev.rloc = al.r_len - q_pos - e_len
             tdiffs.append(ev)
         elif op == "~":
-            raise PwasmError(
-                f"Error: spliced alignments not supported! at line:\n{line}\n")
+            raise PwasmError(SPLICE_ERROR.format(line))
         else:
-            raise PwasmError(
-                f"Error: unhandled event at {cs[i - 1:]} in cs, line:\n{line}\n")
+            # the reference reports from the position *after* the op char
+            raise PwasmError(CS_OP_ERROR.format(cs[i:], line))
 
     # ---- context fill + reverse-strand fixups (pafreport.cpp:628-643)
     tseq_final = bytes(tseq)
@@ -262,8 +284,7 @@ def extract_alignment(rec: PafRecord, refseq_aln: bytes) -> PafAlignment:
         elif cop == "S":
             # soft clip: shouldn't appear in this application
             # (reference warns on stderr, pafreport.cpp:675-679)
-            print("Warning: soft clipping shouldn't be found in this "
-                  f"application!\n{line}", file=sys.stderr)
+            print(f"{SOFTCLIP_WARNING}\n{line}", file=sys.stderr)
             qpos += cl
         elif cop == "I":
             # gap in the target sequence; tpos not advanced
@@ -285,17 +306,14 @@ def extract_alignment(rec: PafRecord, refseq_aln: bytes) -> PafAlignment:
                 pos = al.r_len - pos
             aln.rgaps.append(GapData(pos, cl))
         else:
-            raise PwasmError(
-                f"Error: unhandled cigar_op {cop} (len {cl}) in {line}\n")
+            raise PwasmError(CIGAR_OP_ERROR.format(cop, cl, line))
         i += 1
 
     # ---- cross-validation (pafreport.cpp:715-718)
     if eff_t_len != tpos or len(tseq) != tpos:
-        raise PwasmError(
-            f"Error: tseq alignment length mismatch ({tpos} vs {eff_t_len}"
-            f"({al.t_alnend}-{al.t_alnstart})) at line:{line}\n")
+        raise PwasmError(TSEQ_LEN_ERROR.format(
+            tpos, eff_t_len, al.t_alnend, al.t_alnstart, line))
     if al.r_alnend - al.r_alnstart != qpos:
-        raise PwasmError(
-            f"Error: ref alignment length mismatch ({qpos} vs "
-            f"{al.r_alnend}-{al.r_alnstart}) at line:{line}\n")
+        raise PwasmError(REF_LEN_ERROR.format(
+            qpos, al.r_alnend, al.r_alnstart, line))
     return aln

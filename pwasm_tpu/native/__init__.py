@@ -1,0 +1,224 @@
+"""Native host core: lazy-built C++ shared library + ctypes bindings.
+
+Provides the hot host-side loops as native code (SURVEY.md §2.4): the
+per-alignment cs/CIGAR diff extraction and a single-core banded Gotoh
+(the honest CPU baseline for the TPU DP benchmarks), plus the base-code
+encoder.  Built on first use with g++ (cached .so, rebuilt when the
+source is newer); every entry point has a pure-Python fallback, so the
+package works without a toolchain.
+
+Set ``PWASM_NATIVE=0`` to disable the native path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "fastparse.cpp")
+_SO = os.path.join(_HERE, "_fastparse.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+EV_FIELDS = 10
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO]
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=180)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    if res.returncode != 0:
+        print(f"pwasm-tpu: native build failed:\n{res.stderr[:2000]}",
+              file=sys.stderr)
+        return False
+    return True
+
+
+def get_lib():
+    """The loaded native library, or None (fallback to Python paths)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("PWASM_NATIVE", "1") == "0":
+            return None
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                if not _build():
+                    return None
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.pw_extract.restype = ctypes.c_int
+        lib.pw_banded_gotoh.restype = ctypes.c_int32
+        lib.pw_banded_gotoh_batch.restype = None
+        lib.pw_encode.restype = None
+        _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+# ---------------------------------------------------------------------------
+def _raise_native_error(rc: int, info, sizes, rec, refseq_aln: bytes):
+    """Translate a native error code into the exact message the Python
+    extractor raises (shared constants in pwasm_tpu.core.events), after
+    replaying any soft-clip warnings seen before the failure."""
+    from pwasm_tpu.core import events as E
+    from pwasm_tpu.core.errors import PwasmError
+
+    for _ in range(int(sizes[4])):
+        print(f"{E.SOFTCLIP_WARNING}\n{rec.line}", file=sys.stderr)
+    line = rec.line
+    al = rec.alninfo
+    a, b = int(info[0]), int(info[1])
+    if rc == 1:
+        raise PwasmError(E.CS_ERROR.format(line, rec.cs[a:]))
+    if rc == 2:
+        refc = chr(refseq_aln[a]) if a < len(refseq_aln) else "?"
+        raise PwasmError(E.BASE_MISMATCH_ERROR.format(chr(b), a, refc,
+                                                      line))
+    if rc == 3:
+        raise PwasmError(E.SPLICE_ERROR.format(line))
+    if rc == 4:
+        raise PwasmError(E.CS_OP_ERROR.format(rec.cs[a:], line))
+    if rc == 5:
+        raise PwasmError(E.CIGAR_ERROR.format(line, rec.cigar[a:]))
+    if rc == 6:
+        raise PwasmError(E.CIGAR_OP_ERROR.format(chr(a), b, line))
+    if rc == 7:
+        raise PwasmError(E.TSEQ_LEN_ERROR.format(
+            a, al.t_alnend - al.t_alnstart, al.t_alnend, al.t_alnstart,
+            line))
+    if rc == 8:
+        raise PwasmError(E.REF_LEN_ERROR.format(
+            a, al.r_alnend, al.r_alnstart, line))
+    raise PwasmError(f"native extraction failed (code {rc})\n")
+
+
+def extract_native(rec, refseq_aln: bytes):
+    """Native counterpart of ``pwasm_tpu.core.events.extract_alignment``.
+    Returns a PafAlignment, or None if the native library is unavailable.
+    Raises PwasmError with the same messages as the Python path."""
+    from pwasm_tpu.core import events as E
+    from pwasm_tpu.core.errors import PwasmError
+    from pwasm_tpu.core.events import DiffEvent, GapData, PafAlignment
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    al = rec.alninfo
+    if not rec.cigar:
+        raise PwasmError(E.CIGAR_ERROR.format(rec.line, 0))
+    if rec.cs is None:
+        raise PwasmError(E.CS_ERROR.format(rec.line, 0))
+    offset = al.r_alnstart
+    if al.reverse:
+        offset = al.r_len - al.r_alnend
+    eff = al.t_alnend - al.t_alnstart
+    tseq_cap = eff + 16
+    ev_cap = EV_FIELDS * (len(rec.cs) + 4)
+    arena_cap = 4 * (len(rec.cs) + 64)
+    gap_cap = 3 * (len(rec.cigar) + 4)
+    for _ in range(3):
+        tseq_buf = np.empty(tseq_cap, dtype=np.uint8)
+        ev_buf = np.empty(ev_cap, dtype=np.int32)
+        arena = np.empty(arena_cap, dtype=np.uint8)
+        gaps_buf = np.empty(gap_cap, dtype=np.int32)
+        sizes = np.zeros(5, dtype=np.int32)
+        err_info = np.zeros(2, dtype=np.int32)
+        ref = np.frombuffer(refseq_aln, dtype=np.uint8)
+        rc = lib.pw_extract(
+            rec.cs.encode(), rec.cigar.encode(),
+            ref.ctypes.data_as(ctypes.c_void_p), len(refseq_aln),
+            offset, int(al.reverse), al.r_len,
+            al.t_alnstart, al.t_alnend, al.r_alnstart, al.r_alnend,
+            tseq_buf.ctypes.data_as(ctypes.c_void_p), tseq_cap,
+            ev_buf.ctypes.data_as(ctypes.c_void_p), ev_cap,
+            arena.ctypes.data_as(ctypes.c_void_p), arena_cap,
+            gaps_buf.ctypes.data_as(ctypes.c_void_p), gap_cap,
+            sizes.ctypes.data_as(ctypes.c_void_p),
+            err_info.ctypes.data_as(ctypes.c_void_p))
+        if rc == 100:  # grow buffers and retry
+            tseq_cap *= 4
+            ev_cap *= 4
+            arena_cap *= 4
+            gap_cap *= 4
+            continue
+        if rc != 0:
+            _raise_native_error(rc, err_info, sizes, rec, refseq_aln)
+        for _ in range(int(sizes[4])):
+            print(f"{E.SOFTCLIP_WARNING}\n{rec.line}", file=sys.stderr)
+        break
+    else:
+        raise PwasmError("native extraction buffers exhausted\n")
+
+    aln = PafAlignment(alninfo=al, seqname=al.t_id, reverse=al.reverse,
+                       edist=rec.edist, alnscore=rec.alnscore)
+    aln.offset = offset
+    aln.seqlen = eff
+    aln.tseq = tseq_buf[: sizes[0]].tobytes()
+    evt_map = "SID"
+    ab = arena.tobytes()
+    for k in range(int(sizes[1])):
+        f = ev_buf[k * EV_FIELDS:(k + 1) * EV_FIELDS]
+        aln.tdiffs.append(DiffEvent(
+            evt=evt_map[f[0]], evtlen=int(f[3]),
+            evtbases=ab[f[4]:f[4] + f[5]], evtsub=ab[f[6]:f[6] + f[7]],
+            rloc=int(f[1]), tloc=int(f[2]),
+            tctx=ab[f[8]:f[8] + f[9]]))
+    for k in range(int(sizes[3])):
+        which, pos, length = (int(x) for x in gaps_buf[k * 3:k * 3 + 3])
+        (aln.rgaps if which == 0 else aln.tgaps).append(
+            GapData(pos, length))
+    return aln
+
+
+def banded_gotoh_batch(q_codes: np.ndarray, ts_codes: np.ndarray,
+                       t_lens: np.ndarray, band: int, dlo: int,
+                       match: int, mismatch: int, gap_open: int,
+                       gap_extend: int) -> np.ndarray | None:
+    """Single-core C++ banded Gotoh over a (T, n_pad) batch; None if the
+    native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    q = np.ascontiguousarray(q_codes, dtype=np.int8)
+    ts = np.ascontiguousarray(ts_codes, dtype=np.int8)
+    tl = np.ascontiguousarray(t_lens, dtype=np.int32)
+    T, n_pad = ts.shape
+    out = np.empty(T, dtype=np.int32)
+    lib.pw_banded_gotoh_batch(
+        q.ctypes.data_as(ctypes.c_void_p), len(q),
+        ts.ctypes.data_as(ctypes.c_void_p),
+        tl.ctypes.data_as(ctypes.c_void_p), T, n_pad,
+        band, dlo, match, mismatch, gap_open, gap_extend,
+        out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+def encode_native(seq: bytes) -> np.ndarray | None:
+    lib = get_lib()
+    if lib is None:
+        return None
+    arr = np.frombuffer(seq, dtype=np.uint8)
+    out = np.empty(len(seq), dtype=np.int8)
+    lib.pw_encode(arr.ctypes.data_as(ctypes.c_void_p), len(seq),
+                  out.ctypes.data_as(ctypes.c_void_p))
+    return out

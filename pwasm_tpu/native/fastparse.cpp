@@ -1,0 +1,413 @@
+// pwasm-tpu native host core: fast per-alignment diff extraction and the
+// single-core banded Gotoh CPU baseline.
+//
+// C ABI consumed through ctypes (pwasm_tpu/native/__init__.py).  The
+// extraction mirrors pwasm_tpu/core/events.py (itself the behavior spec
+// of the reference PAFAlignment constructor, pafreport.cpp:477-719):
+// cs-string walk reconstructing the target and emitting S/I/D events with
+// adjacent-substitution merging and reverse-strand fixups, CIGAR walk
+// collecting gap lists, and the length cross-validations.  Parity between
+// this and the Python extractor is enforced by tests/test_native.py.
+//
+// Layout contracts (all int32 little-endian):
+//   event record  : evt(0=S,1=I,2=D), rloc, tloc, evtlen,
+//                   bases_off, bases_len, sub_off, sub_len,
+//                   tctx_off, tctx_len                      (10 fields)
+//   gap record    : which(0=query/rgap, 1=target/tgap), pos, len
+// Variable-length bytes (event bases / substituted bases / target
+// context) live in a caller-provided arena buffer.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cctype>
+#include <vector>
+#include <string>
+
+namespace {
+
+constexpr int EV_FIELDS = 10;
+
+struct Ev {
+  int32_t evt, rloc, tloc, evtlen;
+  std::string bases, sub, tctx;
+};
+
+char comp(char c) {
+  switch (toupper((unsigned char)c)) {
+    case 'A': return islower((unsigned char)c) ? 't' : 'T';
+    case 'C': return islower((unsigned char)c) ? 'g' : 'G';
+    case 'G': return islower((unsigned char)c) ? 'c' : 'C';
+    case 'T': case 'U': return islower((unsigned char)c) ? 'a' : 'A';
+    case 'M': return islower((unsigned char)c) ? 'k' : 'K';
+    case 'K': return islower((unsigned char)c) ? 'm' : 'M';
+    case 'R': return islower((unsigned char)c) ? 'y' : 'Y';
+    case 'Y': return islower((unsigned char)c) ? 'r' : 'R';
+    case 'V': return islower((unsigned char)c) ? 'b' : 'B';
+    case 'B': return islower((unsigned char)c) ? 'v' : 'V';
+    case 'H': return islower((unsigned char)c) ? 'd' : 'D';
+    case 'D': return islower((unsigned char)c) ? 'h' : 'H';
+    default:  return c;  // W, S, N, X map to themselves
+  }
+}
+
+void revcomp_inplace(std::string& s) {
+  std::string out(s.rbegin(), s.rend());
+  for (auto& c : out) c = comp(c);
+  s = out;
+}
+
+// error codes surfaced to the Python wrapper, which formats the exact
+// reference-parity messages (pwasm_tpu/core/events.py constants)
+enum ErrCode {
+  OK = 0,
+  ERR_CS_PARSE = 1,       // err_info[0] = cs position
+  ERR_BASE_MISMATCH = 2,  // err_info[0] = q_pos, err_info[1] = qch
+  ERR_SPLICE = 3,
+  ERR_CS_OP = 4,          // err_info[0] = position after the op char
+  ERR_CIGAR_PARSE = 5,    // err_info[0] = cigar position
+  ERR_CIGAR_OP = 6,       // err_info[0] = op char, err_info[1] = count
+  ERR_TSEQ_LEN = 7,       // err_info[0] = tpos
+  ERR_REF_LEN = 8,        // err_info[0] = qpos
+  ERR_GROW = 100,         // output buffers too small; caller retries
+};
+
+bool parse_uint(const char* s, int& i, long& out) {
+  int start = i;
+  long v = 0;
+  while (s[i] >= '0' && s[i] <= '9') {
+    v = v * 10 + (s[i] - '0');
+    ++i;
+  }
+  out = v;
+  return i != start;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an ErrCode.  out_sizes = [tseq_len, n_events, arena_used,
+// n_gaps, n_softclip_ops]; n_softclip_ops is valid even on error (S ops
+// seen before the failure, so the wrapper can replay the reference's
+// per-op warnings in order).  err_info carries per-code details.
+int pw_extract(const char* cs, const char* cigar,
+               const uint8_t* ref, int32_t ref_len,
+               int32_t offset, int32_t reverse, int32_t r_len,
+               int32_t t_alnstart, int32_t t_alnend,
+               int32_t r_alnstart, int32_t r_alnend,
+               uint8_t* tseq_out, int32_t tseq_cap,
+               int32_t* ev_out, int32_t ev_cap,
+               uint8_t* arena, int32_t arena_cap,
+               int32_t* gaps_out, int32_t gap_cap,
+               int32_t* out_sizes, int32_t* err_info) {
+  int32_t n_softclip = 0;
+  out_sizes[4] = 0;
+  err_info[0] = err_info[1] = 0;
+#define FAIL(code, a, b) \
+  do { out_sizes[4] = n_softclip; err_info[0] = (int32_t)(a); \
+       err_info[1] = (int32_t)(b); return (code); } while (0)
+  std::string tseq;
+  tseq.reserve((size_t)(t_alnend - t_alnstart) + 2);
+  std::vector<Ev> evs;
+  const int eff_t_len = t_alnend - t_alnstart;
+  long qpos = 0, tpos = 0;
+  int i = 0;
+
+  // ---- cs walk
+  while (cs[i] != '\0') {
+    char op = cs[i++];
+    if (op == ':') {
+      long cl;
+      if (!parse_uint(cs, i, cl)) FAIL(ERR_CS_PARSE, i, 0);
+      if (offset + qpos + cl > ref_len)
+        FAIL(ERR_CS_PARSE, i, 0);
+      tseq.append((const char*)ref + offset + qpos, (size_t)cl);
+      qpos += cl;
+      tpos += cl;
+    } else if (op == '*') {
+      if (cs[i] == '\0' || cs[i + 1] == '\0')
+        FAIL(ERR_CS_PARSE, i, 0);
+      char tch = (char)toupper((unsigned char)cs[i]);
+      char qch = (char)toupper((unsigned char)cs[i + 1]);
+      i += 2;
+      long q_pos = offset + qpos;
+      if (q_pos >= ref_len || qch != (char)ref[q_pos])
+        FAIL(ERR_BASE_MISMATCH, q_pos, qch);
+      if (!evs.empty() && evs.back().evt == 0 &&
+          evs.back().rloc == q_pos - (long)evs.back().bases.size()) {
+        evs.back().bases.push_back(tch);
+        evs.back().sub.push_back(qch);
+        // NB: evtlen stays 1 for merged substitutions (reference quirk)
+      } else {
+        Ev e;
+        e.evt = 0;
+        e.evtlen = 1;
+        e.rloc = (int32_t)q_pos;
+        e.tloc = (int32_t)tpos;
+        e.bases.push_back(tch);
+        e.sub.push_back(qch);
+        evs.push_back(std::move(e));
+      }
+      tseq.push_back((char)tolower((unsigned char)tch));
+      ++qpos;
+      ++tpos;
+    } else if (op == '-') {  // bases present only in the target: Insertion
+      long s_pos = tpos;
+      while (isalpha((unsigned char)cs[i])) {
+        tseq.push_back((char)tolower((unsigned char)cs[i]));
+        ++i;
+        ++tpos;
+      }
+      long e_len = tpos - s_pos;
+      long q_pos = offset + qpos;
+      Ev e;
+      e.evt = 1;
+      e.evtlen = (int32_t)e_len;
+      e.rloc = (int32_t)q_pos;
+      e.tloc = (int32_t)s_pos;
+      e.bases = tseq.substr(tseq.size() - (size_t)e_len);
+      if (reverse) {
+        revcomp_inplace(e.bases);
+        e.rloc = (int32_t)(r_len - q_pos);
+      }
+      evs.push_back(std::move(e));
+    } else if (op == '+') {  // query bases missing from target: Deletion
+      long s_pos = qpos;
+      while (isalpha((unsigned char)cs[i])) {
+        ++i;
+        ++qpos;
+      }
+      long e_len = qpos - s_pos;
+      long q_pos = s_pos + offset;
+      if (q_pos + e_len > ref_len)
+        FAIL(ERR_CS_PARSE, i, 0);
+      Ev e;
+      e.evt = 2;
+      e.evtlen = (int32_t)e_len;
+      e.rloc = (int32_t)q_pos;
+      e.tloc = (int32_t)tpos;
+      e.bases.assign((const char*)ref + q_pos, (size_t)e_len);
+      if (reverse) {
+        revcomp_inplace(e.bases);
+        e.rloc = (int32_t)(r_len - q_pos - e_len);
+      }
+      evs.push_back(std::move(e));
+    } else if (op == '~') {
+      FAIL(ERR_SPLICE, 0, 0);
+    } else {
+      FAIL(ERR_CS_OP, i, 0);
+    }
+  }
+
+  // ---- context fill + reverse fixups
+  const long tlen = (long)tseq.size();
+  for (auto& e : evs) {
+    long tc_start = e.tloc - 5;
+    if (tc_start < 0) tc_start = 0;
+    long evt_len = (e.evt == 2) ? 0 : e.evtlen;
+    long tc_end = e.tloc + evt_len + 5;
+    if (tc_end >= tlen) tc_end = tlen - 1;
+    e.tctx = tseq.substr((size_t)tc_start, (size_t)(tc_end - tc_start));
+    if (reverse) {
+      revcomp_inplace(e.tctx);
+      e.tloc = (int32_t)(tlen - e.tloc);
+      if (e.evt == 0) {
+        revcomp_inplace(e.bases);
+        revcomp_inplace(e.sub);
+        e.rloc = (int32_t)(r_len - e.rloc - (long)e.bases.size());
+      }
+    }
+  }
+  if (reverse) {
+    std::vector<Ev> rev(evs.rbegin(), evs.rend());
+    evs = std::move(rev);
+  }
+
+  // ---- CIGAR walk
+  std::vector<int32_t> gaps;  // triples
+  qpos = 0;
+  tpos = 0;
+  i = 0;
+  while (cigar[i] != '\0') {
+    long cl;
+    if (!parse_uint(cigar, i, cl))
+      FAIL(ERR_CIGAR_PARSE, i, 0);
+    char cop = cigar[i];
+    if (cop == '\0') FAIL(ERR_CIGAR_PARSE, i, 0);
+    switch (cop) {
+      case 'X': case 'M': case '=':
+        tpos += cl;
+        qpos += cl;
+        break;
+      case 'P': case 'H':
+        break;
+      case 'S':
+        ++n_softclip;  // Python layer replays the per-op warning
+        qpos += cl;
+        break;
+      case 'I': {
+        long pos = reverse ? eff_t_len - tpos : tpos;
+        gaps.push_back(1);
+        gaps.push_back((int32_t)pos);
+        gaps.push_back((int32_t)cl);
+        qpos += cl;
+        break;
+      }
+      case 'D': case 'N': {
+        long pos = offset + qpos;
+        if (reverse) pos = r_len - pos;
+        gaps.push_back(0);
+        gaps.push_back((int32_t)pos);
+        gaps.push_back((int32_t)cl);
+        tpos += cl;
+        break;
+      }
+      default:
+        FAIL(ERR_CIGAR_OP, (unsigned char)cop, cl);
+    }
+    ++i;
+  }
+
+  // ---- cross-validation
+  if (eff_t_len != tpos || (long)tseq.size() != tpos)
+    FAIL(ERR_TSEQ_LEN, tpos, 0);
+  if (r_alnend - r_alnstart != qpos)
+    FAIL(ERR_REF_LEN, qpos, 0);
+
+  // ---- serialize
+  if ((int32_t)tseq.size() > tseq_cap) return ERR_GROW;
+  if ((int32_t)evs.size() * EV_FIELDS > ev_cap) return ERR_GROW;
+  if ((int32_t)gaps.size() > gap_cap) return ERR_GROW;
+  long arena_used = 0;
+  for (auto& e : evs)
+    arena_used += (long)(e.bases.size() + e.sub.size() + e.tctx.size());
+  if (arena_used > arena_cap) return ERR_GROW;
+
+  memcpy(tseq_out, tseq.data(), tseq.size());
+  int32_t* p = ev_out;
+  long aoff = 0;
+  for (auto& e : evs) {
+    p[0] = e.evt;
+    p[1] = e.rloc;
+    p[2] = e.tloc;
+    p[3] = e.evtlen;
+    p[4] = (int32_t)aoff;
+    p[5] = (int32_t)e.bases.size();
+    memcpy(arena + aoff, e.bases.data(), e.bases.size());
+    aoff += (long)e.bases.size();
+    p[6] = (int32_t)aoff;
+    p[7] = (int32_t)e.sub.size();
+    memcpy(arena + aoff, e.sub.data(), e.sub.size());
+    aoff += (long)e.sub.size();
+    p[8] = (int32_t)aoff;
+    p[9] = (int32_t)e.tctx.size();
+    memcpy(arena + aoff, e.tctx.data(), e.tctx.size());
+    aoff += (long)e.tctx.size();
+    p += EV_FIELDS;
+  }
+  if (!gaps.empty())
+    memcpy(gaps_out, gaps.data(), gaps.size() * sizeof(int32_t));
+  out_sizes[0] = (int32_t)tseq.size();
+  out_sizes[1] = (int32_t)evs.size();
+  out_sizes[2] = (int32_t)arena_used;
+  out_sizes[3] = (int32_t)(gaps.size() / 3);
+  out_sizes[4] = n_softclip;
+  return OK;
+}
+#undef FAIL
+
+// Single-core banded Gotoh over int8 base codes — the honest CPU baseline
+// for the TPU banded-DP benchmarks (same recurrence as
+// pwasm_tpu/ops/banded_dp.py, no Ix<->Iy adjacency).  Returns the global
+// score at (m, t_len), or NEG if t_len's end diagonal is out of band.
+int32_t pw_banded_gotoh(const int8_t* q, int32_t m,
+                        const int8_t* t, int32_t t_len,
+                        int32_t band, int32_t dlo,
+                        int32_t match, int32_t mismatch,
+                        int32_t gap_open, int32_t gap_extend) {
+  const int32_t NEG = -(1 << 30);
+  const int32_t go = gap_open + gap_extend;
+  const int32_t ge = gap_extend;
+  const int32_t n = t_len;
+  std::vector<int32_t> M(band), Ix(band), Iy(band);
+  std::vector<int32_t> M2(band), Ix2(band), Iy2(band);
+  for (int b = 0; b < band; ++b) {
+    int j = dlo + b;
+    M[b] = (j == 0) ? 0 : NEG;
+    Iy[b] = (j >= 1 && j <= n) ? -(go + (j - 1) * ge) : NEG;
+    Ix[b] = NEG;
+  }
+  for (int i = 1; i <= m; ++i) {
+    const int8_t qi = q[i - 1];
+    for (int b = 0; b < band; ++b) {
+      int j = i + dlo + b;
+      bool valid = (j >= 1 && j <= n);
+      int32_t mnew = NEG;
+      if (valid) {
+        int32_t diag = M[b];
+        if (Ix[b] > diag) diag = Ix[b];
+        if (Iy[b] > diag) diag = Iy[b];
+        int32_t s = (qi == t[j - 1] && qi < 4) ? match : -mismatch;
+        mnew = diag + s;
+      }
+      M2[b] = mnew;
+      int32_t upM = (b + 1 < band) ? M[b + 1] : NEG;
+      int32_t upIx = (b + 1 < band) ? Ix[b + 1] : NEG;
+      int32_t ix = upM - go;
+      if (upIx - ge > ix) ix = upIx - ge;
+      if (j == 0) ix = -(go + (i - 1) * ge);
+      if (j < 0 || j > n) ix = NEG;
+      Ix2[b] = ix;
+      int32_t iy = NEG;
+      if (valid && b > 0) {
+        int32_t a = M2[b - 1] - go;
+        int32_t c = Iy2[b - 1] - ge;
+        iy = (a > c) ? a : c;
+      }
+      Iy2[b] = iy;
+    }
+    M.swap(M2);
+    Ix.swap(Ix2);
+    Iy.swap(Iy2);
+  }
+  int b_end = n - m - dlo;
+  if (b_end < 0 || b_end >= band) return NEG;
+  int32_t best = M[b_end];
+  if (Ix[b_end] > best) best = Ix[b_end];
+  if (Iy[b_end] > best) best = Iy[b_end];
+  return best;
+}
+
+// Batched wrapper over contiguous (T, n_pad) targets.
+void pw_banded_gotoh_batch(const int8_t* q, int32_t m,
+                           const int8_t* ts, const int32_t* t_lens,
+                           int32_t T, int32_t n_pad,
+                           int32_t band, int32_t dlo,
+                           int32_t match, int32_t mismatch,
+                           int32_t gap_open, int32_t gap_extend,
+                           int32_t* out) {
+  for (int32_t k = 0; k < T; ++k) {
+    out[k] = pw_banded_gotoh(q, m, ts + (size_t)k * n_pad, t_lens[k],
+                             band, dlo, match, mismatch, gap_open,
+                             gap_extend);
+  }
+}
+
+// Base-code encoder (A0 C1 G2 T3 N4, '-'/'*' 5, case-insensitive).
+void pw_encode(const uint8_t* seq, int32_t n, int8_t* out) {
+  static int8_t lut[256];
+  static bool init = false;
+  if (!init) {
+    for (int k = 0; k < 256; ++k) lut[k] = 4;
+    lut['A'] = lut['a'] = 0;
+    lut['C'] = lut['c'] = 1;
+    lut['G'] = lut['g'] = 2;
+    lut['T'] = lut['t'] = lut['U'] = lut['u'] = 3;
+    lut['-'] = lut['*'] = 5;
+    init = true;
+  }
+  for (int32_t k = 0; k < n; ++k) out[k] = lut[seq[k]];
+}
+
+}  // extern "C"

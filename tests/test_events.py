@@ -18,7 +18,8 @@ def _extract(line, q_seq=Q):
     rec = parse_paf_line(line)
     q = q_seq.upper().encode()
     refseq_aln = revcomp(q) if rec.alninfo.reverse else q
-    return extract_alignment(rec, refseq_aln)
+    # pin the pure-Python path; native parity is covered by test_native.py
+    return extract_alignment(rec, refseq_aln, use_native=False)
 
 
 def test_forward_worked_example():

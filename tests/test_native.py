@@ -1,0 +1,162 @@
+"""Native C++ host core: extraction parity vs the Python path, the
+single-core banded Gotoh baseline, and the encoder."""
+
+import numpy as np
+import pytest
+
+from pwasm_tpu.core.dna import encode, revcomp
+from pwasm_tpu.core.errors import PwasmError
+from pwasm_tpu.core.events import extract_alignment
+from pwasm_tpu.core.paf import parse_paf_line
+from pwasm_tpu.native import (
+    banded_gotoh_batch,
+    encode_native,
+    extract_native,
+    native_available,
+)
+from pwasm_tpu.ops.banded_dp import ScoreParams, band_dlo, full_gotoh_score
+
+from helpers import make_paf_line
+from test_events import _random_ops
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native library unavailable")
+
+
+def _aln_tuple(aln):
+    return (aln.tseq, aln.offset, aln.seqlen,
+            [(e.evt, e.rloc, e.tloc, e.evtlen, e.evtbases, e.evtsub,
+              e.tctx) for e in aln.tdiffs],
+            [(g.pos, g.len) for g in aln.rgaps],
+            [(g.pos, g.len) for g in aln.tgaps])
+
+
+@pytest.mark.parametrize("strand", ["+", "-"])
+@pytest.mark.parametrize("seed", range(8))
+def test_extraction_parity(strand, seed):
+    rng = np.random.default_rng(300 + seed)
+    q = "".join(rng.choice(list("ACGT"), size=int(rng.integers(60, 160))))
+    q_start = int(rng.integers(0, 8))
+    q_end = len(q) - int(rng.integers(0, 8))
+    if strand == "-":
+        q_aln = revcomp(q.encode()).decode()[len(q) - q_end:len(q) - q_start]
+    else:
+        q_aln = q[q_start:q_end]
+    ops = _random_ops(rng, q_aln)
+    line, _ = make_paf_line("q", q, "t", strand, ops,
+                            q_start=q_start, q_end=q_end)
+    rec = parse_paf_line(line)
+    refseq_aln = revcomp(q.encode()) if rec.alninfo.reverse else q.encode()
+    py = extract_alignment(rec, refseq_aln, use_native=False)
+    nat = extract_native(rec, refseq_aln)
+    assert _aln_tuple(nat) == _aln_tuple(py)
+
+
+def test_native_error_base_mismatch():
+    q = "ACGTACGTAC"
+    line, _ = make_paf_line("q", q, "t", "+",
+                            [("=", 3), ("*", "a", "t"), ("=", 6)])
+    line = line.replace("*at", "*ag")
+    rec = parse_paf_line(line)
+    with pytest.raises(PwasmError, match="base mismatch"):
+        extract_native(rec, q.encode())
+
+
+def test_native_error_splice_and_lengths():
+    q = "ACGTACGTAC"
+    line, _ = make_paf_line("q", q, "t", "+", [("=", 10)])
+    rec = parse_paf_line(line.replace("cs:Z::10", "cs:Z::5~gt4ac:5"))
+    with pytest.raises(PwasmError, match="spliced"):
+        extract_native(rec, q.encode())
+    rec2 = parse_paf_line(line.replace("cg:Z:10M", "cg:Z:9M"))
+    with pytest.raises(PwasmError, match="length mismatch"):
+        extract_native(rec2, q.encode())
+
+
+def test_native_buffer_growth_long_insertion():
+    # an insertion far larger than the initial arena guess
+    rng = np.random.default_rng(1)
+    q = "".join(rng.choice(list("ACGT"), size=50))
+    ins = "".join(rng.choice(list("acgt"), size=3000))
+    line, _ = make_paf_line("q", q, "t", "+",
+                            [("=", 25), ("ins", ins), ("=", 25)])
+    rec = parse_paf_line(line)
+    py = extract_alignment(rec, q.encode(), use_native=False)
+    nat = extract_native(rec, q.encode())
+    assert _aln_tuple(nat) == _aln_tuple(py)
+
+
+def test_banded_gotoh_matches_oracle():
+    rng = np.random.default_rng(5)
+    p = ScoreParams()
+    m = 40
+    q = rng.integers(0, 4, size=m).astype(np.int8)
+    targets, lens = [], []
+    n_pad = 56
+    for _ in range(8):
+        t = list(q)
+        for _ in range(int(rng.integers(0, 4))):
+            t[int(rng.integers(0, len(t)))] = int(rng.integers(0, 4))
+        if rng.random() < 0.5 and len(t) > 10:
+            del t[int(rng.integers(1, len(t) - 1))]
+        pad = np.full(n_pad, 127, dtype=np.int8)
+        pad[:len(t)] = t
+        targets.append(pad)
+        lens.append(len(t))
+    ts = np.stack(targets)
+    tl = np.array(lens, dtype=np.int32)
+    dlo = band_dlo(m, n_pad, 32)
+    got = banded_gotoh_batch(q, ts, tl, 32, dlo, p.match, p.mismatch,
+                             p.gap_open, p.gap_extend)
+    for k in range(8):
+        assert got[k] == full_gotoh_score(q, targets[k][:lens[k]], p)
+
+
+def test_native_jax_banded_parity():
+    import jax.numpy as jnp
+
+    from pwasm_tpu.ops.banded_dp import banded_scores_batch
+
+    rng = np.random.default_rng(9)
+    p = ScoreParams()
+    m = 48
+    q = rng.integers(0, 4, size=m).astype(np.int8)
+    n_pad = 64
+    ts = np.full((10, n_pad), 127, dtype=np.int8)
+    tl = np.zeros(10, dtype=np.int32)
+    for k in range(10):
+        t = list(q)
+        for _ in range(int(rng.integers(0, 5))):
+            t[int(rng.integers(0, len(t)))] = int(rng.integers(0, 4))
+        ts[k, :len(t)] = t
+        tl[k] = len(t)
+    dlo = band_dlo(m, n_pad, 32)
+    nat = banded_gotoh_batch(q, ts, tl, 32, dlo, p.match, p.mismatch,
+                             p.gap_open, p.gap_extend)
+    jx = np.asarray(banded_scores_batch(jnp.asarray(q), jnp.asarray(ts),
+                                        jnp.asarray(tl), band=32))
+    np.testing.assert_array_equal(nat, jx)
+
+
+def test_encode_native_matches_python():
+    seq = b"ACGTNacgtn-*XRYW"
+    np.testing.assert_array_equal(encode_native(seq), encode(seq))
+
+
+def test_cli_uses_native_transparently(tmp_path):
+    # end-to-end through the CLI with the native extractor active
+    from io import StringIO
+
+    from pwasm_tpu.cli import run
+    from pwasm_tpu.core.fasta import write_fasta
+
+    fa = tmp_path / "q.fa"
+    write_fasta(str(fa), [("q", b"ACGTACGTAC")])
+    line, _ = make_paf_line("q", "ACGTACGTAC", "asm1", "+",
+                            [("=", 3), ("*", "a", "t"), ("=", 6)])
+    paf = tmp_path / "in.paf"
+    paf.write_text(line + "\n")
+    out = StringIO()
+    assert run([str(paf), "-r", str(fa)], stdout=out,
+               stderr=StringIO()) == 0
+    assert "S\t4\t" in out.getvalue()
