@@ -140,6 +140,9 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
     cfg.verbose = bool(opts.get("v")) or cfg.debug
     cfg.gene_cds = gene_cds
     cfg.device = str(opts.get("device", "cpu"))
+    if cfg.device not in ("cpu", "tpu"):
+        raise CliError(f"{USAGE}\nInvalid --device value: {cfg.device} "
+                       "(must be cpu or tpu)\n")
     for knob in ("band", "batch"):
         if knob in opts:
             val = opts[knob]
@@ -226,82 +229,112 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
     ref_msa: Msa | None = None
     numalns = 0
 
-    for line in inf:
-        line = line.rstrip("\n")
-        if not line or line.startswith("#"):
-            continue
-        rec = parse_paf_line(line)
-        al: AlnInfo = rec.alninfo
-        if al.r_id == al.t_id:
-            if cfg.verbose:
-                print("Skipping alignment of qry seq to itself.",
-                      file=stderr)
-            continue
-        if not cfg.fullgenome:  # gene CDS mode: first q~t alignment only
-            key = f"{al.r_id}~{al.t_id}"
-            if key not in alnpairs:
-                alnpairs[key] = 0
-            else:
-                alnpairs[key] += 1
-                if alnpairs[key] == 1:
-                    print(f"Warning: alignment {al.r_id} to {al.t_id} "
-                          f"already seen, ignoring ", file=stderr)
+    # --device=tpu: buffer alignments and flush through one batched device
+    # program per cfg.batch (the SURVEY.md §3.1 TPU boundary — control
+    # crosses host->device once per batch, not per alignment)
+    use_device = cfg.device != "cpu"
+    pending: list[tuple] = []
+
+    def flush_pending():
+        if not pending:
+            return
+        from pwasm_tpu.report.device_report import print_diff_info_batch
+        print_diff_info_batch(pending, freport, skip_codan=cfg.skip_codan,
+                              motifs=cfg.motifs, summary=summary)
+        pending.clear()
+
+    def per_line_loop():
+        nonlocal refseq_id, refseq, refseq_rc, ref_gseq, ref_msa, \
+            numalns
+        for line in inf:
+            line = line.rstrip("\n")
+            if not line or line.startswith("#"):
                 continue
-        numalns += 1
-        if refseq_id is None or refseq_id != al.r_id:
-            if al.r_id in ref_cache:
-                refseq = ref_cache[al.r_id]
-            else:
-                fetched = qfasta.fetch(al.r_id)
-                if fetched is None:
-                    raise PwasmError(
-                        f"Error: could not retrieve sequence for "
-                        f"{al.r_id} !\n")
-                refseq = bytes(fetched).upper()
-                ref_cache[al.r_id] = refseq
-            refseq_rc = revcomp(refseq)
-            refseq_id = al.r_id
-            ref_gseq = None
-        if al.r_len != len(refseq):
-            raise PwasmError(
-                f"Error: ref seq len in this PAF line ({al.r_len}) differs "
-                f"from loaded sequence length({len(refseq)})!\n{line}\n")
-        refseq_aln = refseq_rc if al.reverse else refseq
-        aln = extract_alignment(rec, refseq_aln)
-        tlabel = f"{al.t_id}:{al.t_alnstart}-{al.t_alnend}" \
-            + ("-" if al.reverse else "+")
-        rlabel = al.r_id
-        if cfg.fullgenome:
-            rlabel += f":{al.r_alnstart}-{al.r_alnend}"
-        if freport is not None:
-            if len(qfasta) == 1 and not cfg.fullgenome:
-                rlabel = ""
-            print_diff_info(aln, rlabel, tlabel, freport, refseq,
-                            skip_codan=cfg.skip_codan, motifs=cfg.motifs,
-                            summary=summary)
-        if fmsa is not None:
-            taseq = GapSeq(tlabel, "", aln.tseq, offset=al.r_alnstart,
-                           revcompl=aln.reverse)
-            first_ref_aln = ref_gseq is None
-            if first_ref_aln:
-                rseq = GapSeq(al.r_id, "", refseq)
-                rseq.set_flag(FLAG_IS_REF)
-            else:
-                # bare instance of refseq for this alignment
-                rseq = GapSeq(al.r_id, "", b"", seqlen=al.r_len)
-            # once a gap, always a gap: propagate this alignment's gaps
-            for g in aln.rgaps:
-                rseq.set_gap(g.pos, g.len)
-            for g in aln.tgaps:
-                taseq.set_gap(g.pos, g.len)
-            newmsa = Msa(rseq, taseq)
-            if first_ref_aln:
-                newmsa.ordnum = numalns
-                ref_msa = newmsa
-                ref_gseq = rseq
-            else:
-                ref_gseq.msa.add_align(ref_gseq, newmsa, rseq)
-                ref_msa = ref_gseq.msa
+            rec = parse_paf_line(line)
+            al: AlnInfo = rec.alninfo
+            if al.r_id == al.t_id:
+                if cfg.verbose:
+                    print("Skipping alignment of qry seq to itself.",
+                          file=stderr)
+                continue
+            if not cfg.fullgenome:  # gene CDS mode: first q~t alignment only
+                key = f"{al.r_id}~{al.t_id}"
+                if key not in alnpairs:
+                    alnpairs[key] = 0
+                else:
+                    alnpairs[key] += 1
+                    if alnpairs[key] == 1:
+                        print(f"Warning: alignment {al.r_id} to {al.t_id} "
+                              f"already seen, ignoring ", file=stderr)
+                    continue
+            numalns += 1
+            if refseq_id is None or refseq_id != al.r_id:
+                if al.r_id in ref_cache:
+                    refseq = ref_cache[al.r_id]
+                else:
+                    fetched = qfasta.fetch(al.r_id)
+                    if fetched is None:
+                        raise PwasmError(
+                            f"Error: could not retrieve sequence for "
+                            f"{al.r_id} !\n")
+                    refseq = bytes(fetched).upper()
+                    ref_cache[al.r_id] = refseq
+                refseq_rc = revcomp(refseq)
+                refseq_id = al.r_id
+                ref_gseq = None
+            if al.r_len != len(refseq):
+                raise PwasmError(
+                    f"Error: ref seq len in this PAF line ({al.r_len}) differs "
+                    f"from loaded sequence length({len(refseq)})!\n{line}\n")
+            refseq_aln = refseq_rc if al.reverse else refseq
+            aln = extract_alignment(rec, refseq_aln)
+            tlabel = f"{al.t_id}:{al.t_alnstart}-{al.t_alnend}" \
+                + ("-" if al.reverse else "+")
+            rlabel = al.r_id
+            if cfg.fullgenome:
+                rlabel += f":{al.r_alnstart}-{al.r_alnend}"
+            if freport is not None:
+                if len(qfasta) == 1 and not cfg.fullgenome:
+                    rlabel = ""
+                if use_device:
+                    pending.append((aln, rlabel, tlabel, refseq))
+                    if len(pending) >= cfg.batch:
+                        flush_pending()
+                else:
+                    print_diff_info(aln, rlabel, tlabel, freport, refseq,
+                                    skip_codan=cfg.skip_codan,
+                                    motifs=cfg.motifs, summary=summary)
+            if fmsa is not None:
+                taseq = GapSeq(tlabel, "", aln.tseq, offset=al.r_alnstart,
+                               revcompl=aln.reverse)
+                first_ref_aln = ref_gseq is None
+                if first_ref_aln:
+                    rseq = GapSeq(al.r_id, "", refseq)
+                    rseq.set_flag(FLAG_IS_REF)
+                else:
+                    # bare instance of refseq for this alignment
+                    rseq = GapSeq(al.r_id, "", b"", seqlen=al.r_len)
+                # once a gap, always a gap: propagate this alignment's gaps
+                for g in aln.rgaps:
+                    rseq.set_gap(g.pos, g.len)
+                for g in aln.tgaps:
+                    taseq.set_gap(g.pos, g.len)
+                newmsa = Msa(rseq, taseq)
+                if first_ref_aln:
+                    newmsa.ordnum = numalns
+                    ref_msa = newmsa
+                    ref_gseq = rseq
+                else:
+                    ref_gseq.msa.add_align(ref_gseq, newmsa, rseq)
+                    ref_msa = ref_gseq.msa
+
+    try:
+        per_line_loop()
+    finally:
+        # emit whatever the device batch buffer holds — including when
+        # a later bad line raises, so earlier alignments' rows aren't
+        # dropped (the cpu path writes them progressively)
+        flush_pending()
 
     if cfg.debug and ref_msa is not None:
         print(f">MSA ({ref_msa.count()})", file=stderr)

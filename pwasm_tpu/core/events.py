@@ -180,6 +180,10 @@ def extract_alignment(rec: PafRecord, refseq_aln: bytes,
             if i2 == i:
                 raise PwasmError(CS_ERROR.format(line, cs[i:]))
             i = i2
+            if offset + qpos + cl > len(refseq_aln):
+                # copy-match run goes past the query end (the native
+                # extractor checks this too; keeps both paths identical)
+                raise PwasmError(CS_ERROR.format(line, cs[i:]))
             tseq += refseq_aln[offset + qpos: offset + qpos + cl]
             qpos += cl
             tpos += cl
@@ -232,6 +236,9 @@ def extract_alignment(rec: PafRecord, refseq_aln: bytes,
                 qpos += 1
             e_len = qpos - s_pos
             q_pos = s_pos + offset
+            if q_pos + e_len > len(refseq_aln):
+                # deleted-bases run goes past the query end (native parity)
+                raise PwasmError(CS_ERROR.format(line, cs[i:]))
             ev = DiffEvent("D", e_len,
                            bytes(refseq_aln[q_pos:q_pos + e_len]), b"",
                            rloc=q_pos, tloc=tpos)

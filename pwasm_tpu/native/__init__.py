@@ -2,8 +2,8 @@
 
 Provides the hot host-side loops as native code (SURVEY.md §2.4): the
 per-alignment cs/CIGAR diff extraction and a single-core banded Gotoh
-(the honest CPU baseline for the TPU DP benchmarks), plus the base-code
-encoder.  Built on first use with g++ (cached .so, rebuilt when the
+(the honest CPU baseline for the TPU DP benchmarks).
+Built on first use with g++ (cached .so, rebuilt when the
 source is newer); every entry point has a pure-Python fallback, so the
 package works without a toolchain.
 
@@ -31,7 +31,10 @@ EV_FIELDS = 10
 
 
 def _build() -> bool:
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO]
+    # compile to a process-unique temp path, then publish atomically with
+    # rename so concurrent processes never load a partially written .so
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
     try:
         res = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=180)
@@ -40,7 +43,12 @@ def _build() -> bool:
     if res.returncode != 0:
         print(f"pwasm-tpu: native build failed:\n{res.stderr[:2000]}",
               file=sys.stderr)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
+    os.replace(tmp, _SO)
     return True
 
 
@@ -66,7 +74,6 @@ def get_lib():
         lib.pw_extract.restype = ctypes.c_int
         lib.pw_banded_gotoh.restype = ctypes.c_int32
         lib.pw_banded_gotoh_batch.restype = None
-        lib.pw_encode.restype = None
         _lib = lib
     return _lib
 
@@ -213,12 +220,3 @@ def banded_gotoh_batch(q_codes: np.ndarray, ts_codes: np.ndarray,
     return out
 
 
-def encode_native(seq: bytes) -> np.ndarray | None:
-    lib = get_lib()
-    if lib is None:
-        return None
-    arr = np.frombuffer(seq, dtype=np.uint8)
-    out = np.empty(len(seq), dtype=np.int8)
-    lib.pw_encode(arr.ctypes.data_as(ctypes.c_void_p), len(seq),
-                  out.ctypes.data_as(ctypes.c_void_p))
-    return out

@@ -394,20 +394,4 @@ void pw_banded_gotoh_batch(const int8_t* q, int32_t m,
   }
 }
 
-// Base-code encoder (A0 C1 G2 T3 N4, '-'/'*' 5, case-insensitive).
-void pw_encode(const uint8_t* seq, int32_t n, int8_t* out) {
-  static int8_t lut[256];
-  static bool init = false;
-  if (!init) {
-    for (int k = 0; k < 256; ++k) lut[k] = 4;
-    lut['A'] = lut['a'] = 0;
-    lut['C'] = lut['c'] = 1;
-    lut['G'] = lut['g'] = 2;
-    lut['T'] = lut['t'] = lut['U'] = lut['u'] = 3;
-    lut['-'] = lut['*'] = 5;
-    init = true;
-  }
-  for (int32_t k = 0; k < n; ++k) out[k] = lut[seq[k]];
-}
-
 }  // extern "C"

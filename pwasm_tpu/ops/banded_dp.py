@@ -136,20 +136,28 @@ def banded_scores_batch(q: jax.Array, ts: jax.Array, t_lens: jax.Array,
 
 
 # ---------------------------------------------------------------------------
-# Pallas TPU kernel: whole batch in one kernel, band on the lane axis,
-# targets on the sublane axis.
+# Pallas TPU kernel: band on the SUBLANE axis, targets on the LANE axis
+# (128 targets per block).  The per-row band window of the target is a
+# dynamic-start sublane slice of a padded, transposed target ref — the only
+# memory-access pattern in the row loop, and one Mosaic lowers natively
+# (no gathers, no value-space dynamic_slice).  The query lives in SMEM and
+# is read one scalar per row.
 # ---------------------------------------------------------------------------
 def _banded_kernel(q_ref, t_ref, tlen_ref, out_ref, *, m, n, band, dlo,
                    match, mismatch, go, ge, block_t):
     """One grid step aligns ``block_t`` targets against the shared query.
 
-    State: three (block_t, band) int32 wavefronts updated over m rows with
-    a fori_loop; the Iy chain is a log2(band) shift-max cumulative scan.
+    State: three (band, block_t) int32 wavefronts updated over m rows with
+    a fori_loop; the Iy chain is a log2(band) shift-max cumulative scan
+    along the sublane (band) axis.  ``t_ref`` is (band + n + band, block_t):
+    the target transposed with ``band`` rows of padding on both ends so the
+    row-``i`` window load ``t_ref[ii + dlo + band :][:band]`` is always in
+    bounds (band_dlo guarantees dlo >= 1 - band and m + dlo <= n).
     """
-    bidx = jax.lax.broadcasted_iota(jnp.int32, (block_t, band), 1)
-    q = q_ref[...]        # (1, m) int32
-    t = t_ref[...]        # (block_t, n) int32
-    neg = jnp.full((block_t, band), NEG, dtype=jnp.int32)
+    from jax.experimental import pallas as pl
+
+    bidx = jax.lax.broadcasted_iota(jnp.int32, (band, block_t), 0)
+    neg = jnp.full((band, block_t), NEG, dtype=jnp.int32)
 
     j0 = dlo + bidx
     m_v = jnp.where(j0 == 0, 0, NEG)
@@ -161,14 +169,15 @@ def _banded_kernel(q_ref, t_ref, tlen_ref, out_ref, *, m, n, band, dlo,
         i = ii + 1
         j = i + dlo + bidx
         valid = (j >= 1) & (j <= n)
-        qi = jax.lax.dynamic_slice(q, (0, ii), (1, 1))[0, 0]
-        jc = jnp.clip(j - 1, 0, n - 1)
-        tj = jnp.take_along_axis(t, jc, axis=1)
+        qi = q_ref[0, ii]  # scalar load from SMEM (dynamic index OK)
+        # band window of target bases t[j-1]: rows (i+dlo-1+b) of the
+        # unpadded transpose = rows (ii+dlo+band ...) of the padded ref
+        tj = t_ref[pl.ds(ii + dlo + band, band), :]
         s = jnp.where((qi == tj) & (qi < 4), match, -mismatch)
         diag = jnp.maximum(m_prev, jnp.maximum(ix_prev, iy_prev))
         m_new = jnp.where(valid, diag + s, NEG)
-        up_m = jnp.concatenate([m_prev[:, 1:], neg[:, :1]], axis=1)
-        up_ix = jnp.concatenate([ix_prev[:, 1:], neg[:, :1]], axis=1)
+        up_m = jnp.concatenate([m_prev[1:], neg[:1]], axis=0)
+        up_ix = jnp.concatenate([ix_prev[1:], neg[:1]], axis=0)
         ix_new = jnp.maximum(up_m - go, up_ix - ge)
         ix_new = jnp.where(j == 0, -(go + (i - 1) * ge), ix_new)
         ix_new = jnp.where((j < 0) | (j > n), NEG, ix_new)
@@ -176,22 +185,22 @@ def _banded_kernel(q_ref, t_ref, tlen_ref, out_ref, *, m, n, band, dlo,
         run = m_new + bidx * ge
         sh = 1
         while sh < band:
-            shifted = jnp.concatenate(
-                [neg[:, :sh], run[:, :-sh]], axis=1)
+            shifted = jnp.concatenate([neg[:sh], run[:-sh]], axis=0)
             run = jnp.maximum(run, shifted)
             sh *= 2
-        run_prev = jnp.concatenate([neg[:, :1], run[:, :-1]], axis=1)
+        run_prev = jnp.concatenate([neg[:1], run[:-1]], axis=0)
         iy_new = run_prev - go - (bidx - 1) * ge
         iy_new = jnp.where(valid, iy_new, NEG)
         return m_new, ix_new, iy_new
 
     m_f, ix_f, iy_f = jax.lax.fori_loop(0, m, row, (m_v, ix_v, iy_v))
-    t_len = tlen_ref[...]  # (block_t, 1)
+    t_len = tlen_ref[...]  # (1, block_t)
     b_end = t_len - m - dlo
     in_band = (b_end >= 0) & (b_end < band)
-    b_clip = jnp.clip(b_end, 0, band - 1)
     best3 = jnp.maximum(m_f, jnp.maximum(ix_f, iy_f))
-    best = jnp.take_along_axis(best3, b_clip, axis=1)
+    # per-lane extraction of band row b_end: masked max (no gather)
+    best = jnp.max(jnp.where(bidx == b_end, best3, NEG), axis=0,
+                   keepdims=True)
     out_ref[...] = jnp.where(in_band, best, NEG)
 
 
@@ -201,14 +210,15 @@ def _banded_kernel(q_ref, t_ref, tlen_ref, out_ref, *, m, n, band, dlo,
 def banded_scores_pallas(q: jax.Array, ts: jax.Array, t_lens: jax.Array,
                          band: int = 128,
                          params: ScoreParams = ScoreParams(),
-                         block_t: int = 8,
+                         block_t: int = 128,
                          interpret: bool | None = None) -> jax.Array:
     """Pallas banded aligner: (T, n) targets -> (T,) int32 scores.
 
-    band rides the lane axis (use multiples of 128); targets ride the
-    sublane axis in blocks of ``block_t`` per grid step.
+    Targets ride the lane axis in blocks of ``block_t`` (use multiples of
+    128); the band rides the sublane axis (multiples of 8).
     """
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -219,6 +229,10 @@ def banded_scores_pallas(q: jax.Array, ts: jax.Array, t_lens: jax.Array,
     if pad_t != T:
         ts = jnp.pad(ts, ((0, pad_t - T), (0, 0)), constant_values=127)
         t_lens = jnp.pad(t_lens, (0, pad_t - T), constant_values=0)
+    # transpose to (n, T) and pad the sequence axis with `band` sentinel
+    # rows on each side so every row-window slice is in bounds
+    ts_T = jnp.pad(ts.astype(jnp.int32).T, ((band, band), (0, 0)),
+                   constant_values=127)
     kernel = functools.partial(
         _banded_kernel, m=m, n=n, band=band, dlo=dlo,
         match=params.match, mismatch=params.mismatch,
@@ -227,16 +241,17 @@ def banded_scores_pallas(q: jax.Array, ts: jax.Array, t_lens: jax.Array,
         kernel,
         grid=(pad_t // block_t,),
         in_specs=[
-            pl.BlockSpec((1, m), lambda i: (0, 0)),
-            pl.BlockSpec((block_t, n), lambda i: (i, 0)),
-            pl.BlockSpec((block_t, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, m), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((n + 2 * band, block_t), lambda i: (0, i)),
+            pl.BlockSpec((1, block_t), lambda i: (0, i)),
         ],
-        out_specs=pl.BlockSpec((block_t, 1), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((pad_t, 1), jnp.int32),
+        out_specs=pl.BlockSpec((1, block_t), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, pad_t), jnp.int32),
         interpret=interpret,
-    )(q.astype(jnp.int32)[None, :], ts.astype(jnp.int32),
-      t_lens.astype(jnp.int32)[:, None])
-    return out[:T, 0]
+    )(q.astype(jnp.int32)[None, :], ts_T,
+      t_lens.astype(jnp.int32)[None, :])
+    return out[0, :T]
 
 
 # ---------------------------------------------------------------------------

@@ -51,16 +51,24 @@ def _translate(c0, c1, c2):
     return _AA_LUT_J[(c0 * 25 + c1 * 5 + c2).astype(jnp.int32)]
 
 
-def pack_events(events, max_ev: int = 16) -> dict:
+def pack_events(events, max_ev: int = 16, bucket: int = 256) -> dict:
     """SoA-pack a list of DiffEvent into device tensors.  Events whose
-    bases exceed ``max_ev`` must take the host path (caller filters)."""
+    bases exceed ``max_ev`` must take the host path (caller filters).
+
+    The event axis is padded up to a multiple of ``bucket`` so the jitted
+    ctx_scan program is reused across flushes instead of recompiling for
+    every distinct event count; padding rows are zeros (a 0-length 'S'
+    event at rloc 0) and callers read only the first ``len(events)``
+    results."""
     E = len(events)
-    rloc = np.zeros(E, np.int32)
-    evt = np.zeros(E, np.int32)
-    evtlen = np.zeros(E, np.int32)
-    nbases = np.zeros(E, np.int32)
-    evtbases = np.full((E, max_ev), PAD, np.int8)
-    evtsub = np.full((E, max_ev), PAD, np.int8)
+    E_pad = max(bucket, (E + bucket - 1) // bucket * bucket) if bucket \
+        else E
+    rloc = np.zeros(E_pad, np.int32)
+    evt = np.zeros(E_pad, np.int32)
+    evtlen = np.zeros(E_pad, np.int32)
+    nbases = np.zeros(E_pad, np.int32)
+    evtbases = np.full((E_pad, max_ev), PAD, np.int8)
+    evtsub = np.full((E_pad, max_ev), PAD, np.int8)
     for k, ev in enumerate(events):
         rloc[k] = ev.rloc
         evt[k] = {"S": EVT_S, "I": EVT_I, "D": EVT_D}[ev.evt]

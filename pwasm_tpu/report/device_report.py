@@ -21,7 +21,8 @@ import numpy as np
 from pwasm_tpu.core.config import DEFAULT_MOTIFS
 from pwasm_tpu.core.dna import encode
 from pwasm_tpu.core.errors import PwasmError
-from pwasm_tpu.ops.ctx_scan import ctx_scan, pack_events, pack_motifs
+from pwasm_tpu.ops.ctx_scan import (PAD as PAD_CODE, ctx_scan, pack_events,
+                                    pack_motifs)
 from pwasm_tpu.report.diff_report import get_ref_context
 
 MAX_EV = 16
@@ -57,7 +58,13 @@ def analyze_events_device(refseq: bytes, events, skip_codan: bool = False,
     if small:
         packed = pack_events(small, max_ev)
         mot_codes, mot_lens = pack_motifs(motifs)
-        out = ctx_scan(jnp.asarray(encode(refseq.upper())),
+        # pad the reference to the (256-rounded) max_len so the jitted
+        # program is keyed on the bucket, not the exact ref length — one
+        # compilation serves every flush; positions >= ref_len hold PAD,
+        # which never matches a base and is masked by ref_len elsewhere
+        ref_codes = np.full(max_len, PAD_CODE, dtype=np.int8)
+        ref_codes[:ref_len] = encode(refseq.upper())
+        out = ctx_scan(jnp.asarray(ref_codes),
                        jnp.int32(ref_len), packed, mot_codes, mot_lens,
                        max_codons=max_ev // 3 + 2, max_len=max_len,
                        skip_codan=skip_codan)
@@ -81,6 +88,41 @@ def analyze_events_device(refseq: bytes, events, skip_codan: bool = False,
         results[id(ev)] = analyze_event_host(ev, refseq, skip_codan,
                                              motifs)
     return [results[id(ev)] for ev in events]
+
+
+def print_diff_info_batch(batch, f, skip_codan: bool = False,
+                          motifs=DEFAULT_MOTIFS, summary=None,
+                          max_ev: int = MAX_EV) -> None:
+    """Batched device-path equivalent of ``print_diff_info`` over many
+    alignments (the SURVEY.md §3.1 TPU boundary: host parse -> batch ->
+    one device program -> host format).
+
+    ``batch`` is a list of (aln: PafAlignment, rlabel, tlabel,
+    refseq: bytes) in input order.  Events are grouped per distinct refseq
+    (the device program is specialized on the reference tensor), analyzed
+    in one ``ctx_scan`` call per group, then rows are emitted in exactly
+    the order the scalar path would produce."""
+    from pwasm_tpu.report.diff_report import format_event_row, format_header
+
+    # group event lists by refseq identity, preserving alignment order
+    groups: dict[bytes, list] = {}
+    for aln, _rl, _tl, refseq in batch:
+        groups.setdefault(refseq, []).extend(aln.tdiffs)
+    analyzed: dict[int, tuple] = {}
+    for refseq, events in groups.items():
+        res = analyze_events_device(refseq, events, skip_codan, motifs,
+                                    max_ev)
+        for ev, r in zip(events, res):
+            analyzed[id(ev)] = r
+    for aln, rlabel, tlabel, refseq in batch:
+        f.write(format_header(aln, rlabel, tlabel))
+        if summary is not None:
+            summary.add_alignment(aln)
+        for di in aln.tdiffs:
+            aa, aapos, rctx, status, impact = analyzed[id(di)]
+            if summary is not None:
+                summary.add_event(di, status, impact)
+            f.write(format_event_row(di, aa, aapos, rctx, status, impact))
 
 
 def _impact_text(ev, k: int, host: dict) -> str:

@@ -250,6 +250,17 @@ def format_event_row(di: DiffEvent, aa: str, aapos: int, rctx: bytes,
             f"{di.tloc + 1}\t{tctx_s}\t{rctx_s}\t{status}\t{impact}\n")
 
 
+def format_header(aln: PafAlignment, rlabel: str, tlabel: str) -> str:
+    """The per-alignment report header line (pafreport.cpp:886-892)."""
+    al = aln.alninfo
+    cov = (al.r_alnend - al.r_alnstart) * 100.00 / al.r_len
+    if not rlabel:
+        return (f">{tlabel} coverage:{cov:.2f} score={aln.alnscore} "
+                f"edit_distance={aln.edist}\n")
+    return (f">{rlabel}--{tlabel} coverage:{cov:.2f} "
+            f"score={aln.alnscore} edit_distance={aln.edist}\n")
+
+
 def print_diff_info(aln: PafAlignment, rlabel: str, tlabel: str, f: IO[str],
                     refseq: bytes, skip_codan: bool = False,
                     motifs=DEFAULT_MOTIFS,
@@ -259,14 +270,7 @@ def print_diff_info(aln: PafAlignment, rlabel: str, tlabel: str, f: IO[str],
 
     ``refseq`` is the *forward* query sequence (upper-case).
     """
-    al = aln.alninfo
-    cov = (al.r_alnend - al.r_alnstart) * 100.00 / al.r_len
-    if not rlabel:
-        f.write(f">{tlabel} coverage:{cov:.2f} score={aln.alnscore} "
-                f"edit_distance={aln.edist}\n")
-    else:
-        f.write(f">{rlabel}--{tlabel} coverage:{cov:.2f} "
-                f"score={aln.alnscore} edit_distance={aln.edist}\n")
+    f.write(format_header(aln, rlabel, tlabel))
     if summary is not None:
         summary.add_alignment(aln)
     for di in aln.tdiffs:

@@ -156,6 +156,58 @@ def test_motifs_file(tmp_path):
     assert "motif ACGTAC" in out.getvalue()
 
 
+def test_device_path_byte_parity(tmp_path):
+    """--device=tpu (batched ctx_scan program; interpret-mode on CPU) must
+    produce byte-identical report + summary vs the scalar --device=cpu
+    path, across multiple batch flushes and both strands."""
+    qseq = "ATGGCCTGGACGTACGATCAAGGT"  # codon-aligned, motif-bearing
+    lines = [
+        make_paf_line("q", qseq, "a1", "+",
+                      [("=", 4), ("*", "a", "c"), ("=", 19)])[0],
+        make_paf_line("q", qseq, "a2", "+",
+                      [("=", 6), ("ins", "gg"), ("=", 18)])[0],
+        make_paf_line("q", qseq, "a3", "-",
+                      [("=", 10), ("del", 2), ("=", 12)])[0],
+        make_paf_line("q", qseq, "a4", "-",
+                      [("=", 3), ("*", "g", "t"), ("=", 20)])[0],
+        make_paf_line("q", qseq, "a5", "+",
+                      [("=", 2), ("*", "c", "g"), ("*", "a", "g"),
+                       ("=", 20)])[0],
+    ]
+    paf, fa = _mk_inputs(tmp_path, lines, qseq=qseq)
+    outs = {}
+    for dev in ("cpu", "tpu"):
+        rep = tmp_path / f"r_{dev}.dfa"
+        summ = tmp_path / f"s_{dev}.txt"
+        rc = run([paf, "-r", fa, "-o", str(rep), "-s", str(summ),
+                  f"--device={dev}", "--batch=2"], stderr=io.StringIO())
+        assert rc == 0
+        outs[dev] = (rep.read_text(), summ.read_text())
+    assert outs["cpu"] == outs["tpu"]
+    assert "S\t" in outs["cpu"][0]  # sanity: events actually analyzed
+
+
+def test_device_path_flushes_on_error(tmp_path):
+    """A bad line mid-stream must not drop earlier alignments buffered by
+    the device path — the cpu path writes them progressively."""
+    qseq = "ATGGCCTGGACGTACGATCAAGGT"
+    good = make_paf_line("q", qseq, "a1", "+",
+                         [("=", 4), ("*", "a", "c"), ("=", 19)])[0]
+    bad = good.replace("a1", "a2").split("\t")
+    bad[1] = "99"  # r_len contradicts the FASTA -> fatal after a1
+    lines = [good, "\t".join(bad)]
+    paf, fa = _mk_inputs(tmp_path, lines, qseq=qseq)
+    outs = {}
+    for dev in ("cpu", "tpu"):
+        rep = tmp_path / f"e_{dev}.dfa"
+        rc = run([paf, "-r", fa, "-o", str(rep), f"--device={dev}"],
+                 stderr=io.StringIO())
+        assert rc == 1
+        outs[dev] = rep.read_text()
+    assert outs["cpu"] == outs["tpu"]
+    assert ">a1" in outs["tpu"]
+
+
 def test_subprocess_entry(tmp_path):
     paf, fa = _mk_inputs(tmp_path, _three_alignments())
     r = subprocess.run(

@@ -1,16 +1,15 @@
-"""Native C++ host core: extraction parity vs the Python path, the
-single-core banded Gotoh baseline, and the encoder."""
+"""Native C++ host core: extraction parity vs the Python path and the
+single-core banded Gotoh baseline."""
 
 import numpy as np
 import pytest
 
-from pwasm_tpu.core.dna import encode, revcomp
+from pwasm_tpu.core.dna import revcomp
 from pwasm_tpu.core.errors import PwasmError
 from pwasm_tpu.core.events import extract_alignment
 from pwasm_tpu.core.paf import parse_paf_line
 from pwasm_tpu.native import (
     banded_gotoh_batch,
-    encode_native,
     extract_native,
     native_available,
 )
@@ -60,6 +59,34 @@ def test_native_error_base_mismatch():
     rec = parse_paf_line(line)
     with pytest.raises(PwasmError, match="base mismatch"):
         extract_native(rec, q.encode())
+
+
+def test_ref_overrun_error_parity():
+    """A cs walk that reads past the query end must raise the same
+    PwasmError on both the Python and native paths (the PAF fields are
+    internally consistent; only the FASTA is shorter than claimed)."""
+    q = "ACGTACGTAC"
+    line, _ = make_paf_line("q", q, "t", "+", [("=", 10)])
+    rec = parse_paf_line(line)
+    short_ref = q.encode()[:7]  # FASTA shorter than the claimed r_len
+    errs = []
+    for fn in (lambda: extract_alignment(rec, short_ref, use_native=False),
+               lambda: extract_native(rec, short_ref)):
+        with pytest.raises(PwasmError, match="parsing cs string") as ei:
+            fn()
+        errs.append(str(ei.value))
+    assert errs[0] == errs[1]
+
+    # same for a '+' (deleted-bases) run past the end
+    line2, _ = make_paf_line("q", q, "t", "+", [("=", 6), ("del", 4)])
+    rec2 = parse_paf_line(line2)
+    errs2 = []
+    for fn in (lambda: extract_alignment(rec2, short_ref, use_native=False),
+               lambda: extract_native(rec2, short_ref)):
+        with pytest.raises(PwasmError, match="parsing cs string") as ei:
+            fn()
+        errs2.append(str(ei.value))
+    assert errs2[0] == errs2[1]
 
 
 def test_native_error_splice_and_lengths():
@@ -137,10 +164,6 @@ def test_native_jax_banded_parity():
                                         jnp.asarray(tl), band=32))
     np.testing.assert_array_equal(nat, jx)
 
-
-def test_encode_native_matches_python():
-    seq = b"ACGTNacgtn-*XRYW"
-    np.testing.assert_array_equal(encode_native(seq), encode(seq))
 
 
 def test_cli_uses_native_transparently(tmp_path):
