@@ -239,13 +239,13 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         if not pending:
             return
         from pwasm_tpu.report.device_report import print_diff_info_batch
-        print_diff_info_batch(pending, freport, skip_codan=cfg.skip_codan,
+        # take the batch first: if the flush itself raises, the finally
+        # below must not retry it (the retry would mask the live error)
+        batch, pending[:] = pending[:], []
+        print_diff_info_batch(batch, freport, skip_codan=cfg.skip_codan,
                               motifs=cfg.motifs, summary=summary)
-        pending.clear()
 
-    def per_line_loop():
-        nonlocal refseq_id, refseq, refseq_rc, ref_gseq, ref_msa, \
-            numalns
+    try:
         for line in inf:
             line = line.rstrip("\n")
             if not line or line.startswith("#"):
@@ -327,9 +327,6 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                 else:
                     ref_gseq.msa.add_align(ref_gseq, newmsa, rseq)
                     ref_msa = ref_gseq.msa
-
-    try:
-        per_line_loop()
     finally:
         # emit whatever the device batch buffer holds — including when
         # a later bad line raises, so earlier alignments' rows aren't

@@ -39,6 +39,10 @@ def _build() -> bool:
         res = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=180)
     except (OSError, subprocess.TimeoutExpired):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
     if res.returncode != 0:
         print(f"pwasm-tpu: native build failed:\n{res.stderr[:2000]}",

@@ -66,33 +66,32 @@ def band_dlo(m: int, n: int, band: int) -> int:
     return dlo
 
 
-@functools.partial(jax.jit, static_argnames=("band", "params"))
-def banded_score(q: jax.Array, t: jax.Array, t_len: jax.Array,
-                 band: int = 64,
-                 params: ScoreParams = ScoreParams()) -> jax.Array:
-    """Banded global alignment score of one query vs one (padded) target.
+def initial_wavefront(n: int, dlo: int, band: int,
+                      params: ScoreParams) -> tuple:
+    """Row-0 wavefront state (M, Ix, Iy) in band coordinates."""
+    ge, go = params.gap_extend, params.go
+    bidx = jnp.arange(band, dtype=jnp.int32)
+    j0 = dlo + bidx
+    m0 = jnp.where(j0 == 0, 0, NEG).astype(jnp.int32)
+    iy0 = jnp.where((j0 >= 1) & (j0 <= n),
+                    -(go + (j0 - 1) * ge), NEG).astype(jnp.int32)
+    ix0 = jnp.full((band,), NEG, dtype=jnp.int32)
+    return m0, ix0, iy0
 
-    q: (m,) int8 base codes (0..3 real bases; >=4 never matches)
-    t: (n,) int8 padded target; t_len: true target length (<= n)
-    Returns the int32 global score at cell (m, t_len), or NEG if t_len
-    falls outside the band.
+
+def make_row_step(n: int, dlo: int, band: int, params: ScoreParams):
+    """The shared DP row recurrence in band coordinates.
+
+    Returns ``step(prev_m, prev_ix, prev_iy, i, qi, t) -> (m, ix, iy)``
+    where ``i`` is the 1-based absolute query row and ``t`` the (n,)
+    padded target.  Both the single-chip scan and the sequence-parallel
+    wavefront pipeline (pwasm_tpu.parallel.wavefront_sp) call this exact
+    function, so their integer scores agree bit for bit.
     """
-    m = q.shape[0]
-    n = t.shape[0]
-    dlo = band_dlo(m, n, band)
-    ge = params.gap_extend
-    go = params.go
+    ge, go = params.gap_extend, params.go
     bidx = jnp.arange(band, dtype=jnp.int32)
 
-    # ---- row 0
-    j0 = dlo + bidx
-    m0 = jnp.where(j0 == 0, 0, NEG)
-    iy0 = jnp.where((j0 >= 1) & (j0 <= n), -(go + (j0 - 1) * ge), NEG)
-    ix0 = jnp.full((band,), NEG, dtype=jnp.int32)
-
-    def row(carry, qi):
-        prev_m, prev_ix, prev_iy, i = carry
-        i = i + 1
+    def step(prev_m, prev_ix, prev_iy, i, qi, t):
         j = i + dlo + bidx
         valid = (j >= 1) & (j <= n)
         tj = jnp.where(valid, t[jnp.clip(j - 1, 0, n - 1)], 127)
@@ -113,17 +112,48 @@ def banded_score(q: jax.Array, t: jax.Array, t_len: jax.Array,
         iy_new = run_prev - go - (bidx - 1) * ge
         iy_new = jnp.where(valid, iy_new, NEG)
         return (m_new.astype(jnp.int32), ix_new.astype(jnp.int32),
-                iy_new.astype(jnp.int32), i), None
+                iy_new.astype(jnp.int32))
 
-    (m_f, ix_f, iy_f, _), _ = jax.lax.scan(
-        row, (m0.astype(jnp.int32), ix0, iy0.astype(jnp.int32),
-              jnp.int32(0)),
-        q.astype(jnp.int32))
+    return step
+
+
+def final_score(m_f, ix_f, iy_f, t_len, m: int, dlo: int,
+                band: int) -> jax.Array:
+    """Extract the global score at cell (m, t_len) from the last
+    wavefront; NEG if t_len falls outside the band."""
     b_end = t_len - m - dlo
     in_band = (b_end >= 0) & (b_end < band)
     b_end = jnp.clip(b_end, 0, band - 1)
     best = jnp.maximum(m_f[b_end], jnp.maximum(ix_f[b_end], iy_f[b_end]))
     return jnp.where(in_band, best, NEG).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("band", "params"))
+def banded_score(q: jax.Array, t: jax.Array, t_len: jax.Array,
+                 band: int = 64,
+                 params: ScoreParams = ScoreParams()) -> jax.Array:
+    """Banded global alignment score of one query vs one (padded) target.
+
+    q: (m,) int8 base codes (0..3 real bases; >=4 never matches)
+    t: (n,) int8 padded target; t_len: true target length (<= n)
+    Returns the int32 global score at cell (m, t_len), or NEG if t_len
+    falls outside the band.
+    """
+    m = q.shape[0]
+    n = t.shape[0]
+    dlo = band_dlo(m, n, band)
+    step = make_row_step(n, dlo, band, params)
+    wf0 = initial_wavefront(n, dlo, band, params)
+
+    def row(carry, qi):
+        prev_m, prev_ix, prev_iy, i = carry
+        i = i + 1
+        m_new, ix_new, iy_new = step(prev_m, prev_ix, prev_iy, i, qi, t)
+        return (m_new, ix_new, iy_new, i), None
+
+    (m_f, ix_f, iy_f, _), _ = jax.lax.scan(
+        row, (*wf0, jnp.int32(0)), q.astype(jnp.int32))
+    return final_score(m_f, ix_f, iy_f, t_len, m, dlo, band)
 
 
 @functools.partial(jax.jit, static_argnames=("band", "params"))

@@ -1,0 +1,127 @@
+"""Sequence-parallel banded DP: the wavefront pipelined over a device mesh.
+
+This is the framework's long-context scaling path (SURVEY.md §5
+'long-context / sequence parallelism'): when a single query is too long
+for one chip's serial row loop to be acceptable, the band is split along
+the diagonal — query rows are sharded over a 1-D ``seq`` mesh axis and
+the wavefront edge is handed to the right neighbor over ICI with
+``ppermute`` (ring-style halo exchange), exactly the design sketched in
+SURVEY.md §5 for 50 kb+ reads.
+
+Pipelining makes it efficient: the DP over ONE target is a serial
+dependency chain, but with a batch of T targets device d can process
+target ``b = stage - d`` while device d+1 processes target ``b - 1``.
+After ``T + D - 1`` stages every target has flowed through all D row
+chunks; per-device serial work is ``(T + D - 1) * m / D`` rows versus
+``T * m`` single-chip — a D-fold speedup for T >> D.
+
+Bit-exactness: each chunk advances the wavefront with the SAME
+``make_row_step`` recurrence the single-chip scan uses, and the carried
+state (M, Ix, Iy in band coordinates) is exactly what crosses a chunk
+boundary, so scores equal ``banded_scores_batch`` bit for bit (tested on
+a virtual 8-device mesh).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pwasm_tpu.ops.banded_dp import (NEG, ScoreParams, band_dlo,
+                                     final_score, initial_wavefront,
+                                     make_row_step)
+
+
+def make_wavefront_sp(mesh: Mesh, m: int, n: int, T: int,
+                      band: int = 64,
+                      params: ScoreParams = ScoreParams(),
+                      axis: str = "seq"):
+    """Build the jitted sequence-parallel scorer for fixed shapes.
+
+    Returns ``fn(q (m,) int, ts (T, n) int, t_lens (T,) int) -> (T,)
+    int32 scores``.  ``m`` must divide by the ``axis`` size of the mesh
+    (pad the query and widen the band upstream if it doesn't).
+    """
+    D = mesh.shape[axis]
+    if m % D != 0:
+        raise ValueError(f"query length {m} must divide by mesh "
+                         f"axis '{axis}' size {D}")
+    chunk = m // D
+    dlo = band_dlo(m, n, band)
+    step = make_row_step(n, dlo, band, params)
+    perm = [(i, i + 1) for i in range(D - 1)]
+
+    def run_chunk(q_loc, t, wf, row0):
+        """Advance the wavefront through this device's rows for one
+        target.  ``row0`` is the absolute 0-based index of the first
+        local row."""
+
+        def row(carry, args):
+            prev_m, prev_ix, prev_iy = carry
+            qi, k = args
+            i = row0 + k + 1          # 1-based absolute query row
+            out = step(prev_m, prev_ix, prev_iy, i, qi, t)
+            return out, None
+
+        ks = jnp.arange(chunk, dtype=jnp.int32)
+        out, _ = jax.lax.scan(row, wf, (q_loc.astype(jnp.int32), ks))
+        return out
+
+    def local(q_loc, ts, t_lens):
+        d = jax.lax.axis_index(axis)
+        row0 = d * chunk
+        wf_init = initial_wavefront(n, dlo, band, params)
+
+        def stage(carry, s):
+            wf_in = carry
+            b = s - d                      # target flowing through here
+            active = (b >= 0) & (b < T)
+            bc = jnp.clip(b, 0, T - 1)
+            t = jax.lax.dynamic_slice(ts, (bc, 0), (1, n))[0]
+            # first chunk starts every target from the row-0 state; later
+            # chunks continue from the neighbor's handed-over wavefront
+            wf = jax.tree.map(
+                lambda a, b_: jnp.where(d == 0, a, b_), wf_init, wf_in)
+            wf_out = run_chunk(q_loc, t, wf, row0)
+            score = final_score(*wf_out, t_lens[bc], m, dlo, band)
+            emit = active & (d == D - 1)   # last chunk completes row m
+            # hand the wavefront edge to the right neighbor (ICI halo)
+            wf_next = jax.tree.map(
+                lambda x: jax.lax.ppermute(x, axis, perm), wf_out)
+            return wf_next, (bc, jnp.where(emit, score, 0),
+                             emit.astype(jnp.int32))
+
+        zeros = jax.tree.map(
+            lambda x: jax.lax.pcast(jnp.zeros_like(x), axis, to="varying"),
+            wf_init)
+        _, (bs, scs, emits) = jax.lax.scan(
+            stage, zeros, jnp.arange(T + D - 1, dtype=jnp.int32))
+        scores = jnp.zeros((T,), jnp.int32).at[bs].add(
+            jnp.where(emits == 1, scs, 0))
+        got = jnp.zeros((T,), jnp.int32).at[bs].add(emits)
+        # only the last device emitted real scores; share them ringwide
+        scores = jax.lax.psum(scores, axis)
+        got = jax.lax.psum(got, axis)
+        return jnp.where(got > 0, scores, NEG)
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P(axis), P(None, None), P(None)),
+                   out_specs=P(None))
+    return jax.jit(fn)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("mesh", "band", "params", "axis"))
+def wavefront_sp_scores(q: jax.Array, ts: jax.Array, t_lens: jax.Array,
+                        mesh: Mesh, band: int = 64,
+                        params: ScoreParams = ScoreParams(),
+                        axis: str = "seq") -> jax.Array:
+    """Convenience wrapper: sequence-parallel scores for one (q, ts)
+    workload (shapes specialize the compilation)."""
+    T, n = ts.shape
+    fn = make_wavefront_sp(mesh, q.shape[0], n, T, band, params, axis)
+    return fn(q, ts, t_lens)

@@ -102,18 +102,31 @@ def print_diff_info_batch(batch, f, skip_codan: bool = False,
     (the device program is specialized on the reference tensor), analyzed
     in one ``ctx_scan`` call per group, then rows are emitted in exactly
     the order the scalar path would produce."""
-    from pwasm_tpu.report.diff_report import format_event_row, format_header
+    from pwasm_tpu.report.diff_report import (format_event_row,
+                                              format_header,
+                                              print_diff_info)
 
     # group event lists by refseq identity, preserving alignment order
     groups: dict[bytes, list] = {}
     for aln, _rl, _tl, refseq in batch:
         groups.setdefault(refseq, []).extend(aln.tdiffs)
     analyzed: dict[int, tuple] = {}
-    for refseq, events in groups.items():
-        res = analyze_events_device(refseq, events, skip_codan, motifs,
-                                    max_ev)
-        for ev, r in zip(events, res):
-            analyzed[id(ev)] = r
+    try:
+        for refseq, events in groups.items():
+            res = analyze_events_device(refseq, events, skip_codan,
+                                        motifs, max_ev)
+            for ev, r in zip(events, res):
+                analyzed[id(ev)] = r
+    except Exception:
+        # the batch analysis failed before any row was written; replay
+        # the whole batch through the scalar path, which writes rows
+        # progressively and raises at exactly the failing event — the
+        # same observable behavior as --device=cpu
+        for aln, rlabel, tlabel, refseq in batch:
+            print_diff_info(aln, rlabel, tlabel, f, refseq,
+                            skip_codan=skip_codan, motifs=motifs,
+                            summary=summary)
+        return
     for aln, rlabel, tlabel, refseq in batch:
         f.write(format_header(aln, rlabel, tlabel))
         if summary is not None:

@@ -69,3 +69,50 @@ def test_graft_entry_and_dryrun():
     assert votes.shape[0] == args[3].shape[1]
     g.dryrun_multichip(len(jax.devices()))
     g.dryrun_multichip(4)
+
+
+def _dp_workload(rng, m, T, n):
+    q = rng.integers(0, 4, size=m).astype(np.int8)
+    ts = np.full((T, n), 127, dtype=np.int8)
+    tl = np.zeros(T, dtype=np.int32)
+    for k in range(T):
+        t = list(q)
+        for _ in range(int(rng.integers(0, 6))):
+            t[int(rng.integers(0, len(t)))] = int(rng.integers(0, 4))
+        for _ in range(int(rng.integers(0, 4))):
+            p = int(rng.integers(1, len(t) - 1))
+            if rng.random() < 0.5:
+                t.insert(p, int(rng.integers(0, 4)))
+            else:
+                del t[p]
+        ts[k, :len(t)] = t
+        tl[k] = len(t)
+    return q, ts, tl
+
+
+def test_wavefront_sp_matches_batch():
+    """Sequence-parallel pipelined DP (query rows sharded over 8 devices,
+    ppermute halo exchange) is bit-exact vs the single-device batch."""
+    from jax.sharding import Mesh
+    from pwasm_tpu.parallel.wavefront_sp import make_wavefront_sp
+
+    devs = np.asarray(jax.devices()[:8])
+    mesh = Mesh(devs, ("seq",))
+    rng = np.random.default_rng(7)
+    m, T, n, band = 64, 11, 96, 64
+    q, ts, tl = _dp_workload(rng, m, T, n)
+    fn = make_wavefront_sp(mesh, m, n, T, band=band)
+    sp = np.asarray(fn(jnp.asarray(q), jnp.asarray(ts), jnp.asarray(tl)))
+    ref = np.asarray(banded_scores_batch(jnp.asarray(q), jnp.asarray(ts),
+                                         jnp.asarray(tl), band=band))
+    np.testing.assert_array_equal(sp, ref)
+
+
+def test_wavefront_sp_rejects_indivisible():
+    from jax.sharding import Mesh
+    import pytest
+    from pwasm_tpu.parallel.wavefront_sp import make_wavefront_sp
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("seq",))
+    with pytest.raises(ValueError, match="must divide"):
+        make_wavefront_sp(mesh, 30, 64, 4)

@@ -163,3 +163,29 @@ def test_long_event_truncation():
     assert "\t:[15]\t" in row
     # tctx is 5 + 15 + 5 = 25 > 22 -> first5 + [len-10] + last5
     assert "\tGGAAA[15]GATCT\t" in row
+
+
+def test_device_batch_analysis_failure_replays_scalar():
+    """If the batched device analysis fails, print_diff_info_batch must
+    fall back to the progressive scalar path so rows before the failing
+    event are still written (parity with --device=cpu)."""
+    from helpers import make_paf_line
+    from pwasm_tpu.core.errors import PwasmError
+    from pwasm_tpu.report.device_report import print_diff_info_batch
+
+    q = "ATGGCCTGGACGTACGATCAAGGT"
+    good_line, _ = make_paf_line("q", q, "a1", "+",
+                                 [("=", 4), ("*", "a", "c"), ("=", 19)])
+    bad_line, _ = make_paf_line("q", q, "a2", "+",
+                                [("=", 7), ("*", "t", "g"), ("=", 16)])
+    ref = q.encode()
+    good = extract_alignment(parse_paf_line(good_line), ref)
+    bad = extract_alignment(parse_paf_line(bad_line), ref)
+    bad.tdiffs[0].evtsub = b"A"  # contradicts the ref -> s_mismatch fatal
+    out = io.StringIO()
+    with pytest.raises(PwasmError, match="modseq"):
+        print_diff_info_batch(
+            [(good, "", "a1:0-24+", ref), (bad, "", "a2:0-24+", ref)], out)
+    body = out.getvalue()
+    assert ">a1:0-24+" in body          # written before the fatal
+    assert body.count("S\t") == 1       # good alignment's row only
