@@ -321,18 +321,43 @@ class Msa:
             print(s.name, file=sys.stderr)
         raise ZeroCoverageError(f"zero-coverage column {col}")
 
+    def device_votes(self) -> np.ndarray:
+        """All column votes in one batched device call: push the
+        [mincol, maxcol] slice of the count tensor through the consensus
+        vote kernel (ops.consensus.consensus_vote_counts) and map codes to
+        the reference's winning characters.  Zero-coverage columns map to 0,
+        exactly like ``best_char``.  Bit-exact with the per-column CPU vote
+        by construction (same closed-form rule over the same int counts)."""
+        import jax.numpy as jnp
+
+        from pwasm_tpu.ops.consensus import consensus_vote_counts
+
+        cols = self.msacolumns
+        counts = jnp.asarray(cols.counts[cols.mincol:cols.maxcol + 1])
+        v = np.asarray(consensus_vote_counts(counts))
+        table = np.frombuffer(b"ACGTN-", dtype=np.uint8)
+        out = np.zeros(len(v), dtype=np.int64)
+        valid = v >= 0
+        out[valid] = table[v[valid]]
+        return out
+
     def refine_msa(self, remove_cons_gaps: bool = True,
-                   refine_clipping: bool = True) -> None:
+                   refine_clipping: bool = True,
+                   device: bool = False) -> None:
         """Consensus construction + clipping refinement driver
         (GSeqAlign::refineMSA, GapAssem.cpp:1133-1183).  The two flags are
         the reference's MSAColumns statics; pafreport runs with
-        remove_cons_gaps=False (SURVEY.md §2.5.8)."""
+        remove_cons_gaps=False (SURVEY.md §2.5.8).  With ``device`` the
+        column votes are computed in one batched device kernel call instead
+        of per-column on host (same integer rule, bit-exact)."""
         self.build_msa()
         cols = self.msacolumns
+        votes = self.device_votes() if device else None
         cols_removed = 0
         consensus = bytearray()
         for col in range(cols.mincol, cols.maxcol + 1):
-            c = cols.best_char(col)
+            c = int(votes[col - cols.mincol]) if device \
+                else cols.best_char(col)
             if c == 0:
                 self._err_zero_cov(col)
             if c in (ord("-"), ord("*")):
@@ -452,10 +477,11 @@ class Msa:
 
     def write_ace(self, f: IO[str], name: str,
                   remove_cons_gaps: bool = True,
-                  refine_clipping: bool = True) -> None:
+                  refine_clipping: bool = True,
+                  device: bool = False) -> None:
         """ACE contig output (GSeqAlign::writeACE, GapAssem.cpp:1200-1262)."""
         if not self.refined:
-            self.refine_msa(remove_cons_gaps, refine_clipping)
+            self.refine_msa(remove_cons_gaps, refine_clipping, device=device)
         fwd = sum(1 for s in self.seqs if s.revcompl == 0)
         rvs = self.count() - fwd
         cons_dir = "C" if rvs > fwd else "U"
@@ -489,9 +515,23 @@ class Msa:
                 seqr = seql + 1
             f.write(f"\nQA {seql} {seqr} {seql} {seqr}\nDS \n\n")
 
+    def write_cons(self, f: IO[str], name: str,
+                   remove_cons_gaps: bool = True,
+                   refine_clipping: bool = True,
+                   device: bool = False, linelen: int = 60) -> None:
+        """Consensus sequence as FASTA (refined on demand, like
+        write_ace/write_info; '*' marks kept all-gap columns)."""
+        if not self.refined:
+            self.refine_msa(remove_cons_gaps, refine_clipping, device=device)
+        cons = self.consensus.decode("ascii", "replace")
+        f.write(f">{name}_cons {self.count()} seqs\n")
+        for i in range(0, len(cons), linelen):
+            f.write(cons[i:i + linelen] + "\n")
+
     def write_info(self, f: IO[str], name: str,
                    remove_cons_gaps: bool = True,
-                   refine_clipping: bool = True) -> None:
+                   refine_clipping: bool = True,
+                   device: bool = False) -> None:
         """Contig-info output with per-seq pid and run-length alndata
         (GSeqAlign::writeInfo, GapAssem.cpp:1264-1367).
 
@@ -505,7 +545,7 @@ class Msa:
           right of the sequence — pid is systematically understated
           (usually 0 for perfect alignments)."""
         if not self.refined:
-            self.refine_msa(remove_cons_gaps, refine_clipping)
+            self.refine_msa(remove_cons_gaps, refine_clipping, device=device)
         cons = self.consensus.decode("ascii", "replace")
         f.write(f">{name} {self.count()} {cons}\n")
         mincol = self.msacolumns.mincol

@@ -42,6 +42,11 @@ USAGE = """Usage:
    -F full genome alignment mode (default for query>100Kb; assumes -N)
    -C perform codon impact analysis
    -N skip codon impact analysis
+   --ace=FILE  write the refined MSA as an ACE contig (consensus calling)
+   --info=FILE write the refined MSA as a contig-info table (per-seq pid)
+   --cons=FILE write the consensus sequence as FASTA
+   --remove-cons-gaps  drop all-gap consensus columns during refinement
+   --no-refine-clip    skip the X-drop clipping refinement pass
 """
 
 # reference optstring: "DGFCNvd:p:r:o:m:w:c:s:" — -d/-p/-m take a value but
@@ -187,16 +192,31 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
                 and fsize > AUTO_FULLGENOME_FASTA_BYTES:
             cfg.skip_codan = True
         fmsa = None
-        if "w" in opts:
+        cons_outs = {}   # kind -> open file, kinds: ace, info, cons
+        if "w" in opts or any(k in opts for k in ("ace", "info", "cons")):
             if cfg.fullgenome:
                 stderr.write(
                     f"{USAGE} Error: can only generate MSA for -G mode!\n")
                 return EXIT_USAGE
-            try:
-                fmsa = open(str(opts["w"]), "w")
-            except OSError:
-                raise PwasmError(
-                    f"Cannot open file {opts['w']} for writing!\n")
+            if "w" in opts:
+                try:
+                    fmsa = open(str(opts["w"]), "w")
+                except OSError:
+                    raise PwasmError(
+                        f"Cannot open file {opts['w']} for writing!\n")
+            for kind in ("ace", "info", "cons"):
+                if opts.get(kind) is True:
+                    raise CliError(
+                        f"{USAGE}\n--{kind} requires a file argument\n")
+            for kind in ("ace", "info", "cons"):
+                if kind in opts:
+                    try:
+                        cons_outs[kind] = open(str(opts[kind]), "w")
+                    except OSError:
+                        raise PwasmError(
+                            f"Cannot open file {opts[kind]} for writing!\n")
+        cfg.remove_cons_gaps = bool(opts.get("remove-cons-gaps"))
+        cfg.refine_clipping = not bool(opts.get("no-refine-clip"))
         try:
             fsummary = open(str(opts["s"]), "w") if "s" in opts else None
         except OSError:
@@ -205,7 +225,7 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
         summary = Summary() if fsummary else None
 
         return _main_loop(cfg, inf, freport, fmsa, fsummary, summary,
-                          qfasta, stdout, stderr)
+                          qfasta, stdout, stderr, cons_outs)
     except PwasmError as e:
         stderr.write(str(e))
         return e.exit_code
@@ -215,7 +235,8 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
 
 
 def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
-               qfasta: FastaFile, stdout, stderr) -> int:
+               qfasta: FastaFile, stdout, stderr,
+               cons_outs: dict | None = None) -> int:
     """The per-PAF-line loop (pafreport.cpp:296-460)."""
     from pwasm_tpu.align.gapseq import FLAG_IS_REF, GapSeq
     from pwasm_tpu.align.msa import Msa
@@ -234,6 +255,8 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
     # crosses host->device once per batch, not per alignment)
     use_device = cfg.device != "cpu"
     pending: list[tuple] = []
+    cons_outs = cons_outs or {}
+    build_msa_out = fmsa is not None or bool(cons_outs)
 
     def flush_pending():
         if not pending:
@@ -304,7 +327,7 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                     print_diff_info(aln, rlabel, tlabel, freport, refseq,
                                     skip_codan=cfg.skip_codan,
                                     motifs=cfg.motifs, summary=summary)
-            if fmsa is not None:
+            if build_msa_out:
                 taseq = GapSeq(tlabel, "", aln.tseq, offset=al.r_alnstart,
                                revcompl=aln.reverse)
                 first_ref_aln = ref_gseq is None
@@ -339,6 +362,24 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
     if fmsa is not None and ref_msa is not None:
         ref_msa.write_msa(fmsa)
         fmsa.close()
+    if cons_outs and ref_msa is not None:
+        # consensus path (the library capability pafreport never calls,
+        # SURVEY.md §2.3): refine once, then emit the requested formats.
+        # write_msa above already captured the unrefined layout, so the
+        # reference's -w output is unchanged by refinement side effects.
+        ref_msa.finalize()
+        ref_msa.refine_msa(remove_cons_gaps=cfg.remove_cons_gaps,
+                           refine_clipping=cfg.refine_clipping,
+                           device=use_device)
+        contig = ref_msa.seqs[0].name if ref_msa.seqs else "contig"
+        if "ace" in cons_outs:
+            ref_msa.write_ace(cons_outs["ace"], contig)
+        if "info" in cons_outs:
+            ref_msa.write_info(cons_outs["info"], contig)
+        if "cons" in cons_outs:
+            ref_msa.write_cons(cons_outs["cons"], contig)
+    for f in cons_outs.values():
+        f.close()
     if fsummary is not None:
         summary.write(fsummary)
         fsummary.close()

@@ -16,6 +16,8 @@ Scope limits (callers fall back to the scalar path per event when hit):
 
 from __future__ import annotations
 
+import sys
+
 import numpy as np
 
 from pwasm_tpu.core.config import DEFAULT_MOTIFS
@@ -26,6 +28,7 @@ from pwasm_tpu.ops.ctx_scan import (PAD as PAD_CODE, ctx_scan, pack_events,
 from pwasm_tpu.report.diff_report import get_ref_context
 
 MAX_EV = 16
+_warned_fallback = False
 
 
 def _round_up(x: int, m: int) -> int:
@@ -117,11 +120,18 @@ def print_diff_info_batch(batch, f, skip_codan: bool = False,
                                         motifs, max_ev)
             for ev, r in zip(events, res):
                 analyzed[id(ev)] = r
-    except Exception:
+    except Exception as e:
         # the batch analysis failed before any row was written; replay
         # the whole batch through the scalar path, which writes rows
         # progressively and raises at exactly the failing event — the
-        # same observable behavior as --device=cpu
+        # same observable behavior as --device=cpu.  Warn once so a dead
+        # device path can't hide behind the always-correct replay.
+        global _warned_fallback
+        if not _warned_fallback:
+            _warned_fallback = True
+            print(f"Warning: device batch analysis failed "
+                  f"({type(e).__name__}: {e}); falling back to the scalar "
+                  f"path for this run", file=sys.stderr)
         for aln, rlabel, tlabel, refseq in batch:
             print_diff_info(aln, rlabel, tlabel, f, refseq,
                             skip_codan=skip_codan, motifs=motifs,

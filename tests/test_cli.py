@@ -215,3 +215,60 @@ def test_subprocess_entry(tmp_path):
         capture_output=True, text=True, cwd="/root/repo")
     assert r.returncode == 0
     assert r.stdout.startswith(">asm1:0-12+")
+
+
+def test_consensus_outputs_ace_info_cons(tmp_path):
+    paf, fa = _mk_inputs(tmp_path, _three_alignments())
+    ace = tmp_path / "out.ace"
+    info = tmp_path / "out.info"
+    cons = tmp_path / "out.cons"
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / "r.dfa"),
+              f"--ace={ace}", f"--info={info}", f"--cons={cons}"],
+             stderr=io.StringIO())
+    assert rc == 0
+    ace_body = ace.read_text()
+    assert ace_body.startswith("CO q ")
+    assert "AF q U 1" in ace_body
+    assert "RD asm1:0-12+ 12 0 0" in ace_body
+    info_body = info.read_text()
+    assert info_body.startswith(">q 4 ")
+    # consensus keeps the all-gap column ('*') without --remove-cons-gaps
+    cons_lines = cons.read_text().splitlines()
+    assert cons_lines[0].startswith(">q_cons 4 seqs")
+    assert cons_lines[1] == "ACGTAC**GTAC"
+
+
+def test_consensus_remove_cons_gaps(tmp_path):
+    paf, fa = _mk_inputs(tmp_path, _three_alignments())
+    cons = tmp_path / "out.cons"
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / "r.dfa"),
+              f"--cons={cons}", "--remove-cons-gaps"],
+             stderr=io.StringIO())
+    assert rc == 0
+    # the 2-col 'gg' insertion columns (1 base vs 3 gaps) win as gaps and
+    # are removed from the layout
+    assert cons.read_text().splitlines()[1] == "ACGTACGTAC"
+
+
+def test_consensus_device_matches_cpu(tmp_path):
+    paf, fa = _mk_inputs(tmp_path, _three_alignments())
+    out_cpu = tmp_path / "cpu.ace"
+    out_dev = tmp_path / "dev.ace"
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / "r1.dfa"),
+              f"--ace={out_cpu}"], stderr=io.StringIO())
+    assert rc == 0
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / "r2.dfa"),
+              f"--ace={out_dev}", "--device=tpu"], stderr=io.StringIO())
+    assert rc == 0
+    assert out_dev.read_text() == out_cpu.read_text()
+
+
+def test_cons_requires_gene_mode(tmp_path):
+    paf, fa = _mk_inputs(tmp_path, _three_alignments())
+    err = io.StringIO()
+    assert run([paf, "-r", fa, "-F", f"--ace={tmp_path / 'x.ace'}"],
+               stderr=err) == 1
+    assert "can only generate MSA for -G mode" in err.getvalue()
+    err = io.StringIO()
+    assert run([paf, "-r", fa, "--ace"], stderr=err) == 1
+    assert "--ace requires a file argument" in err.getvalue()
