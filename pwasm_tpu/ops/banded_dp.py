@@ -173,36 +173,34 @@ def banded_scores_batch(q: jax.Array, ts: jax.Array, t_lens: jax.Array,
 # (no gathers, no value-space dynamic_slice).  The query lives in SMEM and
 # is read one scalar per row.
 # ---------------------------------------------------------------------------
-def _banded_kernel(q_ref, t_ref, tlen_ref, out_ref, *, m, n, band, dlo,
-                   match, mismatch, go, ge, block_t):
-    """One grid step aligns ``block_t`` targets against the shared query.
+def _make_tile_recurrence(n, band, dlo, match, mismatch, go, ge, block_t):
+    """The DP row recurrence on (band, block_t) int32 tiles, shared by
+    BOTH Pallas kernels (resident and HBM-streaming) so their scoring
+    stays identical by construction — the tile-space analog of
+    ``make_row_step``.  Returns ``(init, row_tile, extract)``:
 
-    State: three (band, block_t) int32 wavefronts updated over m rows with
-    a fori_loop; the Iy chain is a log2(band) shift-max cumulative scan
-    along the sublane (band) axis.  ``t_ref`` is (band + n + band, block_t):
-    the target transposed with ``band`` rows of padding on both ends so the
-    row-``i`` window load ``t_ref[ii + dlo + band :][:band]`` is always in
-    bounds (band_dlo guarantees dlo >= 1 - band and m + dlo <= n).
+    - ``init() -> (m, ix, iy)`` row-0 wavefront tiles;
+    - ``row_tile(carry, i, qi, tj) -> (m, ix, iy)`` one query row given
+      the scalar query base ``qi`` and the (band, block_t) target window
+      ``tj``; the Iy chain is a log2(band) shift-max cumulative scan along
+      the sublane (band) axis;
+    - ``extract(carry, t_len, m) -> (1, block_t)`` the per-lane global
+      score at cell (m, t_len) via a masked max (no gather).
     """
-    from jax.experimental import pallas as pl
-
     bidx = jax.lax.broadcasted_iota(jnp.int32, (band, block_t), 0)
     neg = jnp.full((band, block_t), NEG, dtype=jnp.int32)
 
-    j0 = dlo + bidx
-    m_v = jnp.where(j0 == 0, 0, NEG)
-    iy_v = jnp.where((j0 >= 1) & (j0 <= n), -(go + (j0 - 1) * ge), NEG)
-    ix_v = neg
+    def init():
+        j0 = dlo + bidx
+        m_v = jnp.where(j0 == 0, 0, NEG)
+        iy_v = jnp.where((j0 >= 1) & (j0 <= n), -(go + (j0 - 1) * ge),
+                         NEG)
+        return m_v, neg, iy_v
 
-    def row(ii, carry):
+    def row_tile(carry, i, qi, tj):
         m_prev, ix_prev, iy_prev = carry
-        i = ii + 1
         j = i + dlo + bidx
         valid = (j >= 1) & (j <= n)
-        qi = q_ref[0, ii]  # scalar load from SMEM (dynamic index OK)
-        # band window of target bases t[j-1]: rows (i+dlo-1+b) of the
-        # unpadded transpose = rows (ii+dlo+band ...) of the padded ref
-        tj = t_ref[pl.ds(ii + dlo + band, band), :]
         s = jnp.where((qi == tj) & (qi < 4), match, -mismatch)
         diag = jnp.maximum(m_prev, jnp.maximum(ix_prev, iy_prev))
         m_new = jnp.where(valid, diag + s, NEG)
@@ -223,15 +221,42 @@ def _banded_kernel(q_ref, t_ref, tlen_ref, out_ref, *, m, n, band, dlo,
         iy_new = jnp.where(valid, iy_new, NEG)
         return m_new, ix_new, iy_new
 
-    m_f, ix_f, iy_f = jax.lax.fori_loop(0, m, row, (m_v, ix_v, iy_v))
-    t_len = tlen_ref[...]  # (1, block_t)
-    b_end = t_len - m - dlo
-    in_band = (b_end >= 0) & (b_end < band)
-    best3 = jnp.maximum(m_f, jnp.maximum(ix_f, iy_f))
-    # per-lane extraction of band row b_end: masked max (no gather)
-    best = jnp.max(jnp.where(bidx == b_end, best3, NEG), axis=0,
-                   keepdims=True)
-    out_ref[...] = jnp.where(in_band, best, NEG)
+    def extract(carry, t_len, m):
+        m_f, ix_f, iy_f = carry
+        b_end = t_len - m - dlo
+        in_band = (b_end >= 0) & (b_end < band)
+        best3 = jnp.maximum(m_f, jnp.maximum(ix_f, iy_f))
+        best = jnp.max(jnp.where(bidx == b_end, best3, NEG), axis=0,
+                       keepdims=True)
+        return jnp.where(in_band, best, NEG)
+
+    return init, row_tile, extract
+
+
+def _banded_kernel(q_ref, t_ref, tlen_ref, out_ref, *, m, n, band, dlo,
+                   match, mismatch, go, ge, block_t):
+    """One grid step aligns ``block_t`` targets against the shared query.
+
+    State: three (band, block_t) int32 wavefronts updated over m rows with
+    a fori_loop.  ``t_ref`` is (band + n + band, block_t): the target
+    transposed with ``band`` rows of padding on both ends so the row-``i``
+    window load ``t_ref[ii + dlo + band :][:band]`` is always in bounds
+    (band_dlo guarantees dlo >= 1 - band and m + dlo <= n).
+    """
+    from jax.experimental import pallas as pl
+
+    init, row_tile, extract = _make_tile_recurrence(
+        n, band, dlo, match, mismatch, go, ge, block_t)
+
+    def row(ii, carry):
+        qi = q_ref[0, ii]  # scalar load from SMEM (dynamic index OK)
+        # band window of target bases t[j-1]: rows (i+dlo-1+b) of the
+        # unpadded transpose = rows (ii+dlo+band ...) of the padded ref
+        tj = t_ref[pl.ds(ii + dlo + band, band), :]
+        return row_tile(carry, ii + 1, qi, tj)
+
+    carry = jax.lax.fori_loop(0, m, row, init())
+    out_ref[...] = extract(carry, tlen_ref[...], m)
 
 
 @functools.partial(jax.jit,
@@ -278,6 +303,138 @@ def banded_scores_pallas(q: jax.Array, ts: jax.Array, t_lens: jax.Array,
         ],
         out_specs=pl.BlockSpec((1, block_t), lambda i: (0, i)),
         out_shape=jax.ShapeDtypeStruct((1, pad_t), jnp.int32),
+        interpret=interpret,
+    )(q.astype(jnp.int32)[None, :], ts_T,
+      t_lens.astype(jnp.int32)[None, :])
+    return out[0, :T]
+
+
+# ---------------------------------------------------------------------------
+# Long-read variant (BASELINE.md config #5): same wavefront recurrence, but
+# the target stays in HBM and the per-chunk band windows stream into a
+# double-buffered VMEM scratch with explicit async DMA — VMEM holds only
+# O(chunk x block_t), not O(n x block_t), so 50 kb+ sequences fit.
+# ---------------------------------------------------------------------------
+def _banded_kernel_long(q_ref, t_hbm, tlen_ref, out_ref, t_buf0, t_buf1,
+                        sems, *, m, n, band, dlo, match, mismatch, go, ge,
+                        block_t, chunk):
+    """One grid step aligns ``block_t`` targets, streaming the target in
+    row chunks.
+
+    ``t_hbm`` is the padded transposed target batch in HBM/ANY:
+    (band + n + band + 2*chunk, T_pad) int32.  Rows
+    [ci*chunk + dlo + band, +chunk+band) cover every band window of query
+    rows [ci*chunk, (ci+1)*chunk).  Chunks are processed in pairs with two
+    statically-addressed VMEM buffers (Mosaic cannot dynamically index a
+    buffer-slot axis, and int8 refs don't support dynamic sublane slices —
+    hence 2 x 2-D int32 buffers): while chunk 2c computes out of buf0, the
+    DMA for 2c+1 fills buf1, and vice versa (double buffering).  Chunks at
+    or past n_chunks read only sentinel padding and their rows are masked
+    pass-throughs, so the pair round-up needs no control flow.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    tb = pl.program_id(0)
+    n_chunks = (m + chunk - 1) // chunk
+    n_pairs = (n_chunks + 1) // 2
+    window = chunk + band
+    init, row_tile, extract = _make_tile_recurrence(
+        n, band, dlo, match, mismatch, go, ge, block_t)
+
+    def get_dma(buf, slot, ci):
+        return pltpu.make_async_copy(
+            t_hbm.at[pl.ds(ci * chunk + dlo + band, window),
+                     pl.ds(tb * block_t, block_t)],
+            buf, sems.at[slot])
+
+    get_dma(t_buf0, 0, 0).start()
+
+    def rows(buf, ci, carry):
+        def row(rr, carry2):
+            ii = ci * chunk + rr
+            qi = q_ref[0, jnp.minimum(ii, m - 1)]
+            tj = buf[pl.ds(rr, band), :]
+            new = row_tile(carry2, ii + 1, qi, tj)
+            # rows past the true query length are pass-through
+            keep = ii < m
+            return tuple(jnp.where(keep, nv, ov)
+                         for nv, ov in zip(new, carry2))
+
+        return jax.lax.fori_loop(0, chunk, row, carry)
+
+    def pair_body(cc, carry):
+        ci0 = 2 * cc
+        get_dma(t_buf1, 1, ci0 + 1).start()
+        get_dma(t_buf0, 0, ci0).wait()
+        carry = rows(t_buf0, ci0, carry)
+
+        @pl.when(cc + 1 < n_pairs)
+        def _():
+            get_dma(t_buf0, 0, ci0 + 2).start()
+
+        get_dma(t_buf1, 1, ci0 + 1).wait()
+        return rows(t_buf1, ci0 + 1, carry)
+
+    carry = jax.lax.fori_loop(0, n_pairs, pair_body, init())
+    out_ref[...] = extract(carry, tlen_ref[...], m)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("band", "params", "block_t", "chunk",
+                                    "interpret"))
+def banded_scores_long(q: jax.Array, ts: jax.Array, t_lens: jax.Array,
+                       band: int = 128,
+                       params: ScoreParams = ScoreParams(),
+                       block_t: int = 128, chunk: int = 1024,
+                       interpret: bool | None = None) -> jax.Array:
+    """HBM-streaming banded aligner for long sequences: (T, n) targets ->
+    (T,) int32 scores, bit-exact with ``banded_scores_batch``.
+
+    Unlike ``banded_scores_pallas`` (whole target resident in VMEM), only
+    a (chunk + band, block_t) double-buffered window lives on-chip, so n
+    is bounded by HBM, not VMEM; DMA of chunk ci+1 overlaps compute of
+    chunk ci.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    m = q.shape[0]
+    T, n = ts.shape
+    dlo = band_dlo(m, n, band)
+    pad_t = (T + block_t - 1) // block_t * block_t
+    if pad_t != T:
+        ts = jnp.pad(ts, ((0, pad_t - T), (0, 0)), constant_values=127)
+        t_lens = jnp.pad(t_lens, (0, pad_t - T), constant_values=0)
+    # sentinel padding: band rows in front (windows may start at negative
+    # diagonals), band + 2*chunk behind (the pair round-up may issue one
+    # dead chunk's DMA past the last real window).  int32 because Mosaic
+    # can't dynamically sublane-slice int8 VMEM refs.
+    ts_T = jnp.pad(ts.astype(jnp.int32).T, ((band, band + 2 * chunk),
+                                            (0, 0)),
+                   constant_values=127)
+    kernel = functools.partial(
+        _banded_kernel_long, m=m, n=n, band=band, dlo=dlo,
+        match=params.match, mismatch=params.mismatch,
+        go=params.go, ge=params.gap_extend, block_t=block_t, chunk=chunk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(pad_t // block_t,),
+        in_specs=[
+            pl.BlockSpec((1, m), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec((1, block_t), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_t), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, pad_t), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((chunk + band, block_t), jnp.int32),
+            pltpu.VMEM((chunk + band, block_t), jnp.int32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
         interpret=interpret,
     )(q.astype(jnp.int32)[None, :], ts_T,
       t_lens.astype(jnp.int32)[None, :])

@@ -1,0 +1,92 @@
+"""Many-to-many alignment over a 2-D (query x target) device mesh.
+
+BASELINE.md config #3: many bacterial CDS queries vs many assembly
+targets — the full (Q x T) score matrix of batched banded affine-gap DP.
+The batch is embarrassingly parallel, so the idiomatic TPU mapping is a
+2-D mesh with queries sharded on one axis and targets on the other: each
+chip aligns its (Q/nq x T/nt) tile locally and the result lands already
+sharded as P('query', 'target') — zero collectives in the hot loop, all
+layout handled by `jax.sharding` (the reference is single-threaded C++,
+Makefile:64-66; there is no counterpart to translate).
+
+Queries must be length-bucketed on host (SURVEY.md §7.3: pad to the
+bucket's length); scores are read at cell (m, t_len) per lane, so all
+queries in one call share m.  Targets are padded to a shared n with
+sentinel 127 and carry true lengths in ``t_lens``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pwasm_tpu.ops.banded_dp import (ScoreParams, banded_scores_batch,
+                                     banded_scores_pallas)
+
+
+def make_mesh2d(n_devices: int | None = None,
+                axis_names: tuple[str, str] = ("query", "target")) -> Mesh:
+    """A 2-D mesh over the first ``n_devices`` devices; the query axis
+    gets the largest factor <= sqrt(n) (targets usually outnumber
+    queries, so the target axis gets the bigger factor)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    nq = 1
+    for cand in range(int(n ** 0.5), 0, -1):
+        if n % cand == 0:
+            nq = cand
+            break
+    return Mesh(np.asarray(devs).reshape(nq, n // nq), axis_names)
+
+
+def make_many2many(mesh: Mesh, band: int = 64,
+                   params: ScoreParams = ScoreParams(),
+                   kernel: str = "xla"):
+    """Build the sharded many-to-many scorer.
+
+    Returns a jitted ``fn(qs (Q, m), ts (T, n), t_lens (T,)) -> (Q, T)``
+    int32 scores with Q sharded over mesh axis 'query' and T over
+    'target' (Q and T must divide by their mesh factors).  ``kernel``
+    selects the local scorer: 'xla' (lax.scan rows) or 'pallas' (the
+    anti-diagonal wavefront TPU kernel).
+    """
+    if kernel == "pallas":
+        def score_all(q, ts_loc, tlens_loc):
+            return banded_scores_pallas(q, ts_loc, tlens_loc, band=band,
+                                        params=params)
+    else:
+        def score_all(q, ts_loc, tlens_loc):
+            return banded_scores_batch(q, ts_loc, tlens_loc, band=band,
+                                       params=params)
+
+    def local(qs_loc, ts_loc, tlens_loc):
+        return jax.vmap(
+            lambda q: score_all(q, ts_loc, tlens_loc))(qs_loc)
+
+    # check_vma off: the row scan's initial wavefront is built from
+    # constants, which the varying-axes checker would otherwise reject as
+    # unvarying carry inputs; the body is per-tile pure so the check adds
+    # nothing here.
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P("query", None), P("target", None),
+                             P("target")),
+                   out_specs=P("query", "target"),
+                   check_vma=False)
+    return jax.jit(fn)
+
+
+@functools.partial(jax.jit, static_argnames=("band", "params"))
+def many2many_scores(qs: jax.Array, ts: jax.Array, t_lens: jax.Array,
+                     band: int = 64,
+                     params: ScoreParams = ScoreParams()) -> jax.Array:
+    """Unsharded (Q, T) score matrix — the single-device reference the
+    mesh version must match bit for bit."""
+    return jax.vmap(
+        lambda q: banded_scores_batch(q, ts, t_lens, band=band,
+                                      params=params))(qs)

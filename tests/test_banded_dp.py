@@ -137,3 +137,57 @@ def test_custom_score_params():
                            jnp.int32(len(t)), band=16, params=p))
     assert got == full_gotoh_score(q, t, p)
     assert got == 9 * 1 - 6
+
+
+def test_long_kernel_matches_batch():
+    """HBM-streaming long-read kernel vs the scan path, chunk smaller than
+    m so multiple DMA windows are exercised (plus the round-up tail)."""
+    from pwasm_tpu.ops.banded_dp import banded_scores_long
+
+    rng = np.random.default_rng(11)
+    m, n, band = 200, 216, 32
+    q = rng.integers(0, 4, size=m).astype(np.int8)
+    T = 5
+    ts = np.full((T, n), 127, dtype=np.int8)
+    t_lens = np.zeros(T, dtype=np.int32)
+    for k in range(T):
+        t = list(q)
+        for _ in range(int(rng.integers(0, 6))):
+            t[int(rng.integers(0, len(t)))] = int(rng.integers(0, 4))
+        for _ in range(int(rng.integers(0, 3))):
+            p = int(rng.integers(1, len(t) - 1))
+            if rng.random() < 0.5:
+                t.insert(p, int(rng.integers(0, 4)))
+            else:
+                del t[p]
+        ts[k, :len(t)] = t
+        t_lens[k] = len(t)
+    got = np.asarray(banded_scores_long(
+        jnp.asarray(q), jnp.asarray(ts), jnp.asarray(t_lens),
+        band=band, block_t=8, chunk=64))
+    expect = np.asarray(banded_scores_batch(
+        jnp.asarray(q), jnp.asarray(ts), jnp.asarray(t_lens), band=band))
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_long_kernel_single_chunk():
+    """chunk >= m: one DMA window, still exact."""
+    from pwasm_tpu.ops.banded_dp import banded_scores_long
+
+    rng = np.random.default_rng(12)
+    m, n, band = 40, 56, 32
+    q = rng.integers(0, 4, size=m).astype(np.int8)
+    ts = np.full((3, n), 127, dtype=np.int8)
+    t_lens = np.array([m, m - 2, m + 4], dtype=np.int32)
+    ts[0, :m] = q
+    ts[1, :m - 2] = q[:m - 2]
+    t2 = list(q)
+    for p in (5, 15, 25, 30):
+        t2.insert(p, 2)
+    ts[2, :len(t2)] = t2
+    got = np.asarray(banded_scores_long(
+        jnp.asarray(q), jnp.asarray(ts), jnp.asarray(t_lens),
+        band=band, block_t=8, chunk=128))
+    expect = np.asarray(banded_scores_batch(
+        jnp.asarray(q), jnp.asarray(ts), jnp.asarray(t_lens), band=band))
+    np.testing.assert_array_equal(got, expect)

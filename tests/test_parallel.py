@@ -116,3 +116,53 @@ def test_wavefront_sp_rejects_indivisible():
     mesh = Mesh(np.asarray(jax.devices()[:8]), ("seq",))
     with pytest.raises(ValueError, match="must divide"):
         make_wavefront_sp(mesh, 30, 64, 4)
+
+
+def _m2m_workload(Q, T, m, n, seed=0):
+    rng = np.random.default_rng(seed)
+    qs = rng.integers(0, 4, size=(Q, m)).astype(np.int8)
+    ts = np.full((T, n), 127, dtype=np.int8)
+    t_lens = np.zeros(T, dtype=np.int32)
+    for k in range(T):
+        t = list(qs[k % Q])
+        for _ in range(int(rng.integers(0, 4))):
+            t[int(rng.integers(0, len(t)))] = int(rng.integers(0, 4))
+        if rng.random() < 0.5 and len(t) > 2:
+            del t[int(rng.integers(1, len(t) - 1))]
+        ts[k, :len(t)] = t
+        t_lens[k] = len(t)
+    return qs, ts, t_lens
+
+
+def test_many2many_mesh_matches_unsharded():
+    from pwasm_tpu.parallel.many2many import (make_many2many, make_mesh2d,
+                                              many2many_scores)
+
+    mesh = make_mesh2d(8)
+    assert mesh.shape["query"] * mesh.shape["target"] == 8
+    nq, nt = mesh.shape["query"], mesh.shape["target"]
+    Q, T, m, n = 2 * nq, 4 * nt, 24, 32
+    qs, ts, t_lens = _m2m_workload(Q, T, m, n)
+    fn = make_many2many(mesh, band=16)
+    got = np.asarray(fn(jnp.asarray(qs), jnp.asarray(ts),
+                        jnp.asarray(t_lens)))
+    expect = np.asarray(many2many_scores(jnp.asarray(qs), jnp.asarray(ts),
+                                         jnp.asarray(t_lens), band=16))
+    assert got.shape == (Q, T)
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_many2many_pallas_kernel_matches():
+    from pwasm_tpu.parallel.many2many import make_many2many, make_mesh2d
+
+    mesh = make_mesh2d(4)
+    nq, nt = mesh.shape["query"], mesh.shape["target"]
+    Q, T, m, n = nq, 2 * nt, 16, 24
+    qs, ts, t_lens = _m2m_workload(Q, T, m, n, seed=3)
+    xla = make_many2many(mesh, band=16, kernel="xla")
+    pal = make_many2many(mesh, band=16, kernel="pallas")
+    a = np.asarray(xla(jnp.asarray(qs), jnp.asarray(ts),
+                       jnp.asarray(t_lens)))
+    b = np.asarray(pal(jnp.asarray(qs), jnp.asarray(ts),
+                       jnp.asarray(t_lens)))
+    np.testing.assert_array_equal(a, b)
