@@ -180,10 +180,14 @@ def _make_tile_recurrence(n, band, dlo, match, mismatch, go, ge, block_t):
     ``make_row_step``.  Returns ``(init, row_tile, extract)``:
 
     - ``init() -> (m, ix, iy)`` row-0 wavefront tiles;
-    - ``row_tile(carry, i, qi, tj) -> (m, ix, iy)`` one query row given
-      the scalar query base ``qi`` and the (band, block_t) target window
-      ``tj``; the Iy chain is a log2(band) shift-max cumulative scan along
-      the sublane (band) axis;
+    - ``row_tile(carry, i, qi, tj, interior=False) -> (m, ix, iy)`` one
+      query row given the scalar query base ``qi`` and the
+      (band, block_t) target window ``tj``; the Iy chain is a log2(band)
+      shift-max cumulative scan along the sublane (band) axis.  With
+      ``interior=True`` (compile-time) the boundary masks are elided —
+      valid only for rows where the whole band lies in 1..n, i.e.
+      ``1 - dlo <= i <= n - band - dlo + 1`` (measured ~1.5x on v5e,
+      since masks are ~1/4 of the row's vector ops);
     - ``extract(carry, t_len, m) -> (1, block_t)`` the per-lane global
       score at cell (m, t_len) via a masked max (no gather).
     """
@@ -197,18 +201,25 @@ def _make_tile_recurrence(n, band, dlo, match, mismatch, go, ge, block_t):
                          NEG)
         return m_v, neg, iy_v
 
-    def row_tile(carry, i, qi, tj):
+    def row_tile(carry, i, qi, tj, interior=False):
         m_prev, ix_prev, iy_prev = carry
-        j = i + dlo + bidx
-        valid = (j >= 1) & (j <= n)
-        s = jnp.where((qi == tj) & (qi < 4), match, -mismatch)
+        # qi < 4 (a real base) is a scalar predicate: fold it into the
+        # match score instead of a per-element vector mask
+        m_sel = jax.lax.select(qi < jnp.int32(4), jnp.int32(match),
+                               jnp.int32(-mismatch))
+        s = jnp.where(tj == qi, m_sel, jnp.int32(-mismatch))
         diag = jnp.maximum(m_prev, jnp.maximum(ix_prev, iy_prev))
-        m_new = jnp.where(valid, diag + s, NEG)
+        m_new = diag + s
         up_m = jnp.concatenate([m_prev[1:], neg[:1]], axis=0)
         up_ix = jnp.concatenate([ix_prev[1:], neg[:1]], axis=0)
         ix_new = jnp.maximum(up_m - go, up_ix - ge)
-        ix_new = jnp.where(j == 0, -(go + (i - 1) * ge), ix_new)
-        ix_new = jnp.where((j < 0) | (j > n), NEG, ix_new)
+        if not interior:
+            j = i + dlo + bidx
+            valid = (j >= 1) & (j <= n)
+            m_new = jnp.where(valid, m_new, NEG)
+            # boundary column j == 0: only a leading target-gap is alive
+            ix_new = jnp.where(j == 0, -(go + (i - 1) * ge), ix_new)
+            ix_new = jnp.where((j < 0) | (j > n), NEG, ix_new)
         # cumulative max of m_new + b*ge along the band (log-step scan)
         run = m_new + bidx * ge
         sh = 1
@@ -218,7 +229,8 @@ def _make_tile_recurrence(n, band, dlo, match, mismatch, go, ge, block_t):
             sh *= 2
         run_prev = jnp.concatenate([neg[:1], run[:-1]], axis=0)
         iy_new = run_prev - go - (bidx - 1) * ge
-        iy_new = jnp.where(valid, iy_new, NEG)
+        if not interior:
+            iy_new = jnp.where(valid, iy_new, NEG)
         return m_new, ix_new, iy_new
 
     def extract(carry, t_len, m):
@@ -234,14 +246,20 @@ def _make_tile_recurrence(n, band, dlo, match, mismatch, go, ge, block_t):
 
 
 def _banded_kernel(q_ref, t_ref, tlen_ref, out_ref, *, m, n, band, dlo,
-                   match, mismatch, go, ge, block_t):
+                   match, mismatch, go, ge, block_t, unroll=4):
     """One grid step aligns ``block_t`` targets against the shared query.
 
-    State: three (band, block_t) int32 wavefronts updated over m rows with
-    a fori_loop.  ``t_ref`` is (band + n + band, block_t): the target
-    transposed with ``band`` rows of padding on both ends so the row-``i``
-    window load ``t_ref[ii + dlo + band :][:band]`` is always in bounds
+    State: three (band, block_t) int32 wavefronts updated over m rows.
+    ``t_ref`` is (band + n + band + unroll, block_t): the target
+    transposed with ``band`` rows of padding in front and
+    ``band + unroll`` behind so every window load is in bounds
     (band_dlo guarantees dlo >= 1 - band and m + dlo <= n).
+
+    Three phases: a masked head loop for rows whose band sticks out of
+    1..n on the left, an interior loop (boundary masks statically elided,
+    ``unroll`` rows per iteration off ONE widened window slice), and a
+    masked tail loop.  The split is static — row ``i`` (1-based) is
+    interior iff ``1 - dlo <= i <= n - band - dlo + 1``.
     """
     from jax.experimental import pallas as pl
 
@@ -255,7 +273,24 @@ def _banded_kernel(q_ref, t_ref, tlen_ref, out_ref, *, m, n, band, dlo,
         tj = t_ref[pl.ds(ii + dlo + band, band), :]
         return row_tile(carry, ii + 1, qi, tj)
 
-    carry = jax.lax.fori_loop(0, m, row, init())
+    # 0-based row index ranges of the three phases (static Python ints)
+    head = min(max(0, -dlo), m)              # rows 0 .. head-1 masked
+    int_end = max(head, min(m, n - band - dlo + 1))
+    nblk = (int_end - head) // unroll
+
+    carry = jax.lax.fori_loop(0, head, row, init())
+
+    def blk(bb, carry):
+        i0 = head + bb * unroll
+        win = t_ref[pl.ds(i0 + dlo + band, band + unroll - 1), :]
+        for r in range(unroll):
+            qi = q_ref[0, i0 + r]
+            carry = row_tile(carry, i0 + r + 1, qi, win[r:r + band],
+                             interior=True)
+        return carry
+
+    carry = jax.lax.fori_loop(0, nblk, blk, carry)
+    carry = jax.lax.fori_loop(head + nblk * unroll, m, row, carry)
     out_ref[...] = extract(carry, tlen_ref[...], m)
 
 
@@ -285,20 +320,24 @@ def banded_scores_pallas(q: jax.Array, ts: jax.Array, t_lens: jax.Array,
         ts = jnp.pad(ts, ((0, pad_t - T), (0, 0)), constant_values=127)
         t_lens = jnp.pad(t_lens, (0, pad_t - T), constant_values=0)
     # transpose to (n, T) and pad the sequence axis with `band` sentinel
-    # rows on each side so every row-window slice is in bounds
-    ts_T = jnp.pad(ts.astype(jnp.int32).T, ((band, band), (0, 0)),
+    # rows in front and `band + unroll` behind so every row-window slice
+    # (including the widened interior-block window) is in bounds
+    unroll = 4
+    ts_T = jnp.pad(ts.astype(jnp.int32).T, ((band, band + unroll), (0, 0)),
                    constant_values=127)
     kernel = functools.partial(
         _banded_kernel, m=m, n=n, band=band, dlo=dlo,
         match=params.match, mismatch=params.mismatch,
-        go=params.go, ge=params.gap_extend, block_t=block_t)
+        go=params.go, ge=params.gap_extend, block_t=block_t,
+        unroll=unroll)
     out = pl.pallas_call(
         kernel,
         grid=(pad_t // block_t,),
         in_specs=[
             pl.BlockSpec((1, m), lambda i: (0, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((n + 2 * band, block_t), lambda i: (0, i)),
+            pl.BlockSpec((n + 2 * band + unroll, block_t),
+                         lambda i: (0, i)),
             pl.BlockSpec((1, block_t), lambda i: (0, i)),
         ],
         out_specs=pl.BlockSpec((1, block_t), lambda i: (0, i)),
