@@ -1,29 +1,44 @@
 #!/usr/bin/env python
 """pwasm-tpu benchmark — prints ONE JSON line for the driver.
 
-Headline config (BASELINE.md #2): batched banded affine-gap DP
-re-alignment of one bacterial-CDS-sized query (~1.5 kb) against a batch of
-Nanopore-assembly-sized targets, band 64 (PWASM_BENCH_BAND to change), on
-one chip — measured as aligned target bases per second.  ``vs_baseline`` is the speedup over the
-single-core C++ banded Gotoh on the same workload (the reference is a
-single-threaded C++ program, Makefile:64-66, and publishes no numbers of
-its own — BASELINE.md).
+``PWASM_BENCH_CONFIG`` selects one of the five BASELINE.md configs
+(default 2, the headline):
 
-A consensus-vote parity check (CPU engine vs device kernel, bit-exact)
-runs as part of the benchmark; a mismatch fails the run.
+1. end-to-end ``pafreport`` CPU reference: 1 CDS vs 1 Nanopore-style
+   assembly through the real CLI (parse -> diff extraction -> context ->
+   codon impact -> report), metric = wall seconds per run.
+2. batched banded affine-gap DP re-align, 1 CDS (~1.5 kb) vs 10k targets,
+   band 64, one chip — aligned target bases/sec (headline metric).
+3. many-to-many: 500 CDS x 10k targets on the 2-D (query x target) tile
+   map, one chip — aligned base-pairs/sec (per-pair target bases).
+4. MSA consensus: 256-deep pileup, per-column ACGT/N/gap count + vote
+   Pallas kernel — pileup bases/sec, bit-exact vs the CPU engine vote.
+5. long-read 50 kb banded DP, HBM-streaming double-buffered wavefront —
+   aligned target bases/sec.
+
+``vs_baseline`` is the speedup over the single-core CPU equivalent of the
+same computation (C++ banded Gotoh for DP configs, the reference-style
+per-column qsort vote for consensus; the reference itself is a
+single-threaded C++ program, Makefile:64-66, and publishes no numbers —
+BASELINE.md).  Config 1 reports vs_baseline=1.0 by definition: it IS the
+CPU reference point.
+
+Parity gates (device vs CPU bit-exact) run inside each config; a mismatch
+fails the run with a zero-value JSON line.
 
 Timing note: the TPU here sits behind a tunnel with a ~70 ms host
 round-trip, so timing fetch-per-rep measures the tunnel, not the chip
 (and ``block_until_ready`` alone can return before the remote execution
-actually runs).  The benchmark therefore times a DEPENDENCY-CHAINED
-pipeline of launches (each rep's t_lens is xor-folded with the previous
-rep's scores, so no rep can be elided or reordered) ending in one host
-fetch, at two pipeline depths k and 2k; the per-rep time is
-``(t(2k) - t(k)) / k``, which cancels the fixed round-trip latency.
+actually runs).  Device configs therefore time a DEPENDENCY-CHAINED
+pipeline of launches (each rep consumes the previous rep's output through
+``lax.optimization_barrier``, so no rep can be elided or reordered)
+ending in one host fetch, at two pipeline depths k and 2k; per-rep time
+is ``(t(2k) - t(k)) / k``, which cancels the fixed round-trip latency.
 
-Env knobs: PWASM_BENCH_T (batch targets, default 10240),
-PWASM_BENCH_KERNEL=pallas|stream|xla (default pallas),
-PWASM_BENCH_BAND (default 64), PWASM_BENCH_CPU_T (CPU baseline subset,
+Env knobs: PWASM_BENCH_CONFIG (1-5, default 2), PWASM_BENCH_T (targets,
+default 10240), PWASM_BENCH_Q (config-3 queries, default 500),
+PWASM_BENCH_KERNEL=pallas|stream|xla (config-2 kernel, default pallas),
+PWASM_BENCH_BAND (default 64), PWASM_BENCH_CPU_T (CPU-baseline subset,
 default 32), PWASM_BENCH_REPS (pipeline depth k, default 8).
 """
 
@@ -36,50 +51,164 @@ import time
 
 import numpy as np
 
-M = 1500          # query length (CDS-sized)
 BAND = int(os.environ.get("PWASM_BENCH_BAND", "64"))
-N_PAD = M + BAND // 2  # padded target length (pad also anchors the band)
+CPU_T = int(os.environ.get("PWASM_BENCH_CPU_T", "32"))
+REPS = int(os.environ.get("PWASM_BENCH_REPS", "8"))
 
 
-def _workload(T: int, seed: int = 0):
+def _workload(T: int, m: int, seed: int = 0, max_subs: int = 40,
+              max_indels: int = 8):
+    """One random query of length m + T mutated copies, padded to
+    n = m + BAND//2 (the pad also anchors the band)."""
+    n_pad = m + BAND // 2
     rng = np.random.default_rng(seed)
-    q = rng.integers(0, 4, size=M).astype(np.int8)
-    ts = np.full((T, N_PAD), 127, dtype=np.int8)
+    q = rng.integers(0, 4, size=m).astype(np.int8)
+    ts = np.full((T, n_pad), 127, dtype=np.int8)
     t_lens = np.zeros(T, dtype=np.int32)
     for k in range(T):
         t = list(q)
-        for _ in range(int(rng.integers(5, 40))):   # subs
+        for _ in range(int(rng.integers(5, max_subs))):
             t[int(rng.integers(0, len(t)))] = int(rng.integers(0, 4))
-        for _ in range(int(rng.integers(0, 8))):    # indels
+        for _ in range(int(rng.integers(0, max_indels))):
             p = int(rng.integers(1, len(t) - 1))
             if rng.random() < 0.5:
                 t.insert(p, int(rng.integers(0, 4)))
             else:
                 del t[p]
-        t = t[:N_PAD]
+        t = t[:n_pad]
         ts[k, :len(t)] = t
         t_lens[k] = len(t)
     return q, ts, t_lens
 
 
-def main() -> int:
+def _fail(metric: str) -> int:
+    print(json.dumps({"metric": metric, "value": 0, "unit": "bool",
+                      "vs_baseline": 0}))
+    return 1
+
+
+def _pipe_rate(run_fn, arg, zero, work_per_rep: float):
+    """Latency-cancelling pipelined rate: work units per second, or None
+    if the timer never stabilizes.  ``run_fn(arg, prev)`` must consume
+    ``prev`` (the previous rep's output) through an optimization_barrier.
+    """
+    prev = zero
+    np.asarray(run_fn(arg, prev))           # compile + settle
+
+    def pipe(reps):
+        prev = zero
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            prev = run_fn(arg, prev)
+        np.asarray(prev)                    # one fetch drains the chain
+        return time.perf_counter() - t0
+
+    pipe(2)                                 # warm the dispatch path
+    for _ in range(3):  # timer noise can make t(2k) <= t(k); retry
+        dt = (pipe(2 * REPS) - pipe(REPS)) / REPS
+        if dt > 0:
+            return work_per_rep / dt
+    return None
+
+
+def _gotoh_cpu_rate(q, ts, t_lens, band, scores_expect) -> float | None:
+    """Single-core C++ banded-Gotoh bases/sec on a subset; also the DP
+    parity gate.  Returns None (and prints the failure line) on mismatch,
+    0.0 when the native library is unavailable."""
+    from pwasm_tpu.native import banded_gotoh_batch, native_available
+    from pwasm_tpu.ops.banded_dp import ScoreParams, band_dlo
+
+    if not native_available():
+        return 0.0
+    params = ScoreParams()
+    dlo = band_dlo(len(q), ts.shape[1], band)
+    sub = slice(0, min(CPU_T, ts.shape[0]))
+    t0 = time.perf_counter()
+    cpu_scores = banded_gotoh_batch(q, ts[sub], t_lens[sub], band, dlo,
+                                    params.match, params.mismatch,
+                                    params.gap_open, params.gap_extend)
+    cpu_dt = time.perf_counter() - t0
+    if not np.array_equal(scores_expect[sub], cpu_scores):
+        return None
+    return float(t_lens[sub].sum()) / cpu_dt
+
+
+def _emit(metric, value, unit, vs_baseline) -> int:
+    print(json.dumps({"metric": metric, "value": round(value, 1),
+                      "unit": unit, "vs_baseline": round(vs_baseline, 2)}))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# config 1 — end-to-end CPU reference: CLI on 1 CDS vs 1 assembly
+# ---------------------------------------------------------------------------
+def cfg1_cli_cpu_ref() -> int:
+    import subprocess
+    import tempfile
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests.helpers import make_paf_line
+
+    rng = np.random.default_rng(0)
+    cds = "ATG" + "".join("ACGT"[i] for i in rng.integers(0, 4, 1494)) + \
+        "TAA"
+    ops = []
+    pos = 0
+    for cut in (200, 500, 900, 1200):   # a few subs + one ins + one del
+        ops.append(("=", cut - pos))
+        qb = cds[cut]
+        tb = "ACGT"[("ACGT".index(qb) + 1) % 4]
+        ops.append(("*", tb, qb))
+        pos = cut + 1
+    ops.append(("=", 99))        # pos 1201 -> 1300
+    ops.append(("ins", "TT"))
+    ops.append(("del", 3))       # pos 1300 -> 1303
+    ops.append(("=", len(cds) - 1303))
+    line, _ = make_paf_line("cds1", cds, "asm1", "+", ops, nm=6, score=80)
+    with tempfile.TemporaryDirectory() as d:
+        fa = os.path.join(d, "cds.fa")
+        paf = os.path.join(d, "in.paf")
+        out = os.path.join(d, "report.dfa")
+        with open(fa, "w") as f:
+            f.write(f">cds1\n{cds}\n")
+        with open(paf, "w") as f:
+            f.write(line + "\n")
+        cmd = [sys.executable, "-m", "pwasm_tpu.cli", paf, "-r", fa,
+               "-o", out]
+        env = dict(os.environ, PYTHONPATH=os.path.dirname(
+            os.path.abspath(__file__)))
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            r = subprocess.run(cmd, env=env, capture_output=True)
+            times.append(time.perf_counter() - t0)
+            if r.returncode != 0:
+                sys.stderr.write(r.stderr.decode()[:2000])
+                return _fail("cli_cpu_ref")
+        with open(out) as f:
+            body = f.read()
+        if "S\t" not in body or "coverage:" not in body:
+            return _fail("cli_cpu_ref_output")
+    return _emit("cpu_ref_wall_s", min(times), "s", 1.0)
+
+
+# ---------------------------------------------------------------------------
+# config 2 — headline: batched banded DP, 1 CDS vs 10k targets
+# ---------------------------------------------------------------------------
+def cfg2_batched_dp() -> int:
     import jax
     import jax.numpy as jnp
 
-    from pwasm_tpu.ops.banded_dp import (ScoreParams, band_dlo,
-                                         banded_scores_batch,
+    from pwasm_tpu.ops.banded_dp import (ScoreParams, banded_scores_batch,
                                          banded_scores_long,
                                          banded_scores_pallas)
     from pwasm_tpu.ops.consensus import consensus_votes
 
     T = int(os.environ.get("PWASM_BENCH_T", "10240"))
-    cpu_T = int(os.environ.get("PWASM_BENCH_CPU_T", "32"))
     kernel = os.environ.get("PWASM_BENCH_KERNEL", "pallas")
     params = ScoreParams()
-    q, ts, t_lens = _workload(T)
-    qd = jnp.asarray(q)
-    tsd = jnp.asarray(ts)
-    tld = jnp.asarray(t_lens)
+    q, ts, t_lens = _workload(T, m=1500)
+    qd, tsd, tld = jnp.asarray(q), jnp.asarray(ts), jnp.asarray(t_lens)
 
     if kernel == "pallas":
         def score_fn(tl_in):
@@ -96,38 +225,16 @@ def main() -> int:
 
     @jax.jit
     def chained(tl_in, prev):
-        # optimization_barrier ties each launch to the previous rep's
-        # scores — unlike an algebraic no-op (e.g. xor with prev&0), XLA
-        # cannot fold it away, so the chain can't be elided or reordered
         tl_in, _ = jax.lax.optimization_barrier((tl_in, prev))
         return score_fn(tl_in)
 
     zero = jnp.zeros_like(tld)
-    scores_h = np.asarray(chained(tld, zero))   # compile + settle
+    scores_h = np.asarray(chained(tld, zero))
+    rate = _pipe_rate(chained, tld, zero, float(t_lens.sum()))
+    if rate is None:
+        return _fail("bench_timing_unstable")
 
-    def pipe(reps):
-        prev = zero
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            prev = chained(tld, prev)
-        np.asarray(prev)                        # one fetch drains the chain
-        return time.perf_counter() - t0
-
-    k = int(os.environ.get("PWASM_BENCH_REPS", "8"))
-    pipe(2)                                     # warm the dispatch path
-    dev_dt = 0.0
-    for _ in range(3):  # timer noise can make t(2k) <= t(k); retry
-        dev_dt = (pipe(2 * k) - pipe(k)) / k
-        if dev_dt > 0:
-            break
-    if dev_dt <= 0:
-        print(json.dumps({"metric": "bench_timing_unstable", "value": 0,
-                          "unit": "bool", "vs_baseline": 0}))
-        return 1
-    total_bases = int(t_lens.sum())
-    bases_per_sec = total_bases / dev_dt
-
-    # ---- consensus parity gate (bit-exact device vs CPU engine)
+    # consensus parity gate (bit-exact device vs CPU engine)
     from pwasm_tpu.align.msa import best_char_from_counts
     rng = np.random.default_rng(1)
     pileup = rng.integers(0, 7, size=(64, 512)).astype(np.int8)
@@ -138,38 +245,146 @@ def main() -> int:
         expect = best_char_from_counts(np.array(counts), sum(counts))
         got = 0 if votes[c] < 0 else nuc[votes[c]]
         if got != expect:
-            print(json.dumps({"metric": "consensus_parity", "value": 0,
-                              "unit": "bool", "vs_baseline": 0}))
-            return 1
+            return _fail("consensus_parity")
 
-    # ---- single-core C++ baseline on a subset, scaled per-base
-    from pwasm_tpu.native import banded_gotoh_batch, native_available
-    dlo = band_dlo(M, N_PAD, BAND)
-    if native_available():
-        sub = slice(0, cpu_T)
-        t0 = time.perf_counter()
-        cpu_scores = banded_gotoh_batch(q, ts[sub], t_lens[sub], BAND, dlo,
-                                        params.match, params.mismatch,
-                                        params.gap_open, params.gap_extend)
-        cpu_dt = time.perf_counter() - t0
-        cpu_bases = int(t_lens[sub].sum())
-        cpu_bases_per_sec = cpu_bases / cpu_dt
-        # score parity between the C++ baseline and the device kernel
-        if not np.array_equal(scores_h[sub], cpu_scores):
-            print(json.dumps({"metric": "dp_parity", "value": 0,
-                              "unit": "bool", "vs_baseline": 0}))
-            return 1
-        vs_baseline = bases_per_sec / cpu_bases_per_sec
-    else:
-        vs_baseline = 0.0
+    cpu_rate = _gotoh_cpu_rate(q, ts, t_lens, BAND, scores_h)
+    if cpu_rate is None:
+        return _fail("dp_parity")
+    return _emit("aligned_bases_per_sec_per_chip", rate, "bases/s",
+                 rate / cpu_rate if cpu_rate else 0.0)
 
-    print(json.dumps({
-        "metric": "aligned_bases_per_sec_per_chip",
-        "value": round(bases_per_sec, 1),
-        "unit": "bases/s",
-        "vs_baseline": round(vs_baseline, 2),
-    }))
-    return 0
+
+# ---------------------------------------------------------------------------
+# config 3 — many-to-many: Q CDS x T targets, 2-D tile map
+# ---------------------------------------------------------------------------
+def cfg3_many2many() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from pwasm_tpu.parallel.many2many import many2many_scores_pallas
+
+    global REPS
+    REPS = max(1, REPS // 8)    # each rep is Q full DP batches (~4 s)
+    Q = int(os.environ.get("PWASM_BENCH_Q", "500"))
+    T = int(os.environ.get("PWASM_BENCH_T", "10240"))
+    m = 1500
+    q0, ts, t_lens = _workload(T, m=m, seed=0)
+    rng = np.random.default_rng(7)
+    qs = np.empty((Q, m), dtype=np.int8)
+    qs[0] = q0
+    for i in range(1, Q):
+        qi = q0.copy()
+        idx = rng.integers(0, m, size=30)
+        qi[idx] = rng.integers(0, 4, size=30).astype(np.int8)
+        qs[i] = qi
+    qsd, tsd, tld = jnp.asarray(qs), jnp.asarray(ts), jnp.asarray(t_lens)
+
+    @jax.jit
+    def chained(tl_in, prev):
+        tl_in, _ = jax.lax.optimization_barrier((tl_in, prev))
+        return many2many_scores_pallas(qsd, tsd, tl_in, band=BAND)
+
+    zero = jnp.zeros_like(tld)
+    scores_h = np.asarray(chained(tld, zero))
+    rate = _pipe_rate(chained, tld, zero, float(t_lens.sum()) * Q)
+    if rate is None:
+        return _fail("bench_timing_unstable")
+
+    # parity gate on one query row vs the C++ single-core baseline
+    cpu_rate = _gotoh_cpu_rate(q0, ts, t_lens, BAND, scores_h[0])
+    if cpu_rate is None:
+        return _fail("dp_parity")
+    return _emit("m2m_aligned_bases_per_sec_per_chip", rate, "bases/s",
+                 rate / cpu_rate if cpu_rate else 0.0)
+
+
+# ---------------------------------------------------------------------------
+# config 4 — consensus vote kernel: 256-deep pileup
+# ---------------------------------------------------------------------------
+def cfg4_consensus() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from pwasm_tpu.align.msa import best_char_from_counts
+    from pwasm_tpu.ops.consensus import consensus_pallas, votes_to_chars
+
+    depth = 256
+    cols = int(os.environ.get("PWASM_BENCH_T", "65536"))
+    rng = np.random.default_rng(3)
+    # realistic pileup: mostly agreeing bases + noise + gaps
+    true_base = rng.integers(0, 4, size=cols).astype(np.int8)
+    pileup = np.broadcast_to(true_base, (depth, cols)).copy()
+    noise = rng.random((depth, cols))
+    pileup[noise < 0.10] = rng.integers(0, 6, size=(noise < 0.10).sum())
+    pd = jnp.asarray(pileup)
+
+    @jax.jit
+    def chained(p_in, prev):
+        p_in, _ = jax.lax.optimization_barrier((p_in, prev))
+        votes, _counts = consensus_pallas(p_in)
+        return votes
+
+    zero = jnp.zeros((cols,), jnp.int8)
+    votes_h = np.asarray(chained(pd, zero))
+    rate = _pipe_rate(chained, pd, zero, float(depth * cols))
+    if rate is None:
+        return _fail("bench_timing_unstable")
+
+    # bit-exact parity + single-core reference-style vote baseline
+    counts_np = np.stack([(pileup == k).sum(0) for k in range(6)], 0)
+    sub = min(cols, 4096)
+    t0 = time.perf_counter()
+    expect_chars = bytes(
+        best_char_from_counts(counts_np[:, c], int(counts_np[:, c].sum()))
+        for c in range(sub))
+    cpu_dt = time.perf_counter() - t0
+    got_chars = votes_to_chars(votes_h[:sub], star_gap=False)
+    if got_chars != expect_chars:
+        return _fail("consensus_parity")
+    cpu_rate = depth * sub / cpu_dt
+    return _emit("pileup_bases_per_sec_per_chip", rate, "bases/s",
+                 rate / cpu_rate if cpu_rate else 0.0)
+
+
+# ---------------------------------------------------------------------------
+# config 5 — long-read 50 kb banded DP, HBM-streaming wavefront
+# ---------------------------------------------------------------------------
+def cfg5_longread() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from pwasm_tpu.ops.banded_dp import ScoreParams, banded_scores_long
+
+    T = int(os.environ.get("PWASM_BENCH_T", "256"))
+    m = 50_000
+    params = ScoreParams()
+    q, ts, t_lens = _workload(T, m=m, seed=5, max_subs=400, max_indels=12)
+    qd, tsd, tld = jnp.asarray(q), jnp.asarray(ts), jnp.asarray(t_lens)
+
+    @jax.jit
+    def chained(tl_in, prev):
+        tl_in, _ = jax.lax.optimization_barrier((tl_in, prev))
+        return banded_scores_long(qd, tsd, tl_in, band=BAND,
+                                  params=params, chunk=1024)
+
+    zero = jnp.zeros_like(tld)
+    scores_h = np.asarray(chained(tld, zero))
+    rate = _pipe_rate(chained, tld, zero, float(t_lens.sum()))
+    if rate is None:
+        return _fail("bench_timing_unstable")
+
+    cpu_rate = _gotoh_cpu_rate(q, ts, t_lens, BAND, scores_h)
+    if cpu_rate is None:
+        return _fail("dp_parity")
+    return _emit("longread_bases_per_sec_per_chip", rate, "bases/s",
+                 rate / cpu_rate if cpu_rate else 0.0)
+
+
+def main() -> int:
+    cfg = os.environ.get("PWASM_BENCH_CONFIG", "2")
+    return {"1": cfg1_cli_cpu_ref, "2": cfg2_batched_dp,
+            "3": cfg3_many2many, "4": cfg4_consensus,
+            "5": cfg5_longread}[cfg]()
 
 
 if __name__ == "__main__":

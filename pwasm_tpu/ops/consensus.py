@@ -103,7 +103,12 @@ def _consensus_kernel(bases_ref, counts_ref, votes_ref):
     gap = cnt[5]
     m_acgt = jnp.max(acgt, axis=0)
     m_all = jnp.maximum(m_acgt, jnp.maximum(n, gap))
-    first_acgt = jnp.argmax(acgt == m_all[None, :], axis=0)
+    # first ACGT index hitting the max — masked min over the class axis
+    # (Mosaic has no integer argmax; min of a where-masked iota is
+    # equivalent and lowers to a plain int reduction)
+    kidx = jax.lax.broadcasted_iota(jnp.int32, acgt.shape, 0)
+    first_acgt = jnp.min(jnp.where(acgt == m_all[None, :], kidx,
+                                   N_CLASSES), axis=0)
     acgt_wins = m_acgt == m_all
     both_tie = (n == m_all) & (gap == m_all)
     n_wins = (n == m_all) & ~both_tie

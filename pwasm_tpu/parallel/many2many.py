@@ -90,3 +90,22 @@ def many2many_scores(qs: jax.Array, ts: jax.Array, t_lens: jax.Array,
     return jax.vmap(
         lambda q: banded_scores_batch(q, ts, t_lens, band=band,
                                       params=params))(qs)
+
+
+@functools.partial(jax.jit, static_argnames=("band", "params"))
+def many2many_scores_pallas(qs: jax.Array, ts: jax.Array,
+                            t_lens: jax.Array, band: int = 64,
+                            params: ScoreParams = ScoreParams()
+                            ) -> jax.Array:
+    """Single-chip (Q, T) score matrix via the Pallas wavefront kernel,
+    sequential over queries (``lax.map``), batched over targets inside
+    each kernel launch.
+
+    Memory stays O(T x band) regardless of Q — unlike vmapping the scan
+    path, whose carry is O(Q x T x band) and OOMs at
+    BASELINE.md config-3 scale (500 x 10k).  Bit-exact with
+    ``many2many_scores``.
+    """
+    return jax.lax.map(
+        lambda q: banded_scores_pallas(q, ts, t_lens, band=band,
+                                       params=params), qs)

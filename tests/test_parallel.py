@@ -166,3 +166,19 @@ def test_many2many_pallas_kernel_matches():
     b = np.asarray(pal(jnp.asarray(qs), jnp.asarray(ts),
                        jnp.asarray(t_lens)))
     np.testing.assert_array_equal(a, b)
+
+
+def test_many2many_scores_pallas_sequential_matches():
+    # the lax.map-over-queries single-chip path (bench config #3) must be
+    # bit-exact with the vmapped scan reference
+    from pwasm_tpu.parallel.many2many import (many2many_scores,
+                                              many2many_scores_pallas)
+
+    Q, T, m, n = 5, 12, 20, 28
+    qs, ts, t_lens = _m2m_workload(Q, T, m, n, seed=9)
+    a = np.asarray(many2many_scores(jnp.asarray(qs), jnp.asarray(ts),
+                                    jnp.asarray(t_lens), band=16))
+    b = np.asarray(many2many_scores_pallas(jnp.asarray(qs),
+                                           jnp.asarray(ts),
+                                           jnp.asarray(t_lens), band=16))
+    np.testing.assert_array_equal(a, b)
