@@ -87,13 +87,14 @@ def _fail(metric: str) -> int:
     return 1
 
 
-def _pipe_rate(run_fn, arg, zero, work_per_rep: float):
+def _pipe_rate(run_fn, arg, zero, work_per_rep: float, reps: int = 0):
     """Latency-cancelling pipelined rate: work units per second, or None
     if the timer never stabilizes.  ``run_fn(arg, prev)`` must consume
-    ``prev`` (the previous rep's output) through an optimization_barrier.
+    ``prev`` (the previous rep's output) through an optimization_barrier,
+    and must already be compiled (every caller fetches one result first
+    for its parity gate, which compiles and settles the function).
     """
-    prev = zero
-    np.asarray(run_fn(arg, prev))           # compile + settle
+    reps = reps or REPS
 
     def pipe(reps):
         prev = zero
@@ -105,24 +106,68 @@ def _pipe_rate(run_fn, arg, zero, work_per_rep: float):
 
     pipe(2)                                 # warm the dispatch path
     for _ in range(3):  # timer noise can make t(2k) <= t(k); retry
-        dt = (pipe(2 * REPS) - pipe(REPS)) / REPS
+        dt = (pipe(2 * reps) - pipe(reps)) / reps
         if dt > 0:
             return work_per_rep / dt
     return None
 
 
+def _numpy_banded_gotoh(q, t, t_len, band, dlo, params) -> int:
+    """Row-wavefront banded Gotoh in plain numpy (no jax) — the
+    independent parity reference when the native library is absent."""
+    NEG = -(2 ** 30)
+    ge, go = params.gap_extend, params.gap_open + params.gap_extend
+    m, n = len(q), t_len
+    bidx = np.arange(band)
+    j0 = dlo + bidx
+    M = np.where(j0 == 0, 0, NEG).astype(np.int64)
+    Iy = np.where((j0 >= 1) & (j0 <= n), -(go + (j0 - 1) * ge),
+                  NEG).astype(np.int64)
+    Ix = np.full(band, NEG, dtype=np.int64)
+    for i in range(1, m + 1):
+        j = i + dlo + bidx
+        valid = (j >= 1) & (j <= n)
+        tj = np.where(valid, t[np.clip(j - 1, 0, len(t) - 1)], 127)
+        s = np.where((tj == q[i - 1]) & (q[i - 1] < 4), params.match,
+                     -params.mismatch)
+        diag = np.maximum(M, np.maximum(Ix, Iy))
+        M2 = np.where(valid, diag + s, NEG)
+        upM = np.append(M[1:], NEG)
+        upIx = np.append(Ix[1:], NEG)
+        Ix2 = np.maximum(upM - go, upIx - ge)
+        Ix2 = np.where(j == 0, -(go + (i - 1) * ge), Ix2)
+        Ix2 = np.where((j < 0) | (j > n), NEG, Ix2)
+        run = np.maximum.accumulate(M2 + bidx * ge)
+        run_prev = np.append(NEG, run[:-1])
+        Iy2 = np.where(valid, run_prev - go - (bidx - 1) * ge, NEG)
+        M, Ix, Iy = M2, Ix2, Iy2
+    b_end = n - m - dlo
+    if b_end < 0 or b_end >= band:
+        return -(2 ** 30)
+    return int(max(M[b_end], Ix[b_end], Iy[b_end]))
+
+
 def _gotoh_cpu_rate(q, ts, t_lens, band, scores_expect) -> float | None:
     """Single-core C++ banded-Gotoh bases/sec on a subset; also the DP
-    parity gate.  Returns None (and prints the failure line) on mismatch,
-    0.0 when the native library is unavailable."""
+    parity gate.  Returns None on parity mismatch, 0.0 when the native
+    library is unavailable — in that case the parity gate still runs,
+    against the XLA scan path (a fully independent lowering of the same
+    recurrence), so no config ever skips its bit-exactness check."""
     from pwasm_tpu.native import banded_gotoh_batch, native_available
     from pwasm_tpu.ops.banded_dp import ScoreParams, band_dlo
 
-    if not native_available():
-        return 0.0
     params = ScoreParams()
-    dlo = band_dlo(len(q), ts.shape[1], band)
     sub = slice(0, min(CPU_T, ts.shape[0]))
+    dlo = band_dlo(len(q), ts.shape[1], band)
+    if not native_available():
+        # still gate parity, against a plain-numpy banded Gotoh — an
+        # implementation independent of every jax lowering (the XLA scan
+        # path could BE the kernel under test when PWASM_BENCH_KERNEL=xla)
+        few = slice(0, min(4, ts.shape[0]))
+        ref = np.array([_numpy_banded_gotoh(q, ts[k], int(t_lens[k]),
+                                            band, dlo, params)
+                        for k in range(few.stop)], dtype=np.int32)
+        return None if not np.array_equal(scores_expect[few], ref) else 0.0
     t0 = time.perf_counter()
     cpu_scores = banded_gotoh_batch(q, ts[sub], t_lens[sub], band, dlo,
                                     params.match, params.mismatch,
@@ -133,9 +178,18 @@ def _gotoh_cpu_rate(q, ts, t_lens, band, scores_expect) -> float | None:
     return float(t_lens[sub].sum()) / cpu_dt
 
 
+def _sig(x: float, digits: int = 4) -> float:
+    """Round to significant digits (plain round-to-decimals destroys
+    sub-second wall times and adds nothing to multi-gigabase rates)."""
+    if x == 0:
+        return 0.0
+    import math
+    return round(x, digits - 1 - int(math.floor(math.log10(abs(x)))))
+
+
 def _emit(metric, value, unit, vs_baseline) -> int:
-    print(json.dumps({"metric": metric, "value": round(value, 1),
-                      "unit": unit, "vs_baseline": round(vs_baseline, 2)}))
+    print(json.dumps({"metric": metric, "value": _sig(value),
+                      "unit": unit, "vs_baseline": _sig(vs_baseline)}))
     return 0
 
 
@@ -175,8 +229,11 @@ def cfg1_cli_cpu_ref() -> int:
             f.write(line + "\n")
         cmd = [sys.executable, "-m", "pwasm_tpu.cli", paf, "-r", fa,
                "-o", out]
-        env = dict(os.environ, PYTHONPATH=os.path.dirname(
-            os.path.abspath(__file__)))
+        repo = os.path.dirname(os.path.abspath(__file__))
+        old_pp = os.environ.get("PYTHONPATH", "")
+        env = dict(os.environ,
+                   PYTHONPATH=repo + (os.pathsep + old_pp if old_pp
+                                      else ""))
         times = []
         for _ in range(3):
             t0 = time.perf_counter()
@@ -214,6 +271,14 @@ def cfg2_batched_dp() -> int:
         def score_fn(tl_in):
             return banded_scores_pallas(qd, tsd, tl_in, band=BAND,
                                         params=params)
+    elif kernel == "packed":
+        from pwasm_tpu.ops.pack import banded_scores_packed, pack_targets
+        tspd = jnp.asarray(pack_targets(np.where(ts == 127, 0, ts)))
+        n_cols = ts.shape[1]
+
+        def score_fn(tl_in):
+            return banded_scores_packed(qd, tspd, n_cols, tl_in,
+                                        band=BAND, params=params)
     elif kernel == "stream":
         def score_fn(tl_in):
             return banded_scores_long(qd, tsd, tl_in, band=BAND,
@@ -263,8 +328,6 @@ def cfg3_many2many() -> int:
 
     from pwasm_tpu.parallel.many2many import many2many_scores_pallas
 
-    global REPS
-    REPS = max(1, REPS // 8)    # each rep is Q full DP batches (~4 s)
     Q = int(os.environ.get("PWASM_BENCH_Q", "500"))
     T = int(os.environ.get("PWASM_BENCH_T", "10240"))
     m = 1500
@@ -286,7 +349,9 @@ def cfg3_many2many() -> int:
 
     zero = jnp.zeros_like(tld)
     scores_h = np.asarray(chained(tld, zero))
-    rate = _pipe_rate(chained, tld, zero, float(t_lens.sum()) * Q)
+    # each rep is Q full DP batches (~4 s) — shallow pipeline suffices
+    rate = _pipe_rate(chained, tld, zero, float(t_lens.sum()) * Q,
+                      reps=max(1, REPS // 8))
     if rate is None:
         return _fail("bench_timing_unstable")
 
@@ -309,7 +374,10 @@ def cfg4_consensus() -> int:
     from pwasm_tpu.ops.consensus import consensus_pallas, votes_to_chars
 
     depth = 256
-    cols = int(os.environ.get("PWASM_BENCH_T", "65536"))
+    # default sized so one vote pass takes ~5 ms on a v5e chip — small
+    # enough to fit comfortably, large enough that per-launch dispatch
+    # through the tunnel doesn't dominate the pipelined timing
+    cols = int(os.environ.get("PWASM_BENCH_T", str(1 << 20)))
     rng = np.random.default_rng(3)
     # realistic pileup: mostly agreeing bases + noise + gaps
     true_base = rng.integers(0, 4, size=cols).astype(np.int8)
@@ -330,18 +398,26 @@ def cfg4_consensus() -> int:
     if rate is None:
         return _fail("bench_timing_unstable")
 
-    # bit-exact parity + single-core reference-style vote baseline
-    counts_np = np.stack([(pileup == k).sum(0) for k in range(6)], 0)
-    sub = min(cols, 4096)
-    t0 = time.perf_counter()
-    expect_chars = bytes(
-        best_char_from_counts(counts_np[:, c], int(counts_np[:, c].sum()))
-        for c in range(sub))
-    cpu_dt = time.perf_counter() - t0
-    got_chars = votes_to_chars(votes_h[:sub], star_gap=False)
-    if got_chars != expect_chars:
-        return _fail("consensus_parity")
-    cpu_rate = depth * sub / cpu_dt
+    # bit-exact parity + single-core C++ vote baseline (full pileup)
+    from pwasm_tpu.native import consensus_vote_pileup, native_available
+    got_chars = votes_to_chars(votes_h, star_gap=False)
+    if native_available():
+        t0 = time.perf_counter()
+        cpu_chars = consensus_vote_pileup(pileup)
+        cpu_dt = time.perf_counter() - t0
+        if got_chars != cpu_chars.tobytes():
+            return _fail("consensus_parity")
+        cpu_rate = depth * cols / cpu_dt
+    else:  # parity vs the Python engine vote on a subset; no baseline
+        counts_np = np.stack([(pileup == k).sum(0) for k in range(6)], 0)
+        sub = min(cols, 4096)
+        expect = bytes(
+            best_char_from_counts(counts_np[:, c],
+                                  int(counts_np[:, c].sum()))
+            for c in range(sub))
+        if got_chars[:sub] != expect:
+            return _fail("consensus_parity")
+        cpu_rate = 0.0
     return _emit("pileup_bases_per_sec_per_chip", rate, "bases/s",
                  rate / cpu_rate if cpu_rate else 0.0)
 
@@ -382,9 +458,12 @@ def cfg5_longread() -> int:
 
 def main() -> int:
     cfg = os.environ.get("PWASM_BENCH_CONFIG", "2")
-    return {"1": cfg1_cli_cpu_ref, "2": cfg2_batched_dp,
-            "3": cfg3_many2many, "4": cfg4_consensus,
-            "5": cfg5_longread}[cfg]()
+    configs = {"1": cfg1_cli_cpu_ref, "2": cfg2_batched_dp,
+               "3": cfg3_many2many, "4": cfg4_consensus,
+               "5": cfg5_longread}
+    if cfg not in configs:
+        return _fail(f"unknown_bench_config_{cfg}")
+    return configs[cfg]()
 
 
 if __name__ == "__main__":

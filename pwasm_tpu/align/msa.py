@@ -353,10 +353,18 @@ class Msa:
         self.build_msa()
         cols = self.msacolumns
         votes = self.device_votes() if device else None
+        if votes is None:
+            # native single-core vote over the whole live window when
+            # available (bit-exact with best_char_from_counts; parity
+            # covered by tests/test_native.py)
+            from pwasm_tpu.native import consensus_vote_counts
+            span = slice(cols.mincol, cols.maxcol + 1)
+            votes = consensus_vote_counts(cols.counts[span],
+                                          cols.layers[span])
         cols_removed = 0
         consensus = bytearray()
         for col in range(cols.mincol, cols.maxcol + 1):
-            c = int(votes[col - cols.mincol]) if device \
+            c = int(votes[col - cols.mincol]) if votes is not None \
                 else cols.best_char(col)
             if c == 0:
                 self._err_zero_cov(col)

@@ -39,6 +39,19 @@ class FastaFile:
         self._build_index()
 
     def _build_index(self) -> None:
+        # native streaming indexer when available (C++ one-pass scan,
+        # bit-identical entries — parity enforced by tests/test_native.py)
+        from pwasm_tpu.native import fasta_index
+        try:
+            entries = fasta_index(self.path)
+        except OSError:
+            entries = None  # fall through to the Python reader's error
+        if entries is not None:
+            for name, seqlen, start, end in entries:
+                self._add(name, seqlen, start, end)
+            if not self._index:
+                raise PwasmError(f"Error: invalid FASTA file {self.path} !")
+            return
         name = None
         seqlen = 0
         seq_start = 0
@@ -86,6 +99,13 @@ class FastaFile:
         ent = self._index.get(name)
         if ent is None:
             return None
+        from pwasm_tpu.native import fasta_fetch
+        try:
+            raw_n = fasta_fetch(self.path, ent.offset, ent.end)
+        except OSError:
+            raw_n = None
+        if raw_n is not None:
+            return raw_n
         with open(self.path, "rb") as f:
             f.seek(ent.offset)
             raw = f.read(ent.end - ent.offset)

@@ -78,6 +78,25 @@ def get_lib():
         lib.pw_extract.restype = ctypes.c_int
         lib.pw_banded_gotoh.restype = ctypes.c_int32
         lib.pw_banded_gotoh_batch.restype = None
+        lib.pw_consensus_vote.restype = None
+        lib.pw_consensus_vote_counts.restype = None
+        lib.pw_fasta_index.restype = ctypes.c_int64
+        lib.pw_fasta_index.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64]
+        lib.pw_fasta_fetch.restype = ctypes.c_int64
+        lib.pw_fasta_fetch.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p]
+        lib.pw_encode_codes.restype = None
+        lib.pw_encode_codes.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+        lib.pw_pack_2bit.restype = None
+        lib.pw_pack_2bit.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+        lib.pw_unpack_2bit.restype = None
+        lib.pw_unpack_2bit.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
         _lib = lib
     return _lib
 
@@ -224,3 +243,124 @@ def banded_gotoh_batch(q_codes: np.ndarray, ts_codes: np.ndarray,
     return out
 
 
+
+
+def consensus_vote_pileup(pileup: np.ndarray) -> np.ndarray | None:
+    """Single-core C++ consensus vote over a (depth, cols) int8 pileup;
+    returns (cols,) uint8 consensus chars ('-' for gap columns, 0 for
+    zero coverage), or None if the native library is unavailable.
+    Bit-exact with pwasm_tpu.align.msa.best_char_from_counts."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    p = np.ascontiguousarray(pileup, dtype=np.int8)
+    depth, cols = p.shape
+    out = np.empty(cols, dtype=np.uint8)
+    lib.pw_consensus_vote(p.ctypes.data_as(ctypes.c_void_p), depth, cols,
+                          out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+def consensus_vote_counts(counts: np.ndarray,
+                          layers: np.ndarray) -> np.ndarray | None:
+    """Native column vote over an already-accumulated (cols, 6) int32
+    count tensor (the MSA engine's pileup format); None when the native
+    library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    c = np.ascontiguousarray(counts, dtype=np.int32)
+    la = np.ascontiguousarray(layers, dtype=np.int32)
+    cols = c.shape[0]
+    out = np.empty(cols, dtype=np.uint8)
+    lib.pw_consensus_vote_counts(c.ctypes.data_as(ctypes.c_void_p),
+                                 la.ctypes.data_as(ctypes.c_void_p),
+                                 cols, out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+def fasta_index(path: str) -> list[tuple[str, int, int, int]] | None:
+    """Native streaming FASTA index build: one pass over the file.
+
+    Returns [(name, seqlen, seq_start, end), ...] in file order
+    (duplicates NOT removed — the caller keeps the first, matching the
+    Python indexer), or None when the native library is unavailable.
+    Raises OSError if the file can't be opened.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    ent_cap, arena_cap = 1024, 1 << 16
+    for _ in range(8):
+        entries = np.empty(ent_cap * 5, dtype=np.int64)
+        arena = np.empty(arena_cap, dtype=np.uint8)
+        n = lib.pw_fasta_index(
+            os.fsencode(path), entries.ctypes.data_as(ctypes.c_void_p),
+            ent_cap, arena.ctypes.data_as(ctypes.c_void_p), arena_cap)
+        if n == -1:
+            raise OSError(f"cannot open FASTA file {path}")
+        if n < -1:  # capacity overflow: -(2 + needed_records)
+            need = -(n + 2)
+            ent_cap = max(ent_cap * 4, need + 16)
+            arena_cap *= 4
+            continue
+        ab = arena.tobytes()
+        out = []
+        for k in range(int(n)):
+            noff, nlen, seqlen, start, end = (
+                int(x) for x in entries[k * 5:(k + 1) * 5])
+            out.append((ab[noff:noff + nlen].decode(), seqlen, start, end))
+        return out
+    raise OSError(f"FASTA index buffers exhausted for {path}")
+
+
+def fasta_fetch(path: str, seq_start: int, end: int) -> bytes | None:
+    """Native range fetch with whitespace stripping; None when the native
+    library is unavailable.  Raises OSError on IO failure."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    buf = np.empty(max(end - seq_start, 1), dtype=np.uint8)
+    n = lib.pw_fasta_fetch(os.fsencode(path), seq_start, end,
+                           buf.ctypes.data_as(ctypes.c_void_p))
+    if n < 0:
+        raise OSError(f"cannot read FASTA file {path}")
+    return buf[:n].tobytes()
+
+
+def encode_codes(seq: bytes) -> np.ndarray | None:
+    """Native byte-sequence -> int8 base-code encoding (twin of
+    pwasm_tpu.core.dna.encode); None when unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    s = np.frombuffer(bytes(seq), dtype=np.uint8)
+    out = np.empty(len(s), dtype=np.int8)
+    lib.pw_encode_codes(s.ctypes.data_as(ctypes.c_void_p), len(s),
+                        out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+def pack_2bit(codes: np.ndarray) -> np.ndarray | None:
+    """Pack int8 base codes (0..3) into 2-bit form, 4 per byte
+    (little-endian within the byte); None when unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    c = np.ascontiguousarray(codes, dtype=np.int8)
+    out = np.empty((len(c) + 3) // 4, dtype=np.uint8)
+    lib.pw_pack_2bit(c.ctypes.data_as(ctypes.c_void_p), len(c),
+                     out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+def unpack_2bit(packed: np.ndarray, n: int) -> np.ndarray | None:
+    """Inverse of pack_2bit; None when unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    p = np.ascontiguousarray(packed, dtype=np.uint8)
+    out = np.empty(n, dtype=np.int8)
+    lib.pw_unpack_2bit(p.ctypes.data_as(ctypes.c_void_p), n,
+                       out.ctypes.data_as(ctypes.c_void_p))
+    return out

@@ -394,4 +394,197 @@ void pw_banded_gotoh_batch(const int8_t* q, int32_t m,
   }
 }
 
+// Single-core consensus vote — the honest CPU baseline for the TPU
+// consensus kernel and the native fast path of the MSA engine's column
+// vote.  Implements bestChar's stable-sort + '-'/'N'-yield rule
+// (GapAssem.cpp:1048-1069, quirk SURVEY.md §2.5.10) in the same closed
+// form as pwasm_tpu/align/msa.py best_char_from_counts: if any of
+// A/C/G/T reaches the max count the first of them wins; else '-' wins a
+// N/'-' tie; else whichever of N/'-' holds the max.  Zero coverage -> 0.
+static inline uint8_t vote_from_counts(const int32_t* c, int32_t layers) {
+  if (layers == 0) return 0;
+  int32_t m = c[0];
+  for (int k = 1; k < 6; ++k)
+    if (c[k] > m) m = c[k];
+  static const char nuc[4] = {'A', 'C', 'G', 'T'};
+  for (int k = 0; k < 4; ++k)
+    if (c[k] == m) return (uint8_t)nuc[k];
+  if (c[4] == m && c[5] == m) return '-';
+  return (c[4] == m) ? 'N' : '-';
+}
+
+// Pileup variant: (depth, cols) int8 base codes, 0..5 = A C G T N gap;
+// codes outside 0..5 contribute nothing (padding).
+void pw_consensus_vote(const int8_t* pileup, int32_t depth, int32_t cols,
+                       uint8_t* out) {
+  std::vector<int32_t> counts((size_t)cols * 6, 0);
+  for (int32_t d = 0; d < depth; ++d) {
+    const int8_t* row = pileup + (size_t)d * cols;
+    for (int32_t c = 0; c < cols; ++c) {
+      int8_t v = row[c];
+      if (v >= 0 && v < 6) counts[(size_t)c * 6 + v]++;
+    }
+  }
+  for (int32_t c = 0; c < cols; ++c) {
+    const int32_t* cc = &counts[(size_t)c * 6];
+    int32_t layers = cc[0] + cc[1] + cc[2] + cc[3] + cc[4] + cc[5];
+    out[c] = vote_from_counts(cc, layers);
+  }
+}
+
+// Counts variant for the MSA engine (counts already accumulated):
+// counts is (cols, 6) int32, layers (cols,) int32.
+void pw_consensus_vote_counts(const int32_t* counts, const int32_t* layers,
+                              int32_t cols, uint8_t* out) {
+  for (int32_t c = 0; c < cols; ++c)
+    out[c] = vote_from_counts(counts + (size_t)c * 6, layers[c]);
+}
+
+// ---------------------------------------------------------------------------
+// FASTA faidx-style index + fetch + base-code packing (SURVEY.md §2.4.2,
+// the gclib GFastaIndex/GFaSeqGet capability, pafreport.cpp:255,346).
+// ---------------------------------------------------------------------------
+
+// Streaming index build: one pass over the file, recording for every
+// record its id, sequence length (whitespace excluded — exactly the bytes
+// a fetch returns), first-sequence-byte offset and one-past-end offset.
+// Duplicate ids keep the FIRST record (dict-insert semantics of the
+// Python FastaFile; dedup is done by the Python wrapper which sees
+// names).  Entry layout: 5 int64 per record
+//   [name_off, name_len, seqlen, seq_start, end]
+// with names concatenated into name_arena.  Returns the record count,
+// -1 on open failure, or -(2 + needed_records) when ent_cap/arena_cap is
+// too small (caller grows and retries).
+int64_t pw_fasta_index(const char* path, int64_t* entries, int64_t ent_cap,
+                       uint8_t* name_arena, int64_t arena_cap) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  std::vector<char> buf(1 << 20);
+  int64_t nrec = 0, arena_used = 0, pos = 0;
+  int64_t seqlen = 0, seq_start = 0;
+  bool have_rec = false, overflow = false;
+  bool at_line_start = true, in_header = false, header_name_done = false;
+  std::string name;
+  auto flush_rec = [&](int64_t end_pos) {
+    if (!have_rec) return;
+    if (in_header) {  // header line hit EOF with no newline: empty seq
+      seq_start = end_pos;
+      seqlen = 0;
+    }
+    if (nrec < ent_cap &&
+        arena_used + (int64_t)name.size() <= arena_cap) {
+      int64_t* e = entries + nrec * 5;
+      e[0] = arena_used;
+      e[1] = (int64_t)name.size();
+      e[2] = seqlen;
+      e[3] = seq_start;
+      e[4] = end_pos;
+      memcpy(name_arena + arena_used, name.data(), name.size());
+      arena_used += (int64_t)name.size();
+    } else {
+      overflow = true;
+    }
+    ++nrec;
+  };
+  size_t got;
+  while ((got = fread(buf.data(), 1, buf.size(), f)) > 0) {
+    for (size_t i = 0; i < got; ++i) {
+      char c = buf[i];
+      if (at_line_start && c == '>') {
+        flush_rec(pos);
+        have_rec = true;
+        name.clear();
+        seqlen = 0;
+        in_header = true;
+        header_name_done = false;
+        at_line_start = false;
+        ++pos;
+        continue;
+      }
+      if (in_header) {
+        if (c == '\n') {
+          in_header = false;
+          at_line_start = true;
+          seq_start = pos + 1;
+        } else if (!header_name_done) {
+          if (isspace((unsigned char)c)) {
+            if (!name.empty()) header_name_done = true;
+          } else {
+            name.push_back(c);
+          }
+        }
+      } else {
+        at_line_start = (c == '\n');
+        if (have_rec && !isspace((unsigned char)c)) ++seqlen;
+      }
+      ++pos;
+    }
+  }
+  flush_rec(pos);
+  fclose(f);
+  if (overflow) return -(2 + nrec);
+  return nrec;
+}
+
+// Fetch [seq_start, end) and strip ALL whitespace in place; returns the
+// stripped length, or -1 on IO failure.  out must hold end - seq_start.
+int64_t pw_fasta_fetch(const char* path, int64_t seq_start, int64_t end,
+                       uint8_t* out) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  if (fseeko(f, (off_t)seq_start, SEEK_SET) != 0) { fclose(f); return -1; }
+  int64_t want = end - seq_start;
+  int64_t got = (int64_t)fread(out, 1, (size_t)want, f);
+  fclose(f);
+  int64_t w = 0;
+  for (int64_t i = 0; i < got; ++i) {
+    uint8_t c = out[i];
+    if (!isspace(c)) out[w++] = c;
+  }
+  return w;
+}
+
+// Byte sequence -> int8 base codes (A0 C1 G2 T3 N4 gap5, U=T, case
+// folded) — the native twin of pwasm_tpu.core.dna.encode.  The lookup
+// table is built once at load time (ctypes calls release the GIL, so a
+// lazily-initialized static would race).
+static const struct EncTbl {
+  int8_t t[256];
+  EncTbl() {
+    for (int i = 0; i < 256; ++i) t[i] = 4;  // N
+    const char* bases = "ACGT";
+    for (int k = 0; k < 4; ++k) {
+      t[(unsigned char)bases[k]] = (int8_t)k;
+      t[(unsigned char)tolower(bases[k])] = (int8_t)k;
+    }
+    t['U'] = 3; t['u'] = 3;
+    t['-'] = 5; t['*'] = 5;
+  }
+} kEncTbl;
+
+void pw_encode_codes(const uint8_t* seq, int64_t n, int8_t* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = kEncTbl.t[seq[i]];
+}
+
+// Pack int8 base codes (must be 0..3; callers map N/gap beforehand) into
+// 2-bit form, 4 codes per byte, little-endian within the byte.  Length of
+// out is ceil(n/4); trailing slots pad with 0.
+void pw_pack_2bit(const int8_t* codes, int64_t n, uint8_t* out) {
+  int64_t nb = (n + 3) / 4;
+  for (int64_t b = 0; b < nb; ++b) {
+    uint8_t v = 0;
+    for (int k = 0; k < 4; ++k) {
+      int64_t i = b * 4 + k;
+      if (i < n) v |= (uint8_t)((codes[i] & 3) << (2 * k));
+    }
+    out[b] = v;
+  }
+}
+
+// Unpack 2-bit form back to int8 codes.
+void pw_unpack_2bit(const uint8_t* packed, int64_t n, int8_t* out) {
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = (int8_t)((packed[i / 4] >> (2 * (i % 4))) & 3);
+}
+
 }  // extern "C"

@@ -1,0 +1,79 @@
+"""2-bit sequence packing for host->device transfer.
+
+The north-star kernel operates on "packed 2-bit sequences" (SURVEY.md §0):
+A/C/G/T fit in 2 bits, so a target batch ships to the device at a quarter
+of the int8 size — which matters when the link to the chip is thin (PCIe,
+or the tunneled transport in this environment).  Packing runs in the
+native C++ core (pwasm_tpu/native/fastparse.cpp pw_pack_2bit, numpy
+fallback here), unpacking runs on device as a fused shift/mask that XLA
+folds into the kernel's own preprocessing.
+
+Padding note: packed batches carry no sentinel — padding columns decode
+to base 0 ('A').  That is safe for the banded DP score: cell (i, j)
+depends only on columns <= j (diag j-1, up j, left-chain < j), so cells
+beyond a target's true length can never reach the score extracted at
+(m, t_len).  The unpacked-path sentinel (127) is therefore unnecessary
+for scoring; tests assert bit-exactness between the two paths.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def pack_targets(ts_codes: np.ndarray) -> np.ndarray:
+    """Pack a (T, n) int8 base-code batch into (T, ceil(n/4)) uint8.
+
+    Codes outside 0..3 pack as base 0 ('A').  For PADDING (beyond each
+    row's t_len) that cannot change scores (module docstring); for N
+    bases INSIDE the aligned span it would — an N never matches in the
+    int8 path but 'A' can.  Callers with N-bearing targets must keep the
+    int8 path; the packed path is the fast transfer format for the
+    ACGT-only common case (enforced here with a cheap check).
+    """
+    from pwasm_tpu.native import pack_2bit
+
+    ts = np.ascontiguousarray(ts_codes, dtype=np.int8)
+    T, n = ts.shape
+    if ((ts >= 4) & (ts <= 6)).any():
+        raise ValueError(
+            "pack_targets: batch contains N/gap codes inside rows; "
+            "2-bit packing would alias them to 'A' — use the int8 path")
+    nb = (n + 3) // 4
+    if n % 4:
+        ts = np.pad(ts, ((0, 0), (0, 4 * nb - n)))
+    packed = pack_2bit(ts.reshape(-1))  # rows stay byte-aligned: 4 | row
+    if packed is None:  # numpy fallback
+        flat = (ts.reshape(-1).astype(np.uint8) & 3).reshape(-1, 4)
+        packed = (flat[:, 0] | (flat[:, 1] << 2) | (flat[:, 2] << 4)
+                  | (flat[:, 3] << 6)).astype(np.uint8)
+    return packed.reshape(T, nb)
+
+
+def unpack_targets_device(packed: jax.Array, n: int) -> jax.Array:
+    """Device-side inverse: (T, nb) uint8 -> (T, n) int8 codes in 0..3.
+    Pure shift/mask ops — XLA fuses this into downstream preprocessing."""
+    shifts = jnp.array([0, 2, 4, 6], dtype=jnp.uint8)
+    c = (packed[:, :, None] >> shifts[None, None, :]) & jnp.uint8(3)
+    T, nb = packed.shape
+    return c.reshape(T, nb * 4)[:, :n].astype(jnp.int8)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n", "band", "params", "block_t"))
+def banded_scores_packed(q: jax.Array, ts_packed: jax.Array, n: int,
+                         t_lens: jax.Array, band: int = 64,
+                         params=None, block_t: int = 128) -> jax.Array:
+    """Banded DP scores from a 2-bit-packed target batch: unpack on
+    device, then the Pallas wavefront kernel.  Bit-exact with
+    ``banded_scores_pallas`` on the unpacked codes."""
+    from pwasm_tpu.ops.banded_dp import ScoreParams, banded_scores_pallas
+
+    params = params or ScoreParams()
+    ts = unpack_targets_device(ts_packed, n)
+    return banded_scores_pallas(q, ts, t_lens, band=band, params=params,
+                                block_t=block_t)

@@ -191,3 +191,57 @@ def test_long_kernel_single_chunk():
     expect = np.asarray(banded_scores_batch(
         jnp.asarray(q), jnp.asarray(ts), jnp.asarray(t_lens), band=band))
     np.testing.assert_array_equal(got, expect)
+
+
+def test_numpy_banded_gotoh_bench_fallback_matches():
+    # bench.py's nativeless parity reference must agree with the jax path
+    import bench as B
+
+    rng = np.random.default_rng(21)
+    m, n, band = 40, 48, 16
+    params = ScoreParams()
+    dlo = band_dlo(m, n, band)
+    q = rng.integers(0, 4, size=m).astype(np.int8)
+    for _ in range(5):
+        t_len = int(rng.integers(m - 4, n + 1))
+        t = np.full(n, 127, dtype=np.int8)
+        t[:t_len] = rng.integers(0, 4, size=t_len)
+        expect = int(np.asarray(banded_score(
+            jnp.asarray(q), jnp.asarray(t), jnp.int32(t_len), band=band)))
+        got = B._numpy_banded_gotoh(q, t, t_len, band, dlo, params)
+        assert got == expect
+
+
+def test_packed_scores_match_unpacked():
+    from pwasm_tpu.ops.pack import (banded_scores_packed, pack_targets,
+                                    unpack_targets_device)
+
+    rng = np.random.default_rng(22)
+    m, n, band, T = 32, 40, 16, 9
+    q = rng.integers(0, 4, size=m).astype(np.int8)
+    ts = np.full((T, n), 127, dtype=np.int8)
+    t_lens = np.zeros(T, dtype=np.int32)
+    for k in range(T):
+        t_len = int(rng.integers(m - 4, n + 1))
+        ts[k, :t_len] = rng.integers(0, 4, size=t_len)
+        t_lens[k] = t_len
+    packed = pack_targets(np.where(ts == 127, 0, ts))
+    assert packed.shape == (T, n // 4)
+    # device unpack restores codes (pad positions become 0, harmless)
+    codes = np.asarray(unpack_targets_device(jnp.asarray(packed), n))
+    np.testing.assert_array_equal(
+        codes, np.where(ts == 127, 0, ts))
+    got = np.asarray(banded_scores_packed(
+        jnp.asarray(q), jnp.asarray(packed), n, jnp.asarray(t_lens),
+        band=band))
+    expect = np.asarray(banded_scores_batch(
+        jnp.asarray(q), jnp.asarray(ts), jnp.asarray(t_lens), band=band))
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_pack_targets_rejects_n_codes():
+    from pwasm_tpu.ops.pack import pack_targets
+
+    bad = np.array([[0, 1, 4, 2]], dtype=np.int8)  # an N inside the row
+    with pytest.raises(ValueError):
+        pack_targets(bad)
