@@ -47,6 +47,12 @@ USAGE = """Usage:
    --cons=FILE write the consensus sequence as FASTA
    --remove-cons-gaps  drop all-gap consensus columns during refinement
    --no-refine-clip    skip the X-drop clipping refinement pass
+   --skip-bad-lines    warn and continue on malformed PAF lines
+   --resume    append to an existing -o report, skipping alignments
+               already emitted (a -s summary then covers only the
+               resumed portion)
+   --profile=DIR  write a jax.profiler device trace for the run
+   --stats=FILE   write run statistics as one JSON object
 """
 
 # reference optstring: "DGFCNvd:p:r:o:m:w:c:s:" — -d/-p/-m take a value but
@@ -169,8 +175,45 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
                 raise PwasmError(f"Cannot open input file {infile}!\n")
         if "c" in opts:
             cfg.clipmax = _parse_clipmax(str(opts["c"]), cfg.verbose)
+        cfg.skip_bad_lines = bool(opts.get("skip-bad-lines"))
+        cfg.resume = bool(opts.get("resume"))
+        for kind in ("profile", "stats"):
+            if opts.get(kind) is True:
+                raise CliError(
+                    f"{USAGE}\n--{kind} requires a file argument\n")
+        if "profile" in opts:
+            cfg.profile_dir = str(opts["profile"])
+        if "stats" in opts:
+            cfg.stats_path = str(opts["stats"])
+        resume_skip = 0
+        if cfg.resume:
+            if "o" not in opts:
+                raise CliError(f"{USAGE}\n--resume requires -o <report>\n")
+            # The report is per-alignment independent in report mode:
+            # resume = drop the LAST record (its event rows may be torn
+            # by the interruption — a header alone doesn't prove the rows
+            # landed), truncate there, count the surviving headers, and
+            # skip that many accepted alignments (SURVEY.md §5
+            # checkpoint/resume).  The dropped record is re-emitted.
+            try:
+                with open(str(opts["o"]), "rb") as f:
+                    body = f.read()
+                if body.startswith(b">"):
+                    last = body.rfind(b"\n>")
+                    keep = last + 1 if last != -1 else 0
+                else:
+                    keep = 0  # not a report produced by this tool
+                kept = body[:keep]
+                resume_skip = kept.count(b"\n>") + \
+                    (1 if kept.startswith(b">") else 0)
+                if keep != len(body):
+                    with open(str(opts["o"]), "ab") as f:
+                        f.truncate(keep)
+            except OSError:
+                resume_skip = 0  # nothing emitted yet: a fresh run
         try:
-            freport = open(str(opts["o"]), "w") if "o" in opts else stdout
+            mode = "a" if cfg.resume else "w"
+            freport = open(str(opts["o"]), mode) if "o" in opts else stdout
         except OSError:
             raise PwasmError(
                 f"Cannot open file {opts['o']} for writing!\n")
@@ -224,8 +267,11 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
                 f"Cannot open file {opts['s']} for writing!\n")
         summary = Summary() if fsummary else None
 
-        return _main_loop(cfg, inf, freport, fmsa, fsummary, summary,
-                          qfasta, stdout, stderr, cons_outs)
+        from pwasm_tpu.utils import device_trace
+        with device_trace(cfg.profile_dir, stderr):
+            return _main_loop(cfg, inf, freport, fmsa, fsummary, summary,
+                              qfasta, stdout, stderr, cons_outs,
+                              resume_skip=resume_skip)
     except PwasmError as e:
         stderr.write(str(e))
         return e.exit_code
@@ -236,10 +282,14 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
 
 def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                qfasta: FastaFile, stdout, stderr,
-               cons_outs: dict | None = None) -> int:
+               cons_outs: dict | None = None,
+               resume_skip: int = 0) -> int:
     """The per-PAF-line loop (pafreport.cpp:296-460)."""
     from pwasm_tpu.align.gapseq import FLAG_IS_REF, GapSeq
     from pwasm_tpu.align.msa import Msa
+    from pwasm_tpu.utils import RunStats
+
+    stats = RunStats()
 
     alnpairs: dict[str, int] = {}   # gene-mode (query~target) dedup counts
     ref_cache: dict[str, bytes] = {}
@@ -267,30 +317,56 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         batch, pending[:] = pending[:], []
         print_diff_info_batch(batch, freport, skip_codan=cfg.skip_codan,
                               motifs=cfg.motifs, summary=summary)
+        stats.device_batches += 1
 
     try:
+        file_line = 0
         for line in inf:
+            file_line += 1
             line = line.rstrip("\n")
             if not line or line.startswith("#"):
                 continue
-            rec = parse_paf_line(line)
+            stats.lines += 1
+            try:
+                rec = parse_paf_line(line)
+            except PwasmError:
+                if not cfg.skip_bad_lines:
+                    raise
+                stats.skipped_bad += 1
+                print(f"Warning: skipping malformed PAF line "
+                      f"{file_line}", file=stderr)
+                continue
             al: AlnInfo = rec.alninfo
             if al.r_id == al.t_id:
+                stats.skipped_self += 1
                 if cfg.verbose:
                     print("Skipping alignment of qry seq to itself.",
                           file=stderr)
                 continue
+            new_pair = None
             if not cfg.fullgenome:  # gene CDS mode: first q~t alignment only
                 key = f"{al.r_id}~{al.t_id}"
                 if key not in alnpairs:
                     alnpairs[key] = 0
+                    new_pair = key
                 else:
                     alnpairs[key] += 1
+                    stats.skipped_dedup += 1
                     if alnpairs[key] == 1:
                         print(f"Warning: alignment {al.r_id} to {al.t_id} "
                               f"already seen, ignoring ", file=stderr)
                     continue
             numalns += 1
+            if (freport is not None and not build_msa_out
+                    and stats.resumed_past < resume_skip):
+                # --resume fast path: this alignment is already in the
+                # report; advance the cursor on parse-level info alone
+                # (no refseq fetch, no extraction), so resume cost scales
+                # with the REMAINING work (SURVEY.md §5)
+                stats.resumed_past += 1
+                stats.alignments += 1
+                stats.aligned_bases += al.t_alnend - al.t_alnstart
+                continue
             if refseq_id is None or refseq_id != al.r_id:
                 if al.r_id in ref_cache:
                     refseq = ref_cache[al.r_id]
@@ -310,7 +386,23 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                     f"Error: ref seq len in this PAF line ({al.r_len}) differs "
                     f"from loaded sequence length({len(refseq)})!\n{line}\n")
             refseq_aln = refseq_rc if al.reverse else refseq
-            aln = extract_alignment(rec, refseq_aln)
+            try:
+                aln = extract_alignment(rec, refseq_aln)
+            except PwasmError:
+                if not cfg.skip_bad_lines:
+                    raise
+                numalns -= 1
+                if new_pair is not None:
+                    # a skipped line must not make later valid alignments
+                    # of the same (q,t) pair look like duplicates
+                    del alnpairs[new_pair]
+                stats.skipped_bad += 1
+                print(f"Warning: skipping malformed PAF line "
+                      f"{file_line}", file=stderr)
+                continue
+            stats.alignments += 1
+            stats.aligned_bases += al.t_alnend - al.t_alnstart
+            stats.events += len(aln.tdiffs)
             tlabel = f"{al.t_id}:{al.t_alnstart}-{al.t_alnend}" \
                 + ("-" if al.reverse else "+")
             rlabel = al.r_id
@@ -319,7 +411,11 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
             if freport is not None:
                 if len(qfasta) == 1 and not cfg.fullgenome:
                     rlabel = ""
-                if use_device:
+                if stats.resumed_past < resume_skip:
+                    # --resume cursor: this alignment's rows are already
+                    # in the report from the interrupted run
+                    stats.resumed_past += 1
+                elif use_device:
                     pending.append((aln, rlabel, tlabel, refseq))
                     if len(pending) >= cfg.batch:
                         flush_pending()
@@ -385,6 +481,15 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         fsummary.close()
     if freport not in (stdout, None):
         freport.close()
+    if cfg.stats_path:
+        try:
+            with open(cfg.stats_path, "w") as f:
+                stats.write(f)
+        except OSError:
+            raise PwasmError(
+                f"Cannot open file {cfg.stats_path} for writing!\n")
+    if cfg.verbose:
+        print(stats.brief(), file=stderr)
     return 0
 
 

@@ -34,6 +34,12 @@ class Config:
     band: int = 64                  # banded-DP band width
     batch: int = 256                # device batch size
 
+    # run-control / observability knobs (SURVEY.md §5; no ref equivalent)
+    skip_bad_lines: bool = False    # warn + continue on malformed lines
+    resume: bool = False            # append to -o, skipping emitted alns
+    profile_dir: str = ""           # jax.profiler trace output directory
+    stats_path: str = ""            # write run-stats JSON here
+
 
 def load_motifs(path: str) -> tuple[str, ...]:
     """Load a motif table: one motif per line, '#' comments allowed."""

@@ -1,0 +1,13 @@
+"""Observability and run-control utilities (SURVEY.md §5).
+
+The reference has none of this — its only observability is ``-v``
+stderr messages and the ``-D`` layout dump, its failure model is
+fail-fast ``GError``/exit, and there is no checkpoint/resume
+(pafreport.cpp:296-460 is a single streaming pass).  The new framework
+adds the subsystems §5 calls for: structured run stats, device trace
+hooks, a resumable report cursor, and batch-level bad-line skipping
+(the latter two live in pwasm_tpu/cli.py).
+"""
+
+from pwasm_tpu.utils.runstats import RunStats  # noqa: F401
+from pwasm_tpu.utils.profiling import device_trace  # noqa: F401

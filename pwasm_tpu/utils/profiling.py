@@ -1,0 +1,29 @@
+"""Device trace hooks (SURVEY.md §5 tracing/profiling).
+
+``device_trace(dir)`` wraps a region in a ``jax.profiler`` trace when a
+directory is given: the dump is viewable in TensorBoard/Perfetto and
+covers every device program launched inside (the batched analysis
+kernels under ``--device=tpu``).  With no directory it is a no-op and
+jax is never imported — the CPU path stays jax-free.
+"""
+
+from __future__ import annotations
+
+import sys
+from contextlib import contextmanager
+
+
+@contextmanager
+def device_trace(profile_dir: str | None, stderr=None):
+    if not profile_dir:
+        yield
+        return
+    stderr = stderr or sys.stderr
+    import jax
+
+    jax.profiler.start_trace(profile_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        print(f"device trace written to {profile_dir}", file=stderr)

@@ -1,0 +1,64 @@
+"""Structured per-run statistics.
+
+Implements the observability the reference gestures at but never ships:
+its documented ``-s`` summary is parsed and dropped (pafreport.cpp:20,274,
+quirk SURVEY.md §2.5.1), and there are no throughput counters anywhere.
+``RunStats`` tracks the run-level counters (alignments, skipped lines,
+aligned bases, wall time) and writes one JSON object; the per-event
+`Summary` (pwasm_tpu.report.diff_report) remains the -s payload.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import IO
+
+
+class RunStats:
+    def __init__(self) -> None:
+        self.t0 = time.perf_counter()
+        self.lines = 0            # PAF lines seen (non-blank, non-comment)
+        self.alignments = 0       # alignments accepted for analysis
+        self.skipped_bad = 0      # lines dropped by --skip-bad-lines
+        self.skipped_dedup = 0    # gene-mode duplicate (q,t) pairs
+        self.skipped_self = 0     # query==target self alignments
+        self.resumed_past = 0     # alignments skipped by --resume
+        self.aligned_bases = 0    # sum of per-alignment target span
+        self.events = 0           # diff events reported
+        self.device_batches = 0   # device flushes (--device=tpu)
+
+    @property
+    def wall_s(self) -> float:
+        return time.perf_counter() - self.t0
+
+    def rate(self) -> float:
+        """Aligned target bases per second of wall clock."""
+        dt = self.wall_s
+        return self.aligned_bases / dt if dt > 0 else 0.0
+
+    def as_dict(self) -> dict:
+        return {
+            "lines": self.lines,
+            "alignments": self.alignments,
+            "skipped_bad_lines": self.skipped_bad,
+            "skipped_duplicates": self.skipped_dedup,
+            "skipped_self": self.skipped_self,
+            "resumed_past": self.resumed_past,
+            "aligned_bases": self.aligned_bases,
+            "events": self.events,
+            "device_batches": self.device_batches,
+            "wall_s": round(self.wall_s, 3),
+            "aligned_bases_per_s": round(self.rate(), 1),
+        }
+
+    def write(self, f: IO[str]) -> None:
+        json.dump(self.as_dict(), f)
+        f.write("\n")
+
+    def brief(self) -> str:
+        """One human line for -v stderr output."""
+        d = self.as_dict()
+        return (f"{d['alignments']} alignments, {d['events']} events, "
+                f"{d['aligned_bases']} aligned bases in {d['wall_s']}s "
+                f"({d['aligned_bases_per_s']:.0f} bases/s)")

@@ -272,3 +272,107 @@ def test_cons_requires_gene_mode(tmp_path):
     err = io.StringIO()
     assert run([paf, "-r", fa, "--ace"], stderr=err) == 1
     assert "--ace requires a file argument" in err.getvalue()
+
+
+def test_skip_bad_lines(tmp_path):
+    lines = _three_alignments()
+    lines.insert(1, "not\ta\tpaf\tline")                  # too few fields
+    l_badcs, _ = make_paf_line("q", Q, "asmX", "+", [("=", 10)])
+    # corrupt the cs tag so extraction fails on base mismatch
+    lines.insert(3, l_badcs.replace("cs:Z::10", "cs:Z::4*gc:5"))
+    paf, fa = _mk_inputs(tmp_path, lines)
+    report = tmp_path / "out.dfa"
+    err = io.StringIO()
+    # without the flag: fatal parse error, exit code 3
+    rc = run([paf, "-r", fa, "-o", str(report)], stderr=err)
+    assert rc != 0
+    # with the flag: bad lines skipped with warnings, good ones reported
+    err = io.StringIO()
+    stats = tmp_path / "stats.json"
+    rc = run([paf, "-r", fa, "-o", str(report), "--skip-bad-lines",
+              f"--stats={stats}"], stderr=err)
+    assert rc == 0
+    rep = report.read_text()
+    assert rep.count(">") == 3
+    assert err.getvalue().count("skipping malformed PAF line") == 2
+    import json
+    st = json.loads(stats.read_text())
+    assert st["skipped_bad_lines"] == 2
+    assert st["alignments"] == 3
+    assert st["aligned_bases"] > 0
+
+
+def test_resume_appends_remaining_alignments(tmp_path):
+    lines = _three_alignments()
+    paf, fa = _mk_inputs(tmp_path, lines)
+    full = tmp_path / "full.dfa"
+    assert run([paf, "-r", fa, "-o", str(full)], stderr=io.StringIO()) == 0
+
+    # simulate an interrupted run: only the first alignment was emitted
+    part = tmp_path / "part.dfa"
+    paf1 = tmp_path / "first.paf"
+    paf1.write_text(lines[0] + "\n")
+    assert run([str(paf1), "-r", fa, "-o", str(part)],
+               stderr=io.StringIO()) == 0
+    # resume over the full input appends exactly the missing alignments
+    assert run([paf, "-r", fa, "-o", str(part), "--resume"],
+               stderr=io.StringIO()) == 0
+    assert part.read_text() == full.read_text()
+    # resuming a complete report is a no-op
+    assert run([paf, "-r", fa, "-o", str(part), "--resume"],
+               stderr=io.StringIO()) == 0
+    assert part.read_text() == full.read_text()
+
+
+def test_resume_requires_report(tmp_path):
+    paf, fa = _mk_inputs(tmp_path, _three_alignments())
+    err = io.StringIO()
+    assert run([paf, "-r", fa, "--resume"], stderr=err) != 0
+
+
+def test_stats_and_profile_flags(tmp_path):
+    paf, fa = _mk_inputs(tmp_path, _three_alignments())
+    report = tmp_path / "out.dfa"
+    stats = tmp_path / "stats.json"
+    err = io.StringIO()
+    rc = run([paf, "-r", fa, "-o", str(report), f"--stats={stats}", "-v"],
+             stderr=err)
+    assert rc == 0
+    import json
+    st = json.loads(stats.read_text())
+    assert st["alignments"] == 3
+    assert st["events"] == 2
+    assert st["wall_s"] >= 0
+    assert "alignments, " in err.getvalue()  # -v brief line
+
+
+def test_resume_truncates_torn_record(tmp_path):
+    lines = _three_alignments()
+    paf, fa = _mk_inputs(tmp_path, lines)
+    full = tmp_path / "full.dfa"
+    assert run([paf, "-r", fa, "-o", str(full)], stderr=io.StringIO()) == 0
+
+    # interrupted mid-record: header + half an event row, no newline
+    torn = tmp_path / "torn.dfa"
+    content = full.read_text()
+    second_hdr = content.index(">", 1)
+    torn.write_text(content[:second_hdr] + ">asm2:0-8+ coverage:100.00 "
+                    "score=0 edit_distance=0\nD\t3\t1(T")
+    assert run([paf, "-r", fa, "-o", str(torn), "--resume"],
+               stderr=io.StringIO()) == 0
+    assert torn.read_text() == content
+
+
+def test_skip_bad_line_does_not_poison_dedup(tmp_path):
+    # a skipped malformed line must not mark its (q,t) pair as seen
+    good, _ = make_paf_line("q", Q, "asm1", "+",
+                            [("=", 6), ("ins", "gg"), ("=", 4)])
+    bad = good.replace("cs:Z::6", "cs:Z::2*gc:3")  # base mismatch vs ref
+    paf, fa = _mk_inputs(tmp_path, [bad, good])
+    report = tmp_path / "out.dfa"
+    err = io.StringIO()
+    rc = run([paf, "-r", fa, "-o", str(report), "--skip-bad-lines"],
+             stderr=err)
+    assert rc == 0
+    assert "already seen" not in err.getvalue()
+    assert report.read_text().count(">asm1") == 1
