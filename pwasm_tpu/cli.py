@@ -196,17 +196,36 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
             # skip that many accepted alignments (SURVEY.md §5
             # checkpoint/resume).  The dropped record is re-emitted.
             try:
+                # stream in chunks (reports can be GBs): count record
+                # headers and remember where the last one starts
+                n_headers = 0
+                last_header = -1
+                size = 0
+                prev_byte = b"\n"  # virtual newline before file start
                 with open(str(opts["o"]), "rb") as f:
-                    body = f.read()
-                if body.startswith(b">"):
-                    last = body.rfind(b"\n>")
-                    keep = last + 1 if last != -1 else 0
+                    starts_ok = f.read(1) == b">"
+                    f.seek(0)
+                    while True:
+                        chunk = f.read(1 << 20)
+                        if not chunk:
+                            break
+                        search = prev_byte + chunk
+                        pos = search.find(b"\n>")
+                        while pos != -1:
+                            n_headers += 1
+                            # search[pos] is the byte BEFORE the '>', so
+                            # the record starts at file offset size + pos
+                            last_header = size + pos
+                            pos = search.find(b"\n>", pos + 1)
+                        prev_byte = chunk[-1:]
+                        size += len(chunk)
+                if starts_ok and n_headers > 0:
+                    # drop the LAST record: its rows may be torn
+                    keep = last_header if n_headers > 1 else 0
+                    resume_skip = n_headers - 1
                 else:
-                    keep = 0  # not a report produced by this tool
-                kept = body[:keep]
-                resume_skip = kept.count(b"\n>") + \
-                    (1 if kept.startswith(b">") else 0)
-                if keep != len(body):
+                    keep, resume_skip = 0, 0  # not a report of this tool
+                if keep != size:
                     with open(str(opts["o"]), "ab") as f:
                         f.truncate(keep)
             except OSError:
@@ -358,11 +377,16 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                     continue
             numalns += 1
             if (freport is not None and not build_msa_out
+                    and not cfg.skip_bad_lines
                     and stats.resumed_past < resume_skip):
                 # --resume fast path: this alignment is already in the
                 # report; advance the cursor on parse-level info alone
                 # (no refseq fetch, no extraction), so resume cost scales
-                # with the REMAINING work (SURVEY.md §5)
+                # with the REMAINING work (SURVEY.md §5).  Disabled under
+                # --skip-bad-lines: there a line can parse yet have been
+                # skipped at extraction in the original run (absent from
+                # the report), so cursor advance must go through
+                # extraction — the slow path below — to stay in sync.
                 stats.resumed_past += 1
                 stats.alignments += 1
                 stats.aligned_bases += al.t_alnend - al.t_alnstart

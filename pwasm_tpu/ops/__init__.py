@@ -21,3 +21,19 @@ from pwasm_tpu.ops.consensus import (  # noqa: F401
     votes_to_chars,
     CODE_ZERO_COV,
 )
+
+
+def default_interpret() -> bool:
+    """Pallas interpreter-mode default: on for non-TPU backends, and
+    forced on everywhere by ``PWASM_DEVICE_INTERPRET=1`` — the JAX-side
+    debugging analog of the reference's sanitizer builds (SURVEY.md §5:
+    Makefile:30-47 memcheck): interpreter mode evaluates kernels
+    op-by-op with real bounds semantics, so out-of-window slices and
+    masking bugs surface as Python errors instead of silent garbage."""
+    import os
+
+    import jax
+
+    if os.environ.get("PWASM_DEVICE_INTERPRET", "0") == "1":
+        return True
+    return jax.default_backend() != "tpu"

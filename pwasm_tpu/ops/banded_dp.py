@@ -311,7 +311,8 @@ def banded_scores_pallas(q: jax.Array, ts: jax.Array, t_lens: jax.Array,
     from jax.experimental.pallas import tpu as pltpu
 
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from pwasm_tpu.ops import default_interpret
+        interpret = default_interpret()
     m = q.shape[0]
     T, n = ts.shape
     dlo = band_dlo(m, n, band)
@@ -439,7 +440,8 @@ def banded_scores_long(q: jax.Array, ts: jax.Array, t_lens: jax.Array,
     from jax.experimental.pallas import tpu as pltpu
 
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from pwasm_tpu.ops import default_interpret
+        interpret = default_interpret()
     m = q.shape[0]
     T, n = ts.shape
     dlo = band_dlo(m, n, band)

@@ -130,7 +130,8 @@ def consensus_pallas(bases: jax.Array, col_tile: int = 512,
     from jax.experimental import pallas as pl
 
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from pwasm_tpu.ops import default_interpret
+        interpret = default_interpret()
     depth, cols = bases.shape
     padded = (cols + col_tile - 1) // col_tile * col_tile
     if padded != cols:

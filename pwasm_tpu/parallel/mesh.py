@@ -14,8 +14,13 @@ mesh with XLA collectives riding ICI:
   the mesh, so a single wide MSA also spreads over chips; votes are
   per-column local, so no collective is needed on that axis.
 
-Multi-slice/DCN: the outer per-alignment loop is data-parallel at the
-process level; nothing in the step crosses slices.
+Multi-slice/DCN: ``make_multislice_mesh``/``make_multislice_step`` add a
+third, OUTERMOST 'slice' axis for pods connected over DCN.  Only the
+embarrassingly-parallel axes (targets, pileup columns) shard across it;
+the one collective in the step (the depth-axis psum of consensus counts)
+runs on the innermost mesh axis, so it rides ICI within a slice and DCN
+never carries a collective — the layout rule the scaling-book recipe
+prescribes.
 """
 
 from __future__ import annotations
@@ -32,6 +37,15 @@ from pwasm_tpu.ops.banded_dp import ScoreParams, banded_scores_batch
 from pwasm_tpu.ops.consensus import consensus_vote_counts, pileup_counts
 
 
+def _inner_factor(n: int) -> int:
+    """Largest factor of n that is <= sqrt(n) — the innermost-axis size
+    when factoring a device count into a 2-D grid."""
+    for cand in range(int(n ** 0.5), 0, -1):
+        if n % cand == 0:
+            return cand
+    return 1
+
+
 def make_mesh(n_devices: int | None = None,
               axis_names: tuple[str, str] = ("batch", "depth")) -> Mesh:
     """A 2-D mesh over the first ``n_devices`` devices.  The depth axis
@@ -40,19 +54,17 @@ def make_mesh(n_devices: int | None = None,
     if n_devices is not None:
         devs = devs[:n_devices]
     n = len(devs)
-    d = 1
-    for cand in range(int(n ** 0.5), 0, -1):
-        if n % cand == 0:
-            d = cand
-            break
+    d = _inner_factor(n)
     return Mesh(np.asarray(devs).reshape(n // d, d), axis_names)
 
 
-def sharded_consensus(mesh: Mesh):
+def sharded_consensus(mesh: Mesh, dp_axes=("batch",)):
     """Consensus with the pileup sharded (depth, cols) over the mesh:
     local counts per shard, ``psum`` over the depth axis (ICI), local
-    votes per column shard.  Returns a jitted fn(bases (depth, cols)) ->
-    votes (cols,)."""
+    votes per column shard.  ``dp_axes`` names the mesh axes the column
+    axis shards over (("slice", "batch") on a multi-slice mesh).
+    Returns a jitted fn(bases (depth, cols)) -> votes (cols,)."""
+    dp = dp_axes if len(dp_axes) > 1 else dp_axes[0]
 
     def block(b_local):
         local = pileup_counts(b_local)
@@ -60,8 +72,8 @@ def sharded_consensus(mesh: Mesh):
         return consensus_vote_counts(total)
 
     fn = shard_map(block, mesh=mesh,
-                   in_specs=P("depth", "batch"),
-                   out_specs=P("batch"))
+                   in_specs=P("depth", dp),
+                   out_specs=P(dp))
     return jax.jit(fn)
 
 
@@ -76,16 +88,24 @@ def make_pipeline_step(mesh: Mesh, band: int = 32,
     T must divide by mesh.shape['batch']; depth by mesh 'depth' and cols
     by mesh 'batch'.
     """
-    s_batch = NamedSharding(mesh, P("batch", None))
-    s_lens = NamedSharding(mesh, P("batch"))
+    return _make_step(mesh, band, params, ("batch",))
+
+
+def _make_step(mesh: Mesh, band, params, dp_axes):
+    """Shared builder behind make_pipeline_step/make_multislice_step:
+    targets and pileup columns shard over ``dp_axes``; the consensus
+    psum reduces over 'depth' only."""
+    dp = dp_axes if len(dp_axes) > 1 else dp_axes[0]
+    s_batch = NamedSharding(mesh, P(dp, None))
+    s_lens = NamedSharding(mesh, P(dp))
     s_rep = NamedSharding(mesh, P())
-    s_pileup = NamedSharding(mesh, P("depth", "batch"))
-    cons = sharded_consensus(mesh)
+    s_pileup = NamedSharding(mesh, P("depth", dp))
+    cons = sharded_consensus(mesh, dp_axes)
 
     @functools.partial(
         jax.jit,
         in_shardings=(s_rep, s_batch, s_lens, s_pileup),
-        out_shardings=(s_lens, NamedSharding(mesh, P("batch"))))
+        out_shardings=(s_lens, NamedSharding(mesh, P(dp))))
     def step(q, ts, t_lens, pileup):
         scores = banded_scores_batch(q, ts, t_lens, band=band,
                                      params=params)
@@ -93,3 +113,33 @@ def make_pipeline_step(mesh: Mesh, band: int = 32,
         return scores, votes
 
     return step
+
+
+def make_multislice_mesh(n_slices: int, n_devices: int | None = None,
+                         axis_names: tuple[str, str, str] =
+                         ("slice", "batch", "depth")) -> Mesh:
+    """A 3-D (slice, batch, depth) mesh.  'slice' is the OUTERMOST axis —
+    on real multi-slice topologies consecutive device blocks belong to
+    the same slice, so this reshape keeps intra-slice axes on ICI and
+    puts only the slice axis across DCN."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    if n % n_slices:
+        raise ValueError(f"{n} devices don't split into {n_slices} slices")
+    per = n // n_slices
+    d = _inner_factor(per)
+    return Mesh(np.asarray(devs).reshape(n_slices, per // d, d),
+                axis_names)
+
+
+def make_multislice_step(mesh: Mesh, band: int = 32,
+                         params: ScoreParams = ScoreParams()):
+    """Data-parallel-over-DCN pipeline step on a (slice, batch, depth)
+    mesh: targets and pileup columns shard over (slice x batch); the
+    consensus psum reduces over 'depth' only, so no collective crosses
+    the slice (DCN) axis.  Same signature and bit-exact results as
+    ``make_pipeline_step``; T and cols must divide by
+    slice*batch, depth by the mesh depth."""
+    return _make_step(mesh, band, params, ("slice", "batch"))

@@ -376,3 +376,24 @@ def test_skip_bad_line_does_not_poison_dedup(tmp_path):
     assert rc == 0
     assert "already seen" not in err.getvalue()
     assert report.read_text().count(">asm1") == 1
+
+
+def test_resume_with_skip_bad_lines_stays_in_sync(tmp_path):
+    """A line that parses but fails extraction (skipped in the original
+    run, absent from the report) must not consume a --resume cursor slot."""
+    good1, good2, good3 = _three_alignments()
+    bad = good1.replace("asm1", "asmB").replace("cs:Z::6", "cs:Z::2*gc:3")
+    lines = [bad, good1, good2, good3]
+    paf, fa = _mk_inputs(tmp_path, lines)
+    full = tmp_path / "full.dfa"
+    assert run([paf, "-r", fa, "-o", str(full), "--skip-bad-lines"],
+               stderr=io.StringIO()) == 0
+
+    # interrupted after the first two emitted records
+    part = tmp_path / "part.dfa"
+    content = full.read_text()
+    third_hdr = content.index(">asm3")
+    part.write_text(content[:third_hdr])
+    assert run([paf, "-r", fa, "-o", str(part), "--resume",
+                "--skip-bad-lines"], stderr=io.StringIO()) == 0
+    assert part.read_text() == content

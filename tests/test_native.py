@@ -1,6 +1,8 @@
 """Native C++ host core: extraction parity vs the Python path and the
 single-core banded Gotoh baseline."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -290,3 +292,23 @@ def test_native_pack_2bit_roundtrip():
     packed = pack_2bit(codes)
     assert packed.shape == (3,)
     np.testing.assert_array_equal(unpack_2bit(packed, len(codes)), codes)
+
+
+def test_native_sanitizer_selftest():
+    """The reference ships ASan/UBSan build targets (Makefile:30-47);
+    our equivalent gate is `make memcheck` in pwasm_tpu/native."""
+    import subprocess
+
+    d = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "pwasm_tpu", "native")
+    # probe: can this toolchain link a sanitized binary at all?
+    probe = subprocess.run(
+        ["g++", "-fsanitize=address,undefined", "-x", "c++", "-",
+         "-o", os.devnull],
+        input="int main(){return 0;}", capture_output=True, text=True)
+    if probe.returncode != 0:
+        pytest.skip("sanitizer toolchain unavailable")
+    res = subprocess.run(["make", "-C", d, "memcheck"],
+                         capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "native selftest OK" in res.stdout

@@ -182,3 +182,35 @@ def test_many2many_scores_pallas_sequential_matches():
                                            jnp.asarray(ts),
                                            jnp.asarray(t_lens), band=16))
     np.testing.assert_array_equal(a, b)
+
+
+def test_multislice_step_matches_single_device():
+    # 2 DCN slices x (2 batch x 2 depth) ICI mesh: results must be
+    # bit-exact with the unsharded path and with the single-slice step
+    from pwasm_tpu.ops.banded_dp import banded_scores_batch
+    from pwasm_tpu.ops.consensus import consensus_votes
+    from pwasm_tpu.parallel.mesh import (make_multislice_mesh,
+                                         make_multislice_step)
+
+    mesh = make_multislice_mesh(2, 8)
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "slice": 2, "batch": 2, "depth": 2}
+    rng = np.random.default_rng(17)
+    m, T, n, depth, cols = 24, 8, 32, 4, 16
+    q = rng.integers(0, 4, size=m).astype(np.int8)
+    ts = np.full((T, n), 127, dtype=np.int8)
+    tl = np.zeros(T, dtype=np.int32)
+    for k in range(T):
+        L = int(rng.integers(m - 2, n + 1))
+        ts[k, :L] = rng.integers(0, 4, size=L)
+        tl[k] = L
+    pileup = rng.integers(0, 6, size=(depth, cols)).astype(np.int8)
+    step = make_multislice_step(mesh, band=16)
+    scores, votes = step(jnp.asarray(q), jnp.asarray(ts), jnp.asarray(tl),
+                         jnp.asarray(pileup))
+    np.testing.assert_array_equal(
+        np.asarray(scores),
+        np.asarray(banded_scores_batch(jnp.asarray(q), jnp.asarray(ts),
+                                       jnp.asarray(tl), band=16)))
+    np.testing.assert_array_equal(
+        np.asarray(votes), np.asarray(consensus_votes(jnp.asarray(pileup))))
