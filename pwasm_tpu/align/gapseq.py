@@ -49,6 +49,9 @@ class GapSeq:
         self.msa = None
         self.msaidx = -1
         self.delops: list[tuple[int, bool]] = []  # (pos, revcompl) pairs
+        # per-base overlap coverage, opt-in (the reference's compile-time
+        # ALIGN_COVERAGE_DATA capability, GapAssem.h:42-46)
+        self.cov: np.ndarray | None = None
 
     # ---- flags ----------------------------------------------------------
     def set_flag(self, bit: int) -> None:
@@ -134,6 +137,29 @@ class GapSeq:
         if self.seqlen > 1:
             self.gaps[1:] = self.gaps[1:][::-1]
 
+    # ---- coverage tracking (opt-in; the reference's compile-time
+    # ALIGN_COVERAGE_DATA capability, GapAssem.h:42-46,131-133) ---------
+    def enable_coverage(self) -> None:
+        """Allocate the per-base coverage array (zeros), like the
+        GCALLOC in the reference ctors (GapAssem.cpp:36-79)."""
+        if self.cov is None:
+            self.cov = np.zeros(self.seqlen, dtype=np.int32)
+
+    def add_coverage(self, other: "GapSeq") -> None:
+        """Merge another instance's coverage of the SAME sequence,
+        flipping when orientations differ (GASeq::addCoverage,
+        GapAssem.cpp:394-410)."""
+        if self.seqlen != other.seqlen:
+            raise ValueError(
+                f"invalid addCoverage {self.name}(len {self.seqlen}) vs "
+                f"{other.name}(len {other.seqlen})")
+        if self.cov is None or other.cov is None:
+            return
+        if self.revcompl != other.revcompl:
+            self.cov += other.cov[::-1]
+        else:
+            self.cov += other.cov
+
     def rev_complement(self, alignlen: int = 0) -> None:
         """Reverse-complement within an alignment layout
         (GASeq::revComplement, GapAssem.cpp:366-392)."""
@@ -149,6 +175,8 @@ class GapSeq:
         if len(self.seq) == self.seqlen:
             self.reverse_complement_bases()
         self.reverse_gaps()
+        if self.cov is not None:  # GapAssem.cpp:383-391
+            self.cov = self.cov[::-1].copy()
 
     def prep_seq(self) -> None:
         """Apply deferred deletions, then RC if needed; once per sequence

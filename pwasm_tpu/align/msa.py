@@ -88,7 +88,8 @@ class MsaColumns:
 class Msa:
     """A multiple sequence alignment (reference GSeqAlign)."""
 
-    def __init__(self, s1: GapSeq | None = None, s2: GapSeq | None = None):
+    def __init__(self, s1: GapSeq | None = None, s2: GapSeq | None = None,
+                 cov_spans: tuple | None = None):
         self.seqs: list[GapSeq] = []
         self.length = 0
         self.minoffset = 0
@@ -108,6 +109,31 @@ class Msa:
             self.length = max(s1.end_offset(), s2.end_offset()) - self.minoffset
             self.ng_len = max(s1.end_ng_offset(), s2.end_ng_offset()) \
                 - self.ng_minofs
+            if cov_spans is not None:
+                self._init_coverage(s1, s2, cov_spans)
+
+    @staticmethod
+    def _init_coverage(s1: GapSeq, s2: GapSeq, cov_spans: tuple) -> None:
+        """Opt-in coverage bookkeeping of the pairwise seed — the
+        reference's ALIGN_COVERAGE_DATA ctor (GapAssem.cpp:599-639):
+        +1 over each aligned span, -1 per base of the shorter mismatched
+        overhang at each end.  (The reference's compiled-out loop
+        decrements a single boundary cell msml/msmr times,
+        GapAssem.cpp:627-639 — an index slip in dead code; this
+        implements the per-base intent.)"""
+        (l1, r1), (l2, r2) = cov_spans
+        s1.enable_coverage()
+        s2.enable_coverage()
+        s1.cov[l1:r1] += 1
+        s2.cov[l2:r2] += 1
+        msml = min(l1, l2)
+        if msml > 0:
+            s1.cov[l1 - msml:l1] -= 1
+            s2.cov[l2 - msml:l2] -= 1
+        msmr = min(s1.seqlen - r1 - 1, s2.seqlen - r2 - 1)
+        if msmr > 0:
+            s1.cov[r1 + 1:r1 + 1 + msmr] -= 1
+            s2.cov[r2 + 1:r2 + 1 + msmr] -= 1
 
     def count(self) -> int:
         return len(self.seqs)
@@ -194,6 +220,7 @@ class Msa:
                 f"(len {seq.seqlen}) vs {oseq.name}(len {oseq.seqlen})\n")
         if seq.revcompl != oseq.revcompl:
             omsa.rev_complement()
+        seq.add_coverage(oseq)  # no-op unless coverage tracking is on
         for i in range(seq.seqlen):
             d = seq.gap(i) - oseq.gap(i)
             if d > 0:

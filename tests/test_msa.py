@@ -354,3 +354,36 @@ def test_mfasta_wrap_and_exact_multiple_blank_line():
     # exact multiple of the line length leaves the reference's trailing
     # blank line (printMFasta quirk)
     assert buf.getvalue() == ">s\n" + "A" * 60 + "\n\n"
+
+
+def test_coverage_tracking_pairwise_and_merge():
+    # opt-in ALIGN_COVERAGE_DATA capability (GapAssem.h:42-46):
+    # +1 over aligned spans, -1 over the shorter mismatched overhangs
+    s1 = GapSeq("a", seq=b"ACGTACGT")
+    s2 = GapSeq("b", seq=b"CGTACGTA", offset=1)
+    Msa(s1, s2, cov_spans=((1, 8), (0, 7)))
+    # s1: span [1,8) +1; left overhang msml=min(1,0)=0; right
+    # msmr=min(8-8-1, 8-7-1)=-1 -> none
+    np.testing.assert_array_equal(s1.cov, [0, 1, 1, 1, 1, 1, 1, 1])
+    np.testing.assert_array_equal(s2.cov, [1, 1, 1, 1, 1, 1, 1, 0])
+
+    # strand-aware merge of another instance's coverage
+    s1b = GapSeq("a", seq=b"ACGTACGT", revcompl=1)
+    s1b.enable_coverage()
+    s1b.cov[:] = [7, 6, 5, 4, 3, 2, 1, 0]
+    s1.add_coverage(s1b)
+    np.testing.assert_array_equal(s1.cov, [0, 2, 3, 4, 5, 6, 7, 8])
+
+    # rev_complement reverses the coverage array (GapAssem.cpp:383-391)
+    s1.rev_complement()
+    np.testing.assert_array_equal(s1.cov, [8, 7, 6, 5, 4, 3, 2, 0])
+
+
+def test_coverage_mismatched_overhang_penalty():
+    s1 = GapSeq("a", seq=b"TTACGTACGTTT")  # len 12
+    s2 = GapSeq("b", seq=b"GGACGTACGTGG")  # len 12
+    Msa(s1, s2, cov_spans=((2, 10), (2, 10)))
+    # left overhang msml=2 -> cov[0:2] -= 1; right msmr=min(1,1)=1
+    np.testing.assert_array_equal(
+        s1.cov, [-1, -1, 1, 1, 1, 1, 1, 1, 1, 1, 0, -1])
+    np.testing.assert_array_equal(s2.cov, s1.cov)
