@@ -116,11 +116,12 @@ class Msa:
     def _init_coverage(s1: GapSeq, s2: GapSeq, cov_spans: tuple) -> None:
         """Opt-in coverage bookkeeping of the pairwise seed — the
         reference's ALIGN_COVERAGE_DATA ctor (GapAssem.cpp:599-639):
-        +1 over each aligned span, -1 per base of the shorter mismatched
-        overhang at each end.  (The reference's compiled-out loop
-        decrements a single boundary cell msml/msmr times,
-        GapAssem.cpp:627-639 — an index slip in dead code; this
-        implements the per-base intent.)"""
+        +1 over each aligned span (half-open [l, r)), -1 per base of the
+        shorter mismatched overhang at each end.  (The reference's
+        compiled-out loop decrements a single boundary cell msml/msmr
+        times and mixes inclusive/exclusive ends, GapAssem.cpp:627-639 —
+        index slips in dead code; this implements the symmetric per-base
+        intent.)"""
         (l1, r1), (l2, r2) = cov_spans
         s1.enable_coverage()
         s2.enable_coverage()
@@ -130,10 +131,10 @@ class Msa:
         if msml > 0:
             s1.cov[l1 - msml:l1] -= 1
             s2.cov[l2 - msml:l2] -= 1
-        msmr = min(s1.seqlen - r1 - 1, s2.seqlen - r2 - 1)
+        msmr = min(s1.seqlen - r1, s2.seqlen - r2)
         if msmr > 0:
-            s1.cov[r1 + 1:r1 + 1 + msmr] -= 1
-            s2.cov[r2 + 1:r2 + 1 + msmr] -= 1
+            s1.cov[r1:r1 + msmr] -= 1
+            s2.cov[r2:r2 + msmr] -= 1
 
     def count(self) -> int:
         return len(self.seqs)

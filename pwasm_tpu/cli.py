@@ -327,16 +327,32 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
     cons_outs = cons_outs or {}
     build_msa_out = fmsa is not None or bool(cons_outs)
 
-    def flush_pending():
-        if not pending:
-            return
-        from pwasm_tpu.report.device_report import print_diff_info_batch
+    inflight: list = []   # at most one submitted-but-unformatted batch
+
+    def flush_pending(drain: bool = False):
+        """Submit the pending batch, then format the PREVIOUS batch —
+        JAX dispatch is async, so batch k's device program runs while
+        batch k-1's rows are formatted and written (the launch/transfer
+        latency is hidden behind host work).  ``drain`` formats the last
+        in-flight batch at end of input."""
+        from pwasm_tpu.report.device_report import submit_diff_info_batch
         # take the batch first: if the flush itself raises, the finally
         # below must not retry it (the retry would mask the live error)
         batch, pending[:] = pending[:], []
-        print_diff_info_batch(batch, freport, skip_codan=cfg.skip_codan,
-                              motifs=cfg.motifs, summary=summary)
-        stats.device_batches += 1
+        if batch:
+            inflight.append(submit_diff_info_batch(
+                batch, freport, skip_codan=cfg.skip_codan,
+                motifs=cfg.motifs, summary=summary))
+            stats.device_batches += 1
+        while len(inflight) > (0 if drain else 1):
+            try:
+                inflight.pop(0)()
+            except BaseException:
+                # a formatting failure mid-batch must leave the report a
+                # clean prefix of input order (--resume depends on it):
+                # drop everything submitted after the failure point
+                inflight.clear()
+                raise
 
     try:
         file_line = 0
@@ -474,7 +490,7 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         # emit whatever the device batch buffer holds — including when
         # a later bad line raises, so earlier alignments' rows aren't
         # dropped (the cpu path writes them progressively)
-        flush_pending()
+        flush_pending(drain=True)
 
     if cfg.debug and ref_msa is not None:
         print(f">MSA ({ref_msa.count()})", file=stderr)

@@ -35,29 +35,32 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
-def analyze_events_device(refseq: bytes, events, skip_codan: bool = False,
-                          motifs=DEFAULT_MOTIFS,
-                          max_ev: int = MAX_EV) -> list[tuple]:
-    """Analyze a batch of DiffEvents on the device.
+def submit_events_device(refseq: bytes, events,
+                         skip_codan: bool = False,
+                         motifs=DEFAULT_MOTIFS, max_ev: int = MAX_EV):
+    """Launch the device analysis of a batch of DiffEvents and return a
+    ``finish() -> list[tuple]`` closure that fetches and assembles the
+    results.
 
-    Returns a list of (aa, aapos, rctx, status, impact) tuples in event
-    order — the same contract as ``analyze_event_host`` (and NB: like the
-    host path it upper-cases each event's ``evtbases`` in place, matching
-    printDiffInfo).  Events over ``max_ev`` bases take the scalar path
-    inline."""
+    JAX dispatch is asynchronous, so between ``submit`` and ``finish``
+    the device computes while the host does other work — the CLI overlaps
+    batch k's device program with batch k-1's host formatting, which
+    hides the transfer/launch latency entirely (one batch in flight).
+    Events over ``max_ev`` bases take the scalar path inside finish().
+    """
     import jax.numpy as jnp
 
     from pwasm_tpu.report.diff_report import analyze_event_host
 
     if not events:
-        return []
+        return lambda: []
     ref_len = len(refseq)
     max_len = _round_up(ref_len + max_ev + 3, 256)
     fits = [len(ev.evtbases) <= max_ev and len(ev.evtsub) <= max_ev
             for ev in events]
     small = [ev for ev, ok in zip(events, fits) if ok]
     big = [ev for ev, ok in zip(events, fits) if not ok]
-    results: dict[int, tuple] = {}
+    out = None
     if small:
         packed = pack_events(small, max_ev)
         mot_codes, mot_lens = pack_motifs(motifs)
@@ -71,56 +74,64 @@ def analyze_events_device(refseq: bytes, events, skip_codan: bool = False,
                        jnp.int32(ref_len), packed, mot_codes, mot_lens,
                        max_codons=max_ev // 3 + 2, max_len=max_len,
                        skip_codan=skip_codan)
-        host = {k: np.asarray(v) for k, v in out.items()}
-        for k, ev in enumerate(small):
-            ev.evtbases = ev.evtbases.upper()
-            aa = chr(int(host["aa"][k]))
-            aapos = int(host["aapos"][k])
-            rctx, _ = get_ref_context(refseq, ev.rloc)
-            if host["hpoly"][k]:
-                status = "homopolymer"
-            elif host["motif"][k] > 0:
-                status = f"motif {motifs[int(host['motif'][k]) - 1]}"
-            else:
-                status = "[unknown]"
-            impact = ""
-            if not skip_codan:
-                impact = _impact_text(ev, k, host)
-            results[id(ev)] = (aa, aapos, rctx, status, impact)
-    for ev in big:
-        results[id(ev)] = analyze_event_host(ev, refseq, skip_codan,
-                                             motifs)
-    return [results[id(ev)] for ev in events]
+
+    def finish() -> list[tuple]:
+        results: dict[int, tuple] = {}
+        if small:
+            host = {k: np.asarray(v) for k, v in out.items()}
+            for k, ev in enumerate(small):
+                ev.evtbases = ev.evtbases.upper()
+                aa = chr(int(host["aa"][k]))
+                aapos = int(host["aapos"][k])
+                rctx, _ = get_ref_context(refseq, ev.rloc)
+                if host["hpoly"][k]:
+                    status = "homopolymer"
+                elif host["motif"][k] > 0:
+                    status = f"motif {motifs[int(host['motif'][k]) - 1]}"
+                else:
+                    status = "[unknown]"
+                impact = ""
+                if not skip_codan:
+                    impact = _impact_text(ev, k, host)
+                results[id(ev)] = (aa, aapos, rctx, status, impact)
+        for ev in big:
+            results[id(ev)] = analyze_event_host(ev, refseq, skip_codan,
+                                                 motifs)
+        return [results[id(ev)] for ev in events]
+
+    return finish
 
 
-def print_diff_info_batch(batch, f, skip_codan: bool = False,
-                          motifs=DEFAULT_MOTIFS, summary=None,
-                          max_ev: int = MAX_EV) -> None:
-    """Batched device-path equivalent of ``print_diff_info`` over many
-    alignments (the SURVEY.md §3.1 TPU boundary: host parse -> batch ->
-    one device program -> host format).
+def analyze_events_device(refseq: bytes, events, skip_codan: bool = False,
+                          motifs=DEFAULT_MOTIFS,
+                          max_ev: int = MAX_EV) -> list[tuple]:
+    """Synchronous submit+finish: a list of (aa, aapos, rctx, status,
+    impact) tuples in event order — the same contract as
+    ``analyze_event_host`` (and NB: like the host path it upper-cases
+    each event's ``evtbases`` in place, matching printDiffInfo)."""
+    return submit_events_device(refseq, events, skip_codan, motifs,
+                                max_ev)()
+
+
+def submit_diff_info_batch(batch, f, skip_codan: bool = False,
+                           motifs=DEFAULT_MOTIFS, summary=None,
+                           max_ev: int = MAX_EV):
+    """Launch the device analysis for a report batch and return a
+    ``finish() -> None`` closure that fetches the results and writes the
+    rows (the SURVEY.md §3.1 TPU boundary: host parse -> batch -> one
+    device program -> host format — with the device program of batch k
+    overlapping the host formatting of batch k-1, see the CLI).
 
     ``batch`` is a list of (aln: PafAlignment, rlabel, tlabel,
-    refseq: bytes) in input order.  Events are grouped per distinct refseq
-    (the device program is specialized on the reference tensor), analyzed
-    in one ``ctx_scan`` call per group, then rows are emitted in exactly
-    the order the scalar path would produce."""
+    refseq: bytes) in input order.  Events are grouped per distinct
+    refseq (the device program is specialized on the reference tensor),
+    analyzed in one ``ctx_scan`` call per group, then rows are emitted in
+    exactly the order the scalar path would produce."""
     from pwasm_tpu.report.diff_report import (format_event_row,
                                               format_header,
                                               print_diff_info)
 
-    # group event lists by refseq identity, preserving alignment order
-    groups: dict[bytes, list] = {}
-    for aln, _rl, _tl, refseq in batch:
-        groups.setdefault(refseq, []).extend(aln.tdiffs)
-    analyzed: dict[int, tuple] = {}
-    try:
-        for refseq, events in groups.items():
-            res = analyze_events_device(refseq, events, skip_codan,
-                                        motifs, max_ev)
-            for ev, r in zip(events, res):
-                analyzed[id(ev)] = r
-    except Exception as e:
+    def scalar_replay(e: Exception) -> None:
         # the batch analysis failed before any row was written; replay
         # the whole batch through the scalar path, which writes rows
         # progressively and raises at exactly the failing event — the
@@ -136,16 +147,53 @@ def print_diff_info_batch(batch, f, skip_codan: bool = False,
             print_diff_info(aln, rlabel, tlabel, f, refseq,
                             skip_codan=skip_codan, motifs=motifs,
                             summary=summary)
-        return
-    for aln, rlabel, tlabel, refseq in batch:
-        f.write(format_header(aln, rlabel, tlabel))
-        if summary is not None:
-            summary.add_alignment(aln)
-        for di in aln.tdiffs:
-            aa, aapos, rctx, status, impact = analyzed[id(di)]
+
+    # group event lists by refseq identity, preserving alignment order
+    groups: dict[bytes, list] = {}
+    for aln, _rl, _tl, refseq in batch:
+        groups.setdefault(refseq, []).extend(aln.tdiffs)
+    finishes = []
+    try:
+        for refseq, events in groups.items():
+            finishes.append((events, submit_events_device(
+                refseq, events, skip_codan, motifs, max_ev)))
+    except Exception as e:
+        err = e
+
+        def finish_failed() -> None:
+            scalar_replay(err)
+
+        return finish_failed
+
+    def finish() -> None:
+        analyzed: dict[int, tuple] = {}
+        try:
+            for events, fin in finishes:
+                for ev, r in zip(events, fin()):
+                    analyzed[id(ev)] = r
+        except Exception as e:
+            scalar_replay(e)
+            return
+        for aln, rlabel, tlabel, refseq in batch:
+            f.write(format_header(aln, rlabel, tlabel))
             if summary is not None:
-                summary.add_event(di, status, impact)
-            f.write(format_event_row(di, aa, aapos, rctx, status, impact))
+                summary.add_alignment(aln)
+            for di in aln.tdiffs:
+                aa, aapos, rctx, status, impact = analyzed[id(di)]
+                if summary is not None:
+                    summary.add_event(di, status, impact)
+                f.write(format_event_row(di, aa, aapos, rctx, status,
+                                         impact))
+
+    return finish
+
+
+def print_diff_info_batch(batch, f, skip_codan: bool = False,
+                          motifs=DEFAULT_MOTIFS, summary=None,
+                          max_ev: int = MAX_EV) -> None:
+    """Synchronous submit+finish of one report batch."""
+    submit_diff_info_batch(batch, f, skip_codan, motifs, summary,
+                           max_ev)()
 
 
 def _impact_text(ev, k: int, host: dict) -> str:

@@ -363,7 +363,7 @@ def test_coverage_tracking_pairwise_and_merge():
     s2 = GapSeq("b", seq=b"CGTACGTA", offset=1)
     Msa(s1, s2, cov_spans=((1, 8), (0, 7)))
     # s1: span [1,8) +1; left overhang msml=min(1,0)=0; right
-    # msmr=min(8-8-1, 8-7-1)=-1 -> none
+    # msmr=min(8-8, 8-7)=0 -> none
     np.testing.assert_array_equal(s1.cov, [0, 1, 1, 1, 1, 1, 1, 1])
     np.testing.assert_array_equal(s2.cov, [1, 1, 1, 1, 1, 1, 1, 0])
 
@@ -383,7 +383,7 @@ def test_coverage_mismatched_overhang_penalty():
     s1 = GapSeq("a", seq=b"TTACGTACGTTT")  # len 12
     s2 = GapSeq("b", seq=b"GGACGTACGTGG")  # len 12
     Msa(s1, s2, cov_spans=((2, 10), (2, 10)))
-    # left overhang msml=2 -> cov[0:2] -= 1; right msmr=min(1,1)=1
+    # symmetric 2-base overhangs: cov[0:2] -= 1 and cov[10:12] -= 1
     np.testing.assert_array_equal(
-        s1.cov, [-1, -1, 1, 1, 1, 1, 1, 1, 1, 1, 0, -1])
+        s1.cov, [-1, -1, 1, 1, 1, 1, 1, 1, 1, 1, -1, -1])
     np.testing.assert_array_equal(s2.cov, s1.cov)
