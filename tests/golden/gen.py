@@ -1,0 +1,59 @@
+"""Regenerate the golden outputs (run from repo root):
+    python tests/golden/gen.py
+Inputs are deterministic; outputs lock the report/MSA/ACE/info/cons
+byte formats across refactors (SURVEY.md §4 golden-file strategy).
+"""
+import io
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(HERE)))
+sys.path.insert(0, os.path.dirname(HERE))  # tests/ for helpers
+
+from helpers import make_paf_line  # noqa: E402
+
+from pwasm_tpu.cli import run  # noqa: E402
+
+QSEQ = "ATGGCCTGGACGTACGATCAAGGTCCTGGAGATCTTT"
+
+
+def lines():
+    return [
+        make_paf_line("q", QSEQ, "a1", "+",
+                      [("=", 4), ("*", "a", "c"), ("=", 32)])[0],
+        make_paf_line("q", QSEQ, "a2", "+",
+                      [("=", 6), ("ins", "gg"), ("=", 31)])[0],
+        make_paf_line("q", QSEQ, "a3", "-",
+                      [("=", 10), ("del", 2), ("=", 25)])[0],
+        make_paf_line("q", QSEQ, "a4", "-",
+                      [("=", 3), ("*", "a", "g"), ("=", 33)])[0],
+        make_paf_line("q", QSEQ, "a5", "+",
+                      [("=", 8), ("*", "c", "g"), ("*", "t", "a"),
+                       ("=", 27)])[0],
+    ]
+
+
+def generate(outdir):
+    fa = os.path.join(outdir, "q.fa")
+    with open(fa, "w") as f:
+        f.write(f">q\n{QSEQ}\n")
+    paf = os.path.join(outdir, "in.paf")
+    with open(paf, "w") as f:
+        f.write("".join(ln + "\n" for ln in lines()))
+    args = [paf, "-r", fa,
+            "-o", os.path.join(outdir, "report.dfa"),
+            "-s", os.path.join(outdir, "summary.txt"),
+            "-w", os.path.join(outdir, "msa.mfa"),
+            "--ace=" + os.path.join(outdir, "contig.ace"),
+            "--info=" + os.path.join(outdir, "contig.info"),
+            "--cons=" + os.path.join(outdir, "cons.fa")]
+    rc = run(args, stderr=io.StringIO())
+    assert rc == 0, rc
+    return ["report.dfa", "summary.txt", "msa.mfa", "contig.ace",
+            "contig.info", "cons.fa"]
+
+
+if __name__ == "__main__":
+    names = generate(HERE)
+    print("golden outputs written:", ", ".join(names))
