@@ -81,10 +81,129 @@ def _workload(T: int, m: int, seed: int = 0, max_subs: int = 40,
     return q, ts, t_lens
 
 
+_WATCHDOG = None
+
+
+def _arm_watchdog() -> None:
+    """Hard deadline: if the bench has not emitted its JSON line after
+    PWASM_BENCH_WATCHDOG seconds (default 1800, 0 disables), print a
+    structured failure line and exit — a mid-run tunnel hang must never
+    leave the driver with no output at all."""
+    global _WATCHDOG
+    try:
+        secs = float(os.environ.get("PWASM_BENCH_WATCHDOG", "1800"))
+    except ValueError:
+        secs = 1800.0
+    if secs <= 0:
+        return
+    import threading
+
+    def fire():
+        print(json.dumps({"metric": "bench_watchdog_timeout", "value": 0,
+                          "unit": "bool", "vs_baseline": 0}), flush=True)
+        os._exit(1)
+
+    _WATCHDOG = threading.Timer(secs, fire)
+    _WATCHDOG.daemon = True
+    _WATCHDOG.start()
+
+
+def _disarm_watchdog() -> None:
+    if _WATCHDOG is not None:
+        _WATCHDOG.cancel()
+
+
 def _fail(metric: str) -> int:
+    _disarm_watchdog()
     print(json.dumps({"metric": metric, "value": 0, "unit": "bool",
                       "vs_baseline": 0}))
     return 1
+
+
+def _probe_backend(env: dict, timeout: float) -> str | None:
+    """Ask a subprocess which jax platform initializes under ``env``.
+    Returns the platform name, or None on error OR hang — the round-1
+    capture died on an init error (BENCH_r01.json) and the tunnel has
+    also been observed to hang indefinitely, so the probe must bound
+    both failure modes."""
+    import subprocess
+
+    code = ("import jax; d = jax.devices(); "
+            "print('PLATFORM=%s:%d' % (d[0].platform, len(d)))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, timeout=timeout, text=True)
+    except Exception:
+        return None
+    if r.returncode != 0:
+        return None
+    for line in r.stdout.splitlines():
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1].split(":")[0]
+    return None
+
+
+def _resolve_backend() -> str:
+    """Pick a healthy jax backend, degrading instead of dying.
+
+    The TPU sits behind a tunnel (an 'axon' platform a site hook
+    registers); when the tunnel is unhealthy the first device query
+    either raises RuntimeError or hangs.  Strategy: probe the configured
+    platform in a bounded subprocess (twice — tunnel errors can be
+    transient); on failure probe relaxed pins (auto, then cpu) and
+    re-exec this script under the first env that proves healthy.  The
+    bench then still measures and emits its one JSON line on the
+    platform it reports to stderr.  A later mid-run hang is bounded by
+    the watchdog."""
+    probe_t = float(os.environ.get("PWASM_BENCH_PROBE_TIMEOUT", "150"))
+    for attempt in range(2):
+        p = _probe_backend(dict(os.environ), probe_t)
+        if p is not None:
+            import jax
+            devs = jax.devices()   # proven healthy just now
+            print(f"[bench] backend={devs[0].platform} "
+                  f"devices={len(devs)}", file=sys.stderr)
+            return devs[0].platform
+        print(f"[bench] backend probe failed/hung "
+              f"(attempt {attempt + 1}/2, timeout {probe_t:.0f}s)",
+              file=sys.stderr)
+    if "PWASM_BENCH_FALLBACK" not in os.environ:  # never re-exec twice
+        # the '' (auto-select) pin is only worth a probe when it differs
+        # from the env that just failed — i.e. when a non-empty pin was set
+        pins = [""] if os.environ.get("JAX_PLATFORMS") else []
+        for pin in pins + ["cpu"]:
+            env = dict(os.environ, JAX_PLATFORMS=pin,
+                       PWASM_BENCH_FALLBACK=pin or "auto")
+            if pin == "cpu":
+                env.pop("PALLAS_AXON_POOL_IPS", None)
+            if _probe_backend(env, probe_t) is not None:
+                print(f"[bench] re-exec with JAX_PLATFORMS={pin!r}",
+                      file=sys.stderr)
+                sys.stderr.flush()
+                sys.stdout.flush()
+                os.execve(sys.executable, [sys.executable] + sys.argv,
+                          env)
+    raise RuntimeError("no healthy jax backend (tunnel down; cpu probe "
+                       "failed too)")
+
+
+def _scale_for_fallback(cfg: str) -> None:
+    """Shrink the workload when the chip is unreachable and the bench
+    fell back to host CPU, so it completes in minutes rather than hours.
+    Explicit PWASM_BENCH_* env settings always win; the measured rate is
+    still honest for the platform reported to stderr."""
+    global REPS
+    small_t = {"2": "512", "3": "256", "4": str(1 << 16), "5": "4"}
+    if cfg in small_t:
+        os.environ.setdefault("PWASM_BENCH_T", small_t[cfg])
+    if cfg == "3":
+        os.environ.setdefault("PWASM_BENCH_Q", "8")
+    # interpreter-mode Pallas on CPU is orders of magnitude too slow to
+    # time; the XLA lowering of the same recurrence is the honest CPU
+    # stand-in (bit-exactness between the two is gated by the test suite)
+    os.environ.setdefault("PWASM_BENCH_KERNEL", "xla")
+    if "PWASM_BENCH_REPS" not in os.environ:
+        REPS = 2
 
 
 def _pipe_rate(run_fn, arg, zero, work_per_rep: float, reps: int = 0):
@@ -187,9 +306,14 @@ def _sig(x: float, digits: int = 4) -> float:
     return round(x, digits - 1 - int(math.floor(math.log10(abs(x)))))
 
 
+_METRIC_PREFIX = ""   # "cpu_fallback_" when the chip was unreachable
+
+
 def _emit(metric, value, unit, vs_baseline) -> int:
-    print(json.dumps({"metric": metric, "value": _sig(value),
-                      "unit": unit, "vs_baseline": _sig(vs_baseline)}))
+    _disarm_watchdog()
+    print(json.dumps({"metric": _METRIC_PREFIX + metric,
+                      "value": _sig(value), "unit": unit,
+                      "vs_baseline": _sig(vs_baseline)}))
     return 0
 
 
@@ -326,7 +450,9 @@ def cfg3_many2many() -> int:
     import jax
     import jax.numpy as jnp
 
-    from pwasm_tpu.parallel.many2many import many2many_scores_pallas
+    from pwasm_tpu.ops import on_tpu_backend
+    from pwasm_tpu.parallel.many2many import (many2many_scores,
+                                              many2many_scores_pallas)
 
     Q = int(os.environ.get("PWASM_BENCH_Q", "500"))
     T = int(os.environ.get("PWASM_BENCH_T", "10240"))
@@ -341,11 +467,15 @@ def cfg3_many2many() -> int:
         qi[idx] = rng.integers(0, 4, size=30).astype(np.int8)
         qs[i] = qi
     qsd, tsd, tld = jnp.asarray(qs), jnp.asarray(ts), jnp.asarray(t_lens)
+    # off-TPU (chip unreachable -> cpu fallback) the Pallas kernel would
+    # run in interpreter mode — time the XLA lowering instead
+    m2m_fn = many2many_scores_pallas if on_tpu_backend() else \
+        many2many_scores
 
     @jax.jit
     def chained(tl_in, prev):
         tl_in, _ = jax.lax.optimization_barrier((tl_in, prev))
-        return many2many_scores_pallas(qsd, tsd, tl_in, band=BAND)
+        return m2m_fn(qsd, tsd, tl_in, band=BAND)
 
     zero = jnp.zeros_like(tld)
     scores_h = np.asarray(chained(tld, zero))
@@ -371,7 +501,9 @@ def cfg4_consensus() -> int:
     import jax.numpy as jnp
 
     from pwasm_tpu.align.msa import best_char_from_counts
-    from pwasm_tpu.ops.consensus import consensus_pallas, votes_to_chars
+    from pwasm_tpu.ops import on_tpu_backend
+    from pwasm_tpu.ops.consensus import (consensus_pallas, consensus_votes,
+                                         votes_to_chars)
 
     depth = 256
     # default sized so one vote pass takes ~5 ms on a v5e chip — small
@@ -386,10 +518,15 @@ def cfg4_consensus() -> int:
     pileup[noise < 0.10] = rng.integers(0, 6, size=(noise < 0.10).sum())
     pd = jnp.asarray(pileup)
 
+    on_tpu = on_tpu_backend()  # off-TPU: XLA path, not interpreted Pallas
+
     @jax.jit
     def chained(p_in, prev):
         p_in, _ = jax.lax.optimization_barrier((p_in, prev))
-        votes, _counts = consensus_pallas(p_in)
+        if on_tpu:
+            votes, _counts = consensus_pallas(p_in)
+        else:
+            votes = consensus_votes(p_in)
         return votes
 
     zero = jnp.zeros((cols,), jnp.int8)
@@ -429,19 +566,25 @@ def cfg5_longread() -> int:
     import jax
     import jax.numpy as jnp
 
-    from pwasm_tpu.ops.banded_dp import ScoreParams, banded_scores_long
+    from pwasm_tpu.ops import on_tpu_backend
+    from pwasm_tpu.ops.banded_dp import (ScoreParams, banded_scores_batch,
+                                         banded_scores_long)
 
     T = int(os.environ.get("PWASM_BENCH_T", "256"))
     m = 50_000
     params = ScoreParams()
     q, ts, t_lens = _workload(T, m=m, seed=5, max_subs=400, max_indels=12)
     qd, tsd, tld = jnp.asarray(q), jnp.asarray(ts), jnp.asarray(t_lens)
+    on_tpu = on_tpu_backend()  # off-TPU: XLA path, not interpreted Pallas
 
     @jax.jit
     def chained(tl_in, prev):
         tl_in, _ = jax.lax.optimization_barrier((tl_in, prev))
-        return banded_scores_long(qd, tsd, tl_in, band=BAND,
-                                  params=params, chunk=1024)
+        if on_tpu:
+            return banded_scores_long(qd, tsd, tl_in, band=BAND,
+                                      params=params, chunk=1024)
+        return banded_scores_batch(qd, tsd, tl_in, band=BAND,
+                                   params=params)
 
     zero = jnp.zeros_like(tld)
     scores_h = np.asarray(chained(tld, zero))
@@ -463,7 +606,24 @@ def main() -> int:
                "5": cfg5_longread}
     if cfg not in configs:
         return _fail(f"unknown_bench_config_{cfg}")
-    return configs[cfg]()
+    _arm_watchdog()
+    try:
+        if cfg != "1":  # config 1 is the subprocess CPU reference
+            _resolve_backend()
+            from pwasm_tpu.ops import on_tpu_backend
+            if not on_tpu_backend():
+                # a host-CPU rate must never be recorded as a chip rate:
+                # rename the metric so benchmark history stays clean
+                global _METRIC_PREFIX
+                _METRIC_PREFIX = "cpu_fallback_"
+                _scale_for_fallback(cfg)
+        return configs[cfg]()
+    except SystemExit:
+        raise
+    except BaseException as e:  # the one JSON line must ALWAYS print
+        import traceback
+        traceback.print_exc()
+        return _fail(f"bench_error_{type(e).__name__}")
 
 
 if __name__ == "__main__":

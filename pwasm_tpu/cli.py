@@ -335,6 +335,11 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         batch k-1's rows are formatted and written (the launch/transfer
         latency is hidden behind host work).  ``drain`` formats the last
         in-flight batch at end of input."""
+        if not pending and not inflight:
+            return  # nothing buffered (always true in --device=cpu mode):
+            # never touch the device module — the plain-CPU CLI must not
+            # initialize jax (a pinned-but-unhealthy TPU tunnel would
+            # hang or kill an otherwise host-only run)
         from pwasm_tpu.report.device_report import submit_diff_info_batch
         # take the batch first: if the flush itself raises, the finally
         # below must not retry it (the retry would mask the live error)

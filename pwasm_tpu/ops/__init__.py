@@ -23,17 +23,38 @@ from pwasm_tpu.ops.consensus import (  # noqa: F401
 )
 
 
+def on_tpu_backend() -> bool:
+    """True when the default backend is a TPU — directly ('tpu') or via
+    a tunnel plugin whose platform name differs (e.g. 'axon') but whose
+    devices are real TPU chips (device_kind says so)."""
+    import jax
+
+    backend = jax.default_backend()
+    if backend == "tpu":
+        return True
+    try:
+        d = jax.devices()[0]
+        kind = (getattr(d, "device_kind", "") or "").lower()
+        return "tpu" in kind or "tpu" in backend.lower()
+    except Exception:
+        return False
+
+
 def default_interpret() -> bool:
     """Pallas interpreter-mode default: on for non-TPU backends, and
     forced on everywhere by ``PWASM_DEVICE_INTERPRET=1`` — the JAX-side
     debugging analog of the reference's sanitizer builds (SURVEY.md §5:
     Makefile:30-47 memcheck): interpreter mode evaluates kernels
     op-by-op with real bounds semantics, so out-of-window slices and
-    masking bugs surface as Python errors instead of silent garbage."""
+    masking bugs surface as Python errors instead of silent garbage.
+    ``PWASM_DEVICE_INTERPRET=0`` forces compiled (Mosaic) lowering even
+    off-TPU — the smoke path that keeps interpreter-mode tests from
+    masking a lowering break."""
     import os
 
-    import jax
-
-    if os.environ.get("PWASM_DEVICE_INTERPRET", "0") == "1":
+    forced = os.environ.get("PWASM_DEVICE_INTERPRET", "")
+    if forced == "1":
         return True
-    return jax.default_backend() != "tpu"
+    if forced == "0":
+        return False
+    return not on_tpu_backend()

@@ -39,16 +39,21 @@ EVT_S, EVT_I, EVT_D = 0, 1, 2
 CTX = 9          # reference-context window size
 MAX_MOTIF = 8    # max motif length supported by the device scan
 
-_AA_LUT_J = jnp.asarray(AA_LUT)
-
-
 def _translate(c0, c1, c2):
     """Codes (clipped to N) -> amino-acid ASCII via the 5^3 LUT; any code
-    outside [0,4) translates through N -> 'X'."""
+    outside [0,4) translates through N -> 'X'.
+
+    The LUT is materialized here, not at module level: a module-level
+    ``jnp.asarray`` would initialize the jax backend at import time, which
+    must never happen on host-only code paths (an unhealthy TPU tunnel
+    would hang a plain-CPU CLI run).  Under jit it constant-folds; it may
+    not be cached across calls (a first call inside a trace would cache a
+    tracer)."""
+    lut = jnp.asarray(AA_LUT)
     c0 = jnp.clip(c0, 0, CODE_N)
     c1 = jnp.clip(c1, 0, CODE_N)
     c2 = jnp.clip(c2, 0, CODE_N)
-    return _AA_LUT_J[(c0 * 25 + c1 * 5 + c2).astype(jnp.int32)]
+    return lut[(c0 * 25 + c1 * 5 + c2).astype(jnp.int32)]
 
 
 def pack_events(events, max_ev: int = 16, bucket: int = 256) -> dict:
