@@ -8,8 +8,9 @@ import os
 import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-sys.path.insert(0, os.path.dirname(os.path.dirname(HERE)))
-sys.path.insert(0, os.path.dirname(HERE))  # tests/ for helpers
+if __name__ == "__main__":  # pytest already puts tests/ + rootdir on path
+    sys.path.insert(0, os.path.dirname(os.path.dirname(HERE)))
+    sys.path.insert(0, os.path.dirname(HERE))  # tests/ for helpers
 
 from helpers import make_paf_line  # noqa: E402
 
@@ -48,8 +49,10 @@ def generate(outdir):
             "--ace=" + os.path.join(outdir, "contig.ace"),
             "--info=" + os.path.join(outdir, "contig.info"),
             "--cons=" + os.path.join(outdir, "cons.fa")]
-    rc = run(args, stderr=io.StringIO())
-    assert rc == 0, rc
+    err = io.StringIO()
+    rc = run(args, stderr=err)
+    assert rc == 0, f"cli rc={rc}: {err.getvalue()}"
+
     return ["report.dfa", "summary.txt", "msa.mfa", "contig.ace",
             "contig.info", "cons.fa"]
 

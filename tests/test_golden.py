@@ -1,0 +1,54 @@
+"""Golden byte-lock tests (SURVEY.md §4 test strategy).
+
+The committed files under tests/golden/ lock the byte format of every
+writer — the .dfa diff report (pafreport.cpp:885-955 equivalent), the -s
+summary (the reference's vestigial flag, SURVEY.md §2.5.1), the -w
+multifasta MSA (GapAssem.cpp:482-520,1039-1046), and the consensus-path
+ACE/info/cons outputs (GapAssem.cpp:1200-1367).  The suite regenerates
+all six through the real CLI into a temp dir and byte-compares; any
+one-byte drift in a writer fails here.  Regenerate intentionally with:
+    python tests/golden/gen.py
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+GOLDEN = os.path.join(HERE, "golden")
+
+_spec = importlib.util.spec_from_file_location(
+    "golden_gen", os.path.join(GOLDEN, "gen.py"))
+_gen = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_gen)
+
+
+@pytest.fixture(scope="module")
+def regenerated(tmp_path_factory):
+    outdir = tmp_path_factory.mktemp("golden_regen")
+    names = _gen.generate(str(outdir))
+    return outdir, names
+
+
+def test_golden_files_committed_nonempty():
+    for name in ("report.dfa", "summary.txt", "msa.mfa", "contig.ace",
+                 "contig.info", "cons.fa"):
+        path = os.path.join(GOLDEN, name)
+        assert os.path.exists(path), f"missing golden file {name}"
+        assert os.path.getsize(path) > 0, f"empty golden file {name}"
+
+
+@pytest.mark.parametrize("name", ["report.dfa", "summary.txt", "msa.mfa",
+                                  "contig.ace", "contig.info", "cons.fa"])
+def test_golden_byte_lock(regenerated, name):
+    outdir, names = regenerated
+    assert name in names
+    with open(os.path.join(GOLDEN, name), "rb") as f:
+        want = f.read()
+    with open(os.path.join(str(outdir), name), "rb") as f:
+        got = f.read()
+    assert got == want, (
+        f"{name} drifted from the committed golden copy "
+        f"({len(got)} vs {len(want)} bytes); if the change is "
+        f"intentional, regenerate with `python tests/golden/gen.py`")
