@@ -42,6 +42,9 @@ USAGE = """Usage:
    -F full genome alignment mode (default for query>100Kb; assumes -N)
    -C perform codon impact analysis
    -N skip codon impact analysis
+   --realign   replace each alignment's PAF gap structure with a banded
+               affine-gap DP re-alignment (device traceback) before MSA
+               construction; requires an MSA output (-w/--ace/--info/--cons)
    --ace=FILE  write the refined MSA as an ACE contig (consensus calling)
    --info=FILE write the refined MSA as a contig-info table (per-seq pid)
    --cons=FILE write the consensus sequence as FASTA
@@ -158,12 +161,18 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
         if knob in opts:
             val = opts[knob]
             if val is True or not str(val).isascii() \
-                    or not str(val).isdigit():
+                    or not str(val).isdigit() or int(val) < 1:
                 raise CliError(
                     f"{USAGE}\nInvalid --{knob} value: {val}\n")
             setattr(cfg, knob, int(val))
     if "motifs" in opts:
         cfg.motifs = load_motifs(str(opts["motifs"]))
+    cfg.realign = bool(opts.get("realign"))
+    if cfg.realign and "w" not in opts \
+            and not any(k in opts for k in ("ace", "info", "cons")):
+        stderr.write(f"{USAGE} Error: --realign requires an MSA output "
+                     "(-w, --ace, --info or --cons)!\n")
+        return EXIT_USAGE
 
     infile = positional[0] if positional else None
     inf = sys.stdin
@@ -329,6 +338,73 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
 
     inflight: list = []   # at most one submitted-but-unformatted batch
 
+    def msa_add(aln, tlabel: str, refseq_b: bytes, ord_num: int) -> None:
+        """Insert one alignment into the progressive MSA (the per-line
+        body of pafreport.cpp:394-421)."""
+        nonlocal ref_gseq, ref_msa
+        al = aln.alninfo
+        taseq = GapSeq(tlabel, "", aln.tseq, offset=al.r_alnstart,
+                       revcompl=aln.reverse)
+        first_ref_aln = ref_gseq is None
+        if first_ref_aln:
+            rseq = GapSeq(al.r_id, "", refseq_b)
+            rseq.set_flag(FLAG_IS_REF)
+        else:
+            # bare instance of refseq for this alignment
+            rseq = GapSeq(al.r_id, "", b"", seqlen=al.r_len)
+        # once a gap, always a gap: propagate this alignment's gaps
+        for g in aln.rgaps:
+            rseq.set_gap(g.pos, g.len)
+        for g in aln.tgaps:
+            taseq.set_gap(g.pos, g.len)
+        newmsa = Msa(rseq, taseq)
+        if first_ref_aln:
+            newmsa.ordnum = ord_num
+            ref_msa = newmsa
+            ref_gseq = rseq
+        else:
+            ref_gseq.msa.add_align(ref_gseq, newmsa, rseq)
+            ref_msa = ref_gseq.msa
+
+    # --realign: buffer MSA insertions and re-align each buffered target
+    # with the batched banded-DP traceback (ops/realign.py), replacing
+    # the PAF's gap structure before the progressive merge.  Insertion
+    # order is preserved, so the resulting MSA differs only in the gap
+    # structures the DP improved.
+    re_pending: list[tuple] = []
+
+    def flush_realign() -> None:
+        if not re_pending:
+            return
+        if cfg.device == "cpu":
+            # --device=cpu must never touch a (possibly unhealthy) TPU
+            # backend: pin the jax platform before the first backend init.
+            # A no-op once a backend is up (update raises; ignore).
+            import jax
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
+        from pwasm_tpu.ops.realign import ops_to_gaps, realign_pairs
+        items, re_pending[:] = re_pending[:], []
+        results = realign_pairs(
+            [(q_seg, bytes(aln.tseq)) for aln, _t, _r, _o, q_seg in items],
+            band=cfg.band)
+        for (aln, tlabel, refseq_b, ordn, _q), res in zip(items, results):
+            al = aln.alninfo
+            if res is None:  # outside realignment resource bounds:
+                # keep the PAF's own gap structure for this alignment
+                print(f"Warning: {al.r_id}~{al.t_id} not re-aligned "
+                      "(length difference beyond band ceiling); keeping "
+                      "PAF gaps", file=stderr)
+            else:
+                _score, ops = res
+                aln.rgaps, aln.tgaps = ops_to_gaps(
+                    ops, aln.offset, al.r_len,
+                    al.t_alnend - al.t_alnstart, aln.reverse)
+                stats.realigned += 1
+            msa_add(aln, tlabel, refseq_b, ordn)
+
     def flush_pending(drain: bool = False):
         """Submit the pending batch, then format the PREVIOUS batch —
         JAX dispatch is async, so batch k's device program runs while
@@ -413,6 +489,9 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                 stats.aligned_bases += al.t_alnend - al.t_alnstart
                 continue
             if refseq_id is None or refseq_id != al.r_id:
+                # buffered re-alignments belong to the previous query's
+                # MSA: merge them before the layout state resets
+                flush_realign()
                 if al.r_id in ref_cache:
                     refseq = ref_cache[al.r_id]
                 else:
@@ -469,34 +548,23 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                                     skip_codan=cfg.skip_codan,
                                     motifs=cfg.motifs, summary=summary)
             if build_msa_out:
-                taseq = GapSeq(tlabel, "", aln.tseq, offset=al.r_alnstart,
-                               revcompl=aln.reverse)
-                first_ref_aln = ref_gseq is None
-                if first_ref_aln:
-                    rseq = GapSeq(al.r_id, "", refseq)
-                    rseq.set_flag(FLAG_IS_REF)
+                if cfg.realign:
+                    q_seg = refseq_aln[aln.offset:
+                                       aln.offset + (al.r_alnend -
+                                                     al.r_alnstart)]
+                    re_pending.append((aln, tlabel, refseq, numalns,
+                                       q_seg))
+                    if len(re_pending) >= cfg.batch:
+                        flush_realign()
                 else:
-                    # bare instance of refseq for this alignment
-                    rseq = GapSeq(al.r_id, "", b"", seqlen=al.r_len)
-                # once a gap, always a gap: propagate this alignment's gaps
-                for g in aln.rgaps:
-                    rseq.set_gap(g.pos, g.len)
-                for g in aln.tgaps:
-                    taseq.set_gap(g.pos, g.len)
-                newmsa = Msa(rseq, taseq)
-                if first_ref_aln:
-                    newmsa.ordnum = numalns
-                    ref_msa = newmsa
-                    ref_gseq = rseq
-                else:
-                    ref_gseq.msa.add_align(ref_gseq, newmsa, rseq)
-                    ref_msa = ref_gseq.msa
+                    msa_add(aln, tlabel, refseq, numalns)
     finally:
         # emit whatever the device batch buffer holds — including when
         # a later bad line raises, so earlier alignments' rows aren't
         # dropped (the cpu path writes them progressively)
         flush_pending(drain=True)
 
+    flush_realign()
     if cfg.debug and ref_msa is not None:
         print(f">MSA ({ref_msa.count()})", file=stderr)
         ref_msa.print_layout(stderr, "v")

@@ -33,6 +33,7 @@ class Config:
     device: str = "cpu"             # cpu | tpu
     band: int = 64                  # banded-DP band width
     batch: int = 256                # device batch size
+    realign: bool = False           # --realign: DP traceback gaps for MSA
 
     # run-control / observability knobs (SURVEY.md §5; no ref equivalent)
     skip_bad_lines: bool = False    # warn + continue on malformed lines

@@ -79,14 +79,25 @@ def initial_wavefront(n: int, dlo: int, band: int,
     return m0, ix0, iy0
 
 
-def make_row_step(n: int, dlo: int, band: int, params: ScoreParams):
+def make_row_step(n: int, dlo, band: int, params: ScoreParams,
+                  emit_ptrs: bool = False):
     """The shared DP row recurrence in band coordinates.
 
     Returns ``step(prev_m, prev_ix, prev_iy, i, qi, t) -> (m, ix, iy)``
     where ``i`` is the 1-based absolute query row and ``t`` the (n,)
-    padded target.  Both the single-chip scan and the sequence-parallel
-    wavefront pipeline (pwasm_tpu.parallel.wavefront_sp) call this exact
-    function, so their integer scores agree bit for bit.
+    padded target.  The single-chip scan, the sequence-parallel
+    wavefront pipeline (pwasm_tpu.parallel.wavefront_sp) and the
+    traceback re-aligner (pwasm_tpu.ops.realign) all call this exact
+    function, so their integer scores agree bit for bit.  ``dlo`` may be
+    a Python int or a traced int32 scalar (every use is arithmetic).
+
+    With ``emit_ptrs=True`` the step additionally returns one packed
+    uint8 pointer per band cell: bits 0-1 = diag argmax (0=M, 1=Ix,
+    2=Iy, tie-break M >= Ix >= Iy), bit 2 = Ix from extend, bit 3 = Iy
+    from extend (gap-open wins ties) — the traceback re-aligner's
+    inputs.  The j==0 Ix boundary override below equals the generic
+    max it replaces (M[i-1][j=0] is NEG for i > 1 and 0 for i = 1), so
+    the extend bit stays valid there.
     """
     ge, go = params.gap_extend, params.go
     bidx = jnp.arange(band, dtype=jnp.int32)
@@ -111,8 +122,22 @@ def make_row_step(n: int, dlo: int, band: int, params: ScoreParams):
         run_prev = jnp.concatenate([jnp.array([NEG]), run[:-1]])
         iy_new = run_prev - go - (bidx - 1) * ge
         iy_new = jnp.where(valid, iy_new, NEG)
-        return (m_new.astype(jnp.int32), ix_new.astype(jnp.int32),
-                iy_new.astype(jnp.int32))
+        m_new = m_new.astype(jnp.int32)
+        ix_new = ix_new.astype(jnp.int32)
+        iy_new = iy_new.astype(jnp.int32)
+        if not emit_ptrs:
+            return m_new, ix_new, iy_new
+        dm = jnp.where((prev_m >= prev_ix) & (prev_m >= prev_iy), 0,
+                       jnp.where(prev_ix >= prev_iy, 1, 2))
+        bx = (up_ix - ge > up_m - go).astype(jnp.int32)
+        # Iy[b] == max(M[b-1] - go, Iy[b-1] - ge) (the closed form is
+        # the unrolled chain); recover the sequential-form bit in-row
+        negv = jnp.full((1,), NEG, dtype=jnp.int32)
+        m_left = jnp.concatenate([negv, m_new[:-1]])
+        iy_left = jnp.concatenate([negv, iy_new[:-1]])
+        by = (iy_left - ge > m_left - go).astype(jnp.int32)
+        ptr = (dm | (bx << 2) | (by << 3)).astype(jnp.uint8)
+        return m_new, ix_new, iy_new, ptr
 
     return step
 

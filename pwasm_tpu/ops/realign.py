@@ -1,0 +1,418 @@
+"""Banded affine-gap DP **re-alignment**: traceback to gap structures.
+
+The scores-only kernels (``ops/banded_dp.py``) rank candidate targets;
+this module turns the same banded Gotoh recurrence into a re-aligner
+(SURVEY.md §0 north star: "batched banded affine-gap DP re-alignment ...
+gated behind the class boundary"): for every (query segment, target)
+pair it emits the optimal alignment *path* and converts it to the exact
+gap-record conventions of the CIGAR walk (core/events.py:296-314,
+reference pafreport.cpp:680-697), so a re-aligned MSA drops in where the
+PAF's own gap structure was used.
+
+Design (TPU-first):
+
+- The forward pass is the shared banded wavefront recurrence with the
+  band on the vector axis, vmapped over targets; each row additionally
+  emits one packed pointer byte per band cell:
+  bits 0-1 = diag argmax (0=M, 1=Ix, 2=Iy), bit 2 = Ix came from extend,
+  bit 3 = Iy came from extend.  Pointers live in a (T, m, band) uint8
+  tensor on device — O(m x band) per lane, not O(m x n).
+- The traceback is a fixed-length ``lax.scan`` walk per lane (vmapped):
+  each step reads one pointer byte (dynamic gather) and emits one op
+  code, in reverse order.  No host round-trip per alignment; one batched
+  fetch of the (T, S) op tensor per flush.
+- Tie-breaks are DEFINED (M >= Ix >= Iy on maxima; gap-open wins ties
+  against gap-extend) and replicated bit-for-bit by the numpy oracle
+  ``full_gotoh_traceback`` so CPU/TPU gap structures are identical —
+  the same bit-exactness contract as the consensus kernel.
+
+Op codes (forward order): 1 = diagonal (consumes query+target),
+2 = Ix (consumes query => gap in target, the CIGAR-walk 'I' case),
+3 = Iy (consumes target => gap in query, the CIGAR-walk 'D' case).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pwasm_tpu.core.events import GapData
+from pwasm_tpu.ops.banded_dp import NEG, ScoreParams
+
+OP_DIAG, OP_IX, OP_IY = 1, 2, 3
+
+
+# ---------------------------------------------------------------------------
+# forward pass with pointers (band coordinates, per lane)
+# ---------------------------------------------------------------------------
+def _forward_lane(q_seg, t, q_len, n: int, dlo, band: int,
+                  params: ScoreParams):
+    """Forward DP over one lane; rows past q_len are pass-throughs.
+    Returns final wavefront (M, Ix, Iy) at row q_len and the (m_max,
+    band) pointer tensor (row i stored at index i-1).  ``dlo`` is a
+    traced int32 scalar, so band placement changes between flushes
+    reuse the compiled program."""
+    from pwasm_tpu.ops.banded_dp import initial_wavefront, make_row_step
+
+    m_max = q_seg.shape[0]
+    step = make_row_step(n, dlo, band, params, emit_ptrs=True)
+    wf0 = initial_wavefront(n, dlo, band, params)
+
+    def row(carry, xs):
+        prev_m, prev_ix, prev_iy, i = carry
+        qi, = xs
+        i = i + 1
+        m_new, ix_new, iy_new, ptr = step(prev_m, prev_ix, prev_iy, i,
+                                          qi, t)
+        keep = i <= q_len
+        m_new = jnp.where(keep, m_new, prev_m)
+        ix_new = jnp.where(keep, ix_new, prev_ix)
+        iy_new = jnp.where(keep, iy_new, prev_iy)
+        return (m_new, ix_new, iy_new, i), ptr
+
+    (m_f, ix_f, iy_f, _), ptrs = jax.lax.scan(
+        row, (*wf0, jnp.int32(0)), (q_seg.astype(jnp.int32),),
+        length=m_max)
+    return m_f, ix_f, iy_f, ptrs
+
+
+# ---------------------------------------------------------------------------
+# traceback walk (per lane)
+# ---------------------------------------------------------------------------
+def _traceback_lane(ptrs, q_len, t_len, m_f, ix_f, iy_f, n: int, dlo,
+                    band: int, steps: int):
+    """Walk the pointer tensor from cell (q_len, t_len) back to (0, 0),
+    emitting one op per step in REVERSE order (0 = done/padding)."""
+    m_max = ptrs.shape[0]
+    b_end = t_len - q_len - dlo
+    in_band = (b_end >= 0) & (b_end < band)
+    b0 = jnp.clip(b_end, 0, band - 1)
+    mv, xv, yv = m_f[b0], ix_f[b0], iy_f[b0]
+    score = jnp.where(in_band, jnp.maximum(mv, jnp.maximum(xv, yv)), NEG)
+    mat0 = jnp.where((mv >= xv) & (mv >= yv), 0,
+                     jnp.where(xv >= yv, 1, 2)).astype(jnp.int32)
+
+    def step(state, _):
+        i, b, mat, done = state
+        j = i + dlo + b
+        done = done | ((i == 0) & (j == 0))
+        # row 0 can only consume target (the init Iy chain has no stored
+        # pointers): force Iy while j > 0
+        mat = jnp.where((i == 0) & ~done, 2, mat)
+        ptr = ptrs[jnp.clip(i - 1, 0, m_max - 1),
+                   jnp.clip(b, 0, band - 1)].astype(jnp.int32)
+        dm = ptr & 3
+        bx = (ptr >> 2) & 1
+        by = (ptr >> 3) & 1
+        op = jnp.where(done, 0, mat + 1)
+        ni = jnp.where(mat <= 1, i - 1, i)
+        nb = jnp.where(mat == 0, b, jnp.where(mat == 1, b + 1, b - 1))
+        nmat = jnp.where(mat == 0, dm,
+                         jnp.where(mat == 1,
+                                   jnp.where(bx == 1, 1, 0),
+                                   jnp.where(by == 1, 2, 0)))
+        nmat = jnp.where(i == 0, 2, nmat)  # stay on the row-0 Iy chain
+        ni = jnp.where(done, i, ni)
+        nb = jnp.where(done, b, nb)
+        nmat = jnp.where(done, mat, nmat)
+        return (ni, nb, nmat, done), op.astype(jnp.int8)
+
+    init = (q_len.astype(jnp.int32), b0.astype(jnp.int32), mat0,
+            ~in_band)  # out-of-band lanes never walk
+    (fi, fb, _, fdone), ops_bwd = jax.lax.scan(step, init, None,
+                                               length=steps)
+    fj = fi + dlo + fb
+    ok = in_band & (score > NEG // 2) & (fi == 0) & (fj == 0)
+    return score.astype(jnp.int32), ops_bwd, ok
+
+
+@functools.partial(jax.jit, static_argnames=("band", "params"))
+def _traceback_batch_jit(qs, ts, q_lens, t_lens, dlo, band, params):
+    m_max = qs.shape[1]
+    n = ts.shape[1]
+    steps = m_max + n
+
+    def lane(q_seg, t, q_len, t_len):
+        m_f, ix_f, iy_f, ptrs = _forward_lane(q_seg, t, q_len, n, dlo,
+                                              band, params)
+        return _traceback_lane(ptrs, q_len, t_len, m_f, ix_f, iy_f, n,
+                               dlo, band, steps)
+
+    return jax.vmap(lane)(qs, ts, q_lens.astype(jnp.int32),
+                          t_lens.astype(jnp.int32))
+
+
+def banded_traceback_batch(qs: jax.Array, ts: jax.Array,
+                           q_lens: jax.Array, t_lens: jax.Array,
+                           band: int = 64,
+                           params: ScoreParams = ScoreParams(),
+                           dlo: int | None = None):
+    """Batched banded re-alignment with traceback.
+
+    qs: (T, m_max) int8 per-lane query segments (codes, pad 127)
+    ts: (T, n) int8 per-lane targets (codes, pad 127)
+    q_lens / t_lens: (T,) true lengths
+    dlo: band placement (diagonals covered are [dlo, dlo+band));
+    default centers the band on the main diagonal.  ``dlo`` is traced,
+    not static — re-placing the band between flushes reuses the
+    compiled program.
+
+    Returns ``(scores, ops_bwd, ok)``:
+    scores (T,) int32 global scores at (q_len, t_len);
+    ops_bwd (T, m_max + n) int8 alignment ops in reverse order, 0-padded;
+    ok (T,) bool — band covered the end cell and the walk closed at the
+    origin.  Lanes with ``ok=False`` need a wider band (see
+    ``realign_pairs`` escalation) or the host oracle.
+    """
+    if band < 1:
+        raise ValueError(f"band must be >= 1, got {band}")
+    if dlo is None:
+        dlo = -(band // 2)
+    return _traceback_batch_jit(qs, ts, q_lens, t_lens,
+                                jnp.int32(dlo), band, params)
+
+
+# ---------------------------------------------------------------------------
+# host-side conversion: op runs -> GapData lists (CIGAR-walk conventions)
+# ---------------------------------------------------------------------------
+def ops_forward(ops_bwd_row: np.ndarray) -> np.ndarray:
+    """Reverse the non-zero prefix of one traceback row into forward
+    alignment order."""
+    k = int((ops_bwd_row != 0).sum())
+    return ops_bwd_row[:k][::-1]
+
+
+def ops_to_gaps(ops_fwd: np.ndarray, offset: int, r_len: int,
+                eff_t_len: int, reverse: int
+                ) -> tuple[list[GapData], list[GapData]]:
+    """Convert a forward op string to (rgaps, tgaps) with the exact
+    conventions of the CIGAR walk (core/events.py:296-314; reference
+    pafreport.cpp:680-697): Ix runs are target gaps at the current
+    target position (strand-flipped when reverse), Iy runs are query
+    gaps at offset+qpos (strand-flipped when reverse)."""
+    rgaps: list[GapData] = []
+    tgaps: list[GapData] = []
+    qpos = tpos = 0
+    i = 0
+    L = len(ops_fwd)
+    while i < L:
+        op = ops_fwd[i]
+        j = i
+        while j < L and ops_fwd[j] == op:
+            j += 1
+        run = j - i
+        if op == OP_DIAG:
+            qpos += run
+            tpos += run
+        elif op == OP_IX:   # gap in the target sequence
+            tgaps.append(GapData(eff_t_len - tpos if reverse else tpos,
+                                 run))
+            qpos += run
+        elif op == OP_IY:   # gap in the query
+            pos = offset + qpos
+            if reverse:
+                pos = r_len - pos
+            rgaps.append(GapData(pos, run))
+            tpos += run
+        i = j
+    return rgaps, tgaps
+
+
+def ops_consumed(ops_fwd: np.ndarray) -> tuple[int, int]:
+    """(query bases, target bases) consumed by a forward op string."""
+    q = int(((ops_fwd == OP_DIAG) | (ops_fwd == OP_IX)).sum())
+    t = int(((ops_fwd == OP_DIAG) | (ops_fwd == OP_IY)).sum())
+    return q, t
+
+
+def ops_score(ops_fwd: np.ndarray, q: np.ndarray, t: np.ndarray,
+              params: ScoreParams = ScoreParams()) -> int:
+    """Score a forward op string (independent check that the traceback
+    path actually achieves the DP score)."""
+    s = 0
+    qpos = tpos = 0
+    prev = 0
+    for op in ops_fwd:
+        if op == OP_DIAG:
+            match = q[qpos] == t[tpos] and q[qpos] < 4
+            s += params.match if match else -params.mismatch
+            qpos += 1
+            tpos += 1
+        elif op == OP_IX:
+            s -= params.go if prev != OP_IX else params.gap_extend
+            qpos += 1
+        elif op == OP_IY:
+            s -= params.go if prev != OP_IY else params.gap_extend
+            tpos += 1
+        prev = op
+    return s
+
+
+# ---------------------------------------------------------------------------
+# numpy oracle: full-matrix Gotoh traceback with the same tie-breaks
+# ---------------------------------------------------------------------------
+def full_gotoh_traceback(q: np.ndarray, t: np.ndarray,
+                         params: ScoreParams = ScoreParams()
+                         ) -> tuple[int, np.ndarray]:
+    """Unbanded Gotoh with traceback — the independent host oracle.
+    Tie-breaks match the device kernel by definition: diag argmax prefers
+    M, then Ix, then Iy; gap recurrences prefer open on ties.  Returns
+    (score, forward op array)."""
+    m, n = len(q), len(t)
+    ge, go = params.gap_extend, params.go
+    M = np.full((m + 1, n + 1), NEG, dtype=np.int64)
+    Ix = np.full((m + 1, n + 1), NEG, dtype=np.int64)
+    Iy = np.full((m + 1, n + 1), NEG, dtype=np.int64)
+    DM = np.zeros((m + 1, n + 1), dtype=np.int8)   # diag argmax
+    BX = np.zeros((m + 1, n + 1), dtype=np.int8)   # Ix from extend
+    BY = np.zeros((m + 1, n + 1), dtype=np.int8)   # Iy from extend
+    M[0, 0] = 0
+    for j in range(1, n + 1):
+        Iy[0, j] = -(go + (j - 1) * ge)
+        BY[0, j] = 1 if j > 1 else 0
+    for i in range(1, m + 1):
+        Ix[i, 0] = -(go + (i - 1) * ge)
+        BX[i, 0] = 1 if i > 1 else 0
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            s = params.match if (q[i - 1] == t[j - 1] and q[i - 1] < 4) \
+                else -params.mismatch
+            a, b, c = M[i - 1, j - 1], Ix[i - 1, j - 1], Iy[i - 1, j - 1]
+            if a >= b and a >= c:
+                DM[i, j] = 0
+                M[i, j] = a + s
+            elif b >= c:
+                DM[i, j] = 1
+                M[i, j] = b + s
+            else:
+                DM[i, j] = 2
+                M[i, j] = c + s
+            op_sc, ext_sc = M[i - 1, j] - go, Ix[i - 1, j] - ge
+            BX[i, j] = 1 if ext_sc > op_sc else 0
+            Ix[i, j] = max(op_sc, ext_sc)
+            op_sc, ext_sc = M[i, j - 1] - go, Iy[i, j - 1] - ge
+            BY[i, j] = 1 if ext_sc > op_sc else 0
+            Iy[i, j] = max(op_sc, ext_sc)
+    mv, xv, yv = M[m, n], Ix[m, n], Iy[m, n]
+    if mv >= xv and mv >= yv:
+        mat = 0
+    elif xv >= yv:
+        mat = 1
+    else:
+        mat = 2
+    score = int(max(mv, xv, yv))
+    ops: list[int] = []
+    i, j = m, n
+    while i > 0 or j > 0:
+        if i == 0:
+            ops.append(OP_IY)
+            j -= 1
+            continue
+        if j == 0:
+            ops.append(OP_IX)
+            i -= 1
+            continue
+        if mat == 0:
+            ops.append(OP_DIAG)
+            mat = int(DM[i, j])
+            i -= 1
+            j -= 1
+        elif mat == 1:
+            ops.append(OP_IX)
+            mat = 1 if BX[i, j] else 0
+            i -= 1
+        else:
+            ops.append(OP_IY)
+            mat = 2 if BY[i, j] else 0
+            j -= 1
+    return score, np.array(ops[::-1], dtype=np.int8)
+
+
+# ---------------------------------------------------------------------------
+# host batch driver: encode, pad, dispatch, convert, oracle fallback
+# ---------------------------------------------------------------------------
+def _bucket(x: int, step: int = 128) -> int:
+    return max(step, (x + step - 1) // step * step)
+
+
+def _pick_dlo(d_ends: np.ndarray, band: int) -> int:
+    """Band placement covering diagonal 0 (the origin) and as many of
+    the lanes' end diagonals ``t_len - q_len`` as possible: center the
+    band on the hull [min(0, d_min), max(0, d_max)] when it fits,
+    else default to centering on the main diagonal."""
+    lo = min(0, int(d_ends.min()))
+    hi = max(0, int(d_ends.max()))
+    span = hi - lo + 1
+    if span <= band:
+        return lo - (band - span) // 2
+    return -(band // 2)
+
+
+# a full-matrix host traceback beyond this many cells would burn minutes
+# of Python time / gigabytes of int64 — escalate the device band instead
+_ORACLE_CELL_LIMIT = 4_000_000
+_MAX_BAND = 4096
+
+
+def realign_pairs(pairs: list[tuple[bytes, bytes]], band: int = 64,
+                  params: ScoreParams = ScoreParams()):
+    """Re-align a batch of (query_segment, target) byte-string pairs.
+
+    Returns a list of (score, ops_fwd) — or ``None`` for pairs that
+    could not be re-aligned within resource bounds (callers keep their
+    original gap structure).  Sequences are encoded upper-case; shapes
+    are bucketed to multiples of 128 so the jitted program is reused
+    across flushes.  Lanes whose end diagonal the static band cannot
+    cover retry on device with an escalated band (x4 per retry up to
+    4096); tiny leftovers use the host oracle.
+    """
+    from pwasm_tpu.core.dna import encode
+
+    if not pairs:
+        return []
+    T = len(pairs)
+    m_max = _bucket(max(len(p[0]) for p in pairs))
+    n = _bucket(max(len(p[1]) for p in pairs))
+    qs = np.full((T, m_max), 127, dtype=np.int8)
+    ts = np.full((T, n), 127, dtype=np.int8)
+    q_lens = np.zeros(T, dtype=np.int32)
+    t_lens = np.zeros(T, dtype=np.int32)
+    for k, (qb, tb) in enumerate(pairs):
+        qc = encode(qb.upper())
+        tc = encode(tb.upper())
+        qs[k, :len(qc)] = qc
+        ts[k, :len(tc)] = tc
+        q_lens[k] = len(qc)
+        t_lens[k] = len(tc)
+
+    out: list = [None] * T
+    todo = np.arange(T)
+    cur_band = max(1, band)
+    first = True
+    # always try the caller's own band, even above the escalation
+    # ceiling; the ceiling bounds only the automatic retries
+    while len(todo) and (first or cur_band <= _MAX_BAND):
+        first = False
+        sub = todo
+        dlo = _pick_dlo(t_lens[sub] - q_lens[sub], cur_band)
+        scores, ops_bwd, ok = banded_traceback_batch(
+            jnp.asarray(qs[sub]), jnp.asarray(ts[sub]),
+            jnp.asarray(q_lens[sub]), jnp.asarray(t_lens[sub]),
+            band=cur_band, params=params, dlo=dlo)
+        scores = np.asarray(scores)
+        ops_bwd = np.asarray(ops_bwd)
+        ok = np.asarray(ok)
+        for idx, k in enumerate(sub):
+            if ok[idx]:
+                out[k] = (int(scores[idx]), ops_forward(ops_bwd[idx]))
+        todo = sub[~ok]
+        cur_band = max(cur_band * 4, 4)
+    for k in todo:
+        # beyond the band ceiling: bounded host oracle or give up
+        if int(q_lens[k]) * int(t_lens[k]) <= _ORACLE_CELL_LIMIT:
+            out[k] = full_gotoh_traceback(qs[k, :q_lens[k]],
+                                          ts[k, :t_lens[k]], params)
+    return out

@@ -1,0 +1,176 @@
+"""End-to-end --realign CLI tests: the DP traceback replaces the PAF's
+gap structure before MSA construction (SURVEY.md §0 north star — the
+re-aligner as a product feature, not just a kernel)."""
+
+import io
+
+from pwasm_tpu.cli import run
+from pwasm_tpu.core.fasta import write_fasta
+
+from helpers import make_paf_line
+
+
+def _mk(tmp_path, lines, qseq):
+    fa = tmp_path / "q.fa"
+    write_fasta(str(fa), [("q", qseq.encode())])
+    paf = tmp_path / "in.paf"
+    paf.write_text("".join(ln + "\n" for ln in lines))
+    return str(paf), str(fa)
+
+
+def test_realign_requires_msa_output(tmp_path):
+    q = "AAACGGGG"
+    line, _ = make_paf_line("q", q, "t1", "+", [("=", 8)])
+    paf, fa = _mk(tmp_path, [line], q)
+    err = io.StringIO()
+    rc = run([paf, "-r", fa, "--realign"], stdout=io.StringIO(),
+             stderr=err)
+    assert rc == 1
+    assert "--realign requires an MSA output" in err.getvalue()
+
+
+def test_band_zero_rejected(tmp_path):
+    q = "AAACGGGG"
+    line, _ = make_paf_line("q", q, "t1", "+", [("=", 8)])
+    paf, fa = _mk(tmp_path, [line], q)
+    import pytest
+
+    from pwasm_tpu.cli import CliError
+    with pytest.raises(CliError, match="Invalid --band value"):
+        run([paf, "-r", fa, "-w", str(tmp_path / "m.mfa"), "--realign",
+             "--band=0"], stdout=io.StringIO(), stderr=io.StringIO())
+
+
+def test_realign_moves_suboptimal_gap(tmp_path):
+    """A PAF encoding (sub C->g, then delete a G) whose optimal
+    re-alignment is a single gap over the C: --realign must move the
+    target gap and leave the plain run untouched."""
+    q = "AAACGGGG"
+    line, _ = make_paf_line(
+        "q", q, "t1", "+",
+        [("=", 3), ("*", "g", "c"), ("del", 1), ("=", 3)])
+    paf, fa = _mk(tmp_path, [line], q)
+
+    plain = tmp_path / "plain.mfa"
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / "r1.dfa"),
+              "-w", str(plain)], stderr=io.StringIO())
+    assert rc == 0
+    assert plain.read_text() == (
+        ">q\nAAACGGGG\n"
+        ">t1:0-7+\nAAAg-GGG\n")
+
+    re = tmp_path / "re.mfa"
+    stats = tmp_path / "st.json"
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / "r2.dfa"),
+              "-w", str(re), "--realign", f"--stats={stats}"],
+             stderr=io.StringIO())
+    assert rc == 0
+    assert re.read_text() == (
+        ">q\nAAACGGGG\n"
+        ">t1:0-7+\nAAA-gGGG\n")
+    assert '"realigned": 1' in stats.read_text()
+
+
+def test_realign_preserves_optimal_alignments(tmp_path):
+    """Alignments that are already optimal (unique-optimum events far
+    apart) re-align to the identical MSA, forward and reverse."""
+    q = "ACGGTCCTGAACGGTTCCAATCGA"
+    lines = [
+        make_paf_line("q", q, "a1", "+",
+                      [("=", 6), ("ins", "TT"), ("=", 18)])[0],
+        make_paf_line("q", q, "a2", "-",
+                      [("=", 10), ("del", 2), ("=", 12)])[0],
+        make_paf_line("q", q, "a3", "+", [("=", 24)])[0],
+    ]
+    paf, fa = _mk(tmp_path, lines, q)
+    plain = tmp_path / "plain.mfa"
+    rc = run([paf, "-r", fa, "-w", str(plain)], stdout=io.StringIO(),
+             stderr=io.StringIO())
+    assert rc == 0
+    re = tmp_path / "re.mfa"
+    rc = run([paf, "-r", fa, "-w", str(re), "--realign"],
+             stdout=io.StringIO(), stderr=io.StringIO())
+    assert rc == 0
+    assert re.read_text() == plain.read_text()
+
+
+def test_realign_two_queries_flush_at_boundary(tmp_path):
+    """Buffered re-alignments must merge into the FIRST query's MSA
+    before the layout state resets for the second query (the flush at
+    the refseq-change branch); the written MSA is the last query's, and
+    it must match the non-realigned run for already-optimal inputs."""
+    q1 = "ACGGTCCTGAACGGTTCCAATCGA"
+    q2 = "TTGACCGGATACCAGTTGACAGGT"
+    fa = tmp_path / "q.fa"
+    write_fasta(str(fa), [("q1", q1.encode()), ("q2", q2.encode())])
+    lines = [
+        make_paf_line("q1", q1, "a1", "+",
+                      [("=", 6), ("ins", "TT"), ("=", 18)])[0],
+        make_paf_line("q2", q2, "b1", "+",
+                      [("=", 10), ("del", 2), ("=", 12)])[0],
+        make_paf_line("q2", q2, "b2", "-", [("=", 24)])[0],
+    ]
+    paf = tmp_path / "in.paf"
+    paf.write_text("".join(ln + "\n" for ln in lines))
+    plain = tmp_path / "plain.mfa"
+    rc = run([str(paf), "-r", str(fa), "-w", str(plain)],
+             stdout=io.StringIO(), stderr=io.StringIO())
+    assert rc == 0
+    re = tmp_path / "re.mfa"
+    st = tmp_path / "st.json"
+    rc = run([str(paf), "-r", str(fa), "-w", str(re), "--realign",
+              f"--stats={st}"],
+             stdout=io.StringIO(), stderr=io.StringIO())
+    assert rc == 0
+    assert re.read_text() == plain.read_text()
+    assert ">q2" in re.read_text()          # last query's MSA written
+    assert '"realigned": 3' in st.read_text()  # q1's buffer flushed too
+
+
+def test_realign_band_escalation(tmp_path):
+    """A target with an insertion far larger than --band must still
+    re-align (device band escalation), not hang or fall back silently."""
+    q = "ACGGTCCTGAACGGTTCCAATCGA" * 4          # 96 bases
+    ins = "TTTTGGGGCCCCAAAA" * 8                # 128-base insertion
+    lines = [make_paf_line("q", q, "big", "+",
+                           [("=", 48), ("ins", ins), ("=", 48)])[0]]
+    paf, fa = _mk(tmp_path, lines, q)
+    re = tmp_path / "re.mfa"
+    st = tmp_path / "st.json"
+    rc = run([str(paf), "-r", str(fa), "-w", str(re), "--realign",
+              "--band=16", f"--stats={st}"],
+             stdout=io.StringIO(), stderr=io.StringIO())
+    assert rc == 0
+    assert '"realigned": 1' in st.read_text()
+    # the 128-base insertion survives re-alignment as a query gap run
+    # (sequences wrap at 60 columns; join each record's lines)
+    recs: dict[str, str] = {}
+    name = None
+    for ln in re.read_text().splitlines():
+        if ln.startswith(">"):
+            name = ln[1:]
+            recs[name] = ""
+        else:
+            recs[name] += ln
+    assert "-" * 128 in recs["q"]
+
+
+def test_realign_batched_flush(tmp_path):
+    """--batch=2 forces mid-stream flushes; the MSA must be identical to
+    a single-flush run (insertion order preserved across flushes)."""
+    q = "ACGGTCCTGAACGGTTCCAATCGA"
+    lines = []
+    for k in range(5):
+        lines.append(make_paf_line("q", q, f"b{k}", "+",
+                                   [("=", 4 + k), ("ins", "GG"),
+                                    ("=", 20 - k)])[0])
+    paf, fa = _mk(tmp_path, lines, q)
+    one = tmp_path / "one.mfa"
+    rc = run([paf, "-r", fa, "-w", str(one), "--realign"],
+             stdout=io.StringIO(), stderr=io.StringIO())
+    assert rc == 0
+    many = tmp_path / "many.mfa"
+    rc = run([paf, "-r", fa, "-w", str(many), "--realign", "--batch=2"],
+             stdout=io.StringIO(), stderr=io.StringIO())
+    assert rc == 0
+    assert many.read_text() == one.read_text()

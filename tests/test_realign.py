@@ -1,0 +1,187 @@
+"""Tests for the banded DP re-aligner (ops/realign.py).
+
+Parity contract: the device traceback must be *identical* (ops, not just
+score) to the host oracle ``full_gotoh_traceback`` whenever the band
+covers the full matrix, and must always emit a path that (a) consumes
+exactly (q_len, t_len) bases and (b) re-scores to the DP score.
+"""
+
+import numpy as np
+import pytest
+
+from pwasm_tpu.core.dna import encode
+from pwasm_tpu.ops.banded_dp import ScoreParams, banded_scores_batch
+from pwasm_tpu.ops.realign import (banded_traceback_batch,
+                                   full_gotoh_traceback, ops_consumed,
+                                   ops_forward, ops_score, ops_to_gaps,
+                                   realign_pairs)
+
+
+def _mutate(rng, q, n_subs, n_indels, maxgap=3):
+    t = list(q)
+    for _ in range(n_subs):
+        p = int(rng.integers(0, len(t)))
+        t[p] = int(rng.integers(0, 4))
+    for _ in range(n_indels):
+        p = int(rng.integers(1, max(2, len(t) - 1)))
+        g = int(rng.integers(1, maxgap + 1))
+        if rng.random() < 0.5:
+            for _ in range(g):
+                t.insert(p, int(rng.integers(0, 4)))
+        else:
+            del t[p:p + g]
+    return np.array(t, dtype=np.int8)
+
+
+def test_oracle_self_consistency():
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        m = int(rng.integers(5, 40))
+        q = rng.integers(0, 4, m).astype(np.int8)
+        t = _mutate(rng, q, 3, 2)
+        score, ops = full_gotoh_traceback(q, t)
+        assert ops_consumed(ops) == (len(q), len(t))
+        assert ops_score(ops, q, t) == score
+
+
+def test_device_matches_oracle_wide_band():
+    """Band covering the whole matrix => identical ops to the oracle."""
+    rng = np.random.default_rng(1)
+    qs, ts, qls, tls, oracle = [], [], [], [], []
+    m_max, n_max, T = 48, 56, 16
+    for _ in range(T):
+        m = int(rng.integers(8, m_max + 1))
+        q = rng.integers(0, 4, m).astype(np.int8)
+        t = _mutate(rng, q, 2, 2)[:n_max]
+        oracle.append(full_gotoh_traceback(q, t))
+        qs.append(np.pad(q, (0, m_max - len(q)), constant_values=127))
+        ts.append(np.pad(t, (0, n_max - len(t)), constant_values=127))
+        qls.append(len(q))
+        tls.append(len(t))
+    band = 256  # covers every diagonal of a 48x56 matrix (dlo = -128)
+    scores, ops_bwd, ok = banded_traceback_batch(
+        np.stack(qs), np.stack(ts), np.array(qls, np.int32),
+        np.array(tls, np.int32), band=band)
+    scores, ops_bwd, ok = (np.asarray(scores), np.asarray(ops_bwd),
+                           np.asarray(ok))
+    for k in range(T):
+        want_score, want_ops = oracle[k]
+        assert bool(ok[k]), k
+        assert int(scores[k]) == want_score, k
+        np.testing.assert_array_equal(ops_forward(ops_bwd[k]), want_ops,
+                                      err_msg=f"lane {k}")
+
+
+def test_device_narrow_band_invariants():
+    """With a narrow band the path may differ from the unbanded optimum,
+    but it must consume exact lengths and re-score to the DP score —
+    and the DP score must equal the scores-only kernel's."""
+    rng = np.random.default_rng(2)
+    m, T = 300, 24
+    q = rng.integers(0, 4, m).astype(np.int8)
+    n = m + 16
+    ts = np.full((T, n), 127, dtype=np.int8)
+    tls = np.zeros(T, dtype=np.int32)
+    for k in range(T):
+        t = _mutate(rng, q, 6, 3)[:n]
+        ts[k, :len(t)] = t
+        tls[k] = len(t)
+    band = 32
+    qs = np.broadcast_to(q, (T, m)).copy()
+    qls = np.full(T, m, dtype=np.int32)
+    scores, ops_bwd, ok = banded_traceback_batch(qs, ts, qls, tls,
+                                                 band=band)
+    scores, ops_bwd, ok = (np.asarray(scores), np.asarray(ops_bwd),
+                           np.asarray(ok))
+    # score parity vs the scores-only kernel (shared query, same band
+    # placement: dlo = -(band//2))
+    from pwasm_tpu.ops.banded_dp import band_dlo  # noqa: F401
+    want = np.asarray(banded_scores_batch(q, ts, tls, band=band))
+    for k in range(T):
+        assert bool(ok[k]), k
+        ops = ops_forward(ops_bwd[k])
+        assert ops_consumed(ops) == (m, int(tls[k])), k
+        assert ops_score(ops, q, ts[k]) == int(scores[k]), k
+    # banded_scores_batch centers the band differently (band_dlo uses
+    # n - m); only compare lanes where both placements cover the path
+    # fully — here n - m = 16 and band = 32 makes the two dlo values
+    # differ, so compare against a matched-dlo run instead
+    scores2, _, _ = banded_traceback_batch(
+        qs, ts, qls, tls, band=band,
+        dlo=band_dlo(m, n, band))
+    np.testing.assert_array_equal(np.asarray(scores2), want)
+
+
+def test_ops_to_gaps_matches_cigar_walk():
+    """DP re-alignment of a synthesized PAF alignment reproduces the
+    CIGAR walk's gap records exactly (unique-optimum construction)."""
+    import sys
+    sys.path.insert(0, "tests")
+    from helpers import make_paf_line
+
+    from pwasm_tpu.core.paf import parse_paf_line
+    from pwasm_tpu.core.events import extract_alignment
+    from pwasm_tpu.core.dna import revcomp
+
+    # seed chosen so the synthesized alignment is the unique optimum
+    # (gap junctions can't slide at equal score) — verified by the
+    # oracle-agreement assertion below
+    rng = np.random.default_rng(0)
+    q = "".join("ACGT"[i] for i in rng.integers(0, 4, 120))
+    for strand in ("+", "-"):
+        line, _ = make_paf_line(
+            "q", q, "t1", strand,
+            [("=", 30), ("ins", "TT"), ("=", 40), ("del", 3), ("=", 47)])
+        rec = parse_paf_line(line)
+        refseq_aln = revcomp(q.encode()) if strand == "-" else q.encode()
+        aln = extract_alignment(rec, refseq_aln)
+        al = rec.alninfo
+        q_seg = refseq_aln[aln.offset:
+                           aln.offset + (al.r_alnend - al.r_alnstart)]
+        [(score, ops)] = realign_pairs([(q_seg, aln.tseq)], band=64)
+        want_score, want_ops = full_gotoh_traceback(
+            encode(q_seg.upper()), encode(bytes(aln.tseq).upper()))
+        np.testing.assert_array_equal(ops, want_ops, err_msg=strand)
+        eff_t_len = al.t_alnend - al.t_alnstart
+        rgaps, tgaps = ops_to_gaps(ops, aln.offset, al.r_len, eff_t_len,
+                                   al.reverse)
+        assert [(g.pos, g.len) for g in rgaps] == \
+            [(g.pos, g.len) for g in aln.rgaps], strand
+        assert [(g.pos, g.len) for g in tgaps] == \
+            [(g.pos, g.len) for g in aln.tgaps], strand
+
+
+def test_realign_pairs_band_fallback():
+    """A pair whose length difference exceeds the band falls back to the
+    host oracle and still returns an exact path."""
+    rng = np.random.default_rng(4)
+    q = rng.integers(0, 4, 64).astype(np.int8)
+    t = np.concatenate([q[:32], rng.integers(0, 4, 100).astype(np.int8),
+                        q[32:]])
+    qb = bytes(b"ACGT"[c] for c in q)
+    tb = bytes(b"ACGT"[c] for c in t)
+    [(score, ops)] = realign_pairs([(qb, tb)], band=16)
+    want_score, want_ops = full_gotoh_traceback(q, t.astype(np.int8))
+    assert score == want_score
+    np.testing.assert_array_equal(ops, want_ops)
+
+
+@pytest.mark.parametrize("seed", [5, 6, 7])
+def test_randomized_path_validity(seed):
+    """Fuzz: random lengths/mutations, mixed lanes; every ok lane's path
+    is length-exact and score-exact."""
+    rng = np.random.default_rng(seed)
+    pairs = []
+    for _ in range(12):
+        m = int(rng.integers(20, 200))
+        q = rng.integers(0, 4, m).astype(np.int8)
+        t = _mutate(rng, q, int(rng.integers(0, 8)),
+                    int(rng.integers(0, 4)))
+        pairs.append((bytes(b"ACGT"[c] for c in q),
+                      bytes(b"ACGT"[c] for c in t)))
+    results = realign_pairs(pairs, band=32)
+    for (qb, tb), (score, ops) in zip(pairs, results):
+        qc = encode(qb)
+        tc = encode(tb)
+        assert ops_consumed(ops) == (len(qc), len(tc))
+        assert ops_score(ops, qc, tc) == score
