@@ -397,7 +397,7 @@ def cfg2_batched_dp() -> int:
                                         params=params)
     elif kernel == "packed":
         from pwasm_tpu.ops.pack import banded_scores_packed, pack_targets
-        tspd = jnp.asarray(pack_targets(np.where(ts == 127, 0, ts)))
+        tspd = jnp.asarray(pack_targets(ts))  # 127 pad packs as 'A'
         n_cols = ts.shape[1]
 
         def score_fn(tl_in):

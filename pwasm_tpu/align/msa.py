@@ -309,10 +309,18 @@ class Msa:
         span, clipped, or a deleted base).  Device pileup counts over this
         matrix equal the CPU column counts bit-for-bit.
 
-        Intended for pre-refine MSAs (no deleted bases).  With deleted
-        bases (negative gaps, post-refine) the cumsum layout collapses
-        dead bases onto neighboring columns; gap runs are written before
-        live bases so a live base always wins such a collision."""
+        Pre-refine MSAs only (enforced): with deleted bases (negative
+        gaps, created by remove_column/remove_base during refinement)
+        the cumsum layout collapses dead bases onto neighboring columns,
+        so the device pileup would silently drift from the CPU column
+        counts.  refine_msa's own device path takes its pileup before
+        any removal, so this never fires internally."""
+        for s in self.seqs:
+            if (s.gaps < 0).any():
+                raise PwasmError(
+                    f"pileup_matrix: sequence {s.name} has deleted bases "
+                    "(post-refine MSA); the device pileup is only exact "
+                    "pre-refine — use the host column counts instead\n")
         mat = np.full((len(self.seqs), self.length), 6, dtype=np.int8)
         for k, s in enumerate(self.seqs):
             base_cols, unclipped, gcols = self._column_geometry(s)

@@ -395,8 +395,9 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
             if res is None:  # outside realignment resource bounds:
                 # keep the PAF's own gap structure for this alignment
                 print(f"Warning: {al.r_id}~{al.t_id} not re-aligned "
-                      "(length difference beyond band ceiling); keeping "
-                      "PAF gaps", file=stderr)
+                      "(no band up to the escalation ceiling covered "
+                      "its optimal path, and it is too large for the "
+                      "host oracle); keeping PAF gaps", file=stderr)
             else:
                 _score, ops = res
                 aln.rgaps, aln.tgaps = ops_to_gaps(

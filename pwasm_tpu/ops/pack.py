@@ -28,21 +28,24 @@ import numpy as np
 def pack_targets(ts_codes: np.ndarray) -> np.ndarray:
     """Pack a (T, n) int8 base-code batch into (T, ceil(n/4)) uint8.
 
-    Codes outside 0..3 pack as base 0 ('A').  For PADDING (beyond each
-    row's t_len) that cannot change scores (module docstring); for N
-    bases INSIDE the aligned span it would — an N never matches in the
-    int8 path but 'A' can.  Callers with N-bearing targets must keep the
-    int8 path; the packed path is the fast transfer format for the
-    ACGT-only common case (enforced here with a cheap check).
+    Accepted codes are 0..3 (A/C/G/T) and the padding sentinel 127,
+    which packs as base 0 ('A').  For padding (beyond each row's t_len)
+    that cannot change scores (module docstring); any OTHER code (N=4,
+    gap codes, negatives) is rejected — 2-bit packing would silently
+    alias it to a real base, so N-bearing targets must use the int8
+    path.  This is the ACGT-only fast transfer format.
     """
     from pwasm_tpu.native import pack_2bit
 
     ts = np.ascontiguousarray(ts_codes, dtype=np.int8)
     T, n = ts.shape
-    if ((ts >= 4) & (ts <= 6)).any():
+    bad = (ts < 0) | ((ts > 3) & (ts != 127))
+    if bad.any():
         raise ValueError(
-            "pack_targets: batch contains N/gap codes inside rows; "
-            "2-bit packing would alias them to 'A' — use the int8 path")
+            "pack_targets: batch contains codes outside {0..3, 127 pad}; "
+            "2-bit packing would alias them to real bases — use the int8 "
+            "path")
+    ts = np.where(ts == 127, np.int8(0), ts)
     nb = (n + 3) // 4
     if n % 4:
         ts = np.pad(ts, ((0, 0), (0, 4 * nb - n)))

@@ -355,6 +355,10 @@ def _pick_dlo(d_ends: np.ndarray, band: int) -> int:
 # of Python time / gigabytes of int64 — escalate the device band instead
 _ORACLE_CELL_LIMIT = 4_000_000
 _MAX_BAND = 4096
+# ceiling on the device pointer tensor (T_chunk x m_max x band uint8)
+# per dispatch; lanes are chunked to stay under it, and a single lane
+# whose m_max x band alone exceeds it skips the device path entirely
+_PTR_BYTES_LIMIT = 1 << 30
 
 
 def realign_pairs(pairs: list[tuple[bytes, bytes]], band: int = 64,
@@ -396,19 +400,27 @@ def realign_pairs(pairs: list[tuple[bytes, bytes]], band: int = 64,
     # ceiling; the ceiling bounds only the automatic retries
     while len(todo) and (first or cur_band <= _MAX_BAND):
         first = False
-        sub = todo
-        dlo = _pick_dlo(t_lens[sub] - q_lens[sub], cur_band)
-        scores, ops_bwd, ok = banded_traceback_batch(
-            jnp.asarray(qs[sub]), jnp.asarray(ts[sub]),
-            jnp.asarray(q_lens[sub]), jnp.asarray(t_lens[sub]),
-            band=cur_band, params=params, dlo=dlo)
-        scores = np.asarray(scores)
-        ops_bwd = np.asarray(ops_bwd)
-        ok = np.asarray(ok)
-        for idx, k in enumerate(sub):
-            if ok[idx]:
-                out[k] = (int(scores[idx]), ops_forward(ops_bwd[idx]))
-        todo = sub[~ok]
+        lane_bytes = m_max * cur_band
+        if lane_bytes > _PTR_BYTES_LIMIT:
+            break  # even one lane's pointer plane is too large
+        chunk = max(1, _PTR_BYTES_LIMIT // lane_bytes)
+        still = []
+        for c0 in range(0, len(todo), chunk):
+            sub = todo[c0:c0 + chunk]
+            dlo = _pick_dlo(t_lens[sub] - q_lens[sub], cur_band)
+            scores, ops_bwd, ok = banded_traceback_batch(
+                jnp.asarray(qs[sub]), jnp.asarray(ts[sub]),
+                jnp.asarray(q_lens[sub]), jnp.asarray(t_lens[sub]),
+                band=cur_band, params=params, dlo=dlo)
+            scores = np.asarray(scores)
+            ops_bwd = np.asarray(ops_bwd)
+            ok = np.asarray(ok)
+            for idx, k in enumerate(sub):
+                if ok[idx]:
+                    out[k] = (int(scores[idx]),
+                              ops_forward(ops_bwd[idx]))
+            still.extend(sub[~ok])
+        todo = np.array(still, dtype=np.int64)
         cur_band = max(cur_band * 4, 4)
     for k in todo:
         # beyond the band ceiling: bounded host oracle or give up
