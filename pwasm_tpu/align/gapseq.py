@@ -222,8 +222,162 @@ class GapSeq:
                         skip_dels: bool = False) -> None:
         """Re-align the clipped ends against the consensus with an X-drop
         extension, updating clp5/clp3 (GASeq::refineClipping,
-        GapAssem.cpp:182-349).  ``cpos`` is this sequence's start column on
-        the consensus."""
+        GapAssem.cpp:182-349).  ``cpos`` is this sequence's start column
+        on the consensus.
+
+        Vectorized: the gapped layout, the initial-match search and the
+        X-drop extension are numpy array passes (cumsum/argmax) instead
+        of the reference's per-character walk — O(layout length) numpy
+        work per end.  Bit-exact with ``refine_clipping_scalar`` (the
+        direct transliteration kept below as the parity oracle;
+        tests/test_gapseq_refine.py fuzzes the two against each other).
+        """
+        if self.clp3 == 0 and self.clp5 == 0:
+            return
+        cons_arr = np.frombuffer(cons, dtype=np.uint8)
+        cons_len = len(cons)
+        rev = self.revcompl != 0
+        clipL, clipR = self.clip_lr()
+        star = ord("*")
+
+        g = self.gaps.astype(np.int64)
+        glen0 = self.seqlen + self.numgaps
+        allocsize = glen0
+        gclipL, gclipR = clipL, clipR
+        if skip_dels:
+            right = g[self.seqlen - clipR:] if clipR else g[:0]
+            left = g[:clipL]
+            allocsize += int((right < 0).sum()) + int((left < 0).sum())
+            gclipR += int(right[right >= 0].sum())
+            gclipL += int(left[left >= 0].sum())
+        else:
+            gclipR += int(g[self.seqlen - clipR:].sum()) if clipR else 0
+            gclipL += int(g[:clipL].sum())
+
+        # gapped layout: per base, max(g,0) star columns then the base
+        # (deleted bases emit nothing unless skip_dels keeps clip-region
+        # ones, mirroring GapAssem.cpp:254-266)
+        stars = np.maximum(g, 0)
+        if skip_dels:
+            in_clip = np.zeros(self.seqlen, dtype=bool)
+            if clipL:
+                in_clip[:clipL] = True
+            if clipR:
+                in_clip[self.seqlen - clipR:] = True
+            include = (g >= 0) | in_clip
+        else:
+            include = g >= 0
+        glen = glen0 + int((include & (g < 0)).sum())
+        if glen != allocsize:
+            raise PwasmError(
+                f"Length mismatch (allocsize {allocsize} vs. glen {glen}) "
+                f"while refineClipping for seq {self.name} !\n")
+        counts = stars + include
+        ends = np.cumsum(counts)
+        total = int(ends[-1]) if self.seqlen else 0
+        gseq = np.full(total, star, dtype=np.uint8)
+        gxpos = np.full(total, -1, dtype=np.int64)
+        seq_arr = np.frombuffer(bytes(self.seq), dtype=np.uint8)
+        base_idx = (ends - 1)[include]
+        gseq[base_idx] = seq_arr[include]
+        gxpos[base_idx] = np.nonzero(include)[0]
+
+        def write_back():
+            # the reference's clipL/clipR are int& aliases of clp5/clp3,
+            # so every increment persists even on early aborts
+            if rev:
+                self.clp3, self.clp5 = clipL, clipR
+            else:
+                self.clp5, self.clp3 = clipL, clipR
+
+        def _take(arr, idx, valid):
+            """arr[idx] where valid, 0 elsewhere — safe for empty arr
+            and out-of-range idx (np.where would evaluate eagerly)."""
+            out = np.zeros(len(idx), dtype=np.uint8)
+            if arr.size:
+                out[valid] = arr[idx[valid]]
+            return out
+
+        def seek(sp_cand, cp_cand):
+            """Initial-match search over candidate positions (in walk
+            order): returns (index of first match or None, #clip bumps
+            before it / over all candidates)."""
+            valid_s = (sp_cand >= 0) & (sp_cand < total)
+            gs = _take(gseq, sp_cand, valid_s)
+            valid_c = (cp_cand >= 0) & (cp_cand < cons_len)
+            cs = _take(cons_arr, cp_cand, valid_c)
+            hit = valid_s & valid_c & (gs == cs) & (gs != star)
+            bump = valid_s & (gs != star)
+            if not hit.any():
+                return None, int(bump.sum())
+            k = int(np.argmax(hit))
+            return k, int(bump[:k].sum())
+
+        def extend(sp_m, cp_m, direction):
+            """X-drop extension from the initial match at (sp_m, cp_m);
+            returns bestpos (== sp_m when no improvement)."""
+            if direction > 0:
+                K = min(glen - 1 - sp_m, cons_len - 1 - cp_m)
+            else:
+                K = min(sp_m, cp_m)
+            if K <= 0:
+                return sp_m
+            ks = np.arange(1, K + 1)
+            gs = gseq[sp_m + direction * ks]
+            cs = cons_arr[cp_m + direction * ks]
+            nonstar = gs != star
+            eq = gs == cs
+            delta = np.where(nonstar,
+                             np.where(eq, self.MATCH_SC,
+                                      self.MISMATCH_SC), 0)
+            scores = self.MATCH_SC + np.cumsum(delta)
+            stop = scores <= self.XDROP
+            limit = int(np.argmax(stop)) + 1 if stop.any() else K
+            cand = np.where(eq & nonstar, scores, self.XDROP)[:limit]
+            if cand.size and cand.max() > self.MATCH_SC:
+                return sp_m + direction * (int(np.argmax(cand)) + 1)
+            return sp_m
+
+        if clipR > 0:
+            sp0 = glen - gclipR - 1
+            # candidates walk down to gclipL; below it the scalar aborts
+            n_cand = (sp0 - gclipL + 1) if sp0 >= gclipL else 1
+            d = np.arange(n_cand, dtype=np.int64)
+            k, bumps = seek(sp0 - d, cpos + sp0 - d)
+            if k is None:
+                clipR += bumps
+                print(f"Warning: reached clipL trying to find an "
+                      f"initial match on {self.name}!", file=sys.stderr)
+                write_back()
+                return
+            clipR += bumps
+            sp_m = sp0 - k
+            bestpos = extend(sp_m, cpos + sp_m, +1)
+            if bestpos > sp_m:
+                clipR = self.seqlen - int(gxpos[bestpos]) - 1
+        if clipL > 0:
+            sp0 = gclipL
+            hi = glen - gclipR - 1  # candidates walk up to here
+            n_cand = (hi - sp0 + 1) if hi >= sp0 else 1
+            d = np.arange(n_cand, dtype=np.int64)
+            k, bumps = seek(sp0 + d, cpos + sp0 + d)
+            if k is None:
+                clipL += bumps
+                print(f"Warning: reached clipR trying to find an "
+                      f"initial match on {self.name}!", file=sys.stderr)
+                write_back()
+                return
+            clipL += bumps
+            sp_m = sp0 + k
+            bestpos = extend(sp_m, cpos + sp_m, -1)
+            if bestpos < sp_m:
+                clipL = int(gxpos[bestpos])
+        write_back()
+
+    def refine_clipping_scalar(self, cons: bytes, cpos: int,
+                               skip_dels: bool = False) -> None:
+        """Direct transliteration of the reference walk (the parity
+        oracle for the vectorized ``refine_clipping``)."""
         if self.clp3 == 0 and self.clp5 == 0:
             return
         cons_len = len(cons)

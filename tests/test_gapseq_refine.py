@@ -1,0 +1,132 @@
+"""Parity fuzz for the vectorized X-drop clip refinement.
+
+The vectorized ``refine_clipping`` must be bit-exact with the
+transliterated reference walk ``refine_clipping_scalar`` on arbitrary
+gapped sequences, clips and consensus offsets (VERDICT r1 next-step 4).
+"""
+
+import io
+import contextlib
+import time
+
+import numpy as np
+import pytest
+
+from pwasm_tpu.align.gapseq import GapSeq
+
+
+def _random_gapseq(rng, seqlen=None, with_dels=False):
+    seqlen = seqlen or int(rng.integers(10, 60))
+    seq = bytes(rng.choice(list(b"ACGT"), seqlen))
+    s = GapSeq(f"s{rng.integers(1e9)}", "", seq)
+    for _ in range(int(rng.integers(0, 6))):
+        s.set_gap(int(rng.integers(0, seqlen)), int(rng.integers(1, 4)))
+    if with_dels:
+        for _ in range(int(rng.integers(0, 3))):
+            p = int(rng.integers(0, seqlen))
+            if s.gaps[p] <= 0:
+                s.remove_base(p)
+    s.clp5 = int(rng.integers(0, max(1, seqlen // 3)))
+    s.clp3 = int(rng.integers(0, max(1, seqlen // 3)))
+    s.revcompl = int(rng.integers(0, 2))
+    return s
+
+
+def _clone(s: GapSeq) -> GapSeq:
+    c = GapSeq(s.name, s.descr, bytes(s.seq))
+    c.gaps = s.gaps.copy()
+    c.numgaps = s.numgaps
+    c.clp5, c.clp3 = s.clp5, s.clp3
+    c.revcompl = s.revcompl
+    c.offset = s.offset
+    return c
+
+
+def _run_both(s: GapSeq, cons: bytes, cpos: int, skip_dels: bool):
+    a, b = _clone(s), _clone(s)
+    ea, eb = io.StringIO(), io.StringIO()
+    with contextlib.redirect_stderr(ea):
+        a.refine_clipping(cons, cpos, skip_dels=skip_dels)
+    with contextlib.redirect_stderr(eb):
+        b.refine_clipping_scalar(cons, cpos, skip_dels=skip_dels)
+    assert (a.clp5, a.clp3) == (b.clp5, b.clp3), \
+        (s.name, cons, cpos, skip_dels, s.revcompl,
+         (a.clp5, a.clp3), (b.clp5, b.clp3))
+    assert ea.getvalue() == eb.getvalue()
+
+
+@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("skip_dels", [False, True])
+@pytest.mark.parametrize("with_dels", [False, True])
+def test_refine_clipping_matches_scalar_fuzz(seed, skip_dels, with_dels):
+    # with_dels x skip_dels decoupled: refine_msa's FIRST refine call
+    # runs skip_dels=False on sequences already carrying deleted bases
+    # (msa.py refine driver), so that regime needs oracle coverage too
+    rng = np.random.default_rng(seed)
+    for _ in range(40):
+        s = _random_gapseq(rng, with_dels=with_dels)
+        glen = s.seqlen + s.numgaps
+        # consensus: sometimes related to the sequence, sometimes noise;
+        # cpos jittered so edge clamps are exercised
+        if rng.random() < 0.6:
+            cons = bytes(s.seq) + bytes(rng.choice(list(b"ACGT"),
+                                                   int(rng.integers(0, 9))))
+        else:
+            cons = bytes(rng.choice(list(b"ACGT"),
+                                    max(4, glen + int(rng.integers(-4, 5)))))
+        cpos = int(rng.integers(-3, 6))
+        _run_both(s, cons, cpos, skip_dels)
+
+
+def test_refine_clipping_degenerate_inputs():
+    """Empty consensus and fully-deleted layouts must warn + return like
+    the scalar oracle, not crash (masked takes in seek)."""
+    rng = np.random.default_rng(5)
+    # empty consensus
+    s = _random_gapseq(rng)
+    s.clp5, s.clp3 = 2, 2
+    _run_both(s, b"", 0, False)
+    # every base deleted -> empty gapped layout
+    s2 = GapSeq("alldel", "", b"ACGT")
+    for p in range(4):
+        s2.remove_base(p)
+    s2.clp3 = 2
+    _run_both(s2, b"ACGTACGT", 0, False)
+
+
+def test_refine_clipping_mixed_case_consensus():
+    """A consensus containing '*' gap columns (from refine_msa with
+    remove_cons_gaps=False) exercises the star-vs-star comparisons."""
+    rng = np.random.default_rng(99)
+    for _ in range(30):
+        s = _random_gapseq(rng)
+        glen = s.seqlen + s.numgaps
+        cons = bytearray(rng.choice(list(b"ACGT*"), glen + 6))
+        _run_both(s, bytes(cons), int(rng.integers(0, 4)), False)
+
+
+def test_refine_clipping_256_member_timing():
+    """The vectorized pass over a 256-member, ~1.5 kb pileup must run in
+    interactive time (the reference's per-character walk was the serial
+    hot loop of BASELINE config 4)."""
+    rng = np.random.default_rng(7)
+    m = 1500
+    base = rng.choice(list(b"ACGT"), m).astype(np.uint8)
+    seqs = []
+    for _ in range(256):
+        arr = base.copy()
+        idx = rng.integers(0, m, 40)
+        arr[idx] = rng.choice(list(b"ACGT"), 40)
+        s = GapSeq(f"r{len(seqs)}", "", bytes(arr))
+        s.clp5 = int(rng.integers(1, 30))
+        s.clp3 = int(rng.integers(1, 30))
+        for _ in range(4):
+            s.set_gap(int(rng.integers(0, m)), 1)
+        seqs.append(s)
+    cons = bytes(base)
+    t0 = time.perf_counter()
+    for s in seqs:
+        s.refine_clipping(cons, 0)
+    dt = time.perf_counter() - t0
+    # generous CI bound; the scalar walk takes ~10x longer
+    assert dt < 2.0, f"vectorized refine too slow: {dt:.2f}s"
