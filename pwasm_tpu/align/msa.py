@@ -332,6 +332,75 @@ class Msa:
             mat[k, base_cols[live]] = codes[live]
         return mat
 
+    def provenance_matrix(self) -> np.ndarray:
+        """(depth, length) int32 companion of ``pileup_matrix``: the
+        1-based source position of each member's base at each layout
+        column, 0 where the member contributes no base (outside its
+        span, clipped, deleted, or a gap column).
+
+        This is the tensor re-design of the reference's per-column
+        ``NucOri`` provenance list (GapAssem.h:142-161, nucs in
+        GAlnColumn GapAssem.h:255-342): instead of a linked list of
+        (seq, pos) per column, one dense index tensor aligned with the
+        pileup codes, so "which read put which base here" is a gather.
+        Pre-refine MSAs only, like pileup_matrix (same exactness
+        argument)."""
+        for s in self.seqs:
+            if (s.gaps < 0).any():
+                raise PwasmError(
+                    f"provenance_matrix: sequence {s.name} has deleted "
+                    "bases (post-refine MSA); provenance is only exact "
+                    "pre-refine\n")
+        prov = np.zeros((len(self.seqs), self.length), dtype=np.int32)
+        for k, s in enumerate(self.seqs):
+            base_cols, unclipped, _g = self._column_geometry(s)
+            live = unclipped & (s.gaps >= 0)
+            prov[k, base_cols[live]] = np.nonzero(live)[0] + 1
+        return prov
+
+    def column_contributors(self, col: int) -> list[tuple]:
+        """Who contributes what at layout column ``col``: a list of
+        ``(member_index, base_pos, symbol, clipped)`` where symbol is
+        the member's base character at that column, '-' for a gap
+        column inside its span, and base_pos is the 0-based position in
+        the member's sequence (for '-', the base the gap run precedes).
+        Members whose span does not cover the column are absent.
+        The queryable surface of the reference's NucOri/GAlnColumn
+        provenance (clipped contributors mirror the stored clip
+        witness, GapAssem.h:295-337)."""
+        out = []
+        for k, s in enumerate(self.seqs):
+            base_cols, unclipped, _g = self._column_geometry(s)
+            gaps = s.gaps.astype(np.int64)
+            j = int(np.searchsorted(base_cols, col, side="left"))
+            if j >= s.seqlen:
+                continue
+            if base_cols[j] == col:
+                if gaps[j] < 0:
+                    continue  # deleted base: no contribution
+                out.append((k, j, chr(s.seq[j]), not bool(unclipped[j])))
+            elif base_cols[j] - max(int(gaps[j]), 0) <= col < base_cols[j]:
+                if unclipped[j]:
+                    out.append((k, j, "-", False))
+        return out
+
+    def column_mismatches(self, col: int) -> list[tuple]:
+        """Contributors at ``col`` that disagree with the column's
+        consensus vote — the SNP-attribution query the reference's
+        provenance list exists for.  Requires ``build_msa()`` (the
+        counts).  Returns ``(member_index, base_pos, symbol)`` for every
+        unclipped contributor whose symbol differs from the vote."""
+        if self.msacolumns is None:
+            raise PwasmError(
+                "column_mismatches requires build_msa() first\n")
+        vote = best_char_from_counts(
+            self.msacolumns.counts[col],
+            int(self.msacolumns.layers[col]))
+        want = chr(vote) if vote else ""
+        return [(k, pos, sym) for k, pos, sym, clipped
+                in self.column_contributors(col)
+                if not clipped and sym.upper() != want]
+
     def build_msa(self) -> None:
         """(GSeqAlign::buildMSA, GapAssem.cpp:1088-1106)"""
         if self.msacolumns is not None:
