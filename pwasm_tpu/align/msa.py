@@ -99,6 +99,7 @@ class Msa:
         self.badseqs = 0
         self.consensus = bytearray()
         self.msacolumns: MsaColumns | None = None
+        self._device_vote_chars: np.ndarray | None = None
         self.refined = False
         if s1 is not None and s2 is not None:
             s1.msa = self
@@ -277,22 +278,28 @@ class Msa:
             gcols = np.empty(0, dtype=np.int64)
         return base_cols, unclipped, gcols
 
-    def _seq_to_columns(self, s: GapSeq, cols: MsaColumns) -> None:
+    def _seq_to_columns(self, s: GapSeq, cols: MsaColumns,
+                        count: bool = True) -> None:
         """Pour one sequence into the column pileup (GASeq::toMSA,
-        GapAssem.cpp:551-591) — vectorized scatter-adds."""
+        GapAssem.cpp:551-591) — vectorized scatter-adds.  With
+        ``count=False`` only the geometry side effects happen (clip
+        witnesses + the live window); the counts are expected to come
+        from the device pileup kernel instead."""
         base_cols, unclipped, gcols = self._column_geometry(s)
         gaps = s.gaps.astype(np.int64)
-        codes = _BUCKET[np.frombuffer(bytes(s.seq), dtype=np.uint8)].astype(
-            np.int64)
         clipped = ~unclipped
-        # nucleotides (clipped ones only set the witness flag)
-        np.add.at(cols.counts, (base_cols[unclipped], codes[unclipped]), 1)
-        np.add.at(cols.layers, base_cols[unclipped], 1)
         cols.has_clip[base_cols[clipped]] = True
-        # gap columns before each unclipped base
-        if len(gcols):
-            np.add.at(cols.counts, (gcols, np.full(len(gcols), 5)), 1)
-            np.add.at(cols.layers, gcols, 1)
+        if count:
+            codes = _BUCKET[np.frombuffer(bytes(s.seq),
+                                          dtype=np.uint8)].astype(np.int64)
+            # nucleotides (clipped ones only set the witness flag)
+            np.add.at(cols.counts, (base_cols[unclipped],
+                                    codes[unclipped]), 1)
+            np.add.at(cols.layers, base_cols[unclipped], 1)
+            # gap columns before each unclipped base
+            if len(gcols):
+                np.add.at(cols.counts, (gcols, np.full(len(gcols), 5)), 1)
+                np.add.at(cols.layers, gcols, 1)
         # min/max over the unclipped span: mincol includes the gap run
         # before the first unclipped base (GapAssem.cpp:565-590)
         if unclipped.any():
@@ -401,10 +408,23 @@ class Msa:
                 in self.column_contributors(col)
                 if not clipped and sym.upper() != want]
 
-    def build_msa(self) -> None:
-        """(GSeqAlign::buildMSA, GapAssem.cpp:1088-1106)"""
+    def build_msa(self, device: bool = False) -> None:
+        """(GSeqAlign::buildMSA, GapAssem.cpp:1088-1106).  With ``device``
+        the column counts (and the consensus votes) come from one Pallas
+        launch over ``pileup_matrix()`` (ops.consensus.consensus_pallas —
+        the device form of toMSA+bestChar, GapAssem.cpp:1088-1106 /
+        1048-1069); the host keeps only the geometry side effects (live
+        window, clip witnesses, bad-trim flags).  Bit-exact: the pileup
+        matrix reproduces the CPU column counts pre-refine (see
+        pileup_matrix)."""
         if self.msacolumns is not None:
             raise PwasmError("Error: cannot call buildMSA() twice!\n")
+        if device and any((s.gaps < 0).any() for s in self.seqs):
+            # deleted bases make the device pileup inexact (see
+            # pileup_matrix); keep correctness by counting on host
+            print("pwasm: MSA has deleted bases; consensus counts fall "
+                  "back to host", file=sys.stderr)
+            device = False
         self.msacolumns = MsaColumns(self.length, self.minoffset)
         for i, s in enumerate(self.seqs):
             s.msaidx = i
@@ -415,7 +435,9 @@ class Msa:
                       file=sys.stderr)
                 s.set_flag(FLAG_BAD_ALN)
                 self.badseqs += 1
-            self._seq_to_columns(s, self.msacolumns)
+            self._seq_to_columns(s, self.msacolumns, count=not device)
+        if device:
+            self._device_count_votes()
 
     def _err_zero_cov(self, col: int) -> None:
         """(GSeqAlign::ErrZeroCov, GapAssem.cpp:1121-1131; exit 5)"""
@@ -426,25 +448,30 @@ class Msa:
             print(s.name, file=sys.stderr)
         raise ZeroCoverageError(f"zero-coverage column {col}")
 
-    def device_votes(self) -> np.ndarray:
-        """All column votes in one batched device call: push the
-        [mincol, maxcol] slice of the count tensor through the consensus
-        vote kernel (ops.consensus.consensus_vote_counts) and map codes to
-        the reference's winning characters.  Zero-coverage columns map to 0,
-        exactly like ``best_char``.  Bit-exact with the per-column CPU vote
-        by construction (same closed-form rule over the same int counts)."""
+    def _device_count_votes(self) -> None:
+        """Fill the column counts AND the consensus votes from one device
+        launch: ``pileup_matrix()`` → ``consensus_pallas`` (pileup counting
+        + the bestChar vote fused in a single Pallas kernel).  This is the
+        device form of the reference's toMSA+bestChar hot loop
+        (GapAssem.cpp:1088-1106, 1048-1069).  Zero-coverage columns vote 0,
+        exactly like ``best_char``.  Bit-exact with the CPU path by
+        construction: integer counts over the same pileup, same closed-form
+        vote rule."""
         import jax.numpy as jnp
 
-        from pwasm_tpu.ops.consensus import consensus_vote_counts
+        from pwasm_tpu.ops.consensus import consensus_pallas
 
         cols = self.msacolumns
-        counts = jnp.asarray(cols.counts[cols.mincol:cols.maxcol + 1])
-        v = np.asarray(consensus_vote_counts(counts))
+        votes, counts = consensus_pallas(jnp.asarray(self.pileup_matrix()))
+        counts = np.asarray(counts)
+        cols.counts[:] = counts
+        cols.layers[:] = counts.sum(axis=1, dtype=np.int32)
+        v = np.asarray(votes)
         table = np.frombuffer(b"ACGTN-", dtype=np.uint8)
-        out = np.zeros(len(v), dtype=np.int64)
+        chars = np.zeros(len(v), dtype=np.int64)
         valid = v >= 0
-        out[valid] = table[v[valid]]
-        return out
+        chars[valid] = table[v[valid]]
+        self._device_vote_chars = chars
 
     def refine_msa(self, remove_cons_gaps: bool = True,
                    refine_clipping: bool = True,
@@ -452,13 +479,16 @@ class Msa:
         """Consensus construction + clipping refinement driver
         (GSeqAlign::refineMSA, GapAssem.cpp:1133-1183).  The two flags are
         the reference's MSAColumns statics; pafreport runs with
-        remove_cons_gaps=False (SURVEY.md §2.5.8).  With ``device`` the
-        column votes are computed in one batched device kernel call instead
-        of per-column on host (same integer rule, bit-exact)."""
-        self.build_msa()
+        remove_cons_gaps=False (SURVEY.md §2.5.8).  With ``device`` both
+        the column counts and the votes come from one Pallas launch over
+        the pileup tensor (see build_msa/_device_count_votes) instead of
+        host scatter-adds + per-column votes (same integer rule,
+        bit-exact)."""
+        self.build_msa(device=device)
         cols = self.msacolumns
-        votes = self.device_votes() if device else None
-        if votes is None:
+        if device and self._device_vote_chars is not None:
+            votes = self._device_vote_chars[cols.mincol:cols.maxcol + 1]
+        else:
             # native single-core vote over the whole live window when
             # available (bit-exact with best_char_from_counts; parity
             # covered by tests/test_native.py)
@@ -469,8 +499,7 @@ class Msa:
         cols_removed = 0
         consensus = bytearray()
         for col in range(cols.mincol, cols.maxcol + 1):
-            c = int(votes[col - cols.mincol]) if votes is not None \
-                else cols.best_char(col)
+            c = int(votes[col - cols.mincol])
             if c == 0:
                 self._err_zero_cov(col)
             if c in (ord("-"), ord("*")):

@@ -263,6 +263,41 @@ def test_consensus_device_matches_cpu(tmp_path):
     assert out_dev.read_text() == out_cpu.read_text()
 
 
+def test_ace_device_deep_pileup_kernel_counts(tmp_path, monkeypatch):
+    """--ace --device=tpu on a 256-deep pileup: the consensus counts come
+    from the Pallas kernel (spied call over the full-depth pileup) and the
+    ACE output is byte-identical to the host engine (VERDICT r2 next #1)."""
+    lines = []
+    for k in range(256):
+        ops = [[("=", 10)],
+               [("=", 6), ("ins", "gg"), ("=", 4)],
+               [("=", 2), ("del", 2), ("=", 6)]][k % 3]
+        l, _ = make_paf_line("q", Q, f"t{k:03d}", "+", ops)
+        lines.append(l)
+    paf, fa = _mk_inputs(tmp_path, lines)
+    out_cpu = tmp_path / "cpu.ace"
+    out_dev = tmp_path / "dev.ace"
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / "r1.dfa"),
+              f"--ace={out_cpu}"], stderr=io.StringIO())
+    assert rc == 0
+
+    import pwasm_tpu.ops.consensus as consmod
+    shapes = []
+    real = consmod.consensus_pallas
+
+    def spy(bases, *a, **k):
+        shapes.append(tuple(bases.shape))
+        return real(bases, *a, **k)
+
+    monkeypatch.setattr(consmod, "consensus_pallas", spy)
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / "r2.dfa"),
+              f"--ace={out_dev}", "--device=tpu"], stderr=io.StringIO())
+    assert rc == 0
+    # one kernel launch over the full pileup: ref + 256 targets deep
+    assert shapes and shapes[0][0] == 257
+    assert out_dev.read_text() == out_cpu.read_text()
+
+
 def test_cons_requires_gene_mode(tmp_path):
     paf, fa = _mk_inputs(tmp_path, _three_alignments())
     err = io.StringIO()
