@@ -1,8 +1,10 @@
 #!/usr/bin/env python
-"""pwasm-tpu benchmark — prints ONE JSON line for the driver.
+"""pwasm-tpu benchmark — one JSON line per config for the driver.
 
-``PWASM_BENCH_CONFIG`` selects one of the five BASELINE.md configs
-(default 2, the headline):
+A bare ``python bench.py`` runs ALL configs sequentially (each in its own
+bounded subprocess), prints each config's JSON line as it completes with
+the headline config (2) LAST, and writes the full table to
+``BENCH_ALL.json``.  ``PWASM_BENCH_CONFIG=k`` runs a single config:
 
 1. end-to-end ``pafreport`` CPU reference: 1 CDS vs 1 Nanopore-style
    assembly through the real CLI (parse -> diff extraction -> context ->
@@ -15,6 +17,10 @@
    Pallas kernel — pileup bases/sec, bit-exact vs the CPU engine vote.
 5. long-read 50 kb banded DP, HBM-streaming double-buffered wavefront —
    aligned target bases/sec.
+6. re-aligner end-to-end: banded DP with device traceback (forward pass
+   emitting packed pointers + lax.scan walk) on 1 CDS vs 10k targets,
+   plus the host op->GapData conversion — re-aligned target bases/sec,
+   parity-gated against the unbanded full-Gotoh host oracle.
 
 ``vs_baseline`` is the speedup over the single-core CPU equivalent of the
 same computation (C++ banded Gotoh for DP configs, the reference-style
@@ -35,7 +41,8 @@ pipeline of launches (each rep consumes the previous rep's output through
 ending in one host fetch, at two pipeline depths k and 2k; per-rep time
 is ``(t(2k) - t(k)) / k``, which cancels the fixed round-trip latency.
 
-Env knobs: PWASM_BENCH_CONFIG (1-5, default 2), PWASM_BENCH_T (targets,
+Env knobs: PWASM_BENCH_CONFIG (1-6, or unset/'all' for the full table),
+PWASM_BENCH_T (targets,
 default 10240), PWASM_BENCH_Q (config-3 queries, default 500),
 PWASM_BENCH_KERNEL=pallas|stream|xla (config-2 kernel, default pallas),
 PWASM_BENCH_BAND (default 64), PWASM_BENCH_CPU_T (CPU-baseline subset,
@@ -193,7 +200,8 @@ def _scale_for_fallback(cfg: str) -> None:
     Explicit PWASM_BENCH_* env settings always win; the measured rate is
     still honest for the platform reported to stderr."""
     global REPS
-    small_t = {"2": "512", "3": "256", "4": str(1 << 16), "5": "4"}
+    small_t = {"2": "512", "3": "256", "4": str(1 << 16), "5": "4",
+               "6": "256"}
     if cfg in small_t:
         os.environ.setdefault("PWASM_BENCH_T", small_t[cfg])
     if cfg == "3":
@@ -599,12 +607,179 @@ def cfg5_longread() -> int:
                  rate / cpu_rate if cpu_rate else 0.0)
 
 
+# ---------------------------------------------------------------------------
+# config 6 — re-aligner end-to-end: device traceback + host gap conversion
+# ---------------------------------------------------------------------------
+def cfg6_realign() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from pwasm_tpu.ops.banded_dp import ScoreParams, band_dlo
+    from pwasm_tpu.ops.realign import (banded_realign_rows, _gaps_jit,
+                                       banded_traceback_batch,
+                                       full_gotoh_traceback,
+                                       gap_slots_to_gapdata, ops_consumed,
+                                       ops_forward, ops_score,
+                                       rows_to_ops_fwd)
+
+    T = int(os.environ.get("PWASM_BENCH_T", "10240"))
+    params = ScoreParams()
+    q, ts, t_lens = _workload(T, m=1500)
+    q_lens = np.full(T, len(q), dtype=np.int32)
+    dlo = band_dlo(len(q), ts.shape[1], BAND)
+    qsd = jnp.asarray(np.broadcast_to(q, (T, len(q))).copy())
+    tsd = jnp.asarray(ts)
+    qld, tld = jnp.asarray(q_lens), jnp.asarray(t_lens)
+
+    # parity gate: 12 small random pairs, device path (same band) vs the
+    # unbanded full-Gotoh host oracle — scores AND op strings identical
+    rng = np.random.default_rng(11)
+    small = []
+    for _ in range(12):
+        m_s = int(rng.integers(40, 150))
+        qq = rng.integers(0, 4, size=m_s).astype(np.int8)
+        tt = list(qq)
+        for _ in range(int(rng.integers(0, 10))):
+            p = int(rng.integers(1, len(tt) - 1))
+            r = rng.random()
+            if r < 0.4:
+                tt[p] = int(rng.integers(0, 4))
+            elif r < 0.7:
+                tt.insert(p, int(rng.integers(0, 4)))
+            else:
+                del tt[p]
+        small.append((qq, np.array(tt, dtype=np.int8)))
+    sm = max(len(p[0]) for p in small)
+    sn = max(len(p[1]) for p in small)
+    sqs = np.full((12, sm), 127, dtype=np.int8)
+    sts = np.full((12, sn), 127, dtype=np.int8)
+    for k, (qq, tt) in enumerate(small):
+        sqs[k, :len(qq)] = qq
+        sts[k, :len(tt)] = tt
+    sql = np.array([len(p[0]) for p in small], dtype=np.int32)
+    stl = np.array([len(p[1]) for p in small], dtype=np.int32)
+    sc_d, ops_d, ok_d = banded_traceback_batch(
+        jnp.asarray(sqs), jnp.asarray(sts), jnp.asarray(sql),
+        jnp.asarray(stl), band=BAND, params=params)
+    sc_d, ops_d, ok_d = (np.asarray(sc_d), np.asarray(ops_d),
+                         np.asarray(ok_d))
+    for k, (qq, tt) in enumerate(small):
+        sc_o, ops_o = full_gotoh_traceback(qq, tt, params)
+        if (not ok_d[k] or int(sc_d[k]) != sc_o
+                or not np.array_equal(ops_forward(ops_d[k]), ops_o)):
+            return _fail("realign_parity")
+
+    # full end-to-end pass once: device forward+walk+gap-extraction, gap
+    # slots fetched, converted to GapData on host; every lane must close
+    scores_d, leads_d, iy_d, ops_d, ok_d = banded_realign_rows(
+        qsd, tsd, qld, tld, band=BAND, params=params, dlo=dlo)
+    slots = _gaps_jit(leads_d, iy_d, ops_d, qld, 32)
+    scores_h = np.asarray(scores_d)
+    ok_h = np.asarray(ok_d)
+    rg_pos, rg_len, r_cnt, tg_pos, tg_len, t_cnt, ovf = \
+        (np.asarray(x) for x in slots)
+    if not ok_h.all() or ovf.any():
+        return _fail("realign_band_coverage")
+    n_gaps = 0
+    for k in range(T):
+        rg, tg = gap_slots_to_gapdata(
+            rg_pos[k], rg_len[k], int(r_cnt[k]), tg_pos[k], tg_len[k],
+            int(t_cnt[k]), 0, len(q), int(t_lens[k]), 0)
+        n_gaps += len(rg) + len(tg)
+    if n_gaps == 0:
+        return _fail("realign_no_gaps")
+    # spot-check: the walked path achieves the DP score and consumes the
+    # full sequences (independent re-walk over the expanded ops)
+    iy_h, opr_h, leads_h = (np.asarray(iy_d), np.asarray(ops_d),
+                            np.asarray(leads_d))
+    for k in range(0, T, max(1, T // 16)):
+        fwd = rows_to_ops_fwd(int(leads_h[k]), iy_h[k], opr_h[k],
+                              int(q_lens[k]))
+        if ops_consumed(fwd) != (int(q_lens[k]), int(t_lens[k])):
+            return _fail("realign_ops_consumed")
+        if ops_score(fwd, np.asarray(q), ts[k], params) != int(scores_h[k]):
+            return _fail("realign_ops_score")
+
+    # throughput: latency-cancelling pipelined rate of the full device
+    # program (forward + row-walk + gap extraction)
+    @jax.jit
+    def chained(tl_in, prev):
+        tl_in, _ = jax.lax.optimization_barrier((tl_in, prev))
+        s, leads, iy, ops_r, _ok = banded_realign_rows(
+            qsd, tsd, qld, tl_in, band=BAND, params=params, dlo=dlo)
+        g = _gaps_jit(leads, iy, ops_r, qld, 32)
+        return s + g[2] + g[5]
+
+    zero = jnp.zeros_like(tld)
+    np.asarray(chained(tld, zero))
+    rate = _pipe_rate(chained, tld, zero, float(t_lens.sum()))
+    if rate is None:
+        return _fail("bench_timing_unstable")
+
+    cpu_rate = _gotoh_cpu_rate(q, ts, t_lens, BAND, scores_h)
+    if cpu_rate is None:
+        return _fail("dp_parity")
+    return _emit("realign_bases_per_sec_per_chip", rate, "bases/s",
+                 rate / cpu_rate if cpu_rate else 0.0)
+
+
+CONFIGS = {"1": cfg1_cli_cpu_ref, "2": cfg2_batched_dp,
+           "3": cfg3_many2many, "4": cfg4_consensus,
+           "5": cfg5_longread, "6": cfg6_realign}
+
+# all-mode run order: headline config 2 LAST, so a driver that records
+# only the final stdout line still gets the metric comparable with
+# earlier rounds' single-config captures
+_ALL_ORDER = ["1", "3", "4", "5", "6", "2"]
+
+
+def _run_all() -> int:
+    """Run every config in its own bounded subprocess, stream each JSON
+    line through, and write the aggregate table to BENCH_ALL.json."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        child_t = float(os.environ.get("PWASM_BENCH_WATCHDOG", "1800"))
+    except ValueError:
+        child_t = 1800.0
+    table = []
+    rc = 0
+    for cfg in _ALL_ORDER:
+        env = dict(os.environ, PWASM_BENCH_CONFIG=cfg)
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                capture_output=True, text=True,
+                timeout=child_t + 120 if child_t > 0 else None)
+            out_lines = [l for l in r.stdout.splitlines() if l.strip()]
+            sys.stderr.write(r.stderr[-4000:])
+            line = out_lines[-1] if out_lines else None
+            row = json.loads(line) if line else None
+            if r.returncode != 0:  # a failed gate still exits nonzero
+                rc = 1
+        except subprocess.TimeoutExpired:
+            row = None
+        except json.JSONDecodeError:
+            row = None
+        if row is None:
+            row = {"metric": f"bench_config_{cfg}_no_output", "value": 0,
+                   "unit": "bool", "vs_baseline": 0}
+            rc = 1
+        row["config"] = int(cfg)
+        print(json.dumps(row), flush=True)
+        table.append(row)
+    with open(os.path.join(repo, "BENCH_ALL.json"), "w") as f:
+        json.dump(table, f, indent=1)
+        f.write("\n")
+    return rc
+
+
 def main() -> int:
-    cfg = os.environ.get("PWASM_BENCH_CONFIG", "2")
-    configs = {"1": cfg1_cli_cpu_ref, "2": cfg2_batched_dp,
-               "3": cfg3_many2many, "4": cfg4_consensus,
-               "5": cfg5_longread}
-    if cfg not in configs:
+    cfg = os.environ.get("PWASM_BENCH_CONFIG", "all")
+    if cfg in ("", "all"):
+        return _run_all()
+    if cfg not in CONFIGS:
         return _fail(f"unknown_bench_config_{cfg}")
     _arm_watchdog()
     try:
@@ -617,7 +792,7 @@ def main() -> int:
                 global _METRIC_PREFIX
                 _METRIC_PREFIX = "cpu_fallback_"
                 _scale_for_fallback(cfg)
-        return configs[cfg]()
+        return CONFIGS[cfg]()
     except SystemExit:
         raise
     except BaseException as e:  # the one JSON line must ALWAYS print

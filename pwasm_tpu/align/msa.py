@@ -499,7 +499,10 @@ class Msa:
         cols_removed = 0
         consensus = bytearray()
         for col in range(cols.mincol, cols.maxcol + 1):
-            c = int(votes[col - cols.mincol])
+            # votes is None when the native library is unavailable
+            # (PWASM_NATIVE=0 / no toolchain): per-column Python vote
+            c = int(votes[col - cols.mincol]) if votes is not None \
+                else cols.best_char(col)
             if c == 0:
                 self._err_zero_cov(col)
             if c in (ord("-"), ord("*")):

@@ -17,10 +17,19 @@ Design (TPU-first):
   bits 0-1 = diag argmax (0=M, 1=Ix, 2=Iy), bit 2 = Ix came from extend,
   bit 3 = Iy came from extend.  Pointers live in a (T, m, band) uint8
   tensor on device — O(m x band) per lane, not O(m x n).
-- The traceback is a fixed-length ``lax.scan`` walk per lane (vmapped):
-  each step reads one pointer byte (dynamic gather) and emits one op
-  code, in reverse order.  No host round-trip per alignment; one batched
-  fetch of the (T, S) op tensor per flush.
+- The traceback is ROW-PARALLEL: instead of one sequential step per
+  alignment op (m + n tiny data-dependent gathers), the walk advances
+  one whole query row per step (m steps).  Within a row the only
+  variable-length move is a run of Iy ops (gaps in the query), and the
+  run length at every band position is a closed form over the row's
+  Iy-extend bits (a cumulative max along the band — vector work, like
+  the forward recurrence itself).  Each row therefore emits a fixed
+  (iy_run, op) pair: the compressed alignment is (m, 2) per lane, not
+  (m + n,) — and the per-step work is band-vectorized.
+- Gap records are extracted ON DEVICE from the compressed rows
+  (``realign_gaps_batch``): fixed-capacity (pos, len) slots per lane,
+  so only O(gaps) ints cross the host link per alignment, not O(m + n)
+  op bytes — the host link (PCIe or worse) never sees the path tensor.
 - Tie-breaks are DEFINED (M >= Ix >= Iy on maxima; gap-open wins ties
   against gap-extend) and replicated bit-for-bit by the numpy oracle
   ``full_gotoh_traceback`` so CPU/TPU gap structures are identical —
@@ -80,13 +89,24 @@ def _forward_lane(q_seg, t, q_len, n: int, dlo, band: int,
 
 
 # ---------------------------------------------------------------------------
-# traceback walk (per lane)
+# row-parallel traceback walk (per lane): m vector steps, not m+n scalar
+# steps.  Within one query row the walk can only (a) consume a run of Iy
+# ops (gaps in the query; moves down the band, stays in the row), then
+# (b) leave the row with exactly one DIAG or IX op.  The Iy run length
+# entering at band index b is closed-form over the row's Iy-extend bits:
+# run(b) = b - lastZero(b) + 1 where lastZero is a cumulative max over
+# positions with BY=0 — the same shift-max scan shape as the forward
+# recurrence, so the whole walk is band-vectorized.
 # ---------------------------------------------------------------------------
-def _traceback_lane(ptrs, q_len, t_len, m_f, ix_f, iy_f, n: int, dlo,
-                    band: int, steps: int):
-    """Walk the pointer tensor from cell (q_len, t_len) back to (0, 0),
-    emitting one op per step in REVERSE order (0 = done/padding)."""
+def _rowwalk_lane(ptrs, q_len, t_len, m_f, ix_f, iy_f, n: int, dlo,
+                  band: int):
+    """Walk from cell (q_len, t_len) back to row 0, one query row per
+    scan step.  Returns (score, lead_run, iy_runs (m_max,), ops_rows
+    (m_max,), ok) with iy_runs/ops_rows in FORWARD row order (row r at
+    index r-1; 0 past q_len): forward op string =
+    [IY]*lead_run + sum_r([op_r] + [IY]*iy_runs[r-1])."""
     m_max = ptrs.shape[0]
+    bidx = jnp.arange(band, dtype=jnp.int32)
     b_end = t_len - q_len - dlo
     in_band = (b_end >= 0) & (b_end < band)
     b0 = jnp.clip(b_end, 0, band - 1)
@@ -95,62 +115,67 @@ def _traceback_lane(ptrs, q_len, t_len, m_f, ix_f, iy_f, n: int, dlo,
     mat0 = jnp.where((mv >= xv) & (mv >= yv), 0,
                      jnp.where(xv >= yv, 1, 2)).astype(jnp.int32)
 
-    def step(state, _):
-        i, b, mat, done = state
-        j = i + dlo + b
-        done = done | ((i == 0) & (j == 0))
-        # row 0 can only consume target (the init Iy chain has no stored
-        # pointers): force Iy while j > 0
-        mat = jnp.where((i == 0) & ~done, 2, mat)
-        ptr = ptrs[jnp.clip(i - 1, 0, m_max - 1),
-                   jnp.clip(b, 0, band - 1)].astype(jnp.int32)
-        dm = ptr & 3
-        bx = (ptr >> 2) & 1
-        by = (ptr >> 3) & 1
-        op = jnp.where(done, 0, mat + 1)
-        ni = jnp.where(mat <= 1, i - 1, i)
-        nb = jnp.where(mat == 0, b, jnp.where(mat == 1, b + 1, b - 1))
-        nmat = jnp.where(mat == 0, dm,
-                         jnp.where(mat == 1,
-                                   jnp.where(bx == 1, 1, 0),
-                                   jnp.where(by == 1, 2, 0)))
-        nmat = jnp.where(i == 0, 2, nmat)  # stay on the row-0 Iy chain
-        ni = jnp.where(done, i, ni)
-        nb = jnp.where(done, b, nb)
-        nmat = jnp.where(done, mat, nmat)
-        return (ni, nb, nmat, done), op.astype(jnp.int8)
+    def row_step(state, xs):
+        b, mat = state
+        ptr_row, i = xs               # walking row i (m_max down to 1)
+        live = i <= q_len
+        p = ptr_row.astype(jnp.int32)
+        # Iy run length entering this row at every band position
+        by = (p >> 3) & 1
+        z = jnp.where(by == 0, bidx, -1)
+        last_zero = jax.lax.associative_scan(jnp.maximum, z)
+        k_at = bidx - last_zero + 1
+        is_iy = mat == 2
+        k_b = jnp.sum(jnp.where(bidx == b, k_at, 0))
+        iy_run = jnp.where(live & is_iy, k_b, 0)
+        b_mid = b - iy_run            # an Iy run always lands in M
+        mat_mid = jnp.where(is_iy, 0, mat)
+        p_mid = jnp.sum(jnp.where(bidx == b_mid, p, 0))
+        dm = p_mid & 3
+        bx = (p_mid >> 2) & 1
+        is_ix = mat_mid == 1
+        op = jnp.where(~live, 0,
+                       jnp.where(is_ix, OP_IX, OP_DIAG)).astype(jnp.int8)
+        nb = jnp.where(is_ix, b_mid + 1, b_mid)
+        nmat = jnp.where(is_ix, jnp.where(bx == 1, 1, 0), dm)
+        nb = jnp.where(live, nb, b)
+        nmat = jnp.where(live, nmat, mat)
+        return (nb, nmat), (iy_run.astype(jnp.int32), op)
 
-    init = (q_len.astype(jnp.int32), b0.astype(jnp.int32), mat0,
-            ~in_band)  # out-of-band lanes never walk
-    (fi, fb, _, fdone), ops_bwd = jax.lax.scan(step, init, None,
-                                               length=steps)
-    fj = fi + dlo + fb
-    ok = in_band & (score > NEG // 2) & (fi == 0) & (fj == 0)
-    return score.astype(jnp.int32), ops_bwd, ok
+    rows_desc = jnp.arange(m_max, 0, -1, dtype=jnp.int32)
+    (b_f, _mat_f), (iy_rev, ops_rev) = jax.lax.scan(
+        row_step, (b0.astype(jnp.int32), mat0),
+        (ptrs[::-1], rows_desc))
+    # at row 0 only the init Iy chain exists: the remaining j becomes the
+    # leading gap-in-query run (reference cs-walk leading '-' case)
+    lead = dlo + b_f
+    ok = in_band & (score > NEG // 2) & (lead >= 0)
+    lead = jnp.where(ok, lead, 0)
+    return (score.astype(jnp.int32), lead.astype(jnp.int32),
+            iy_rev[::-1], ops_rev[::-1], ok)
 
 
 @functools.partial(jax.jit, static_argnames=("band", "params"))
-def _traceback_batch_jit(qs, ts, q_lens, t_lens, dlo, band, params):
-    m_max = qs.shape[1]
+def _rowwalk_batch_jit(qs, ts, q_lens, t_lens, dlo, band, params):
     n = ts.shape[1]
-    steps = m_max + n
 
     def lane(q_seg, t, q_len, t_len):
         m_f, ix_f, iy_f, ptrs = _forward_lane(q_seg, t, q_len, n, dlo,
                                               band, params)
-        return _traceback_lane(ptrs, q_len, t_len, m_f, ix_f, iy_f, n,
-                               dlo, band, steps)
+        return _rowwalk_lane(ptrs, q_len, t_len, m_f, ix_f, iy_f, n,
+                             dlo, band)
 
     return jax.vmap(lane)(qs, ts, q_lens.astype(jnp.int32),
                           t_lens.astype(jnp.int32))
 
 
-def banded_traceback_batch(qs: jax.Array, ts: jax.Array,
-                           q_lens: jax.Array, t_lens: jax.Array,
-                           band: int = 64,
-                           params: ScoreParams = ScoreParams(),
-                           dlo: int | None = None):
-    """Batched banded re-alignment with traceback.
+def banded_realign_rows(qs: jax.Array, ts: jax.Array,
+                        q_lens: jax.Array, t_lens: jax.Array,
+                        band: int = 64,
+                        params: ScoreParams = ScoreParams(),
+                        dlo: int | None = None,
+                        kernel: str | None = None):
+    """Batched banded re-alignment, compressed row form (all on device).
 
     qs: (T, m_max) int8 per-lane query segments (codes, pad 127)
     ts: (T, n) int8 per-lane targets (codes, pad 127)
@@ -160,19 +185,411 @@ def banded_traceback_batch(qs: jax.Array, ts: jax.Array,
     not static — re-placing the band between flushes reuses the
     compiled program.
 
-    Returns ``(scores, ops_bwd, ok)``:
+    Returns ``(scores, leads, iy_runs, ops_rows, ok)``:
     scores (T,) int32 global scores at (q_len, t_len);
-    ops_bwd (T, m_max + n) int8 alignment ops in reverse order, 0-padded;
-    ok (T,) bool — band covered the end cell and the walk closed at the
-    origin.  Lanes with ``ok=False`` need a wider band (see
-    ``realign_pairs`` escalation) or the host oracle.
+    leads (T,) int32 leading gap-in-query run;
+    iy_runs (T, m_max) int32 per-row Iy run AFTER the row's op;
+    ops_rows (T, m_max) int8 per-row leaving op (1=DIAG, 2=IX; 0 pad);
+    ok (T,) bool — band covered the end cell and the walk closed.
+    Lanes with ``ok=False`` need a wider band (see ``realign_pairs``
+    escalation) or the host oracle.
+
+    ``kernel``: 'pallas' (fused TPU kernels; band must be a multiple
+    of 8), 'xla' (lax.scan path, any band, traced dlo), or None = pallas
+    on a TPU backend, xla elsewhere.  Outputs are bit-identical.
     """
     if band < 1:
         raise ValueError(f"band must be >= 1, got {band}")
     if dlo is None:
         dlo = -(band // 2)
-    return _traceback_batch_jit(qs, ts, q_lens, t_lens,
-                                jnp.int32(dlo), band, params)
+    if kernel is None:
+        from pwasm_tpu.ops import on_tpu_backend
+        kernel = "pallas" if (band % 8 == 0 and on_tpu_backend()) \
+            else "xla"
+    if kernel == "pallas":
+        return _rowwalk_batch_pallas(jnp.asarray(qs), jnp.asarray(ts),
+                                     jnp.asarray(q_lens),
+                                     jnp.asarray(t_lens),
+                                     int(dlo), band, params)
+    return _rowwalk_batch_jit(qs, ts, q_lens, t_lens,
+                              jnp.int32(dlo), band, params)
+
+
+def rows_to_ops_fwd(lead: int, iy_runs: np.ndarray, ops_rows: np.ndarray,
+                    q_len: int) -> np.ndarray:
+    """Expand one lane's compressed rows to the forward op string
+    (host side; only needed when a caller wants the full path)."""
+    vals = np.empty(2 * q_len + 1, dtype=np.int8)
+    lens = np.empty(2 * q_len + 1, dtype=np.int64)
+    vals[0] = OP_IY
+    lens[0] = lead
+    vals[1::2] = ops_rows[:q_len]
+    lens[1::2] = 1
+    vals[2::2] = OP_IY
+    lens[2::2] = iy_runs[:q_len]
+    return np.repeat(vals, lens)
+
+
+def banded_traceback_batch(qs: jax.Array, ts: jax.Array,
+                           q_lens: jax.Array, t_lens: jax.Array,
+                           band: int = 64,
+                           params: ScoreParams = ScoreParams(),
+                           dlo: int | None = None):
+    """Batched banded re-alignment with an expanded op-string traceback.
+
+    Compatibility wrapper over ``banded_realign_rows``: fetches the
+    compressed rows and expands them on host.  Returns ``(scores,
+    ops_bwd, ok)`` with ops_bwd (T, m_max + n) int8 REVERSE-order ops,
+    0-padded.  Prefer ``banded_realign_rows`` + ``realign_gaps_batch``
+    in throughput paths — they never materialize O(m + n) per lane.
+    """
+    scores, leads, iy_runs, ops_rows, ok = banded_realign_rows(
+        qs, ts, q_lens, t_lens, band=band, params=params, dlo=dlo)
+    scores = np.asarray(scores)
+    leads = np.asarray(leads)
+    iy_runs = np.asarray(iy_runs)
+    ops_rows = np.asarray(ops_rows)
+    ok = np.asarray(ok)
+    T, m_max = iy_runs.shape
+    width = m_max + ts.shape[1]
+    q_lens = np.asarray(q_lens)
+    ops_bwd = np.zeros((T, width), dtype=np.int8)
+    for k in range(T):
+        if not ok[k]:
+            continue
+        fwd = rows_to_ops_fwd(int(leads[k]), iy_runs[k], ops_rows[k],
+                              int(q_lens[k]))
+        ops_bwd[k, :len(fwd)] = fwd[::-1]
+    return scores, ops_bwd, ok
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernels: pointer-emitting forward + row-parallel walk.
+# Same tile geometry as ops/banded_dp.py's scores kernel (band on the
+# sublane axis, block_t targets on the lane axis), but the query is
+# per-lane (a (m, block_t) VMEM tile, one vector row per DP row) and the
+# grid adds a row-chunk axis: each grid step advances 8 query rows and
+# writes one (band, block_t) int32 tile of PACKED pointers (8 rows x 4
+# bits).  The walk kernel replays the chunks in reverse, carrying the
+# per-lane (band index, matrix) state in scratch, and emits the
+# compressed (iy_run, op) row stream — identical, bit for bit, to the
+# XLA row-walk (fuzzed in tests/test_realign.py).
+# ---------------------------------------------------------------------------
+def _fwdptr_kernel(q_ref, t_ref, qlen_ref, tlen_ref,
+                   ptr_ref, score_ref, b0_ref, mat0_ref,
+                   m_c, ix_c, iy_c, *, n, band, dlo,
+                   match, mismatch, go, ge, block_t, m8):
+    from jax.experimental import pallas as pl
+
+    p8 = pl.program_id(1)
+    bidx = jax.lax.broadcasted_iota(jnp.int32, (band, block_t), 0)
+    neg = jnp.full((band, block_t), NEG, dtype=jnp.int32)
+
+    @pl.when(p8 == 0)
+    def _():
+        j0 = dlo + bidx
+        m_c[...] = jnp.where(j0 == 0, 0, NEG)
+        ix_c[...] = neg
+        iy_c[...] = jnp.where((j0 >= 1) & (j0 <= n),
+                              -(go + (j0 - 1) * ge), NEG)
+
+    q_len = qlen_ref[...]                      # (1, block_t)
+    i0 = p8 * 8
+    win = t_ref[pl.ds(i0 + dlo + band, band + 7), :]
+    m_prev, ix_prev, iy_prev = m_c[...], ix_c[...], iy_c[...]
+    packed = jnp.zeros((band, block_t), jnp.int32)
+    for r in range(8):
+        i = i0 + r + 1                         # 1-based absolute row
+        qi = q_ref[pl.ds(i0 + r, 1), :]        # (1, block_t) per-lane base
+        tj = win[r:r + band]
+        s = jnp.where((tj == qi) & (qi < 4), match, -mismatch)
+        diag = jnp.maximum(m_prev, jnp.maximum(ix_prev, iy_prev))
+        dm = jnp.where((m_prev >= ix_prev) & (m_prev >= iy_prev), 0,
+                       jnp.where(ix_prev >= iy_prev, 1, 2))
+        m_new = diag + s
+        up_m = jnp.concatenate([m_prev[1:], neg[:1]], axis=0)
+        up_ix = jnp.concatenate([ix_prev[1:], neg[:1]], axis=0)
+        bx = (up_ix - ge > up_m - go).astype(jnp.int32)
+        ix_new = jnp.maximum(up_m - go, up_ix - ge)
+        j = i + dlo + bidx
+        valid = (j >= 1) & (j <= n)
+        m_new = jnp.where(valid, m_new, NEG)
+        ix_new = jnp.where(j == 0, -(go + (i - 1) * ge), ix_new)
+        ix_new = jnp.where((j < 0) | (j > n), NEG, ix_new)
+        run = m_new + bidx * ge
+        sh = 1
+        while sh < band:
+            run = jnp.maximum(
+                run, jnp.concatenate([neg[:sh], run[:-sh]], axis=0))
+            sh *= 2
+        run_prev = jnp.concatenate([neg[:1], run[:-1]], axis=0)
+        iy_new = run_prev - go - (bidx - 1) * ge
+        iy_new = jnp.where(valid, iy_new, NEG)
+        m_left = jnp.concatenate([neg[:1], m_new[:-1]], axis=0)
+        iy_left = jnp.concatenate([neg[:1], iy_new[:-1]], axis=0)
+        by = (iy_left - ge > m_left - go).astype(jnp.int32)
+        packed = packed | ((dm | (bx << 2) | (by << 3)) << (4 * r))
+        keep = i <= q_len                      # rows past q_len freeze
+        m_prev = jnp.where(keep, m_new, m_prev)
+        ix_prev = jnp.where(keep, ix_new, ix_prev)
+        iy_prev = jnp.where(keep, iy_new, iy_prev)
+    m_c[...] = m_prev
+    ix_c[...] = ix_prev
+    iy_c[...] = iy_prev
+    ptr_ref[0] = packed
+
+    @pl.when(p8 == m8 - 1)
+    def _():
+        t_len = tlen_ref[...]                  # (1, block_t)
+        b_end = t_len - q_len - dlo
+        in_band = (b_end >= 0) & (b_end < band)
+        sel = bidx == b_end
+        mv = jnp.max(jnp.where(sel, m_prev, NEG), axis=0, keepdims=True)
+        xv = jnp.max(jnp.where(sel, ix_prev, NEG), axis=0, keepdims=True)
+        yv = jnp.max(jnp.where(sel, iy_prev, NEG), axis=0, keepdims=True)
+        best = jnp.maximum(mv, jnp.maximum(xv, yv))
+        score_ref[...] = jnp.where(in_band, best, NEG)
+        b0_ref[...] = jnp.clip(b_end, 0, band - 1)
+        mat0_ref[...] = jnp.where((mv >= xv) & (mv >= yv), 0,
+                                  jnp.where(xv >= yv, 1, 2))
+
+
+def _walk_kernel(packed_ref, b0_ref, mat0_ref, qlen_ref,
+                 walk_ref, bf_ref, b_c, mat_c, *, band, block_t, m8):
+    from jax.experimental import pallas as pl
+
+    p8 = pl.program_id(1)
+    chunk = m8 - 1 - p8                        # row chunks in reverse
+    bidx = jax.lax.broadcasted_iota(jnp.int32, (band, block_t), 0)
+
+    @pl.when(p8 == 0)
+    def _():
+        b_c[...] = b0_ref[...]
+        mat_c[...] = mat0_ref[...]
+
+    q_len = qlen_ref[...]                      # (1, block_t)
+    packed = packed_ref[0]
+    b = b_c[...]
+    mat = mat_c[...]
+    for r in range(7, -1, -1):
+        i = chunk * 8 + r + 1
+        ptr = (packed >> (4 * r)) & 0xF
+        by = (ptr >> 3) & 1
+        z = jnp.where(by == 0, bidx, -1)
+        sh = 1
+        while sh < band:                       # cumulative max: lastZero
+            z = jnp.maximum(z, jnp.concatenate(
+                [jnp.full((sh, block_t), -1, jnp.int32), z[:-sh]],
+                axis=0))
+            sh *= 2
+        k_at = bidx - z + 1
+        live = i <= q_len
+        is_iy = mat == 2
+        k_b = jnp.sum(jnp.where(bidx == b, k_at, 0), axis=0,
+                      keepdims=True)
+        iy_run = jnp.where(live & is_iy, k_b, 0)
+        b_mid = b - iy_run                     # an Iy run lands in M
+        p_mid = jnp.sum(jnp.where(bidx == b_mid, ptr, 0), axis=0,
+                        keepdims=True)
+        dm = p_mid & 3
+        bx = (p_mid >> 2) & 1
+        is_ix = jnp.where(is_iy, 0, mat) == 1
+        op = jnp.where(live, jnp.where(is_ix, OP_IX, OP_DIAG), 0)
+        nb = jnp.where(is_ix, b_mid + 1, b_mid)
+        nmat = jnp.where(is_ix, jnp.where(bx == 1, 1, 0), dm)
+        b = jnp.where(live, nb, b)
+        mat = jnp.where(live, nmat, mat)
+        walk_ref[0, r:r + 1, :] = iy_run * 4 + op
+    b_c[...] = b
+    mat_c[...] = mat
+
+    @pl.when(p8 == m8 - 1)
+    def _():
+        bf_ref[...] = b
+
+
+@functools.partial(jax.jit, static_argnames=("dlo", "band", "params",
+                                             "block_t", "interpret"))
+def _rowwalk_batch_pallas(qs, ts, q_lens, t_lens, dlo: int, band: int,
+                          params: ScoreParams, block_t: int = 128,
+                          interpret: bool | None = None):
+    """Pallas path of ``banded_realign_rows`` — same output contract as
+    ``_rowwalk_batch_jit``, bit for bit (fuzz-gated in tests)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        from pwasm_tpu.ops import default_interpret
+        interpret = default_interpret()
+    T, m_max = qs.shape
+    n = ts.shape[1]
+    m8 = (m_max + 7) // 8
+    m_pad8 = m8 * 8
+    pad_t = (T + block_t - 1) // block_t * block_t
+    if pad_t != T:
+        qs = jnp.pad(qs, ((0, pad_t - T), (0, 0)), constant_values=127)
+        ts = jnp.pad(ts, ((0, pad_t - T), (0, 0)), constant_values=127)
+        q_lens = jnp.pad(q_lens, (0, pad_t - T))
+        t_lens = jnp.pad(t_lens, (0, pad_t - T))
+    qs_T = jnp.pad(qs.astype(jnp.int32).T, ((0, m_pad8 - m_max), (0, 0)),
+                   constant_values=127)
+    ts_T = jnp.pad(ts.astype(jnp.int32).T, ((band, band + 8), (0, 0)),
+                   constant_values=127)
+    grid = (pad_t // block_t, m8)
+    fwd = functools.partial(
+        _fwdptr_kernel, n=n, band=band, dlo=dlo, match=params.match,
+        mismatch=params.mismatch, go=params.go, ge=params.gap_extend,
+        block_t=block_t, m8=m8)
+    ptrs, scores, b0, mat0 = pl.pallas_call(
+        fwd,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((m_pad8, block_t), lambda tb, p8: (0, tb)),
+            pl.BlockSpec((n + 2 * band + 8, block_t),
+                         lambda tb, p8: (0, tb)),
+            pl.BlockSpec((1, block_t), lambda tb, p8: (0, tb)),
+            pl.BlockSpec((1, block_t), lambda tb, p8: (0, tb)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, band, block_t), lambda tb, p8: (p8, 0, tb)),
+            pl.BlockSpec((1, block_t), lambda tb, p8: (0, tb)),
+            pl.BlockSpec((1, block_t), lambda tb, p8: (0, tb)),
+            pl.BlockSpec((1, block_t), lambda tb, p8: (0, tb)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m8, band, pad_t), jnp.int32),
+            jax.ShapeDtypeStruct((1, pad_t), jnp.int32),
+            jax.ShapeDtypeStruct((1, pad_t), jnp.int32),
+            jax.ShapeDtypeStruct((1, pad_t), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((band, block_t), jnp.int32)] * 3,
+        interpret=interpret,
+    )(qs_T, ts_T, q_lens.astype(jnp.int32)[None, :],
+      t_lens.astype(jnp.int32)[None, :])
+
+    walk = functools.partial(_walk_kernel, band=band, block_t=block_t,
+                             m8=m8)
+    walk_rows, b_f = pl.pallas_call(
+        walk,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, band, block_t),
+                         lambda tb, p8: (m8 - 1 - p8, 0, tb)),
+            pl.BlockSpec((1, block_t), lambda tb, p8: (0, tb)),
+            pl.BlockSpec((1, block_t), lambda tb, p8: (0, tb)),
+            pl.BlockSpec((1, block_t), lambda tb, p8: (0, tb)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 8, block_t),
+                         lambda tb, p8: (m8 - 1 - p8, 0, tb)),
+            pl.BlockSpec((1, block_t), lambda tb, p8: (0, tb)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m8, 8, pad_t), jnp.int32),
+            jax.ShapeDtypeStruct((1, pad_t), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((1, block_t), jnp.int32)] * 2,
+        interpret=interpret,
+    )(ptrs, b0, mat0, q_lens.astype(jnp.int32)[None, :])
+
+    rows = walk_rows.reshape(m8 * 8, pad_t)[:m_max, :T].T
+    iy_runs = rows // 4
+    ops_rows = (rows & 3).astype(jnp.int8)
+    scores = scores[0, :T]
+    leads = dlo + b_f[0, :T]
+    ok = (scores > NEG // 2) & (leads >= 0)
+    leads = jnp.where(ok, leads, 0)
+    return scores, leads, iy_runs, ops_rows, ok
+
+
+# ---------------------------------------------------------------------------
+# device-side gap extraction: compressed rows -> fixed-capacity gap slots
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnames=("max_gaps",))
+def _gaps_jit(leads, iy_runs, ops_rows, q_lens, max_gaps: int):
+    m_max = iy_runs.shape[1]
+    G = max_gaps
+
+    def lane(lead, iy, op, q_len):
+        rows = jnp.arange(1, m_max + 1, dtype=jnp.int32)
+        live = rows <= q_len
+        iy = jnp.where(live, iy, 0)
+        opl = jnp.where(live, op.astype(jnp.int32), 0)
+        diag = (opl == OP_DIAG).astype(jnp.int32)
+        consumed = iy + diag
+        # target bases consumed before each row's op (exclusive prefix)
+        tcons = lead + jnp.cumsum(consumed) - consumed
+        # gaps in the query: the lead run at qpos 0, then every row with
+        # an Iy run, at qpos = row (the run follows the row's op)
+        has_lead = (lead > 0).astype(jnp.int32)
+        r_mask = iy > 0
+        slot = jnp.where(r_mask, jnp.cumsum(r_mask) - 1 + has_lead, G)
+        rg_pos = jnp.zeros(G, jnp.int32).at[slot].set(rows, mode="drop")
+        rg_len = jnp.zeros(G, jnp.int32).at[slot].set(iy, mode="drop")
+        lead_slot = jnp.where(has_lead == 1, 0, G)
+        rg_pos = rg_pos.at[lead_slot].set(0, mode="drop")
+        rg_len = rg_len.at[lead_slot].set(lead, mode="drop")
+        r_count = jnp.sum(r_mask) + has_lead
+        # gaps in the target: maximal runs of op == OP_IX rows, at the
+        # target position where the run starts
+        is_ix = opl == OP_IX
+        prev = jnp.concatenate([jnp.zeros(1, dtype=bool), is_ix[:-1]])
+        start = is_ix & ~prev
+        idx = jnp.arange(m_max, dtype=jnp.int32)
+        nni = jax.lax.associative_scan(          # next non-Ix row index
+            jnp.minimum, jnp.where(is_ix, m_max, idx), reverse=True)
+        length = nni - idx
+        t_slot = jnp.where(start, jnp.cumsum(start) - 1, G)
+        tg_pos = jnp.zeros(G, jnp.int32).at[t_slot].set(tcons,
+                                                        mode="drop")
+        tg_len = jnp.zeros(G, jnp.int32).at[t_slot].set(length,
+                                                        mode="drop")
+        t_count = jnp.sum(start)
+        overflow = (r_count > G) | (t_count > G)
+        return (rg_pos, rg_len, r_count.astype(jnp.int32),
+                tg_pos, tg_len, t_count.astype(jnp.int32), overflow)
+
+    return jax.vmap(lane)(leads, iy_runs, ops_rows,
+                          q_lens.astype(jnp.int32))
+
+
+def realign_gaps_batch(qs: jax.Array, ts: jax.Array,
+                       q_lens: jax.Array, t_lens: jax.Array,
+                       band: int = 64,
+                       params: ScoreParams = ScoreParams(),
+                       dlo: int | None = None, max_gaps: int = 32):
+    """Re-align a batch and extract gap records entirely on device.
+
+    Returns ``(scores, ok, (rg_pos, rg_len, r_count, tg_pos, tg_len,
+    t_count, overflow))`` — per lane, up to ``max_gaps`` (pos, len)
+    slots per side in forward coordinates (rg_pos = qpos of the run,
+    tg_pos = tpos where the run starts); ``overflow`` lanes have more
+    gaps than slots and must take the expanded-ops path.  Feed slots to
+    ``gap_slots_to_gapdata`` for the CIGAR-walk strand conventions."""
+    scores, leads, iy_runs, ops_rows, ok = banded_realign_rows(
+        qs, ts, q_lens, t_lens, band=band, params=params, dlo=dlo)
+    return scores, ok, _gaps_jit(leads, iy_runs, ops_rows, q_lens,
+                                 max_gaps)
+
+
+def gap_slots_to_gapdata(rg_pos, rg_len, r_count, tg_pos, tg_len, t_count,
+                         offset: int, r_len: int, eff_t_len: int,
+                         reverse: int
+                         ) -> tuple[list[GapData], list[GapData]]:
+    """One lane's device gap slots -> (rgaps, tgaps) GapData lists with
+    the exact conventions of ``ops_to_gaps`` (strand flip included)."""
+    rgaps: list[GapData] = []
+    for i in range(int(r_count)):
+        pos = offset + int(rg_pos[i])
+        if reverse:
+            pos = r_len - pos
+        rgaps.append(GapData(pos, int(rg_len[i])))
+    tgaps: list[GapData] = []
+    for i in range(int(t_count)):
+        pos = int(tg_pos[i])
+        tgaps.append(GapData(eff_t_len - pos if reverse else pos,
+                             int(tg_len[i])))
+    return rgaps, tgaps
 
 
 # ---------------------------------------------------------------------------
@@ -408,17 +825,22 @@ def realign_pairs(pairs: list[tuple[bytes, bytes]], band: int = 64,
         for c0 in range(0, len(todo), chunk):
             sub = todo[c0:c0 + chunk]
             dlo = _pick_dlo(t_lens[sub] - q_lens[sub], cur_band)
-            scores, ops_bwd, ok = banded_traceback_batch(
+            scores, leads, iy_runs, ops_rows, ok = banded_realign_rows(
                 jnp.asarray(qs[sub]), jnp.asarray(ts[sub]),
                 jnp.asarray(q_lens[sub]), jnp.asarray(t_lens[sub]),
                 band=cur_band, params=params, dlo=dlo)
             scores = np.asarray(scores)
-            ops_bwd = np.asarray(ops_bwd)
+            leads = np.asarray(leads)
+            iy_runs = np.asarray(iy_runs)
+            ops_rows = np.asarray(ops_rows)
             ok = np.asarray(ok)
             for idx, k in enumerate(sub):
                 if ok[idx]:
                     out[k] = (int(scores[idx]),
-                              ops_forward(ops_bwd[idx]))
+                              rows_to_ops_fwd(int(leads[idx]),
+                                              iy_runs[idx],
+                                              ops_rows[idx],
+                                              int(q_lens[k])))
             still.extend(sub[~ok])
         todo = np.array(still, dtype=np.int64)
         cur_band = max(cur_band * 4, 4)

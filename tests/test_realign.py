@@ -166,6 +166,114 @@ def test_realign_pairs_band_fallback():
     np.testing.assert_array_equal(ops, want_ops)
 
 
+@pytest.mark.parametrize("seed", [8, 9])
+@pytest.mark.parametrize("reverse", [0, 1])
+def test_device_gap_extraction_matches_ops_to_gaps(seed, reverse):
+    """realign_gaps_batch's on-device gap slots must reproduce
+    ops_to_gaps over the expanded op string exactly, both strands."""
+    from pwasm_tpu.ops.realign import (gap_slots_to_gapdata,
+                                       realign_gaps_batch,
+                                       rows_to_ops_fwd,
+                                       banded_realign_rows)
+
+    rng = np.random.default_rng(seed)
+    T, m_max, n_max = 12, 160, 200
+    qs = np.full((T, m_max), 127, dtype=np.int8)
+    ts = np.full((T, n_max), 127, dtype=np.int8)
+    qls = np.zeros(T, dtype=np.int32)
+    tls = np.zeros(T, dtype=np.int32)
+    for k in range(T):
+        m = int(rng.integers(30, m_max + 1))
+        q = rng.integers(0, 4, m).astype(np.int8)
+        t = _mutate(rng, q, int(rng.integers(0, 6)),
+                    int(rng.integers(0, 5)))[:n_max]
+        qs[k, :m] = q
+        ts[k, :len(t)] = t
+        qls[k] = m
+        tls[k] = len(t)
+    band = 48
+    scores, ok, slots = realign_gaps_batch(qs, ts, qls, tls, band=band)
+    rg_pos, rg_len, r_cnt, tg_pos, tg_len, t_cnt, ovf = \
+        (np.asarray(x) for x in slots)
+    scores2, leads, iy_runs, ops_rows, ok2 = banded_realign_rows(
+        qs, ts, qls, tls, band=band)
+    leads, iy_runs, ops_rows = (np.asarray(leads), np.asarray(iy_runs),
+                                np.asarray(ops_rows))
+    ok = np.asarray(ok)
+    assert ok.all()
+    for k in range(T):
+        offset, r_len = 3, int(qls[k]) + 7
+        eff_t_len = int(tls[k])
+        fwd = rows_to_ops_fwd(int(leads[k]), iy_runs[k], ops_rows[k],
+                              int(qls[k]))
+        want_r, want_t = ops_to_gaps(fwd, offset, r_len, eff_t_len,
+                                     reverse)
+        assert not bool(ovf[k])
+        got_r, got_t = gap_slots_to_gapdata(
+            rg_pos[k], rg_len[k], int(r_cnt[k]),
+            tg_pos[k], tg_len[k], int(t_cnt[k]),
+            offset, r_len, eff_t_len, reverse)
+        assert [(g.pos, g.len) for g in got_r] == \
+            [(g.pos, g.len) for g in want_r], k
+        assert [(g.pos, g.len) for g in got_t] == \
+            [(g.pos, g.len) for g in want_t], k
+
+
+def test_gap_extraction_overflow_flag():
+    """More gaps than slots must set the overflow flag, not silently
+    truncate."""
+    from pwasm_tpu.ops.realign import realign_gaps_batch
+
+    rng = np.random.default_rng(10)
+    m = 120
+    q = rng.integers(0, 4, m).astype(np.int8)
+    t = _mutate(rng, q, 0, 30, maxgap=1)  # ~30 separate indel sites
+    n = len(t)
+    scores, ok, slots = realign_gaps_batch(
+        q[None, :], t[None, :n], np.array([m], np.int32),
+        np.array([n], np.int32), band=128, max_gaps=4)
+    assert bool(np.asarray(slots[6])[0])  # overflow
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_pallas_rowwalk_matches_xla(seed):
+    """The fused Pallas forward+walk kernels must be bit-identical to
+    the XLA scan path: scores, leads, per-row runs/ops, ok."""
+    from pwasm_tpu.ops.realign import banded_realign_rows
+
+    rng = np.random.default_rng(seed)
+    T, m_max, n_max = 20, 100, 120
+    qs = np.full((T, m_max), 127, dtype=np.int8)
+    ts = np.full((T, n_max), 127, dtype=np.int8)
+    qls = np.zeros(T, dtype=np.int32)
+    tls = np.zeros(T, dtype=np.int32)
+    for k in range(T):
+        m = int(rng.integers(10, m_max + 1))
+        q = rng.integers(0, 4, m).astype(np.int8)
+        t = _mutate(rng, q, int(rng.integers(0, 8)),
+                    int(rng.integers(0, 5)))[:n_max]
+        qs[k, :m] = q
+        ts[k, :len(t)] = t
+        qls[k] = m
+        tls[k] = len(t)
+    for band in (16, 32):
+        ref = banded_realign_rows(qs, ts, qls, tls, band=band,
+                                  kernel="xla")
+        got = banded_realign_rows(qs, ts, qls, tls, band=band,
+                                  kernel="pallas")
+        names = ("scores", "leads", "iy_runs", "ops_rows", "ok")
+        for name, a, b in zip(names, ref, got):
+            ar, br = np.asarray(a), np.asarray(b)
+            if name in ("iy_runs", "ops_rows"):
+                # rows past q_len / non-ok lanes are don't-cares
+                okm = np.asarray(ref[4])
+                live = (np.arange(ar.shape[1])[None, :]
+                        < np.asarray(qls)[:, None]) & okm[:, None]
+                ar, br = ar * live, br * live
+            np.testing.assert_array_equal(ar, br,
+                                          err_msg=f"{name} band={band}")
+
+
 @pytest.mark.parametrize("seed", [5, 6, 7])
 def test_randomized_path_validity(seed):
     """Fuzz: random lengths/mutations, mixed lanes; every ok lane's path
