@@ -734,8 +734,10 @@ _ALL_ORDER = ["1", "3", "4", "5", "6", "2"]
 
 
 def _run_all() -> int:
-    """Run every config in its own bounded subprocess, stream each JSON
-    line through, and write the aggregate table to BENCH_ALL.json."""
+    """Run the Pallas lowering smoke, then every config in its own
+    bounded subprocess; stream each JSON line through and write the
+    aggregate table to BENCH_ALL.json (smoke row first) plus the smoke's
+    own line to TPU_SMOKE.json — the per-round lowering-gate artifact."""
     import subprocess
 
     repo = os.path.dirname(os.path.abspath(__file__))
@@ -745,6 +747,26 @@ def _run_all() -> int:
         child_t = 1800.0
     table = []
     rc = 0
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(repo, "tpu_smoke.py")],
+            env=dict(os.environ), capture_output=True, text=True,
+            timeout=child_t + 120 if child_t > 0 else None)
+        smoke_line = [l for l in r.stdout.splitlines() if l.strip()][-1]
+        smoke = json.loads(smoke_line)
+        with open(os.path.join(repo, "TPU_SMOKE.json"), "w") as f:
+            f.write(smoke_line + "\n")
+        if r.returncode != 0:
+            rc = 1
+    except Exception as e:
+        smoke = {"smoke": "pallas_lowering", "ok": False,
+                 "error": f"{type(e).__name__}: {e}"}
+        rc = 1
+    row = {"metric": "pallas_lowering_ok",
+           "value": 1 if smoke.get("ok") else 0, "unit": "bool",
+           "vs_baseline": 0, "config": 0}
+    print(json.dumps(row), flush=True)
+    table.append(row)
     for cfg in _ALL_ORDER:
         env = dict(os.environ, PWASM_BENCH_CONFIG=cfg)
         try:

@@ -424,7 +424,7 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         if batch:
             inflight.append(submit_diff_info_batch(
                 batch, freport, skip_codan=cfg.skip_codan,
-                motifs=cfg.motifs, summary=summary))
+                motifs=cfg.motifs, summary=summary, stats=stats))
             stats.device_batches += 1
         while len(inflight) > (0 if drain else 1):
             try:
@@ -602,6 +602,12 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         except OSError:
             raise PwasmError(
                 f"Cannot open file {cfg.stats_path} for writing!\n")
+    if stats.fallback_batches:
+        # a degraded --device=tpu run must be visible at exit, not just
+        # in the once-per-run warning scrolled past hours earlier
+        print(f"Warning: {stats.fallback_batches}/{stats.device_batches} "
+              "device batches fell back to the host scalar path",
+              file=stderr)
     if cfg.verbose:
         print(stats.brief(), file=stderr)
     return 0

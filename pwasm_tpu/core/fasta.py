@@ -5,6 +5,13 @@ usage (pafreport.cpp:255,346): open a FASTA file, fetch whole records by id
 without re-scanning the file.  The index is built in one streaming pass and
 records byte offsets, so fetches are O(record size) seeks.
 
+Like gclib's GFastaIndex (the ``.fai`` files pafreport rides), the index
+persists: after a scan of a uniformly-wrapped FASTA a samtools-compatible
+5-column ``<path>.fai`` sidecar is written, and later opens load it instead
+of re-scanning — the sidecar is ignored when older than the FASTA.
+Irregularly-wrapped files (which the 5-column format cannot describe) are
+simply re-scanned each open.
+
 Also provides in-memory helpers used by tests and the MSA writers.
 """
 
@@ -36,9 +43,86 @@ class FastaFile:
         self.path = os.fspath(path)
         self._index: dict[str, _FaiEntry] = {}
         self._order: list[str] = []
-        self._build_index()
+        if not self._load_fai():
+            self._full_scan()
+            self._write_fai()
 
-    def _build_index(self) -> None:
+    @property
+    def _fai_path(self) -> str:
+        return self.path + ".fai"
+
+    def _load_fai(self) -> bool:
+        """Load the ``.fai`` sidecar when present and not older than the
+        FASTA itself.  The 5-column samtools layout is name, length,
+        offset, linebases, linewidth; the fetch window's end offset is
+        derived from the line geometry."""
+        try:
+            if (os.path.getmtime(self._fai_path)
+                    < os.path.getmtime(self.path)):
+                return False
+            with open(self._fai_path) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    name, length, offset, lb, lw = line.split("\t")
+                    length, offset = int(length), int(offset)
+                    lb, lw = int(lb), int(lw)
+                    if length < 0 or offset < 0 or lb < 1 or lw <= lb:
+                        return False
+                    nlines = (length + lb - 1) // lb
+                    end = offset + length + nlines * (lw - lb)
+                    self._add(name, length, offset, end)
+        except (OSError, ValueError):
+            self._index.clear()
+            self._order.clear()
+            return False
+        return bool(self._index)
+
+    def _write_fai(self) -> None:
+        """Persist the index when every record is uniformly wrapped (the
+        only shape the 5-column format can describe); best-effort — a
+        read-only directory just skips persistence."""
+        rows = []
+        try:
+            fsize = os.path.getsize(self.path)
+            with open(self.path, "rb") as f:
+                for name in self._order:
+                    ent = self._index[name]
+                    f.seek(ent.offset)
+                    first = f.readline()
+                    lb = len(first.rstrip(b"\r\n"))
+                    lw = len(first)
+                    if lb < 1 or lw <= lb:
+                        return
+                    nlines = (ent.length + lb - 1) // lb
+                    span = ent.length + nlines * (lw - lb)
+                    # uniform wrapping must reproduce the scanned window;
+                    # a missing final newline is only legitimate at EOF —
+                    # anywhere else the reload would overshoot into the
+                    # next record's '>' header
+                    window = ent.end - ent.offset
+                    if window != span and not (
+                            window == span - (lw - lb)
+                            and ent.end == fsize):
+                        return
+                    if "\t" in name or "\n" in name:
+                        return
+                    rows.append(f"{name}\t{ent.length}\t{ent.offset}"
+                                f"\t{lb}\t{lw}\n")
+            # atomic publish: a concurrent reader must see either no
+            # sidecar or a complete one, never a prefix
+            tmp = self._fai_path + f".tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.writelines(rows)
+            os.replace(tmp, self._fai_path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except (OSError, UnboundLocalError):
+                pass
+            return
+
+    def _full_scan(self) -> None:
         # native streaming indexer when available (C++ one-pass scan,
         # bit-identical entries — parity enforced by tests/test_native.py)
         from pwasm_tpu.native import fasta_index

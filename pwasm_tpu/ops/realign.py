@@ -784,32 +784,47 @@ def realign_pairs(pairs: list[tuple[bytes, bytes]], band: int = 64,
 
     Returns a list of (score, ops_fwd) — or ``None`` for pairs that
     could not be re-aligned within resource bounds (callers keep their
-    original gap structure).  Sequences are encoded upper-case; shapes
-    are bucketed to multiples of 128 so the jitted program is reused
-    across flushes.  Lanes whose end diagonal the static band cannot
-    cover retry on device with an escalated band (x4 per retry up to
-    4096); tiny leftovers use the host oracle.
+    original gap structure).  Sequences are encoded upper-case.  Lanes
+    are grouped by their 128-rounded (query, target) shape bucket
+    before dispatch (SURVEY.md §7.3 variable-length batching): one
+    50 kb target in a batch of 1.5 kb lanes pads only its own group's
+    tensors ~30x, not every lane's, and the per-bucket jitted program
+    is reused across flushes.  Lanes whose end diagonal the static band
+    cannot cover retry on device with an escalated band (x4 per retry
+    up to 4096); tiny leftovers use the host oracle.
     """
     from pwasm_tpu.core.dna import encode
 
     if not pairs:
         return []
-    T = len(pairs)
-    m_max = _bucket(max(len(p[0]) for p in pairs))
-    n = _bucket(max(len(p[1]) for p in pairs))
+    enc = [(encode(qb.upper()), encode(tb.upper())) for qb, tb in pairs]
+    out: list = [None] * len(pairs)
+    groups: dict[tuple[int, int], list[int]] = {}
+    for k, (qc, tc) in enumerate(enc):
+        groups.setdefault((_bucket(len(qc)), _bucket(len(tc))),
+                          []).append(k)
+    for (mb, nb), idxs in sorted(groups.items()):
+        _realign_group(enc, idxs, mb, nb, band, params, out)
+    return out
+
+
+def _realign_group(enc, idxs: list[int], m_max: int, n: int, band: int,
+                   params: ScoreParams, out: list) -> None:
+    """Dispatch one shape bucket of ``realign_pairs`` lanes (padded to
+    (m_max, n)), writing results into ``out`` at their original
+    indices."""
+    T = len(idxs)
     qs = np.full((T, m_max), 127, dtype=np.int8)
     ts = np.full((T, n), 127, dtype=np.int8)
     q_lens = np.zeros(T, dtype=np.int32)
     t_lens = np.zeros(T, dtype=np.int32)
-    for k, (qb, tb) in enumerate(pairs):
-        qc = encode(qb.upper())
-        tc = encode(tb.upper())
+    for k, ki in enumerate(idxs):
+        qc, tc = enc[ki]
         qs[k, :len(qc)] = qc
         ts[k, :len(tc)] = tc
         q_lens[k] = len(qc)
         t_lens[k] = len(tc)
 
-    out: list = [None] * T
     todo = np.arange(T)
     cur_band = max(1, band)
     first = True
@@ -836,17 +851,17 @@ def realign_pairs(pairs: list[tuple[bytes, bytes]], band: int = 64,
             ok = np.asarray(ok)
             for idx, k in enumerate(sub):
                 if ok[idx]:
-                    out[k] = (int(scores[idx]),
-                              rows_to_ops_fwd(int(leads[idx]),
-                                              iy_runs[idx],
-                                              ops_rows[idx],
-                                              int(q_lens[k])))
+                    out[idxs[k]] = (int(scores[idx]),
+                                    rows_to_ops_fwd(int(leads[idx]),
+                                                    iy_runs[idx],
+                                                    ops_rows[idx],
+                                                    int(q_lens[k])))
             still.extend(sub[~ok])
         todo = np.array(still, dtype=np.int64)
         cur_band = max(cur_band * 4, 4)
     for k in todo:
         # beyond the band ceiling: bounded host oracle or give up
         if int(q_lens[k]) * int(t_lens[k]) <= _ORACLE_CELL_LIMIT:
-            out[k] = full_gotoh_traceback(qs[k, :q_lens[k]],
-                                          ts[k, :t_lens[k]], params)
-    return out
+            out[idxs[k]] = full_gotoh_traceback(qs[k, :q_lens[k]],
+                                                ts[k, :t_lens[k]],
+                                                params)

@@ -115,7 +115,7 @@ def analyze_events_device(refseq: bytes, events, skip_codan: bool = False,
 
 def submit_diff_info_batch(batch, f, skip_codan: bool = False,
                            motifs=DEFAULT_MOTIFS, summary=None,
-                           max_ev: int = MAX_EV):
+                           max_ev: int = MAX_EV, stats=None):
     """Launch the device analysis for a report batch and return a
     ``finish() -> None`` closure that fetches the results and writes the
     rows (the SURVEY.md §3.1 TPU boundary: host parse -> batch -> one
@@ -138,6 +138,8 @@ def submit_diff_info_batch(batch, f, skip_codan: bool = False,
         # same observable behavior as --device=cpu.  Warn once so a dead
         # device path can't hide behind the always-correct replay.
         global _warned_fallback
+        if stats is not None:
+            stats.fallback_batches += 1
         if not _warned_fallback:
             _warned_fallback = True
             print(f"Warning: device batch analysis failed "

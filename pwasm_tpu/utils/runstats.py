@@ -27,6 +27,7 @@ class RunStats:
         self.aligned_bases = 0    # sum of per-alignment target span
         self.events = 0           # diff events reported
         self.device_batches = 0   # device flushes (--device=tpu)
+        self.fallback_batches = 0  # device batches replayed on host
         self.realigned = 0        # alignments re-aligned (--realign)
 
     @property
@@ -49,6 +50,7 @@ class RunStats:
             "aligned_bases": self.aligned_bases,
             "events": self.events,
             "device_batches": self.device_batches,
+            "fallback_batches": self.fallback_batches,
             "realigned": self.realigned,
             "wall_s": round(self.wall_s, 3),
             "aligned_bases_per_s": round(self.rate(), 1),

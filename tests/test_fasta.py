@@ -41,3 +41,83 @@ def test_file_size(tmp_path):
     p = tmp_path / "x.fa"
     p.write_text(">s\nACGT\n")
     assert FastaFile(p).file_size() == 8
+
+
+def test_fai_sidecar_written_and_loaded(tmp_path, monkeypatch):
+    """Second open of a uniformly-wrapped FASTA loads the .fai sidecar
+    with NO full scan, and the loaded index equals a fresh build."""
+    p = tmp_path / "big.fa"
+    recs = [("g1", b"ACGTACGTACGT" * 10), ("g2", b"TTTTGGGGCCCC" * 7),
+            ("g3", b"ACG")]
+    write_fasta(str(p), recs, width=60)
+    fa1 = FastaFile(p)
+    fai = tmp_path / "big.fa.fai"
+    assert fai.exists()
+    body = fai.read_text()
+    assert body.splitlines()[0].split("\t")[:2] == ["g1", "120"]
+
+    def boom(self):
+        raise AssertionError("full scan ran despite a fresh sidecar")
+
+    monkeypatch.setattr(FastaFile, "_full_scan", boom)
+    fa2 = FastaFile(p)
+    assert fa2.names == fa1.names
+    for name, seq in recs:
+        assert fa2.fetch(name) == seq
+        assert fa2.length(name) == fa1.length(name)
+    assert fa2._index == fa1._index
+
+
+def test_fai_sidecar_stale_triggers_rescan(tmp_path):
+    """A FASTA newer than its sidecar must be re-scanned (and the
+    sidecar refreshed), never served stale."""
+    import os
+    import time as _time
+
+    p = tmp_path / "x.fa"
+    write_fasta(str(p), [("a", b"ACGT" * 5)])
+    FastaFile(p)
+    write_fasta(str(p), [("a", b"ACGT" * 5), ("b", b"GG" * 30)])
+    now = _time.time()
+    os.utime(p, (now + 5, now + 5))  # FASTA strictly newer
+    fa = FastaFile(p)
+    assert fa.names == ["a", "b"]
+    assert fa.fetch("b") == b"GG" * 30
+
+
+def test_fai_not_written_when_geometry_cannot_describe(tmp_path):
+    """A wrapping the 5-column format can't reproduce (derived end
+    would be wrong) must not be persisted — correctness over caching."""
+    p = tmp_path / "odd.fa"
+    p.write_text(">s\nAC\nACGTACGT\n")  # short FIRST line
+    fa = FastaFile(p)
+    assert fa.fetch("s") == b"ACACGTACGT"
+    assert not (tmp_path / "odd.fa.fai").exists()
+
+
+def test_fai_not_written_for_midfile_eof_coincidence(tmp_path):
+    """A mid-file record whose window coincides with the missing-final-
+    newline size must NOT persist: the derived end would overshoot into
+    the next record's header on reload (code-review r3 finding)."""
+    p = tmp_path / "trap.fa"
+    p.write_text(">s\nACGTACGT\nACGTACGTA\n>t\nAC\n")
+    fa = FastaFile(p)
+    assert fa.fetch("s") == b"ACGTACGTACGTACGTA"
+    assert fa.fetch("t") == b"AC"
+    assert not (tmp_path / "trap.fa.fai").exists()
+    # and a second open (full re-scan) still fetches identically
+    fa2 = FastaFile(p)
+    assert fa2.fetch("s") == b"ACGTACGTACGTACGTA"
+
+
+def test_fai_written_when_derived_end_coincides(tmp_path, monkeypatch):
+    """Mid-record irregularity whose derived end still lands on the
+    scanned end IS persistable — reload must fetch identically."""
+    p = tmp_path / "odd2.fa"
+    p.write_text(">s\nACGTACGT\nAC\nACGTACGT\n")  # 8,2,8: end coincides
+    fa1 = FastaFile(p)
+    assert (tmp_path / "odd2.fa.fai").exists()
+    monkeypatch.setattr(FastaFile, "_full_scan",
+                        lambda self: (_ for _ in ()).throw(AssertionError))
+    fa2 = FastaFile(p)
+    assert fa2.fetch("s") == fa1.fetch("s") == b"ACGTACGTACACGTACGT"

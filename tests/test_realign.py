@@ -235,6 +235,41 @@ def test_gap_extraction_overflow_flag():
     assert bool(np.asarray(slots[6])[0])  # overflow
 
 
+def test_realign_pairs_length_buckets(monkeypatch):
+    """Mixed short/long lanes dispatch in separate shape buckets — one
+    long target must not inflate every short lane's tensors (SURVEY
+    §7.3 variable-length batching) — and bucketing must not change any
+    result."""
+    import pwasm_tpu.ops.realign as ra
+
+    shapes = []
+    real = ra.banded_realign_rows
+
+    def spy(qs, ts, *a, **k):
+        shapes.append((np.asarray(qs).shape, np.asarray(ts).shape))
+        return real(qs, ts, *a, **k)
+
+    monkeypatch.setattr(ra, "banded_realign_rows", spy)
+    rng = np.random.default_rng(20)
+    pairs = []
+    for i in range(6):
+        m = 3000 if i == 3 else 300
+        q = rng.integers(0, 4, m).astype(np.int8)
+        t = _mutate(rng, q, 4, 2)
+        pairs.append((bytes(b"ACGT"[c] for c in q),
+                      bytes(b"ACGT"[c] for c in t)))
+    results = ra.realign_pairs(pairs, band=32)
+    short = [s for s in shapes if s[0][1] <= 512]
+    long_ = [s for s in shapes if s[0][1] >= 2944]
+    assert short and long_ and len(short) + len(long_) == len(shapes)
+    assert all(s[0][0] == 5 for s in short)   # 5 short lanes together
+    assert all(s[0][0] == 1 for s in long_)   # the long lane alone
+    for p, r in zip(pairs, results):
+        [(s1, o1)] = ra.realign_pairs([p], band=32)
+        assert r[0] == s1
+        np.testing.assert_array_equal(r[1], o1)
+
+
 @pytest.mark.parametrize("seed", [11, 12, 13])
 def test_pallas_rowwalk_matches_xla(seed):
     """The fused Pallas forward+walk kernels must be bit-identical to

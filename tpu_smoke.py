@@ -94,11 +94,25 @@ def main() -> int:
                                                  tld, band=band))
         assert np.array_equal(got, want_m2m), "score mismatch"
 
+    def realign():
+        from pwasm_tpu.ops.realign import banded_realign_rows
+        qs = np.broadcast_to(q, (ts.shape[0], len(q))).copy()
+        qls = np.full(ts.shape[0], len(q), dtype=np.int32)
+        ref = banded_realign_rows(qs, ts, qls, t_lens, band=band,
+                                  kernel="xla")
+        got = banded_realign_rows(qs, ts, qls, t_lens, band=band,
+                                  kernel="pallas")
+        for name, a, b in zip(("scores", "leads", "iy", "ops", "ok"),
+                              ref, got):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), \
+                f"{name} mismatch"
+
     kernels = {"banded_scores_pallas": dp_pallas,
                "banded_scores_long": dp_long,
                "banded_scores_packed": dp_packed,
                "consensus_pallas": consensus,
-               "many2many_scores_pallas": m2m}
+               "many2many_scores_pallas": m2m,
+               "realign_fwdptr_walk_pallas": realign}
     results = {}
     for name, fn in kernels.items():
         try:
