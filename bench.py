@@ -762,6 +762,12 @@ def _run_all() -> int:
         smoke = {"smoke": "pallas_lowering", "ok": False,
                  "error": f"{type(e).__name__}: {e}"}
         rc = 1
+        try:  # never leave a stale passing artifact from a prior round
+            with open(os.path.join(repo, "TPU_SMOKE.json"), "w") as f:
+                json.dump(smoke, f)
+                f.write("\n")
+        except OSError:
+            pass
     row = {"metric": "pallas_lowering_ok",
            "value": 1 if smoke.get("ok") else 0, "unit": "bool",
            "vs_baseline": 0, "config": 0}

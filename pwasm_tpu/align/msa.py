@@ -408,7 +408,7 @@ class Msa:
                 in self.column_contributors(col)
                 if not clipped and sym.upper() != want]
 
-    def build_msa(self, device: bool = False) -> None:
+    def build_msa(self, device: bool = False, mesh=None) -> None:
         """(GSeqAlign::buildMSA, GapAssem.cpp:1088-1106).  With ``device``
         the column counts (and the consensus votes) come from one Pallas
         launch over ``pileup_matrix()`` (ops.consensus.consensus_pallas —
@@ -437,7 +437,7 @@ class Msa:
                 self.badseqs += 1
             self._seq_to_columns(s, self.msacolumns, count=not device)
         if device:
-            self._device_count_votes()
+            self._device_count_votes(mesh)
 
     def _err_zero_cov(self, col: int) -> None:
         """(GSeqAlign::ErrZeroCov, GapAssem.cpp:1121-1131; exit 5)"""
@@ -448,7 +448,7 @@ class Msa:
             print(s.name, file=sys.stderr)
         raise ZeroCoverageError(f"zero-coverage column {col}")
 
-    def _device_count_votes(self) -> None:
+    def _device_count_votes(self, mesh=None) -> None:
         """Fill the column counts AND the consensus votes from one device
         launch: ``pileup_matrix()`` → ``consensus_pallas`` (pileup counting
         + the bestChar vote fused in a single Pallas kernel).  This is the
@@ -456,14 +456,37 @@ class Msa:
         (GapAssem.cpp:1088-1106, 1048-1069).  Zero-coverage columns vote 0,
         exactly like ``best_char``.  Bit-exact with the CPU path by
         construction: integer counts over the same pileup, same closed-form
-        vote rule."""
+        vote rule.
+
+        With ``mesh`` (a jax.sharding.Mesh from ``pafreport --shard``)
+        the pileup shards (depth, cols) over the mesh and the per-column
+        class counts are ``psum``-reduced over the depth axis before the
+        vote — the north-star ICI collective (SURVEY.md §0).  Same
+        integers, so still bit-exact."""
         import jax.numpy as jnp
 
-        from pwasm_tpu.ops.consensus import consensus_pallas
-
         cols = self.msacolumns
-        votes, counts = consensus_pallas(jnp.asarray(self.pileup_matrix()))
-        counts = np.asarray(counts)
+        if mesh is not None:
+            from pwasm_tpu.parallel.mesh import sharded_counts_votes
+
+            pile = self.pileup_matrix()
+            d_ax = mesh.shape["depth"]
+            c_ax = int(np.prod([mesh.shape[a] for a in mesh.axis_names
+                                if a != "depth"]))
+            pad_d = -len(pile) % d_ax
+            pad_c = -pile.shape[1] % c_ax
+            if pad_d or pad_c:
+                pile = np.pad(pile, ((0, pad_d), (0, pad_c)),
+                              constant_values=6)
+            votes, counts = sharded_counts_votes(mesh)(jnp.asarray(pile))
+            votes = votes[:self.length]
+            counts = np.asarray(counts)[:self.length]
+        else:
+            from pwasm_tpu.ops.consensus import consensus_pallas
+
+            votes, counts = consensus_pallas(
+                jnp.asarray(self.pileup_matrix()))
+            counts = np.asarray(counts)
         cols.counts[:] = counts
         cols.layers[:] = counts.sum(axis=1, dtype=np.int32)
         v = np.asarray(votes)
@@ -475,7 +498,7 @@ class Msa:
 
     def refine_msa(self, remove_cons_gaps: bool = True,
                    refine_clipping: bool = True,
-                   device: bool = False) -> None:
+                   device: bool = False, mesh=None) -> None:
         """Consensus construction + clipping refinement driver
         (GSeqAlign::refineMSA, GapAssem.cpp:1133-1183).  The two flags are
         the reference's MSAColumns statics; pafreport runs with
@@ -484,7 +507,7 @@ class Msa:
         the pileup tensor (see build_msa/_device_count_votes) instead of
         host scatter-adds + per-column votes (same integer rule,
         bit-exact)."""
-        self.build_msa(device=device)
+        self.build_msa(device=device, mesh=mesh)
         cols = self.msacolumns
         if device and self._device_vote_chars is not None:
             votes = self._device_vote_chars[cols.mincol:cols.maxcol + 1]

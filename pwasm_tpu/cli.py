@@ -56,6 +56,10 @@ USAGE = """Usage:
                resumed portion)
    --profile=DIR  write a jax.profiler device trace for the run
    --stats=FILE   write run statistics as one JSON object
+   --shard[=N]    (with --device=tpu) shard the device work over a mesh
+               of N chips (default: all visible): the analysis batch
+               spreads over the mesh and consensus pileup counts are
+               psum-reduced over the depth axis before the vote
 """
 
 # reference optstring: "DGFCNvd:p:r:o:m:w:c:s:" — -d/-p/-m take a value but
@@ -167,6 +171,19 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
             setattr(cfg, knob, int(val))
     if "motifs" in opts:
         cfg.motifs = load_motifs(str(opts["motifs"]))
+    if "shard" in opts:
+        val = opts["shard"]
+        if val is True:
+            cfg.shard = -1          # all visible devices
+        elif str(val).isascii() and str(val).isdigit() and int(val) >= 1:
+            cfg.shard = int(val)
+        else:
+            stderr.write(f"{USAGE}\nInvalid --shard value: {val}\n")
+            return EXIT_USAGE
+        if cfg.device != "tpu":
+            stderr.write(f"{USAGE} Error: --shard requires "
+                         "--device=tpu!\n")
+            return EXIT_USAGE
     cfg.realign = bool(opts.get("realign"))
     if cfg.realign and "w" not in opts \
             and not any(k in opts for k in ("ace", "info", "cons")):
@@ -336,6 +353,25 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
     cons_outs = cons_outs or {}
     build_msa_out = fmsa is not None or bool(cons_outs)
 
+    # --shard: one mesh for the whole run (device work spreads over it;
+    # consensus counts psum over its depth axis).  Built lazily so a
+    # plain run never initializes jax.
+    shard_mesh = None
+    if use_device and cfg.shard:
+        import jax
+
+        from pwasm_tpu.parallel.mesh import make_mesh
+        n_dev = len(jax.devices())
+        want = n_dev if cfg.shard < 0 else cfg.shard
+        if want > n_dev:
+            raise PwasmError(
+                f"Error: --shard={want} but only {n_dev} devices are "
+                "visible!\n")
+        shard_mesh = make_mesh(want)
+        if cfg.verbose:
+            print(f"sharding over mesh {dict(shard_mesh.shape)}",
+                  file=stderr)
+
     inflight: list = []   # at most one submitted-but-unformatted batch
 
     def msa_add(aln, tlabel: str, refseq_b: bytes, ord_num: int) -> None:
@@ -424,7 +460,8 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         if batch:
             inflight.append(submit_diff_info_batch(
                 batch, freport, skip_codan=cfg.skip_codan,
-                motifs=cfg.motifs, summary=summary, stats=stats))
+                motifs=cfg.motifs, summary=summary, stats=stats,
+                mesh=shard_mesh))
             stats.device_batches += 1
         while len(inflight) > (0 if drain else 1):
             try:
@@ -580,7 +617,7 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         ref_msa.finalize()
         ref_msa.refine_msa(remove_cons_gaps=cfg.remove_cons_gaps,
                            refine_clipping=cfg.refine_clipping,
-                           device=use_device)
+                           device=use_device, mesh=shard_mesh)
         contig = ref_msa.seqs[0].name if ref_msa.seqs else "contig"
         if "ace" in cons_outs:
             ref_msa.write_ace(cons_outs["ace"], contig)

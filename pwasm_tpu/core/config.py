@@ -34,6 +34,8 @@ class Config:
     band: int = 64                  # banded-DP band width
     batch: int = 256                # device batch size
     realign: bool = False           # --realign: DP traceback gaps for MSA
+    shard: int = 0                  # --shard[=N]: mesh over N devices
+    #                                 (0 = off, -1 = all visible devices)
 
     # run-control / observability knobs (SURVEY.md §5; no ref equivalent)
     skip_bad_lines: bool = False    # warn + continue on malformed lines

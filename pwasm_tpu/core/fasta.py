@@ -55,11 +55,20 @@ class FastaFile:
         """Load the ``.fai`` sidecar when present and not older than the
         FASTA itself.  The 5-column samtools layout is name, length,
         offset, linebases, linewidth; the fetch window's end offset is
-        derived from the line geometry."""
+        derived from the line geometry.
+
+        mtime alone cannot catch an mtime-preserving content swap
+        (``cp -p``/``rsync -a``), so the loaded geometry is probed
+        against the file's structure: a header must end right before
+        each record's first base, the next record's ``>`` must sit
+        exactly where the previous record's window closes, and the last
+        window must close at EOF (modulo a missing final newline).  Any
+        probe failure falls back to a full scan."""
         try:
             if (os.path.getmtime(self._fai_path)
                     < os.path.getmtime(self.path)):
                 return False
+            rows = []
             with open(self._fai_path) as f:
                 for line in f:
                     if not line.strip():
@@ -71,7 +80,24 @@ class FastaFile:
                         return False
                     nlines = (length + lb - 1) // lb
                     end = offset + length + nlines * (lw - lb)
-                    self._add(name, length, offset, end)
+                    rows.append((name, length, offset, end, lw - lb))
+            if not rows:
+                return False
+            fsize = os.path.getsize(self.path)
+            with open(self.path, "rb") as f:
+                if f.read(1) != b">":
+                    return False
+                for _n, _l, offset, end, term in rows:
+                    f.seek(offset - 1)
+                    if f.read(1) != b"\n":
+                        return False
+                    f.seek(end)
+                    nxt = f.read(1)
+                    if nxt != b">" and not (
+                            nxt == b"" and end in (fsize, fsize + term)):
+                        return False
+            for name, length, offset, end, _t in rows:
+                self._add(name, length, offset, end)
         except (OSError, ValueError):
             self._index.clear()
             self._order.clear()
@@ -88,24 +114,38 @@ class FastaFile:
             with open(self.path, "rb") as f:
                 for name in self._order:
                     ent = self._index[name]
+                    if "\t" in name or "\n" in name:
+                        return
                     f.seek(ent.offset)
                     first = f.readline()
                     lb = len(first.rstrip(b"\r\n"))
                     lw = len(first)
                     if lb < 1 or lw <= lb:
                         return
-                    nlines = (ent.length + lb - 1) // lb
-                    span = ent.length + nlines * (lw - lb)
-                    # uniform wrapping must reproduce the scanned window;
-                    # a missing final newline is only legitimate at EOF —
-                    # anywhere else the reload would overshoot into the
-                    # next record's '>' header
-                    window = ent.end - ent.offset
-                    if window != span and not (
-                            window == span - (lw - lb)
-                            and ent.end == fsize):
-                        return
-                    if "\t" in name or "\n" in name:
+                    # verify EVERY line: foreign faidx readers
+                    # (samtools/pysam) derive in-record offsets from the
+                    # line geometry, so a coincidental total-window match
+                    # is not enough — each full line must carry exactly
+                    # lb bases and the same terminator, no interior
+                    # whitespace; the final line may be short, and may
+                    # lack its terminator only at EOF
+                    f.seek(ent.offset)
+                    left = ent.length
+                    pos = ent.offset
+                    while left > 0:
+                        line = f.readline()
+                        pos += len(line)
+                        body = line.rstrip(b"\r\n")
+                        if body.translate(
+                                None, b" \t\v\f\r\n") != body:
+                            return
+                        if len(body) != min(lb, left):
+                            return
+                        if len(line) - len(body) != lw - lb and not (
+                                len(body) == left and pos == fsize):
+                            return
+                        left -= len(body)
+                    if pos != ent.end:
                         return
                     rows.append(f"{name}\t{ent.length}\t{ent.offset}"
                                 f"\t{lb}\t{lw}\n")

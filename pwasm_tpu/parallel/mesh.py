@@ -77,6 +77,29 @@ def sharded_consensus(mesh: Mesh, dp_axes=("batch",)):
     return jax.jit(fn)
 
 
+def sharded_counts_votes(mesh: Mesh, dp_axes=("batch",)):
+    """Counts AND votes with the pileup sharded (depth, cols) over the
+    mesh — the product consensus path behind ``pafreport --shard``:
+    local pileup counts per shard, ``psum`` over the depth axis (the
+    north-star ICI collective, SURVEY.md §0), local votes per column
+    shard.  The summed counts are returned too, so the host column
+    tensor (MsaColumns) is filled from the same reduction the vote used.
+    Returns a jitted fn(bases (depth, cols)) -> (votes (cols,) int8,
+    counts (cols, 6) int32); depth must divide the mesh depth axis and
+    cols the ``dp_axes`` product (callers pad with code 6, which
+    contributes nothing)."""
+    dp = dp_axes if len(dp_axes) > 1 else dp_axes[0]
+
+    def block(b_local):
+        total = jax.lax.psum(pileup_counts(b_local), "depth")
+        return consensus_vote_counts(total), total
+
+    fn = shard_map(block, mesh=mesh,
+                   in_specs=P("depth", dp),
+                   out_specs=(P(dp), P(dp, None)))
+    return jax.jit(fn)
+
+
 def make_pipeline_step(mesh: Mesh, band: int = 32,
                        params: ScoreParams = ScoreParams()):
     """The full sharded pipeline step — the framework's 'training step'

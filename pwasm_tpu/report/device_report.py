@@ -35,9 +35,24 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _pad_axis0(v, n: int):
+    """Pad an event tensor's leading axis to a multiple of ``n`` (rows
+    of zeros/PAD are inert 0-length events, like pack_events' own
+    bucket padding)."""
+    import jax.numpy as jnp
+
+    pad = -v.shape[0] % n
+    if not pad:
+        return v
+    fill = PAD_CODE if v.dtype == jnp.int8 else 0
+    return jnp.pad(v, ((0, pad),) + ((0, 0),) * (v.ndim - 1),
+                   constant_values=fill)
+
+
 def submit_events_device(refseq: bytes, events,
                          skip_codan: bool = False,
-                         motifs=DEFAULT_MOTIFS, max_ev: int = MAX_EV):
+                         motifs=DEFAULT_MOTIFS, max_ev: int = MAX_EV,
+                         mesh=None):
     """Launch the device analysis of a batch of DiffEvents and return a
     ``finish() -> list[tuple]`` closure that fetches and assembles the
     results.
@@ -63,6 +78,21 @@ def submit_events_device(refseq: bytes, events,
     out = None
     if small:
         packed = pack_events(small, max_ev)
+        if mesh is not None:
+            # --shard: spread the event batch over the mesh (all axes
+            # flattened — the analysis is embarrassingly parallel, so
+            # GSPMD partitions the fused program with no collectives)
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            n_mesh = int(np.prod(list(mesh.shape.values())))
+            packed = {
+                k: jax.device_put(
+                    _pad_axis0(v, n_mesh),
+                    NamedSharding(mesh, PartitionSpec(
+                        tuple(mesh.axis_names),
+                        *([None] * (v.ndim - 1)))))
+                for k, v in packed.items()}
         mot_codes, mot_lens = pack_motifs(motifs)
         # pad the reference to the (256-rounded) max_len so the jitted
         # program is keyed on the bucket, not the exact ref length — one
@@ -115,7 +145,7 @@ def analyze_events_device(refseq: bytes, events, skip_codan: bool = False,
 
 def submit_diff_info_batch(batch, f, skip_codan: bool = False,
                            motifs=DEFAULT_MOTIFS, summary=None,
-                           max_ev: int = MAX_EV, stats=None):
+                           max_ev: int = MAX_EV, stats=None, mesh=None):
     """Launch the device analysis for a report batch and return a
     ``finish() -> None`` closure that fetches the results and writes the
     rows (the SURVEY.md §3.1 TPU boundary: host parse -> batch -> one
@@ -158,7 +188,7 @@ def submit_diff_info_batch(batch, f, skip_codan: bool = False,
     try:
         for refseq, events in groups.items():
             finishes.append((events, submit_events_device(
-                refseq, events, skip_codan, motifs, max_ev)))
+                refseq, events, skip_codan, motifs, max_ev, mesh=mesh)))
     except Exception as e:
         err = e
 

@@ -298,6 +298,39 @@ def test_ace_device_deep_pileup_kernel_counts(tmp_path, monkeypatch):
     assert out_dev.read_text() == out_cpu.read_text()
 
 
+def test_shard_cli_byte_identical_on_virtual_mesh(tmp_path):
+    """--shard over the 8 virtual CPU devices (conftest mesh): report
+    AND consensus outputs byte-identical to the unsharded device run —
+    the product multi-chip path (VERDICT r2 next #5)."""
+    import jax
+
+    assert len(jax.devices()) >= 8
+    lines = []
+    for k in range(64):
+        ops = [[("=", 10)], [("=", 6), ("ins", "gg"), ("=", 4)],
+               [("=", 2), ("del", 2), ("=", 6)]][k % 3]
+        l, _ = make_paf_line("q", Q, f"t{k:03d}", "+", ops)
+        lines.append(l)
+    paf, fa = _mk_inputs(tmp_path, lines)
+    outs = {}
+    for mode, extra in (("plain", []), ("shard", ["--shard"]),
+                        ("shard4", ["--shard=4"])):
+        rep = tmp_path / f"{mode}.dfa"
+        ace = tmp_path / f"{mode}.ace"
+        rc = run([paf, "-r", fa, "-o", str(rep), f"--ace={ace}",
+                  "--device=tpu"] + extra, stderr=io.StringIO())
+        assert rc == 0, mode
+        outs[mode] = rep.read_text() + ace.read_text()
+    assert outs["plain"] == outs["shard"] == outs["shard4"]
+
+
+def test_shard_requires_device_tpu(tmp_path):
+    paf, fa = _mk_inputs(tmp_path, _three_alignments())
+    err = io.StringIO()
+    assert run([paf, "-r", fa, "--shard"], stderr=err) == 1
+    assert "--shard requires --device=tpu" in err.getvalue()
+
+
 def test_cons_requires_gene_mode(tmp_path):
     paf, fa = _mk_inputs(tmp_path, _three_alignments())
     err = io.StringIO()

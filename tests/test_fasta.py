@@ -110,14 +110,36 @@ def test_fai_not_written_for_midfile_eof_coincidence(tmp_path):
     assert fa2.fetch("s") == b"ACGTACGTACGTACGTA"
 
 
-def test_fai_written_when_derived_end_coincides(tmp_path, monkeypatch):
-    """Mid-record irregularity whose derived end still lands on the
-    scanned end IS persistable — reload must fetch identically."""
+def test_fai_not_written_for_any_irregular_wrapping(tmp_path):
+    """Even when the derived end coincidentally matches the scanned
+    window (lines 8,2,8), the geometry misdescribes the record for
+    foreign faidx readers (samtools/pysam derive in-record offsets from
+    linebases) — so no sidecar may be written (code-review r3)."""
     p = tmp_path / "odd2.fa"
-    p.write_text(">s\nACGTACGT\nAC\nACGTACGT\n")  # 8,2,8: end coincides
+    p.write_text(">s\nACGTACGT\nAC\nACGTACGT\n")
+    fa = FastaFile(p)
+    assert fa.fetch("s") == b"ACGTACGTACACGTACGT"
+    assert not (tmp_path / "odd2.fa.fai").exists()
+
+
+def test_fai_mtime_preserving_swap_detected(tmp_path):
+    """Replacing the FASTA with cp -p style mtime preservation must not
+    serve the stale index: the structural probes catch a layout change
+    and fall back to a full scan (code-review r3)."""
+    import os
+
+    p = tmp_path / "swap.fa"
+    write_fasta(str(p), [("a", b"ACGT" * 30), ("b", b"TTTT" * 9)])
     fa1 = FastaFile(p)
-    assert (tmp_path / "odd2.fa.fai").exists()
-    monkeypatch.setattr(FastaFile, "_full_scan",
-                        lambda self: (_ for _ in ()).throw(AssertionError))
+    old_times = (os.path.getatime(p), os.path.getmtime(p))
+    fai_times = (os.path.getatime(str(p) + ".fai"),
+                 os.path.getmtime(str(p) + ".fai"))
+    # swap in a differently-shaped file, preserving mtimes
+    write_fasta(str(p), [("x", b"GG" * 8), ("y", b"CC" * 50),
+                         ("z", b"AA" * 3)], width=20)
+    os.utime(p, old_times)
+    os.utime(str(p) + ".fai", fai_times)
     fa2 = FastaFile(p)
-    assert fa2.fetch("s") == fa1.fetch("s") == b"ACGTACGTACACGTACGT"
+    assert fa2.names == ["x", "y", "z"]
+    assert fa2.fetch("y") == b"CC" * 50
+    assert fa1.names == ["a", "b"]
