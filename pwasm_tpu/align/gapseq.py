@@ -225,154 +225,13 @@ class GapSeq:
         GapAssem.cpp:182-349).  ``cpos`` is this sequence's start column
         on the consensus.
 
-        Vectorized: the gapped layout, the initial-match search and the
-        X-drop extension are numpy array passes (cumsum/argmax) instead
-        of the reference's per-character walk — O(layout length) numpy
-        work per end.  Bit-exact with ``refine_clipping_scalar`` (the
-        direct transliteration kept below as the parity oracle;
-        tests/test_gapseq_refine.py fuzzes the two against each other).
+        Delegates to ``refine_clipping_batch`` with a single member —
+        ONE vectorized implementation serves both the per-member and the
+        whole-MSA paths, and the member-by-member fuzz against the
+        transliterated reference walk (``refine_clipping_scalar``,
+        tests/test_gapseq_refine.py) gates them both.
         """
-        if self.clp3 == 0 and self.clp5 == 0:
-            return
-        cons_arr = np.frombuffer(cons, dtype=np.uint8)
-        cons_len = len(cons)
-        rev = self.revcompl != 0
-        clipL, clipR = self.clip_lr()
-        star = ord("*")
-
-        g = self.gaps.astype(np.int64)
-        glen0 = self.seqlen + self.numgaps
-        allocsize = glen0
-        gclipL, gclipR = clipL, clipR
-        if skip_dels:
-            right = g[self.seqlen - clipR:] if clipR else g[:0]
-            left = g[:clipL]
-            allocsize += int((right < 0).sum()) + int((left < 0).sum())
-            gclipR += int(right[right >= 0].sum())
-            gclipL += int(left[left >= 0].sum())
-        else:
-            gclipR += int(g[self.seqlen - clipR:].sum()) if clipR else 0
-            gclipL += int(g[:clipL].sum())
-
-        # gapped layout: per base, max(g,0) star columns then the base
-        # (deleted bases emit nothing unless skip_dels keeps clip-region
-        # ones, mirroring GapAssem.cpp:254-266)
-        stars = np.maximum(g, 0)
-        if skip_dels:
-            in_clip = np.zeros(self.seqlen, dtype=bool)
-            if clipL:
-                in_clip[:clipL] = True
-            if clipR:
-                in_clip[self.seqlen - clipR:] = True
-            include = (g >= 0) | in_clip
-        else:
-            include = g >= 0
-        glen = glen0 + int((include & (g < 0)).sum())
-        if glen != allocsize:
-            raise PwasmError(
-                f"Length mismatch (allocsize {allocsize} vs. glen {glen}) "
-                f"while refineClipping for seq {self.name} !\n")
-        counts = stars + include
-        ends = np.cumsum(counts)
-        total = int(ends[-1]) if self.seqlen else 0
-        gseq = np.full(total, star, dtype=np.uint8)
-        gxpos = np.full(total, -1, dtype=np.int64)
-        seq_arr = np.frombuffer(bytes(self.seq), dtype=np.uint8)
-        base_idx = (ends - 1)[include]
-        gseq[base_idx] = seq_arr[include]
-        gxpos[base_idx] = np.nonzero(include)[0]
-
-        def write_back():
-            # the reference's clipL/clipR are int& aliases of clp5/clp3,
-            # so every increment persists even on early aborts
-            if rev:
-                self.clp3, self.clp5 = clipL, clipR
-            else:
-                self.clp5, self.clp3 = clipL, clipR
-
-        def _take(arr, idx, valid):
-            """arr[idx] where valid, 0 elsewhere — safe for empty arr
-            and out-of-range idx (np.where would evaluate eagerly)."""
-            out = np.zeros(len(idx), dtype=np.uint8)
-            if arr.size:
-                out[valid] = arr[idx[valid]]
-            return out
-
-        def seek(sp_cand, cp_cand):
-            """Initial-match search over candidate positions (in walk
-            order): returns (index of first match or None, #clip bumps
-            before it / over all candidates)."""
-            valid_s = (sp_cand >= 0) & (sp_cand < total)
-            gs = _take(gseq, sp_cand, valid_s)
-            valid_c = (cp_cand >= 0) & (cp_cand < cons_len)
-            cs = _take(cons_arr, cp_cand, valid_c)
-            hit = valid_s & valid_c & (gs == cs) & (gs != star)
-            bump = valid_s & (gs != star)
-            if not hit.any():
-                return None, int(bump.sum())
-            k = int(np.argmax(hit))
-            return k, int(bump[:k].sum())
-
-        def extend(sp_m, cp_m, direction):
-            """X-drop extension from the initial match at (sp_m, cp_m);
-            returns bestpos (== sp_m when no improvement)."""
-            if direction > 0:
-                K = min(glen - 1 - sp_m, cons_len - 1 - cp_m)
-            else:
-                K = min(sp_m, cp_m)
-            if K <= 0:
-                return sp_m
-            ks = np.arange(1, K + 1)
-            gs = gseq[sp_m + direction * ks]
-            cs = cons_arr[cp_m + direction * ks]
-            nonstar = gs != star
-            eq = gs == cs
-            delta = np.where(nonstar,
-                             np.where(eq, self.MATCH_SC,
-                                      self.MISMATCH_SC), 0)
-            scores = self.MATCH_SC + np.cumsum(delta)
-            stop = scores <= self.XDROP
-            limit = int(np.argmax(stop)) + 1 if stop.any() else K
-            cand = np.where(eq & nonstar, scores, self.XDROP)[:limit]
-            if cand.size and cand.max() > self.MATCH_SC:
-                return sp_m + direction * (int(np.argmax(cand)) + 1)
-            return sp_m
-
-        if clipR > 0:
-            sp0 = glen - gclipR - 1
-            # candidates walk down to gclipL; below it the scalar aborts
-            n_cand = (sp0 - gclipL + 1) if sp0 >= gclipL else 1
-            d = np.arange(n_cand, dtype=np.int64)
-            k, bumps = seek(sp0 - d, cpos + sp0 - d)
-            if k is None:
-                clipR += bumps
-                print(f"Warning: reached clipL trying to find an "
-                      f"initial match on {self.name}!", file=sys.stderr)
-                write_back()
-                return
-            clipR += bumps
-            sp_m = sp0 - k
-            bestpos = extend(sp_m, cpos + sp_m, +1)
-            if bestpos > sp_m:
-                clipR = self.seqlen - int(gxpos[bestpos]) - 1
-        if clipL > 0:
-            sp0 = gclipL
-            hi = glen - gclipR - 1  # candidates walk up to here
-            n_cand = (hi - sp0 + 1) if hi >= sp0 else 1
-            d = np.arange(n_cand, dtype=np.int64)
-            k, bumps = seek(sp0 + d, cpos + sp0 + d)
-            if k is None:
-                clipL += bumps
-                print(f"Warning: reached clipR trying to find an "
-                      f"initial match on {self.name}!", file=sys.stderr)
-                write_back()
-                return
-            clipL += bumps
-            sp_m = sp0 + k
-            bestpos = extend(sp_m, cpos + sp_m, -1)
-            if bestpos < sp_m:
-                clipL = int(gxpos[bestpos])
-        write_back()
+        refine_clipping_batch([self], cons, [cpos], skip_dels=skip_dels)
 
     def refine_clipping_scalar(self, cons: bytes, cpos: int,
                                skip_dels: bool = False) -> None:
@@ -592,3 +451,250 @@ class GapSeq:
         if printed < llen:
             out.append("\n")
         f.write("".join(out))
+
+
+# ---------------------------------------------------------------------------
+# batched X-drop clipping refinement: all MSA members in ONE 2-D pass
+# ---------------------------------------------------------------------------
+def refine_clipping_batch(seqs: list[GapSeq], cons: bytes,
+                          cposes: list[int],
+                          skip_dels: bool = False) -> None:
+    """Refine the clipped ends of MANY members against the consensus in
+    one vectorized pass (the refineMSA member loop,
+    GapAssem.cpp:1133-1183, flattened into (members, layout) tensors).
+
+    Per member this runs the exact ``GapSeq.refine_clipping`` program —
+    same initial-match seek, same X-drop extension, same clip-bump and
+    abort semantics (fuzz-gated member-by-member in
+    tests/test_gapseq_refine.py) — but the seek and extension passes are
+    single 2-D numpy programs over every clipped member at once instead
+    of a Python loop of 1-D passes.  Members with no clips are skipped
+    outright (the common case costs nothing).
+    """
+    sel = [i for i, s in enumerate(seqs) if s.clp5 or s.clp3]
+    if not sel:
+        return
+    cons_arr = np.frombuffer(cons, dtype=np.uint8)
+    cons_len = len(cons)
+    star = ord("*")
+    M = len(sel)
+    XDROP = GapSeq.XDROP
+    MATCH_SC = GapSeq.MATCH_SC
+    MISMATCH_SC = GapSeq.MISMATCH_SC
+
+    # --- per-member gapped layout build (ragged -> padded 2-D) ----------
+    # NB two different lengths per member, exactly like the 1-D pass:
+    # ``glen`` is the REFERENCE walk length (seqlen + numgaps, plus the
+    # clip-kept deletions under skip_dels — GapAssem.cpp:243) used for
+    # every bound, while ``totals`` is the actual rendered layout array
+    # length used for index validity; doubly-deleted bases (gap <= -2)
+    # make them differ.
+    glen = np.zeros(M, dtype=np.int64)
+    totals = np.zeros(M, dtype=np.int64)
+    gclipL = np.zeros(M, dtype=np.int64)
+    gclipR = np.zeros(M, dtype=np.int64)
+    clipL0 = np.zeros(M, dtype=np.int64)
+    clipR0 = np.zeros(M, dtype=np.int64)
+    seqlens = np.zeros(M, dtype=np.int64)
+    cpos = np.asarray([cposes[i] for i in sel], dtype=np.int64)
+    rows = []
+    xrows = []
+    for k, i in enumerate(sel):
+        s = seqs[i]
+        g = s.gaps.astype(np.int64)
+        cl, cr = s.clip_lr()
+        clipL0[k], clipR0[k] = cl, cr
+        seqlens[k] = s.seqlen
+        glen0 = s.seqlen + s.numgaps
+        allocsize = glen0
+        gl, gr = cl, cr
+        if skip_dels:
+            right = g[s.seqlen - cr:] if cr else g[:0]
+            left = g[:cl]
+            allocsize += int((right < 0).sum()) + int((left < 0).sum())
+            gr += int(right[right >= 0].sum())
+            gl += int(left[left >= 0].sum())
+            in_clip = np.zeros(s.seqlen, dtype=bool)
+            if cl:
+                in_clip[:cl] = True
+            if cr:
+                in_clip[s.seqlen - cr:] = True
+            include = (g >= 0) | in_clip
+        else:
+            gr += int(g[s.seqlen - cr:].sum()) if cr else 0
+            gl += int(g[:cl].sum())
+            include = g >= 0
+        gclipL[k], gclipR[k] = gl, gr
+        glen[k] = glen0 + int((include & (g < 0)).sum())
+        if glen[k] != allocsize:
+            raise PwasmError(
+                f"Length mismatch (allocsize {allocsize} vs. glen "
+                f"{glen[k]}) while refineClipping for seq {s.name} !\n")
+        stars = np.maximum(g, 0)
+        counts = stars + include
+        ends = np.cumsum(counts)
+        total = int(ends[-1]) if s.seqlen else 0
+        totals[k] = total
+        gseq = np.full(total, star, dtype=np.uint8)
+        gxpos = np.full(total, -1, dtype=np.int64)
+        seq_arr = np.frombuffer(bytes(s.seq), dtype=np.uint8)
+        base_idx = (ends - 1)[include]
+        gseq[base_idx] = seq_arr[include]
+        gxpos[base_idx] = np.nonzero(include)[0]
+        rows.append(gseq)
+        xrows.append(gxpos)
+    L = max(1, int(totals.max()))
+    gseq2 = np.full((M, L), star, dtype=np.uint8)
+    gxpos2 = np.full((M, L), -1, dtype=np.int64)
+    for k in range(M):
+        gseq2[k, :totals[k]] = rows[k]
+        gxpos2[k, :totals[k]] = xrows[k]
+
+    clipL = clipL0.copy()
+    clipR = clipR0.copy()
+    aborted = np.zeros(M, dtype=bool)
+    ridx = np.arange(M)
+
+    cons2 = np.broadcast_to(cons_arr, (M, cons_len))
+    CH = 128   # chunk of walk steps per round: the seek usually hits and
+    #            the X-drop usually fires within a few steps, so chunked
+    #            scans with early exit do O(M x CH) work instead of
+    #            O(M x layout)
+
+    def take2(arr2, pos, valid, width):
+        out = np.zeros(pos.shape, dtype=arr2.dtype)
+        if width <= 0:          # degenerate: empty consensus/layout
+            return out
+        safe = np.clip(pos, 0, width - 1)
+        vals = np.take_along_axis(arr2, safe, axis=1)
+        out[valid] = vals[valid]
+        return out
+
+    def seek2(active, sp0, n_cand, direction):
+        """Batched initial-match seek, chunked with early exit.  Returns
+        (hit row mask, first-hit step k, bumps) where bumps counts
+        non-star candidates before the hit — or over ALL candidates for
+        rows with no hit (the scalar abort semantics)."""
+        found = np.zeros(M, dtype=bool)
+        k = np.zeros(M, dtype=np.int64)
+        bumps = np.zeros(M, dtype=np.int64)
+        Dmax = int(n_cand[active].max()) if active.any() else 0
+        for d0 in range(0, Dmax, CH):
+            todo = active & ~found & (d0 < n_cand)
+            if not todo.any():
+                break
+            d = d0 + np.arange(min(CH, Dmax - d0))[None, :]
+            sp = sp0[:, None] + direction * d
+            cmask = todo[:, None] & (d < n_cand[:, None])
+            valid_s = cmask & (sp >= 0) & (sp < totals[:, None])
+            gs = take2(gseq2, sp, valid_s, L)
+            cp = cpos[:, None] + sp
+            valid_c = cmask & (cp >= 0) & (cp < cons_len)
+            cs = take2(cons2, cp, valid_c, cons_len)
+            hit = valid_s & valid_c & (gs == cs) & (gs != star)
+            bump = valid_s & (gs != star)
+            hh = hit.any(axis=1)
+            kk = np.argmax(hit, axis=1)
+            bc = np.cumsum(bump, axis=1)
+            newly = todo & hh
+            k[newly] = d0 + kk[newly]
+            bumps[newly] += (bc[ridx, kk] - bump[ridx, kk])[newly]
+            not_yet = todo & ~hh
+            if bump.shape[1]:
+                bumps[not_yet] += bc[not_yet, -1]
+            found |= newly
+        return found & active, k, bumps
+
+    def extend2(active, sp_m, direction):
+        """Batched X-drop extension, chunked with early exit; returns
+        bestpos (== sp_m when no improvement)."""
+        cp_m = cpos + sp_m
+        if direction > 0:
+            K = np.minimum(glen - 1 - sp_m, cons_len - 1 - cp_m)
+        else:
+            K = np.minimum(sp_m, cp_m)
+        K = np.where(active, np.maximum(K, 0), 0)
+        Kmax = int(K.max()) if active.any() else 0
+        best = np.full(M, XDROP, dtype=np.int64)
+        bestk = np.zeros(M, dtype=np.int64)
+        carry = np.full(M, MATCH_SC, dtype=np.int64)
+        alive = active & (K > 0)
+        for k0 in range(0, Kmax, CH):
+            if not alive.any():
+                break
+            w = min(CH, Kmax - k0)
+            ks = k0 + 1 + np.arange(w)[None, :]
+            within = alive[:, None] & (ks <= K[:, None])
+            pos = sp_m[:, None] + direction * ks
+            gs = take2(gseq2, pos, within, L)
+            cp2 = cp_m[:, None] + direction * ks
+            cs = take2(cons2, cp2, within, cons_len)
+            nonstar = within & (gs != star)
+            eq = gs == cs
+            delta = np.where(nonstar,
+                             np.where(eq, MATCH_SC, MISMATCH_SC), 0)
+            scores = carry[:, None] + np.cumsum(delta, axis=1)
+            stop = within & (scores <= XDROP)
+            has_stop = stop.any(axis=1)
+            first_stop = np.where(has_stop, np.argmax(stop, axis=1), w)
+            in_limit = within & (np.arange(w)[None, :]
+                                 <= first_stop[:, None])
+            cand = np.where(eq & nonstar & in_limit, scores, XDROP)
+            cbest = cand.max(axis=1, initial=XDROP)
+            # strict >: an equal max from an earlier chunk keeps the
+            # scalar walk's first-occurrence tie-break
+            improve = alive & (cbest > best)
+            best = np.where(improve, cbest, best)
+            bestk = np.where(improve,
+                             k0 + 1 + np.argmax(cand, axis=1), bestk)
+            carry = scores[:, -1] if w else carry
+            alive = alive & ~has_stop & (K > k0 + w)
+        improved = active & (best > MATCH_SC)
+        return np.where(improved, sp_m + direction * bestk, sp_m)
+
+    # --- clipR phase ----------------------------------------------------
+    actR = clipR0 > 0
+    if actR.any():
+        sp0 = glen - gclipR - 1
+        n_cand = np.where(sp0 >= gclipL, sp0 - gclipL + 1, 1)
+        has_hit, k, bumps = seek2(actR, sp0, n_cand, -1)
+        miss = actR & ~has_hit
+        for km in np.nonzero(miss)[0]:
+            print(f"Warning: reached clipL trying to find an initial "
+                  f"match on {seqs[sel[km]].name}!", file=sys.stderr)
+        clipR = np.where(actR, clipR + bumps, clipR)
+        aborted |= miss
+        hitm = actR & has_hit
+        sp_m = sp0 - k
+        bestpos = extend2(hitm, sp_m, +1)
+        upd = hitm & (bestpos > sp_m)
+        newR = seqlens - take2(gxpos2, bestpos[:, None],
+                               upd[:, None], L)[:, 0] - 1
+        clipR = np.where(upd, newR, clipR)
+
+    # --- clipL phase ----------------------------------------------------
+    actL = (clipL0 > 0) & ~aborted
+    if actL.any():
+        sp0 = gclipL
+        hi = glen - gclipR - 1
+        n_cand = np.where(hi >= sp0, hi - sp0 + 1, 1)
+        has_hit, k, bumps = seek2(actL, sp0, n_cand, +1)
+        miss = actL & ~has_hit
+        for km in np.nonzero(miss)[0]:
+            print(f"Warning: reached clipR trying to find an initial "
+                  f"match on {seqs[sel[km]].name}!", file=sys.stderr)
+        clipL = np.where(actL, clipL + bumps, clipL)
+        hitm = actL & has_hit
+        sp_m = sp0 + k
+        bestpos = extend2(hitm, sp_m, -1)
+        upd = hitm & (bestpos < sp_m)
+        newL = take2(gxpos2, bestpos[:, None], upd[:, None], L)[:, 0]
+        clipL = np.where(upd, newL, clipL)
+
+    # --- write back (strand-aware aliasing, GapAssem.cpp:188-189) -------
+    for k, i in enumerate(sel):
+        s = seqs[i]
+        if s.revcompl:
+            s.clp3, s.clp5 = int(clipL[k]), int(clipR[k])
+        else:
+            s.clp5, s.clp3 = int(clipL[k]), int(clipR[k])

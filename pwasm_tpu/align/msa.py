@@ -536,14 +536,25 @@ class Msa:
                 c = ord("*")
             consensus.append(c)
         self.consensus = consensus
+        # X-drop clipping refinement: one 2-D pass over all members
+        # (refineMSA's member loop, GapAssem.cpp:1169-1180; members are
+        # independent given the fixed consensus, so batching is exact)
+        from pwasm_tpu.align.gapseq import refine_clipping_batch
+
+        def _cpos(s):
+            return s.offset - self.minoffset - cols.mincol
+
+        if refine_clipping:
+            refine_clipping_batch(self.seqs, bytes(self.consensus),
+                                  [_cpos(s) for s in self.seqs])
+        second: list = []
         for s in self.seqs:
-            if refine_clipping:
-                s.refine_clipping(bytes(self.consensus),
-                                  s.offset - self.minoffset - cols.mincol)
             grem = s.remove_clip_gaps() if remove_cons_gaps else 0
             if grem != 0 and refine_clipping:
-                s.refine_clipping(bytes(self.consensus),
-                                  s.offset - self.minoffset - cols.mincol,
+                second.append(s)
+        if second:
+            refine_clipping_batch(second, bytes(self.consensus),
+                                  [_cpos(s) for s in second],
                                   skip_dels=True)
         self.refined = True
 

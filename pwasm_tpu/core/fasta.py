@@ -43,6 +43,9 @@ class FastaFile:
         self.path = os.fspath(path)
         self._index: dict[str, _FaiEntry] = {}
         self._order: list[str] = []
+        # per-record (linebases, linewidth, uniform) from the native
+        # scan, so _write_fai needs no second pass over the file
+        self._geom: dict[str, tuple[int, int, int]] = {}
         if not self._load_fai():
             self._full_scan()
             self._write_fai()
@@ -87,7 +90,9 @@ class FastaFile:
             with open(self.path, "rb") as f:
                 if f.read(1) != b">":
                     return False
-                for _n, _l, offset, end, term in rows:
+                prev_end = 0
+                for name, _l, offset, end, term in sorted(
+                        rows, key=lambda r: r[2]):
                     f.seek(offset - 1)
                     if f.read(1) != b"\n":
                         return False
@@ -96,6 +101,19 @@ class FastaFile:
                     if nxt != b">" and not (
                             nxt == b"" and end in (fsize, fsize + term)):
                         return False
+                    # the header between the previous window and this
+                    # record must still carry this record's name (a
+                    # same-geometry swap with renamed records would
+                    # otherwise serve stale attributions)
+                    f.seek(prev_end)
+                    header = f.read(min(offset - prev_end, 1 << 16))
+                    if not header.startswith(b">"):
+                        return False
+                    tok = header[1:].split(None, 1)
+                    got = tok[0].split(b"\n")[0] if tok else b""
+                    if got.decode("utf-8", "replace") != name:
+                        return False
+                    prev_end = end
             for name, length, offset, end, _t in rows:
                 self._add(name, length, offset, end)
         except (OSError, ValueError):
@@ -106,8 +124,13 @@ class FastaFile:
 
     def _write_fai(self) -> None:
         """Persist the index when every record is uniformly wrapped (the
-        only shape the 5-column format can describe); best-effort — a
-        read-only directory just skips persistence."""
+        only shape the 5-column format can describe — foreign faidx
+        readers like samtools/pysam derive in-record offsets from the
+        line geometry, so a coincidental total-window match is not
+        enough); best-effort — a read-only directory just skips
+        persistence.  Geometry comes from the native scan when it ran
+        (``self._geom``, no extra IO); the Python-scan fallback verifies
+        line-by-line, one extra sequential pass."""
         rows = []
         try:
             fsize = os.path.getsize(self.path)
@@ -116,36 +139,48 @@ class FastaFile:
                     ent = self._index[name]
                     if "\t" in name or "\n" in name:
                         return
-                    f.seek(ent.offset)
-                    first = f.readline()
-                    lb = len(first.rstrip(b"\r\n"))
-                    lw = len(first)
-                    if lb < 1 or lw <= lb:
-                        return
-                    # verify EVERY line: foreign faidx readers
-                    # (samtools/pysam) derive in-record offsets from the
-                    # line geometry, so a coincidental total-window match
-                    # is not enough — each full line must carry exactly
-                    # lb bases and the same terminator, no interior
-                    # whitespace; the final line may be short, and may
-                    # lack its terminator only at EOF
-                    f.seek(ent.offset)
-                    left = ent.length
-                    pos = ent.offset
-                    while left > 0:
-                        line = f.readline()
-                        pos += len(line)
-                        body = line.rstrip(b"\r\n")
-                        if body.translate(
-                                None, b" \t\v\f\r\n") != body:
+                    geom = self._geom.get(name)
+                    if geom is not None:
+                        lb, lw, uniform = geom
+                        if not uniform or lb < 1 or lw <= lb:
                             return
-                        if len(body) != min(lb, left):
+                    else:
+                        # no native geometry: verify EVERY line — each
+                        # full line exactly lb bases + the same
+                        # terminator, no interior whitespace; the final
+                        # line may be short, and may lack its
+                        # terminator only at EOF
+                        f.seek(ent.offset)
+                        first = f.readline()
+                        lb = len(first.rstrip(b"\r\n"))
+                        lw = len(first)
+                        if lb < 1 or lw <= lb:
                             return
-                        if len(line) - len(body) != lw - lb and not (
-                                len(body) == left and pos == fsize):
+                        f.seek(ent.offset)
+                        left = ent.length
+                        pos = ent.offset
+                        while left > 0:
+                            line = f.readline()
+                            pos += len(line)
+                            body = line.rstrip(b"\r\n")
+                            if body.translate(
+                                    None, b" \t\v\f\r\n") != body:
+                                return
+                            if len(body) != min(lb, left):
+                                return
+                            if len(line) - len(body) != lw - lb and not (
+                                    len(body) == left and pos == fsize):
+                                return
+                            left -= len(body)
+                        if pos != ent.end:
                             return
-                        left -= len(body)
-                    if pos != ent.end:
+                    # belt: the derived window must reproduce the scan
+                    nlines = (ent.length + lb - 1) // lb
+                    span = ent.length + nlines * (lw - lb)
+                    window = ent.end - ent.offset
+                    if window != span and not (
+                            window == span - (lw - lb)
+                            and ent.end == fsize):
                         return
                     rows.append(f"{name}\t{ent.length}\t{ent.offset}"
                                 f"\t{lb}\t{lw}\n")
@@ -171,7 +206,9 @@ class FastaFile:
         except OSError:
             entries = None  # fall through to the Python reader's error
         if entries is not None:
-            for name, seqlen, start, end in entries:
+            for name, seqlen, start, end, lb, lw, uniform in entries:
+                if name not in self._index:
+                    self._geom[name] = (lb, lw, uniform)
                 self._add(name, seqlen, start, end)
             if not self._index:
                 raise PwasmError(f"Error: invalid FASTA file {self.path} !")

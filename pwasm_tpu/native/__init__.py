@@ -279,12 +279,16 @@ def consensus_vote_counts(counts: np.ndarray,
     return out
 
 
-def fasta_index(path: str) -> list[tuple[str, int, int, int]] | None:
+def fasta_index(path: str
+                ) -> list[tuple[str, int, int, int, int, int, int]] | None:
     """Native streaming FASTA index build: one pass over the file.
 
-    Returns [(name, seqlen, seq_start, end), ...] in file order
-    (duplicates NOT removed — the caller keeps the first, matching the
-    Python indexer), or None when the native library is unavailable.
+    Returns [(name, seqlen, seq_start, end, linebases, linewidth,
+    uniform), ...] in file order (duplicates NOT removed — the caller
+    keeps the first, matching the Python indexer); the last three fields
+    describe the record's line geometry for .fai persistence (uniform=1
+    iff every line is reproducible from linebases/linewidth — see
+    pw_fasta_index).  None when the native library is unavailable.
     Raises OSError if the file can't be opened.
     """
     lib = get_lib()
@@ -292,7 +296,7 @@ def fasta_index(path: str) -> list[tuple[str, int, int, int]] | None:
         return None
     ent_cap, arena_cap = 1024, 1 << 16
     for _ in range(8):
-        entries = np.empty(ent_cap * 5, dtype=np.int64)
+        entries = np.empty(ent_cap * 8, dtype=np.int64)
         arena = np.empty(arena_cap, dtype=np.uint8)
         n = lib.pw_fasta_index(
             os.fsencode(path), entries.ctypes.data_as(ctypes.c_void_p),
@@ -307,9 +311,10 @@ def fasta_index(path: str) -> list[tuple[str, int, int, int]] | None:
         ab = arena.tobytes()
         out = []
         for k in range(int(n)):
-            noff, nlen, seqlen, start, end = (
-                int(x) for x in entries[k * 5:(k + 1) * 5])
-            out.append((ab[noff:noff + nlen].decode(), seqlen, start, end))
+            noff, nlen, seqlen, start, end, lb, lw, uni = (
+                int(x) for x in entries[k * 8:(k + 1) * 8])
+            out.append((ab[noff:noff + nlen].decode(), seqlen, start,
+                        end, lb, lw, uni))
         return out
     raise OSError(f"FASTA index buffers exhausted for {path}")
 

@@ -447,11 +447,19 @@ void pw_consensus_vote_counts(const int32_t* counts, const int32_t* layers,
 
 // Streaming index build: one pass over the file, recording for every
 // record its id, sequence length (whitespace excluded — exactly the bytes
-// a fetch returns), first-sequence-byte offset and one-past-end offset.
+// a fetch returns), first-sequence-byte offset and one-past-end offset,
+// plus the per-record line geometry so the caller can persist a
+// samtools-compatible .fai without re-reading the file: linebases /
+// linewidth of the first line and a uniformity flag that is 1 only when
+// EVERY line of the record is describable by that geometry (all full
+// lines exactly linebases bases + the same terminator, no interior
+// whitespace, no blank lines, at most one final short line whose
+// terminator may be missing only at end of record).
 // Duplicate ids keep the FIRST record (dict-insert semantics of the
 // Python FastaFile; dedup is done by the Python wrapper which sees
-// names).  Entry layout: 5 int64 per record
-//   [name_off, name_len, seqlen, seq_start, end]
+// names).  Entry layout: 8 int64 per record
+//   [name_off, name_len, seqlen, seq_start, end, linebases, linewidth,
+//    uniform]
 // with names concatenated into name_arena.  Returns the record count,
 // -1 on open failure, or -(2 + needed_records) when ent_cap/arena_cap is
 // too small (caller grows and retries).
@@ -464,21 +472,55 @@ int64_t pw_fasta_index(const char* path, int64_t* entries, int64_t ent_cap,
   int64_t seqlen = 0, seq_start = 0;
   bool have_rec = false, overflow = false;
   bool at_line_start = true, in_header = false, header_name_done = false;
+  // line-geometry state for the current record
+  int64_t lb = -1, lw = -1;        // first line's bases / total bytes
+  int64_t cur_bases = 0, pend_ws = 0;
+  bool uniform = true, short_seen = false, line_open = false;
   std::string name;
+  auto close_line = [&](bool has_newline) {
+    // a line ends: check it against the record's first-line geometry
+    int64_t bytes = cur_bases + pend_ws + (has_newline ? 1 : 0);
+    if (short_seen) uniform = false;  // a short line was not the last
+    if (cur_bases == 0) {
+      uniform = false;                // blank line inside the window
+    } else if (lb < 0) {
+      lb = cur_bases;
+      lw = bytes;
+      if (!has_newline) uniform = false;  // single unterminated line:
+      // lw would include no terminator, underiving the window
+      if (lw <= lb) uniform = false;
+    } else if (cur_bases == lb && bytes == lw && has_newline) {
+      // a regular full line
+    } else if (!has_newline && bytes == cur_bases && cur_bases <= lb) {
+      short_seen = true;   // unterminated final line at end of record
+    } else if (cur_bases < lb && bytes - cur_bases == lw - lb) {
+      short_seen = true;   // terminated short line: final only
+    } else {
+      uniform = false;
+    }
+    cur_bases = 0;
+    pend_ws = 0;
+    line_open = false;
+  };
   auto flush_rec = [&](int64_t end_pos) {
     if (!have_rec) return;
     if (in_header) {  // header line hit EOF with no newline: empty seq
       seq_start = end_pos;
       seqlen = 0;
     }
+    if (line_open) close_line(false);
+    if (lb < 1 || lw <= lb || seqlen == 0) uniform = false;
     if (nrec < ent_cap &&
         arena_used + (int64_t)name.size() <= arena_cap) {
-      int64_t* e = entries + nrec * 5;
+      int64_t* e = entries + nrec * 8;
       e[0] = arena_used;
       e[1] = (int64_t)name.size();
       e[2] = seqlen;
       e[3] = seq_start;
       e[4] = end_pos;
+      e[5] = lb;
+      e[6] = lw;
+      e[7] = uniform ? 1 : 0;
       memcpy(name_arena + arena_used, name.data(), name.size());
       arena_used += (int64_t)name.size();
     } else {
@@ -495,6 +537,11 @@ int64_t pw_fasta_index(const char* path, int64_t* entries, int64_t ent_cap,
         have_rec = true;
         name.clear();
         seqlen = 0;
+        lb = lw = -1;
+        cur_bases = pend_ws = 0;
+        uniform = true;
+        short_seen = false;
+        line_open = false;
         in_header = true;
         header_name_done = false;
         at_line_start = false;
@@ -515,7 +562,19 @@ int64_t pw_fasta_index(const char* path, int64_t* entries, int64_t ent_cap,
         }
       } else {
         at_line_start = (c == '\n');
-        if (have_rec && !isspace((unsigned char)c)) ++seqlen;
+        if (have_rec) {
+          if (c == '\n') {
+            close_line(true);
+          } else if (isspace((unsigned char)c)) {
+            line_open = true;
+            ++pend_ws;
+          } else {
+            if (pend_ws > 0) uniform = false;  // interior whitespace
+            line_open = true;
+            ++cur_bases;
+            ++seqlen;
+          }
+        }
       }
       ++pos;
     }

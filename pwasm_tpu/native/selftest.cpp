@@ -95,12 +95,18 @@ static void test_fasta() {
   FILE* f = fdopen(fd, "w");
   fputs(">one desc\nACGT\nAC\n>two\r\nGG\r\n", f);
   fclose(f);
-  int64_t entries[2 * 5];
+  int64_t entries[2 * 8];
   uint8_t arena[64];
   int64_t n = pw_fasta_index(path, entries, 2, arena, 64);
   assert(n == 2);
   assert(entries[1] == 3 && memcmp(arena, "one", 3) == 0);
   assert(entries[2] == 6);  // seqlen of record one
+  // line geometry: record one wraps 4 then 2 bases at width 5 — a
+  // short non-final... no: 'AC' IS final, so uniform with lb=4, lw=5
+  assert(entries[5] == 4 && entries[6] == 5 && entries[7] == 1);
+  // record two: one CRLF line, GG: lb=2, lw=4, uniform
+  assert(entries[8 + 5] == 2 && entries[8 + 6] == 4
+         && entries[8 + 7] == 1);
   uint8_t buf[32];
   int64_t got = pw_fasta_fetch(path, entries[3], entries[4], buf);
   assert(got == 6 && memcmp(buf, "ACGTAC", 6) == 0);

@@ -143,3 +143,23 @@ def test_fai_mtime_preserving_swap_detected(tmp_path):
     assert fa2.names == ["x", "y", "z"]
     assert fa2.fetch("y") == b"CC" * 50
     assert fa1.names == ["a", "b"]
+
+
+def test_fai_renamed_swap_detected(tmp_path):
+    """A same-geometry mtime-preserving swap that only RENAMES records
+    must not serve stale names (code-review r3: header-name probe)."""
+    import os
+
+    p = tmp_path / "ren.fa"
+    write_fasta(str(p), [("aa", b"ACGT" * 15), ("bb", b"TT" * 30)])
+    FastaFile(p)
+    times = (os.path.getatime(p), os.path.getmtime(p))
+    fai_t = (os.path.getatime(str(p) + ".fai"),
+             os.path.getmtime(str(p) + ".fai"))
+    write_fasta(str(p), [("xx", b"ACGT" * 15), ("yy", b"TT" * 30)])
+    os.utime(p, times)
+    os.utime(str(p) + ".fai", fai_t)
+    fa = FastaFile(p)
+    assert fa.names == ["xx", "yy"]
+    assert fa.fetch("yy") == b"TT" * 30
+    assert fa.fetch("aa") is None

@@ -130,3 +130,71 @@ def test_refine_clipping_256_member_timing():
     dt = time.perf_counter() - t0
     # generous CI bound; the scalar walk takes ~10x longer
     assert dt < 2.0, f"vectorized refine too slow: {dt:.2f}s"
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("skip_dels", [False, True])
+def test_refine_clipping_batch_matches_single(seed, skip_dels):
+    """The one-pass 2-D batch (refine_clipping_batch) must leave every
+    member with exactly the clips the per-member pass produces —
+    including no-hit abort bumps and zero-clip skips (VERDICT r2
+    next #10)."""
+    from pwasm_tpu.align.gapseq import refine_clipping_batch
+
+    rng = np.random.default_rng(100 + seed)
+    seqs, clones, cposes = [], [], []
+    for k in range(24):
+        s = _random_gapseq(rng, with_dels=skip_dels)
+        if k % 5 == 0:
+            s.clp5 = s.clp3 = 0      # the skip path
+        seqs.append(s)
+        clones.append(_clone(s))
+        cposes.append(int(rng.integers(0, 5)))
+    glen_max = max(s.seqlen + s.numgaps for s in seqs)
+    cons = bytes(rng.choice(list(b"ACGT*"), glen_max + 8))
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        refine_clipping_batch(seqs, cons, cposes, skip_dels=skip_dels)
+    err2 = io.StringIO()
+    with contextlib.redirect_stderr(err2):
+        for c, cp in zip(clones, cposes):
+            c.refine_clipping(cons, cp, skip_dels=skip_dels)
+    for s, c in zip(seqs, clones):
+        assert (s.clp5, s.clp3) == (c.clp5, c.clp3), s.name
+    # same number of no-hit warnings (order may differ)
+    assert (err.getvalue().count("Warning")
+            == err2.getvalue().count("Warning"))
+
+
+def test_refine_clipping_batch_256_member_speedup():
+    """One 2-D pass over a 256-member ~1.5 kb pileup must beat the
+    member-by-member loop (measured; VERDICT r2 next #10)."""
+    from pwasm_tpu.align.gapseq import refine_clipping_batch
+
+    rng = np.random.default_rng(7)
+    m = 1500
+    base = rng.choice(list(b"ACGT"), m).astype(np.uint8)
+    seqs, clones = [], []
+    for k in range(256):
+        arr = base.copy()
+        idx = rng.integers(0, m, 40)
+        arr[idx] = rng.choice(list(b"ACGT"), 40)
+        s = GapSeq(f"r{k}", "", bytes(arr))
+        s.clp5 = int(rng.integers(1, 30))
+        s.clp3 = int(rng.integers(1, 30))
+        for _ in range(4):
+            s.set_gap(int(rng.integers(0, m)), 1)
+        seqs.append(s)
+        clones.append(_clone(s))
+    cons = bytes(base)
+    t0 = time.perf_counter()
+    refine_clipping_batch(seqs, cons, [0] * 256)
+    dt_batch = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for c in clones:
+        c.refine_clipping(cons, 0)
+    dt_loop = time.perf_counter() - t0
+    for s, c in zip(seqs, clones):
+        assert (s.clp5, s.clp3) == (c.clp5, c.clp3)
+    # the batch must at least keep pace; typically it is ~2-4x faster
+    assert dt_batch < dt_loop, (dt_batch, dt_loop)
