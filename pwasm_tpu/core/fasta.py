@@ -110,7 +110,7 @@ class FastaFile:
                     if not header.startswith(b">"):
                         return False
                     tok = header[1:].split(None, 1)
-                    got = tok[0].split(b"\n")[0] if tok else b""
+                    got = tok[0] if tok else b""
                     if got.decode("utf-8", "replace") != name:
                         return False
                     prev_end = end

@@ -97,6 +97,12 @@ def get_lib():
         lib.pw_unpack_2bit.restype = None
         lib.pw_unpack_2bit.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+        lib.pw_gotoh_traceback.restype = ctypes.c_int64
+        lib.pw_gotoh_traceback.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p,
+            ctypes.c_void_p]
         _lib = lib
     return _lib
 
@@ -317,6 +323,32 @@ def fasta_index(path: str
                         end, lb, lw, uni))
         return out
     raise OSError(f"FASTA index buffers exhausted for {path}")
+
+
+def gotoh_traceback(q: np.ndarray, t: np.ndarray, match: int,
+                    mismatch: int, gap_open: int, gap_extend: int
+                    ) -> tuple[int, np.ndarray] | None:
+    """Native full-matrix Gotoh with traceback — the single-core form of
+    the re-aligner's host oracle (ops/realign.py full_gotoh_traceback;
+    tie-breaks identical, parity fuzzed in tests/test_native.py).
+    Returns (score, forward int8 op array) or None when the native
+    library is unavailable or allocation fails."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    qc = np.ascontiguousarray(q, dtype=np.int8)
+    tc = np.ascontiguousarray(t, dtype=np.int8)
+    m, n = len(qc), len(tc)
+    ops = np.empty(m + n, dtype=np.int8)
+    score = ctypes.c_int64(0)
+    k = lib.pw_gotoh_traceback(
+        qc.ctypes.data_as(ctypes.c_void_p), m,
+        tc.ctypes.data_as(ctypes.c_void_p), n,
+        match, mismatch, gap_open, gap_extend,
+        ops.ctypes.data_as(ctypes.c_void_p), ctypes.byref(score))
+    if k < 0:
+        return None
+    return int(score.value), ops[:k].copy()
 
 
 def fasta_fetch(path: str, seq_start: int, end: int) -> bytes | None:

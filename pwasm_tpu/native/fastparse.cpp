@@ -646,4 +646,85 @@ void pw_unpack_2bit(const uint8_t* packed, int64_t n, int8_t* out) {
     out[i] = (int8_t)((packed[i / 4] >> (2 * (i % 4))) & 3);
 }
 
+
+// Full-matrix Gotoh global alignment WITH traceback — the native form
+// of the host oracle in ops/realign.py (full_gotoh_traceback), for the
+// re-aligner's beyond-the-band fallback.  Tie-breaks are identical by
+// construction: the diagonal argmax prefers M, then Ix, then Iy; the
+// gap recurrences prefer open on ties (strict > for the extend bit).
+// No Ix<->Iy adjacency (standard Gotoh).  Writes forward-order op codes
+// (1=diag, 2=Ix consumes query, 3=Iy consumes target) into ops_out
+// (capacity m+n) and the final score into *score_out; returns the op
+// count, or -1 on allocation failure.  Work/memory: O(m*n) time, one
+// uint8 pointer byte per cell (dm 2 bits | bx<<2 | by<<3), three
+// rolling int64 rows.
+int64_t pw_gotoh_traceback(const int8_t* q, int64_t m, const int8_t* t,
+                           int64_t n, int32_t match, int32_t mismatch,
+                           int32_t gap_open, int32_t gap_extend,
+                           int8_t* ops_out, int64_t* score_out) {
+  const int64_t NEG = -((int64_t)1 << 40);
+  const int64_t ge = gap_extend, go = (int64_t)gap_open + gap_extend;
+  std::vector<int64_t> Mp, Ip, Yp, Mc, Ic, Yc;
+  std::vector<uint8_t> ptr;
+  try {
+    Mp.assign(n + 1, NEG); Ip.assign(n + 1, NEG); Yp.assign(n + 1, NEG);
+    Mc.assign(n + 1, NEG); Ic.assign(n + 1, NEG); Yc.assign(n + 1, NEG);
+    ptr.assign((size_t)(m + 1) * (size_t)(n + 1), 0);
+  } catch (...) {
+    return -1;
+  }
+  Mp[0] = 0;
+  for (int64_t j = 1; j <= n; ++j) {
+    Yp[j] = -(go + (j - 1) * ge);
+    if (j > 1) ptr[j] |= 8;  // BY row 0
+  }
+  for (int64_t i = 1; i <= m; ++i) {
+    uint8_t* prow = ptr.data() + (size_t)i * (size_t)(n + 1);
+    Mc[0] = NEG; Yc[0] = NEG;
+    Ic[0] = -(go + (i - 1) * ge);
+    if (i > 1) prow[0] |= 4;  // BX col 0
+    for (int64_t j = 1; j <= n; ++j) {
+      int64_t s = (q[i - 1] == t[j - 1] && q[i - 1] < 4) ? match
+                                                         : -mismatch;
+      int64_t a = Mp[j - 1], b = Ip[j - 1], c = Yp[j - 1];
+      uint8_t dm;
+      int64_t diag;
+      if (a >= b && a >= c) { dm = 0; diag = a; }
+      else if (b >= c)      { dm = 1; diag = b; }
+      else                  { dm = 2; diag = c; }
+      Mc[j] = diag + s;
+      int64_t op_sc = Mp[j] - go, ext_sc = Ip[j] - ge;
+      uint8_t bx = ext_sc > op_sc ? 4 : 0;
+      Ic[j] = ext_sc > op_sc ? ext_sc : op_sc;
+      int64_t op2 = Mc[j - 1] - go, ext2 = Yc[j - 1] - ge;
+      uint8_t by = ext2 > op2 ? 8 : 0;
+      Yc[j] = ext2 > op2 ? ext2 : op2;
+      prow[j] = (uint8_t)(dm | bx | by);
+    }
+    std::swap(Mp, Mc); std::swap(Ip, Ic); std::swap(Yp, Yc);
+  }
+  int64_t mv = Mp[n], xv = Ip[n], yv = Yp[n];
+  int mat;
+  if (mv >= xv && mv >= yv) mat = 0;
+  else if (xv >= yv)        mat = 1;
+  else                      mat = 2;
+  int64_t best = mv > xv ? mv : xv;
+  if (yv > best) best = yv;
+  *score_out = best;
+  // backward walk, then reverse into forward order
+  int64_t i = m, j = n, k = 0;
+  while (i > 0 || j > 0) {
+    if (i == 0)      { ops_out[k++] = 3; --j; continue; }
+    if (j == 0)      { ops_out[k++] = 2; --i; continue; }
+    uint8_t p = ptr[(size_t)i * (size_t)(n + 1) + j];
+    if (mat == 0)      { ops_out[k++] = 1; mat = p & 3; --i; --j; }
+    else if (mat == 1) { ops_out[k++] = 2; mat = (p & 4) ? 1 : 0; --i; }
+    else               { ops_out[k++] = 3; mat = (p & 8) ? 2 : 0; --j; }
+  }
+  for (int64_t a2 = 0, b2 = k - 1; a2 < b2; ++a2, --b2) {
+    int8_t tmp = ops_out[a2]; ops_out[a2] = ops_out[b2]; ops_out[b2] = tmp;
+  }
+  return k;
+}
+
 }  // extern "C"

@@ -204,8 +204,16 @@ def banded_realign_rows(qs: jax.Array, ts: jax.Array,
         dlo = -(band // 2)
     if kernel is None:
         from pwasm_tpu.ops import on_tpu_backend
-        kernel = "pallas" if (band % 8 == 0 and on_tpu_backend()) \
-            else "xla"
+        # the fused kernels keep the target window, query column, carry
+        # and pointer tiles resident per 128-lane block, double-buffered
+        # — about (n + m + 8*band) * 1024 bytes; beyond ~10 MB Mosaic's
+        # 16 MB scoped-vmem allocator rejects the kernel (seen at
+        # band=1024 on the escalation path), so big shapes take the XLA
+        # scan instead
+        fits = (ts.shape[1] + qs.shape[1] + 8 * band + 160) * 1024 \
+            <= 10 << 20
+        kernel = "pallas" if (band % 8 == 0 and fits
+                              and on_tpu_backend()) else "xla"
     if kernel == "pallas":
         return _rowwalk_batch_pallas(jnp.asarray(qs), jnp.asarray(ts),
                                      jnp.asarray(q_lens),
@@ -768,9 +776,11 @@ def _pick_dlo(d_ends: np.ndarray, band: int) -> int:
     return -(band // 2)
 
 
-# a full-matrix host traceback beyond this many cells would burn minutes
-# of Python time / gigabytes of int64 — escalate the device band instead
+# a full-matrix PYTHON traceback beyond this many cells would burn
+# minutes of interpreter time — the native oracle below takes over far
+# beyond it (bounded by its one pointer byte per cell)
 _ORACLE_CELL_LIMIT = 4_000_000
+_NATIVE_ORACLE_CELL_LIMIT = 256_000_000   # ~256 MB of pointer bytes
 _MAX_BAND = 4096
 # ceiling on the device pointer tensor (T_chunk x m_max x band uint8)
 # per dispatch; lanes are chunked to stay under it, and a single lane
@@ -860,8 +870,18 @@ def _realign_group(enc, idxs: list[int], m_max: int, n: int, band: int,
         todo = np.array(still, dtype=np.int64)
         cur_band = max(cur_band * 4, 4)
     for k in todo:
-        # beyond the band ceiling: bounded host oracle or give up
-        if int(q_lens[k]) * int(t_lens[k]) <= _ORACLE_CELL_LIMIT:
-            out[idxs[k]] = full_gotoh_traceback(qs[k, :q_lens[k]],
-                                                ts[k, :t_lens[k]],
-                                                params)
+        # beyond the band ceiling: bounded host oracle or give up — the
+        # native single-core Gotoh (same tie-breaks) reaches ~64x more
+        # cells than the Python oracle before the give-up window opens
+        cells = int(q_lens[k]) * int(t_lens[k])
+        res = None
+        if cells <= _NATIVE_ORACLE_CELL_LIMIT:
+            from pwasm_tpu.native import gotoh_traceback
+            res = gotoh_traceback(qs[k, :q_lens[k]], ts[k, :t_lens[k]],
+                                  params.match, params.mismatch,
+                                  params.gap_open, params.gap_extend)
+        if res is None and cells <= _ORACLE_CELL_LIMIT:
+            res = full_gotoh_traceback(qs[k, :q_lens[k]],
+                                       ts[k, :t_lens[k]], params)
+        if res is not None:
+            out[idxs[k]] = res

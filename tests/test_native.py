@@ -312,3 +312,45 @@ def test_native_sanitizer_selftest():
                          capture_output=True, text=True, timeout=300)
     assert res.returncode == 0, res.stderr[-2000:]
     assert "native selftest OK" in res.stdout
+
+
+def test_native_gotoh_traceback_matches_python_oracle():
+    """pw_gotoh_traceback must reproduce full_gotoh_traceback exactly:
+    score AND op string (identical tie-breaks by construction)."""
+    from pwasm_tpu.native import gotoh_traceback, native_available
+    from pwasm_tpu.ops.banded_dp import ScoreParams
+    from pwasm_tpu.ops.realign import full_gotoh_traceback
+
+    if not native_available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(42)
+    params = ScoreParams()
+    for _ in range(40):
+        m = int(rng.integers(5, 120))
+        q = rng.integers(0, 4, m).astype(np.int8)
+        t = list(q)
+        for _ in range(int(rng.integers(0, 10))):
+            p = int(rng.integers(0, max(1, len(t) - 1)))
+            r = rng.random()
+            if r < 0.4:
+                t[p] = int(rng.integers(0, 4))
+            elif r < 0.7:
+                t.insert(p, int(rng.integers(0, 4)))
+            elif len(t) > 2:
+                del t[p]
+        t = np.array(t, dtype=np.int8)
+        want_score, want_ops = full_gotoh_traceback(q, t, params)
+        got = gotoh_traceback(q, t, params.match, params.mismatch,
+                              params.gap_open, params.gap_extend)
+        assert got is not None
+        score, ops = got
+        assert score == want_score
+        np.testing.assert_array_equal(ops, want_ops)
+    # degenerate shapes
+    for q, t in ((np.zeros(0, np.int8), np.array([1, 2], np.int8)),
+                 (np.array([1], np.int8), np.zeros(0, np.int8))):
+        want = full_gotoh_traceback(q, t, params)
+        got = gotoh_traceback(q, t, params.match, params.mismatch,
+                              params.gap_open, params.gap_extend)
+        assert got[0] == want[0]
+        np.testing.assert_array_equal(got[1], want[1])
