@@ -491,7 +491,7 @@ def banded_scores_long(q: jax.Array, ts: jax.Array, t_lens: jax.Array,
         in_specs=[
             pl.BlockSpec((1, m), lambda i: (0, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec((1, block_t), lambda i: (0, i)),
         ],
         out_specs=pl.BlockSpec((1, block_t), lambda i: (0, i)),

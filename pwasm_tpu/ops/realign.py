@@ -194,9 +194,13 @@ def banded_realign_rows(qs: jax.Array, ts: jax.Array,
     Lanes with ``ok=False`` need a wider band (see ``realign_pairs``
     escalation) or the host oracle.
 
-    ``kernel``: 'pallas' (fused TPU kernels; band must be a multiple
-    of 8), 'xla' (lax.scan path, any band, traced dlo), or None = pallas
-    on a TPU backend, xla elsewhere.  Outputs are bit-identical.
+    ``kernel``: 'pallas' (fused TPU kernels, sequences resident in
+    VMEM; band must be a multiple of 8), 'pallas_long' (same kernels
+    with the sequences streamed from HBM in double-buffered windows —
+    long-read shapes), 'xla' (lax.scan path, any band, traced dlo), or
+    None = auto: resident pallas when the footprint fits VMEM, the
+    streaming variant for bigger shapes on TPU, xla elsewhere.  Outputs
+    are bit-identical across all three.
     """
     if band < 1:
         raise ValueError(f"band must be >= 1, got {band}")
@@ -204,21 +208,27 @@ def banded_realign_rows(qs: jax.Array, ts: jax.Array,
         dlo = -(band // 2)
     if kernel is None:
         from pwasm_tpu.ops import on_tpu_backend
-        # the fused kernels keep the target window, query column, carry
-        # and pointer tiles resident per 128-lane block, double-buffered
-        # — about (n + m + 8*band) * 1024 bytes; beyond ~10 MB Mosaic's
-        # 16 MB scoped-vmem allocator rejects the kernel (seen at
-        # band=1024 on the escalation path), so big shapes take the XLA
-        # scan instead
-        fits = (ts.shape[1] + qs.shape[1] + 8 * band + 160) * 1024 \
-            <= 10 << 20
-        kernel = "pallas" if (band % 8 == 0 and fits
-                              and on_tpu_backend()) else "xla"
-    if kernel == "pallas":
+        if band % 8 or not on_tpu_backend():
+            kernel = "xla"
+        # resident: target window + query column + carry + pointer tiles
+        # per 128-lane block, double-buffered — about
+        # (n + m + 8*band) * 1024 bytes against Mosaic's 16 MB scoped
+        # vmem (band=1024 escalations were seen rejected at ~18 MB)
+        elif (ts.shape[1] + qs.shape[1] + 8 * band + 160) * 1024 \
+                <= 10 << 20:
+            kernel = "pallas"
+        # streaming: only the (band+8)-row windows and carries are
+        # resident — bounded by band alone
+        elif (10 * band + 200) * 1024 <= 10 << 20:
+            kernel = "pallas_long"
+        else:
+            kernel = "xla"
+    if kernel in ("pallas", "pallas_long"):
         return _rowwalk_batch_pallas(jnp.asarray(qs), jnp.asarray(ts),
                                      jnp.asarray(q_lens),
                                      jnp.asarray(t_lens),
-                                     int(dlo), band, params)
+                                     int(dlo), band, params,
+                                     streaming=kernel == "pallas_long")
     return _rowwalk_batch_jit(qs, ts, q_lens, t_lens,
                               jnp.int32(dlo), band, params)
 
@@ -283,32 +293,28 @@ def banded_traceback_batch(qs: jax.Array, ts: jax.Array,
 # compressed (iy_run, op) row stream — identical, bit for bit, to the
 # XLA row-walk (fuzzed in tests/test_realign.py).
 # ---------------------------------------------------------------------------
-def _fwdptr_kernel(q_ref, t_ref, qlen_ref, tlen_ref,
-                   ptr_ref, score_ref, b0_ref, mat0_ref,
-                   m_c, ix_c, iy_c, *, n, band, dlo,
-                   match, mismatch, go, ge, block_t, m8):
-    from jax.experimental import pallas as pl
+def _fwdptr_init(n, band, dlo, go, ge, block_t):
+    bidx = jax.lax.broadcasted_iota(jnp.int32, (band, block_t), 0)
+    j0 = dlo + bidx
+    return (jnp.where(j0 == 0, 0, NEG),
+            jnp.full((band, block_t), NEG, dtype=jnp.int32),
+            jnp.where((j0 >= 1) & (j0 <= n), -(go + (j0 - 1) * ge), NEG))
 
-    p8 = pl.program_id(1)
+
+def _fwdptr_block(win, q8, q_len, i0, carry, *, n, band, dlo,
+                  match, mismatch, go, ge, block_t):
+    """8 DP rows over one (>= band+7, block_t) target window starting at
+    absolute row i0+1; ``q8`` holds the 8 per-lane query bases.  Shared
+    by the resident and HBM-streaming forward kernels, so their pointers
+    and scores are identical by construction.  Returns (carry, packed
+    pointer tile)."""
     bidx = jax.lax.broadcasted_iota(jnp.int32, (band, block_t), 0)
     neg = jnp.full((band, block_t), NEG, dtype=jnp.int32)
-
-    @pl.when(p8 == 0)
-    def _():
-        j0 = dlo + bidx
-        m_c[...] = jnp.where(j0 == 0, 0, NEG)
-        ix_c[...] = neg
-        iy_c[...] = jnp.where((j0 >= 1) & (j0 <= n),
-                              -(go + (j0 - 1) * ge), NEG)
-
-    q_len = qlen_ref[...]                      # (1, block_t)
-    i0 = p8 * 8
-    win = t_ref[pl.ds(i0 + dlo + band, band + 7), :]
-    m_prev, ix_prev, iy_prev = m_c[...], ix_c[...], iy_c[...]
+    m_prev, ix_prev, iy_prev = carry
     packed = jnp.zeros((band, block_t), jnp.int32)
     for r in range(8):
         i = i0 + r + 1                         # 1-based absolute row
-        qi = q_ref[pl.ds(i0 + r, 1), :]        # (1, block_t) per-lane base
+        qi = q8[r:r + 1, :]                    # (1, block_t) per-lane base
         tj = win[r:r + band]
         s = jnp.where((tj == qi) & (qi < 4), match, -mismatch)
         diag = jnp.maximum(m_prev, jnp.maximum(ix_prev, iy_prev))
@@ -341,25 +347,134 @@ def _fwdptr_kernel(q_ref, t_ref, qlen_ref, tlen_ref,
         m_prev = jnp.where(keep, m_new, m_prev)
         ix_prev = jnp.where(keep, ix_new, ix_prev)
         iy_prev = jnp.where(keep, iy_new, iy_prev)
-    m_c[...] = m_prev
-    ix_c[...] = ix_prev
-    iy_c[...] = iy_prev
+    return (m_prev, ix_prev, iy_prev), packed
+
+
+def _fwdptr_extract(carry, q_len, t_len, band, dlo,
+                    score_ref, b0_ref, mat0_ref):
+    m_prev, ix_prev, iy_prev = carry
+    bidx = jax.lax.broadcasted_iota(jnp.int32, m_prev.shape, 0)
+    b_end = t_len - q_len - dlo
+    in_band = (b_end >= 0) & (b_end < band)
+    sel = bidx == b_end
+    mv = jnp.max(jnp.where(sel, m_prev, NEG), axis=0, keepdims=True)
+    xv = jnp.max(jnp.where(sel, ix_prev, NEG), axis=0, keepdims=True)
+    yv = jnp.max(jnp.where(sel, iy_prev, NEG), axis=0, keepdims=True)
+    best = jnp.maximum(mv, jnp.maximum(xv, yv))
+    score_ref[...] = jnp.where(in_band, best, NEG)
+    b0_ref[...] = jnp.clip(b_end, 0, band - 1)
+    mat0_ref[...] = jnp.where((mv >= xv) & (mv >= yv), 0,
+                              jnp.where(xv >= yv, 1, 2))
+
+
+def _fwdptr_kernel(q_ref, t_ref, qlen_ref, tlen_ref,
+                   ptr_ref, score_ref, b0_ref, mat0_ref,
+                   m_c, ix_c, iy_c, *, n, band, dlo,
+                   match, mismatch, go, ge, block_t, m8):
+    from jax.experimental import pallas as pl
+
+    p8 = pl.program_id(1)
+
+    @pl.when(p8 == 0)
+    def _():
+        m0, x0, y0 = _fwdptr_init(n, band, dlo, go, ge, block_t)
+        m_c[...] = m0
+        ix_c[...] = x0
+        iy_c[...] = y0
+
+    q_len = qlen_ref[...]                      # (1, block_t)
+    i0 = p8 * 8
+    win = t_ref[pl.ds(i0 + dlo + band, band + 7), :]
+    q8 = q_ref[pl.ds(i0, 8), :]
+    carry, packed = _fwdptr_block(
+        win, q8, q_len, i0, (m_c[...], ix_c[...], iy_c[...]),
+        n=n, band=band, dlo=dlo, match=match, mismatch=mismatch,
+        go=go, ge=ge, block_t=block_t)
+    m_c[...], ix_c[...], iy_c[...] = carry
     ptr_ref[0] = packed
 
     @pl.when(p8 == m8 - 1)
     def _():
-        t_len = tlen_ref[...]                  # (1, block_t)
-        b_end = t_len - q_len - dlo
-        in_band = (b_end >= 0) & (b_end < band)
-        sel = bidx == b_end
-        mv = jnp.max(jnp.where(sel, m_prev, NEG), axis=0, keepdims=True)
-        xv = jnp.max(jnp.where(sel, ix_prev, NEG), axis=0, keepdims=True)
-        yv = jnp.max(jnp.where(sel, iy_prev, NEG), axis=0, keepdims=True)
-        best = jnp.maximum(mv, jnp.maximum(xv, yv))
-        score_ref[...] = jnp.where(in_band, best, NEG)
-        b0_ref[...] = jnp.clip(b_end, 0, band - 1)
-        mat0_ref[...] = jnp.where((mv >= xv) & (mv >= yv), 0,
-                                  jnp.where(xv >= yv, 1, 2))
+        _fwdptr_extract(carry, q_len, tlen_ref[...], band, dlo,
+                        score_ref, b0_ref, mat0_ref)
+
+
+def _fwdptr_kernel_long(q_hbm, t_hbm, qlen_ref, tlen_ref,
+                        ptr_ref, score_ref, b0_ref, mat0_ref,
+                        m_c, ix_c, iy_c, tbuf0, tbuf1, qbuf0, qbuf1,
+                        sems, *, n, band, dlo, match, mismatch, go, ge,
+                        block_t, m8):
+    """HBM-streaming variant: the target and query stay in HBM/ANY and
+    each grid step's (band+8, block_t) window and (8, block_t) query
+    rows stream into double-buffered VMEM scratch (the banded_scores_long
+    pattern, GapAssem has no analog) — so 50 kb+ re-alignments fit in
+    VMEM.  DP math is the shared ``_fwdptr_block``: bit-identical to the
+    resident kernel."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    tb = pl.program_id(0)
+    p8 = pl.program_id(1)
+
+    def t_dma(buf, slot, step):
+        return pltpu.make_async_copy(
+            t_hbm.at[pl.ds(step * 8 + dlo + band, band + 8),
+                     pl.ds(tb * block_t, block_t)], buf, sems.at[slot])
+
+    def q_dma(buf, slot, step):
+        return pltpu.make_async_copy(
+            q_hbm.at[pl.ds(step * 8, 8),
+                     pl.ds(tb * block_t, block_t)], buf,
+            sems.at[2 + slot])
+
+    @pl.when(p8 == 0)
+    def _():
+        m0, x0, y0 = _fwdptr_init(n, band, dlo, go, ge, block_t)
+        m_c[...] = m0
+        ix_c[...] = x0
+        iy_c[...] = y0
+        t_dma(tbuf0, 0, 0).start()
+        q_dma(qbuf0, 0, 0).start()
+
+    # prefetch the next chunk into the other slot before consuming this
+    # one (slots alternate by grid-step parity; the other slot's buffer
+    # was consumed on the previous step)
+    @pl.when((p8 + 1 < m8) & (p8 % 2 == 0))
+    def _():
+        t_dma(tbuf1, 1, p8 + 1).start()
+        q_dma(qbuf1, 1, p8 + 1).start()
+
+    @pl.when((p8 + 1 < m8) & (p8 % 2 == 1))
+    def _():
+        t_dma(tbuf0, 0, p8 + 1).start()
+        q_dma(qbuf0, 0, p8 + 1).start()
+
+    q_len = qlen_ref[...]
+
+    def compute(tbuf, qbuf, slot):
+        t_dma(tbuf, slot, p8).wait()
+        q_dma(qbuf, slot, p8).wait()
+        carry, packed = _fwdptr_block(
+            tbuf[...], qbuf[...], q_len, p8 * 8,
+            (m_c[...], ix_c[...], iy_c[...]),
+            n=n, band=band, dlo=dlo, match=match, mismatch=mismatch,
+            go=go, ge=ge, block_t=block_t)
+        m_c[...], ix_c[...], iy_c[...] = carry
+        ptr_ref[0] = packed
+
+    @pl.when(p8 % 2 == 0)
+    def _():
+        compute(tbuf0, qbuf0, 0)
+
+    @pl.when(p8 % 2 == 1)
+    def _():
+        compute(tbuf1, qbuf1, 1)
+
+    @pl.when(p8 == m8 - 1)
+    def _():
+        _fwdptr_extract((m_c[...], ix_c[...], iy_c[...]), q_len,
+                        tlen_ref[...], band, dlo,
+                        score_ref, b0_ref, mat0_ref)
 
 
 def _walk_kernel(packed_ref, b0_ref, mat0_ref, qlen_ref,
@@ -417,12 +532,16 @@ def _walk_kernel(packed_ref, b0_ref, mat0_ref, qlen_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("dlo", "band", "params",
-                                             "block_t", "interpret"))
+                                             "block_t", "interpret",
+                                             "streaming"))
 def _rowwalk_batch_pallas(qs, ts, q_lens, t_lens, dlo: int, band: int,
                           params: ScoreParams, block_t: int = 128,
-                          interpret: bool | None = None):
+                          interpret: bool | None = None,
+                          streaming: bool = False):
     """Pallas path of ``banded_realign_rows`` — same output contract as
-    ``_rowwalk_batch_jit``, bit for bit (fuzz-gated in tests)."""
+    ``_rowwalk_batch_jit``, bit for bit (fuzz-gated in tests).  With
+    ``streaming`` the forward kernel keeps sequences in HBM and streams
+    per-chunk windows (long-read shapes that don't fit VMEM resident)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -441,39 +560,69 @@ def _rowwalk_batch_pallas(qs, ts, q_lens, t_lens, dlo: int, band: int,
         t_lens = jnp.pad(t_lens, (0, pad_t - T))
     qs_T = jnp.pad(qs.astype(jnp.int32).T, ((0, m_pad8 - m_max), (0, 0)),
                    constant_values=127)
-    ts_T = jnp.pad(ts.astype(jnp.int32).T, ((band, band + 8), (0, 0)),
+    ts_T = jnp.pad(ts.astype(jnp.int32).T, ((band, band + 16), (0, 0)),
                    constant_values=127)
     grid = (pad_t // block_t, m8)
-    fwd = functools.partial(
-        _fwdptr_kernel, n=n, band=band, dlo=dlo, match=params.match,
-        mismatch=params.mismatch, go=params.go, ge=params.gap_extend,
-        block_t=block_t, m8=m8)
-    ptrs, scores, b0, mat0 = pl.pallas_call(
-        fwd,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((m_pad8, block_t), lambda tb, p8: (0, tb)),
-            pl.BlockSpec((n + 2 * band + 8, block_t),
-                         lambda tb, p8: (0, tb)),
-            pl.BlockSpec((1, block_t), lambda tb, p8: (0, tb)),
-            pl.BlockSpec((1, block_t), lambda tb, p8: (0, tb)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, band, block_t), lambda tb, p8: (p8, 0, tb)),
-            pl.BlockSpec((1, block_t), lambda tb, p8: (0, tb)),
-            pl.BlockSpec((1, block_t), lambda tb, p8: (0, tb)),
-            pl.BlockSpec((1, block_t), lambda tb, p8: (0, tb)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((m8, band, pad_t), jnp.int32),
-            jax.ShapeDtypeStruct((1, pad_t), jnp.int32),
-            jax.ShapeDtypeStruct((1, pad_t), jnp.int32),
-            jax.ShapeDtypeStruct((1, pad_t), jnp.int32),
-        ],
-        scratch_shapes=[pltpu.VMEM((band, block_t), jnp.int32)] * 3,
-        interpret=interpret,
-    )(qs_T, ts_T, q_lens.astype(jnp.int32)[None, :],
-      t_lens.astype(jnp.int32)[None, :])
+    common = dict(n=n, band=band, dlo=dlo, match=params.match,
+                  mismatch=params.mismatch, go=params.go,
+                  ge=params.gap_extend, block_t=block_t, m8=m8)
+    out_specs = [
+        pl.BlockSpec((1, band, block_t), lambda tb, p8: (p8, 0, tb)),
+        pl.BlockSpec((1, block_t), lambda tb, p8: (0, tb)),
+        pl.BlockSpec((1, block_t), lambda tb, p8: (0, tb)),
+        pl.BlockSpec((1, block_t), lambda tb, p8: (0, tb)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((m8, band, pad_t), jnp.int32),
+        jax.ShapeDtypeStruct((1, pad_t), jnp.int32),
+        jax.ShapeDtypeStruct((1, pad_t), jnp.int32),
+        jax.ShapeDtypeStruct((1, pad_t), jnp.int32),
+    ]
+    lens_spec = pl.BlockSpec((1, block_t), lambda tb, p8: (0, tb))
+    if streaming:
+        # target and query stay in HBM; per-step windows stream into
+        # double-buffered VMEM scratch — m and n bounded by HBM only
+        ptrs, scores, b0, mat0 = pl.pallas_call(
+            functools.partial(_fwdptr_kernel_long, **common),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                lens_spec,
+                lens_spec,
+            ],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            scratch_shapes=[
+                pltpu.VMEM((band, block_t), jnp.int32),
+                pltpu.VMEM((band, block_t), jnp.int32),
+                pltpu.VMEM((band, block_t), jnp.int32),
+                pltpu.VMEM((band + 8, block_t), jnp.int32),
+                pltpu.VMEM((band + 8, block_t), jnp.int32),
+                pltpu.VMEM((8, block_t), jnp.int32),
+                pltpu.VMEM((8, block_t), jnp.int32),
+                pltpu.SemaphoreType.DMA((4,)),
+            ],
+            interpret=interpret,
+        )(qs_T, ts_T, q_lens.astype(jnp.int32)[None, :],
+          t_lens.astype(jnp.int32)[None, :])
+    else:
+        ptrs, scores, b0, mat0 = pl.pallas_call(
+            functools.partial(_fwdptr_kernel, **common),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((m_pad8, block_t), lambda tb, p8: (0, tb)),
+                pl.BlockSpec((n + 2 * band + 16, block_t),
+                             lambda tb, p8: (0, tb)),
+                lens_spec,
+                lens_spec,
+            ],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            scratch_shapes=[pltpu.VMEM((band, block_t), jnp.int32)] * 3,
+            interpret=interpret,
+        )(qs_T, ts_T, q_lens.astype(jnp.int32)[None, :],
+          t_lens.astype(jnp.int32)[None, :])
 
     walk = functools.partial(_walk_kernel, band=band, block_t=block_t,
                              m8=m8)

@@ -270,10 +270,12 @@ def test_realign_pairs_length_buckets(monkeypatch):
         np.testing.assert_array_equal(r[1], o1)
 
 
+@pytest.mark.parametrize("kernel", ["pallas", "pallas_long"])
 @pytest.mark.parametrize("seed", [11, 12, 13])
-def test_pallas_rowwalk_matches_xla(seed):
-    """The fused Pallas forward+walk kernels must be bit-identical to
-    the XLA scan path: scores, leads, per-row runs/ops, ok."""
+def test_pallas_rowwalk_matches_xla(seed, kernel):
+    """The fused Pallas forward+walk kernels — resident AND
+    HBM-streaming — must be bit-identical to the XLA scan path: scores,
+    leads, per-row runs/ops, ok."""
     from pwasm_tpu.ops.realign import banded_realign_rows
 
     rng = np.random.default_rng(seed)
@@ -295,7 +297,7 @@ def test_pallas_rowwalk_matches_xla(seed):
         ref = banded_realign_rows(qs, ts, qls, tls, band=band,
                                   kernel="xla")
         got = banded_realign_rows(qs, ts, qls, tls, band=band,
-                                  kernel="pallas")
+                                  kernel=kernel)
         names = ("scores", "leads", "iy_runs", "ops_rows", "ok")
         for name, a, b in zip(names, ref, got):
             ar, br = np.asarray(a), np.asarray(b)
