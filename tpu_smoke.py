@@ -94,14 +94,14 @@ def main() -> int:
                                                  tld, band=band))
         assert np.array_equal(got, want_m2m), "score mismatch"
 
-    def realign():
+    def realign(kernel="pallas"):
         from pwasm_tpu.ops.realign import banded_realign_rows
         qs = np.broadcast_to(q, (ts.shape[0], len(q))).copy()
         qls = np.full(ts.shape[0], len(q), dtype=np.int32)
         ref = banded_realign_rows(qs, ts, qls, t_lens, band=band,
                                   kernel="xla")
         got = banded_realign_rows(qs, ts, qls, t_lens, band=band,
-                                  kernel="pallas")
+                                  kernel=kernel)
         for name, a, b in zip(("scores", "leads", "iy", "ops", "ok"),
                               ref, got):
             assert np.array_equal(np.asarray(a), np.asarray(b)), \
@@ -112,7 +112,9 @@ def main() -> int:
                "banded_scores_packed": dp_packed,
                "consensus_pallas": consensus,
                "many2many_scores_pallas": m2m,
-               "realign_fwdptr_walk_pallas": realign}
+               "realign_fwdptr_walk_pallas": realign,
+               "realign_fwdptr_streaming_pallas":
+                   lambda: realign("pallas_long")}
     results = {}
     for name, fn in kernels.items():
         try:
