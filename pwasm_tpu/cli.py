@@ -425,7 +425,7 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         items, re_pending[:] = re_pending[:], []
         results = realign_pairs(
             [(q_seg, bytes(aln.tseq)) for aln, _t, _r, _o, q_seg in items],
-            band=cfg.band)
+            band=cfg.band, mesh=shard_mesh)
         for (aln, tlabel, refseq_b, ordn, _q), res in zip(items, results):
             al = aln.alninfo
             if res is None:  # outside realignment resource bounds:

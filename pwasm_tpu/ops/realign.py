@@ -169,6 +169,26 @@ def _rowwalk_batch_jit(qs, ts, q_lens, t_lens, dlo, band, params):
                           t_lens.astype(jnp.int32))
 
 
+def _select_kernel(m_max: int, n: int, band: int) -> str:
+    """Auto kernel choice for ``banded_realign_rows``:
+    - resident pallas when target window + query column + carry +
+      pointer tiles fit per 128-lane block, double-buffered — about
+      (n + m + 8*band) * 1024 bytes against Mosaic's 16 MB scoped vmem
+      (band=1024 escalations were seen rejected at ~18 MB);
+    - streaming pallas when only the (band+8)-row windows and carries
+      are resident — bounded by band alone;
+    - the XLA scan off-TPU or for bands no kernel variant fits."""
+    from pwasm_tpu.ops import on_tpu_backend
+
+    if band % 8 or not on_tpu_backend():
+        return "xla"
+    if (n + m_max + 8 * band + 160) * 1024 <= 10 << 20:
+        return "pallas"
+    if (10 * band + 200) * 1024 <= 10 << 20:
+        return "pallas_long"
+    return "xla"
+
+
 def banded_realign_rows(qs: jax.Array, ts: jax.Array,
                         q_lens: jax.Array, t_lens: jax.Array,
                         band: int = 64,
@@ -207,22 +227,7 @@ def banded_realign_rows(qs: jax.Array, ts: jax.Array,
     if dlo is None:
         dlo = -(band // 2)
     if kernel is None:
-        from pwasm_tpu.ops import on_tpu_backend
-        if band % 8 or not on_tpu_backend():
-            kernel = "xla"
-        # resident: target window + query column + carry + pointer tiles
-        # per 128-lane block, double-buffered — about
-        # (n + m + 8*band) * 1024 bytes against Mosaic's 16 MB scoped
-        # vmem (band=1024 escalations were seen rejected at ~18 MB)
-        elif (ts.shape[1] + qs.shape[1] + 8 * band + 160) * 1024 \
-                <= 10 << 20:
-            kernel = "pallas"
-        # streaming: only the (band+8)-row windows and carries are
-        # resident — bounded by band alone
-        elif (10 * band + 200) * 1024 <= 10 << 20:
-            kernel = "pallas_long"
-        else:
-            kernel = "xla"
+        kernel = _select_kernel(qs.shape[1], ts.shape[1], band)
     if kernel in ("pallas", "pallas_long"):
         return _rowwalk_batch_pallas(jnp.asarray(qs), jnp.asarray(ts),
                                      jnp.asarray(q_lens),
@@ -660,6 +665,90 @@ def _rowwalk_batch_pallas(qs, ts, q_lens, t_lens, dlo: int, band: int,
 
 
 # ---------------------------------------------------------------------------
+# multi-chip: lanes shard over the mesh, every device runs the fused
+# kernels on its shard (embarrassingly parallel — no collectives)
+# ---------------------------------------------------------------------------
+def _shard_specs(mesh):
+    from jax.sharding import PartitionSpec as P
+
+    axes = tuple(mesh.axis_names)
+    dp = axes if len(axes) > 1 else axes[0]
+    return (P(dp, None), P(dp, None), P(dp), P(dp)), \
+        (P(dp), P(dp), P(dp, None), P(dp, None), P(dp))
+
+
+# check_vma off in both wrappers: the block is collective-free, and the
+# DP scan's constant initial wavefront is device-invariant while its
+# outputs vary per shard — exactly the pattern the varying-axis checker
+# rejects
+@functools.partial(jax.jit, static_argnames=("mesh", "band", "params",
+                                             "dlo", "kernel"))
+def _sharded_rows_static(qs, ts, q_lens, t_lens, mesh, band: int,
+                         params: ScoreParams, dlo: int, kernel: str):
+    """Sharded dispatch for the Pallas kernels (dlo is genuinely static
+    there — the unsharded Pallas path recompiles per placement too)."""
+    from jax import shard_map
+
+    def block(qs_l, ts_l, ql_l, tl_l):
+        return banded_realign_rows(qs_l, ts_l, ql_l, tl_l, band=band,
+                                   params=params, dlo=dlo, kernel=kernel)
+
+    in_specs, out_specs = _shard_specs(mesh)
+    fn = shard_map(block, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, check_vma=False)
+    return fn(qs, ts, q_lens, t_lens)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "band", "params"))
+def _sharded_rows_traced(qs, ts, q_lens, t_lens, dlo, mesh, band: int,
+                         params: ScoreParams):
+    """Sharded dispatch for the XLA scan path: ``dlo`` stays a traced
+    replicated scalar, so re-placing the band between flushes reuses
+    the compiled program (same contract as the unsharded XLA path)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def block(qs_l, ts_l, ql_l, tl_l, dlo_l):
+        return _rowwalk_batch_jit(qs_l, ts_l, ql_l, tl_l, dlo_l, band,
+                                  params)
+
+    in_specs, out_specs = _shard_specs(mesh)
+    fn = shard_map(block, mesh=mesh, in_specs=in_specs + (P(),),
+                   out_specs=out_specs, check_vma=False)
+    return fn(qs, ts, q_lens, t_lens, dlo)
+
+
+def sharded_realign_rows(mesh, qs, ts, q_lens, t_lens, band: int = 64,
+                         params: ScoreParams = ScoreParams(),
+                         dlo: int | None = None):
+    """``banded_realign_rows`` with the lane axis sharded over every
+    mesh axis (the ``pafreport --shard`` realign path): each device runs
+    the fused forward+walk kernels on its own lane shard.  Lanes are
+    padded to a mesh multiple with empty entries (ok=False) and sliced
+    back; results are bit-identical to the unsharded call."""
+    if dlo is None:
+        dlo = -(band // 2)
+    n_mesh = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    T = qs.shape[0]
+    pad = -T % n_mesh
+    if pad:
+        qs = np.pad(qs, ((0, pad), (0, 0)), constant_values=127)
+        ts = np.pad(ts, ((0, pad), (0, 0)), constant_values=127)
+        q_lens = np.pad(q_lens, (0, pad))
+        t_lens = np.pad(t_lens, (0, pad))
+    args = (jnp.asarray(qs), jnp.asarray(ts), jnp.asarray(q_lens),
+            jnp.asarray(t_lens))
+    kernel = _select_kernel(qs.shape[1], ts.shape[1], band)
+    if kernel == "xla":
+        out = _sharded_rows_traced(*args, jnp.int32(dlo), mesh, band,
+                                   params)
+    else:
+        out = _sharded_rows_static(*args, mesh, band, params, int(dlo),
+                                   kernel)
+    return tuple(x[:T] for x in out)
+
+
+# ---------------------------------------------------------------------------
 # device-side gap extraction: compressed rows -> fixed-capacity gap slots
 # ---------------------------------------------------------------------------
 @functools.partial(jax.jit, static_argnames=("max_gaps",))
@@ -938,7 +1027,7 @@ _PTR_BYTES_LIMIT = 1 << 30
 
 
 def realign_pairs(pairs: list[tuple[bytes, bytes]], band: int = 64,
-                  params: ScoreParams = ScoreParams()):
+                  params: ScoreParams = ScoreParams(), mesh=None):
     """Re-align a batch of (query_segment, target) byte-string pairs.
 
     Returns a list of (score, ops_fwd) — or ``None`` for pairs that
@@ -951,6 +1040,9 @@ def realign_pairs(pairs: list[tuple[bytes, bytes]], band: int = 64,
     is reused across flushes.  Lanes whose end diagonal the static band
     cannot cover retry on device with an escalated band (x4 per retry
     up to 4096); tiny leftovers use the host oracle.
+
+    ``mesh``: a jax.sharding.Mesh (``pafreport --shard``) — lanes shard
+    over every mesh axis, one fused-kernel launch per device shard.
     """
     from pwasm_tpu.core.dna import encode
 
@@ -963,12 +1055,12 @@ def realign_pairs(pairs: list[tuple[bytes, bytes]], band: int = 64,
         groups.setdefault((_bucket(len(qc)), _bucket(len(tc))),
                           []).append(k)
     for (mb, nb), idxs in sorted(groups.items()):
-        _realign_group(enc, idxs, mb, nb, band, params, out)
+        _realign_group(enc, idxs, mb, nb, band, params, out, mesh)
     return out
 
 
 def _realign_group(enc, idxs: list[int], m_max: int, n: int, band: int,
-                   params: ScoreParams, out: list) -> None:
+                   params: ScoreParams, out: list, mesh=None) -> None:
     """Dispatch one shape bucket of ``realign_pairs`` lanes (padded to
     (m_max, n)), writing results into ``out`` at their original
     indices."""
@@ -999,10 +1091,19 @@ def _realign_group(enc, idxs: list[int], m_max: int, n: int, band: int,
         for c0 in range(0, len(todo), chunk):
             sub = todo[c0:c0 + chunk]
             dlo = _pick_dlo(t_lens[sub] - q_lens[sub], cur_band)
-            scores, leads, iy_runs, ops_rows, ok = banded_realign_rows(
-                jnp.asarray(qs[sub]), jnp.asarray(ts[sub]),
-                jnp.asarray(q_lens[sub]), jnp.asarray(t_lens[sub]),
-                band=cur_band, params=params, dlo=dlo)
+            if mesh is not None:
+                scores, leads, iy_runs, ops_rows, ok = \
+                    sharded_realign_rows(mesh, qs[sub], ts[sub],
+                                         q_lens[sub], t_lens[sub],
+                                         band=cur_band, params=params,
+                                         dlo=dlo)
+            else:
+                scores, leads, iy_runs, ops_rows, ok = \
+                    banded_realign_rows(
+                        jnp.asarray(qs[sub]), jnp.asarray(ts[sub]),
+                        jnp.asarray(q_lens[sub]),
+                        jnp.asarray(t_lens[sub]),
+                        band=cur_band, params=params, dlo=dlo)
             scores = np.asarray(scores)
             leads = np.asarray(leads)
             iy_runs = np.asarray(iy_runs)

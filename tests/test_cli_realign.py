@@ -174,3 +174,40 @@ def test_realign_batched_flush(tmp_path):
              stdout=io.StringIO(), stderr=io.StringIO())
     assert rc == 0
     assert many.read_text() == one.read_text()
+
+
+def test_realign_shard_byte_identical(tmp_path):
+    """--realign --shard over the virtual 8-device mesh: MSA and report
+    byte-identical to the unsharded device run."""
+    import io
+    import sys
+
+    import numpy as np
+
+    from pwasm_tpu.cli import run
+    from pwasm_tpu.core.fasta import write_fasta
+
+    sys.path.insert(0, "tests")
+    from helpers import make_paf_line
+
+    rng = np.random.default_rng(33)
+    q = "".join("ACGT"[i] for i in rng.integers(0, 4, 150))
+    fa = tmp_path / "q.fa"
+    write_fasta(str(fa), [("q", q.encode())])
+    lines = []
+    for k in range(12):
+        ops = [[("=", 150)], [("=", 40), ("ins", "TT"), ("=", 110)],
+               [("=", 70), ("del", 3), ("=", 77)]][k % 3]
+        lines.append(make_paf_line("q", q, f"t{k}", "+", ops)[0])
+    paf = tmp_path / "in.paf"
+    paf.write_text("".join(l + "\n" for l in lines))
+    outs = {}
+    for mode, extra in (("plain", []), ("shard", ["--shard"])):
+        rep = tmp_path / f"{mode}.dfa"
+        mfa = tmp_path / f"{mode}.mfa"
+        rc = run([str(paf), "-r", str(fa), "-o", str(rep),
+                  "-w", str(mfa), "--realign", "--device=tpu"] + extra,
+                 stderr=io.StringIO())
+        assert rc == 0, mode
+        outs[mode] = rep.read_text() + mfa.read_text()
+    assert outs["plain"] == outs["shard"]
