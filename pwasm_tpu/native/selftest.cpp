@@ -33,6 +33,9 @@ int64_t pw_fasta_fetch(const char*, int64_t, int64_t, uint8_t*);
 void pw_encode_codes(const uint8_t*, int64_t, int8_t*);
 void pw_pack_2bit(const int8_t*, int64_t, uint8_t*);
 void pw_unpack_2bit(const uint8_t*, int64_t, int8_t*);
+int64_t pw_gotoh_traceback(const int8_t*, int64_t, const int8_t*, int64_t,
+                           int32_t, int32_t, int32_t, int32_t, int8_t*,
+                           int64_t*);
 }
 
 static void test_extract() {
@@ -69,6 +72,26 @@ static void test_gotoh() {
   memcpy(ts + 12, t, 12);
   pw_banded_gotoh_batch(q, 8, ts, t_lens, 2, 12, 8, -4, 2, 4, 4, 2, out);
   assert(out[0] == 16 && out[1] == 16);
+}
+
+static void test_gotoh_traceback() {
+  // q ACGTACGT vs t with one inserted base: 8 diagonals + 1 Iy
+  int8_t q[8] = {0, 1, 2, 3, 0, 1, 2, 3};
+  int8_t t[9] = {0, 1, 2, 2, 3, 0, 1, 2, 3};
+  int8_t ops[17];
+  int64_t score = 0;
+  int64_t k = pw_gotoh_traceback(q, 8, t, 9, 2, 4, 4, 2, ops, &score);
+  assert(k == 9);
+  assert(score == 8 * 2 - (4 + 2));  // 8 matches - one 1-base gap
+  int diag = 0, iy = 0;
+  for (int64_t i = 0; i < k; ++i) {
+    if (ops[i] == 1) ++diag;
+    if (ops[i] == 3) ++iy;
+  }
+  assert(diag == 8 && iy == 1);
+  // degenerate: empty query -> all Iy
+  k = pw_gotoh_traceback(q, 0, t, 3, 2, 4, 4, 2, ops, &score);
+  assert(k == 3 && ops[0] == 3 && score == -(4 + 2) - 2 * 2);
 }
 
 static void test_consensus() {
@@ -130,6 +153,7 @@ static void test_pack() {
 int main() {
   test_extract();
   test_gotoh();
+  test_gotoh_traceback();
   test_consensus();
   test_fasta();
   test_pack();
