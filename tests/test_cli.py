@@ -427,6 +427,29 @@ def test_resume_appends_remaining_alignments(tmp_path):
     assert part.read_text() == full.read_text()
 
 
+def test_resume_with_msa_rebuilds_full_msa(tmp_path):
+    """--resume with an MSA output: report rows for already-emitted
+    alignments are skipped, but the MSA must still include EVERY
+    alignment (the fast-path cursor is disabled when an MSA output is
+    requested — every line goes through extraction and merge)."""
+    lines = _three_alignments()
+    paf, fa = _mk_inputs(tmp_path, lines)
+    full = tmp_path / "full.dfa"
+    full_mfa = tmp_path / "full.mfa"
+    assert run([paf, "-r", fa, "-o", str(full), "-w", str(full_mfa)],
+               stderr=io.StringIO()) == 0
+    part = tmp_path / "part.dfa"
+    paf1 = tmp_path / "first.paf"
+    paf1.write_text(lines[0] + "\n")
+    assert run([str(paf1), "-r", fa, "-o", str(part)],
+               stderr=io.StringIO()) == 0
+    mfa = tmp_path / "resumed.mfa"
+    assert run([paf, "-r", fa, "-o", str(part), "-w", str(mfa),
+                "--resume"], stderr=io.StringIO()) == 0
+    assert part.read_text() == full.read_text()
+    assert mfa.read_text() == full_mfa.read_text()
+
+
 def test_resume_requires_report(tmp_path):
     paf, fa = _mk_inputs(tmp_path, _three_alignments())
     err = io.StringIO()
