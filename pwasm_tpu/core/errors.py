@@ -1,10 +1,14 @@
 """Error model.
 
 The reference fails fast with distinct exit codes (SURVEY.md §2.5.12):
-usage/argument errors exit 1, alignment parse errors exit 3
-(pafreport.cpp:463-467), a zero-coverage MSA column exits 5
-(GapAssem.cpp:1121-1131), and generic fatal errors (GError) use the default
-exit code. We mirror those codes so scripted callers behave identically.
+usage/argument errors exit 1, a zero-coverage MSA column exits 5
+(GapAssem.cpp:1121-1131), and generic fatal errors (GError) use the
+default exit code.  NB the reference DECLARES a parse-error path exiting
+3 (PAFAlignment::parseErr, pafreport.cpp:463-467) but never calls it —
+every actual parse failure goes through GError (pafreport.cpp:521-718)
+and exits 1.  We mirror that faithfully: ``ParseError`` exists as the
+parseErr analog but the extractors raise plain ``PwasmError`` (exit 1),
+exactly like the reference's live code path.
 """
 
 from __future__ import annotations
@@ -27,7 +31,10 @@ class PwasmError(Exception):
 
 
 class ParseError(PwasmError):
-    """Malformed alignment line (reference: PAFAlignment::parseErr, exit 3)."""
+    """Malformed alignment line (reference: PAFAlignment::parseErr,
+    exit 3).  Like parseErr itself — which the reference declares but
+    never calls (every live parse failure GErrors with exit 1) — this
+    class is API surface, intentionally unraised by the extractors."""
 
     exit_code = EXIT_PARSE
 

@@ -42,6 +42,27 @@ SOFTCLIP_WARNING = ("Warning: soft clipping shouldn't be found in this "
 BASE_MISMATCH_ERROR = ("Error: base mismatch {} != qstr[{}] ({}) at line"
                        "\n{}\n")
 SPLICE_ERROR = "Error: spliced alignments not supported! at line:\n{}\n"
+COORDS_ERROR = ("Error: invalid alignment coordinates "
+                "(q {}-{}/{}, t {}-{}) at line:\n{}\n")
+
+
+def validate_coords(al, line: str) -> None:
+    """Coordinate sanity shared by BOTH extractors: corrupted fields
+    (negative or inverted spans) must fail as a clean PwasmError, not
+    as allocation blow-ups or out-of-bounds reference reads (found by
+    fuzzing mutated PAF lines; the reference would GMALLOC the bogus
+    size and crash too — our --skip-bad-lines contract needs a clean
+    error).  Only what memory safety requires: the query bounds feed
+    the offset math (r_len - r_alnend on reverse strands) and the
+    reference reads; the target span sizes the reconstruction buffer.
+    The PAF t_len column is NOT checked against — the reference never
+    reads it, and inputs with a junk t_len but self-consistent spans
+    extract identically there."""
+    if not (0 <= al.r_alnstart <= al.r_alnend <= al.r_len
+            and 0 <= al.t_alnstart <= al.t_alnend):
+        raise PwasmError(COORDS_ERROR.format(
+            al.r_alnstart, al.r_alnend, al.r_len,
+            al.t_alnstart, al.t_alnend, line))
 CS_OP_ERROR = "Error: unhandled event at {} in cs, line:\n{}\n"
 CIGAR_OP_ERROR = "Error: unhandled cigar_op {} (len {}) in {}\n"
 TSEQ_LEN_ERROR = ("Error: tseq alignment length mismatch ({} vs {}({}-{}))"
@@ -141,6 +162,7 @@ def extract_alignment(rec: PafRecord, refseq_aln: bytes,
     Dispatches to the native C++ extractor when available (parity enforced
     by tests/test_native.py); ``use_native=False`` forces the Python path.
     """
+    validate_coords(rec.alninfo, rec.line)
     if use_native is None:
         use_native = os.environ.get("PWASM_NATIVE", "1") != "0"
     if use_native:

@@ -145,6 +145,10 @@ def _raise_native_error(rc: int, info, sizes, rec, refseq_aln: bytes):
     if rc == 8:
         raise PwasmError(E.REF_LEN_ERROR.format(
             a, al.r_alnend, al.r_alnstart, line))
+    if rc == 9:
+        raise PwasmError(E.COORDS_ERROR.format(
+            al.r_alnstart, al.r_alnend, al.r_len,
+            al.t_alnstart, al.t_alnend, line))
     raise PwasmError(f"native extraction failed (code {rc})\n")
 
 
@@ -160,6 +164,10 @@ def extract_native(rec, refseq_aln: bytes):
     if lib is None:
         return None
     al = rec.alninfo
+    # same coordinate sanity as the Python path (negative/inverted spans
+    # would otherwise size buffers below with a negative value); the C++
+    # entry carries a belt guard too for non-Python callers
+    E.validate_coords(al, rec.line)
     if not rec.cigar:
         raise PwasmError(E.CIGAR_ERROR.format(rec.line, 0))
     if rec.cs is None:

@@ -69,6 +69,7 @@ enum ErrCode {
   ERR_CIGAR_OP = 6,       // err_info[0] = op char, err_info[1] = count
   ERR_TSEQ_LEN = 7,       // err_info[0] = tpos
   ERR_REF_LEN = 8,        // err_info[0] = qpos
+  ERR_COORDS = 9,         // negative/inverted alignment spans
   ERR_GROW = 100,         // output buffers too small; caller retries
 };
 
@@ -107,6 +108,11 @@ int pw_extract(const char* cs, const char* cigar,
 #define FAIL(code, a, b) \
   do { out_sizes[4] = n_softclip; err_info[0] = (int32_t)(a); \
        err_info[1] = (int32_t)(b); return (code); } while (0)
+  // belt guard (the Python caller validates first): inverted/negative
+  // spans must never reach the size computations below
+  if (offset < 0 || r_len < 0 || ref_len < 0 || t_alnstart < 0 ||
+      t_alnend < t_alnstart || r_alnstart < 0 || r_alnend < r_alnstart)
+    FAIL(ERR_COORDS, 0, 0);
   std::string tseq;
   tseq.reserve((size_t)(t_alnend - t_alnstart) + 2);
   std::vector<Ev> evs;

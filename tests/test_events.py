@@ -212,3 +212,31 @@ def test_forward_reverse_event_equivalence(seed):
     ev_r = [(e.evt, e.rloc, e.evtbases.upper(), e.evtsub.upper())
             for e in aln_r.tdiffs]
     assert ev_f == ev_r
+
+
+def test_invalid_coordinates_raise_cleanly():
+    """Corrupted PAF fields (negative or inverted spans) must raise a
+    clean PwasmError from the shared guard AND from the native extractor
+    called directly — found by fuzzing: the native path previously
+    aborted the whole process with std::length_error on an inverted
+    target span (reserve of a wrapped size_t)."""
+    from pwasm_tpu.native import extract_native, native_available
+
+    line, _ = make_paf_line("q", Q, "t", "+", [("=", 10)])
+    f = line.split("\t")
+    bad_lines = []
+    f2 = f[:]; f2[7] = "5"; f2[8] = "2"; bad_lines.append("\t".join(f2))
+    f2 = f[:]; f2[7] = "-3"; bad_lines.append("\t".join(f2))  # neg t start
+    f2 = f[:]; f2[3] = "12"; bad_lines.append("\t".join(f2))  # q end>q len
+    f2 = f[:]; f2[2] = "9"; f2[3] = "2"; bad_lines.append("\t".join(f2))
+    f2 = f[:]; f2[7] = "1000000"; f2[8] = "0"    # huge inverted span:
+    bad_lines.append("\t".join(f2))              # the original abort
+    for bl in bad_lines:
+        rec = parse_paf_line(bl)
+        with pytest.raises(PwasmError, match="invalid alignment"):
+            extract_alignment(rec, Q.encode())
+        if native_available():
+            # direct native call (bypasses extract_alignment's guard):
+            # the wrapper-level validation must fire, same message
+            with pytest.raises(PwasmError, match="invalid alignment"):
+                extract_native(rec, Q.encode())
