@@ -374,7 +374,8 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
 
     inflight: list = []   # at most one submitted-but-unformatted batch
 
-    def msa_add(aln, tlabel: str, refseq_b: bytes, ord_num: int) -> None:
+    def msa_add(aln, tlabel: str, refseq_b: bytes, ord_num: int,
+                realigned: bool = False) -> None:
         """Insert one alignment into the progressive MSA (the per-line
         body of pafreport.cpp:394-421)."""
         nonlocal ref_gseq, ref_msa
@@ -388,11 +389,34 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         else:
             # bare instance of refseq for this alignment
             rseq = GapSeq(al.r_id, "", b"", seqlen=al.r_len)
-        # once a gap, always a gap: propagate this alignment's gaps
-        for g in aln.rgaps:
-            rseq.set_gap(g.pos, g.len)
-        for g in aln.tgaps:
-            taseq.set_gap(g.pos, g.len)
+        # once a gap, always a gap: propagate this alignment's gaps.
+        # rseq/taseq are fresh objects, so a gap the layout cannot hold
+        # (e.g. an alignment starting with a deletion on the reverse
+        # strand puts a ref gap at position r_len — fatal in the
+        # reference's setGap too, GapAssem.cpp:105-107) fails BEFORE any
+        # MSA mutation and is skippable under --skip-bad-lines
+        try:
+            for g in aln.rgaps:
+                rseq.set_gap(g.pos, g.len)
+            for g in aln.tgaps:
+                taseq.set_gap(g.pos, g.len)
+        except PwasmError:
+            if not cfg.skip_bad_lines:
+                raise
+            # NB the alignment's report rows were already emitted — it
+            # is only excluded from the MSA, so it counts under
+            # msa_dropped, not skipped_bad_lines
+            stats.msa_dropped += 1
+            src = ("re-aligned gap structure — possible re-aligner "
+                   "defect" if realigned else "out-of-layout gap "
+                   "structure in the input")
+            print(f"Warning: excluding alignment {tlabel} from the MSA "
+                  f"({src})", file=stderr)
+            # free the gene-mode dedup slot so a later valid alignment
+            # of the same pair can take this one's place (mirrors the
+            # extraction-stage skip)
+            alnpairs.pop(f"{al.r_id}~{al.t_id}", None)
+            return
         newmsa = Msa(rseq, taseq)
         if first_ref_aln:
             newmsa.ordnum = ord_num
@@ -440,7 +464,8 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                     ops, aln.offset, al.r_len,
                     al.t_alnend - al.t_alnstart, aln.reverse)
                 stats.realigned += 1
-            msa_add(aln, tlabel, refseq_b, ordn)
+            msa_add(aln, tlabel, refseq_b, ordn,
+                    realigned=res is not None)
 
     def flush_pending(drain: bool = False):
         """Submit the pending batch, then format the PREVIOUS batch —

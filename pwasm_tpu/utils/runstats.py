@@ -29,6 +29,8 @@ class RunStats:
         self.device_batches = 0   # device flushes (--device=tpu)
         self.fallback_batches = 0  # device batches replayed on host
         self.realigned = 0        # alignments re-aligned (--realign)
+        self.msa_dropped = 0      # reported alignments excluded from
+        #                           the MSA (bad gap structure)
 
     @property
     def wall_s(self) -> float:
@@ -52,6 +54,7 @@ class RunStats:
             "device_batches": self.device_batches,
             "fallback_batches": self.fallback_batches,
             "realigned": self.realigned,
+            "msa_dropped": self.msa_dropped,
             "wall_s": round(self.wall_s, 3),
             "aligned_bases_per_s": round(self.rate(), 1),
         }

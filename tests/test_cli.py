@@ -405,6 +405,45 @@ def test_skip_bad_lines(tmp_path):
     assert st["aligned_bases"] > 0
 
 
+def test_skip_bad_lines_covers_out_of_layout_gaps(tmp_path):
+    """An alignment whose gap structure cannot be inserted (a reverse
+    alignment STARTING with a deletion puts a ref gap at r_len — fatal
+    in the reference's setGap, GapAssem.cpp:105-107) aborts a bare -w
+    run exactly like the reference, and is skipped cleanly under
+    --skip-bad-lines."""
+    import json
+
+    good, _ = make_paf_line("q", Q, "t0", "+", [("=", 10)])
+    bad, _ = make_paf_line("q", Q, "tBAD", "-", [("del", 2), ("=", 8)])
+    # a later VALID alignment of the same pair must take the dropped
+    # one's gene-mode dedup slot
+    redo, _ = make_paf_line("q", Q, "tBAD", "+", [("=", 10)])
+    paf, fa = _mk_inputs(tmp_path, [good, bad, redo])
+    mfa = tmp_path / "out.mfa"
+    err = io.StringIO()
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / "r.dfa"),
+              "-w", str(mfa)], stderr=err)
+    assert rc == 1
+    assert "invalid gap position" in err.getvalue()
+    err = io.StringIO()
+    stats = tmp_path / "stats.json"
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / "r2.dfa"),
+              "-w", str(mfa), "--skip-bad-lines",
+              f"--stats={stats}"], stderr=err)
+    assert rc == 0
+    assert "excluding alignment tBAD:0-8- from the MSA" in err.getvalue()
+    body = mfa.read_text()
+    assert ">t0:0-10+" in body
+    assert ">tBAD:0-10+" in body      # the valid retry made it in
+    assert "tBAD:0-8-" not in body    # the bad one did not
+    st = json.loads(stats.read_text())
+    # the dropped alignment's report rows exist: it counts as an
+    # alignment AND as msa_dropped, not as a skipped line
+    assert st["msa_dropped"] == 1
+    assert st["skipped_bad_lines"] == 0
+    assert st["alignments"] == 3
+
+
 def test_resume_appends_remaining_alignments(tmp_path):
     lines = _three_alignments()
     paf, fa = _mk_inputs(tmp_path, lines)
