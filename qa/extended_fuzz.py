@@ -1,0 +1,305 @@
+#!/usr/bin/env python
+"""Extended QA sweeps — heavier than the CI suite, run ad hoc per round.
+
+Five independent adversarial sweeps over the surfaces the test suite
+fuzzes lightly.  Each prints one PASS/FAIL line; exit 0 iff all pass.
+Run on CPU (JAX_PLATFORMS=cpu, 8 virtual devices recommended) or
+against a real chip.  Round-3 findings credited to these sweeps: a
+native process abort on inverted alignment spans (fixed: shared
+coordinate validation) and the --skip-bad-lines gap at MSA insertion.
+
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python qa/extended_fuzz.py
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import os
+import random
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+
+def sweep_refine_batch(seeds: int = 40) -> bool:
+    """Batched X-drop refinement vs the scalar reference transliteration,
+    all (skip_dels x with_dels) regimes."""
+    from test_gapseq_refine import _clone, _random_gapseq
+
+    from pwasm_tpu.align.gapseq import refine_clipping_batch
+
+    bad = total = 0
+    for seed in range(seeds):
+        rng = np.random.default_rng(1000 + seed)
+        for skip_dels in (False, True):
+            for with_dels in (False, True):
+                seqs, clones, cposes = [], [], []
+                for _ in range(16):
+                    s = _random_gapseq(rng, with_dels=with_dels)
+                    seqs.append(s)
+                    clones.append(_clone(s))
+                    cposes.append(int(rng.integers(0, 6)))
+                gm = max(s.seqlen + s.numgaps + 8 for s in seqs)
+                cons = bytes(rng.choice(list(b"ACGT*"), gm + 10))
+                with contextlib.redirect_stderr(io.StringIO()):
+                    refine_clipping_batch(seqs, cons, cposes,
+                                          skip_dels=skip_dels)
+                    for c, cp in zip(clones, cposes):
+                        c.refine_clipping_scalar(cons, cp,
+                                                 skip_dels=skip_dels)
+                for s, c in zip(seqs, clones):
+                    total += 1
+                    if (s.clp5, s.clp3) != (c.clp5, c.clp3):
+                        bad += 1
+    print(f"[{'PASS' if not bad else 'FAIL'}] refine batch-vs-scalar: "
+          f"{bad} mismatches / {total}")
+    return bad == 0
+
+
+def sweep_realign_oracle(seeds: int = 25) -> bool:
+    """Row-walk re-aligner (auto kernel) vs the full-Gotoh oracle with a
+    band covering the whole matrix — scores AND op strings."""
+    from pwasm_tpu.ops.realign import (banded_realign_rows,
+                                       full_gotoh_traceback,
+                                       rows_to_ops_fwd)
+
+    bad = total = 0
+    for seed in range(seeds):
+        rng = np.random.default_rng(2000 + seed)
+        T, m_max, n_max = 12, 70, 90
+        qs = np.full((T, m_max), 127, np.int8)
+        ts = np.full((T, n_max), 127, np.int8)
+        qls = np.zeros(T, np.int32)
+        tls = np.zeros(T, np.int32)
+        oracle = []
+        for k in range(T):
+            m = int(rng.integers(5, m_max + 1))
+            q = rng.integers(0, 4, m).astype(np.int8)
+            t = list(q)
+            for _ in range(int(rng.integers(0, 12))):
+                p = int(rng.integers(0, max(1, len(t) - 1)))
+                r = rng.random()
+                if r < 0.4:
+                    t[p] = int(rng.integers(0, 4))
+                elif r < 0.7:
+                    t.insert(p, int(rng.integers(0, 4)))
+                elif len(t) > 2:
+                    del t[p]
+            t = np.array(t[:n_max], np.int8)
+            oracle.append(full_gotoh_traceback(q, t))
+            qs[k, :m] = q
+            ts[k, :len(t)] = t
+            qls[k] = m
+            tls[k] = len(t)
+        sc, leads, iy, ops, ok = (np.asarray(x) for x in
+                                  banded_realign_rows(qs, ts, qls, tls,
+                                                      band=256))
+        for k in range(T):
+            want_s, want_o = oracle[k]
+            total += 1
+            got = rows_to_ops_fwd(int(leads[k]), iy[k], ops[k],
+                                  int(qls[k]))
+            if not ok[k] or sc[k] != want_s \
+                    or not np.array_equal(got, want_o):
+                bad += 1
+    print(f"[{'PASS' if not bad else 'FAIL'}] realign-vs-oracle: "
+          f"{bad} mismatches / {total}")
+    return bad == 0
+
+
+def sweep_fai_roundtrip(trials: int = 120) -> bool:
+    """.fai sidecar: random record shapes (uniform/irregular/CRLF/blank
+    lines/interior whitespace/no final newline) — reload must fetch
+    identically whether the sidecar persisted or a rescan ran."""
+    from pwasm_tpu.core.fasta import FastaFile
+
+    rng = np.random.default_rng(7)
+    bad = checked = 0
+    for _ in range(trials):
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "f.fa")
+            recs, body = [], []
+            for r in range(int(rng.integers(1, 6))):
+                name = f"s{r}"
+                L = int(rng.integers(1, 200))
+                seq = "".join("ACGT"[i] for i in rng.integers(0, 4, L))
+                style = rng.integers(0, 5)
+                if style == 0:
+                    w = int(rng.integers(1, 80))
+                    lines = [seq[i:i + w] for i in range(0, L, w)]
+                elif style == 1:
+                    lines, i = [], 0
+                    while i < L:
+                        w = int(rng.integers(1, 30))
+                        lines.append(seq[i:i + w])
+                        i += w
+                elif style == 2:
+                    w = int(rng.integers(1, 60))
+                    lines = [seq[i:i + w] + "\r"
+                             for i in range(0, L, w)]
+                elif style == 3:
+                    w = max(1, L // 2)
+                    lines = [seq[:w], "", seq[w:]]
+                else:
+                    lines = [seq[:L // 2] + " " + seq[L // 2:]]
+                body.append(f">{name}\n" + "\n".join(lines) + "\n")
+                recs.append((name, seq.replace(" ", "").encode()))
+            text = "".join(body)
+            if rng.random() < 0.2:
+                text = text.rstrip("\n")
+            with open(p, "w") as f:
+                f.write(text)
+            fa1 = FastaFile(p)
+            fa2 = FastaFile(p)
+            for name, seq in recs:
+                checked += 1
+                if fa1.fetch(name) != seq or fa2.fetch(name) != seq:
+                    bad += 1
+    print(f"[{'PASS' if not bad else 'FAIL'}] .fai roundtrip: "
+          f"{bad} bad fetches / {checked}")
+    return bad == 0
+
+
+def sweep_paf_corruption(trials: int = 20000) -> bool:
+    """Random corruptions of valid PAF lines: every outcome must be a
+    clean accept or PwasmError — never a crash (this sweep found the
+    native std::length_error abort in round 3)."""
+    from helpers import make_paf_line
+
+    from pwasm_tpu.core.dna import revcomp
+    from pwasm_tpu.core.errors import PwasmError
+    from pwasm_tpu.core.events import extract_alignment
+    from pwasm_tpu.core.paf import parse_paf_line
+
+    rng = np.random.default_rng(99)
+    random.seed(99)
+    Q = "".join("ACGT"[i] for i in rng.integers(0, 4, 90))
+    base_lines = []
+    for strand in "+-":
+        for ops in ([("=", 90)],
+                    [("=", 30), ("ins", "ttg"), ("=", 60)],
+                    [("=", 40), ("del", 5), ("=", 45)]):
+            base_lines.append(
+                make_paf_line("q", Q, "t0", strand, ops)[0])
+    alpha = "ACGTacgt:*+-~0123456789\tNnXx"
+    ok = err = 0
+    for _ in range(trials):
+        s = list(random.choice(base_lines))
+        for _ in range(random.randint(1, 5)):
+            p = random.randrange(len(s))
+            r = random.random()
+            if r < 0.5:
+                s[p] = random.choice(alpha)
+            elif r < 0.8:
+                s.insert(p, random.choice(alpha))
+            elif len(s) > 2:
+                del s[p]
+        try:
+            rec = parse_paf_line("".join(s))
+            al = rec.alninfo
+            refseq = Q.encode()
+            if al.r_len != len(refseq):
+                raise PwasmError("len mismatch\n")
+            refseq_aln = revcomp(refseq) if al.reverse else refseq
+            extract_alignment(rec, refseq_aln)
+            ok += 1
+        except PwasmError:
+            err += 1
+    print(f"[PASS] paf corruption: {ok} accepted, {err} rejected "
+          f"cleanly, 0 crashes / {trials}")
+    return True
+
+
+def sweep_cli_parity(trials: int = 15) -> bool:
+    """Random anchored alignment sets through the full CLI: cpu, tpu and
+    tpu+shard outputs (.dfa/.ace/.mfa/.info) must be byte-identical."""
+    from helpers import make_paf_line
+
+    from pwasm_tpu.cli import run
+    from pwasm_tpu.core.dna import revcomp
+    from pwasm_tpu.core.fasta import write_fasta
+
+    rng = np.random.default_rng(11)
+    bad = 0
+    for trial in range(trials):
+        with tempfile.TemporaryDirectory() as td:
+            L = int(rng.integers(60, 240))
+            Q = "".join("ACGT"[i] for i in rng.integers(0, 4, L))
+            fa = os.path.join(td, "q.fa")
+            write_fasta(fa, [("q", Q.encode())])
+            lines = []
+            for k in range(int(rng.integers(2, 14))):
+                strand = "-" if rng.random() < 0.3 else "+"
+                q_aln = revcomp(Q.encode()).decode() \
+                    if strand == "-" else Q
+                head = int(rng.integers(3, 10))
+                tail = int(rng.integers(3, 10))
+                ops = [("=", head)]
+                pos = head
+                while pos < L - tail:
+                    r = rng.random()
+                    span = int(rng.integers(1, L - tail - pos + 1))
+                    if r < 0.55:
+                        ops.append(("=", span))
+                        pos += span
+                    elif r < 0.7:
+                        qb = q_aln[pos]
+                        tb = "ACGT"[("ACGT".index(qb) + 1) % 4]
+                        ops.append(("*", tb.lower(), qb.lower()))
+                        pos += 1
+                    elif r < 0.85:
+                        ins = "".join(
+                            "acgt"[i] for i in rng.integers(
+                                0, 4, int(rng.integers(1, 6))))
+                        ops.append(("ins", ins))
+                    else:
+                        d = min(int(rng.integers(1, 6)),
+                                L - tail - pos)
+                        if d > 0:
+                            ops.append(("del", d))
+                            pos += d
+                ops.append(("=", L - pos))
+                lines.append(
+                    make_paf_line("q", Q, f"t{k:02d}", strand, ops)[0])
+            paf = os.path.join(td, "in.paf")
+            with open(paf, "w") as f:
+                f.write("".join(l + "\n" for l in lines))
+            outs = {}
+            for mode, extra in (("cpu", ["--device=cpu"]),
+                                ("tpu", ["--device=tpu"]),
+                                ("shard", ["--device=tpu", "--shard"])):
+                rc = run([paf, "-r", fa,
+                          "-o", os.path.join(td, f"{mode}.dfa"),
+                          f"--ace={os.path.join(td, mode + '.ace')}",
+                          "-w", os.path.join(td, f"{mode}.mfa"),
+                          f"--info={os.path.join(td, mode + '.info')}"]
+                         + extra, stderr=io.StringIO())
+                if rc != 0:
+                    bad += 1
+                    continue
+                outs[mode] = "".join(
+                    open(os.path.join(td, f"{mode}.{e}")).read()
+                    for e in ("dfa", "ace", "mfa", "info"))
+            if len(set(outs.values())) != 1:
+                bad += 1
+    print(f"[{'PASS' if not bad else 'FAIL'}] CLI parity "
+          f"(cpu/tpu/shard): {bad} divergent trials / {trials}")
+    return bad == 0
+
+
+def main() -> int:
+    results = [sweep_refine_batch(), sweep_realign_oracle(),
+               sweep_fai_roundtrip(), sweep_paf_corruption(),
+               sweep_cli_parity()]
+    return 0 if all(results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
