@@ -47,7 +47,7 @@ def sweep_refine_batch(seeds: int = 40) -> bool:
                     clones.append(_clone(s))
                     cposes.append(int(rng.integers(0, 6)))
                 gm = max(s.seqlen + s.numgaps + 8 for s in seqs)
-                cons = bytes(rng.choice(list(b"ACGT*"), gm + 10))
+                cons = rng.choice(list(b"ACGT*"), gm + 10).astype("uint8").tobytes()
                 with contextlib.redirect_stderr(io.StringIO()):
                     refine_clipping_batch(seqs, cons, cposes,
                                           skip_dels=skip_dels)

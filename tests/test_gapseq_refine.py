@@ -17,7 +17,7 @@ from pwasm_tpu.align.gapseq import GapSeq
 
 def _random_gapseq(rng, seqlen=None, with_dels=False):
     seqlen = seqlen or int(rng.integers(10, 60))
-    seq = bytes(rng.choice(list(b"ACGT"), seqlen))
+    seq = rng.choice(list(b"ACGT"), seqlen).astype("uint8").tobytes()
     s = GapSeq(f"s{rng.integers(1e9)}", "", seq)
     for _ in range(int(rng.integers(0, 6))):
         s.set_gap(int(rng.integers(0, seqlen)), int(rng.integers(1, 4)))
@@ -69,11 +69,14 @@ def test_refine_clipping_matches_scalar_fuzz(seed, skip_dels, with_dels):
         # consensus: sometimes related to the sequence, sometimes noise;
         # cpos jittered so edge clamps are exercised
         if rng.random() < 0.6:
-            cons = bytes(s.seq) + bytes(rng.choice(list(b"ACGT"),
-                                                   int(rng.integers(0, 9))))
+            cons = bytes(s.seq) + rng.choice(
+                list(b"ACGT"),
+                int(rng.integers(0, 9))).astype("uint8").tobytes()
         else:
-            cons = bytes(rng.choice(list(b"ACGT"),
-                                    max(4, glen + int(rng.integers(-4, 5)))))
+            cons = rng.choice(
+                list(b"ACGT"),
+                max(4, glen + int(rng.integers(-4, 5)))).astype(
+                    "uint8").tobytes()
         cpos = int(rng.integers(-3, 6))
         _run_both(s, cons, cpos, skip_dels)
 
@@ -151,7 +154,7 @@ def test_refine_clipping_batch_matches_single(seed, skip_dels):
         clones.append(_clone(s))
         cposes.append(int(rng.integers(0, 5)))
     glen_max = max(s.seqlen + s.numgaps for s in seqs)
-    cons = bytes(rng.choice(list(b"ACGT*"), glen_max + 8))
+    cons = rng.choice(list(b"ACGT*"), glen_max + 8).astype("uint8").tobytes()
     err = io.StringIO()
     with contextlib.redirect_stderr(err):
         refine_clipping_batch(seqs, cons, cposes, skip_dels=skip_dels)
