@@ -21,6 +21,28 @@ import random
 import sys
 import tempfile
 
+# Pin jax to host CPU exactly like tests/conftest.py: this environment's
+# site hook registers a TPU-tunnel backend that overrides even
+# JAX_PLATFORMS=cpu, and a downed tunnel would block the --device=tpu
+# sweeps forever.  (Run against the real chip by exporting
+# PWASM_QA_REAL_CHIP=1 first.)
+if os.environ.get("PWASM_QA_REAL_CHIP", "") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        import jax._src.xla_bridge as _xb
+
+        getattr(_xb, "_backend_factories", {}).pop("axon", None)
+    except Exception:
+        pass
+
 import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -271,24 +293,31 @@ def sweep_cli_parity(trials: int = 15) -> bool:
             paf = os.path.join(td, "in.paf")
             with open(paf, "w") as f:
                 f.write("".join(l + "\n" for l in lines))
-            outs = {}
-            for mode, extra in (("cpu", ["--device=cpu"]),
-                                ("tpu", ["--device=tpu"]),
-                                ("shard", ["--device=tpu", "--shard"])):
-                rc = run([paf, "-r", fa,
-                          "-o", os.path.join(td, f"{mode}.dfa"),
-                          f"--ace={os.path.join(td, mode + '.ace')}",
-                          "-w", os.path.join(td, f"{mode}.mfa"),
-                          f"--info={os.path.join(td, mode + '.info')}"]
-                         + extra, stderr=io.StringIO())
-                if rc != 0:
+            # parity is judged WITHIN each feature-flag variant: devices
+            # must agree byte-for-byte whatever the pipeline does
+            for vname, vflags in (("base", []),
+                                  ("realign", ["--realign"]),
+                                  ("rcg", ["--remove-cons-gaps"])):
+                outs = {}
+                for mode, extra in (("cpu", ["--device=cpu"]),
+                                    ("tpu", ["--device=tpu"]),
+                                    ("shard", ["--device=tpu",
+                                               "--shard"])):
+                    tag = f"{vname}_{mode}"
+                    rc = run([paf, "-r", fa,
+                              "-o", os.path.join(td, f"{tag}.dfa"),
+                              f"--ace={os.path.join(td, tag + '.ace')}",
+                              "-w", os.path.join(td, f"{tag}.mfa"),
+                              f"--info={os.path.join(td, tag)}.info"]
+                             + vflags + extra, stderr=io.StringIO())
+                    if rc != 0:
+                        bad += 1
+                        continue
+                    outs[mode] = "".join(
+                        open(os.path.join(td, f"{tag}.{e}")).read()
+                        for e in ("dfa", "ace", "mfa", "info"))
+                if len(set(outs.values())) != 1:
                     bad += 1
-                    continue
-                outs[mode] = "".join(
-                    open(os.path.join(td, f"{mode}.{e}")).read()
-                    for e in ("dfa", "ace", "mfa", "info"))
-            if len(set(outs.values())) != 1:
-                bad += 1
     print(f"[{'PASS' if not bad else 'FAIL'}] CLI parity "
           f"(cpu/tpu/shard): {bad} divergent trials / {trials}")
     return bad == 0
