@@ -169,8 +169,8 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
                 raise CliError(
                     f"{USAGE}\nInvalid --{knob} value: {val}\n")
             setattr(cfg, knob, int(val))
-    if "motifs" in opts:
-        cfg.motifs = load_motifs(str(opts["motifs"]))
+    if opts.get("motifs") is True:
+        raise CliError(f"{USAGE}\n--motifs requires a file argument\n")
     if "shard" in opts:
         val = opts["shard"]
         if val is True:
@@ -199,6 +199,12 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
                 inf = open(infile)
             except OSError:
                 raise PwasmError(f"Cannot open input file {infile}!\n")
+        if "motifs" in opts:
+            try:
+                cfg.motifs = load_motifs(str(opts["motifs"]))
+            except (OSError, UnicodeDecodeError):
+                raise PwasmError(
+                    f"Cannot open motif file {opts['motifs']}!\n")
         if "c" in opts:
             cfg.clipmax = _parse_clipmax(str(opts["c"]), cfg.verbose)
         cfg.skip_bad_lines = bool(opts.get("skip-bad-lines"))

@@ -45,9 +45,12 @@ class Config:
 
 
 def load_motifs(path: str) -> tuple[str, ...]:
-    """Load a motif table: one motif per line, '#' comments allowed."""
+    """Load a motif table: one motif per line, '#' comments allowed.
+    Motifs are DNA strings, so the file must be ASCII text — opening with
+    ``encoding="ascii"`` keeps the native binary's byte-oriented reader
+    and this one in exact agreement (both reject non-ASCII content)."""
     out = []
-    with open(path) as f:
+    with open(path, encoding="ascii") as f:
         for line in f:
             line = line.strip().upper()
             if line and not line.startswith("#"):

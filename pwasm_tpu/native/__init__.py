@@ -30,30 +30,32 @@ _tried = False
 EV_FIELDS = 10
 
 
-def _build() -> bool:
-    # compile to a process-unique temp path, then publish atomically with
-    # rename so concurrent processes never load a partially written .so
-    tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+def _compile(extra_args: list[str], dest: str, what: str) -> bool:
+    """g++-compile to a process-unique temp path, then publish atomically
+    with rename so concurrent processes never load a partially written
+    artifact."""
+    tmp = f"{dest}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-std=c++17", *extra_args, "-o", tmp]
     try:
         res = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=180)
     except (OSError, subprocess.TimeoutExpired):
+        res = None
+    if res is None or res.returncode != 0:
+        if res is not None:
+            print(f"pwasm-tpu: native {what} build failed:\n"
+                  f"{res.stderr[:2000]}", file=sys.stderr)
         try:
             os.unlink(tmp)
         except OSError:
             pass
         return False
-    if res.returncode != 0:
-        print(f"pwasm-tpu: native build failed:\n{res.stderr[:2000]}",
-              file=sys.stderr)
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        return False
-    os.replace(tmp, _SO)
+    os.replace(tmp, dest)
     return True
+
+
+def _build() -> bool:
+    return _compile(["-shared", "-fPIC", _SRC], _SO, "library")
 
 
 def get_lib():
@@ -105,6 +107,40 @@ def get_lib():
             ctypes.c_void_p]
         _lib = lib
     return _lib
+
+
+_CLI_SRC = os.path.join(_HERE, "pafreport_main.cpp")
+_CLI_BIN = os.path.join(_HERE, "pafreport")
+_cli_lock = threading.Lock()
+_cli_path: str | None = None
+_cli_tried = False
+
+
+def native_cli_path() -> str | None:
+    """Path to the standalone C++ ``pafreport`` binary, building it on
+    first use (like the shared library), or None when no toolchain is
+    available.  The binary is the pure-native ``--device=cpu`` CLI
+    (SURVEY.md §2.4.7-8, §7.3); byte-parity with the Python CLI is
+    enforced by tests/test_native_cli.py."""
+    global _cli_path, _cli_tried
+    if _cli_path is not None or _cli_tried:
+        return _cli_path
+    with _cli_lock:
+        if _cli_path is not None or _cli_tried:
+            return _cli_path
+        _cli_tried = True
+        if os.environ.get("PWASM_NATIVE", "1") == "0":
+            return None
+        try:
+            fresh = (os.path.exists(_CLI_BIN)
+                     and os.path.getmtime(_CLI_BIN) >= os.path.getmtime(_CLI_SRC)
+                     and os.path.getmtime(_CLI_BIN) >= os.path.getmtime(_SRC))
+        except OSError:
+            return None
+        if not fresh and not _compile([_CLI_SRC, _SRC], _CLI_BIN, "CLI"):
+            return None
+        _cli_path = _CLI_BIN
+    return _cli_path
 
 
 def native_available() -> bool:

@@ -253,7 +253,10 @@ def format_event_row(di: DiffEvent, aa: str, aapos: int, rctx: bytes,
 def format_header(aln: PafAlignment, rlabel: str, tlabel: str) -> str:
     """The per-alignment report header line (pafreport.cpp:886-892)."""
     al = aln.alninfo
-    cov = (al.r_alnend - al.r_alnstart) * 100.00 / al.r_len
+    # degenerate zero-length query: the reference's C++ double division
+    # yields NaN and keeps going; mirror that instead of raising
+    cov = ((al.r_alnend - al.r_alnstart) * 100.00 / al.r_len
+           if al.r_len else float("nan"))
     if not rlabel:
         return (f">{tlabel} coverage:{cov:.2f} score={aln.alnscore} "
                 f"edit_distance={aln.edist}\n")
