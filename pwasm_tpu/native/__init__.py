@@ -132,9 +132,12 @@ def native_cli_path() -> str | None:
         if os.environ.get("PWASM_NATIVE", "1") == "0":
             return None
         try:
-            fresh = (os.path.exists(_CLI_BIN)
-                     and os.path.getmtime(_CLI_BIN) >= os.path.getmtime(_CLI_SRC)
-                     and os.path.getmtime(_CLI_BIN) >= os.path.getmtime(_SRC))
+            deps = [_CLI_SRC, _SRC] + [
+                os.path.join(_HERE, h)
+                for h in ("pafreport_msa.h", "pafreport_util.h")]
+            fresh = os.path.exists(_CLI_BIN) and all(
+                os.path.getmtime(_CLI_BIN) >= os.path.getmtime(d)
+                for d in deps)
         except OSError:
             return None
         if not fresh and not _compile([_CLI_SRC, _SRC], _CLI_BIN, "CLI"):

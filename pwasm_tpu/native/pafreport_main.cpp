@@ -39,6 +39,9 @@
 #include <unordered_map>
 #include <vector>
 
+#include "pafreport_msa.h"
+#include "pafreport_util.h"
+
 // ---- shared native core (fastparse.cpp, linked into this binary)
 extern "C" {
 int pw_extract(const char* cs, const char* cigar, const uint8_t* ref,
@@ -85,59 +88,13 @@ const char* USAGE =
     "   --skip-bad-lines    warn and continue on malformed PAF lines\n"
     "   --stats=FILE        write run statistics as one JSON object\n";
 
-struct PwErr {
-  std::string msg;
-  int code;
-  explicit PwErr(std::string m, int c = 1) : msg(std::move(m)), code(c) {}
-};
-
-std::string sformat(const char* fmt, ...) {
-  va_list ap;
-  va_start(ap, fmt);
-  char stackbuf[512];
-  va_list ap2;
-  va_copy(ap2, ap);
-  int n = vsnprintf(stackbuf, sizeof stackbuf, fmt, ap);
-  va_end(ap);
-  if (n < (int)sizeof stackbuf) {
-    va_end(ap2);
-    return std::string(stackbuf, (size_t)(n < 0 ? 0 : n));
-  }
-  std::string out((size_t)n + 1, '\0');
-  vsnprintf(&out[0], out.size(), fmt, ap2);
-  va_end(ap2);
-  out.resize((size_t)n);
-  return out;
-}
-
-// ---------------------------------------------------------------------------
-// DNA tables — native twin of pwasm_tpu/core/dna.py (IUPAC complement of
-// gclib gdna as used by revCompl, pafreport.cpp:469-472; translateCodon of
-// gclib codons as used by predictImpact, pafreport.cpp:824-825,855).
-// ---------------------------------------------------------------------------
-struct CompTbl {
-  unsigned char t[256];
-  CompTbl() {
-    for (int i = 0; i < 256; ++i) t[i] = (unsigned char)i;
-    const char* a = "ACGTUMRWSYKVHDBNX";
-    const char* b = "TGCAAKYWSRMBDHVNX";
-    for (int i = 0; a[i]; ++i) {
-      t[(unsigned char)a[i]] = (unsigned char)b[i];
-      t[(unsigned char)tolower(a[i])] = (unsigned char)tolower(b[i]);
-    }
-  }
-};
-const CompTbl kComp;
-
-std::string revcomp(const std::string& s) {
-  std::string out(s.rbegin(), s.rend());
-  for (auto& c : out) c = (char)kComp.t[(unsigned char)c];
-  return out;
-}
-
-void upper_inplace(std::string& s) {
-  for (auto& c : s) c = (char)toupper((unsigned char)c);
-}
+using pwnative::GapSeq;
+using pwnative::LineReader;
+using pwnative::Msa;
+using pwnative::PwErr;
+using pwnative::revcomp;
+using pwnative::sformat;
+using pwnative::upper_inplace;
 
 // Standard genetic code, index 16*c0 + 4*c1 + c2 with A0 C1 G2 T3
 // (stop='.', anything ambiguous/short='X') — same table as core/dna.py.
@@ -796,42 +753,6 @@ double parse_clipmax(std::string s, bool verbose) {
   return (double)c;
 }
 
-// Buffered line reader with Python universal-newline semantics: '\n',
-// '\r\n' and lone '\r' all terminate a line (the Python CLI reads its
-// text inputs in text mode, which performs exactly this translation).
-class LineReader {
- public:
-  explicit LineReader(FILE* f) : f_(f) {}
-  bool next(std::string& line) {
-    line.clear();
-    for (;;) {
-      if (pos_ >= len_) {
-        len_ = fread(buf_, 1, sizeof buf_, f_);
-        pos_ = 0;
-        if (len_ == 0) return !line.empty();
-      }
-      if (pending_cr_) {  // swallow the '\n' of a '\r\n' pair
-        pending_cr_ = false;
-        if (buf_[pos_] == '\n') ++pos_;
-        continue;
-      }
-      char c = buf_[pos_++];
-      if (c == '\n') return true;
-      if (c == '\r') {  // lone '\r' (or start of '\r\n') ends the line
-        pending_cr_ = true;
-        return true;
-      }
-      line.push_back(c);
-    }
-  }
-
- private:
-  FILE* f_;
-  char buf_[1 << 16];
-  size_t pos_ = 0, len_ = 0;
-  bool pending_cr_ = false;
-};
-
 std::vector<std::string> load_motifs(const std::string& path) {
   // ASCII text, any readable file object (FIFOs/process substitution
   // work in the Python CLI, so they must here too) — only directories
@@ -866,7 +787,7 @@ std::vector<std::string> load_motifs(const std::string& path) {
 struct RunStats {
   struct timespec t0;
   long lines = 0, alignments = 0, skipped_bad = 0, skipped_dedup = 0,
-       skipped_self = 0, aligned_bases = 0, events = 0;
+       skipped_self = 0, aligned_bases = 0, events = 0, msa_dropped = 0;
   RunStats() { clock_gettime(CLOCK_MONOTONIC, &t0); }
   double wall_s() const {
     struct timespec t1;
@@ -882,10 +803,10 @@ struct RunStats {
             "%ld, \"skipped_duplicates\": %ld, \"skipped_self\": %ld, "
             "\"resumed_past\": 0, \"aligned_bases\": %ld, \"events\": %ld, "
             "\"device_batches\": 0, \"fallback_batches\": 0, \"realigned\": "
-            "0, \"msa_dropped\": 0, \"wall_s\": %.3f, "
+            "0, \"msa_dropped\": %ld, \"wall_s\": %.3f, "
             "\"aligned_bases_per_s\": %.1f}\n",
             lines, alignments, skipped_bad, skipped_dedup, skipped_self,
-            aligned_bases, events, w, rate);
+            aligned_bases, events, msa_dropped, w, rate);
   }
 };
 
@@ -1001,16 +922,16 @@ int run(int argc, char** argv) {
   if (!cfg.skip_codan && !force_coding &&
       fsize > AUTO_FULLGENOME_FASTA_BYTES)
     cfg.skip_codan = true;
-  if (opts.vals.count("w") || opts.flags.count("w")) {
+  FILE* fmsa = nullptr;
+  if (opts.vals.count("w")) {
     if (cfg.fullgenome) {
       fprintf(stderr, "%s Error: can only generate MSA for -G mode!\n",
               USAGE);
       return 1;
     }
-    fprintf(stderr,
-            "Error: -w MSA output is handled by the Python CLI "
-            "(python -m pwasm_tpu.cli), not yet by the native binary.\n");
-    return 1;
+    fmsa = fopen(opts.get("w").c_str(), "wb");
+    if (!fmsa)
+      throw PwErr("Cannot open file " + opts.get("w") + " for writing!\n");
   }
   FILE* fsummary = nullptr;
   if (opts.vals.count("s")) {
@@ -1026,6 +947,79 @@ int run(int argc, char** argv) {
   std::unordered_map<std::string, std::string> ref_cache;
   std::string refseq_id, refseq, refseq_rc;
   bool have_ref = false;
+
+  // progressive MSA state (-w; cli.py msa_add / pafreport.cpp:394-421):
+  // one arena owns every GapSeq/Msa; Msas hold raw pointers into it
+  std::vector<std::unique_ptr<GapSeq>> seq_arena;
+  std::vector<std::unique_ptr<Msa>> msa_arena;
+  GapSeq* ref_gseq = nullptr;  // current query's MSA instance
+  Msa* ref_msa = nullptr;
+  long numalns = 0;
+
+  auto msa_add = [&](const Extraction& ex, const AlnInfo& al,
+                     const std::string& tlabel, long ord_num) {
+    seq_arena.push_back(std::make_unique<GapSeq>(
+        tlabel, ex.tseq, -1, al.r_alnstart, al.reverse));
+    GapSeq* taseq = seq_arena.back().get();
+    bool first_ref_aln = ref_gseq == nullptr;
+    GapSeq* rseq;
+    if (first_ref_aln) {
+      seq_arena.push_back(
+          std::make_unique<GapSeq>(al.r_id, refseq));
+      rseq = seq_arena.back().get();
+      rseq->set_flag(pwnative::FLAG_IS_REF);
+    } else {  // bare instance of refseq for this alignment
+      seq_arena.push_back(
+          std::make_unique<GapSeq>(al.r_id, "", al.r_len));
+      rseq = seq_arena.back().get();
+    }
+    // once a gap, always a gap: apply this alignment's gaps to fresh
+    // objects so an out-of-layout gap fails BEFORE any MSA mutation
+    // (skippable under --skip-bad-lines, cli.py msa_add)
+    try {
+      for (const auto& g : ex.gaps) {
+        if (g[0] == 0)
+          rseq->set_gap(g[1], g[2]);
+        else
+          taseq->set_gap(g[1], g[2]);
+      }
+    } catch (const PwErr&) {
+      if (!cfg.skip_bad_lines) throw;
+      ++stats.msa_dropped;
+      fprintf(stderr,
+              "Warning: excluding alignment %s from the MSA "
+              "(out-of-layout gap structure in the input)\n",
+              tlabel.c_str());
+      alnpairs.erase(al.r_id + "~" + al.t_id);
+      // nothing references the two objects just pushed (rseq last)
+      seq_arena.pop_back();
+      seq_arena.pop_back();
+      return;
+    }
+    if (first_ref_aln && seq_arena.size() > 2) {
+      // only the LAST query's MSA is ever written (cli.py keeps a
+      // single ref_msa and the Python GC frees the previous query's
+      // object graph at this point) — release everything except the
+      // two sequences of the new pairwise seed
+      std::unique_ptr<GapSeq> t = std::move(seq_arena[seq_arena.size() - 2]);
+      std::unique_ptr<GapSeq> r = std::move(seq_arena.back());
+      seq_arena.clear();
+      seq_arena.push_back(std::move(t));
+      seq_arena.push_back(std::move(r));
+      msa_arena.clear();
+      ref_msa = nullptr;
+    }
+    msa_arena.push_back(std::make_unique<Msa>(rseq, taseq));
+    Msa* newmsa = msa_arena.back().get();
+    if (first_ref_aln) {
+      newmsa->ordnum = ord_num;
+      ref_msa = newmsa;
+      ref_gseq = rseq;
+    } else {
+      ref_gseq->msa->add_align(ref_gseq, newmsa, rseq);
+      ref_msa = ref_gseq->msa;
+    }
+  };
 
   LineReader reader(inf);
   std::string line;
@@ -1068,6 +1062,7 @@ int run(int argc, char** argv) {
         continue;
       }
     }
+    ++numalns;
     if (refseq_id != al.r_id || !have_ref) {
       auto it = ref_cache.find(al.r_id);
       if (it != ref_cache.end()) {
@@ -1082,6 +1077,7 @@ int run(int argc, char** argv) {
       refseq_rc = revcomp(refseq);
       refseq_id = al.r_id;
       have_ref = true;
+      ref_gseq = nullptr;  // a new query starts a new MSA (cli.py)
     }
     if (al.r_len != (long)refseq.size())
       throw PwErr(sformat(
@@ -1094,6 +1090,7 @@ int run(int argc, char** argv) {
       ex = extract_alignment(rec, refseq_aln);
     } catch (const PwErr&) {
       if (!cfg.skip_bad_lines) throw;
+      --numalns;
       if (!new_pair.empty()) alnpairs.erase(new_pair);
       ++stats.skipped_bad;
       fprintf(stderr, "Warning: skipping malformed PAF line %ld\n",
@@ -1113,8 +1110,17 @@ int run(int argc, char** argv) {
     print_diff_info(freport, al, rec.alnscore, rec.edist, ex.evs, rlabel,
                     tlabel, refseq, cfg.skip_codan, cfg.motifs,
                     fsummary ? &summary : nullptr);
+    if (fmsa) msa_add(ex, al, tlabel, numalns);
   }
   if (inf != stdin) fclose(inf);
+  if (cfg.debug && ref_msa != nullptr) {
+    fprintf(stderr, ">MSA (%zu)\n", ref_msa->count());
+    ref_msa->print_layout(stderr, 'v');
+  }
+  if (fmsa != nullptr) {
+    if (ref_msa != nullptr) ref_msa->write_msa(fmsa);
+    fclose(fmsa);
+  }
   if (fsummary) {
     summary.write(fsummary);
     fclose(fsummary);

@@ -1,0 +1,314 @@
+// Native MSA engine for the pafreport binary's -w path: gapped-sequence
+// model + progressive pairwise->MSA merging with bidirectional gap
+// propagation + the offset-padded multifasta writer.
+//
+// C++ twin of pwasm_tpu/align/gapseq.py (GapSeq) and align/msa.py (Msa),
+// which are themselves the behavior spec of the reference's GASeq /
+// GSeqAlign (GapAssem.h:35-138,381-461; GapAssem.cpp:27-591,593-1046).
+// Byte parity of the .mfa output with the Python CLI is enforced by
+// tests/test_native_cli.py.  Only the -w surface lives here: set_gap,
+// inject_gap, add_align, rev_complement, finalize/prep_seq, print_mfasta,
+// print_gapped_seq (the -D debug layout).  The consensus/refinement path
+// (refine_msa, ACE/info writers) stays in the Python engine.
+#pragma once
+
+#include <algorithm>
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "pafreport_util.h"
+
+namespace pwnative {
+
+constexpr int FLAG_IS_REF = 0;
+constexpr int FLAG_PREPPED = 2;
+
+class Msa;
+
+// A sequence in an MSA layout: bases + per-base gap counts + offsets
+// (GASeq, GapAssem.h:35-138).  gaps[i] = gap columns BEFORE base i;
+// negative marks the base deleted (not used on the -w path).
+class GapSeq {
+ public:
+  std::string name;
+  std::string seq;      // may be empty for a bare layout instance
+  long seqlen = 0;
+  std::vector<int32_t> gaps;
+  long numgaps = 0;
+  long offset = 0, ng_ofs = 0;
+  int revcompl = 0;
+  int flags = 0;
+  Msa* msa = nullptr;
+
+  GapSeq(std::string name_, std::string seq_, long seqlen_ = -1,
+         long offset_ = 0, int revcompl_ = 0)
+      : name(std::move(name_)), seq(std::move(seq_)),
+        seqlen(seqlen_ < 0 ? (long)seq.size() : seqlen_),
+        gaps((size_t)(seqlen_ < 0 ? (long)seq.size() : seqlen_), 0),
+        offset(offset_), ng_ofs(offset_), revcompl(revcompl_) {}
+
+  void set_flag(int bit) { flags |= 1 << bit; }
+  bool has_flag(int bit) const { return (flags >> bit) & 1; }
+
+  long end_offset() const { return offset + seqlen + numgaps; }
+  long end_ng_offset() const { return ng_ofs + seqlen; }
+  int32_t gap(long pos) const { return gaps[(size_t)pos]; }
+
+  // (GapAssem.cpp:104-111; gapseq.py set_gap)
+  void set_gap(long pos, int32_t gaplen = 1) {
+    if (pos < 0 || pos >= seqlen)
+      throw PwErr(sformat(
+          "Error: invalid gap position (%ld) given for sequence %s\n",
+          pos + 1, name.c_str()));
+    numgaps -= gaps[(size_t)pos];
+    gaps[(size_t)pos] = gaplen;
+    numgaps += gaplen;
+  }
+
+  // (GapAssem.cpp:113-120)
+  void add_gap(long pos, int32_t gapadd) {
+    if (pos < 0 || pos >= seqlen)
+      throw PwErr(sformat(
+          "Error: invalid gap position (%ld) given for sequence %s\n",
+          pos + 1, name.c_str()));
+    numgaps += gapadd;
+    gaps[(size_t)pos] += gapadd;
+  }
+
+  // First position j whose walk coordinate passes alpos
+  // (the reference's per-member walk, GapAssem.cpp:739-744; the Python
+  // engine uses a prefix-sum + binary search over the same monotone
+  // positions — this linear walk computes the identical stopping point).
+  long find_walk_pos(long alpos) const {
+    long w = offset;
+    for (long j = 0; j < seqlen; ++j) {
+      w += 1 + gaps[(size_t)j];
+      if (w > alpos) return j;
+    }
+    return seqlen;
+  }
+
+  void reverse_complement_bases() { seq = revcomp(seq); }
+
+  // Reverse the gap array keeping index 0 fixed (GapAssem.cpp:351-364).
+  void reverse_gaps() {
+    if (seqlen > 1) std::reverse(gaps.begin() + 1, gaps.end());
+  }
+
+  void rev_complement(long alignlen = 0);  // needs Msa; defined below
+
+  // Apply deferred deletions then RC once (GASeq::prepSeq,
+  // GapAssem.cpp:89-101); the -w path has no delops.
+  void prep_seq() {
+    if (revcompl == 1) reverse_complement_bases();
+    set_flag(FLAG_PREPPED);
+  }
+
+  void check_loaded(const char* what) const {
+    if (seq.empty() || (long)seq.size() != seqlen)
+      throw PwErr(sformat(
+          "GapSeq %s Error: invalid sequence data '%s' (len=%zu, "
+          "seqlen=%ld)\n",
+          what, name.c_str(), seq.size(), seqlen));
+  }
+
+  // Offset-padded multifasta record (GASeq::printMFasta,
+  // GapAssem.cpp:482-520; gapseq.py print_mfasta).
+  void print_mfasta(FILE* f, int llen = 60) const {
+    check_loaded("print");
+    fprintf(f, ">%s\n", name.c_str());
+    std::string out;
+    int printed = 0;
+    auto put = [&](char ch) {
+      ++printed;
+      out.push_back(ch);
+      if (printed == llen) {
+        out.push_back('\n');
+        printed = 0;
+      }
+    };
+    for (long i = 0; i < offset; ++i) put('-');
+    for (long i = 0; i < seqlen; ++i) {
+      int32_t g = gaps[(size_t)i];
+      if (g < 0) continue;  // deleted base
+      for (int32_t k = 0; k < g; ++k) put('-');
+      put(seq[(size_t)i]);
+    }
+    if (printed < llen) out.push_back('\n');
+    fwrite(out.data(), 1, out.size(), f);
+  }
+
+  // Debug layout line with lowercase clips (GASeq::printGappedSeq,
+  // GapAssem.cpp:412-440).  The -w path never sets clips, so clp5/clp3
+  // are omitted from this engine and every base prints as stored.
+  void print_gapped_seq(FILE* f, long baseoffs = 0) const {
+    check_loaded("print");
+    std::string out((size_t)(offset - baseoffs), ' ');
+    for (long i = 0; i < seqlen; ++i) {
+      int32_t g = gaps[(size_t)i];
+      if (g < 0) continue;
+      out.append((size_t)g, '-');
+      out.push_back(seq[(size_t)i]);
+    }
+    out.push_back('\n');
+    fwrite(out.data(), 1, out.size(), f);
+  }
+};
+
+// A multiple sequence alignment (GSeqAlign, GapAssem.h:381-461).
+// Holds raw pointers; the CLI keeps ownership in one arena.
+class Msa {
+ public:
+  std::vector<GapSeq*> seqs;
+  long length = 0, minoffset = 0, ng_len = 0, ng_minofs = 0;
+  long ordnum = 0;
+
+  Msa() = default;
+  // pairwise seed (GapAssem.cpp:605-641)
+  Msa(GapSeq* s1, GapSeq* s2) {
+    s1->msa = this;
+    s2->msa = this;
+    seqs = {s1, s2};
+    minoffset = std::min(s1->offset, s2->offset);
+    ng_minofs = minoffset;
+    length = std::max(s1->end_offset(), s2->end_offset()) - minoffset;
+    ng_len = std::max(s1->end_ng_offset(), s2->end_ng_offset())
+             - ng_minofs;
+  }
+
+  size_t count() const { return seqs.size(); }
+
+  // (GSeqAlign::addSeq, GapAssem.cpp:694-716)
+  void add_seq(GapSeq* s, long soffs, long ngofs) {
+    s->offset = soffs;
+    s->ng_ofs = ngofs;
+    s->msa = this;
+    seqs.push_back(s);
+    if (soffs < minoffset) {
+      length += minoffset - soffs;
+      minoffset = soffs;
+    }
+    if (ngofs < ng_minofs) {
+      ng_len += ng_minofs - ngofs;
+      ng_minofs = ngofs;
+    }
+    if (s->end_offset() - minoffset > length)
+      length = s->end_offset() - minoffset;
+    if (s->end_ng_offset() - ng_minofs > ng_len)
+      ng_len = s->end_ng_offset() - ng_minofs;
+  }
+
+  // Layout position of seq[pos] (GapAssem.cpp:721-725)
+  long alpos_of(const GapSeq* seq, long pos) const {
+    long gsum = 0;
+    for (long j = 0; j <= pos; ++j) gsum += seq->gaps[(size_t)j];
+    return seq->offset + pos + gsum;
+  }
+
+  // Propagate a gap through every member (GSeqAlign::injectGap,
+  // GapAssem.cpp:720-753)
+  void inject_gap(GapSeq* seq, long pos, int32_t xgap) {
+    long alpos = alpos_of(seq, pos);
+    for (GapSeq* s : seqs) {
+      long spos;
+      if (s == seq) {
+        spos = pos;
+      } else {
+        if (s->offset >= alpos) {
+          s->offset += xgap;
+          continue;
+        }
+        spos = s->find_walk_pos(alpos);
+        if (spos >= s->seqlen) continue;
+      }
+      s->add_gap(spos, xgap);
+    }
+    length += xgap;
+  }
+
+  // Merge another MSA through the shared sequence (GSeqAlign::addAlign,
+  // GapAssem.cpp:645-690): RC on strand mismatch, bidirectional
+  // per-position gap diff, then absorb the other members.
+  void add_align(GapSeq* seq, Msa* omsa, GapSeq* oseq) {
+    if (seq->seqlen != oseq->seqlen)
+      throw PwErr(sformat(
+          "GSeqAlign Error: invalid merge %s(len %ld) vs %s(len %ld)\n",
+          seq->name.c_str(), seq->seqlen, oseq->name.c_str(),
+          oseq->seqlen));
+    if (seq->revcompl != oseq->revcompl) omsa->rev_complement();
+    for (long i = 0; i < seq->seqlen; ++i) {
+      int32_t d = seq->gap(i) - oseq->gap(i);
+      if (d > 0)
+        omsa->inject_gap(oseq, i, d);
+      else if (d < 0)
+        inject_gap(seq, i, -d);
+    }
+    for (GapSeq* s : omsa->seqs) {
+      if (s == oseq) continue;
+      add_seq(s, seq->offset + s->offset - oseq->offset,
+              seq->ng_ofs + s->ng_ofs - oseq->ng_ofs);
+    }
+  }
+
+  // (GSeqAlign::revComplement, GapAssem.cpp:998-1004)
+  void rev_complement() {
+    for (GapSeq* s : seqs) s->rev_complement(length);
+    std::stable_sort(seqs.begin(), seqs.end(),
+                     [](const GapSeq* a, const GapSeq* b) {
+                       return a->offset < b->offset;
+                     });
+  }
+
+  // (GSeqAlign::finalize, GapAssem.cpp:1006-1012)
+  void finalize() {
+    for (GapSeq* s : seqs) {
+      if (s->seq.empty())
+        throw PwErr(sformat("Error: sequence for %s not loaded!\n",
+                            s->name.c_str()));
+      if (!s->has_flag(FLAG_PREPPED)) s->prep_seq();
+    }
+  }
+
+  // (GSeqAlign::writeMSA, GapAssem.cpp:1039-1046)
+  void write_msa(FILE* f, int linelen = 60) {
+    finalize();
+    for (GapSeq* s : seqs) s->print_mfasta(f, linelen);
+  }
+
+  // Debug layout view (GSeqAlign::print, GapAssem.cpp:1013-1037)
+  void print_layout(FILE* f, char sep = '\0') {
+    finalize();
+    size_t width = 0;
+    for (GapSeq* s : seqs) width = std::max(width, s->name.size());
+    if (sep) {
+      fprintf(f, "%*s   ", (int)width, "");
+      for (long i = 0; i < length; ++i) fputc(sep, f);
+      fputc('\n', f);
+    }
+    for (GapSeq* s : seqs) {
+      fprintf(f, "%*s %c ", (int)width, s->name.c_str(),
+              s->revcompl == 1 ? '-' : '+');
+      s->print_gapped_seq(f, minoffset);
+    }
+  }
+};
+
+// GASeq::revComplement within a layout (GapAssem.cpp:366-392) — defined
+// after Msa because it reads the owning MSA's layout fields.
+inline void GapSeq::rev_complement(long alignlen) {
+  if (alignlen > 0) {
+    offset = alignlen - end_offset();
+    if (msa != nullptr) {
+      ng_ofs = msa->ng_len - end_ng_offset();
+      if (msa->minoffset > offset) msa->minoffset = offset;
+      if (msa->ng_minofs > ng_ofs) msa->ng_minofs = ng_ofs;
+    }
+  }
+  revcompl = revcompl ? 0 : 1;
+  if ((long)seq.size() == seqlen) reverse_complement_bases();
+  reverse_gaps();
+}
+
+}  // namespace pwnative

@@ -89,12 +89,17 @@ def _write_inputs(tmp_path, lines, records):
 
 
 def _rand_ops(rng, q_aln):
-    ops = []
-    pos = 0
+    # real minimap2 alignments are anchored on matches, so the first and
+    # last ops are always match runs — an indel at the very edge would
+    # also put a gap outside the MSA layout (GapAssem.cpp:105-107),
+    # which is a separate, deliberate test case
     n = len(q_aln)
-    while pos < n:
+    first = rng.randint(1, min(n, 40))
+    ops = [("=", first)]
+    pos = first
+    while pos < n - 1:
         r = rng.random()
-        left = n - pos
+        left = n - 1 - pos   # reserve one base for the final anchor
         if r < 0.55:
             k = rng.randint(1, min(left, 80))
             ops.append(("=", k))
@@ -111,6 +116,7 @@ def _rand_ops(rng, q_aln):
             k = rng.randint(1, min(left, 10))
             ops.append(("del", k))
             pos += k
+    ops.append(("=", n - pos))
     return ops
 
 
@@ -415,14 +421,109 @@ def test_parity_device_values(tmp_path):
     _assert_parity(tmp_path, [paf, "-r", fa, "--device=cpu"])
 
 
+def _assert_msa_parity(tmp_path, lines, records, extra=None):
+    paf, fa = _write_inputs(tmp_path, lines, records)
+    args = [paf, "-r", fa] + (extra or [])
+    py_m, na_m = tmp_path / "p.mfa", tmp_path / "n.mfa"
+    rc_p, _, err_p = _run_py(args + ["-o", str(tmp_path / "p.dfa"),
+                                     "-w", str(py_m)])
+    rc_n, _, err_n = _run_native(args + ["-o", str(tmp_path / "n.dfa"),
+                                         "-w", str(na_m)])
+    assert (rc_n, err_n) == (rc_p, err_p)
+    if py_m.exists() or na_m.exists():
+        assert na_m.read_bytes() == py_m.read_bytes()
+    assert (tmp_path / "n.dfa").read_bytes() == \
+        (tmp_path / "p.dfa").read_bytes()
+    return py_m.read_bytes() if py_m.exists() else b""
+
+
+def test_parity_msa_randomized(tmp_path):
+    rng = random.Random(20260731)
+    q = "".join(rng.choice("ACGT") for _ in range(800))
+    lines = _rand_lines(rng, "g", q, 16)
+    mfa = _assert_msa_parity(tmp_path, lines, [("g", q.encode())])
+    assert mfa.count(b">") == 17  # query + every alignment
+
+
+def test_parity_msa_debug_layout(tmp_path):
+    rng = random.Random(61)
+    q = "".join(rng.choice("ACGT") for _ in range(200))
+    lines = _rand_lines(rng, "g", q, 4)
+    paf, fa = _write_inputs(tmp_path, lines, [("g", q.encode())])
+    rc_p, _, err_p = _run_py(
+        [paf, "-r", fa, "-D", "-o", str(tmp_path / "p.dfa"),
+         "-w", str(tmp_path / "p.mfa")])
+    rc_n, _, err_n = _run_native(
+        [paf, "-r", fa, "-D", "-o", str(tmp_path / "n.dfa"),
+         "-w", str(tmp_path / "n.mfa")])
+    assert rc_n == rc_p == 0
+    # the -D layout dump goes to stderr on both sides; the native -v
+    # brief has wall-clock in it, so compare the layout block only
+    assert ">MSA (5)" in err_n
+    # drop the -v stats brief (embeds wall time) before comparing
+    p_block = [l for l in err_p[err_p.index(">MSA"):].splitlines()
+               if "bases/s" not in l]
+    n_block = [l for l in err_n[err_n.index(">MSA"):].splitlines()
+               if "bases/s" not in l]
+    assert n_block == p_block
+
+
+def test_parity_msa_out_of_layout_gap(tmp_path):
+    # a reverse-strand alignment starting with an insertion event puts a
+    # ref gap at position r_len — fatal at MSA insertion, skippable
+    # under --skip-bad-lines (cli.py msa_add; GapAssem.cpp:105-107)
+    rng = random.Random(67)
+    q = "".join(rng.choice("ACGT") for _ in range(120))
+    bad, _ = make_paf_line("g", q, "tbad", "-",
+                           [("ins", "cc"), ("=", 120)])
+    good, _ = make_paf_line("g", q, "tok", "+", [("=", 120)])
+    records = [("g", q.encode())]
+    # without skip: both fail with the same message and exit code
+    paf, fa = _write_inputs(tmp_path, [bad], records)
+    rc_p, _, err_p = _run_py([paf, "-r", fa, "-o",
+                              str(tmp_path / "p.dfa"),
+                              "-w", str(tmp_path / "p.mfa")])
+    rc_n, _, err_n = _run_native([paf, "-r", fa, "-o",
+                                  str(tmp_path / "n.dfa"),
+                                  "-w", str(tmp_path / "n.mfa")])
+    assert (rc_n, err_n) == (rc_p, err_p)
+    assert rc_p == 1 and "invalid gap position" in err_n
+    # with skip: dropped from the MSA with the same warning, and the
+    # dedup slot frees so a later valid alignment of the pair lands
+    bad2 = bad.replace("\ttbad\t", "\ttok\t")
+    mfa = _assert_msa_parity(tmp_path, [bad2, good], records,
+                             extra=["--skip-bad-lines"])
+    assert mfa.count(b">tok") == 1
+    stats = tmp_path / "st.json"
+    paf, fa = _write_inputs(tmp_path, [bad2, good], records)
+    rc, _, _ = _run_native([paf, "-r", fa, "--skip-bad-lines",
+                            "-o", str(tmp_path / "r.dfa"),
+                            "-w", str(tmp_path / "m.mfa"),
+                            f"--stats={stats}"])
+    assert rc == 0
+    assert json.loads(stats.read_text())["msa_dropped"] == 1
+
+
+def test_parity_msa_multi_query_writes_last(tmp_path):
+    # cli.py writes the LAST query's MSA when the PAF spans several
+    # queries; the native binary must mirror that exactly
+    rng = random.Random(71)
+    q1 = "".join(rng.choice("ACGT") for _ in range(150))
+    q2 = "".join(rng.choice("ACGT") for _ in range(180))
+    lines = (_rand_lines(rng, "gA", q1, 2)
+             + _rand_lines(rng, "gB", q2, 2))
+    mfa = _assert_msa_parity(tmp_path, lines,
+                             [("gA", q1.encode()), ("gB", q2.encode())])
+    assert b">gB\n" in mfa and b">gA\n" not in mfa
+
+
 def test_native_rejects_python_only_features(tmp_path):
     rng = random.Random(41)
     q = "".join(rng.choice("ACGT") for _ in range(100))
     lines = _rand_lines(rng, "g", q, 1)
     paf, fa = _write_inputs(tmp_path, lines, [("g", q.encode())])
     for extra in (["--device=tpu"], ["--realign"], ["--shard"],
-                  ["--resume"], ["--ace=" + str(tmp_path / "a")],
-                  ["-w", str(tmp_path / "m")]):
+                  ["--resume"], ["--ace=" + str(tmp_path / "a")]):
         rc, _, err = _run_native([paf, "-r", fa] + extra)
         assert rc == 1
-        assert "Python CLI" in err or "MSA" in err
+        assert "Python CLI" in err
