@@ -812,13 +812,65 @@ struct RunStats {
 
 struct Cfg {
   bool debug = false, verbose = false, fullgenome = false, gene_cds = false,
-       skip_codan = false, skip_bad_lines = false;
+       skip_codan = false, skip_bad_lines = false,
+       remove_cons_gaps = false, refine_clip = true;
   double clipmax = 0.0;
   std::vector<std::string> motifs = {"CCTGG", "CCAGG", "GATC", "GTAC"};
 };
 
+// Hidden test hook: exercise the X-drop clip refinement with nonzero
+// clips (unreachable from the CLI flow, where nothing sets clp5/clp3 —
+// clipmax is parsed but evalClipping is never called, mirroring the
+// reference).  Input: first line the consensus; then one line per case,
+// tab-separated: name, revcompl, clp5, clp3, cpos, skip_dels,
+// comma-joined gaps, bases.  Output: name\tclp5\tclp3 after refinement.
+// tests/test_native_cli.py fuzzes this against the Python engine's
+// transliterated reference walk (gapseq.py refine_clipping_scalar).
+int run_refine_selftest(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) throw PwErr("Cannot open input file " + path + "!\n");
+  LineReader reader(f);
+  std::string cons;
+  if (!reader.next(cons)) {
+    fclose(f);
+    throw PwErr("refine-selftest: empty input\n");
+  }
+  std::string line;
+  while (reader.next(line)) {
+    if (line.empty()) continue;
+    std::vector<std::string> fields = split_tabs(line);
+    if (fields.size() != 8)
+      throw PwErr("refine-selftest: bad case line\n");
+    GapSeq s(fields[0], fields[7]);
+    s.revcompl = (int)atol(fields[1].c_str());
+    s.clp5 = atol(fields[2].c_str());
+    s.clp3 = atol(fields[3].c_str());
+    long cpos = atol(fields[4].c_str());
+    bool skip_dels = atol(fields[5].c_str()) != 0;
+    size_t start = 0, gi = 0;
+    const std::string& gs = fields[6];
+    while (start <= gs.size() && gi < s.gaps.size()) {
+      size_t comma = gs.find(',', start);
+      std::string tok = gs.substr(
+          start, comma == std::string::npos ? std::string::npos
+                                            : comma - start);
+      s.gaps[gi] = (int32_t)atol(tok.c_str());
+      s.numgaps += s.gaps[gi];
+      ++gi;
+      if (comma == std::string::npos) break;
+      start = comma + 1;
+    }
+    s.refine_clipping(cons, cpos, skip_dels);
+    printf("%s\t%ld\t%ld\n", s.name.c_str(), s.clp5, s.clp3);
+  }
+  fclose(f);
+  return 0;
+}
+
 int run(int argc, char** argv) {
   Opts opts = parse_args(argc, argv);
+  if (opts.vals.count("refine-selftest"))
+    return run_refine_selftest(opts.get("refine-selftest"));
   if (opts.has("h")) {
     fprintf(stderr, "%s\n", USAGE);
     return 1;
@@ -856,9 +908,7 @@ int run(int argc, char** argv) {
     return 1;
   }
   // Python-CLI-only features: fail clearly rather than silently ignore
-  for (const char* k : {"realign", "shard", "profile", "resume", "ace",
-                        "info", "cons", "remove-cons-gaps",
-                        "no-refine-clip"}) {
+  for (const char* k : {"realign", "shard", "profile", "resume"}) {
     if (opts.has(k)) {
       fprintf(stderr,
               "Error: --%s is handled by the Python CLI "
@@ -923,16 +973,41 @@ int run(int argc, char** argv) {
       fsize > AUTO_FULLGENOME_FASTA_BYTES)
     cfg.skip_codan = true;
   FILE* fmsa = nullptr;
-  if (opts.vals.count("w")) {
+  std::unordered_map<std::string, FILE*> cons_outs;  // ace/info/cons
+  const char* cons_kinds[] = {"ace", "info", "cons"};
+  bool any_cons = false;
+  for (const char* kind : cons_kinds)
+    if (opts.has(kind)) any_cons = true;
+  if (opts.vals.count("w") || any_cons) {
     if (cfg.fullgenome) {
       fprintf(stderr, "%s Error: can only generate MSA for -G mode!\n",
               USAGE);
       return 1;
     }
-    fmsa = fopen(opts.get("w").c_str(), "wb");
-    if (!fmsa)
-      throw PwErr("Cannot open file " + opts.get("w") + " for writing!\n");
+    if (opts.vals.count("w")) {
+      fmsa = fopen(opts.get("w").c_str(), "wb");
+      if (!fmsa)
+        throw PwErr("Cannot open file " + opts.get("w") +
+                    " for writing!\n");
+    }
+    for (const char* kind : cons_kinds) {
+      if (opts.is_bool(kind)) {
+        fprintf(stderr, "%s\n--%s requires a file argument\n", USAGE,
+                kind);
+        return 1;
+      }
+    }
+    for (const char* kind : cons_kinds) {
+      if (!opts.vals.count(kind)) continue;
+      FILE* f = fopen(opts.get(kind).c_str(), "wb");
+      if (!f)
+        throw PwErr("Cannot open file " + opts.get(kind) +
+                    " for writing!\n");
+      cons_outs[kind] = f;
+    }
   }
+  cfg.remove_cons_gaps = opts.has("remove-cons-gaps");
+  cfg.refine_clip = !opts.has("no-refine-clip");
   FILE* fsummary = nullptr;
   if (opts.vals.count("s")) {
     fsummary = fopen(opts.get("s").c_str(), "wb");
@@ -1110,7 +1185,7 @@ int run(int argc, char** argv) {
     print_diff_info(freport, al, rec.alnscore, rec.edist, ex.evs, rlabel,
                     tlabel, refseq, cfg.skip_codan, cfg.motifs,
                     fsummary ? &summary : nullptr);
-    if (fmsa) msa_add(ex, al, tlabel, numalns);
+    if (fmsa || !cons_outs.empty()) msa_add(ex, al, tlabel, numalns);
   }
   if (inf != stdin) fclose(inf);
   if (cfg.debug && ref_msa != nullptr) {
@@ -1121,6 +1196,22 @@ int run(int argc, char** argv) {
     if (ref_msa != nullptr) ref_msa->write_msa(fmsa);
     fclose(fmsa);
   }
+  if (!cons_outs.empty() && ref_msa != nullptr) {
+    // consensus path (the library capability pafreport never calls,
+    // SURVEY.md §2.3): refine once, then emit the requested formats —
+    // write_msa above already captured the unrefined layout (cli.py)
+    ref_msa->finalize();
+    ref_msa->refine_msa(cfg.remove_cons_gaps, cfg.refine_clip);
+    std::string contig =
+        ref_msa->seqs.empty() ? "contig" : ref_msa->seqs[0]->name;
+    if (cons_outs.count("ace"))
+      ref_msa->write_ace(cons_outs["ace"], contig);
+    if (cons_outs.count("info"))
+      ref_msa->write_info(cons_outs["info"], contig);
+    if (cons_outs.count("cons"))
+      ref_msa->write_cons(cons_outs["cons"], contig);
+  }
+  for (auto& kv : cons_outs) fclose(kv.second);
   if (fsummary) {
     summary.write(fsummary);
     fclose(fsummary);

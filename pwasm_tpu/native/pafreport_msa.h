@@ -1,19 +1,20 @@
-// Native MSA engine for the pafreport binary's -w path: gapped-sequence
-// model + progressive pairwise->MSA merging with bidirectional gap
-// propagation + the offset-padded multifasta writer.
+// Native MSA engine for the pafreport binary: gapped-sequence model +
+// progressive pairwise->MSA merging with bidirectional gap propagation,
+// the offset-padded multifasta writer (-w), and the consensus path —
+// column pileup counts, the bestChar vote with its '-'/'N'-yield
+// tie-break, consensus-gap column removal, X-drop clip refinement, and
+// the ACE / contig-info / consensus-FASTA writers (--ace/--info/--cons).
 //
 // C++ twin of pwasm_tpu/align/gapseq.py (GapSeq) and align/msa.py (Msa),
 // which are themselves the behavior spec of the reference's GASeq /
-// GSeqAlign (GapAssem.h:35-138,381-461; GapAssem.cpp:27-591,593-1046).
-// Byte parity of the .mfa output with the Python CLI is enforced by
-// tests/test_native_cli.py.  Only the -w surface lives here: set_gap,
-// inject_gap, add_align, rev_complement, finalize/prep_seq, print_mfasta,
-// print_gapped_seq (the -D debug layout).  The consensus/refinement path
-// (refine_msa, ACE/info writers) stays in the Python engine.
+// GSeqAlign / MSAColumns / GAlnColumn (GapAssem.h:35-461;
+// GapAssem.cpp:27-1367).  Byte parity of every output with the Python
+// CLI is enforced by tests/test_native_cli.py.
 #pragma once
 
 #include <algorithm>
 #include <cstdint>
+#include <limits>
 #include <memory>
 #include <string>
 #include <vector>
@@ -24,8 +25,37 @@ namespace pwnative {
 
 constexpr int FLAG_IS_REF = 0;
 constexpr int FLAG_PREPPED = 2;
+constexpr int FLAG_BAD_ALN = 7;
 
 class Msa;
+
+// The consensus vote for one column's A,C,G,T,N,- counts: bestChar's
+// stable-sort + '-'/'N'-yield tie-break in closed form (GapAssem.cpp:
+// 1048-1069, quirk SURVEY.md §2.5.10; msa.py best_char_from_counts).
+inline int best_char_from_counts(const int32_t c[6], int32_t layers) {
+  if (layers == 0) return 0;
+  int32_t m = c[0];
+  for (int k = 1; k < 6; ++k)
+    if (c[k] > m) m = c[k];
+  static const char nuc[4] = {'A', 'C', 'G', 'T'};
+  for (int k = 0; k < 4; ++k)
+    if (c[k] == m) return nuc[k];
+  if (c[4] == m && c[5] == m) return '-';
+  return c[4] == m ? 'N' : '-';
+}
+
+// Column bucket of one base char: A0 C1 G2 T3, N for everything else,
+// '-'/'*' 5 (msa.py _BUCKET).
+inline int column_bucket(unsigned char ch) {
+  switch (ch) {
+    case 'A': case 'a': return 0;
+    case 'C': case 'c': return 1;
+    case 'G': case 'g': return 2;
+    case 'T': case 't': return 3;
+    case '-': case '*': return 5;
+    default: return 4;
+  }
+}
 
 // A sequence in an MSA layout: bases + per-base gap counts + offsets
 // (GASeq, GapAssem.h:35-138).  gaps[i] = gap columns BEFORE base i;
@@ -40,6 +70,8 @@ class GapSeq {
   long offset = 0, ng_ofs = 0;
   int revcompl = 0;
   int flags = 0;
+  long clp5 = 0, clp3 = 0;
+  int msaidx = -1;
   Msa* msa = nullptr;
 
   GapSeq(std::string name_, std::string seq_, long seqlen_ = -1,
@@ -100,10 +132,212 @@ class GapSeq {
   void rev_complement(long alignlen = 0);  // needs Msa; defined below
 
   // Apply deferred deletions then RC once (GASeq::prepSeq,
-  // GapAssem.cpp:89-101); the -w path has no delops.
+  // GapAssem.cpp:89-101); the CLI flow has no delops.
   void prep_seq() {
     if (revcompl == 1) reverse_complement_bases();
     set_flag(FLAG_PREPPED);
+  }
+
+  // Remove one layout column at pos: a gap if one exists, else the base
+  // itself — the gap count may go negative = deleted base
+  // (GapAssem.cpp:122-180; gapseq.py remove_base).
+  void remove_base(long pos) {
+    if (pos < 0 || pos >= seqlen)
+      throw PwErr(sformat(
+          "Error: invalid gap position (%ld) given for sequence %s\n",
+          pos + 1, name.c_str()));
+    gaps[(size_t)pos] -= 1;
+    numgaps -= 1;
+  }
+
+  // (clipL, clipR) in layout orientation — strand-aware aliasing of
+  // clp5/clp3 (GapAssem.cpp:188-189).
+  void clip_lr(long& l, long& r) const {
+    if (revcompl != 0) {
+      l = clp3;
+      r = clp5;
+    } else {
+      l = clp5;
+      r = clp3;
+    }
+  }
+
+  // Zero gaps inside the clipped ends, fixing the offset
+  // (GapAssem.cpp:522-549; gapseq.py remove_clip_gaps).
+  long remove_clip_gaps() {
+    long clipL, clipR;
+    clip_lr(clipL, clipR);
+    long delgaps_l = 0, delgaps_r = 0;
+    for (long i = 0; i < seqlen; ++i) {
+      if (i <= clipL) {
+        delgaps_l += gaps[(size_t)i];
+        gaps[(size_t)i] = 0;
+        continue;
+      }
+      if (i >= seqlen - clipR) {
+        delgaps_r += gaps[(size_t)i];
+        gaps[(size_t)i] = 0;
+      }
+    }
+    offset += delgaps_l;
+    numgaps -= delgaps_l + delgaps_r;
+    return delgaps_l + delgaps_r;
+  }
+
+  // X-drop end re-alignment against the consensus, updating clp5/clp3
+  // (GASeq::refineClipping, GapAssem.cpp:182-349) — a direct port of
+  // the reference walk (the same program as the Python engine's
+  // transliterated oracle, gapseq.py refine_clipping_scalar).
+  static constexpr int XDROP = -16, MATCH_SC = 1, MISMATCH_SC = -3;
+
+  void refine_clipping(const std::string& cons, long cpos,
+                       bool skip_dels = false) {
+    if (clp3 == 0 && clp5 == 0) return;
+    long cons_len = (long)cons.size();
+    bool rev = revcompl != 0;
+    long clipL, clipR;
+    clip_lr(clipL, clipR);
+    long glen = seqlen + numgaps;
+    long allocsize = glen;
+    long gclipR = clipR, gclipL = clipL;
+    if (skip_dels) {
+      for (long i = 1; i <= clipR; ++i) {
+        if (gaps[(size_t)(seqlen - i)] < 0)
+          ++allocsize;
+        else
+          gclipR += gaps[(size_t)(seqlen - i)];
+      }
+      for (long i = 0; i < clipL; ++i) {
+        if (gaps[(size_t)i] < 0)
+          ++allocsize;
+        else
+          gclipL += gaps[(size_t)i];
+      }
+    } else {
+      for (long i = 1; i <= clipR; ++i) gclipR += gaps[(size_t)(seqlen - i)];
+      for (long i = 0; i < clipL; ++i) gclipL += gaps[(size_t)i];
+    }
+    std::string gseq;
+    std::vector<long> gxpos;
+    for (long i = 0; i < seqlen; ++i) {
+      int32_t g = gaps[(size_t)i];
+      if (g < 0) {
+        if (!skip_dels) continue;
+        if (clipL <= i && i < seqlen - clipR) continue;
+        ++glen;
+      }
+      for (int32_t k = 0; k < g; ++k) {
+        gseq.push_back('*');
+        gxpos.push_back(-1);
+      }
+      gseq.push_back(seq[(size_t)i]);
+      gxpos.push_back(i);
+    }
+    if (glen != allocsize)
+      throw PwErr(sformat(
+          "Length mismatch (allocsize %ld vs. glen %ld) while "
+          "refineClipping for seq %s !\n",
+          allocsize, glen, name.c_str()));
+    auto write_back = [&]() {
+      // clipL/clipR are aliases of clp5/clp3 in the reference, so every
+      // increment persists even on the early-warning returns
+      if (rev) {
+        clp3 = clipL;
+        clp5 = clipR;
+      } else {
+        clp5 = clipL;
+        clp3 = clipR;
+      }
+    };
+    auto at = [&](long sp) -> int {
+      return sp >= 0 && sp < (long)gseq.size()
+                 ? (unsigned char)gseq[(size_t)sp] : -1;
+    };
+    if (clipR > 0) {
+      long cp = cpos + glen - gclipR - 1;
+      long sp = glen - gclipR - 1;
+      bool ok = true;
+      while (sp < 0 || cp < 0 || cp >= cons_len ||
+             at(sp) != (unsigned char)cons[(size_t)cp] || at(sp) == '*') {
+        if (sp >= 0 && at(sp) != '*') ++clipR;
+        --sp;
+        --cp;
+        if (sp < gclipL) {
+          fprintf(stderr,
+                  "Warning: reached clipL trying to find an initial "
+                  "match on %s!\n",
+                  name.c_str());
+          ok = false;
+          break;
+        }
+      }
+      if (!ok) {
+        write_back();
+        return;
+      }
+      long score = MATCH_SC, maxscore = MATCH_SC;
+      long startpos = sp, bestpos = sp;
+      while (score > XDROP) {
+        ++cp;
+        ++sp;
+        if (cp >= cons_len || sp >= glen) break;
+        if (at(sp) == (unsigned char)cons[(size_t)cp]) {
+          if (at(sp) != '*') {
+            score += MATCH_SC;
+            if (score > maxscore) {
+              bestpos = sp;
+              maxscore = score;
+            }
+          }
+        } else if (at(sp) != '*') {
+          score += MISMATCH_SC;
+        }
+      }
+      if (bestpos > startpos) clipR = seqlen - gxpos[(size_t)bestpos] - 1;
+    }
+    if (clipL > 0) {
+      long cp = cpos + gclipL;
+      long sp = gclipL;
+      bool ok = true;
+      while (sp >= glen || cp >= cons_len || cp < 0 ||
+             at(sp) != (unsigned char)cons[(size_t)cp] || at(sp) == '*') {
+        if (sp < glen && at(sp) != '*') ++clipL;
+        ++sp;
+        ++cp;
+        if (sp >= glen - gclipR) {
+          fprintf(stderr,
+                  "Warning: reached clipR trying to find an initial "
+                  "match on %s!\n",
+                  name.c_str());
+          ok = false;
+          break;
+        }
+      }
+      if (!ok) {
+        write_back();
+        return;
+      }
+      long score = MATCH_SC, maxscore = MATCH_SC;
+      long startpos = sp, bestpos = sp;
+      while (score > XDROP) {
+        --cp;
+        --sp;
+        if (cp < 0 || sp < 0) break;
+        if (at(sp) == (unsigned char)cons[(size_t)cp]) {
+          if (at(sp) != '*') {
+            score += MATCH_SC;
+            if (score > maxscore) {
+              bestpos = sp;
+              maxscore = score;
+            }
+          }
+        } else if (at(sp) != '*') {
+          score += MISMATCH_SC;
+        }
+      }
+      if (bestpos < startpos) clipL = gxpos[(size_t)bestpos];
+    }
+    write_back();
   }
 
   void check_loaded(const char* what) const {
@@ -141,19 +375,69 @@ class GapSeq {
   }
 
   // Debug layout line with lowercase clips (GASeq::printGappedSeq,
-  // GapAssem.cpp:412-440).  The -w path never sets clips, so clp5/clp3
-  // are omitted from this engine and every base prints as stored.
+  // GapAssem.cpp:412-440).
   void print_gapped_seq(FILE* f, long baseoffs = 0) const {
     check_loaded("print");
+    long clipL, clipR;
+    clip_lr(clipL, clipR);
     std::string out((size_t)(offset - baseoffs), ' ');
     for (long i = 0; i < seqlen; ++i) {
       int32_t g = gaps[(size_t)i];
       if (g < 0) continue;
       out.append((size_t)g, '-');
-      out.push_back(seq[(size_t)i]);
+      char c = seq[(size_t)i];
+      if (i < clipL || i >= seqlen - clipR)
+        c = (char)tolower((unsigned char)c);
+      out.push_back(c);
     }
     out.push_back('\n');
     fwrite(out.data(), 1, out.size(), f);
+  }
+
+  // ACE-style gapped sequence, '*' gaps, 60-col wrap; the exact-multiple
+  // trailing blank line is preserved (GASeq::printGappedFasta,
+  // GapAssem.cpp:442-480; gapseq.py print_gapped_fasta).
+  void print_gapped_fasta(FILE* f) const {
+    check_loaded("print");
+    std::string out;
+    int printed = 0;
+    for (long i = 0; i < seqlen; ++i) {
+      int32_t g = gaps[(size_t)i];
+      if (g < 0) continue;
+      for (int32_t k = 0; k < g; ++k) {
+        out.push_back('*');
+        if (++printed == 60) {
+          out.push_back('\n');
+          printed = 0;
+        }
+      }
+      ++printed;
+      out.push_back(seq[(size_t)i]);
+      if (printed == 60) {
+        out.push_back('\n');
+        printed = 0;
+      }
+    }
+    if (printed < 60) out.push_back('\n');
+    fwrite(out.data(), 1, out.size(), f);
+  }
+};
+
+// Column pileup: (size, 6) counts + live [mincol, maxcol] window
+// (MSAColumns/GAlnColumn, GapAssem.h:255-376; msa.py MsaColumns).
+struct MsaColumns {
+  long size = 0, baseoffset = 0;
+  std::vector<int32_t> counts;  // size x 6
+  std::vector<int32_t> layers;
+  long mincol = std::numeric_limits<long>::max(), maxcol = 0;
+
+  MsaColumns(long size_, long baseoffset_)
+      : size(size_), baseoffset(baseoffset_),
+        counts((size_t)size_ * 6, 0), layers((size_t)size_, 0) {}
+
+  void update_min_max(long minc, long maxc) {
+    if (minc < mincol) mincol = minc;
+    if (maxc > maxcol) maxcol = maxc;
   }
 };
 
@@ -163,7 +447,10 @@ class Msa {
  public:
   std::vector<GapSeq*> seqs;
   long length = 0, minoffset = 0, ng_len = 0, ng_minofs = 0;
-  long ordnum = 0;
+  long ordnum = 0, badseqs = 0;
+  std::string consensus;
+  std::unique_ptr<MsaColumns> msacolumns;
+  bool refined = false;
 
   Msa() = default;
   // pairwise seed (GapAssem.cpp:605-641)
@@ -205,6 +492,22 @@ class Msa {
     long gsum = 0;
     for (long j = 0; j <= pos; ++j) gsum += seq->gaps[(size_t)j];
     return seq->offset + pos + gsum;
+  }
+
+  // Delete one layout column from every member
+  // (GSeqAlign::removeColumn, GapAssem.cpp:755-779)
+  void remove_column(long column) {
+    long alpos = column + minoffset;
+    for (GapSeq* s : seqs) {
+      if (s->offset >= alpos) {
+        s->offset -= 1;
+        continue;
+      }
+      long spos = s->find_walk_pos(alpos);
+      if (spos >= s->seqlen) continue;
+      s->remove_base(spos);
+    }
+    length -= 1;
   }
 
   // Propagate a gap through every member (GSeqAlign::injectGap,
@@ -275,6 +578,236 @@ class Msa {
   void write_msa(FILE* f, int linelen = 60) {
     finalize();
     for (GapSeq* s : seqs) s->print_mfasta(f, linelen);
+  }
+
+  // ---- consensus path (GSeqAlign::buildMSA/refineMSA + writers,
+  // GapAssem.cpp:1048-1367; msa.py build_msa/refine_msa/write_*) ------
+
+  // Pour one sequence into the column pileup (GASeq::toMSA,
+  // GapAssem.cpp:551-591; msa.py _seq_to_columns).
+  void seq_to_columns(const GapSeq* s, MsaColumns& cols) const {
+    if (s->seq.empty() || (long)s->seq.size() != s->seqlen)
+      throw PwErr(sformat(
+          "GapSeq toMSA Error: invalid sequence data '%s' (len=%zu, "
+          "seqlen=%ld)\n",
+          s->name.c_str(), s->seq.size(), s->seqlen));
+    long clipL, clipR;
+    s->clip_lr(clipL, clipR);
+    // base i sits at offset - minoffset + i + inclusive-cumsum(gaps);
+    // start one left so the += (1 + g) walk lands exactly there
+    long col = s->offset - minoffset - 1;
+    long first_col = -1, last_col = -1;
+    int32_t first_gap = 0;
+    for (long i = 0; i < s->seqlen; ++i) {
+      int32_t g = s->gaps[(size_t)i];
+      col += 1 + g;  // base i sits at `col` (inclusive-cumsum layout)
+      bool unclipped = !(i < clipL || i >= s->seqlen - clipR);
+      if (!unclipped) continue;
+      cols.counts[(size_t)col * 6 + column_bucket(
+          (unsigned char)s->seq[(size_t)i])]++;
+      cols.layers[(size_t)col]++;
+      for (int32_t k = 1; k <= g; ++k) {  // gap run before the base
+        cols.counts[(size_t)(col - k) * 6 + 5]++;
+        cols.layers[(size_t)(col - k)]++;
+      }
+      if (first_col < 0) {
+        first_col = col;
+        first_gap = g > 0 ? g : 0;
+      }
+      last_col = col;
+    }
+    if (first_col >= 0)
+      cols.update_min_max(first_col - first_gap, last_col);
+  }
+
+  // (GSeqAlign::buildMSA, GapAssem.cpp:1088-1106)
+  void build_msa() {
+    if (msacolumns)
+      throw PwErr("Error: cannot call buildMSA() twice!\n");
+    msacolumns = std::make_unique<MsaColumns>(length, minoffset);
+    for (size_t i = 0; i < seqs.size(); ++i) {
+      GapSeq* s = seqs[i];
+      s->msaidx = (int)i;
+      if (s->seqlen - s->clp3 - s->clp5 < 1) {
+        fprintf(stderr,
+                "Warning: sequence %s (length %ld) was trimmed too "
+                "badly (%ld,%ld) -- should be removed from MSA w/ %s!\n",
+                s->name.c_str(), s->seqlen, s->clp5, s->clp3,
+                seqs[0]->name.c_str());
+        s->set_flag(FLAG_BAD_ALN);
+        ++badseqs;
+      }
+      seq_to_columns(s, *msacolumns);
+    }
+  }
+
+  // (GSeqAlign::ErrZeroCov, GapAssem.cpp:1121-1131; exit 5)
+  [[noreturn]] void err_zero_cov(long col) const {
+    fprintf(stderr,
+            "WARNING: 0 coverage column %ld (mincol=%ld) found within "
+            "alignment of %zu seqs!\n",
+            col, msacolumns->mincol, count());
+    for (const GapSeq* s : seqs) fprintf(stderr, "%s\n", s->name.c_str());
+    throw PwErr(sformat("zero-coverage column %ld", col), 5);
+  }
+
+  // Consensus construction + clipping refinement driver
+  // (GSeqAlign::refineMSA, GapAssem.cpp:1133-1183; msa.py refine_msa).
+  void refine_msa(bool remove_cons_gaps, bool refine_clipping) {
+    build_msa();
+    MsaColumns& cols = *msacolumns;
+    // votes come from the counts as built — column removal below
+    // mutates the members, never the counts (msa.py computes the vote
+    // array up-front for the same reason)
+    std::vector<int> votes;
+    for (long col = cols.mincol; col <= cols.maxcol; ++col)
+      votes.push_back(best_char_from_counts(
+          &cols.counts[(size_t)col * 6], cols.layers[(size_t)col]));
+    long cols_removed = 0;
+    consensus.clear();
+    for (long col = cols.mincol; col <= cols.maxcol; ++col) {
+      int c = votes[(size_t)(col - cols.mincol)];
+      if (c == 0) err_zero_cov(col);
+      if (c == '-' || c == '*') {
+        if (remove_cons_gaps) {
+          remove_column(col - cols_removed);
+          ++cols_removed;
+          continue;
+        }
+        c = '*';
+      }
+      consensus.push_back((char)c);
+    }
+    auto cpos = [&](const GapSeq* s) {
+      return s->offset - minoffset - cols.mincol;
+    };
+    if (refine_clipping)
+      for (GapSeq* s : seqs) s->refine_clipping(consensus, cpos(s));
+    std::vector<GapSeq*> second;
+    for (GapSeq* s : seqs) {
+      long grem = remove_cons_gaps ? s->remove_clip_gaps() : 0;
+      if (grem != 0 && refine_clipping) second.push_back(s);
+    }
+    for (GapSeq* s : second)
+      s->refine_clipping(consensus, cpos(s), true);
+    refined = true;
+  }
+
+  // ACE contig output (GSeqAlign::writeACE, GapAssem.cpp:1200-1262)
+  void write_ace(FILE* f, const std::string& name,
+                 bool remove_cons_gaps = true,
+                 bool refine_clipping = true) {
+    if (!refined) refine_msa(remove_cons_gaps, refine_clipping);
+    size_t fwd = 0;
+    for (const GapSeq* s : seqs)
+      if (s->revcompl == 0) ++fwd;
+    char cons_dir = count() - fwd > fwd ? 'C' : 'U';
+    fprintf(f, "CO %s %zu %zu 0 %c\n", name.c_str(), consensus.size(),
+            count(), cons_dir);
+    for (size_t i = 0; i < consensus.size(); i += 60)
+      fprintf(f, "%s\n",
+              consensus.substr(i, std::min<size_t>(
+                  60, consensus.size() - i)).c_str());
+    fprintf(f, "\nBQ \n\n");
+    long mincol = msacolumns->mincol;
+    for (const GapSeq* s : seqs)
+      fprintf(f, "AF %s %c %ld\n", s->name.c_str(),
+              s->revcompl == 0 ? 'U' : 'C',
+              s->offset - minoffset - mincol + 1);
+    fprintf(f, "\n");
+    for (GapSeq* s : seqs) {
+      long gapped_len = s->seqlen + s->numgaps;
+      fprintf(f, "RD %s %ld 0 0\n", s->name.c_str(), gapped_len);
+      s->print_gapped_fasta(f);
+      long clpl, clpr;
+      s->clip_lr(clpl, clpr);
+      long l = clpl, r = clpr;
+      for (long j = 1; j <= r; ++j) clpr += s->gaps[(size_t)(s->seqlen - j)];
+      for (long j = 0; j <= l; ++j) clpl += s->gaps[(size_t)j];
+      long seql = clpl + 1;
+      long seqr = gapped_len - clpr;
+      if (seqr < seql) {
+        fprintf(stderr, "Bad trimming for %s of gapped len %ld (%ld, "
+                        "%ld)\n",
+                s->name.c_str(), gapped_len, seql, seqr);
+        seqr = seql + 1;
+      }
+      fprintf(f, "\nQA %ld %ld %ld %ld\nDS \n\n", seql, seqr, seql, seqr);
+    }
+  }
+
+  // Consensus FASTA ('*' marks kept all-gap columns; msa.py write_cons)
+  void write_cons(FILE* f, const std::string& name,
+                  bool remove_cons_gaps = true,
+                  bool refine_clipping = true) {
+    if (!refined) refine_msa(remove_cons_gaps, refine_clipping);
+    fprintf(f, ">%s_cons %zu seqs\n", name.c_str(), count());
+    for (size_t i = 0; i < consensus.size(); i += 60)
+      fprintf(f, "%s\n",
+              consensus.substr(i, std::min<size_t>(
+                  60, consensus.size() - i)).c_str());
+  }
+
+  // Contig-info output with per-seq pid and run-length alndata,
+  // including the reference's double-'+1' pid quirk
+  // (GSeqAlign::writeInfo, GapAssem.cpp:1264-1367; msa.py write_info)
+  void write_info(FILE* f, const std::string& name,
+                  bool remove_cons_gaps = true,
+                  bool refine_clipping = true) {
+    if (!refined) refine_msa(remove_cons_gaps, refine_clipping);
+    fprintf(f, ">%s %zu %s\n", name.c_str(), count(), consensus.c_str());
+    long mincol = msacolumns->mincol;
+    for (GapSeq* s : seqs) {
+      long gapped_len = s->seqlen + s->numgaps;
+      long seqoffset = s->offset - minoffset - mincol + 1;
+      long clpl, clpr;
+      s->clip_lr(clpl, clpr);
+      long asml = seqoffset + 1;
+      long asmr = asml - 1;
+      double pid = 0.0;
+      long aligned_len = 0, indel_ofs = 0;
+      std::string alndata;
+      for (long j = s->clp5; j < s->seqlen - s->clp3; ++j) {
+        long indel = s->gaps[(size_t)j];
+        char indel_type = '\0';
+        asmr += indel + 1;
+        if (indel < 0) {
+          indel_type = 'd';
+          indel = -indel;
+        } else {
+          if (indel > 0)
+            indel_type = 'g';
+          else
+            ++indel_ofs;
+          if (asmr - 1 >= 0 && asmr - 1 < (long)consensus.size() &&
+              toupper((unsigned char)s->seq[(size_t)j]) ==
+                  toupper((unsigned char)consensus[(size_t)(asmr - 1)]))
+            pid += 1;
+          ++aligned_len;
+        }
+        if (indel_type) {
+          if (indel > 2)
+            alndata += sformat("%ld%c%ld-", indel_ofs, indel_type, indel);
+          else
+            alndata.append((size_t)indel, indel_type);
+          indel_ofs = 0;
+        }
+      }
+      pid = aligned_len ? pid * 100.0 / (double)aligned_len : 0.0;
+      long seql = clpl + 1;
+      long seqr = (long)s->seq.size() - clpr;
+      if (seqr < seql) {
+        fprintf(stderr,
+                "WARNING: Bad trimming for %s of gapped len %ld (%ld, "
+                "%ld)\n",
+                s->name.c_str(), gapped_len, seql, seqr);
+        seqr = seql + 1;
+      }
+      if (s->revcompl) std::swap(seql, seqr);
+      fprintf(f, "%s %zu %ld %ld %ld %ld %ld %4.2f %s\n", s->name.c_str(),
+              s->seq.size(), seqoffset, asml, asmr, seql, seqr, pid,
+              alndata.c_str());
+    }
   }
 
   // Debug layout view (GSeqAlign::print, GapAssem.cpp:1013-1037)

@@ -517,13 +517,131 @@ def test_parity_msa_multi_query_writes_last(tmp_path):
     assert b">gB\n" in mfa and b">gA\n" not in mfa
 
 
+def _assert_cons_parity(tmp_path, lines, records, extra=None):
+    """Byte-parity of the consensus path: --ace/--info/--cons (plus the
+    report and MSA) between the native binary and the Python CLI."""
+    paf, fa = _write_inputs(tmp_path, lines, records)
+
+    def args(pfx):
+        return ([paf, "-r", fa, "-o", str(tmp_path / f"{pfx}.dfa"),
+                 "-w", str(tmp_path / f"{pfx}.mfa"),
+                 f"--ace={tmp_path / (pfx + '.ace')}",
+                 f"--info={tmp_path / (pfx + '.info')}",
+                 f"--cons={tmp_path / (pfx + '.cons')}"] + (extra or []))
+
+    rc_p, _, err_p = _run_py(args("p"))
+    rc_n, _, err_n = _run_native(args("n"))
+    assert (rc_n, err_n) == (rc_p, err_p)
+    for suff in ("dfa", "mfa", "ace", "info", "cons"):
+        pa, na = tmp_path / f"p.{suff}", tmp_path / f"n.{suff}"
+        if pa.exists() or na.exists():
+            assert na.read_bytes() == pa.read_bytes(), suff
+    return ((tmp_path / "p.ace").read_bytes()
+            if (tmp_path / "p.ace").exists() else b"")
+
+
+def test_parity_consensus_writers(tmp_path):
+    rng = random.Random(20260801)
+    q = "".join(rng.choice("ACGT") for _ in range(600))
+    lines = _rand_lines(rng, "g", q, 12)
+    ace = _assert_cons_parity(tmp_path, lines, [("g", q.encode())])
+    assert ace.startswith(b"CO g ") and b"\nBQ \n" in ace
+    # the two refinement flags change the outputs; parity must hold on
+    # every combination (reference statics, SURVEY.md §2.5.8)
+    _assert_cons_parity(tmp_path, lines, [("g", q.encode())],
+                        extra=["--remove-cons-gaps"])
+    _assert_cons_parity(tmp_path, lines, [("g", q.encode())],
+                        extra=["--no-refine-clip"])
+    _assert_cons_parity(tmp_path, lines, [("g", q.encode())],
+                        extra=["--remove-cons-gaps", "--no-refine-clip"])
+
+
+def test_parity_consensus_reverse_heavy(tmp_path):
+    # majority-reverse MSA: the ACE contig direction flips to 'C'
+    rng = random.Random(20260802)
+    q = "".join(rng.choice("ACGT") for _ in range(300))
+    lines = []
+    for t in range(5):
+        strand = "-" if t < 4 else "+"
+        ops = _rand_ops(rng, q.upper()) if strand == "+" else None
+        if strand == "-":
+            from pwasm_tpu.core.dna import revcomp
+            q_aln = revcomp(q.encode()).decode()
+            ops = _rand_ops(rng, q_aln.upper())
+        line, _ = make_paf_line("g", q, f"t{t}", strand, ops)
+        lines.append(line)
+    ace = _assert_cons_parity(tmp_path, lines, [("g", q.encode())])
+    assert b" C\n" in ace.splitlines()[0] + b"\n"
+
+
+def test_refine_clipping_parity_fuzz(tmp_path):
+    """Clip-seeded fuzz of the native X-drop refinement against the
+    Python engine's transliterated reference walk (the CLI flow never
+    sets clips, so this hook is the only way to exercise the port —
+    reference GapAssem.cpp:182-349)."""
+    import contextlib
+
+    from pwasm_tpu.align.gapseq import GapSeq
+
+    rng = random.Random(20260803)
+    cases = []
+    cons_alpha = "ACGT*"
+    cons = "".join(rng.choice(cons_alpha) for _ in range(400))
+    for k in range(250):
+        n = rng.randint(8, 60)
+        bases = "".join(rng.choice("ACGT") for _ in range(n))
+        # bias toward consensus-like content so the seek finds matches
+        cpos = rng.randint(0, 300)
+        if rng.random() < 0.7:
+            seg = cons[cpos:cpos + n].replace("*", "A")
+            bases = (seg + bases)[:n]
+        gaps = [0] * n
+        for _ in range(rng.randint(0, 5)):
+            gaps[rng.randint(0, n - 1)] = rng.randint(0, 3)
+        skip_dels = rng.random() < 0.3
+        if skip_dels and rng.random() < 0.5:
+            gaps[rng.randint(0, n - 1)] = -1
+        clp5 = rng.randint(0, n // 3)
+        clp3 = rng.randint(0, n - clp5 - 1) if rng.random() < 0.8 else 0
+        rev = rng.randint(0, 1)
+        cases.append((f"c{k}", rev, clp5, clp3, cpos, int(skip_dels),
+                      gaps, bases))
+    infile = tmp_path / "cases.tsv"
+    with open(infile, "w") as f:
+        f.write(cons + "\n")
+        for name, rev, c5, c3, cpos, sd, gaps, bases in cases:
+            f.write(f"{name}\t{rev}\t{c5}\t{c3}\t{cpos}\t{sd}\t"
+                    f"{','.join(map(str, gaps))}\t{bases}\n")
+    rc, out, _err = _run_native([f"--refine-selftest={infile}"])
+    assert rc == 0
+    got = {}
+    for line in out.splitlines():
+        name, c5, c3 = line.split("\t")
+        got[name] = (int(c5), int(c3))
+    assert len(got) == len(cases)
+    import numpy as np
+    for name, rev, c5, c3, cpos, sd, gaps, bases in cases:
+        s = GapSeq(name, "", bases.encode())
+        s.gaps = np.asarray(gaps, dtype=np.int32)
+        s.numgaps = int(sum(gaps))
+        s.revcompl = rev
+        s.clp5, s.clp3 = c5, c3
+        try:  # swallow seek-miss warnings, keep the clip results
+            with contextlib.redirect_stderr(io.StringIO()):
+                s.refine_clipping_scalar(cons.encode(), cpos,
+                                         skip_dels=bool(sd))
+        except Exception as e:  # length-mismatch guard must agree
+            raise AssertionError(f"{name}: oracle raised {e}")
+        assert got[name] == (s.clp5, s.clp3), name
+
+
 def test_native_rejects_python_only_features(tmp_path):
     rng = random.Random(41)
     q = "".join(rng.choice("ACGT") for _ in range(100))
     lines = _rand_lines(rng, "g", q, 1)
     paf, fa = _write_inputs(tmp_path, lines, [("g", q.encode())])
     for extra in (["--device=tpu"], ["--realign"], ["--shard"],
-                  ["--resume"], ["--ace=" + str(tmp_path / "a")]):
+                  ["--resume"], ["--profile=" + str(tmp_path / "t")]):
         rc, _, err = _run_native([paf, "-r", fa] + extra)
         assert rc == 1
         assert "Python CLI" in err
