@@ -70,8 +70,10 @@ def get_lib():
         if os.environ.get("PWASM_NATIVE", "1") == "0":
             return None
         try:
+            so_deps = [_SRC, os.path.join(_HERE, "pafreport_util.h")]
             if (not os.path.exists(_SO)
-                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                    or any(os.path.getmtime(_SO) < os.path.getmtime(d)
+                           for d in so_deps)):
                 if not _build():
                     return None
             lib = ctypes.CDLL(_SO)

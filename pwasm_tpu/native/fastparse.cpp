@@ -24,6 +24,8 @@
 #include <vector>
 #include <string>
 
+#include "pafreport_util.h"  // best_char_from_counts (the one C++ copy)
+
 namespace {
 
 constexpr int EV_FIELDS = 10;
@@ -402,21 +404,12 @@ void pw_banded_gotoh_batch(const int8_t* q, int32_t m,
 
 // Single-core consensus vote — the honest CPU baseline for the TPU
 // consensus kernel and the native fast path of the MSA engine's column
-// vote.  Implements bestChar's stable-sort + '-'/'N'-yield rule
-// (GapAssem.cpp:1048-1069, quirk SURVEY.md §2.5.10) in the same closed
-// form as pwasm_tpu/align/msa.py best_char_from_counts: if any of
-// A/C/G/T reaches the max count the first of them wins; else '-' wins a
-// N/'-' tie; else whichever of N/'-' holds the max.  Zero coverage -> 0.
+// vote.  bestChar's stable-sort + '-'/'N'-yield rule (GapAssem.cpp:
+// 1048-1069, quirk SURVEY.md §2.5.10), delegating to the shared closed
+// form in pafreport_util.h (same rule as align/msa.py
+// best_char_from_counts).  Zero coverage -> 0.
 static inline uint8_t vote_from_counts(const int32_t* c, int32_t layers) {
-  if (layers == 0) return 0;
-  int32_t m = c[0];
-  for (int k = 1; k < 6; ++k)
-    if (c[k] > m) m = c[k];
-  static const char nuc[4] = {'A', 'C', 'G', 'T'};
-  for (int k = 0; k < 4; ++k)
-    if (c[k] == m) return (uint8_t)nuc[k];
-  if (c[4] == m && c[5] == m) return '-';
-  return (c[4] == m) ? 'N' : '-';
+  return (uint8_t)pwnative::best_char_from_counts(c, layers);
 }
 
 // Pileup variant: (depth, cols) int8 base codes, 0..5 = A C G T N gap;

@@ -84,6 +84,12 @@ const char* USAGE =
     "   -F full genome alignment mode (default for query>100Kb; assumes -N)\n"
     "   -C perform codon impact analysis\n"
     "   -N skip codon impact analysis\n"
+    "   --ace=FILE  write the refined MSA as an ACE contig (consensus)\n"
+    "   --info=FILE write the refined MSA as a contig-info table\n"
+    "   --cons=FILE write the consensus sequence as FASTA\n"
+    "   --remove-cons-gaps  drop all-gap consensus columns during\n"
+    "               refinement\n"
+    "   --no-refine-clip    skip the X-drop clipping refinement pass\n"
     "   --motifs=FILE       load the methylation-motif table from FILE\n"
     "   --skip-bad-lines    warn and continue on malformed PAF lines\n"
     "   --stats=FILE        write run statistics as one JSON object\n";

@@ -29,20 +29,7 @@ constexpr int FLAG_BAD_ALN = 7;
 
 class Msa;
 
-// The consensus vote for one column's A,C,G,T,N,- counts: bestChar's
-// stable-sort + '-'/'N'-yield tie-break in closed form (GapAssem.cpp:
-// 1048-1069, quirk SURVEY.md §2.5.10; msa.py best_char_from_counts).
-inline int best_char_from_counts(const int32_t c[6], int32_t layers) {
-  if (layers == 0) return 0;
-  int32_t m = c[0];
-  for (int k = 1; k < 6; ++k)
-    if (c[k] > m) m = c[k];
-  static const char nuc[4] = {'A', 'C', 'G', 'T'};
-  for (int k = 0; k < 4; ++k)
-    if (c[k] == m) return nuc[k];
-  if (c[4] == m && c[5] == m) return '-';
-  return c[4] == m ? 'N' : '-';
-}
+// (the bestChar vote rule lives in pafreport_util.h — one C++ copy)
 
 // Column bucket of one base char: A0 C1 G2 T3, N for everything else,
 // '-'/'*' 5 (msa.py _BUCKET).

@@ -6,10 +6,30 @@
 
 #include <cctype>
 #include <cstdarg>
+#include <cstdint>
 #include <cstdio>
 #include <string>
 
 namespace pwnative {
+
+// The consensus vote for one column's A,C,G,T,N,- counts: bestChar's
+// stable-sort + '-'/'N'-yield tie-break in closed form (reference
+// GapAssem.cpp:1048-1069, quirk SURVEY.md §2.5.10; Python twin
+// align/msa.py best_char_from_counts).  The ONE C++ copy of the rule —
+// both the ctypes library (fastparse.cpp) and the MSA engine
+// (pafreport_msa.h) delegate here.  Returns the winning character, or
+// 0 for a zero-coverage column.
+inline int best_char_from_counts(const int32_t c[6], int32_t layers) {
+  if (layers == 0) return 0;
+  int32_t m = c[0];
+  for (int k = 1; k < 6; ++k)
+    if (c[k] > m) m = c[k];
+  static const char nuc[4] = {'A', 'C', 'G', 'T'};
+  for (int k = 0; k < 4; ++k)
+    if (c[k] == m) return nuc[k];
+  if (c[4] == m && c[5] == m) return '-';
+  return c[4] == m ? 'N' : '-';
+}
 
 struct PwErr {
   std::string msg;

@@ -90,13 +90,32 @@ def votes_to_chars(votes: np.ndarray, star_gap: bool = True) -> bytes:
 # ---------------------------------------------------------------------------
 def _consensus_kernel(bases_ref, counts_ref, votes_ref):
     """One grid step: a (depth, COL_TILE) int8 block -> per-column counts
-    and votes.  Pure VPU work: 6 masked column sums + the closed-form vote.
+    and votes.  Pure VPU work; the counting packs all six class counters
+    into one int32 per element (5 bits each, bits 0-29) and accumulates
+    ``1 << 5*code`` over row chunks of 31 (the 5-bit carry limit), then
+    unpacks — ~4 VPU ops/base instead of the naive 6x compare+select+add
+    (~18 ops/base), measured 1.7x faster on a v5e.  Codes outside [0, 6)
+    are remapped to the no-contribution shift (bit 30, never extracted;
+    31 such rows overflow harmlessly past bit 31).
     """
     b = bases_ref[...].astype(jnp.int32)  # (depth, C)
-    counts = []
-    for k in range(N_CLASSES):
-        counts.append(jnp.sum((b == k).astype(jnp.int32), axis=0))
-    cnt = jnp.stack(counts, axis=0)  # (6, C)
+    depth, c_tile = b.shape
+    if depth <= 1024:
+        # packed path: the 31-row chunk loop unrolls depth/31 bodies at
+        # trace time, so cap it — beyond ~1024 rows the naive path below
+        # keeps compile time flat (its 6 sums are depth-constant ops)
+        b = jnp.where((b < 0) | (b > 5), N_CLASSES, b)
+        cnts = [jnp.zeros((c_tile,), jnp.int32) for _ in range(N_CLASSES)]
+        for r0 in range(0, depth, 31):
+            chunk = b[r0:r0 + 31]
+            packed = jnp.sum(jnp.left_shift(jnp.int32(1), 5 * chunk),
+                             axis=0)
+            for k in range(N_CLASSES):
+                cnts[k] = cnts[k] + (jnp.right_shift(packed, 5 * k) & 31)
+        cnt = jnp.stack(cnts, axis=0)  # (6, C)
+    else:
+        cnt = jnp.stack([jnp.sum((b == k).astype(jnp.int32), axis=0)
+                         for k in range(N_CLASSES)], axis=0)
     counts_ref[...] = cnt
     acgt = cnt[:4]
     n = cnt[4]
@@ -119,13 +138,21 @@ def _consensus_kernel(bases_ref, counts_ref, votes_ref):
 
 
 @functools.partial(jax.jit, static_argnames=("col_tile", "interpret"))
-def consensus_pallas(bases: jax.Array, col_tile: int = 512,
+def consensus_pallas(bases: jax.Array, col_tile: int | None = None,
                      interpret: bool | None = None):
     """Pallas consensus over a (depth, cols) pileup.
 
     Returns (votes int8 (cols,), counts int32 (cols, 6)).  Pads columns to
     the tile size with PAD_CODE (those columns vote CODE_ZERO_COV and are
     sliced off).  On non-TPU backends runs in interpreter mode.
+
+    The default column tile is depth-aware: 2048 measured fastest on a
+    v5e at 256-deep pileups (512: 192 G bases/s, 2048: ~300 G, 4096:
+    regresses on VMEM pressure, 8192: fails to compile), but the block
+    is (depth, col_tile) in VMEM, so the tile shrinks with depth to keep
+    depth * col_tile at the measured-good 512K elements (floor 128) —
+    a 4096-deep contig pileup compiles exactly like it did at the old
+    fixed 512 tile.
     """
     from jax.experimental import pallas as pl
 
@@ -133,6 +160,9 @@ def consensus_pallas(bases: jax.Array, col_tile: int = 512,
         from pwasm_tpu.ops import default_interpret
         interpret = default_interpret()
     depth, cols = bases.shape
+    if col_tile is None:
+        col_tile = max(128, min(2048, (1 << 19) // max(depth, 1)))
+        col_tile = 1 << (col_tile.bit_length() - 1)  # power of two
     padded = (cols + col_tile - 1) // col_tile * col_tile
     if padded != cols:
         bases = jnp.pad(bases, ((0, 0), (0, padded - cols)),

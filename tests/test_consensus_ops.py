@@ -80,6 +80,19 @@ def test_pallas_unaligned_columns():
         np.asarray(votes), np.asarray(consensus_votes(jnp.asarray(bases))))
 
 
+def test_pallas_out_of_range_codes_and_odd_depths():
+    """Negative codes and codes > 5 must contribute nothing, and depths
+    that are not multiples of the packed-counter row chunk (31) must
+    count exactly — guards the packed 5-bit accumulation (the remap to
+    the never-extracted bit-30 shift and the chunk-boundary slices)."""
+    rng = np.random.default_rng(5)
+    for depth in (1, 30, 31, 32, 77, 256):
+        bases = rng.integers(-3, 9, size=(depth, 640)).astype(np.int8)
+        _votes, counts = consensus_pallas(jnp.asarray(bases), col_tile=128)
+        expect = np.stack([(bases == k).sum(0) for k in range(6)], 1)
+        np.testing.assert_array_equal(np.asarray(counts), expect)
+
+
 # ---------------------------------------------------------------------------
 # parity with the CPU MSA engine on a random progressive MSA
 # ---------------------------------------------------------------------------
