@@ -149,10 +149,11 @@ def consensus_pallas(bases: jax.Array, col_tile: int | None = None,
     The default column tile is depth-aware: 2048 measured fastest on a
     v5e at 256-deep pileups (512: 192 G bases/s, 2048: ~300 G, 4096:
     regresses on VMEM pressure, 8192: fails to compile), but the block
-    is (depth, col_tile) in VMEM, so the tile shrinks with depth to keep
-    depth * col_tile at the measured-good 512K elements (floor 128) —
-    a 4096-deep contig pileup compiles exactly like it did at the old
-    fixed 512 tile.
+    is (depth, col_tile) in VMEM, so the tile shrinks with depth to hold
+    depth * col_tile at the measured-good 512K elements (floor 128): a
+    1024-deep pileup gets tile 512, a 4096-deep one tile 128 — always
+    at or below the VMEM footprint the old fixed 512 tile had at depth
+    1024, where it was known to compile.
     """
     from jax.experimental import pallas as pl
 

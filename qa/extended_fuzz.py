@@ -323,10 +323,119 @@ def sweep_cli_parity(trials: int = 15) -> bool:
     return bad == 0
 
 
+def sweep_native_cli_parity(trials: int = 25) -> bool:
+    """Random anchored alignment sets through BOTH front ends: the
+    standalone C++ binary's outputs (.dfa/.mfa/.ace/.info/.cons +
+    summary + stderr) must be byte-identical to the Python CLI's CPU
+    path, across the refinement-flag variants."""
+    import subprocess
+
+    from helpers import make_paf_line
+
+    from pwasm_tpu.cli import run
+    from pwasm_tpu.core.dna import revcomp
+    from pwasm_tpu.core.fasta import write_fasta
+    from pwasm_tpu.native import native_cli_path
+
+    cli = native_cli_path()
+    if cli is None:
+        print("[SKIP] native CLI parity: no toolchain")
+        return True
+    rng = np.random.default_rng(13)
+    bad = 0
+    for trial in range(trials):
+        with tempfile.TemporaryDirectory() as td:
+            L = int(rng.integers(60, 240))
+            Q = "".join("ACGT"[i] for i in rng.integers(0, 4, L))
+            fa = os.path.join(td, "q.fa")
+            write_fasta(fa, [("q", Q.encode())])
+            lines = []
+            for k in range(int(rng.integers(2, 14))):
+                strand = "-" if rng.random() < 0.3 else "+"
+                q_aln = revcomp(Q.encode()).decode() \
+                    if strand == "-" else Q
+                head = int(rng.integers(3, 10))
+                tail = int(rng.integers(3, 10))
+                ops = [("=", head)]
+                pos = head
+                while pos < L - tail:
+                    r = rng.random()
+                    span = int(rng.integers(1, L - tail - pos + 1))
+                    if r < 0.55:
+                        ops.append(("=", span))
+                        pos += span
+                    elif r < 0.7:
+                        qb = q_aln[pos]
+                        tb = "ACGT"[("ACGT".index(qb) + 1) % 4]
+                        ops.append(("*", tb.lower(), qb.lower()))
+                        pos += 1
+                    elif r < 0.85:
+                        ins = "".join(
+                            "acgt"[i] for i in rng.integers(
+                                0, 4, int(rng.integers(1, 6))))
+                        ops.append(("ins", ins))
+                    else:
+                        d = min(int(rng.integers(1, 6)),
+                                L - tail - pos)
+                        if d > 0:
+                            ops.append(("del", d))
+                            pos += d
+                ops.append(("=", L - pos))
+                lines.append(
+                    make_paf_line("q", Q, f"t{k:02d}", strand, ops)[0])
+            # sprinkle duplicates and self-alignments for the warning
+            # paths (both must be byte-identical on stderr too)
+            if lines and rng.random() < 0.5:
+                lines.append(lines[0])
+            if rng.random() < 0.5:
+                lines.append(make_paf_line("q", Q, "q", "+",
+                                           [("=", L)])[0])
+            paf = os.path.join(td, "in.paf")
+            with open(paf, "w") as f:
+                f.write("".join(l + "\n" for l in lines))
+            for vname, vflags in (("base", []),
+                                  ("rcg", ["--remove-cons-gaps"]),
+                                  ("norc", ["--no-refine-clip"])):
+                exts = ("dfa", "mfa", "ace", "info", "cons", "sum")
+                def outset(tag):
+                    return [
+                        "-o", os.path.join(td, f"{tag}.dfa"),
+                        "-w", os.path.join(td, f"{tag}.mfa"),
+                        f"--ace={os.path.join(td, tag + '.ace')}",
+                        f"--info={os.path.join(td, tag + '.info')}",
+                        f"--cons={os.path.join(td, tag + '.cons')}",
+                        "-s", os.path.join(td, f"{tag}.sum")]
+                perr = io.StringIO()
+                rc_p = run([paf, "-r", fa] + outset(f"{vname}_p")
+                           + vflags, stderr=perr)
+                res = subprocess.run(
+                    [cli, paf, "-r", fa] + outset(f"{vname}_n") + vflags,
+                    capture_output=True, text=True)
+                if res.returncode != rc_p:
+                    bad += 1
+                    continue
+                if res.stderr != perr.getvalue():
+                    bad += 1
+                    continue
+                for e in exts:
+                    pf = os.path.join(td, f"{vname}_p.{e}")
+                    nf = os.path.join(td, f"{vname}_n.{e}")
+                    pb = open(pf, "rb").read() if os.path.exists(pf) \
+                        else None
+                    nb = open(nf, "rb").read() if os.path.exists(nf) \
+                        else None
+                    if pb != nb:
+                        bad += 1
+                        break
+    print(f"[{'PASS' if not bad else 'FAIL'}] native-binary CLI parity: "
+          f"{bad} divergent trials / {trials}")
+    return bad == 0
+
+
 def main() -> int:
     results = [sweep_refine_batch(), sweep_realign_oracle(),
                sweep_fai_roundtrip(), sweep_paf_corruption(),
-               sweep_cli_parity()]
+               sweep_cli_parity(), sweep_native_cli_parity()]
     return 0 if all(results) else 1
 
 
