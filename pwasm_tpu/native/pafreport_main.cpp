@@ -22,6 +22,7 @@
 // defined over valid ASCII FASTA/PAF only.
 
 #include <sys/stat.h>
+#include <unistd.h>
 
 #include <array>
 #include <cctype>
@@ -92,6 +93,9 @@ const char* USAGE =
     "   --no-refine-clip    skip the X-drop clipping refinement pass\n"
     "   --motifs=FILE       load the methylation-motif table from FILE\n"
     "   --skip-bad-lines    warn and continue on malformed PAF lines\n"
+    "   --resume    append to an existing -o report, skipping alignments\n"
+    "               already emitted (a -s summary then covers only the\n"
+    "               resumed portion)\n"
     "   --stats=FILE        write run statistics as one JSON object\n";
 
 using pwnative::GapSeq;
@@ -793,7 +797,8 @@ std::vector<std::string> load_motifs(const std::string& path) {
 struct RunStats {
   struct timespec t0;
   long lines = 0, alignments = 0, skipped_bad = 0, skipped_dedup = 0,
-       skipped_self = 0, aligned_bases = 0, events = 0, msa_dropped = 0;
+       skipped_self = 0, aligned_bases = 0, events = 0, msa_dropped = 0,
+       resumed_past = 0;
   RunStats() { clock_gettime(CLOCK_MONOTONIC, &t0); }
   double wall_s() const {
     struct timespec t1;
@@ -807,12 +812,12 @@ struct RunStats {
     fprintf(f,
             "{\"lines\": %ld, \"alignments\": %ld, \"skipped_bad_lines\": "
             "%ld, \"skipped_duplicates\": %ld, \"skipped_self\": %ld, "
-            "\"resumed_past\": 0, \"aligned_bases\": %ld, \"events\": %ld, "
-            "\"device_batches\": 0, \"fallback_batches\": 0, \"realigned\": "
-            "0, \"msa_dropped\": %ld, \"wall_s\": %.3f, "
+            "\"resumed_past\": %ld, \"aligned_bases\": %ld, \"events\": "
+            "%ld, \"device_batches\": 0, \"fallback_batches\": 0, "
+            "\"realigned\": 0, \"msa_dropped\": %ld, \"wall_s\": %.3f, "
             "\"aligned_bases_per_s\": %.1f}\n",
             lines, alignments, skipped_bad, skipped_dedup, skipped_self,
-            aligned_bases, events, msa_dropped, w, rate);
+            resumed_past, aligned_bases, events, msa_dropped, w, rate);
   }
 };
 
@@ -914,7 +919,7 @@ int run(int argc, char** argv) {
     return 1;
   }
   // Python-CLI-only features: fail clearly rather than silently ignore
-  for (const char* k : {"realign", "shard", "profile", "resume"}) {
+  for (const char* k : {"realign", "shard", "profile"}) {
     if (opts.has(k)) {
       fprintf(stderr,
               "Error: --%s is handled by the Python CLI "
@@ -955,13 +960,54 @@ int run(int argc, char** argv) {
   if (opts.vals.count("c"))
     cfg.clipmax = parse_clipmax(opts.get("c"), cfg.verbose);
   cfg.skip_bad_lines = opts.has("skip-bad-lines");
+  bool resume = opts.has("resume");
   if (opts.is_bool("stats")) {
     fprintf(stderr, "%s\n--stats requires a file argument\n", USAGE);
     return 1;
   }
+  long resume_skip = 0;
+  if (resume) {
+    // --resume (cli.py:214-258): the report is per-alignment
+    // independent, so resume = drop the LAST record (its rows may be
+    // torn), truncate there, count the surviving headers, and skip
+    // that many accepted alignments
+    if (!opts.vals.count("o"))
+      throw PwErr(sformat("%s\n--resume requires -o <report>\n", USAGE));
+    FILE* rf = fopen(opts.get("o").c_str(), "rb");
+    if (rf != nullptr) {
+      long n_headers = 0, last_header = -1, size = 0;
+      char prev_byte = '\n';  // virtual newline before file start
+      int first = fgetc(rf);
+      bool starts_ok = first == '>';
+      fseek(rf, 0, SEEK_SET);
+      std::vector<char> chunk(1 << 20);
+      size_t got;
+      while ((got = fread(chunk.data(), 1, chunk.size(), rf)) > 0) {
+        for (size_t i = 0; i < got; ++i) {
+          // the virtual leading '\n' makes a '>' at offset 0 count,
+          // exactly like the Python scan's prepended prev_byte
+          if (prev_byte == '\n' && chunk[i] == '>') {
+            ++n_headers;
+            last_header = size + (long)i;
+          }
+          prev_byte = chunk[i];
+        }
+        size += (long)got;
+      }
+      fclose(rf);
+      long keep = 0;
+      if (starts_ok && n_headers > 0) {
+        keep = n_headers > 1 ? last_header : 0;
+        resume_skip = n_headers - 1;
+      }
+      if (keep != size && truncate(opts.get("o").c_str(), keep) != 0)
+        resume_skip = 0;  // like the Python scan's OSError fallback:
+        // treat an untruncatable report as a fresh run (append mode)
+    }
+  }
   FILE* freport = stdout;
   if (opts.vals.count("o")) {
-    freport = fopen(opts.get("o").c_str(), "wb");
+    freport = fopen(opts.get("o").c_str(), resume ? "ab" : "wb");
     if (!freport)
       throw PwErr("Cannot open file " + opts.get("o") + " for writing!\n");
   }
@@ -1102,6 +1148,7 @@ int run(int argc, char** argv) {
     }
   };
 
+  const bool build_msa_out = fmsa != nullptr || !cons_outs.empty();
   LineReader reader(inf);
   std::string line;
   long file_line = 0;
@@ -1144,6 +1191,19 @@ int run(int argc, char** argv) {
       }
     }
     ++numalns;
+    if (!build_msa_out && !cfg.skip_bad_lines &&
+        stats.resumed_past < resume_skip) {
+      // --resume fast path (cli.py:539-553): this alignment is already
+      // in the report; advance the cursor on parse-level info alone so
+      // resume cost scales with the REMAINING work.  Disabled under
+      // --skip-bad-lines (a parseable line can still have been skipped
+      // at extraction in the original run) and with MSA outputs (the
+      // MSA needs every alignment).
+      ++stats.resumed_past;
+      ++stats.alignments;
+      stats.aligned_bases += al.t_alnend - al.t_alnstart;
+      continue;
+    }
     if (refseq_id != al.r_id || !have_ref) {
       auto it = ref_cache.find(al.r_id);
       if (it != ref_cache.end()) {
@@ -1188,10 +1248,16 @@ int run(int argc, char** argv) {
     if (cfg.fullgenome)
       rlabel += sformat(":%ld-%ld", al.r_alnstart, al.r_alnend);
     if (qfasta.size() == 1 && !cfg.fullgenome) rlabel.clear();
-    print_diff_info(freport, al, rec.alnscore, rec.edist, ex.evs, rlabel,
-                    tlabel, refseq, cfg.skip_codan, cfg.motifs,
-                    fsummary ? &summary : nullptr);
-    if (fmsa || !cons_outs.empty()) msa_add(ex, al, tlabel, numalns);
+    if (stats.resumed_past < resume_skip) {
+      // --resume cursor: rows already in the report from the
+      // interrupted run (slow path: MSA/skip-bad-lines modes)
+      ++stats.resumed_past;
+    } else {
+      print_diff_info(freport, al, rec.alnscore, rec.edist, ex.evs,
+                      rlabel, tlabel, refseq, cfg.skip_codan, cfg.motifs,
+                      fsummary ? &summary : nullptr);
+    }
+    if (build_msa_out) msa_add(ex, al, tlabel, numalns);
   }
   if (inf != stdin) fclose(inf);
   if (cfg.debug && ref_msa != nullptr) {
