@@ -312,6 +312,9 @@ def test_native_sanitizer_selftest():
                          capture_output=True, text=True, timeout=300)
     assert res.returncode == 0, res.stderr[-2000:]
     assert "native selftest OK" in res.stdout
+    # the full CLI (MSA + consensus engine) must also run clean under
+    # ASan/UBSan — the recipe exits nonzero on any sanitizer report
+    assert "native CLI sanitizer run OK" in res.stdout
 
 
 def test_native_gotoh_traceback_matches_python_oracle():
