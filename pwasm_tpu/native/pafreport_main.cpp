@@ -829,6 +829,23 @@ struct Cfg {
   std::vector<std::string> motifs = {"CCTGG", "CCAGG", "GATC", "GTAC"};
 };
 
+// Shared by the selftest hooks: fill a GapSeq's gap array from a
+// comma-joined list, maintaining numgaps.
+void parse_gap_list(const std::string& gs, GapSeq& s) {
+  size_t start = 0, gi = 0;
+  while (start <= gs.size() && gi < s.gaps.size()) {
+    size_t comma = gs.find(',', start);
+    std::string tok = gs.substr(
+        start, comma == std::string::npos ? std::string::npos
+                                          : comma - start);
+    s.gaps[gi] = (int32_t)atol(tok.c_str());
+    s.numgaps += s.gaps[gi];
+    ++gi;
+    if (comma == std::string::npos) break;
+    start = comma + 1;
+  }
+}
+
 // Hidden test hook: exercise the X-drop clip refinement with nonzero
 // clips (unreachable from the CLI flow, where nothing sets clp5/clp3 —
 // clipmax is parsed but evalClipping is never called, mirroring the
@@ -858,19 +875,7 @@ int run_refine_selftest(const std::string& path) {
     s.clp3 = atol(fields[3].c_str());
     long cpos = atol(fields[4].c_str());
     bool skip_dels = atol(fields[5].c_str()) != 0;
-    size_t start = 0, gi = 0;
-    const std::string& gs = fields[6];
-    while (start <= gs.size() && gi < s.gaps.size()) {
-      size_t comma = gs.find(',', start);
-      std::string tok = gs.substr(
-          start, comma == std::string::npos ? std::string::npos
-                                            : comma - start);
-      s.gaps[gi] = (int32_t)atol(tok.c_str());
-      s.numgaps += s.gaps[gi];
-      ++gi;
-      if (comma == std::string::npos) break;
-      start = comma + 1;
-    }
+    parse_gap_list(fields[6], s);
     s.refine_clipping(cons, cpos, skip_dels);
     printf("%s\t%ld\t%ld\n", s.name.c_str(), s.clp5, s.clp3);
   }
@@ -878,10 +883,72 @@ int run_refine_selftest(const std::string& path) {
   return 0;
 }
 
+// Hidden test hook for the clipping transaction (evalClipping/
+// applyClipping, unreachable from the CLI flow like the reference,
+// where clipmax is parsed but never consumed).  Input: line 1 the
+// clipmax value; then SEQ lines (name, revcompl, offset, clp5, clp3,
+// comma-joined gaps, seqlen) building one MSA in order, then EVAL
+// lines (seq index, c5, c3).  Each EVAL gets a fresh transaction and
+// applies on success; output per EVAL is "ok"/"rejected", then one
+// final line per seq: name\tclp5\tclp3.  Fuzz-compared against the
+// Python engine in tests/test_native_cli.py.
+int run_clip_selftest(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) throw PwErr("Cannot open input file " + path + "!\n");
+  LineReader reader(f);
+  std::string line;
+  if (!reader.next(line)) {
+    fclose(f);
+    throw PwErr("clip-selftest: empty input\n");
+  }
+  double clipmax = atof(line.c_str());
+  std::vector<std::unique_ptr<GapSeq>> arena;
+  Msa msa;
+  while (reader.next(line)) {
+    if (line.empty()) continue;
+    std::vector<std::string> fld = split_tabs(line);
+    if (fld[0] == "SEQ") {
+      if (fld.size() != 8) throw PwErr("clip-selftest: bad SEQ line\n");
+      long seqlen = atol(fld[7].c_str());
+      arena.push_back(std::make_unique<GapSeq>(
+          fld[1], "", seqlen, atol(fld[3].c_str()),
+          (int)atol(fld[2].c_str())));
+      GapSeq* s = arena.back().get();
+      s->clp5 = atol(fld[4].c_str());
+      s->clp3 = atol(fld[5].c_str());
+      parse_gap_list(fld[6], *s);
+      if (msa.count() == 0) {
+        msa.seqs.push_back(s);  // waiting for its pairwise partner
+        s->msa = &msa;
+      } else if (msa.count() == 1) {
+        msa.seed_pair(msa.seqs[0], s);
+      } else {
+        msa.add_seq(s, s->offset, s->ng_ofs);
+      }
+    } else if (fld[0] == "EVAL") {
+      if (fld.size() != 4) throw PwErr("clip-selftest: bad EVAL line\n");
+      size_t idx = (size_t)atol(fld[1].c_str());
+      if (idx >= msa.count())
+        throw PwErr("clip-selftest: EVAL index out of range\n");
+      pwnative::AlnClipOps ops;
+      bool ok = msa.eval_clipping(msa.seqs[idx], atol(fld[2].c_str()),
+                                  atol(fld[3].c_str()), clipmax, ops);
+      if (ok) msa.apply_clipping(ops);
+      printf("%s\n", ok ? "ok" : "rejected");
+    }
+  }
+  fclose(f);
+  for (const GapSeq* s : msa.seqs)
+    printf("%s\t%ld\t%ld\n", s->name.c_str(), s->clp5, s->clp3);
+  return 0;
+}
+
 int run(int argc, char** argv) {
   Opts opts = parse_args(argc, argv);
   if (opts.vals.count("refine-selftest"))
     return run_refine_selftest(opts.get("refine-selftest"));
+  if (opts.vals.count("clip-selftest"))
+    return run_clip_selftest(opts.get("clip-selftest"));
   if (opts.has("h")) {
     fprintf(stderr, "%s\n", USAGE);
     return 1;

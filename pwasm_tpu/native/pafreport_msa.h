@@ -13,6 +13,7 @@
 #pragma once
 
 #include <algorithm>
+#include <cmath>
 #include <cstdint>
 #include <limits>
 #include <memory>
@@ -441,7 +442,11 @@ class Msa {
 
   Msa() = default;
   // pairwise seed (GapAssem.cpp:605-641)
-  Msa(GapSeq* s1, GapSeq* s2) {
+  Msa(GapSeq* s1, GapSeq* s2) { seed_pair(s1, s2); }
+
+  // the pairwise-seed bookkeeping, callable on a default-constructed
+  // Msa too (the clip-selftest hook builds its MSA incrementally)
+  void seed_pair(GapSeq* s1, GapSeq* s2) {
     s1->msa = this;
     s2->msa = this;
     seqs = {s1, s2};
@@ -566,6 +571,13 @@ class Msa {
     finalize();
     for (GapSeq* s : seqs) s->print_mfasta(f, linelen);
   }
+
+  // ---- clipping transaction (GSeqAlign::evalClipping/applyClipping,
+  // GapAssem.cpp:814-996; msa.py eval_clipping/apply_clipping) --------
+  // declared here, defined after AlnClipOps below
+  bool eval_clipping(GapSeq* seq, long c5, long c3, double clipmax,
+                     class AlnClipOps& clipops);
+  void apply_clipping(const class AlnClipOps& clipops);
 
   // ---- consensus path (GSeqAlign::buildMSA/refineMSA + writers,
   // GapAssem.cpp:1048-1367; msa.py build_msa/refine_msa/write_*) ------
@@ -814,6 +826,132 @@ class Msa {
     }
   }
 };
+
+// Staged clipping transaction (AlnClipOps, GapAssem.h:183-253; msa.py
+// AlnClipOps): collect per-seq clip updates, refusing any that exceed
+// clipmax or leave a read under 25% of its length.
+class AlnClipOps {
+ public:
+  struct Op {
+    GapSeq* s;
+    long clp5, clp3;  // -1 = leave unchanged
+  };
+  std::vector<Op> ops;
+  long total = 0;
+
+  static long maxovh(const GapSeq* s, double clipmax) {
+    // Python: int(clipmax) if clipmax > 1 else int(round(clipmax *
+    // seqlen)) — round() is round-half-even, which nearbyint matches
+    // under the default FE_TONEAREST mode
+    return clipmax > 1 ? (long)clipmax
+                       : (long)std::nearbyint(clipmax *
+                                              (double)s->seqlen);
+  }
+
+  bool add5(GapSeq* s, long clp, double clipmax) {
+    if (s->clp5 < clp) {
+      if (clipmax > 0 && clp > maxovh(s, clipmax)) return false;
+      if (s->seqlen - s->clp3 - clp < (s->seqlen >> 2)) return false;
+      total += 10000 + clp - s->clp5;
+      ops.push_back({s, clp, -1});
+    }
+    return true;
+  }
+
+  bool add3(GapSeq* s, long clp, double clipmax) {
+    if (s->clp3 < clp) {
+      if (clipmax > 0 && clp > maxovh(s, clipmax)) return false;
+      if (s->seqlen - s->clp5 - clp < (s->seqlen >> 2)) return false;
+      total += 10000 + clp - s->clp3;
+      ops.push_back({s, -1, clp});
+    }
+    return true;
+  }
+};
+
+// (GSeqAlign::evalClipping, GapAssem.cpp:823-996; msa.py eval_clipping)
+// Propagate a proposed end-trim of ``seq`` to every member, refusing if
+// any member would be over-clipped.
+inline bool Msa::eval_clipping(GapSeq* seq, long c5, long c3,
+                               double clipmax, AlnClipOps& clipops) {
+  if (c5 >= 0) {
+    long pos = seq->revcompl != 0 ? seq->seqlen - c5 - 1 : c5;
+    long alpos = alpos_of(seq, pos);
+    for (GapSeq* s : seqs) {
+      if (s == seq) {
+        if (!clipops.add5(s, c5, clipmax)) return false;
+        continue;
+      }
+      if (s->offset >= alpos) {
+        if (seq->revcompl != 0) return false;  // clipped entirely
+        continue;
+      }
+      long spos = s->find_walk_pos(alpos);
+      if (spos >= s->seqlen) {
+        if (seq->revcompl == 0) return false;
+        continue;
+      }
+      if (seq->revcompl != 0) {  // trimming the right side of the msa
+        if (s->revcompl != 0) {
+          if (!clipops.add5(s, s->seqlen - spos - 1, clipmax))
+            return false;
+        } else {
+          if (!clipops.add3(s, s->seqlen - spos - 1, clipmax))
+            return false;
+        }
+      } else {  // trimming the left side
+        if (s->revcompl != 0) {
+          if (!clipops.add3(s, spos, clipmax)) return false;
+        } else {
+          if (!clipops.add5(s, spos, clipmax)) return false;
+        }
+      }
+    }
+  }
+  if (c3 >= 0) {
+    long pos = seq->revcompl != 0 ? c3 : seq->seqlen - c3 - 1;
+    long alpos = alpos_of(seq, pos);
+    for (GapSeq* s : seqs) {
+      if (s == seq) {
+        if (!clipops.add3(s, c3, clipmax)) return false;
+        continue;
+      }
+      if (s->offset >= alpos) {
+        if (seq->revcompl == 0) return false;
+        continue;
+      }
+      long spos = s->find_walk_pos(alpos);
+      if (spos >= s->seqlen) {
+        if (seq->revcompl != 0) return false;
+        continue;
+      }
+      if (seq->revcompl != 0) {  // trim left side
+        if (s->revcompl != 0) {
+          if (!clipops.add3(s, spos, clipmax)) return false;
+        } else {
+          if (!clipops.add5(s, spos, clipmax)) return false;
+        }
+      } else {  // trim right side
+        if (s->revcompl != 0) {
+          if (!clipops.add5(s, s->seqlen - spos - 1, clipmax))
+            return false;
+        } else {
+          if (!clipops.add3(s, s->seqlen - spos - 1, clipmax))
+            return false;
+        }
+      }
+    }
+  }
+  return true;
+}
+
+// (GSeqAlign::applyClipping, GapAssem.cpp:814-822)
+inline void Msa::apply_clipping(const AlnClipOps& clipops) {
+  for (const auto& op : clipops.ops) {
+    if (op.clp5 >= 0) op.s->clp5 = op.clp5;
+    if (op.clp3 >= 0) op.s->clp3 = op.clp3;
+  }
+}
 
 // GASeq::revComplement within a layout (GapAssem.cpp:366-392) — defined
 // after Msa because it reads the owning MSA's layout fields.

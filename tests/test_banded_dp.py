@@ -170,6 +170,49 @@ def test_long_kernel_matches_batch():
     np.testing.assert_array_equal(got, expect)
 
 
+def test_long_kernel_interior_pairs():
+    """Small chunk so whole DMA pairs fall in the interior phase (the
+    statically mask-elided bodies) — the masked/interior/masked pair
+    split must be bit-exact across the phase boundaries."""
+    from pwasm_tpu.ops.banded_dp import band_dlo, banded_scores_long
+
+    rng = np.random.default_rng(13)
+    m, n, band, chunk = 256, 280, 32, 32
+    # sanity: this geometry really exercises interior pairs
+    dlo = band_dlo(m, n, band)
+    head = min(max(0, -dlo), m)
+    int_end = max(head, min(m, n - band - dlo + 1))
+    n_chunks = (m + chunk - 1) // chunk
+    ok = [c * chunk >= head and (c + 1) * chunk <= int_end
+          for c in range(n_chunks)]
+    assert any(ok[2 * c] and ok[2 * c + 1]
+               for c in range((n_chunks + 1) // 2 - 1)), \
+        "geometry no longer covers interior pairs; adjust the test"
+    q = rng.integers(0, 4, size=m).astype(np.int8)
+    T = 7
+    ts = np.full((T, n), 127, dtype=np.int8)
+    t_lens = np.zeros(T, dtype=np.int32)
+    for k in range(T):
+        t = list(q)
+        for _ in range(int(rng.integers(0, 8))):
+            t[int(rng.integers(0, len(t)))] = int(rng.integers(0, 4))
+        for _ in range(int(rng.integers(0, 4))):
+            p = int(rng.integers(0, len(t)))
+            if rng.random() < 0.5:
+                t.insert(p, int(rng.integers(0, 4)))
+            elif len(t) > 1:
+                del t[p]
+        t = t[:n]
+        ts[k, :len(t)] = t
+        t_lens[k] = len(t)
+    got = np.asarray(banded_scores_long(
+        jnp.asarray(q), jnp.asarray(ts), jnp.asarray(t_lens),
+        band=band, block_t=8, chunk=chunk))
+    expect = np.asarray(banded_scores_batch(
+        jnp.asarray(q), jnp.asarray(ts), jnp.asarray(t_lens), band=band))
+    np.testing.assert_array_equal(got, expect)
+
+
 def test_long_kernel_single_chunk():
     """chunk >= m: one DMA window, still exact."""
     from pwasm_tpu.ops.banded_dp import banded_scores_long

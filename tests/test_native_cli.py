@@ -686,6 +686,81 @@ def test_parity_resume(tmp_path):
     assert (tmp_path / "fresh.dfa").read_bytes() == body
 
 
+def test_clip_transaction_parity_fuzz(tmp_path):
+    """Clip-transaction fuzz: the native eval_clipping/apply_clipping
+    (GapAssem.cpp:823-996 capability) must accept/reject and apply
+    exactly like the Python engine on random MSAs and random proposed
+    end-trims, across strands and clipmax forms (absolute/fraction)."""
+    import numpy as np
+
+    from pwasm_tpu.align.gapseq import GapSeq
+    from pwasm_tpu.align.msa import AlnClipOps, Msa
+
+    rng = random.Random(20260805)
+    for case in range(40):
+        clipmax = rng.choice([0.0, 0.25, 0.4, 12.0, 30.0])
+        n_seqs = rng.randint(2, 6)
+        seqs_spec = []
+        for k in range(n_seqs):
+            seqlen = rng.randint(12, 60)
+            gaps = [0] * seqlen
+            for _ in range(rng.randint(0, 4)):
+                gaps[rng.randint(0, seqlen - 1)] = rng.randint(1, 3)
+            seqs_spec.append(dict(
+                name=f"s{k}", revcompl=rng.randint(0, 1),
+                offset=rng.randint(0, 20), clp5=rng.randint(0, 3),
+                clp3=rng.randint(0, 3), gaps=gaps, seqlen=seqlen))
+        evals = []
+        for _ in range(rng.randint(1, 6)):
+            idx = rng.randint(0, n_seqs - 1)
+            sl = seqs_spec[idx]["seqlen"]
+            c5 = rng.randint(-1, sl // 2)
+            c3 = rng.randint(-1, sl // 2)
+            evals.append((idx, c5, c3))
+        # native side
+        infile = tmp_path / f"clip{case}.tsv"
+        with open(infile, "w") as f:
+            f.write(f"{clipmax}\n")
+            for sp in seqs_spec:
+                f.write(f"SEQ\t{sp['name']}\t{sp['revcompl']}\t"
+                        f"{sp['offset']}\t{sp['clp5']}\t{sp['clp3']}\t"
+                        f"{','.join(map(str, sp['gaps']))}\t"
+                        f"{sp['seqlen']}\n")
+            for idx, c5, c3 in evals:
+                f.write(f"EVAL\t{idx}\t{c5}\t{c3}\n")
+        rc, out, err = _run_native([f"--clip-selftest={infile}"])
+        assert rc == 0, err
+        lines = out.splitlines()
+        got_verdicts = lines[:len(evals)]
+        got_clips = {}
+        for line in lines[len(evals):]:
+            name, c5, c3 = line.split("\t")
+            got_clips[name] = (int(c5), int(c3))
+        # python side
+        pseqs = []
+        for sp in seqs_spec:
+            s = GapSeq(sp["name"], "", b"", seqlen=sp["seqlen"],
+                       offset=sp["offset"], clp5=sp["clp5"],
+                       clp3=sp["clp3"], revcompl=sp["revcompl"])
+            s.gaps = np.asarray(sp["gaps"], dtype=np.int32)
+            s.numgaps = int(sum(sp["gaps"]))
+            pseqs.append(s)
+        msa = Msa(pseqs[0], pseqs[1])
+        for s in pseqs[2:]:
+            msa.add_seq(s, s.offset, s.ng_ofs)
+        want_verdicts = []
+        for idx, c5, c3 in evals:
+            ops = AlnClipOps()
+            ok = msa.eval_clipping(pseqs[idx], c5, c3, clipmax, ops)
+            if ok:
+                msa.apply_clipping(ops)
+            want_verdicts.append("ok" if ok else "rejected")
+        assert got_verdicts == want_verdicts, f"case {case}"
+        for s in pseqs:
+            assert got_clips[s.name] == (s.clp5, s.clp3), \
+                f"case {case} seq {s.name}"
+
+
 def test_native_rejects_python_only_features(tmp_path):
     rng = random.Random(41)
     q = "".join(rng.choice("ACGT") for _ in range(100))
