@@ -74,8 +74,12 @@ def main() -> int:
         assert np.array_equal(got, want), "score mismatch"
 
     def dp_long():
+        # chunk=32 so at least one DMA pair falls in the statically
+        # mask-elided interior phase (m=192, n=224, band=64 puts chunks
+        # 2-3 inside [head, int_end)) — the interior bodies must both
+        # lower AND execute on hardware, not just compile
         got = np.asarray(banded_scores_long(qd, tsd, tld, band=band,
-                                            chunk=64))
+                                            chunk=32))
         assert np.array_equal(got, want), "score mismatch"
 
     def dp_packed():
