@@ -128,12 +128,13 @@ def _fail(metric: str) -> int:
     return 1
 
 
-def _probe_backend(env: dict, timeout: float) -> str | None:
+def _probe_backend(env: dict, timeout: float) -> tuple[str | None, str]:
     """Ask a subprocess which jax platform initializes under ``env``.
-    Returns the platform name, or None on error OR hang — the round-1
-    capture died on an init error (BENCH_r01.json) and the tunnel has
-    also been observed to hang indefinitely, so the probe must bound
-    both failure modes."""
+    Returns ``(platform, "")`` on success, or ``(None, diagnostic)`` on
+    error OR hang — the round-1 capture died on an init error
+    (BENCH_r01.json) and the tunnel has also been observed to hang
+    indefinitely, so the probe must bound both failure modes.  Shared
+    with tpu_smoke.py (which imports it), so fixes land in one place."""
     import subprocess
 
     code = ("import jax; d = jax.devices(); "
@@ -141,14 +142,16 @@ def _probe_backend(env: dict, timeout: float) -> str | None:
     try:
         r = subprocess.run([sys.executable, "-c", code], env=env,
                            capture_output=True, timeout=timeout, text=True)
-    except Exception:
-        return None
+    except subprocess.TimeoutExpired:
+        return None, f"probe hang (> {timeout:.0f}s)"
+    except Exception as e:
+        return None, f"probe spawn failed: {type(e).__name__}: {e}"
     if r.returncode != 0:
-        return None
+        return None, r.stderr[-500:]
     for line in r.stdout.splitlines():
         if line.startswith("PLATFORM="):
-            return line.split("=", 1)[1].split(":")[0]
-    return None
+            return line.split("=", 1)[1].split(":")[0], ""
+    return None, r.stderr[-500:]
 
 
 def _resolve_backend() -> str:
@@ -165,7 +168,7 @@ def _resolve_backend() -> str:
     the watchdog."""
     probe_t = float(os.environ.get("PWASM_BENCH_PROBE_TIMEOUT", "150"))
     for attempt in range(2):
-        p = _probe_backend(dict(os.environ), probe_t)
+        p, _why = _probe_backend(dict(os.environ), probe_t)
         if p is not None:
             import jax
             devs = jax.devices()   # proven healthy just now
@@ -184,7 +187,7 @@ def _resolve_backend() -> str:
                        PWASM_BENCH_FALLBACK=pin or "auto")
             if pin == "cpu":
                 env.pop("PALLAS_AXON_POOL_IPS", None)
-            if _probe_backend(env, probe_t) is not None:
+            if _probe_backend(env, probe_t)[0] is not None:
                 print(f"[bench] re-exec with JAX_PLATFORMS={pin!r}",
                       file=sys.stderr)
                 sys.stderr.flush()

@@ -40,7 +40,35 @@ def _workload(T=256, m=192, band=64, seed=0):
     return q, ts, t_lens
 
 
+def _probe_backend_bounded() -> tuple[bool, str]:
+    """The tunnel backend can hang indefinitely when unhealthy; reuse
+    bench.py's bounded subprocess probe (one shared implementation),
+    with the same two-attempt retry its _resolve_backend uses because
+    tunnel errors are documented as transient.  Returns (healthy,
+    diagnostic-from-the-last-attempt)."""
+    from bench import _probe_backend
+
+    try:
+        t = float(os.environ.get("PWASM_BENCH_PROBE_TIMEOUT", "150"))
+    except ValueError:
+        t = 150.0
+    why = ""
+    for _attempt in range(2):
+        platform, why = _probe_backend(dict(os.environ), t)
+        if platform is not None:
+            return True, ""
+    return False, why
+
+
 def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    healthy, why = _probe_backend_bounded()
+    if not healthy:
+        print(json.dumps({"smoke": "pallas_lowering", "ok": False,
+                          "error": "jax backend unreachable "
+                                   f"(tunnel down?): {why}"}))
+        return 1
+
     import jax.numpy as jnp
 
     from pwasm_tpu.ops import on_tpu_backend
