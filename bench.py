@@ -600,9 +600,13 @@ def cfg4_consensus() -> int:
                       or "out of memory" in msg.lower()
                       or "ran out of memory" in msg.lower())
             pd = zero = None  # release before the smaller attempt
-            if not oomish or cols <= (1 << 20):
+            if not oomish:
                 raise
-            cols //= 4
+            nxt = max(cols // 4, 1 << 20)  # never shrink below the
+            # 1 M-column floor (smaller is dispatch-bound/unstable)
+            if nxt >= cols:
+                raise
+            cols = nxt
             print(f"[bench] device OOM ({msg[:200]}); retrying with "
                   f"cols={cols}", file=sys.stderr)
     if rate is None:

@@ -908,10 +908,15 @@ int run_clip_selftest(const std::string& path) {
     if (line.empty()) continue;
     std::vector<std::string> fld = split_tabs(line);
     if (fld[0] == "SEQ") {
-      if (fld.size() != 8) throw PwErr("clip-selftest: bad SEQ line\n");
+      if (fld.size() != 8 && fld.size() != 9)
+        throw PwErr("clip-selftest: bad SEQ line\n");
       long seqlen = atol(fld[7].c_str());
+      // optional 9th field: the bases, enabling WRITE commands below
+      std::string bases = fld.size() == 9 ? fld[8] : std::string();
+      if (!bases.empty() && (long)bases.size() != seqlen)
+        throw PwErr("clip-selftest: bases/seqlen mismatch\n");
       arena.push_back(std::make_unique<GapSeq>(
-          fld[1], "", seqlen, atol(fld[3].c_str()),
+          fld[1], bases, seqlen, atol(fld[3].c_str()),
           (int)atol(fld[2].c_str())));
       GapSeq* s = arena.back().get();
       s->clp5 = atol(fld[4].c_str());
@@ -935,6 +940,21 @@ int run_clip_selftest(const std::string& path) {
                                   atol(fld[3].c_str()), clipmax, ops);
       if (ok) msa.apply_clipping(ops);
       printf("%s\n", ok ? "ok" : "rejected");
+    } else if (fld[0] == "WRITE") {
+      // emit a writer's output for the current (possibly clip-bearing)
+      // MSA — parity-fuzzes the clip paths of write_ace/write_info
+      // (QA clip math, negative AF offsets, seql/seqr strand swap)
+      // that the CLI flow can never reach
+      if (fld.size() != 2) throw PwErr("clip-selftest: bad WRITE line\n");
+      if (msa.count() < 2)  // an unseeded MSA has no layout (length 0)
+        throw PwErr("clip-selftest: WRITE needs a seeded MSA "
+                    "(>= 2 SEQ lines)\n");
+      if (fld[1] == "ace")
+        msa.write_ace(stdout, "ctg", false, false);
+      else if (fld[1] == "info")
+        msa.write_info(stdout, "ctg", false, false);
+      else
+        throw PwErr("clip-selftest: unknown WRITE kind\n");
     }
   }
   fclose(f);

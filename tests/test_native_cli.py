@@ -686,6 +686,39 @@ def test_parity_resume(tmp_path):
     assert (tmp_path / "fresh.dfa").read_bytes() == body
 
 
+def _write_selftest_seqs(f, specs, with_bases=False):
+    """Serialize SEQ lines for the --clip-selftest hook."""
+    for sp in specs:
+        row = (f"SEQ\t{sp['name']}\t{sp['revcompl']}\t{sp['offset']}\t"
+               f"{sp['clp5']}\t{sp['clp3']}\t"
+               f"{','.join(map(str, sp['gaps']))}\t{sp['seqlen']}")
+        if with_bases:
+            row += f"\t{sp['bases']}"
+        f.write(row + "\n")
+
+
+def _build_python_msa(specs):
+    """The Python-engine twin of the hook's MSA construction."""
+    import numpy as np
+
+    from pwasm_tpu.align.gapseq import GapSeq
+    from pwasm_tpu.align.msa import Msa
+
+    pseqs = []
+    for sp in specs:
+        s = GapSeq(sp["name"], "", sp.get("bases", "").encode(),
+                   seqlen=sp["seqlen"], offset=sp["offset"],
+                   clp5=sp["clp5"], clp3=sp["clp3"],
+                   revcompl=sp["revcompl"])
+        s.gaps = np.asarray(sp["gaps"], dtype=np.int32)
+        s.numgaps = int(sum(sp["gaps"]))
+        pseqs.append(s)
+    msa = Msa(pseqs[0], pseqs[1])
+    for s in pseqs[2:]:
+        msa.add_seq(s, s.offset, s.ng_ofs)
+    return msa, pseqs
+
+
 def test_clip_transaction_parity_fuzz(tmp_path):
     """Clip-transaction fuzz: the native eval_clipping/apply_clipping
     (GapAssem.cpp:823-996 capability) must accept/reject and apply
@@ -721,11 +754,7 @@ def test_clip_transaction_parity_fuzz(tmp_path):
         infile = tmp_path / f"clip{case}.tsv"
         with open(infile, "w") as f:
             f.write(f"{clipmax}\n")
-            for sp in seqs_spec:
-                f.write(f"SEQ\t{sp['name']}\t{sp['revcompl']}\t"
-                        f"{sp['offset']}\t{sp['clp5']}\t{sp['clp3']}\t"
-                        f"{','.join(map(str, sp['gaps']))}\t"
-                        f"{sp['seqlen']}\n")
+            _write_selftest_seqs(f, seqs_spec)
             for idx, c5, c3 in evals:
                 f.write(f"EVAL\t{idx}\t{c5}\t{c3}\n")
         rc, out, err = _run_native([f"--clip-selftest={infile}"])
@@ -737,17 +766,7 @@ def test_clip_transaction_parity_fuzz(tmp_path):
             name, c5, c3 = line.split("\t")
             got_clips[name] = (int(c5), int(c3))
         # python side
-        pseqs = []
-        for sp in seqs_spec:
-            s = GapSeq(sp["name"], "", b"", seqlen=sp["seqlen"],
-                       offset=sp["offset"], clp5=sp["clp5"],
-                       clp3=sp["clp3"], revcompl=sp["revcompl"])
-            s.gaps = np.asarray(sp["gaps"], dtype=np.int32)
-            s.numgaps = int(sum(sp["gaps"]))
-            pseqs.append(s)
-        msa = Msa(pseqs[0], pseqs[1])
-        for s in pseqs[2:]:
-            msa.add_seq(s, s.offset, s.ng_ofs)
+        msa, pseqs = _build_python_msa(seqs_spec)
         want_verdicts = []
         for idx, c5, c3 in evals:
             ops = AlnClipOps()
@@ -759,6 +778,65 @@ def test_clip_transaction_parity_fuzz(tmp_path):
         for s in pseqs:
             assert got_clips[s.name] == (s.clp5, s.clp3), \
                 f"case {case} seq {s.name}"
+
+
+def test_clip_bearing_writers_parity_fuzz(tmp_path):
+    """ACE/info writer parity on MSAs WITH clips — the QA clip math,
+    negative AF offsets and the seql/seqr strand swap are unreachable
+    from the CLI flow (nothing sets clips there), so this drives the
+    native engine's writers directly via the clip-selftest hook and
+    byte-compares against the Python engine."""
+    import io as _io
+
+    import numpy as np
+
+    from pwasm_tpu.align.gapseq import GapSeq
+    from pwasm_tpu.align.msa import Msa
+
+    rng = random.Random(20260806)
+    for case in range(15):
+        n_seqs = rng.randint(2, 5)
+        seqlen = rng.randint(10, 30)
+        specs = []
+        for k in range(n_seqs):
+            bases = "".join(rng.choice("ACGT") for _ in range(seqlen))
+            gaps = [0] * seqlen
+            for _ in range(rng.randint(0, 3)):
+                gaps[rng.randint(0, seqlen - 1)] = rng.randint(1, 2)
+            # member 0 stays unclipped so every layout column keeps at
+            # least one unclipped contributor (an all-clipped column
+            # would be a zero-coverage exit-5 on both sides)
+            clp5 = rng.randint(0, 3) if k else 0
+            clp3 = (rng.randint(0, max(0, seqlen // 2 - clp5 - 2))
+                    if k else 0)
+            specs.append(dict(name=f"s{k}", revcompl=rng.randint(0, 1),
+                              offset=0, clp5=clp5, clp3=clp3,
+                              gaps=gaps, bases=bases, seqlen=seqlen))
+        # same layout length for every member keeps the MSA covered
+        # (no zero-coverage exit-5 columns) without gap propagation
+        total = [sum(sp["gaps"]) for sp in specs]
+        mx = max(total)
+        for sp, t in zip(specs, total):
+            if t < mx:
+                sp["gaps"][0] += mx - t
+        infile = tmp_path / f"wclip{case}.tsv"
+        with open(infile, "w") as f:
+            f.write("0.0\n")
+            _write_selftest_seqs(f, specs, with_bases=True)
+            f.write("WRITE\tace\nWRITE\tinfo\n")
+        rc, out, err = _run_native([f"--clip-selftest={infile}"])
+        assert rc == 0, err
+        # strip the trailing per-seq clip-summary lines (tab-separated,
+        # unlike the space-separated writer bodies)
+        native_out = out[:out.rfind(f"{specs[0]['name']}\t")]
+        # python twin
+        msa, _pseqs = _build_python_msa(specs)
+        buf = _io.StringIO()
+        msa.write_ace(buf, "ctg", remove_cons_gaps=False,
+                      refine_clipping=False)
+        msa.write_info(buf, "ctg", remove_cons_gaps=False,
+                       refine_clipping=False)
+        assert native_out == buf.getvalue(), f"case {case}"
 
 
 def test_native_rejects_python_only_features(tmp_path):
