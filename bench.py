@@ -846,8 +846,19 @@ def _run_all() -> int:
            "vs_baseline": 0, "config": 0}
     print(json.dumps(row), flush=True)
     table.append(row)
+    # the smoke already probed the backend (bounded, two attempts); if
+    # it proved the tunnel unreachable, pre-pin every config child to
+    # CPU so they don't each spend ~5 minutes re-discovering that
+    backend_down = (not smoke.get("ok")
+                    and "unreachable" in str(smoke.get("error", "")))
+    if backend_down:
+        print("[bench] backend unreachable; pre-pinning configs to cpu",
+              file=sys.stderr)
     for cfg in _ALL_ORDER:
         env = dict(os.environ, PWASM_BENCH_CONFIG=cfg)
+        if backend_down:
+            env.update(JAX_PLATFORMS="cpu", PWASM_BENCH_FALLBACK="cpu")
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         rows = []
         try:
             r = subprocess.run(
