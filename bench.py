@@ -183,10 +183,11 @@ def _resolve_backend() -> str:
         # from the env that just failed — i.e. when a non-empty pin was set
         pins = [""] if os.environ.get("JAX_PLATFORMS") else []
         for pin in pins + ["cpu"]:
-            env = dict(os.environ, JAX_PLATFORMS=pin,
-                       PWASM_BENCH_FALLBACK=pin or "auto")
             if pin == "cpu":
-                env.pop("PALLAS_AXON_POOL_IPS", None)
+                env = _cpu_pin_env(dict(os.environ))
+            else:
+                env = dict(os.environ, JAX_PLATFORMS=pin,
+                           PWASM_BENCH_FALLBACK=pin or "auto")
             if _probe_backend(env, probe_t)[0] is not None:
                 print(f"[bench] re-exec with JAX_PLATFORMS={pin!r}",
                       file=sys.stderr)
@@ -196,6 +197,14 @@ def _resolve_backend() -> str:
                           env)
     raise RuntimeError("no healthy jax backend (tunnel down; cpu probe "
                        "failed too)")
+
+
+def _cpu_pin_env(env: dict) -> dict:
+    """The one recipe for pinning a child process to the CPU backend
+    (used by _resolve_backend's re-exec and run-all's pre-pin)."""
+    env.update(JAX_PLATFORMS="cpu", PWASM_BENCH_FALLBACK="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
 
 
 def _scale_for_fallback(cfg: str) -> None:
@@ -832,7 +841,13 @@ def _run_all() -> int:
         if r.returncode != 0:
             rc = 1
     except Exception as e:
+        import subprocess as _sp
         smoke = {"smoke": "pallas_lowering", "ok": False,
+                 # a smoke TIMEOUT means the tunnel hung mid-kernels —
+                 # the children would hang the same way, so pin them;
+                 # other parent-side failures say nothing about the
+                 # backend and must not downgrade a healthy capture
+                 "backend_down": isinstance(e, _sp.TimeoutExpired),
                  "error": f"{type(e).__name__}: {e}"}
         rc = 1
         try:  # never leave a stale passing artifact from a prior round
@@ -847,18 +862,18 @@ def _run_all() -> int:
     print(json.dumps(row), flush=True)
     table.append(row)
     # the smoke already probed the backend (bounded, two attempts); if
-    # it proved the tunnel unreachable, pre-pin every config child to
-    # CPU so they don't each spend ~5 minutes re-discovering that
-    backend_down = (not smoke.get("ok")
-                    and "unreachable" in str(smoke.get("error", "")))
+    # it proved the tunnel unreachable — the structured backend_down
+    # flag, set by tpu_smoke's probe or by a smoke timeout above —
+    # pre-pin every config child to CPU so they don't each spend ~5
+    # minutes (or a 30-minute hang) re-discovering that
+    backend_down = bool(smoke.get("backend_down"))
     if backend_down:
         print("[bench] backend unreachable; pre-pinning configs to cpu",
               file=sys.stderr)
     for cfg in _ALL_ORDER:
         env = dict(os.environ, PWASM_BENCH_CONFIG=cfg)
         if backend_down:
-            env.update(JAX_PLATFORMS="cpu", PWASM_BENCH_FALLBACK="cpu")
-            env.pop("PALLAS_AXON_POOL_IPS", None)
+            _cpu_pin_env(env)
         rows = []
         try:
             r = subprocess.run(

@@ -64,7 +64,10 @@ def main() -> int:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     healthy, why = _probe_backend_bounded()
     if not healthy:
+        # backend_down is the STRUCTURED signal bench.py's run-all keys
+        # on to pre-pin config children to CPU (don't rely on wording)
         print(json.dumps({"smoke": "pallas_lowering", "ok": False,
+                          "backend_down": True,
                           "error": "jax backend unreachable "
                                    f"(tunnel down?): {why}"}))
         return 1
