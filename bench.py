@@ -393,6 +393,12 @@ def cfg1_cli_cpu_ref() -> int:
         env = dict(os.environ,
                    PYTHONPATH=repo + (os.pathsep + old_pp if old_pp
                                       else ""))
+        # pin the child to CPU: the CLI's plain report path never
+        # touches jax, but this environment's site hook performs a
+        # tunnel handshake at interpreter start (~1.6 s) unless pinned —
+        # py_cli_wall_s should measure the CLI, not the hook
+        env.update(JAX_PLATFORMS="cpu")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         py_times = []
         for _ in range(3):
             t0 = time.perf_counter()
