@@ -840,7 +840,15 @@ def _run_all() -> int:
             [sys.executable, os.path.join(repo, "tpu_smoke.py")],
             env=dict(os.environ), capture_output=True, text=True,
             timeout=child_t + 120 if child_t > 0 else None)
-        smoke_line = [l for l in r.stdout.splitlines() if l.strip()][-1]
+        smoke_lines = [l for l in r.stdout.splitlines() if l.strip()]
+        smoke_line = smoke_lines[-1] if smoke_lines else ""
+        if r.returncode < 0 or not smoke_line:
+            # killed by a signal (OOM-kill etc.) or produced nothing:
+            # the smoke never got far enough to vouch for the backend —
+            # treat it as down so children don't each re-discover an
+            # unreachable tunnel the slow way
+            raise RuntimeError(
+                f"smoke produced no verdict (rc={r.returncode})")
         smoke = json.loads(smoke_line)
         with open(os.path.join(repo, "TPU_SMOKE.json"), "w") as f:
             f.write(smoke_line + "\n")
@@ -851,9 +859,14 @@ def _run_all() -> int:
         smoke = {"smoke": "pallas_lowering", "ok": False,
                  # a smoke TIMEOUT means the tunnel hung mid-kernels —
                  # the children would hang the same way, so pin them;
-                 # other parent-side failures say nothing about the
-                 # backend and must not downgrade a healthy capture
-                 "backend_down": isinstance(e, _sp.TimeoutExpired),
+                 # a signal-killed or mute smoke (RuntimeError above, or
+                 # an unparseable verdict line) likewise never proved the
+                 # backend healthy; other parent-side failures say
+                 # nothing about the backend and must not downgrade a
+                 # healthy capture
+                 "backend_down": isinstance(
+                     e, (_sp.TimeoutExpired, RuntimeError,
+                         json.JSONDecodeError)),
                  "error": f"{type(e).__name__}: {e}"}
         rc = 1
         try:  # never leave a stale passing artifact from a prior round

@@ -49,10 +49,16 @@ def load_motifs(path: str) -> tuple[str, ...]:
     Motifs are DNA strings, so the file must be ASCII text — opening with
     ``encoding="ascii"`` keeps the native binary's byte-oriented reader
     and this one in exact agreement (both reject non-ASCII content)."""
+    from .errors import PwasmError
+
     out = []
-    with open(path, encoding="ascii") as f:
-        for line in f:
-            line = line.strip().upper()
-            if line and not line.startswith("#"):
-                out.append(line)
+    try:
+        with open(path, encoding="ascii") as f:
+            for line in f:
+                line = line.strip().upper()
+                if line and not line.startswith("#"):
+                    out.append(line)
+    except UnicodeDecodeError as e:
+        raise PwasmError(
+            f"Error: motif file {path} is not ASCII text ({e})") from e
     return tuple(out)

@@ -262,7 +262,8 @@ void validate_coords(const AlnInfo& al, const std::string& line) {
           "Error parsing cs string from line: %s (cs position: %s)\n",
           line.c_str(), rec.cs.substr((size_t)a).c_str()));
     case 2: {
-      char refc = a < (long)refseq_aln.size() ? refseq_aln[a] : '?';
+      char refc =
+          (a >= 0 && a < (long)refseq_aln.size()) ? refseq_aln[a] : '?';
       throw PwErr(sformat(
           "Error: base mismatch %c != qstr[%ld] (%c) at line\n%s\n",
           (char)b, a, refc, line.c_str()));

@@ -95,7 +95,11 @@ class LineReader {
       if (pos_ >= len_) {
         len_ = fread(buf_, 1, sizeof buf_, f_);
         pos_ = 0;
-        if (len_ == 0) return !line.empty();
+        if (len_ == 0) {
+          if (ferror(f_))
+            throw PwErr("Error: read failure on input stream\n");
+          return !line.empty();
+        }
       }
       if (pending_cr_) {  // swallow the '\n' of a '\r\n' pair
         pending_cr_ = false;
