@@ -656,6 +656,7 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
             ref_msa.write_info(cons_outs["info"], contig)
         if "cons" in cons_outs:
             ref_msa.write_cons(cons_outs["cons"], contig)
+        stats.engine_fallbacks += ref_msa.engine_fallbacks
     for f in cons_outs.values():
         f.close()
     if fsummary is not None:
@@ -675,6 +676,10 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         # in the once-per-run warning scrolled past hours earlier
         print(f"Warning: {stats.fallback_batches}/{stats.device_batches} "
               "device batches fell back to the host scalar path",
+              file=stderr)
+    if stats.engine_fallbacks:
+        print(f"Warning: {stats.engine_fallbacks} MSA engine stage(s) "
+              "fell back from the requested device/native path",
               file=stderr)
     if cfg.verbose:
         print(stats.brief(), file=stderr)

@@ -815,7 +815,8 @@ struct RunStats {
             "%ld, \"skipped_duplicates\": %ld, \"skipped_self\": %ld, "
             "\"resumed_past\": %ld, \"aligned_bases\": %ld, \"events\": "
             "%ld, \"device_batches\": 0, \"fallback_batches\": 0, "
-            "\"realigned\": 0, \"msa_dropped\": %ld, \"wall_s\": %.3f, "
+            "\"realigned\": 0, \"msa_dropped\": %ld, "
+            "\"engine_fallbacks\": 0, \"wall_s\": %.3f, "
             "\"aligned_bases_per_s\": %.1f}\n",
             lines, alignments, skipped_bad, skipped_dedup, skipped_self,
             resumed_past, aligned_bases, events, msa_dropped, w, rate);

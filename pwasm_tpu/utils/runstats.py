@@ -31,6 +31,8 @@ class RunStats:
         self.realigned = 0        # alignments re-aligned (--realign)
         self.msa_dropped = 0      # reported alignments excluded from
         #                           the MSA (bad gap structure)
+        self.engine_fallbacks = 0  # engine-level device/native demotions
+        #                            inside the MSA consensus path
 
     @property
     def wall_s(self) -> float:
@@ -55,6 +57,7 @@ class RunStats:
             "fallback_batches": self.fallback_batches,
             "realigned": self.realigned,
             "msa_dropped": self.msa_dropped,
+            "engine_fallbacks": self.engine_fallbacks,
             "wall_s": round(self.wall_s, 3),
             "aligned_bases_per_s": round(self.rate(), 1),
         }

@@ -1,6 +1,7 @@
 """End-to-end CLI tests: report + MSA outputs, modes, exit codes."""
 
 import io
+import json
 import subprocess
 import sys
 
@@ -261,6 +262,30 @@ def test_consensus_device_matches_cpu(tmp_path):
               f"--ace={out_dev}", "--device=tpu"], stderr=io.StringIO())
     assert rc == 0
     assert out_dev.read_text() == out_cpu.read_text()
+
+
+def test_ace_remove_cons_gaps_device_no_fallback(tmp_path):
+    """--ace --remove-cons-gaps --device=tpu: the whole consensus path
+    (counts+votes, gap-column removal, both refine passes) runs without
+    any engine-level host demotion (VERDICT r3 item 4) — byte-identical
+    to the cpu run and engine_fallbacks == 0 in --stats."""
+    paf, fa = _mk_inputs(tmp_path, _three_alignments())
+    outs = {}
+    for dev in ("cpu", "tpu"):
+        ace = tmp_path / f"{dev}.ace"
+        info = tmp_path / f"{dev}.info"
+        stats = tmp_path / f"{dev}.stats"
+        err = io.StringIO()
+        rc = run([paf, "-r", fa, "-o", str(tmp_path / f"r_{dev}.dfa"),
+                  f"--ace={ace}", f"--info={info}", "--remove-cons-gaps",
+                  f"--device={dev}", f"--stats={stats}"], stderr=err)
+        assert rc == 0
+        assert "fell back" not in err.getvalue()
+        assert "unavailable" not in err.getvalue()
+        d = json.loads(stats.read_text())
+        assert d["engine_fallbacks"] == 0
+        outs[dev] = ace.read_text() + info.read_text()
+    assert outs["cpu"] == outs["tpu"]
 
 
 def test_ace_device_deep_pileup_kernel_counts(tmp_path, monkeypatch):

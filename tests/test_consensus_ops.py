@@ -195,28 +195,68 @@ def test_refine_msa_device_full_parity(seed):
         assert (sh.clp5, sh.clp3) == (sd.clp5, sd.clp3)
 
 
-def test_refine_msa_device_falls_back_on_deleted_bases(capsys):
-    """An MSA with deleted bases (negative gaps) can't use the device
-    pileup exactly; device=True must degrade to host counting loudly,
-    not raise and not drift."""
-    dev = _random_msa(1)
-    dev.seqs[1].remove_base(2)
-    host = _random_msa(1)
-    host.seqs[1].remove_base(2)
+@pytest.mark.parametrize("seed", range(3))
+def test_refine_msa_device_survives_deleted_bases(seed, capsys):
+    """An MSA with deleted bases (negative gaps) stays on the device
+    path: collided column occupants spill onto extra pileup rows so the
+    device counts remain bit-exact vs the host scatter-adds (VERDICT r3
+    item 4) — no demotion, engine_fallbacks stays zero."""
+    dev = _random_msa(seed)
+    host = _random_msa(seed)
+    for m in (dev, host):
+        # delete a few interior bases (the --remove-cons-gaps state),
+        # including adjacent ones so collision multiplicity exceeds 2
+        for s_idx, pos in [(1, 2), (1, 3), (0, 5)]:
+            if m.seqs[s_idx].seqlen > pos + 2:
+                m.seqs[s_idx].remove_base(pos)
     host.refine_msa(remove_cons_gaps=False)
     dev.refine_msa(remove_cons_gaps=False, device=True)
     assert bytes(dev.consensus) == bytes(host.consensus)
-    assert "fall back to host" in capsys.readouterr().err
+    np.testing.assert_array_equal(dev.msacolumns.counts,
+                                  host.msacolumns.counts)
+    np.testing.assert_array_equal(dev.msacolumns.layers,
+                                  host.msacolumns.layers)
+    assert dev.engine_fallbacks == 0
+    assert "fall back to host" not in capsys.readouterr().err
 
 
-def test_pileup_matrix_rejects_post_refine_msa():
-    """Deleted bases (negative gaps) make the cumsum pileup layout
-    inexact; pileup_matrix must refuse rather than silently drift
-    (VERDICT r1 weak #6)."""
+def test_pileup_matrix_spills_collided_columns():
+    """With a deleted base, the member contributes two symbols to one
+    column; the pileup matrix grows a spill row carrying the second
+    occupant, and per-column code counts over the matrix match the host
+    scatter counts exactly."""
+    msa = _random_msa(0)
+    depth = len(msa.seqs)
+    assert msa.pileup_matrix().shape[0] == depth   # pre-refine: no spill
+    msa.seqs[1].remove_base(2)                     # a deleted base
+    mat = msa.pileup_matrix()
+    assert mat.shape[0] > depth                    # spill row appended
+    host = _random_msa(0)
+    host.seqs[1].remove_base(2)
+    host.build_msa()                               # host scatter counts
+    counts = np.zeros((msa.length, 6), dtype=np.int32)
+    for code in range(6):
+        counts[:, code] = (mat == code).sum(axis=0)
+    np.testing.assert_array_equal(counts, host.msacolumns.counts)
+
+
+def test_stranded_deleted_base_raises_on_both_paths():
+    """A deleted base whose collapsed column falls before the layout
+    start is uncountable: the host scatter would wrap the negative
+    index and the device matrix has no cell for it.  Both build paths
+    must refuse loudly rather than drift."""
     from pwasm_tpu.core.errors import PwasmError
 
-    msa = _random_msa(0)
-    msa.pileup_matrix()                      # pre-refine: fine
-    msa.seqs[1].remove_base(2)               # a deleted base
-    with pytest.raises(PwasmError, match="post-refine"):
-        msa.pileup_matrix()
+    def _strand(m):
+        lead = min(m.seqs, key=lambda s: s.offset)
+        # ensure no gap run can absorb the deletion, then delete the
+        # very first base of the leftmost member: its column collapses
+        # to offset-minoffset-1 == -1, outside the layout
+        lead.set_gap(0, 0)
+        lead.remove_base(0)
+
+    for device in (False, True):
+        msa = _random_msa(0)
+        _strand(msa)
+        with pytest.raises(PwasmError, match="outside the layout"):
+            msa.build_msa(device=device)

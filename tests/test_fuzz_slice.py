@@ -1,0 +1,52 @@
+"""Bounded, seeded slice of the qa/extended_fuzz.py adversarial sweeps.
+
+The full sweeps run ad hoc per round (and found two real defects in
+round 3), but nothing forced them to run — this gate runs a ~30 s
+deterministic slice of every sweep inside the normal pytest run, so a
+regression in any fuzzed surface fails CI, not just builder discipline
+(VERDICT r3 item 7).  Budgets are per-sweep trial counts, not wall
+clock, so the slice is reproducible bit-for-bit (each sweep seeds its
+own RNG from a constant).
+"""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+_QA = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "qa", "extended_fuzz.py")
+
+
+@pytest.fixture(scope="module")
+def fuzz():
+    spec = importlib.util.spec_from_file_location("extended_fuzz", _QA)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["extended_fuzz"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_slice_refine_batch(fuzz):
+    assert fuzz.sweep_refine_batch(seeds=6)
+
+
+def test_slice_realign_oracle(fuzz):
+    assert fuzz.sweep_realign_oracle(seeds=4)
+
+
+def test_slice_fai_roundtrip(fuzz):
+    assert fuzz.sweep_fai_roundtrip(trials=20)
+
+
+def test_slice_paf_corruption(fuzz):
+    assert fuzz.sweep_paf_corruption(trials=3000)
+
+
+def test_slice_cli_parity(fuzz):
+    assert fuzz.sweep_cli_parity(trials=2)
+
+
+def test_slice_native_cli_parity(fuzz):
+    assert fuzz.sweep_native_cli_parity(trials=3)
