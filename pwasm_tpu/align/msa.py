@@ -402,8 +402,11 @@ class Msa:
         GAlnColumn GapAssem.h:255-342): instead of a linked list of
         (seq, pos) per column, one dense index tensor aligned with the
         pileup codes, so "which read put which base here" is a gather.
-        Pre-refine MSAs only, like pileup_matrix (same exactness
-        argument)."""
+        Pre-refine MSAs only (enforced below): rows map 1:1 to members,
+        and a deleted base would collide two source positions onto one
+        cell — unlike pileup_matrix, whose counts are row-order-free and
+        so can spill collisions onto extra rows, provenance has no such
+        escape."""
         for s in self.seqs:
             if (s.gaps < 0).any():
                 raise PwasmError(

@@ -70,7 +70,8 @@ def get_lib():
         if os.environ.get("PWASM_NATIVE", "1") == "0":
             return None
         try:
-            so_deps = [_SRC, os.path.join(_HERE, "pafreport_util.h")]
+            so_deps = [_SRC, os.path.join(_HERE, "pafreport_util.h"),
+                       os.path.join(_HERE, "pafreport_msa.h")]
             if (not os.path.exists(_SO)
                     or any(os.path.getmtime(_SO) < os.path.getmtime(d)
                            for d in so_deps)):
@@ -107,6 +108,34 @@ def get_lib():
             ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p,
             ctypes.c_void_p]
+        lib.pw_msa_new.restype = ctypes.c_void_p
+        lib.pw_msa_new.argtypes = []
+        lib.pw_msa_free.restype = None
+        lib.pw_msa_free.argtypes = [ctypes.c_void_p]
+        lib.pw_msa_reset.restype = None
+        lib.pw_msa_reset.argtypes = [ctypes.c_void_p]
+        lib.pw_msa_count.restype = ctypes.c_int64
+        lib.pw_msa_count.argtypes = [ctypes.c_void_p]
+        lib.pw_msa_add.restype = ctypes.c_int
+        lib.pw_msa_add.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int32]
+        lib.pw_msa_refine.restype = ctypes.c_int
+        lib.pw_msa_refine.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int32]
+        lib.pw_msa_write.restype = ctypes.c_int
+        lib.pw_msa_write.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int32]
+        lib.pw_msa_contig.restype = None
+        lib.pw_msa_contig.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32]
         _lib = lib
     return _lib
 
@@ -450,3 +479,126 @@ def unpack_2bit(packed: np.ndarray, n: int) -> np.ndarray | None:
     lib.pw_unpack_2bit(p.ctypes.data_as(ctypes.c_void_p), n,
                        out.ctypes.data_as(ctypes.c_void_p))
     return out
+
+# ---------------------------------------------------------------------------
+# Progressive-MSA engine delegation (VERDICT r3 item 5): the Python CLI
+# ships the native C++ MSA engine (pafreport_msa.h, ~8x faster per
+# progressive merge than the Python engine) — this handle lets the CLI
+# use it for -w / consensus builds on the pure-CPU path, byte-identical
+# by the same parity contract the standalone binary is held to.
+# ---------------------------------------------------------------------------
+_MSA_WRITE_KINDS = {"mfa": 0, "ace": 1, "info": 2, "cons": 3, "layout": 4}
+
+
+class NativeMsa:
+    """ctypes handle to the native progressive-MSA engine.  Mirrors the
+    cli.py msa_add protocol: ``add`` one alignment at a time, ``reset``
+    on query change, then ``write``/``refine`` at end of input.  Engine
+    warnings are captured per call and replayed through sys.stderr —
+    the same stream the Python engine's warnings use."""
+
+    def __init__(self, lib):
+        import tempfile
+
+        self._lib = lib
+        self._h = lib.pw_msa_new()
+        self._err = ctypes.create_string_buffer(8192)
+        fd, self._warn_path = tempfile.mkstemp(prefix="pwasm_msa_warn_")
+        os.close(fd)
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.pw_msa_free(self._h)
+            self._h = None
+        try:
+            os.unlink(self._warn_path)
+        except OSError:
+            pass
+
+    def __del__(self):  # belt: free the C++ arena with the object
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def reset(self) -> None:
+        self._lib.pw_msa_reset(self._h)
+
+    def count(self) -> int:
+        return int(self._lib.pw_msa_count(self._h))
+
+    def contig(self) -> str:
+        buf = ctypes.create_string_buffer(4096)
+        self._lib.pw_msa_contig(self._h, buf, len(buf))
+        return buf.value.decode("utf-8", "replace")
+
+    def _replay_warnings(self) -> None:
+        try:
+            with open(self._warn_path, "r") as f:
+                text = f.read()
+        except OSError:
+            return
+        if text:
+            sys.stderr.write(text)
+
+    def _raise(self, rc: int) -> None:
+        from pwasm_tpu.core.errors import PwasmError, ZeroCoverageError
+
+        msg = self._err.value.decode("utf-8", "replace")
+        if rc == 5:
+            raise ZeroCoverageError(msg)
+        raise PwasmError(msg or f"native MSA engine failed (code {rc})\n")
+
+    def add(self, tlabel: str, tseq: bytes, t_offset: int, reverse: int,
+            rid: str, refseq: bytes, r_len: int,
+            rgaps, tgaps, ord_num: int) -> bool:
+        """Insert one alignment.  Returns False when the alignment's gap
+        structure does not fit the layout (the --skip-bad-lines case —
+        nothing was mutated; ``gap_err`` holds the engine's message for
+        the caller's fatal path); raises on other engine errors."""
+        rg = np.asarray([(g.pos, g.len) for g in rgaps],
+                        dtype=np.int32).reshape(-1)
+        tg = np.asarray([(g.pos, g.len) for g in tgaps],
+                        dtype=np.int32).reshape(-1)
+        rc = self._lib.pw_msa_add(
+            self._h, tlabel.encode(), tseq, len(tseq), t_offset,
+            int(reverse), rid.encode(), refseq, len(refseq), r_len,
+            rg.ctypes.data_as(ctypes.c_void_p), len(rg) // 2,
+            tg.ctypes.data_as(ctypes.c_void_p), len(tg) // 2,
+            ord_num, self._err, len(self._err))
+        if rc == 1:
+            self.gap_err = self._err.value.decode("utf-8", "replace")
+            return False
+        if rc != 0:
+            self._raise(rc)
+        return True
+
+    def refine(self, remove_cons_gaps: bool, refine_clipping: bool) -> None:
+        rc = self._lib.pw_msa_refine(
+            self._h, int(remove_cons_gaps), int(refine_clipping),
+            self._warn_path.encode(), self._err, len(self._err))
+        self._replay_warnings()
+        if rc != 0:
+            self._raise(rc)
+
+    def write(self, kind: str, path: str, contig: str = "contig",
+              remove_cons_gaps: bool = True,
+              refine_clipping: bool = True) -> None:
+        rc = self._lib.pw_msa_write(
+            self._h, _MSA_WRITE_KINDS[kind], os.fsencode(path),
+            contig.encode(), int(remove_cons_gaps), int(refine_clipping),
+            self._warn_path.encode(), self._err, len(self._err))
+        self._replay_warnings()
+        if rc != 0:
+            self._raise(rc)
+
+
+def native_msa() -> NativeMsa | None:
+    """A fresh native MSA engine handle, or None when the native library
+    is unavailable or delegation is disabled (PWASM_NATIVE_MSA=0)."""
+    if os.environ.get("PWASM_NATIVE_MSA", "1") == "0":
+        return None
+    lib = get_lib()
+    if lib is None:
+        return None
+    return NativeMsa(lib)

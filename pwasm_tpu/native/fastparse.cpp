@@ -727,3 +727,231 @@ int64_t pw_gotoh_traceback(const int8_t* q, int64_t m, const int8_t* t,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Progressive-MSA engine bridge: the Python CLI delegates its -w /
+// consensus MSA builds to the native engine (pafreport_msa.h) through
+// this C ABI, mirroring cli.py msa_add / the end-of-run writer block of
+// pafreport_main.cpp verbatim (byte parity with the Python engine is
+// enforced by tests/test_native_cli.py + tests/test_native_msa_bridge.py).
+// Engine warnings are redirected into a caller-given capture file so the
+// Python side can replay them through sys.stderr.
+// ---------------------------------------------------------------------------
+
+#include "pafreport_msa.h"
+
+namespace {
+
+struct MsaBridge {
+  std::vector<std::unique_ptr<pwnative::GapSeq>> seq_arena;
+  std::vector<std::unique_ptr<pwnative::Msa>> msa_arena;
+  pwnative::GapSeq* ref_gseq = nullptr;
+  pwnative::Msa* ref_msa = nullptr;
+};
+
+void fill_err(char* errbuf, int32_t errcap, const std::string& msg) {
+  if (errbuf && errcap > 0) {
+    snprintf(errbuf, (size_t)errcap, "%s", msg.c_str());
+  }
+}
+
+// Redirect the engine's warning sink to a capture file for the duration
+// of one bridge call (NULL path = leave it on stderr).
+struct WarnCapture {
+  FILE* prev;
+  FILE* f = nullptr;
+  explicit WarnCapture(const char* path) : prev(pwnative::warn_stream()) {
+    if (path && *path) {
+      f = fopen(path, "wb");
+      if (f) pwnative::warn_stream() = f;
+    }
+  }
+  ~WarnCapture() {
+    pwnative::warn_stream() = prev;
+    if (f) fclose(f);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pw_msa_new() { return new MsaBridge(); }
+
+void pw_msa_free(void* h) { delete (MsaBridge*)h; }
+
+// A new query starts a new MSA (cli.py: ref_gseq = None on query
+// change).  Only the seed pointer resets here — ref_msa and the arena
+// survive until the new query's FIRST SUCCESSFUL add (the lazy release
+// in pw_msa_add), so that a final query whose alignments are all
+// dropped under --skip-bad-lines still writes the previous query's MSA,
+// exactly like the Python engine and the standalone binary.
+void pw_msa_reset(void* h) {
+  MsaBridge* b = (MsaBridge*)h;
+  b->ref_gseq = nullptr;
+}
+
+int64_t pw_msa_count(void* h) {
+  MsaBridge* b = (MsaBridge*)h;
+  return b->ref_msa ? (int64_t)b->ref_msa->count() : 0;
+}
+
+// Contig name for the consensus writers: the MSA's first member (the
+// cli.py `ref_msa.seqs[0].name` — order may change after a strand
+// flip's re-sort, so the Python side cannot derive it).
+void pw_msa_contig(void* h, char* buf, int32_t cap) {
+  MsaBridge* b = (MsaBridge*)h;
+  const std::string name =
+      (b->ref_msa && !b->ref_msa->seqs.empty())
+          ? b->ref_msa->seqs[0]->name
+          : std::string("contig");
+  snprintf(buf, (size_t)cap, "%s", name.c_str());
+}
+
+// Insert one alignment (cli.py msa_add / pafreport_main.cpp msa_add).
+// refseq is the full query sequence (used only for the first alignment
+// of a query; later adds build a bare layout instance of length r_len).
+// rgaps/tgaps are (pos,len) int32 pairs.  Returns 0 ok; 1 out-of-layout
+// gap structure (nothing mutated — the caller handles --skip-bad-lines);
+// -1 other engine error (errbuf).
+int pw_msa_add(void* h, const char* tlabel, const uint8_t* tseq,
+               int64_t tseq_len, int64_t t_offset, int32_t reverse,
+               const char* rid, const uint8_t* refseq, int64_t refseq_len,
+               int64_t r_len, const int32_t* rgaps, int64_t n_rgaps,
+               const int32_t* tgaps, int64_t n_tgaps, int64_t ord_num,
+               char* errbuf, int32_t errcap) {
+  MsaBridge* b = (MsaBridge*)h;
+  try {
+    b->seq_arena.push_back(std::make_unique<pwnative::GapSeq>(
+        tlabel, std::string((const char*)tseq, (size_t)tseq_len), -1,
+        t_offset, reverse));
+    pwnative::GapSeq* taseq = b->seq_arena.back().get();
+    bool first_ref_aln = b->ref_gseq == nullptr;
+    pwnative::GapSeq* rseq;
+    if (first_ref_aln) {
+      b->seq_arena.push_back(std::make_unique<pwnative::GapSeq>(
+          rid, std::string((const char*)refseq, (size_t)refseq_len)));
+      rseq = b->seq_arena.back().get();
+      rseq->set_flag(pwnative::FLAG_IS_REF);
+    } else {  // bare instance of refseq for this alignment
+      b->seq_arena.push_back(
+          std::make_unique<pwnative::GapSeq>(rid, "", r_len));
+      rseq = b->seq_arena.back().get();
+    }
+    // once a gap, always a gap — applied to the fresh objects so an
+    // out-of-layout gap fails BEFORE any MSA mutation
+    try {
+      for (int64_t k = 0; k < n_rgaps; ++k)
+        rseq->set_gap(rgaps[2 * k], rgaps[2 * k + 1]);
+      for (int64_t k = 0; k < n_tgaps; ++k)
+        taseq->set_gap(tgaps[2 * k], tgaps[2 * k + 1]);
+    } catch (const pwnative::PwErr& e) {
+      b->seq_arena.pop_back();
+      b->seq_arena.pop_back();
+      fill_err(errbuf, errcap, e.msg);  // exact set_gap message for the
+      return 1;                        // caller's fatal (non-skip) path
+    }
+    if (first_ref_aln && b->seq_arena.size() > 2) {
+      // only the LAST query's MSA is ever written: release the previous
+      // query's object graph, keeping the new pairwise seed
+      std::unique_ptr<pwnative::GapSeq> t =
+          std::move(b->seq_arena[b->seq_arena.size() - 2]);
+      std::unique_ptr<pwnative::GapSeq> r = std::move(b->seq_arena.back());
+      b->seq_arena.clear();
+      b->seq_arena.push_back(std::move(t));
+      b->seq_arena.push_back(std::move(r));
+      b->msa_arena.clear();
+      b->ref_msa = nullptr;
+    }
+    b->msa_arena.push_back(std::make_unique<pwnative::Msa>(rseq, taseq));
+    pwnative::Msa* newmsa = b->msa_arena.back().get();
+    if (first_ref_aln) {
+      newmsa->ordnum = ord_num;
+      b->ref_msa = newmsa;
+      b->ref_gseq = rseq;
+    } else {
+      b->ref_gseq->msa->add_align(b->ref_gseq, newmsa, rseq);
+      b->ref_msa = b->ref_gseq->msa;
+    }
+    return 0;
+  } catch (const pwnative::PwErr& e) {
+    fill_err(errbuf, errcap, e.msg);
+    return -1;
+  } catch (const std::exception& e) {
+    fill_err(errbuf, errcap, e.what());
+    return -1;
+  }
+}
+
+// finalize + refine_msa (the cli.py consensus block, cli.py:648-651).
+// Returns 0 ok, a PwErr code (5 = zero-coverage column) with the exact
+// message in errbuf, or -1.
+int pw_msa_refine(void* h, int32_t remove_cons_gaps, int32_t refine_clip,
+                  const char* warn_path, char* errbuf, int32_t errcap) {
+  MsaBridge* b = (MsaBridge*)h;
+  if (!b->ref_msa) return 0;
+  WarnCapture cap(warn_path);
+  try {
+    b->ref_msa->finalize();
+    b->ref_msa->refine_msa(remove_cons_gaps != 0, refine_clip != 0);
+    return 0;
+  } catch (const pwnative::PwErr& e) {
+    fill_err(errbuf, errcap, e.msg);
+    return e.code > 0 ? e.code : -1;
+  } catch (const std::exception& e) {
+    fill_err(errbuf, errcap, e.what());
+    return -1;
+  }
+}
+
+// Write one output to ``path``: what 0 = -w multifasta, 1 = ACE,
+// 2 = contig info, 3 = consensus FASTA, 4 = -D layout dump.  ``contig``
+// names the contig for 1-3 (ignored otherwise).  The caller refines
+// first for 1-3 (pw_msa_refine), mirroring the Python CLI's refine-once
+// ordering.  Returns 0 ok, a PwErr code with message, or -1.
+int pw_msa_write(void* h, int32_t what, const char* path,
+                 const char* contig, int32_t remove_cons_gaps,
+                 int32_t refine_clip, const char* warn_path, char* errbuf,
+                 int32_t errcap) {
+  MsaBridge* b = (MsaBridge*)h;
+  if (!b->ref_msa) return 0;
+  WarnCapture cap(warn_path);
+  FILE* f = fopen(path, "wb");
+  if (!f) {
+    fill_err(errbuf, errcap,
+             std::string("Cannot open file ") + path + " for writing!\n");
+    return -1;
+  }
+  int rc = 0;
+  try {
+    switch (what) {
+      case 0: b->ref_msa->write_msa(f); break;
+      case 1:
+        b->ref_msa->write_ace(f, contig, remove_cons_gaps != 0,
+                              refine_clip != 0);
+        break;
+      case 2:
+        b->ref_msa->write_info(f, contig, remove_cons_gaps != 0,
+                               refine_clip != 0);
+        break;
+      case 3:
+        b->ref_msa->write_cons(f, contig, remove_cons_gaps != 0,
+                               refine_clip != 0);
+        break;
+      case 4: b->ref_msa->print_layout(f, 'v'); break;
+      default:
+        fill_err(errbuf, errcap, "pw_msa_write: unknown output kind\n");
+        rc = -1;
+    }
+  } catch (const pwnative::PwErr& e) {
+    fill_err(errbuf, errcap, e.msg);
+    rc = e.code > 0 ? e.code : -1;
+  } catch (const std::exception& e) {
+    fill_err(errbuf, errcap, e.what());
+    rc = -1;
+  }
+  fclose(f);
+  return rc;
+}
+
+}  // extern "C"

@@ -1,0 +1,78 @@
+// Sanitizer driver for the progressive-MSA ctypes bridge (the pw_msa_*
+// C ABI in fastparse.cpp): exercises new/add/reset/refine/write/free —
+// including the skip-bad-lines rejection path, the lazy query-change
+// release, and the warning capture — under ASan/UBSan via `make
+// memcheck`.  The Python test suite drives the same ABI unsanitized
+// (tests/test_native_msa_bridge.py); this catches memory bugs there.
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+extern "C" {
+void* pw_msa_new();
+void pw_msa_free(void*);
+void pw_msa_reset(void*);
+int64_t pw_msa_count(void*);
+void pw_msa_contig(void*, char*, int32_t);
+int pw_msa_add(void*, const char*, const uint8_t*, int64_t, int64_t,
+               int32_t, const char*, const uint8_t*, int64_t, int64_t,
+               const int32_t*, int64_t, const int32_t*, int64_t, int64_t,
+               char*, int32_t);
+int pw_msa_refine(void*, int32_t, int32_t, const char*, char*, int32_t);
+int pw_msa_write(void*, int32_t, const char*, const char*, int32_t,
+                 int32_t, const char*, char*, int32_t);
+}
+
+int main() {
+  char err[4096];
+  void* h = pw_msa_new();
+  const std::string q1 = "ACGTACGTACGTACGTACGTACGTACGT";
+  // first query: seed + one merge (one alignment has a target gap)
+  int rc = pw_msa_add(h, "t1:0-28+", (const uint8_t*)q1.data(),
+                      (int64_t)q1.size(), 0, 0, "q1",
+                      (const uint8_t*)q1.data(), (int64_t)q1.size(),
+                      (int64_t)q1.size(), nullptr, 0, nullptr, 0, 1, err,
+                      sizeof err);
+  assert(rc == 0);
+  const int32_t tg[2] = {14, 2};
+  rc = pw_msa_add(h, "t2:0-30+", (const uint8_t*)q1.data(),
+                  (int64_t)q1.size(), 0, 0, "q1", nullptr, 0,
+                  (int64_t)q1.size(), nullptr, 0, tg, 1, 2, err,
+                  sizeof err);
+  assert(rc == 0 && pw_msa_count(h) == 3);
+  // rejected add: out-of-range gap position fails before any mutation
+  const int32_t badg[2] = {999, 2};
+  rc = pw_msa_add(h, "t3:0-28+", (const uint8_t*)q1.data(),
+                  (int64_t)q1.size(), 0, 0, "q1", nullptr, 0,
+                  (int64_t)q1.size(), badg, 1, nullptr, 0, 3, err,
+                  sizeof err);
+  assert(rc == 1 && strstr(err, "invalid gap position"));
+  assert(pw_msa_count(h) == 3);
+  // query change: lazy reset keeps the old MSA until a successful add
+  pw_msa_reset(h);
+  assert(pw_msa_count(h) == 3);
+  const std::string q2 = "TTTTCCCCGGGGAAAA";
+  rc = pw_msa_add(h, "u1:0-16-", (const uint8_t*)q2.data(),
+                  (int64_t)q2.size(), 0, 1, "q2",
+                  (const uint8_t*)q2.data(), (int64_t)q2.size(),
+                  (int64_t)q2.size(), nullptr, 0, nullptr, 0, 1, err,
+                  sizeof err);
+  assert(rc == 0 && pw_msa_count(h) == 2);
+  char contig[256];
+  pw_msa_contig(h, contig, sizeof contig);
+  assert(contig[0] != '\0');
+  rc = pw_msa_refine(h, 1, 1, "san_msa_warn.tmp", err, sizeof err);
+  assert(rc == 0);
+  for (int what = 0; what <= 4; ++what) {
+    rc = pw_msa_write(h, what, "san_msa_out.tmp", contig, 1, 1,
+                      "san_msa_warn.tmp", err, sizeof err);
+    assert(rc == 0);
+  }
+  pw_msa_free(h);
+  remove("san_msa_out.tmp");
+  remove("san_msa_warn.tmp");
+  printf("msa bridge sanitizer run OK\n");
+  return 0;
+}

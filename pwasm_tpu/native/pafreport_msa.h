@@ -28,6 +28,15 @@ constexpr int FLAG_IS_REF = 0;
 constexpr int FLAG_PREPPED = 2;
 constexpr int FLAG_BAD_ALN = 7;
 
+// Warning sink for the engine's diagnostics.  The standalone binary
+// leaves it on stderr; the ctypes bridge (fastparse.cpp pw_msa_*)
+// points it at a capture file so the Python front end can route engine
+// warnings through sys.stderr exactly like its own engine does.
+inline FILE*& warn_stream() {
+  static FILE* s = stderr;
+  return s;
+}
+
 class Msa;
 
 // (the bestChar vote rule lives in pafreport_util.h — one C++ copy)
@@ -251,7 +260,7 @@ class GapSeq {
         --sp;
         --cp;
         if (sp < gclipL) {
-          fprintf(stderr,
+          fprintf(warn_stream(),
                   "Warning: reached clipL trying to find an initial "
                   "match on %s!\n",
                   name.c_str());
@@ -293,7 +302,7 @@ class GapSeq {
         ++sp;
         ++cp;
         if (sp >= glen - gclipR) {
-          fprintf(stderr,
+          fprintf(warn_stream(),
                   "Warning: reached clipR trying to find an initial "
                   "match on %s!\n",
                   name.c_str());
@@ -628,7 +637,7 @@ class Msa {
       GapSeq* s = seqs[i];
       s->msaidx = (int)i;
       if (s->seqlen - s->clp3 - s->clp5 < 1) {
-        fprintf(stderr,
+        fprintf(warn_stream(),
                 "Warning: sequence %s (length %ld) was trimmed too "
                 "badly (%ld,%ld) -- should be removed from MSA w/ %s!\n",
                 s->name.c_str(), s->seqlen, s->clp5, s->clp3,
@@ -642,11 +651,11 @@ class Msa {
 
   // (GSeqAlign::ErrZeroCov, GapAssem.cpp:1121-1131; exit 5)
   [[noreturn]] void err_zero_cov(long col) const {
-    fprintf(stderr,
+    fprintf(warn_stream(),
             "WARNING: 0 coverage column %ld (mincol=%ld) found within "
             "alignment of %zu seqs!\n",
             col, msacolumns->mincol, count());
-    for (const GapSeq* s : seqs) fprintf(stderr, "%s\n", s->name.c_str());
+    for (const GapSeq* s : seqs) fprintf(warn_stream(), "%s\n", s->name.c_str());
     throw PwErr(sformat("zero-coverage column %ld", col), 5);
   }
 
@@ -726,7 +735,7 @@ class Msa {
       long seql = clpl + 1;
       long seqr = gapped_len - clpr;
       if (seqr < seql) {
-        fprintf(stderr, "Bad trimming for %s of gapped len %ld (%ld, "
+        fprintf(warn_stream(), "Bad trimming for %s of gapped len %ld (%ld, "
                         "%ld)\n",
                 s->name.c_str(), gapped_len, seql, seqr);
         seqr = seql + 1;
@@ -796,7 +805,7 @@ class Msa {
       long seql = clpl + 1;
       long seqr = (long)s->seq.size() - clpr;
       if (seqr < seql) {
-        fprintf(stderr,
+        fprintf(warn_stream(),
                 "WARNING: Bad trimming for %s of gapped len %ld (%ld, "
                 "%ld)\n",
                 s->name.c_str(), gapped_len, seql, seqr);
