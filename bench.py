@@ -21,6 +21,9 @@ the headline config (2) LAST, and writes the full table to
    emitting packed pointers + lax.scan walk) on 1 CDS vs 10k targets,
    plus the host op->GapData conversion — re-aligned target bases/sec,
    parity-gated against the unbanded full-Gotoh host oracle.
+7. device X-drop clip refinement: 256-member ~1.5 kb pileup, the jitted
+   dense phase program vs the host 2-D numpy batch pass — layout
+   cells/sec, vs_baseline = wall speedup over the host pass.
 
 ``vs_baseline`` is the speedup over the single-core CPU equivalent of the
 same computation (C++ banded Gotoh for DP configs, the reference-style
@@ -214,7 +217,7 @@ def _scale_for_fallback(cfg: str) -> None:
     still honest for the platform reported to stderr."""
     global REPS
     small_t = {"2": "512", "3": "256", "4": str(1 << 16), "5": "4",
-               "6": "256"}
+               "6": "256", "7": "64"}
     if cfg in small_t:
         os.environ.setdefault("PWASM_BENCH_T", small_t[cfg])
     if cfg == "3":
@@ -811,14 +814,82 @@ def cfg6_realign() -> int:
                  rate / cpu_rate if cpu_rate else 0.0)
 
 
+# ---------------------------------------------------------------------------
+# config 7 — device X-drop clip refinement (VERDICT r3 item 3)
+# ---------------------------------------------------------------------------
+def cfg7_refine_clip() -> int:
+    """256-member ~1.5 kb consensus pileup with clipped ends: the device
+    phase program (ops/refine_clip.py) vs the host 2-D numpy batch pass,
+    end-to-end wall per refinement (both include the shared layout
+    build; the device side also pays its transfers — the honest
+    comparison).  Parity-gated bit-exact each rep."""
+    from pwasm_tpu.align.gapseq import GapSeq, refine_clipping_batch
+
+    M = int(os.environ.get("PWASM_BENCH_T", "256"))
+    m = 1500
+    rng = np.random.default_rng(7)
+    base = rng.choice(list(b"ACGT"), m).astype(np.uint8)
+
+    def mk():
+        seqs = []
+        r = np.random.default_rng(11)
+        for k in range(M):
+            arr = base.copy()
+            idx = r.integers(0, m, 40)
+            arr[idx] = r.choice(list(b"ACGT"), 40)
+            s = GapSeq(f"r{k}", "", bytes(arr))
+            s.clp5 = int(r.integers(1, 30))
+            s.clp3 = int(r.integers(1, 30))
+            for _ in range(4):
+                s.set_gap(int(r.integers(0, m)), 1)
+            seqs.append(s)
+        return seqs
+
+    cons = bytes(base)
+    cells = float(M) * (m + 8)  # layout cells walked per refinement
+
+    host_ref = mk()
+    refine_clipping_batch(host_ref, cons, [0] * M)
+
+    def timed(device: bool, reps: int = 5):
+        # fresh members each rep: refinement mutates the clip state.
+        # Returns (median wall, error label or None) so a mid-run
+        # demotion (infra) is distinguishable from a clip mismatch
+        # (bit-exactness failure).
+        walls = []
+        for _ in range(reps):
+            seqs = mk()
+            t0 = time.perf_counter()
+            demoted = refine_clipping_batch(seqs, cons, [0] * M,
+                                            device=device)
+            walls.append(time.perf_counter() - t0)
+            if demoted:
+                return None, "refine_clip_device_demoted"
+            for s, hr in zip(seqs, host_ref):
+                if (s.clp5, s.clp3) != (hr.clp5, hr.clp3):
+                    return None, "refine_clip_parity"
+        return float(np.median(walls)), None
+
+    warm = mk()  # compile outside the timed reps
+    if refine_clipping_batch(warm, cons, [0] * M, device=True):
+        return _fail("refine_clip_device_demoted")
+    dev_wall, dev_err = timed(True)
+    host_wall, host_err = timed(False)
+    if dev_wall is None or host_wall is None:
+        return _fail(dev_err or host_err)
+    return _emit("refine_clip_cells_per_sec", cells / dev_wall,
+                 "cells/s", host_wall / dev_wall)
+
+
 CONFIGS = {"1": cfg1_cli_cpu_ref, "2": cfg2_batched_dp,
            "3": cfg3_many2many, "4": cfg4_consensus,
-           "5": cfg5_longread, "6": cfg6_realign}
+           "5": cfg5_longread, "6": cfg6_realign,
+           "7": cfg7_refine_clip}
 
 # all-mode run order: headline config 2 LAST, so a driver that records
 # only the final stdout line still gets the metric comparable with
 # earlier rounds' single-config captures
-_ALL_ORDER = ["1", "3", "4", "5", "6", "2"]
+_ALL_ORDER = ["1", "3", "4", "5", "6", "7", "2"]
 
 
 def _run_all() -> int:

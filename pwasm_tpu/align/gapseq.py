@@ -458,7 +458,8 @@ class GapSeq:
 # ---------------------------------------------------------------------------
 def refine_clipping_batch(seqs: list[GapSeq], cons: bytes,
                           cposes: list[int],
-                          skip_dels: bool = False) -> None:
+                          skip_dels: bool = False,
+                          device: bool = False) -> int:
     """Refine the clipped ends of MANY members against the consensus in
     one vectorized pass (the refineMSA member loop,
     GapAssem.cpp:1133-1183, flattened into (members, layout) tensors).
@@ -470,10 +471,17 @@ def refine_clipping_batch(seqs: list[GapSeq], cons: bytes,
     single 2-D numpy programs over every clipped member at once instead
     of a Python loop of 1-D passes.  Members with no clips are skipped
     outright (the common case costs nothing).
+
+    With ``device`` the two phase computations run as one jitted dense
+    program on the accelerator (ops/refine_clip.py) over the same
+    padded tensors — bit-exact — with the host layout build and
+    write-back unchanged.  Returns the number of engine-level device
+    demotions (0 on success or on a pure-host run; 1 when a requested
+    device pass fell back to the host phases).
     """
     sel = [i for i, s in enumerate(seqs) if s.clp5 or s.clp3]
     if not sel:
-        return
+        return 0
     cons_arr = np.frombuffer(cons, dtype=np.uint8)
     cons_len = len(cons)
     star = ord("*")
@@ -549,6 +557,31 @@ def refine_clipping_batch(seqs: list[GapSeq], cons: bytes,
     for k in range(M):
         gseq2[k, :totals[k]] = rows[k]
         gxpos2[k, :totals[k]] = xrows[k]
+
+    demotions = 0
+    if device:
+        try:
+            from pwasm_tpu.ops.refine_clip import refine_phases_device
+            clipL, clipR, missR, missL = refine_phases_device(
+                gseq2, gxpos2, cons_arr, cpos, glen, totals, gclipL,
+                gclipR, clipL0, clipR0, seqlens, XDROP, MATCH_SC,
+                MISMATCH_SC)
+        except Exception as e:  # backend down / jax unavailable:
+            # replay on the host phases (bit-exact), surfaced by count
+            print(f"pwasm: device clip refinement fell back to host "
+                  f"({type(e).__name__})", file=sys.stderr)
+            demotions = 1
+        else:
+            for km in np.nonzero(missR)[0]:
+                print(f"Warning: reached clipL trying to find an "
+                      f"initial match on {seqs[sel[km]].name}!",
+                      file=sys.stderr)
+            for km in np.nonzero(missL)[0]:
+                print(f"Warning: reached clipR trying to find an "
+                      f"initial match on {seqs[sel[km]].name}!",
+                      file=sys.stderr)
+            _write_back_clips(seqs, sel, clipL, clipR)
+            return 0
 
     clipL = clipL0.copy()
     clipR = clipR0.copy()
@@ -692,6 +725,11 @@ def refine_clipping_batch(seqs: list[GapSeq], cons: bytes,
         clipL = np.where(upd, newL, clipL)
 
     # --- write back (strand-aware aliasing, GapAssem.cpp:188-189) -------
+    _write_back_clips(seqs, sel, clipL, clipR)
+    return demotions
+
+
+def _write_back_clips(seqs, sel, clipL, clipR) -> None:
     for k, i in enumerate(sel):
         s = seqs[i]
         if s.revcompl:

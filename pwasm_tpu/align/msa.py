@@ -607,17 +607,19 @@ class Msa:
             return s.offset - self.minoffset - cols.mincol
 
         if refine_clipping:
-            refine_clipping_batch(self.seqs, bytes(self.consensus),
-                                  [_cpos(s) for s in self.seqs])
+            self.engine_fallbacks += refine_clipping_batch(
+                self.seqs, bytes(self.consensus),
+                [_cpos(s) for s in self.seqs], device=device)
         second: list = []
         for s in self.seqs:
             grem = s.remove_clip_gaps() if remove_cons_gaps else 0
             if grem != 0 and refine_clipping:
                 second.append(s)
         if second:
-            refine_clipping_batch(second, bytes(self.consensus),
-                                  [_cpos(s) for s in second],
-                                  skip_dels=True)
+            self.engine_fallbacks += refine_clipping_batch(
+                second, bytes(self.consensus),
+                [_cpos(s) for s in second], skip_dels=True,
+                device=device)
         self.refined = True
 
     # ---- clipping transaction (library capability) ---------------------

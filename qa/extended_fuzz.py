@@ -51,8 +51,9 @@ sys.path.insert(0, os.path.join(REPO, "tests"))
 
 
 def sweep_refine_batch(seeds: int = 40) -> bool:
-    """Batched X-drop refinement vs the scalar reference transliteration,
-    all (skip_dels x with_dels) regimes."""
+    """Batched X-drop refinement vs the scalar reference transliteration
+    AND the device phase program (ops/refine_clip.py), all
+    (skip_dels x with_dels) regimes — three-way bit-exactness."""
     from test_gapseq_refine import _clone, _random_gapseq
 
     from pwasm_tpu.align.gapseq import refine_clipping_batch
@@ -62,26 +63,36 @@ def sweep_refine_batch(seeds: int = 40) -> bool:
         rng = np.random.default_rng(1000 + seed)
         for skip_dels in (False, True):
             for with_dels in (False, True):
-                seqs, clones, cposes = [], [], []
+                seqs, clones, dev, cposes = [], [], [], []
                 for _ in range(16):
                     s = _random_gapseq(rng, with_dels=with_dels)
                     seqs.append(s)
                     clones.append(_clone(s))
+                    dev.append(_clone(s))
                     cposes.append(int(rng.integers(0, 6)))
                 gm = max(s.seqlen + s.numgaps + 8 for s in seqs)
                 cons = rng.choice(list(b"ACGT*"), gm + 10).astype("uint8").tobytes()
-                with contextlib.redirect_stderr(io.StringIO()):
+                eh, ed = io.StringIO(), io.StringIO()
+                with contextlib.redirect_stderr(eh):
                     refine_clipping_batch(seqs, cons, cposes,
                                           skip_dels=skip_dels)
+                with contextlib.redirect_stderr(io.StringIO()):
                     for c, cp in zip(clones, cposes):
                         c.refine_clipping_scalar(cons, cp,
                                                  skip_dels=skip_dels)
-                for s, c in zip(seqs, clones):
+                with contextlib.redirect_stderr(ed):
+                    demoted = refine_clipping_batch(
+                        dev, cons, cposes, skip_dels=skip_dels,
+                        device=True)
+                if demoted or eh.getvalue() != ed.getvalue():
+                    bad += 1
+                for s, c, v in zip(seqs, clones, dev):
                     total += 1
-                    if (s.clp5, s.clp3) != (c.clp5, c.clp3):
+                    if (s.clp5, s.clp3) != (c.clp5, c.clp3) \
+                            or (s.clp5, s.clp3) != (v.clp5, v.clp3):
                         bad += 1
-    print(f"[{'PASS' if not bad else 'FAIL'}] refine batch-vs-scalar: "
-          f"{bad} mismatches / {total}")
+    print(f"[{'PASS' if not bad else 'FAIL'}] refine "
+          f"batch-vs-scalar-vs-device: {bad} mismatches / {total}")
     return bad == 0
 
 
