@@ -44,12 +44,14 @@ pipeline of launches (each rep consumes the previous rep's output through
 ending in one host fetch, at two pipeline depths k and 2k; per-rep time
 is ``(t(2k) - t(k)) / k``, which cancels the fixed round-trip latency.
 
-Env knobs: PWASM_BENCH_CONFIG (1-6, or unset/'all' for the full table),
+Env knobs: PWASM_BENCH_CONFIG (1-7, or unset/'all' for the full table),
 PWASM_BENCH_T (targets,
 default 10240), PWASM_BENCH_Q (config-3 queries, default 500),
 PWASM_BENCH_KERNEL=pallas|stream|xla (config-2 kernel, default pallas),
 PWASM_BENCH_BAND (default 64), PWASM_BENCH_CPU_T (CPU-baseline subset,
-default 32), PWASM_BENCH_REPS (pipeline depth k, default 8).
+default 32), PWASM_BENCH_REPS (pipeline depth k, default 8),
+PWASM_BENCH_CTILE (config-4 column-tile override for on-chip sweeps),
+PWASM_DP_IYCHAIN=log|two_level (config-2 Iy-chain variant A/B).
 """
 
 from __future__ import annotations
@@ -590,11 +592,15 @@ def cfg4_consensus() -> int:
         rand = jax.random.randint(k3, (d, c), 0, 6, dtype=jnp.int8)
         return jnp.where(noise, rand, true_base[None, :])
 
+    # PWASM_BENCH_CTILE overrides the kernel's depth-aware column tile
+    # (for on-chip tile sweeps; 0/unset = the kernel's default)
+    ctile = int(os.environ.get("PWASM_BENCH_CTILE", "0")) or None
+
     @jax.jit
     def chained(p_in, prev):
         p_in, _ = jax.lax.optimization_barrier((p_in, prev))
         if on_tpu:
-            votes, _counts = consensus_pallas(p_in)
+            votes, _counts = consensus_pallas(p_in, col_tile=ctile)
         else:
             votes = consensus_votes(p_in)
         return votes

@@ -39,6 +39,14 @@ import numpy as np
 
 NEG = -(2 ** 30)  # -inf surrogate, safe against int32 underflow
 
+# Iy-chain implementation inside the Pallas tile recurrence:
+# "log" (default) = flat log2(band) shift-max chain; "two_level" =
+# intra-sublane-group scan + group-prefix fold (see _make_tile_recurrence)
+# — an on-chip A/B knob for the headline kernel's dominant op block.
+import os as _os
+
+_IY_CHAIN = _os.environ.get("PWASM_DP_IYCHAIN", "log")
+
 
 @dataclass(frozen=True)
 class ScoreParams:
@@ -245,13 +253,42 @@ def _make_tile_recurrence(n, band, dlo, match, mismatch, go, ge, block_t):
             # boundary column j == 0: only a leading target-gap is alive
             ix_new = jnp.where(j == 0, -(go + (i - 1) * ge), ix_new)
             ix_new = jnp.where((j < 0) | (j > n), NEG, ix_new)
-        # cumulative max of m_new + b*ge along the band (log-step scan)
+        # cumulative max of m_new + b*ge along the band
         run = m_new + bidx * ge
-        sh = 1
-        while sh < band:
-            shifted = jnp.concatenate([neg[:sh], run[:-sh]], axis=0)
-            run = jnp.maximum(run, shifted)
-            sh *= 2
+        if _IY_CHAIN == "two_level" and band % 8 == 0 and band >= 16:
+            # two-level scan: an intra-group inclusive scan over
+            # 8-sublane groups (3 full-tile shift-max steps), then an
+            # exclusive scan over the band//8 group totals (log steps on
+            # 1/8 of the data) folded back with one max — ~7 full-tile
+            # op-equivalents vs 2*log2(band) for the flat chain.  The
+            # group axis maps shifts to intra-vreg sublane moves; worth
+            # it only if Mosaic relayouts the (g, 8, T) reshape cheaply
+            # (an on-chip A/B knob, PWASM_DP_IYCHAIN).
+            g = band // 8
+            r3 = run.reshape(g, 8, block_t)
+            neg3 = jnp.full_like(r3, NEG)
+            intra = r3
+            for sh in (1, 2, 4):
+                shifted = jnp.concatenate(
+                    [neg3[:, :sh], intra[:, :-sh]], axis=1)
+                intra = jnp.maximum(intra, shifted)
+            totals = intra[:, 7:8, :]            # (g, 1, T) group maxes
+            pre = jnp.full_like(totals, NEG)     # exclusive group prefix
+            acc = totals
+            sh = 1
+            while sh < g:
+                shifted = jnp.concatenate(
+                    [jnp.full_like(acc[:sh], NEG), acc[:-sh]], axis=0)
+                acc = jnp.maximum(acc, shifted)
+                sh *= 2
+            pre = jnp.concatenate([pre[:1], acc[:-1]], axis=0)
+            run = jnp.maximum(intra, pre).reshape(band, block_t)
+        else:
+            sh = 1                       # flat log-step shift-max chain
+            while sh < band:
+                shifted = jnp.concatenate([neg[:sh], run[:-sh]], axis=0)
+                run = jnp.maximum(run, shifted)
+                sh *= 2
         run_prev = jnp.concatenate([neg[:1], run[:-1]], axis=0)
         iy_new = run_prev - go - (bidx - 1) * ge
         if not interior:

@@ -98,22 +98,32 @@ def _consensus_kernel(bases_ref, counts_ref, votes_ref):
     are remapped to the no-contribution shift (bit 30, never extracted;
     31 such rows overflow harmlessly past bit 31).
     """
-    b = bases_ref[...].astype(jnp.int32)  # (depth, C)
-    depth, c_tile = b.shape
+    depth, c_tile = bases_ref.shape
     if depth <= 1024:
         # packed path: the 31-row chunk loop unrolls depth/31 bodies at
         # trace time, so cap it — beyond ~1024 rows the naive path below
-        # keeps compile time flat (its 6 sums are depth-constant ops)
-        b = jnp.where((b < 0) | (b > 5), N_CLASSES, b)
+        # keeps compile time flat (its 6 sums are depth-constant ops).
+        # The int8->int32 widening and the out-of-range handling happen
+        # PER CHUNK (31 rows), never materializing a (depth, C) int32
+        # tensor: peak VMEM stays ~chunk-sized, which is what lets the
+        # column tile grow (tile 4096 previously regressed on the
+        # block-wide int32 temporaries).  Out-of-range codes remap to 6,
+        # which shifts into bit 30, the never-extracted no-contribution
+        # lane (31 such rows overflow harmlessly past bit 31): `& 255`
+        # sends negative int8 codes to 128..255, then `min(, 6)` folds
+        # them and every code > 6 onto 6 — 2 VPU ops vs 3 for the old
+        # where-remap.
         cnts = [jnp.zeros((c_tile,), jnp.int32) for _ in range(N_CLASSES)]
         for r0 in range(0, depth, 31):
-            chunk = b[r0:r0 + 31]
+            chunk = bases_ref[r0:r0 + 31, :].astype(jnp.int32)
+            chunk = jnp.minimum(chunk & 255, N_CLASSES)
             packed = jnp.sum(jnp.left_shift(jnp.int32(1), 5 * chunk),
                              axis=0)
             for k in range(N_CLASSES):
                 cnts[k] = cnts[k] + (jnp.right_shift(packed, 5 * k) & 31)
         cnt = jnp.stack(cnts, axis=0)  # (6, C)
     else:
+        b = bases_ref[...].astype(jnp.int32)
         cnt = jnp.stack([jnp.sum((b == k).astype(jnp.int32), axis=0)
                          for k in range(N_CLASSES)], axis=0)
     counts_ref[...] = cnt
