@@ -338,14 +338,8 @@ def sweep_native_cli_parity(trials: int = 25) -> bool:
     """Random anchored alignment sets through BOTH front ends: the
     standalone C++ binary's outputs (.dfa/.mfa/.ace/.info/.cons +
     summary + stderr) must be byte-identical to the Python CLI's CPU
-    path, across the refinement-flag variants."""
-    import subprocess
-
-    from helpers import make_paf_line
-
-    from pwasm_tpu.cli import run
-    from pwasm_tpu.core.dna import revcomp
-    from pwasm_tpu.core.fasta import write_fasta
+    path, across the refinement-flag variants (and both Python-side
+    MSA engines — trials alternate the native-engine delegation)."""
     from pwasm_tpu.native import native_cli_path
 
     cli = native_cli_path()
@@ -353,6 +347,28 @@ def sweep_native_cli_parity(trials: int = 25) -> bool:
         print("[SKIP] native CLI parity: no toolchain")
         return True
     rng = np.random.default_rng(13)
+    saved_delegation = os.environ.get("PWASM_NATIVE_MSA")
+    try:
+        bad = _native_cli_parity_trials(cli, rng, trials)
+    finally:
+        if saved_delegation is None:
+            os.environ.pop("PWASM_NATIVE_MSA", None)
+        else:
+            os.environ["PWASM_NATIVE_MSA"] = saved_delegation
+    print(f"[{'PASS' if not bad else 'FAIL'}] native-binary CLI parity: "
+          f"{bad} divergent trials / {trials}")
+    return bad == 0
+
+
+def _native_cli_parity_trials(cli, rng, trials) -> int:
+    import subprocess
+
+    from helpers import make_paf_line
+
+    from pwasm_tpu.cli import run
+    from pwasm_tpu.core.dna import revcomp
+    from pwasm_tpu.core.fasta import write_fasta
+
     bad = 0
     for trial in range(trials):
         with tempfile.TemporaryDirectory() as td:
@@ -404,6 +420,10 @@ def sweep_native_cli_parity(trials: int = 25) -> bool:
             paf = os.path.join(td, "in.paf")
             with open(paf, "w") as f:
                 f.write("".join(l + "\n" for l in lines))
+            # alternate (per trial) the Python CLI between the delegated
+            # native MSA engine and the Python engine, so BOTH stay
+            # byte-locked to the standalone binary
+            os.environ["PWASM_NATIVE_MSA"] = "0" if trial % 2 else "1"
             for vname, vflags in (("base", []),
                                   ("rcg", ["--remove-cons-gaps"]),
                                   ("norc", ["--no-refine-clip"])):
@@ -438,9 +458,7 @@ def sweep_native_cli_parity(trials: int = 25) -> bool:
                     if pb != nb:
                         bad += 1
                         break
-    print(f"[{'PASS' if not bad else 'FAIL'}] native-binary CLI parity: "
-          f"{bad} divergent trials / {trials}")
-    return bad == 0
+    return bad
 
 
 def main() -> int:

@@ -93,8 +93,13 @@ def main() -> int:
     want = np.asarray(banded_scores_batch(qd, tsd, tld, band=band))
 
     rng = np.random.default_rng(1)
-    pileup = rng.integers(0, 7, size=(64, 1024)).astype(np.int8)
+    # codes -3..8: the compiled kernel must treat every code outside
+    # [0, 6) — negatives, PAD_CODE 6 and beyond — as no-contribution
+    # exactly like the interpreter (round-3 leftover: this was
+    # interpreter-tested only)
+    pileup = rng.integers(-3, 9, size=(64, 1024)).astype(np.int8)
     want_votes = np.asarray(consensus_votes(jnp.asarray(pileup)))
+    want_counts = np.stack([(pileup == k).sum(0) for k in range(6)], 1)
 
     qs2 = np.stack([q, np.roll(q, 3)])
     want_m2m = np.asarray(many2many_scores(jnp.asarray(qs2), tsd, tld,
@@ -120,9 +125,37 @@ def main() -> int:
         assert np.array_equal(got, want), "score mismatch"
 
     def consensus():
-        votes, _ = consensus_pallas(jnp.asarray(pileup))
+        votes, counts = consensus_pallas(jnp.asarray(pileup))
         assert np.array_equal(np.asarray(votes), want_votes), \
             "vote mismatch"
+        assert np.array_equal(np.asarray(counts), want_counts), \
+            "count mismatch (out-of-range code handling)"
+
+    def refine_clip():
+        # the device X-drop phase program (XLA, not Pallas) end-to-end
+        # on the chip vs the host batch pass
+        from pwasm_tpu.align.gapseq import GapSeq, refine_clipping_batch
+        r = np.random.default_rng(5)
+        base = r.choice(list(b"ACGT"), 400).astype(np.uint8)
+        def mk():
+            out = []
+            rr = np.random.default_rng(6)
+            for k in range(16):
+                arr = base.copy()
+                arr[rr.integers(0, 400, 8)] = rr.choice(list(b"ACGT"), 8)
+                s = GapSeq(f"r{k}", "", bytes(arr))
+                s.clp5 = int(rr.integers(1, 12))
+                s.clp3 = int(rr.integers(1, 12))
+                for _ in range(3):
+                    s.set_gap(int(rr.integers(0, 400)), 1)
+                out.append(s)
+            return out
+        dev, host = mk(), mk()
+        assert refine_clipping_batch(dev, bytes(base), [0] * 16,
+                                     device=True) == 0, "device demoted"
+        refine_clipping_batch(host, bytes(base), [0] * 16)
+        for a, b in zip(dev, host):
+            assert (a.clp5, a.clp3) == (b.clp5, b.clp3), "clip mismatch"
 
     def m2m():
         got = np.asarray(many2many_scores_pallas(jnp.asarray(qs2), tsd,
@@ -149,7 +182,8 @@ def main() -> int:
                "many2many_scores_pallas": m2m,
                "realign_fwdptr_walk_pallas": realign,
                "realign_fwdptr_streaming_pallas":
-                   lambda: realign("pallas_long")}
+                   lambda: realign("pallas_long"),
+               "refine_clip_device": refine_clip}
     results = {}
     for name, fn in kernels.items():
         try:
