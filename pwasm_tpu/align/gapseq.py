@@ -459,7 +459,8 @@ class GapSeq:
 def refine_clipping_batch(seqs: list[GapSeq], cons: bytes,
                           cposes: list[int],
                           skip_dels: bool = False,
-                          device: bool = False) -> int:
+                          device: bool = False,
+                          mesh=None) -> int:
     """Refine the clipped ends of MANY members against the consensus in
     one vectorized pass (the refineMSA member loop,
     GapAssem.cpp:1133-1183, flattened into (members, layout) tensors).
@@ -565,7 +566,7 @@ def refine_clipping_batch(seqs: list[GapSeq], cons: bytes,
             clipL, clipR, missR, missL = refine_phases_device(
                 gseq2, gxpos2, cons_arr, cpos, glen, totals, gclipL,
                 gclipR, clipL0, clipR0, seqlens, XDROP, MATCH_SC,
-                MISMATCH_SC)
+                MISMATCH_SC, mesh=mesh)
         except Exception as e:  # backend down / jax unavailable:
             # replay on the host phases (bit-exact), surfaced by count
             print(f"pwasm: device clip refinement fell back to host "

@@ -609,7 +609,7 @@ class Msa:
         if refine_clipping:
             self.engine_fallbacks += refine_clipping_batch(
                 self.seqs, bytes(self.consensus),
-                [_cpos(s) for s in self.seqs], device=device)
+                [_cpos(s) for s in self.seqs], device=device, mesh=mesh)
         second: list = []
         for s in self.seqs:
             grem = s.remove_clip_gaps() if remove_cons_gaps else 0
@@ -619,7 +619,7 @@ class Msa:
             self.engine_fallbacks += refine_clipping_batch(
                 second, bytes(self.consensus),
                 [_cpos(s) for s in second], skip_dels=True,
-                device=device)
+                device=device, mesh=mesh)
         self.refined = True
 
     # ---- clipping transaction (library capability) ---------------------

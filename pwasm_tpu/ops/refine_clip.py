@@ -30,9 +30,12 @@ def _pow2(n: int, floor: int) -> int:
 
 
 @functools.lru_cache(maxsize=None)
-def _compiled(xdrop: int, match_sc: int, mismatch_sc: int):
-    """The jitted phase program for one scoring constant set (the
-    reference's XDROP/MATCH_SC/MISMATCH_SC — effectively a singleton)."""
+def _phases_fn(xdrop: int, match_sc: int, mismatch_sc: int):
+    """The raw (unjitted) phase program for one scoring constant set —
+    jitted by ``_compiled`` for the single-device path and wrapped in
+    ``shard_map`` by ``parallel.mesh.sharded_refine_phases`` for the
+    member-sharded multi-chip path (members are independent lanes, so
+    the sharding is pure data parallelism; no collectives)."""
     import jax
     import jax.numpy as jnp
 
@@ -135,19 +138,33 @@ def _compiled(xdrop: int, match_sc: int, mismatch_sc: int):
 
         return clipL, clipR, missR, missL
 
-    return jax.jit(phases)
+    return phases
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled(xdrop: int, match_sc: int, mismatch_sc: int):
+    """The jitted phase program for one scoring constant set (the
+    reference's XDROP/MATCH_SC/MISMATCH_SC — effectively a singleton)."""
+    import jax
+
+    return jax.jit(_phases_fn(xdrop, match_sc, mismatch_sc))
 
 
 def refine_phases_device(gseq2, gxpos2, cons_arr, cpos, glen, totals,
                          gclipL, gclipR, clipL0, clipR0, seqlens,
-                         xdrop: int, match_sc: int, mismatch_sc: int):
+                         xdrop: int, match_sc: int, mismatch_sc: int,
+                         mesh=None):
     """Run both refinement phases on the device over the padded layout
-    tensors built by refine_clipping_batch.  Returns numpy
-    (clipL, clipR, missR, missL) for the M real members."""
+    tensors built by refine_clipping_batch.  With ``mesh`` the member
+    axis shards over every mesh axis (pure data parallelism).  Returns
+    numpy (clipL, clipR, missR, missL) for the M real members."""
     import jax.numpy as jnp
 
     M, L = gseq2.shape
     Mp = _pow2(M, 8)
+    if mesh is not None:
+        tot = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+        Mp = -(-Mp // tot) * tot  # member axis must divide the mesh
     Lp = _pow2(L, 128)
     C = len(cons_arr)
     Cp = _pow2(C, 128)
@@ -164,7 +181,13 @@ def refine_phases_device(gseq2, gxpos2, cons_arr, cpos, glen, totals,
         out[:M] = v
         return jnp.asarray(out)
 
-    fn = _compiled(int(xdrop), int(match_sc), int(mismatch_sc))
+    if mesh is not None:
+        from pwasm_tpu.parallel.mesh import sharded_refine_phases
+
+        fn = sharded_refine_phases(mesh, int(xdrop), int(match_sc),
+                                   int(mismatch_sc))
+    else:
+        fn = _compiled(int(xdrop), int(match_sc), int(mismatch_sc))
     clipL, clipR, missR, missL = fn(
         jnp.asarray(gseq), jnp.asarray(gxpos), jnp.asarray(cons),
         padv(cpos), padv(glen), padv(totals), padv(gclipL),

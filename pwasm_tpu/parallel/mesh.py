@@ -100,6 +100,31 @@ def sharded_counts_votes(mesh: Mesh, dp_axes=("batch",)):
     return jax.jit(fn)
 
 
+@functools.lru_cache(maxsize=None)
+def sharded_refine_phases(mesh: Mesh, xdrop: int, match_sc: int,
+                          mismatch_sc: int):
+    """The X-drop clip-refinement phase program
+    (ops/refine_clip._phases_fn) with the MEMBER axis sharded over every
+    mesh axis — members are independent lanes, so this is pure data
+    parallelism (the consensus and its length are replicated; no
+    collective).  Bit-exact with the single-device program by
+    construction.  The padded member count must divide the mesh size
+    (refine_phases_device pads accordingly).  Cached per (mesh,
+    constants): Mesh has value-based hash/eq, so equal meshes share one
+    compiled program."""
+    from pwasm_tpu.ops.refine_clip import _phases_fn
+
+    fn = _phases_fn(xdrop, match_sc, mismatch_sc)
+    ax = tuple(mesh.axis_names)
+    spec_m = P(ax)
+    sm = shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(ax, None), P(ax, None), P(None)) + (spec_m,) * 8
+        + (P(),),
+        out_specs=(spec_m,) * 4)
+    return jax.jit(sm)
+
+
 def make_pipeline_step(mesh: Mesh, band: int = 32,
                        params: ScoreParams = ScoreParams()):
     """The full sharded pipeline step — the framework's 'training step'

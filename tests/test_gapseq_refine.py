@@ -174,6 +174,36 @@ def test_refine_clipping_batch_matches_single(seed, skip_dels, device):
             == err2.getvalue().count("Warning"))
 
 
+@pytest.mark.parametrize("skip_dels", [False, True])
+def test_refine_clipping_batch_mesh_sharded(skip_dels):
+    """The device phase program with the member axis sharded over the
+    virtual 8-device mesh: bit-exact with the host batch pass (pure
+    data parallelism — no collective, so exactness is structural)."""
+    import jax
+
+    from pwasm_tpu.align.gapseq import refine_clipping_batch
+    from pwasm_tpu.parallel.mesh import make_mesh
+
+    assert len(jax.devices()) >= 8
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(31)
+    seqs, clones, cposes = [], [], []
+    for k in range(20):   # deliberately NOT a multiple of the mesh size
+        s = _random_gapseq(rng, with_dels=skip_dels)
+        seqs.append(s)
+        clones.append(_clone(s))
+        cposes.append(int(rng.integers(0, 5)))
+    glen_max = max(s.seqlen + s.numgaps for s in seqs)
+    cons = rng.choice(list(b"ACGT*"), glen_max + 8).astype("uint8").tobytes()
+    with contextlib.redirect_stderr(io.StringIO()):
+        assert refine_clipping_batch(seqs, cons, cposes,
+                                     skip_dels=skip_dels, device=True,
+                                     mesh=mesh) == 0
+        refine_clipping_batch(clones, cons, cposes, skip_dels=skip_dels)
+    for s, c in zip(seqs, clones):
+        assert (s.clp5, s.clp3) == (c.clp5, c.clp3), s.name
+
+
 def test_refine_clipping_batch_256_member_speedup():
     """One 2-D pass over a 256-member ~1.5 kb pileup must beat the
     member-by-member loop (measured; VERDICT r2 next #10)."""
