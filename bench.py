@@ -51,7 +51,9 @@ PWASM_BENCH_KERNEL=pallas|stream|xla (config-2 kernel, default pallas),
 PWASM_BENCH_BAND (default 64), PWASM_BENCH_CPU_T (CPU-baseline subset,
 default 32), PWASM_BENCH_REPS (pipeline depth k, default 8),
 PWASM_BENCH_CTILE (config-4 column-tile override for on-chip sweeps),
-PWASM_DP_IYCHAIN=log|two_level (config-2 Iy-chain variant A/B).
+PWASM_DP_IYCHAIN=log|two_level (config-2 Iy-chain variant A/B),
+PWASM_BENCH_PROFILE=DIR (write one jax.profiler trace of the pipelined
+run before timing).
 """
 
 from __future__ import annotations
@@ -250,6 +252,15 @@ def _pipe_rate(run_fn, arg, zero, work_per_rep: float, reps: int = 0):
         return time.perf_counter() - t0
 
     pipe(2)                                 # warm the dispatch path
+    prof_dir = os.environ.get("PWASM_BENCH_PROFILE", "")
+    if prof_dir:
+        # one profiled pipeline for where-does-the-time-go analysis
+        # (device trace viewable offline); timing below stays unprofiled
+        import jax
+
+        with jax.profiler.trace(prof_dir):
+            pipe(reps)
+        print(f"[bench] profile written to {prof_dir}", file=sys.stderr)
     # the chip is shared: other tenants' work landing inside a window
     # skews a single differenced estimate either way (an inflated
     # pipe(k) makes the difference too small, an inflated pipe(2k) too
@@ -968,6 +979,9 @@ def _run_all() -> int:
               file=sys.stderr)
     for cfg in _ALL_ORDER:
         env = dict(os.environ, PWASM_BENCH_CONFIG=cfg)
+        # profiling is a single-config affair (PWASM_BENCH_CONFIG=k);
+        # a run-all must not dump one overlapping trace per child
+        env.pop("PWASM_BENCH_PROFILE", None)
         if backend_down:
             _cpu_pin_env(env)
         rows = []

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""One-shot chip burst: run every chip-gated validation/measurement in
+priority order the moment the tunnel is healthy, so a short window is
+never wasted (the tunnel goes down for multi-hour stretches — see
+ROUND4.md).  Results land in ``chip_burst/`` as JSONL + logs; the
+driver-style artifacts (BENCH_ALL.json, TPU_SMOKE.json) are refreshed
+by the full bench step exactly as a bare ``python bench.py`` would.
+
+Order: smoke (gate) -> full bench table -> cfg4 column-tile sweep ->
+cfg2 Iy-chain A/B -> cfg7 on chip -> cfg4 profiled launch.  Exit 3 =
+backend down or not a real TPU (nothing ran); exit 0 = burst completed
+(individual steps may still record failures in the JSONL).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "chip_burst")
+
+
+def _run(name: str, env_extra: dict, args: list[str], timeout: float,
+         log: list) -> dict:
+    env = dict(os.environ, **{k: str(v) for k, v in env_extra.items()})
+    t0 = time.time()
+    try:
+        r = subprocess.run([sys.executable] + args, env=env, cwd=REPO,
+                           capture_output=True, text=True,
+                           timeout=timeout)
+        rows = []
+        for line in r.stdout.splitlines():
+            try:
+                row = json.loads(line)
+                if isinstance(row, dict):
+                    rows.append(row)
+            except json.JSONDecodeError:
+                continue
+        rec = {"step": name, "rc": r.returncode, "rows": rows,
+               "wall_s": round(time.time() - t0, 1)}
+        with open(os.path.join(OUT, f"{name}.stderr"), "w") as f:
+            f.write(r.stderr)
+    except subprocess.TimeoutExpired as e:
+        rec = {"step": name, "rc": None, "rows": [],
+               "wall_s": round(time.time() - t0, 1), "timeout": True}
+        with open(os.path.join(OUT, f"{name}.stderr"), "w") as f:
+            for part in (e.stdout, e.stderr):  # partial output is the
+                if part:                       # only hang diagnostic
+                    f.write(part if isinstance(part, str)
+                            else part.decode("utf-8", "replace"))
+    log.append(rec)
+    print(json.dumps(rec), flush=True)
+    with open(os.path.join(OUT, "burst.jsonl"), "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    return rec
+
+
+def main() -> int:
+    os.makedirs(OUT, exist_ok=True)
+    log: list = []
+
+    smoke = _run("smoke", {}, ["tpu_smoke.py"], 700, log)
+    verdict = smoke["rows"][-1] if smoke["rows"] else {}
+    if verdict.get("backend_down") or not verdict.get("ok") \
+            or verdict.get("backend") != "tpu":
+        # a healthy-but-CPU backend must not burn the burst budget on
+        # TPU-sized workloads (or overwrite the driver artifacts with
+        # non-chip numbers)
+        print("[burst] backend down, smoke failed, or not a real TPU; "
+              "aborting", file=sys.stderr)
+        return 3
+
+    # 1. the driver-style full table (writes BENCH_ALL.json/TPU_SMOKE.json)
+    _run("bench_all", {}, ["bench.py"], 5400, log)
+
+    # 2. cfg4 column-tile sweep with the chunk-wise kernel
+    for t in (2048, 4096, 8192):
+        _run(f"cfg4_ctile{t}",
+             {"PWASM_BENCH_CONFIG": "4", "PWASM_BENCH_CTILE": t},
+             ["bench.py"], 1200, log)
+
+    # 3. cfg2 Iy-chain A/B
+    for chain in ("log", "two_level"):
+        _run(f"cfg2_iy_{chain}",
+             {"PWASM_BENCH_CONFIG": "2", "PWASM_DP_IYCHAIN": chain},
+             ["bench.py"], 1200, log)
+
+    # 4. cfg7 device clip refinement on chip
+    _run("cfg7_chip", {"PWASM_BENCH_CONFIG": "7"}, ["bench.py"], 1200,
+         log)
+
+    # 5. one profiled cfg4 launch for the roofline-gap analysis
+    _run("cfg4_profile",
+         {"PWASM_BENCH_CONFIG": "4",
+          "PWASM_BENCH_PROFILE": os.path.join(OUT, "cfg4_trace")},
+         ["bench.py"], 1800, log)
+
+    print(f"[burst] complete: {len(log)} steps, results in {OUT}",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
