@@ -128,6 +128,23 @@ def _disarm_watchdog() -> None:
         _WATCHDOG.cancel()
 
 
+def _json_rows(stdout: str) -> list[dict]:
+    """Parse the one-JSON-object-per-line stdout protocol of bench/smoke
+    children (stray non-JSON lines and JSON scalars are noise) — the one
+    parser shared by run-all and qa/chip_burst.py."""
+    rows = []
+    for line in stdout.splitlines():
+        if not line.strip():
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(row, dict):
+            rows.append(row)
+    return rows
+
+
 def _fail(metric: str) -> int:
     _disarm_watchdog()
     print(json.dumps({"metric": metric, "value": 0, "unit": "bool",
@@ -611,7 +628,10 @@ def cfg4_consensus() -> int:
     def chained(p_in, prev):
         p_in, _ = jax.lax.optimization_barrier((p_in, prev))
         if on_tpu:
-            votes, _counts = consensus_pallas(p_in, col_tile=ctile)
+            # the generated pileup holds codes 0..5 only: use the same
+            # remap-free path the product consensus uses
+            votes, _counts = consensus_pallas(p_in, col_tile=ctile,
+                                              assume_valid=True)
         else:
             votes = consensus_votes(p_in)
         return votes
@@ -994,15 +1014,7 @@ def _run_all() -> int:
             # a config may emit several metric lines (e.g. config 1's
             # native reference + Python-CLI secondary); keep them all,
             # last line remains the config's primary metric
-            for line in r.stdout.splitlines():
-                if not line.strip():
-                    continue
-                try:
-                    row = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if isinstance(row, dict):  # stray JSON scalars are noise
-                    rows.append(row)
+            rows = _json_rows(r.stdout)
             if r.returncode != 0:  # a failed gate still exits nonzero
                 rc = 1
         except subprocess.TimeoutExpired:

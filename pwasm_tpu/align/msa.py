@@ -539,7 +539,10 @@ class Msa:
         else:
             from pwasm_tpu.ops.consensus import consensus_pallas
 
-            votes, counts = consensus_pallas(jnp.asarray(pile))
+            # pileup_matrix emits only codes 0..6, so the kernel may
+            # skip its out-of-range remap
+            votes, counts = consensus_pallas(jnp.asarray(pile),
+                                             assume_valid=True)
             counts = np.asarray(counts)
         cols.counts[:] = counts
         cols.layers[:] = counts.sum(axis=1, dtype=np.int32)

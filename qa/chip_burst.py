@@ -26,21 +26,23 @@ OUT = os.path.join(REPO, "chip_burst")
 
 def _run(name: str, env_extra: dict, args: list[str], timeout: float,
          log: list) -> dict:
-    env = dict(os.environ, **{k: str(v) for k, v in env_extra.items()})
+    # each step fully controls its PWASM knobs: stray operator-shell
+    # values (a lingering PWASM_BENCH_CONFIG pin, a profile dir, ...)
+    # must not leak into the children
+    env = {k: v for k, v in os.environ.items()
+           if not (k.startswith("PWASM_BENCH_")
+                   or k.startswith("PWASM_DP_"))}
+    env.update({k: str(v) for k, v in env_extra.items()})
     t0 = time.time()
     try:
         r = subprocess.run([sys.executable] + args, env=env, cwd=REPO,
                            capture_output=True, text=True,
                            timeout=timeout)
-        rows = []
-        for line in r.stdout.splitlines():
-            try:
-                row = json.loads(line)
-                if isinstance(row, dict):
-                    rows.append(row)
-            except json.JSONDecodeError:
-                continue
-        rec = {"step": name, "rc": r.returncode, "rows": rows,
+        sys.path.insert(0, REPO)
+        from bench import _json_rows
+
+        rec = {"step": name, "rc": r.returncode,
+               "rows": _json_rows(r.stdout),
                "wall_s": round(time.time() - t0, 1)}
         with open(os.path.join(OUT, f"{name}.stderr"), "w") as f:
             f.write(r.stderr)

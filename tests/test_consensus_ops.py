@@ -80,6 +80,19 @@ def test_pallas_unaligned_columns():
         np.asarray(votes), np.asarray(consensus_votes(jnp.asarray(bases))))
 
 
+def test_pallas_assume_valid_matches_robust_path():
+    """assume_valid elides the out-of-range remap; on in-contract codes
+    (0..6, incl. PAD) it must be bit-identical to the robust path."""
+    rng = np.random.default_rng(6)
+    for depth in (1, 31, 64, 256):
+        bases = rng.integers(0, 7, size=(depth, 512)).astype(np.int8)
+        v0, c0 = consensus_pallas(jnp.asarray(bases), col_tile=128)
+        v1, c1 = consensus_pallas(jnp.asarray(bases), col_tile=128,
+                                  assume_valid=True)
+        np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+        np.testing.assert_array_equal(np.asarray(c0), np.asarray(c1))
+
+
 def test_pallas_out_of_range_codes_and_odd_depths():
     """Negative codes and codes > 5 must contribute nothing, and depths
     that are not multiples of the packed-counter row chunk (31) must
