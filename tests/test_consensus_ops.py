@@ -284,6 +284,45 @@ def test_refine_msa_device_clip_phases_on_device(seed, monkeypatch):
         assert (sh.clp5, sh.clp3) == (sd.clp5, sd.clp3)
 
 
+def test_refine_msa_mesh_routes_consensus_and_clips(monkeypatch):
+    """refine_msa(device=True, mesh=...) shards BOTH device stages: the
+    consensus counts (depth psum) and the clip-refinement phases (member
+    sharding) — results bit-exact with the host engine."""
+    from pwasm_tpu.parallel import mesh as meshmod
+    from pwasm_tpu.parallel.mesh import make_mesh
+
+    assert len(jax.devices()) >= 8
+    mesh = make_mesh(8)
+    calls = []
+    real_refine = meshmod.sharded_refine_phases
+    real_counts = meshmod.sharded_counts_votes
+
+    def spy_refine(*a, **k):
+        calls.append("refine")
+        return real_refine(*a, **k)
+
+    def spy_counts(*a, **k):
+        calls.append("counts")
+        return real_counts(*a, **k)
+
+    monkeypatch.setattr(meshmod, "sharded_refine_phases", spy_refine)
+    monkeypatch.setattr(meshmod, "sharded_counts_votes", spy_counts)
+    host = _random_msa(4)
+    dev = _random_msa(4)
+    for m in (host, dev):
+        r = np.random.default_rng(60)
+        for s in m.seqs[1:]:
+            s.clp5 = int(r.integers(1, 4))
+            s.clp3 = int(r.integers(1, 4))
+    host.refine_msa(remove_cons_gaps=False)
+    dev.refine_msa(remove_cons_gaps=False, device=True, mesh=mesh)
+    assert "refine" in calls, "sharded refine phases not invoked"
+    assert "counts" in calls, "sharded consensus counts not invoked"
+    assert bytes(dev.consensus) == bytes(host.consensus)
+    for sh, sd in zip(host.seqs, dev.seqs):
+        assert (sh.clp5, sh.clp3) == (sd.clp5, sd.clp3)
+
+
 def test_stranded_deleted_base_raises_on_both_paths():
     """A deleted base whose collapsed column falls before the layout
     start is uncountable: the host scatter would wrap the negative
