@@ -67,6 +67,46 @@ def best_char_from_counts(counts, layers: int) -> int:
     return ord("N") if n == m else ord("-")
 
 
+def device_counts_votes(pile: np.ndarray, mesh=None):
+    """Device counts + votes for a (rows, cols) int8 code pileup (codes
+    0..6): one fused Pallas launch (``consensus_pallas``), or the
+    depth-``psum`` sharded program over ``mesh``.  Returns
+    ``(chars (cols,) int64 — vote character codes, 0 = zero coverage;
+    counts (cols, 6) int32)``.  Shared by ``Msa._device_count_votes``
+    and the native-engine device delegation (cli.py), so both product
+    paths run the identical kernel program."""
+    import jax.numpy as jnp
+
+    ncols = pile.shape[1]
+    if mesh is not None:
+        from pwasm_tpu.parallel.mesh import sharded_counts_votes
+
+        d_ax = mesh.shape["depth"]
+        c_ax = int(np.prod([mesh.shape[a] for a in mesh.axis_names
+                            if a != "depth"]))
+        pad_d = -len(pile) % d_ax
+        pad_c = -ncols % c_ax
+        if pad_d or pad_c:
+            pile = np.pad(pile, ((0, pad_d), (0, pad_c)),
+                          constant_values=6)
+        votes, counts = sharded_counts_votes(mesh)(jnp.asarray(pile))
+        votes = votes[:ncols]
+        counts = np.asarray(counts)[:ncols]
+    else:
+        from pwasm_tpu.ops.consensus import consensus_pallas
+
+        # engine-built pileups carry only codes 0..6: remap-free kernel
+        votes, counts = consensus_pallas(jnp.asarray(pile),
+                                         assume_valid=True)
+        counts = np.asarray(counts)
+    v = np.asarray(votes)
+    table = np.frombuffer(b"ACGTN-", dtype=np.uint8)
+    chars = np.zeros(len(v), dtype=np.int64)
+    valid = v >= 0
+    chars[valid] = table[v[valid]]
+    return chars, counts
+
+
 class MsaColumns:
     """Column pileup: (size, 6) count tensor + live [mincol, maxcol] window
     (reference MSAColumns, GapAssem.h:345-376).  ``layers`` counts every
@@ -517,40 +557,12 @@ class Msa:
         class counts are ``psum``-reduced over the depth axis before the
         vote — the north-star ICI collective (SURVEY.md §0).  Same
         integers, so still bit-exact."""
-        import jax.numpy as jnp
-
         cols = self.msacolumns
         if pile is None:
             pile = self.pileup_matrix()
-        if mesh is not None:
-            from pwasm_tpu.parallel.mesh import sharded_counts_votes
-
-            d_ax = mesh.shape["depth"]
-            c_ax = int(np.prod([mesh.shape[a] for a in mesh.axis_names
-                                if a != "depth"]))
-            pad_d = -len(pile) % d_ax
-            pad_c = -pile.shape[1] % c_ax
-            if pad_d or pad_c:
-                pile = np.pad(pile, ((0, pad_d), (0, pad_c)),
-                              constant_values=6)
-            votes, counts = sharded_counts_votes(mesh)(jnp.asarray(pile))
-            votes = votes[:self.length]
-            counts = np.asarray(counts)[:self.length]
-        else:
-            from pwasm_tpu.ops.consensus import consensus_pallas
-
-            # pileup_matrix emits only codes 0..6, so the kernel may
-            # skip its out-of-range remap
-            votes, counts = consensus_pallas(jnp.asarray(pile),
-                                             assume_valid=True)
-            counts = np.asarray(counts)
+        chars, counts = device_counts_votes(pile, mesh=mesh)
         cols.counts[:] = counts
         cols.layers[:] = counts.sum(axis=1, dtype=np.int32)
-        v = np.asarray(votes)
-        table = np.frombuffer(b"ACGTN-", dtype=np.uint8)
-        chars = np.zeros(len(v), dtype=np.int64)
-        valid = v >= 0
-        chars[valid] = table[v[valid]]
         self._device_vote_chars = chars
 
     def refine_msa(self, remove_cons_gaps: bool = True,

@@ -136,6 +136,21 @@ def get_lib():
         lib.pw_msa_contig.restype = None
         lib.pw_msa_contig.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32]
+        lib.pw_msa_dims.restype = None
+        lib.pw_msa_dims.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.pw_msa_prepare_device.restype = ctypes.c_int
+        lib.pw_msa_prepare_device.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_int32]
+        lib.pw_msa_render_pileup.restype = ctypes.c_int
+        lib.pw_msa_render_pileup.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_int32]
+        lib.pw_msa_refine_external.restype = ctypes.c_int
+        lib.pw_msa_refine_external.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int32]
         _lib = lib
     return _lib
 
@@ -576,6 +591,55 @@ class NativeMsa:
     def refine(self, remove_cons_gaps: bool, refine_clipping: bool) -> None:
         rc = self._lib.pw_msa_refine(
             self._h, int(remove_cons_gaps), int(refine_clipping),
+            self._warn_path.encode(), self._err, len(self._err))
+        self._replay_warnings()
+        if rc != 0:
+            self._raise(rc)
+
+    # ---- device-consensus delegation (--device=tpu): the engine holds
+    # the MSA, renders the pileup for the TPU kernel, and applies the
+    # kernel's bit-exact votes (cli.py _native_msa_outputs) ------------
+    def dims(self) -> tuple[int, int]:
+        out = np.zeros(2, dtype=np.int64)
+        self._lib.pw_msa_dims(self._h,
+                              out.ctypes.data_as(ctypes.c_void_p))
+        return int(out[0]), int(out[1])
+
+    def prepare_device(self) -> None:
+        """finalize + geometry-only column build (counts come from the
+        device kernel) — the native twin of build_msa(device=True)'s
+        host half."""
+        rc = self._lib.pw_msa_prepare_device(
+            self._h, self._warn_path.encode(), self._err, len(self._err))
+        self._replay_warnings()
+        if rc != 0:
+            self._raise(rc)
+
+    def render_pileup(self, out: np.ndarray) -> None:
+        """Fill ``out`` (depth, length int8, C-order) with the engine's
+        pre-refine pileup codes (0..6, exactly msa.py pileup_matrix)."""
+        assert out.dtype == np.int8 and out.flags.c_contiguous
+        rc = self._lib.pw_msa_render_pileup(
+            self._h, out.ctypes.data_as(ctypes.c_void_p), out.shape[0],
+            out.shape[1], self._err, len(self._err))
+        if rc != 0:
+            self._raise(rc)
+
+    def refine_external(self, counts: np.ndarray, votes_chars: np.ndarray,
+                        remove_cons_gaps: bool,
+                        refine_clipping: bool) -> None:
+        """Finish the consensus with the device kernel's counts+votes
+        (votes_chars: one uint8 char code per layout column, 0 = zero
+        coverage)."""
+        c = np.ascontiguousarray(counts, dtype=np.int32)
+        v = np.ascontiguousarray(votes_chars, dtype=np.uint8)
+        # the C side sizes its counts reads by len(votes): a shorter
+        # counts buffer would be a native out-of-bounds read
+        assert c.shape == (len(v), 6), (c.shape, len(v))
+        rc = self._lib.pw_msa_refine_external(
+            self._h, c.ctypes.data_as(ctypes.c_void_p),
+            v.ctypes.data_as(ctypes.c_void_p), len(v),
+            int(remove_cons_gaps), int(refine_clipping),
             self._warn_path.encode(), self._err, len(self._err))
         self._replay_warnings()
         if rc != 0:

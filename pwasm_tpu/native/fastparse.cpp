@@ -955,3 +955,104 @@ int pw_msa_write(void* h, int32_t what, const char* path,
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// Dims of the pre-refine pileup the engine would render: [depth, length]
+// (0,0 when no MSA).
+void pw_msa_dims(void* h, int64_t* out2) {
+  MsaBridge* b = (MsaBridge*)h;
+  out2[0] = b->ref_msa ? (int64_t)b->ref_msa->count() : 0;
+  out2[1] = b->ref_msa ? (int64_t)b->ref_msa->length : 0;
+}
+
+// Device-consensus preparation: finalize members (prep_seq/RC) and
+// build the column GEOMETRY only (counts come from the device kernel)
+// — the native twin of msa.py build_msa(device=True)'s host half.
+int pw_msa_prepare_device(void* h, const char* warn_path, char* errbuf,
+                          int32_t errcap) {
+  MsaBridge* b = (MsaBridge*)h;
+  if (!b->ref_msa) return 0;
+  WarnCapture cap(warn_path);
+  try {
+    b->ref_msa->finalize();
+    b->ref_msa->build_msa(/*count=*/false);
+    return 0;
+  } catch (const pwnative::PwErr& e) {
+    fill_err(errbuf, errcap, e.msg);
+    return e.code > 0 ? e.code : -1;
+  } catch (const std::exception& e) {
+    fill_err(errbuf, errcap, e.what());
+    return -1;
+  }
+}
+
+// Render the (depth, length) int8 pileup into caller memory (dims must
+// match pw_msa_dims).  Callable after pw_msa_prepare_device.
+int pw_msa_render_pileup(void* h, int8_t* out, int64_t depth,
+                         int64_t cols, char* errbuf, int32_t errcap) {
+  MsaBridge* b = (MsaBridge*)h;
+  if (!b->ref_msa) return 0;
+  if (depth != (int64_t)b->ref_msa->count() ||
+      cols != (int64_t)b->ref_msa->length) {
+    fill_err(errbuf, errcap, "pw_msa_render_pileup: dims mismatch\n");
+    return -1;
+  }
+  try {
+    b->ref_msa->render_pileup(out);
+    return 0;
+  } catch (const pwnative::PwErr& e) {
+    fill_err(errbuf, errcap, e.msg);
+    return e.code > 0 ? e.code : -1;
+  } catch (const std::exception& e) {
+    fill_err(errbuf, errcap, e.what());
+    return -1;
+  }
+}
+
+// Finish the consensus with EXTERNAL counts+votes (from the device
+// kernel): fill the column counts/layers the geometry-only build left
+// empty, then run the post-vote half of refine_msa.  ``votes`` is one
+// char code per layout column over the FULL [0, length) range ('A'..,
+// 'N', '-', 0 = zero coverage); counts is (length, 6) int32 C-order.
+// Returns 0 ok, a PwErr code (5 = zero-coverage column), or -1.
+int pw_msa_refine_external(void* h, const int32_t* counts,
+                           const uint8_t* votes, int64_t n,
+                           int32_t remove_cons_gaps, int32_t refine_clip,
+                           const char* warn_path, char* errbuf,
+                           int32_t errcap) {
+  MsaBridge* b = (MsaBridge*)h;
+  if (!b->ref_msa) return 0;
+  WarnCapture cap(warn_path);
+  try {
+    pwnative::Msa& m = *b->ref_msa;
+    if (!m.msacolumns || n != (int64_t)m.length) {
+      fill_err(errbuf, errcap,
+               "pw_msa_refine_external: prepare_device not run or dims "
+               "mismatch\n");
+      return -1;
+    }
+    pwnative::MsaColumns& cols = *m.msacolumns;
+    for (int64_t c = 0; c < n; ++c) {
+      int32_t layer = 0;
+      for (int k = 0; k < 6; ++k) {
+        cols.counts[(size_t)c * 6 + k] = counts[c * 6 + k];
+        layer += counts[c * 6 + k];
+      }
+      cols.layers[(size_t)c] = layer;
+    }
+    std::vector<int> v;
+    for (long col = cols.mincol; col <= cols.maxcol; ++col)
+      v.push_back((int)votes[(size_t)col]);
+    m.refine_with_votes(v, remove_cons_gaps != 0, refine_clip != 0);
+    return 0;
+  } catch (const pwnative::PwErr& e) {
+    fill_err(errbuf, errcap, e.msg);
+    return e.code > 0 ? e.code : -1;
+  } catch (const std::exception& e) {
+    fill_err(errbuf, errcap, e.what());
+    return -1;
+  }
+}
+
+}  // extern "C"

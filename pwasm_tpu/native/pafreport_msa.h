@@ -592,8 +592,12 @@ class Msa {
   // GapAssem.cpp:1048-1367; msa.py build_msa/refine_msa/write_*) ------
 
   // Pour one sequence into the column pileup (GASeq::toMSA,
-  // GapAssem.cpp:551-591; msa.py _seq_to_columns).
-  void seq_to_columns(const GapSeq* s, MsaColumns& cols) const {
+  // GapAssem.cpp:551-591; msa.py _seq_to_columns).  With count=false
+  // only the geometry side effects happen (live window) — the counts
+  // are expected to come from the device pileup kernel instead
+  // (msa.py _seq_to_columns(count=False)).
+  void seq_to_columns(const GapSeq* s, MsaColumns& cols,
+                      bool count = true) const {
     if (s->seq.empty() || (long)s->seq.size() != s->seqlen)
       throw PwErr(sformat(
           "GapSeq toMSA Error: invalid sequence data '%s' (len=%zu, "
@@ -611,12 +615,14 @@ class Msa {
       col += 1 + g;  // base i sits at `col` (inclusive-cumsum layout)
       bool unclipped = !(i < clipL || i >= s->seqlen - clipR);
       if (!unclipped) continue;
-      cols.counts[(size_t)col * 6 + column_bucket(
-          (unsigned char)s->seq[(size_t)i])]++;
-      cols.layers[(size_t)col]++;
-      for (int32_t k = 1; k <= g; ++k) {  // gap run before the base
-        cols.counts[(size_t)(col - k) * 6 + 5]++;
-        cols.layers[(size_t)(col - k)]++;
+      if (count) {
+        cols.counts[(size_t)col * 6 + column_bucket(
+            (unsigned char)s->seq[(size_t)i])]++;
+        cols.layers[(size_t)col]++;
+        for (int32_t k = 1; k <= g; ++k) {  // gap run before the base
+          cols.counts[(size_t)(col - k) * 6 + 5]++;
+          cols.layers[(size_t)(col - k)]++;
+        }
       }
       if (first_col < 0) {
         first_col = col;
@@ -629,7 +635,7 @@ class Msa {
   }
 
   // (GSeqAlign::buildMSA, GapAssem.cpp:1088-1106)
-  void build_msa() {
+  void build_msa(bool count = true) {
     if (msacolumns)
       throw PwErr("Error: cannot call buildMSA() twice!\n");
     msacolumns = std::make_unique<MsaColumns>(length, minoffset);
@@ -645,7 +651,35 @@ class Msa {
         s->set_flag(FLAG_BAD_ALN);
         ++badseqs;
       }
-      seq_to_columns(s, *msacolumns);
+      seq_to_columns(s, *msacolumns, count);
+    }
+  }
+
+  // Render the pre-refine MSA as a (count(), length) int8 code matrix
+  // for the device consensus kernel — the C++ twin of
+  // msa.py pileup_matrix's no-deletions fast path: A0 C1 G2 T3 N4,
+  // gap-run columns 5, everything else (outside span / clipped) 6.
+  // Pre-refine only (deleted bases would need spill rows; the device
+  // delegation path always renders before any removal).
+  void render_pileup(int8_t* out) const {
+    memset(out, 6, (size_t)count() * (size_t)length);
+    for (size_t r = 0; r < seqs.size(); ++r) {
+      const GapSeq* s = seqs[r];
+      int8_t* row = out + r * (size_t)length;
+      long clipL, clipR;
+      s->clip_lr(clipL, clipR);
+      long col = s->offset - minoffset - 1;
+      for (long i = 0; i < s->seqlen; ++i) {
+        int32_t g = s->gaps[(size_t)i];
+        if (g < 0)
+          throw PwErr(sformat(
+              "render_pileup: sequence %s has deleted bases "
+              "(post-refine MSA)\n", s->name.c_str()));
+        col += 1 + g;
+        if (i < clipL || i >= s->seqlen - clipR) continue;
+        row[col] = (int8_t)column_bucket((unsigned char)s->seq[(size_t)i]);
+        for (int32_t k = 1; k <= g; ++k) row[col - k] = 5;
+      }
     }
   }
 
@@ -671,6 +705,17 @@ class Msa {
     for (long col = cols.mincol; col <= cols.maxcol; ++col)
       votes.push_back(best_char_from_counts(
           &cols.counts[(size_t)col * 6], cols.layers[(size_t)col]));
+    refine_with_votes(votes, remove_cons_gaps, refine_clipping);
+  }
+
+  // The post-vote half of refine_msa with the votes supplied by the
+  // caller — the seam the device consensus delegation uses: the bridge
+  // builds geometry only (build_msa(false)), renders the pileup for
+  // the TPU kernel, and hands the kernel's bit-exact votes (char codes
+  // over [mincol, maxcol]; 0 = zero coverage) back here.
+  void refine_with_votes(const std::vector<int>& votes,
+                         bool remove_cons_gaps, bool refine_clipping) {
+    MsaColumns& cols = *msacolumns;
     long cols_removed = 0;
     consensus.clear();
     for (long col = cols.mincol; col <= cols.maxcol; ++col) {

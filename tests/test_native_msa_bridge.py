@@ -228,6 +228,78 @@ def test_delegated_realign_path(tmp_path, monkeypatch):
     assert native[0] == 0
 
 
+@pytest.mark.parametrize("extra", [[], ["--remove-cons-gaps"],
+                                   ["--shard"]])
+def test_device_delegation_byte_identical(tmp_path, monkeypatch, extra):
+    """--device=tpu with the native engine: the C++ merge renders the
+    pileup, the device kernel votes, C++ applies the votes — outputs
+    byte-identical to the Python-engine device path (and the cpu
+    path)."""
+    rng = np.random.default_rng(29)
+    L = 120
+    Q = "".join("ACGT"[i] for i in rng.integers(0, 4, L))
+    lines = _rand_lines(rng, "q", Q, 8)
+    paf, fa = _write_inputs(tmp_path, lines, [("q", Q.encode())])
+    outs = {}
+    for tag, env, dev in (("native_tpu", "1", "tpu"),
+                          ("python_tpu", "0", "tpu"),
+                          ("native_cpu", "1", "cpu")):
+        if extra == ["--shard"] and dev == "cpu":
+            continue  # --shard requires --device=tpu
+        monkeypatch.setenv("PWASM_NATIVE_MSA", env)
+        err = io.StringIO()
+        stats = tmp_path / f"{tag}.stats"
+        rc = run([paf, "-r", fa, "-o", str(tmp_path / f"{tag}.dfa"),
+                  "-w", str(tmp_path / f"{tag}.mfa"),
+                  f"--ace={tmp_path / tag}.ace",
+                  f"--info={tmp_path / tag}.info",
+                  f"--device={dev}", f"--stats={stats}"] + extra,
+                 stderr=err)
+        assert rc == 0, err.getvalue()
+        import json as _json
+        assert _json.loads(stats.read_text())["engine_fallbacks"] == 0
+        outs[tag] = b"".join(
+            (tmp_path / f"{tag}.{e}").read_bytes()
+            for e in ("dfa", "mfa", "ace", "info"))
+    assert len(set(outs.values())) == 1
+
+
+def test_device_delegation_kernel_provenance(tmp_path, monkeypatch):
+    """The delegated --device=tpu consensus provably uses the Pallas
+    kernel: tamper with its votes and watch the ACE consensus change."""
+    import pwasm_tpu.ops.consensus as consmod
+
+    rng = np.random.default_rng(33)
+    Q = "".join("ACGT"[i] for i in rng.integers(0, 4, 60))
+    lines = _rand_lines(rng, "q", Q, 4)
+    paf, fa = _write_inputs(tmp_path, lines, [("q", Q.encode())])
+    monkeypatch.setenv("PWASM_NATIVE_MSA", "1")
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / "r.dfa"),
+              f"--ace={tmp_path / 'good'}.ace", "--device=tpu"],
+             stderr=io.StringIO())
+    assert rc == 0
+
+    real = consmod.consensus_pallas
+
+    def tampered(bases, *a, **k):
+        votes, counts = real(bases, *a, **k)
+        # flip every vote to 'T' (code 3) where there is coverage
+        import jax.numpy as jnp
+        return jnp.where(votes >= 0, jnp.int8(3), votes), counts
+
+    monkeypatch.setattr(consmod, "consensus_pallas", tampered)
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / "r2.dfa"),
+              f"--ace={tmp_path / 'bad'}.ace", "--device=tpu"],
+             stderr=io.StringIO())
+    assert rc == 0
+    good = (tmp_path / "good.ace").read_text()
+    bad = (tmp_path / "bad.ace").read_text()
+    assert good != bad
+    # tampered consensus is all T over its live window
+    cons_line = bad.splitlines()[1]
+    assert set(cons_line) == {"T"}
+
+
 def test_delegation_used(tmp_path, monkeypatch):
     """Prove the native engine actually handles the build when enabled:
     tamper with the Python engine's merge and observe no effect (and the
