@@ -153,29 +153,13 @@ def _fail(metric: str) -> int:
 
 
 def _probe_backend(env: dict, timeout: float) -> tuple[str | None, str]:
-    """Ask a subprocess which jax platform initializes under ``env``.
-    Returns ``(platform, "")`` on success, or ``(None, diagnostic)`` on
-    error OR hang — the round-1 capture died on an init error
-    (BENCH_r01.json) and the tunnel has also been observed to hang
-    indefinitely, so the probe must bound both failure modes.  Shared
-    with tpu_smoke.py (which imports it), so fixes land in one place."""
-    import subprocess
+    """Bounded which-platform-initializes probe — canonical
+    implementation in pwasm_tpu.utils.backend (shared with the CLI's
+    --device=tpu health gate); this alias keeps tpu_smoke.py's import
+    working."""
+    from pwasm_tpu.utils.backend import probe_backend
 
-    code = ("import jax; d = jax.devices(); "
-            "print('PLATFORM=%s:%d' % (d[0].platform, len(d)))")
-    try:
-        r = subprocess.run([sys.executable, "-c", code], env=env,
-                           capture_output=True, timeout=timeout, text=True)
-    except subprocess.TimeoutExpired:
-        return None, f"probe hang (> {timeout:.0f}s)"
-    except Exception as e:
-        return None, f"probe spawn failed: {type(e).__name__}: {e}"
-    if r.returncode != 0:
-        return None, r.stderr[-500:]
-    for line in r.stdout.splitlines():
-        if line.startswith("PLATFORM="):
-            return line.split("=", 1)[1].split(":")[0], ""
-    return None, r.stderr[-500:]
+    return probe_backend(env, timeout)
 
 
 def _resolve_backend() -> str:

@@ -426,6 +426,20 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
     # program per cfg.batch (the SURVEY.md §3.1 TPU boundary — control
     # crosses host->device once per batch, not per alignment)
     use_device = cfg.device != "cpu"
+    if use_device:
+        # bounded health check before the first jax touch: an
+        # unreachable tunnel must cost seconds and a loud CPU demotion,
+        # not an indefinite hang at backend init (SURVEY.md §5 failure
+        # detection; PWASM_DEVICE_PROBE=0 skips)
+        from pwasm_tpu.utils.backend import device_backend_reachable
+        ok, why = device_backend_reachable()
+        if not ok:
+            print(f"Warning: jax backend unreachable ({why.strip()}); "
+                  "running with --device=cpu", file=stderr)
+            use_device = False
+            cfg.device = "cpu"
+            cfg.shard = 0
+            stats.engine_fallbacks += 1
     pending: list[tuple] = []
     cons_outs = cons_outs or {}
     build_msa_out = fmsa is not None or bool(cons_outs)
@@ -791,7 +805,7 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
               "device batches fell back to the host scalar path",
               file=stderr)
     if stats.engine_fallbacks:
-        print(f"Warning: {stats.engine_fallbacks} MSA engine stage(s) "
+        print(f"Warning: {stats.engine_fallbacks} engine/device stage(s) "
               "fell back from the requested device/native path",
               file=stderr)
     if cfg.verbose:

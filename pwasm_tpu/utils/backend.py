@@ -1,0 +1,104 @@
+"""Bounded jax-backend probing (SURVEY.md §5 failure detection).
+
+The TPU here sits behind a tunnel that goes down for multi-hour
+stretches; an unguarded first `jax.devices()` then hangs indefinitely.
+Every front end that can touch the device — the CLI's `--device=tpu`
+path, `bench.py`, `tpu_smoke.py` — probes through this module first:
+a subprocess asks which platform initializes under the current env,
+bounded by a timeout, so a dead tunnel costs seconds, not a hang.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_probe_cache: tuple[str | None, str] | None = None
+
+
+def probe_backend(env: dict, timeout: float) -> tuple[str | None, str]:
+    """Ask a subprocess which jax platform initializes under ``env``.
+    Returns ``(platform, "")`` on success, or ``(None, diagnostic)`` on
+    error OR hang — both failure modes have been observed on the
+    tunnel (an init error in round 1, multi-hour hangs since)."""
+    code = ("import jax; d = jax.devices(); "
+            "print('PLATFORM=%s:%d' % (d[0].platform, len(d)))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, timeout=timeout,
+                           text=True)
+    except subprocess.TimeoutExpired:
+        return None, f"probe hang (> {timeout:.0f}s)"
+    except Exception as e:
+        return None, f"probe spawn failed: {type(e).__name__}: {e}"
+    if r.returncode != 0:
+        return None, r.stderr[-500:]
+    for line in r.stdout.splitlines():
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1].split(":")[0], ""
+    return None, r.stderr[-500:]
+
+
+def _success_marker() -> str:
+    """Path of the cross-process probe-success marker, keyed on the
+    env bits that select the backend (a CPU-pinned shell and a
+    tunnel-pointed shell must not share a verdict)."""
+    import hashlib
+    import tempfile
+
+    key = "|".join(os.environ.get(k, "") for k in
+                   ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS",
+                    "JAX_PLATFORM_NAME"))
+    h = hashlib.sha256(key.encode()).hexdigest()[:16]
+    return os.path.join(tempfile.gettempdir(), f"pwasm_probe_ok_{h}")
+
+
+def device_backend_reachable() -> tuple[bool, str]:
+    """Bounded health check before the CLI's first device touch.
+
+    Returns ``(True, "")`` when a jax backend initializes under the
+    current env (whatever platform — CPU-pinned test runs are healthy),
+    or ``(False, diagnostic)``.  The probe subprocess pays a full jax
+    import + backend init, so the healthy verdict is cached two ways:
+    per process, and cross-process via a TTL success marker in the temp
+    dir (``PWASM_DEVICE_PROBE_TTL`` seconds, default 300) keyed on the
+    backend-selecting env — consecutive healthy ``--device=tpu`` runs
+    probe once, not every run.  Skipped (True) when jax is already
+    imported in-process — its backend either initialized already or
+    will fail fast — or when ``PWASM_DEVICE_PROBE=0``.
+    ``PWASM_DEVICE_PROBE_TIMEOUT`` bounds the probe (default 150 s,
+    matching the bench)."""
+    global _probe_cache
+    if os.environ.get("PWASM_DEVICE_PROBE", "1") == "0":
+        return True, ""
+    if "jax" in sys.modules:
+        return True, ""
+    if _probe_cache is None:
+        try:
+            ttl = float(os.environ.get("PWASM_DEVICE_PROBE_TTL", "300"))
+        except ValueError:
+            ttl = 300.0
+        marker = _success_marker()
+        try:
+            import time
+            if ttl > 0 and time.time() - os.path.getmtime(marker) < ttl:
+                _probe_cache = ("cached", "")
+                return True, ""
+        except OSError:
+            pass
+        try:
+            timeout = float(os.environ.get(
+                "PWASM_DEVICE_PROBE_TIMEOUT", "150"))
+        except ValueError:
+            timeout = 150.0
+        _probe_cache = probe_backend(dict(os.environ), timeout)
+        if _probe_cache[0] is not None:
+            try:  # refresh the cross-process marker
+                with open(marker, "w"):
+                    pass
+                os.utime(marker, None)
+            except OSError:
+                pass
+    platform, why = _probe_cache
+    return platform is not None, why

@@ -264,6 +264,33 @@ def test_consensus_device_matches_cpu(tmp_path):
     assert out_dev.read_text() == out_cpu.read_text()
 
 
+def test_device_probe_failure_demotes_to_cpu(tmp_path, monkeypatch):
+    """--device=tpu against an unreachable backend (simulated probe
+    failure): the run demotes to the CPU path loudly instead of hanging
+    at jax init — outputs byte-identical to --device=cpu and the
+    demotion counted in engine_fallbacks."""
+    import pwasm_tpu.utils.backend as backend
+
+    paf, fa = _mk_inputs(tmp_path, _three_alignments())
+    monkeypatch.setattr(backend, "device_backend_reachable",
+                        lambda: (False, "probe hang (> 150s)"))
+    err = io.StringIO()
+    stats = tmp_path / "s.json"
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / "d.dfa"),
+              f"--ace={tmp_path / 'd.ace'}", "--device=tpu",
+              f"--stats={stats}"], stderr=err)
+    assert rc == 0
+    assert "backend unreachable" in err.getvalue()
+    assert json.loads(stats.read_text())["engine_fallbacks"] == 1
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / "c.dfa"),
+              f"--ace={tmp_path / 'c.ace'}"], stderr=io.StringIO())
+    assert rc == 0
+    assert (tmp_path / "d.dfa").read_bytes() == \
+        (tmp_path / "c.dfa").read_bytes()
+    assert (tmp_path / "d.ace").read_bytes() == \
+        (tmp_path / "c.ace").read_bytes()
+
+
 def test_ace_remove_cons_gaps_device_no_fallback(tmp_path):
     """--ace --remove-cons-gaps --device=tpu: the whole consensus path
     (counts+votes, gap-column removal, both refine passes) runs without
