@@ -307,12 +307,18 @@ def _fwdptr_init(n, band, dlo, go, ge, block_t):
 
 
 def _fwdptr_block(win, q8, q_len, i0, carry, *, n, band, dlo,
-                  match, mismatch, go, ge, block_t):
+                  match, mismatch, go, ge, block_t, interior=False):
     """8 DP rows over one (>= band+7, block_t) target window starting at
     absolute row i0+1; ``q8`` holds the 8 per-lane query bases.  Shared
     by the resident and HBM-streaming forward kernels, so their pointers
     and scores are identical by construction.  Returns (carry, packed
-    pointer tile)."""
+    pointer tile).
+
+    ``interior`` (trace-time) elides the band-boundary masks — valid
+    only when all 8 rows keep the whole band inside 1..n, i.e.
+    ``i0 + 1 >= 1 - dlo`` and ``i0 + 8 <= n - band - dlo + 1`` (the
+    same condition the scores kernel splits its phases on); the
+    per-lane q_len freeze is data-dependent and always stays."""
     bidx = jax.lax.broadcasted_iota(jnp.int32, (band, block_t), 0)
     neg = jnp.full((band, block_t), NEG, dtype=jnp.int32)
     m_prev, ix_prev, iy_prev = carry
@@ -330,11 +336,12 @@ def _fwdptr_block(win, q8, q_len, i0, carry, *, n, band, dlo,
         up_ix = jnp.concatenate([ix_prev[1:], neg[:1]], axis=0)
         bx = (up_ix - ge > up_m - go).astype(jnp.int32)
         ix_new = jnp.maximum(up_m - go, up_ix - ge)
-        j = i + dlo + bidx
-        valid = (j >= 1) & (j <= n)
-        m_new = jnp.where(valid, m_new, NEG)
-        ix_new = jnp.where(j == 0, -(go + (i - 1) * ge), ix_new)
-        ix_new = jnp.where((j < 0) | (j > n), NEG, ix_new)
+        if not interior:
+            j = i + dlo + bidx
+            valid = (j >= 1) & (j <= n)
+            m_new = jnp.where(valid, m_new, NEG)
+            ix_new = jnp.where(j == 0, -(go + (i - 1) * ge), ix_new)
+            ix_new = jnp.where((j < 0) | (j > n), NEG, ix_new)
         run = m_new + bidx * ge
         sh = 1
         while sh < band:
@@ -343,7 +350,8 @@ def _fwdptr_block(win, q8, q_len, i0, carry, *, n, band, dlo,
             sh *= 2
         run_prev = jnp.concatenate([neg[:1], run[:-1]], axis=0)
         iy_new = run_prev - go - (bidx - 1) * ge
-        iy_new = jnp.where(valid, iy_new, NEG)
+        if not interior:
+            iy_new = jnp.where(valid, iy_new, NEG)
         m_left = jnp.concatenate([neg[:1], m_new[:-1]], axis=0)
         iy_left = jnp.concatenate([neg[:1], iy_new[:-1]], axis=0)
         by = (iy_left - ge > m_left - go).astype(jnp.int32)
@@ -391,16 +399,33 @@ def _fwdptr_kernel(q_ref, t_ref, qlen_ref, tlen_ref,
     i0 = p8 * 8
     win = t_ref[pl.ds(i0 + dlo + band, band + 7), :]
     q8 = q_ref[pl.ds(i0, 8), :]
-    carry, packed = _fwdptr_block(
-        win, q8, q_len, i0, (m_c[...], ix_c[...], iy_c[...]),
-        n=n, band=band, dlo=dlo, match=match, mismatch=mismatch,
-        go=go, ge=ge, block_t=block_t)
-    m_c[...], ix_c[...], iy_c[...] = carry
-    ptr_ref[0] = packed
+    carry_in = (m_c[...], ix_c[...], iy_c[...])
+    # all 8 rows keep the whole band inside 1..n: run the statically
+    # mask-elided block body (the scores kernel's interior trick); the
+    # row-block index is a grid coordinate, so the split is a runtime
+    # branch rather than a static phase split
+    interior_ok = (i0 + 1 >= 1 - dlo) & (i0 + 8 <= n - band - dlo + 1)
+
+    def run_block(interior):
+        carry, packed = _fwdptr_block(
+            win, q8, q_len, i0, carry_in,
+            n=n, band=band, dlo=dlo, match=match, mismatch=mismatch,
+            go=go, ge=ge, block_t=block_t, interior=interior)
+        m_c[...], ix_c[...], iy_c[...] = carry
+        ptr_ref[0] = packed
+
+    @pl.when(interior_ok)
+    def _():
+        run_block(True)
+
+    @pl.when(jnp.logical_not(interior_ok))
+    def _():
+        run_block(False)
 
     @pl.when(p8 == m8 - 1)
     def _():
-        _fwdptr_extract(carry, q_len, tlen_ref[...], band, dlo,
+        _fwdptr_extract((m_c[...], ix_c[...], iy_c[...]), q_len,
+                        tlen_ref[...], band, dlo,
                         score_ref, b0_ref, mat0_ref)
 
 
@@ -455,25 +480,30 @@ def _fwdptr_kernel_long(q_hbm, t_hbm, qlen_ref, tlen_ref,
         q_dma(qbuf0, 0, p8 + 1).start()
 
     q_len = qlen_ref[...]
+    # mask-elided interior body for fully in-band row blocks (the same
+    # runtime split as the resident kernel)
+    i0 = p8 * 8
+    interior_ok = (i0 + 1 >= 1 - dlo) & (i0 + 8 <= n - band - dlo + 1)
 
-    def compute(tbuf, qbuf, slot):
+    def compute(tbuf, qbuf, slot, interior):
         t_dma(tbuf, slot, p8).wait()
         q_dma(qbuf, slot, p8).wait()
         carry, packed = _fwdptr_block(
-            tbuf[...], qbuf[...], q_len, p8 * 8,
+            tbuf[...], qbuf[...], q_len, i0,
             (m_c[...], ix_c[...], iy_c[...]),
             n=n, band=band, dlo=dlo, match=match, mismatch=mismatch,
-            go=go, ge=ge, block_t=block_t)
+            go=go, ge=ge, block_t=block_t, interior=interior)
         m_c[...], ix_c[...], iy_c[...] = carry
         ptr_ref[0] = packed
 
-    @pl.when(p8 % 2 == 0)
-    def _():
-        compute(tbuf0, qbuf0, 0)
-
-    @pl.when(p8 % 2 == 1)
-    def _():
-        compute(tbuf1, qbuf1, 1)
+    for parity in (0, 1):
+        for inter in (True, False):
+            @pl.when((p8 % 2 == parity)
+                     & (interior_ok if inter
+                        else jnp.logical_not(interior_ok)))
+            def _(parity=parity, inter=inter):
+                compute(tbuf0 if parity == 0 else tbuf1,
+                        qbuf0 if parity == 0 else qbuf1, parity, inter)
 
     @pl.when(p8 == m8 - 1)
     def _():

@@ -14,7 +14,7 @@ import os
 import subprocess
 import sys
 
-_probe_cache: tuple[str | None, str] | None = None
+_probe_cache: tuple[float, str | None, str] | None = None  # (ts, platform, why)
 
 
 def probe_backend(env: dict, timeout: float) -> tuple[str | None, str]:
@@ -43,7 +43,8 @@ def probe_backend(env: dict, timeout: float) -> tuple[str | None, str]:
 def _success_marker() -> str:
     """Path of the cross-process probe-success marker, keyed on the
     env bits that select the backend (a CPU-pinned shell and a
-    tunnel-pointed shell must not share a verdict)."""
+    tunnel-pointed shell must not share a verdict) AND the uid (a
+    shared temp dir must not let another user poison the verdict)."""
     import hashlib
     import tempfile
 
@@ -51,7 +52,23 @@ def _success_marker() -> str:
                    ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS",
                     "JAX_PLATFORM_NAME"))
     h = hashlib.sha256(key.encode()).hexdigest()[:16]
-    return os.path.join(tempfile.gettempdir(), f"pwasm_probe_ok_{h}")
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    return os.path.join(tempfile.gettempdir(),
+                        f"pwasm_probe_ok_{uid}_{h}")
+
+
+def _backend_already_initialized() -> bool:
+    """True only when an in-process jax BACKEND exists (a mere
+    ``import jax`` does not initialize one and proves nothing about
+    tunnel health)."""
+    if "jax" not in sys.modules:
+        return False
+    try:
+        from jax._src import xla_bridge as xb
+
+        return bool(getattr(xb, "_backends", {}))
+    except Exception:
+        return False
 
 
 def device_backend_reachable() -> tuple[bool, str]:
@@ -70,20 +87,22 @@ def device_backend_reachable() -> tuple[bool, str]:
     ``PWASM_DEVICE_PROBE_TIMEOUT`` bounds the probe (default 150 s,
     matching the bench)."""
     global _probe_cache
+    import time
+
     if os.environ.get("PWASM_DEVICE_PROBE", "1") == "0":
         return True, ""
-    if "jax" in sys.modules:
+    if _backend_already_initialized():
         return True, ""
-    if _probe_cache is None:
-        try:
-            ttl = float(os.environ.get("PWASM_DEVICE_PROBE_TTL", "300"))
-        except ValueError:
-            ttl = 300.0
+    try:
+        ttl = float(os.environ.get("PWASM_DEVICE_PROBE_TTL", "300"))
+    except ValueError:
+        ttl = 300.0
+    now = time.time()
+    if _probe_cache is None or (ttl > 0 and now - _probe_cache[0] > ttl):
         marker = _success_marker()
         try:
-            import time
-            if ttl > 0 and time.time() - os.path.getmtime(marker) < ttl:
-                _probe_cache = ("cached", "")
+            if ttl > 0 and now - os.path.getmtime(marker) < ttl:
+                _probe_cache = (now, "cached", "")
                 return True, ""
         except OSError:
             pass
@@ -92,13 +111,18 @@ def device_backend_reachable() -> tuple[bool, str]:
                 "PWASM_DEVICE_PROBE_TIMEOUT", "150"))
         except ValueError:
             timeout = 150.0
-        _probe_cache = probe_backend(dict(os.environ), timeout)
-        if _probe_cache[0] is not None:
-            try:  # refresh the cross-process marker
-                with open(marker, "w"):
-                    pass
-                os.utime(marker, None)
+        platform, why = probe_backend(dict(os.environ), timeout)
+        _probe_cache = (now, platform, why)
+        if platform is not None:
+            try:  # refresh the cross-process marker (never through a
+                # symlink another user could plant in the shared dir)
+                fd = os.open(marker,
+                             os.O_WRONLY | os.O_CREAT | os.O_TRUNC
+                             | getattr(os, "O_NOFOLLOW", 0), 0o600)
+                os.close(fd)
+                os.utime(marker, None)  # O_TRUNC on empty keeps mtime:
+                #                         refresh it explicitly
             except OSError:
                 pass
-    platform, why = _probe_cache
+    _ts, platform, why = _probe_cache
     return platform is not None, why

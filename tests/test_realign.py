@@ -311,6 +311,42 @@ def test_pallas_rowwalk_matches_xla(seed, kernel):
                                           err_msg=f"{name} band={band}")
 
 
+@pytest.mark.parametrize("kernel", ["pallas", "pallas_long"])
+def test_pallas_interior_blocks_match_xla(kernel):
+    """Geometry with MANY fully-interior 8-row blocks (the forward
+    kernels' mask-elided branch): pinned so the elided body provably
+    executes, bit-identical to the XLA scan."""
+    from pwasm_tpu.ops.banded_dp import band_dlo
+    from pwasm_tpu.ops.realign import banded_realign_rows
+
+    m, n_max, band = 256, 272, 32   # n-m = band/2: band covers 0..16
+    dlo = band_dlo(m, n_max, band)
+    # at least one 8-row block entirely inside [1-dlo, n-band-dlo+1]
+    lo = max(0, -dlo)           # 0-based first interior row index
+    hi = n_max - band - dlo + 1 - 8
+    assert hi - lo >= 16, "geometry no longer pins interior blocks"
+    rng = np.random.default_rng(21)
+    T = 12
+    qs = np.full((T, m), 127, dtype=np.int8)
+    ts = np.full((T, n_max), 127, dtype=np.int8)
+    qls = np.zeros(T, dtype=np.int32)
+    tls = np.zeros(T, dtype=np.int32)
+    for k in range(T):
+        q = rng.integers(0, 4, m).astype(np.int8)
+        t = _mutate(rng, q, int(rng.integers(0, 10)),
+                    int(rng.integers(0, 6)))[:n_max]
+        qs[k] = q
+        ts[k, :len(t)] = t
+        qls[k] = m
+        tls[k] = len(t)
+    ref = banded_realign_rows(qs, ts, qls, tls, band=band, kernel="xla")
+    got = banded_realign_rows(qs, ts, qls, tls, band=band, kernel=kernel)
+    for name, a, b in zip(("scores", "leads", "iy", "ops", "ok"),
+                          ref, got):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=name)
+
+
 def test_sharded_realign_matches_unsharded():
     """Lanes sharded over the virtual 8-device mesh produce bit-identical
     compressed rows to the single-device call — the --shard realign
