@@ -22,6 +22,9 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "chip_burst")
+sys.path.insert(0, REPO)
+
+from bench import _json_rows  # noqa: E402  (one shared stdout parser)
 
 
 def _run(name: str, env_extra: dict, args: list[str], timeout: float,
@@ -38,9 +41,6 @@ def _run(name: str, env_extra: dict, args: list[str], timeout: float,
         r = subprocess.run([sys.executable] + args, env=env, cwd=REPO,
                            capture_output=True, text=True,
                            timeout=timeout)
-        sys.path.insert(0, REPO)
-        from bench import _json_rows
-
         rec = {"step": name, "rc": r.returncode,
                "rows": _json_rows(r.stdout),
                "wall_s": round(time.time() - t0, 1)}
@@ -54,6 +54,12 @@ def _run(name: str, env_extra: dict, args: list[str], timeout: float,
                 if part:                       # only hang diagnostic
                     f.write(part if isinstance(part, str)
                             else part.decode("utf-8", "replace"))
+    except Exception as e:
+        # a spawn failure must cost one step record, never the rest of
+        # a scarce healthy-tunnel window
+        rec = {"step": name, "rc": None, "rows": [],
+               "wall_s": round(time.time() - t0, 1),
+               "error": f"{type(e).__name__}: {e}"}
     log.append(rec)
     print(json.dumps(rec), flush=True)
     with open(os.path.join(OUT, "burst.jsonl"), "a") as f:

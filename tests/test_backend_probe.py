@@ -1,0 +1,91 @@
+"""The bounded backend health gate (utils/backend.py): caching, TTL,
+and skip semantics — all probe calls are stubbed, so these tests never
+touch a real backend."""
+
+import os
+
+import pytest
+
+import pwasm_tpu.utils.backend as B
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch, tmp_path):
+    """Isolate every test: no in-process cache, a private marker path,
+    and pretend no jax backend is initialized (the pytest process has
+    one, which would short-circuit the gate)."""
+    monkeypatch.setattr(B, "_probe_cache", None)
+    marker = tmp_path / "marker"
+    monkeypatch.setattr(B, "_success_marker", lambda: str(marker))
+    monkeypatch.setattr(B, "_backend_already_initialized", lambda: False)
+    monkeypatch.delenv("PWASM_DEVICE_PROBE", raising=False)
+    monkeypatch.delenv("PWASM_DEVICE_PROBE_TTL", raising=False)
+    yield marker
+
+
+def test_probe_failure_demotes_and_caches(monkeypatch, _fresh):
+    calls = []
+
+    def probe(env, timeout):
+        calls.append(1)
+        return None, "probe hang (> 1s)"
+
+    monkeypatch.setattr(B, "probe_backend", probe)
+    ok, why = B.device_backend_reachable()
+    assert not ok and "hang" in why
+    ok2, _ = B.device_backend_reachable()
+    assert not ok2
+    assert len(calls) == 1          # verdict cached within the TTL
+    assert not os.path.exists(_fresh)   # failure writes no marker
+
+
+def test_probe_success_writes_marker_and_skips_reprobe(monkeypatch,
+                                                       _fresh):
+    calls = []
+
+    def probe(env, timeout):
+        calls.append(1)
+        return "tpu", ""
+
+    monkeypatch.setattr(B, "probe_backend", probe)
+    assert B.device_backend_reachable() == (True, "")
+    assert os.path.exists(_fresh)
+    # a second process (fresh in-process cache) trusts the marker
+    monkeypatch.setattr(B, "_probe_cache", None)
+    monkeypatch.setattr(
+        B, "probe_backend",
+        lambda *a: (_ for _ in ()).throw(AssertionError("re-probed")))
+    assert B.device_backend_reachable() == (True, "")
+    assert len(calls) == 1
+
+
+def test_failed_verdict_recovers_after_ttl(monkeypatch, _fresh):
+    monkeypatch.setenv("PWASM_DEVICE_PROBE_TTL", "100")
+    now = [1000.0]
+    monkeypatch.setattr(B, "probe_backend",
+                        lambda *a: (None, "down"))
+    import time as _time
+
+    monkeypatch.setattr(_time, "time", lambda: now[0])
+    assert not B.device_backend_reachable()[0]
+    # tunnel comes back; verdict flips only after the TTL expires
+    monkeypatch.setattr(B, "probe_backend", lambda *a: ("tpu", ""))
+    assert not B.device_backend_reachable()[0]   # still cached
+    now[0] += 200.0
+    assert B.device_backend_reachable()[0]       # re-probed, healthy
+
+
+def test_probe_opt_out(monkeypatch, _fresh):
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    monkeypatch.setattr(
+        B, "probe_backend",
+        lambda *a: (_ for _ in ()).throw(AssertionError("probed")))
+    assert B.device_backend_reachable() == (True, "")
+
+
+def test_initialized_backend_skips(monkeypatch, _fresh):
+    monkeypatch.setattr(B, "_backend_already_initialized", lambda: True)
+    monkeypatch.setattr(
+        B, "probe_backend",
+        lambda *a: (_ for _ in ()).throw(AssertionError("probed")))
+    assert B.device_backend_reachable() == (True, "")
