@@ -569,8 +569,10 @@ def refine_clipping_batch(seqs: list[GapSeq], cons: bytes,
                 MISMATCH_SC, mesh=mesh)
         except Exception as e:  # backend down / jax unavailable:
             # replay on the host phases (bit-exact), surfaced by count
+            from pwasm_tpu.utils import exc_detail
+
             print(f"pwasm: device clip refinement fell back to host "
-                  f"({type(e).__name__})", file=sys.stderr)
+                  f"({exc_detail(e)})", file=sys.stderr)
             demotions = 1
         else:
             for km in np.nonzero(missR)[0]:

@@ -311,7 +311,18 @@ class Msa:
         (base_cols, unclipped mask, gap-run columns before unclipped
         bases).  ``base_cols[i]`` is the layout column of base i under the
         walk semantics (1 + gap per base; negative gaps collapse deleted
-        bases onto their neighbor's column)."""
+        bases onto their neighbor's column).
+
+        Post-deletion placement is a repo-defined extension: this walk
+        follows the reference's *salpos* accumulation (cumsum of 1+gap,
+        so a negative gap pulls the deleted base's successors left),
+        NOT its GASeq::toMSA gap loop (GapAssem.cpp:569-588), which
+        advances ``max(ofs,0)+1`` and never pulls back.  The two agree
+        everywhere the reference can actually reach (buildMSA runs once,
+        before any removal); after a library-level remove_base the
+        reference has no defined behavior, and host, device, and the
+        native C++ engine all implement THIS semantics and are verified
+        mutually exact."""
         if len(s.seq) == 0 or len(s.seq) != s.seqlen:
             raise PwasmError(
                 f"GapSeq toMSA Error: invalid sequence data '{s.name}' "
@@ -386,7 +397,8 @@ class Msa:
         cumsum layout collapses dead bases onto neighboring columns, so
         one member can contribute MORE than one symbol to a column — the
         host scatter-add counts them all (matching the engine's walk
-        semantics, see _seq_to_columns).  A one-symbol-per-cell matrix
+        semantics; this post-deletion placement is a repo-defined
+        extension, see _column_geometry).  A one-symbol-per-cell matrix
         can't hold that in the member's own row, so the extra occupants
         spill onto appended rows: counts are a sum over rows, so the
         device reduction stays exact with any row assignment.  Pre-refine

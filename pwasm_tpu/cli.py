@@ -373,9 +373,9 @@ def _native_msa_outputs(nmsa, cfg, fmsa, cons_outs, stderr,
                 from pwasm_tpu.align.msa import device_counts_votes
                 chars, counts = device_counts_votes(mat, mesh=mesh)
             except Exception as e:  # backend down mid-run: host replay
-                detail = f"{type(e).__name__}: {str(e)[:300]}"
+                from pwasm_tpu.utils import exc_detail
                 print("pwasm: device consensus fell back to host "
-                      f"({detail})", file=stderr)
+                      f"({exc_detail(e)})", file=stderr)
                 if stats is not None:
                     stats.engine_fallbacks += 1
                 from pwasm_tpu.native import consensus_vote_counts
@@ -456,7 +456,7 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         import os as _os
 
         from pwasm_tpu.native import native_msa
-        nmsa = native_msa()
+        nmsa = native_msa(stream=stderr)
         if nmsa is None \
                 and _os.environ.get("PWASM_NATIVE_MSA", "1") != "0" \
                 and _os.environ.get("PWASM_NATIVE", "1") != "0":

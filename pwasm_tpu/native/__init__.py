@@ -509,15 +509,19 @@ class NativeMsa:
     """ctypes handle to the native progressive-MSA engine.  Mirrors the
     cli.py msa_add protocol: ``add`` one alignment at a time, ``reset``
     on query change, then ``write``/``refine`` at end of input.  Engine
-    warnings are captured per call and replayed through sys.stderr —
-    the same stream the Python engine's warnings use."""
+    warnings are captured per call and replayed through ``stream`` —
+    set it to the same stream the Python engine's warnings use (the
+    CLI passes its stderr) so both engines warn identically."""
 
-    def __init__(self, lib):
+    def __init__(self, lib, stream=None):
         import tempfile
 
         self._lib = lib
         self._h = lib.pw_msa_new()
         self._err = ctypes.create_string_buffer(8192)
+        # None = resolve sys.stderr at replay time (late binding, so a
+        # redirect_stderr active when the warning fires is honored)
+        self.stream = stream
         fd, self._warn_path = tempfile.mkstemp(prefix="pwasm_msa_warn_")
         os.close(fd)
 
@@ -554,7 +558,8 @@ class NativeMsa:
         except OSError:
             return
         if text:
-            sys.stderr.write(text)
+            (self.stream if self.stream is not None
+             else sys.stderr).write(text)
 
     def _raise(self, rc: int) -> None:
         from pwasm_tpu.core.errors import PwasmError, ZeroCoverageError
@@ -657,12 +662,14 @@ class NativeMsa:
             self._raise(rc)
 
 
-def native_msa() -> NativeMsa | None:
+def native_msa(stream=None) -> NativeMsa | None:
     """A fresh native MSA engine handle, or None when the native library
-    is unavailable or delegation is disabled (PWASM_NATIVE_MSA=0)."""
+    is unavailable or delegation is disabled (PWASM_NATIVE_MSA=0).
+    ``stream`` receives replayed engine warnings (the CLI passes its
+    stderr so both engines warn on the same stream)."""
     if os.environ.get("PWASM_NATIVE_MSA", "1") == "0":
         return None
     lib = get_lib()
     if lib is None:
         return None
-    return NativeMsa(lib)
+    return NativeMsa(lib, stream=stream)

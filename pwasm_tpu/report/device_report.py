@@ -172,8 +172,9 @@ def submit_diff_info_batch(batch, f, skip_codan: bool = False,
             stats.fallback_batches += 1
         if not _warned_fallback:
             _warned_fallback = True
+            from pwasm_tpu.utils import exc_detail
             print(f"Warning: device batch analysis failed "
-                  f"({type(e).__name__}: {e}); falling back to the scalar "
+                  f"({exc_detail(e)}); falling back to the scalar "
                   f"path for this run", file=sys.stderr)
         for aln, rlabel, tlabel, refseq in batch:
             print_diff_info(aln, rlabel, tlabel, f, refseq,

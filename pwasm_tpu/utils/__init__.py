@@ -11,3 +11,11 @@ hooks, a resumable report cursor, and batch-level bad-line skipping
 
 from pwasm_tpu.utils.runstats import RunStats  # noqa: F401
 from pwasm_tpu.utils.profiling import device_trace  # noqa: F401
+
+
+def exc_detail(e: BaseException, limit: int = 200) -> str:
+    """One-line ``TypeName: message`` for device-demotion stderr
+    messages — newlines flattened and truncated so a shape/dtype
+    programming bug reads differently from a backend outage without
+    breaking the one-warning-per-line convention."""
+    return f"{type(e).__name__}: " + str(e).replace("\n", " ")[:limit]

@@ -40,21 +40,41 @@ def probe_backend(env: dict, timeout: float) -> tuple[str | None, str]:
     return None, r.stderr[-500:]
 
 
-def _success_marker() -> str:
+def _marker_uid() -> int:
+    """The uid the marker directory is keyed on AND verified against —
+    one definition so the path key and the trust check cannot drift."""
+    return os.getuid() if hasattr(os, "getuid") else 0
+
+
+def _success_marker() -> str | None:
     """Path of the cross-process probe-success marker, keyed on the
     env bits that select the backend (a CPU-pinned shell and a
-    tunnel-pointed shell must not share a verdict) AND the uid (a
-    shared temp dir must not let another user poison the verdict)."""
+    tunnel-pointed shell must not share a verdict).  The marker lives
+    in a per-uid 0700 subdirectory of the temp dir: in a sticky-bit
+    /tmp another local user can pre-create (and the victim cannot
+    unlink) files at any predictable shared name, so per-file trust
+    checks alone can be griefed into permanently disabling the cache —
+    owning the whole directory removes the foreign-file case.  Returns
+    None when the directory cannot be created/trusted (cache disabled,
+    probes still work)."""
     import hashlib
+    import stat as _stat
     import tempfile
 
+    d = os.path.join(tempfile.gettempdir(),
+                     f"pwasm_probe_{_marker_uid()}")
+    try:
+        os.makedirs(d, mode=0o700, exist_ok=True)
+        st = os.lstat(d)
+        if not _stat.S_ISDIR(st.st_mode) or st.st_uid != _marker_uid():
+            return None     # squatted by another user: no cache
+    except OSError:
+        return None
     key = "|".join(os.environ.get(k, "") for k in
                    ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS",
                     "JAX_PLATFORM_NAME"))
     h = hashlib.sha256(key.encode()).hexdigest()[:16]
-    uid = os.getuid() if hasattr(os, "getuid") else 0
-    return os.path.join(tempfile.gettempdir(),
-                        f"pwasm_probe_ok_{uid}_{h}")
+    return os.path.join(d, f"ok_{h}")
 
 
 def _backend_already_initialized() -> bool:
@@ -100,12 +120,28 @@ def device_backend_reachable() -> tuple[bool, str]:
     now = time.time()
     if _probe_cache is None or (ttl > 0 and now - _probe_cache[0] > ttl):
         marker = _success_marker()
-        try:
-            if ttl > 0 and now - os.path.getmtime(marker) < ttl:
-                _probe_cache = (now, "cached", "")
-                return True, ""
-        except OSError:
-            pass
+        if marker is not None:
+            try:
+                # the 0700 per-uid directory already excludes other
+                # users; the lstat + regular-file + uid check is belt
+                # and braces — anything unexpected is removed and falls
+                # through to a real probe rather than skipping the
+                # health check.
+                st = os.lstat(marker)
+                import stat as _stat
+
+                if (_stat.S_ISREG(st.st_mode)
+                        and st.st_uid == _marker_uid()):
+                    if ttl > 0 and now - st.st_mtime < ttl:
+                        _probe_cache = (now, "cached", "")
+                        return True, ""
+                else:
+                    try:
+                        os.unlink(marker)
+                    except OSError:
+                        pass
+            except OSError:
+                pass
         try:
             timeout = float(os.environ.get(
                 "PWASM_DEVICE_PROBE_TIMEOUT", "150"))
@@ -113,9 +149,9 @@ def device_backend_reachable() -> tuple[bool, str]:
             timeout = 150.0
         platform, why = probe_backend(dict(os.environ), timeout)
         _probe_cache = (now, platform, why)
-        if platform is not None:
+        if platform is not None and marker is not None:
             try:  # refresh the cross-process marker (never through a
-                # symlink another user could plant in the shared dir)
+                # symlink, even inside the owned dir)
                 fd = os.open(marker,
                              os.O_WRONLY | os.O_CREAT | os.O_TRUNC
                              | getattr(os, "O_NOFOLLOW", 0), 0o600)

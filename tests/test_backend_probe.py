@@ -89,3 +89,64 @@ def test_initialized_backend_skips(monkeypatch, _fresh):
         B, "probe_backend",
         lambda *a: (_ for _ in ()).throw(AssertionError("probed")))
     assert B.device_backend_reachable() == (True, "")
+
+
+def test_untrusted_marker_falls_through_to_probe(monkeypatch, _fresh,
+                                                 tmp_path):
+    """A symlink or a foreign-uid file at the marker path must NOT be
+    trusted (shared temp dir: another local user can pre-create the
+    predictable name) — the gate re-probes instead (ADVICE r4)."""
+    calls = []
+
+    def probe(env, timeout):
+        calls.append(1)
+        return "tpu", ""
+
+    monkeypatch.setattr(B, "probe_backend", probe)
+    # marker is a symlink to a fresh file some other process controls
+    target = tmp_path / "planted"
+    target.write_text("")
+    os.symlink(target, _fresh)
+    monkeypatch.setattr(B, "_probe_cache", None)
+    assert B.device_backend_reachable() == (True, "")
+    assert len(calls) == 1          # symlink ignored, real probe ran
+
+    # a foreign-uid regular file is equally untrusted
+    os.unlink(_fresh)
+    _fresh.write_text("")
+    real_lstat = os.lstat
+
+    class _St:
+        def __init__(self, st):
+            self.st_mode = st.st_mode
+            self.st_uid = st.st_uid + 1
+            self.st_mtime = st.st_mtime
+
+    monkeypatch.setattr(
+        B.os, "lstat",
+        lambda p: _St(real_lstat(p)) if str(p) == str(_fresh)
+        else real_lstat(p))
+    monkeypatch.setattr(B, "_probe_cache", None)
+    assert B.device_backend_reachable() == (True, "")
+    assert len(calls) == 2          # foreign file ignored, re-probed
+
+
+def test_untrusted_marker_is_removed_so_cache_recovers(monkeypatch,
+                                                       _fresh, tmp_path):
+    """Distrusting a planted marker must also remove it: otherwise the
+    cross-process cache is permanently disabled at that path (every
+    run re-probes; a dead tunnel costs the full timeout every time)."""
+    calls = []
+    monkeypatch.setattr(B, "probe_backend",
+                        lambda *a: (calls.append(1), ("tpu", ""))[1])
+    target = tmp_path / "planted2"
+    target.write_text("")
+    os.symlink(target, _fresh)
+    assert B.device_backend_reachable() == (True, "")
+    assert len(calls) == 1
+    # the symlink is gone and a real marker took its place: a fresh
+    # process now trusts it without re-probing
+    assert os.path.exists(_fresh) and not os.path.islink(_fresh)
+    monkeypatch.setattr(B, "_probe_cache", None)
+    assert B.device_backend_reachable() == (True, "")
+    assert len(calls) == 1

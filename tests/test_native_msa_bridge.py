@@ -321,3 +321,37 @@ def test_delegation_used(tmp_path, monkeypatch):
               "-w", str(tmp_path / "r.mfa")], stderr=err)
     assert rc == 0
     assert (tmp_path / "r.mfa").read_bytes()
+
+
+def test_engine_warnings_go_to_callers_stderr_stream():
+    """Replayed engine warnings must reach the stream the caller passed
+    (the CLI threads its stderr in), not the process sys.stderr — a
+    caller capturing stderr (as every CLI test does) must see native
+    warnings exactly like Python-engine warnings (ADVICE r4)."""
+    import contextlib
+
+    stream = io.StringIO()
+    nmsa = native_msa(stream=stream)
+    try:
+        with open(nmsa._warn_path, "w") as f:
+            f.write("Warning: synthetic engine warning\n")
+        proc_err = io.StringIO()
+        with contextlib.redirect_stderr(proc_err):
+            nmsa._replay_warnings()
+        assert stream.getvalue() == "Warning: synthetic engine warning\n"
+        assert proc_err.getvalue() == ""
+        # default (no stream): sys.stderr resolved at REPLAY time, so a
+        # redirect active when the warning fires is honored
+        nmsa2 = native_msa()
+        try:
+            assert nmsa2.stream is None
+            with open(nmsa2._warn_path, "w") as f:
+                f.write("late\n")
+            late = io.StringIO()
+            with contextlib.redirect_stderr(late):
+                nmsa2._replay_warnings()
+            assert late.getvalue() == "late\n"
+        finally:
+            nmsa2.close()
+    finally:
+        nmsa.close()
