@@ -11,6 +11,7 @@ bounded by a timeout, so a dead tunnel costs seconds, not a hang.
 from __future__ import annotations
 
 import os
+import stat as _stat
 import subprocess
 import sys
 
@@ -58,7 +59,6 @@ def _success_marker() -> str | None:
     None when the directory cannot be created/trusted (cache disabled,
     probes still work)."""
     import hashlib
-    import stat as _stat
     import tempfile
 
     d = os.path.join(tempfile.gettempdir(),
@@ -128,16 +128,18 @@ def device_backend_reachable() -> tuple[bool, str]:
                 # through to a real probe rather than skipping the
                 # health check.
                 st = os.lstat(marker)
-                import stat as _stat
-
                 if (_stat.S_ISREG(st.st_mode)
                         and st.st_uid == _marker_uid()):
                     if ttl > 0 and now - st.st_mtime < ttl:
                         _probe_cache = (now, "cached", "")
                         return True, ""
                 else:
-                    try:
-                        os.unlink(marker)
+                    try:  # a squatting directory needs rmdir, not
+                        # unlink, or the cache never recovers here
+                        if _stat.S_ISDIR(st.st_mode):
+                            os.rmdir(marker)
+                        else:
+                            os.unlink(marker)
                     except OSError:
                         pass
             except OSError:
