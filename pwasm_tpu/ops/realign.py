@@ -1027,8 +1027,9 @@ def full_gotoh_traceback(q: np.ndarray, t: np.ndarray,
 # ---------------------------------------------------------------------------
 # host batch driver: encode, pad, dispatch, convert, oracle fallback
 # ---------------------------------------------------------------------------
-def _bucket(x: int, step: int = 128) -> int:
-    return max(step, (x + step - 1) // step * step)
+# the shared variable-length batching policy lives in
+# parallel/bucketing.py; the re-aligner's 2-D shape grouping uses its
+# group_by_shape (see realign_pairs)
 
 
 def _pick_dlo(d_ends: np.ndarray, band: int) -> int:
@@ -1080,10 +1081,9 @@ def realign_pairs(pairs: list[tuple[bytes, bytes]], band: int = 64,
         return []
     enc = [(encode(qb.upper()), encode(tb.upper())) for qb, tb in pairs]
     out: list = [None] * len(pairs)
-    groups: dict[tuple[int, int], list[int]] = {}
-    for k, (qc, tc) in enumerate(enc):
-        groups.setdefault((_bucket(len(qc)), _bucket(len(tc))),
-                          []).append(k)
+    from pwasm_tpu.parallel.bucketing import group_by_shape
+    groups = group_by_shape(
+        ((len(qc), len(tc)) for qc, tc in enc))
     for (mb, nb), idxs in sorted(groups.items()):
         _realign_group(enc, idxs, mb, nb, band, params, out, mesh)
     return out

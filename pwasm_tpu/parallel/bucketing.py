@@ -1,0 +1,188 @@
+"""Host-side length bucketing for ragged device batches (SURVEY §7.3).
+
+Every device entry point in the framework wants rectangular tensors:
+targets padded to a shared width with true lengths alongside
+(``banded_scores_batch``), queries sharing one exact length (scores are
+read at cell (m, t_len), so the query axis cannot be padded), and batch
+counts divisible by mesh axis sizes (``shard_map``).  The reference has
+no counterpart — it is a single-threaded per-alignment loop
+(pafreport.cpp:296-460) — so this module is where the repo's
+variable-length batching policy lives, shared by the CLI device path
+(``ops/realign.py``), ``parallel/many2many.py``, and
+``parallel/wavefront_sp.py`` instead of re-implemented per caller.
+
+The policy: group by step-rounded shape so one outlier pads only its
+own group ~step-fold, not the whole batch; keep the original index of
+every row so results scatter back to input order; round batch counts
+up with explicitly-marked filler rows (``idx == -1``) whose results
+are dropped on reassembly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Sequence
+
+import numpy as np
+
+PAD = 127      # target-code sentinel the DP kernels treat as never-match
+
+
+def round_up(x: int, step: int = 128) -> int:
+    """``x`` rounded up to a positive multiple of ``step``."""
+    return max(step, (x + step - 1) // step * step)
+
+
+def encode_seqs(seqs) -> list[np.ndarray]:
+    """Normalize a ragged sequence list to int8 code arrays: bytes/str
+    encode upper-case via ``core.dna.encode``; arrays pass through."""
+    from pwasm_tpu.core.dna import encode
+
+    out = []
+    for s in seqs:
+        if isinstance(s, (bytes, bytearray)):
+            out.append(encode(bytes(s).upper()))
+        elif isinstance(s, str):
+            out.append(encode(s.upper().encode()))
+        else:
+            out.append(np.asarray(s, dtype=np.int8))
+    return out
+
+
+@dataclass(frozen=True)
+class Bucket:
+    """One rectangular slice of a ragged batch.
+
+    ``data``  (B, width) int8, padded with ``PAD``;
+    ``lens``  (B,) int32 true lengths (0 for filler rows);
+    ``idx``   (B,) int64 position of each row in the caller's input
+              order, or -1 for filler rows added to satisfy
+              ``batch_multiple``.
+    """
+
+    data: np.ndarray
+    lens: np.ndarray
+    idx: np.ndarray
+
+    @property
+    def width(self) -> int:
+        return int(self.data.shape[1])
+
+    @property
+    def n_real(self) -> int:
+        return int((self.idx >= 0).sum())
+
+
+def _build_bucket(enc: list[np.ndarray], idxs: list[int], width: int,
+                  batch_multiple: int, pad: int) -> Bucket:
+    B = len(idxs)
+    if batch_multiple > 1:
+        B = (B + batch_multiple - 1) // batch_multiple * batch_multiple
+    data = np.full((B, width), pad, dtype=np.int8)
+    lens = np.zeros(B, dtype=np.int32)
+    idx = np.full(B, -1, dtype=np.int64)
+    for k, ki in enumerate(idxs):
+        s = enc[ki]
+        data[k, :len(s)] = s
+        lens[k] = len(s)
+        idx[k] = ki
+    return Bucket(data, lens, idx)
+
+
+def bucket_targets(seqs, *, step: int = 128, batch_multiple: int = 1,
+                   pad: int = PAD) -> list[Bucket]:
+    """Group target sequences by step-rounded length into padded
+    (B, width) tensors with true lengths — ready for
+    ``banded_scores_batch`` / ``many2many_scores`` / ``wavefront_sp``.
+
+    ``seqs``: bytes/str (encoded upper-case via ``core.dna.encode``) or
+    int8 code arrays.  ``batch_multiple`` rounds each bucket's row
+    count up with filler rows (``idx == -1``) so the batch axis divides
+    a mesh factor.  Buckets are returned widest first (compile the big
+    program while the small ones queue)."""
+    enc = encode_seqs(seqs)
+    groups: dict[int, list[int]] = {}
+    for k, s in enumerate(enc):
+        groups.setdefault(round_up(len(s), step), []).append(k)
+    return [_build_bucket(enc, idxs, w, batch_multiple, pad)
+            for w, idxs in sorted(groups.items(), reverse=True)]
+
+
+def bucket_queries(seqs, *, batch_multiple: int = 1,
+                   pad: int = PAD) -> list[Bucket]:
+    """Group query sequences by EXACT length (the banded DP reads its
+    global score at cell (m, t_len): padding the query axis would move
+    the read row, so queries can only batch with equal-length peers).
+    Filler rows repeat ``pad`` and are dropped by ``scatter_results``.
+    """
+    enc = encode_seqs(seqs)
+    groups: dict[int, list[int]] = {}
+    for k, s in enumerate(enc):
+        groups.setdefault(len(s), []).append(k)
+    return [_build_bucket(enc, idxs, w, batch_multiple, pad)
+            for w, idxs in sorted(groups.items(), reverse=True)]
+
+
+def pad_to_width(seqs, width: int, *, batch_multiple: int = 1,
+                 pad: int = PAD, truncate: bool = False) -> Bucket:
+    """One rectangular Bucket at a caller-chosen ``width``.
+
+    The banded DP couples the useful target width to the QUERY length
+    (``band_dlo(m, n, band)``), so callers like the ragged many2many
+    pick ``width`` per query bucket rather than bucketing targets by
+    their own lengths.  ``lens`` always records TRUE lengths;
+    ``truncate=True`` clips longer sequences' data (only sound when
+    every cell needing the clipped content is out of band — the caller
+    must pick ``width`` accordingly); ``truncate=False`` raises on
+    overflow instead."""
+    enc = encode_seqs(seqs)
+    over = [k for k, s in enumerate(enc) if len(s) > width]
+    if over and not truncate:
+        raise ValueError(
+            f"{len(over)} sequence(s) longer than width {width} "
+            f"(first: index {over[0]}, length {len(enc[over[0]])})")
+    b = _build_bucket([s[:width] for s in enc], list(range(len(enc))),
+                      width, batch_multiple, pad)
+    lens = b.lens.copy()
+    for k in over:
+        lens[k] = len(enc[k])       # true length, clipped data
+    return Bucket(b.data, lens, b.idx)
+
+
+def group_by_shape(shapes: Iterable[Sequence[int]],
+                   step: int = 128) -> dict[tuple, list[int]]:
+    """Indices grouped by their step-rounded shape tuple — the n-D
+    generalization used by the re-aligner's (query, target) buckets."""
+    groups: dict[tuple, list[int]] = {}
+    for k, shp in enumerate(shapes):
+        key = tuple(round_up(int(x), step) for x in shp)
+        groups.setdefault(key, []).append(k)
+    return groups
+
+
+def scatter_results(buckets: Sequence[Bucket],
+                    per_bucket: Sequence[np.ndarray], n: int,
+                    fill=0) -> np.ndarray:
+    """Reassemble per-bucket row results into input order.
+
+    ``per_bucket[i]`` must have leading dimension equal to
+    ``buckets[i].data.shape[0]``; filler rows (``idx == -1``) are
+    dropped.  Returns an array of leading dimension ``n`` (rows never
+    written stay ``fill`` — there are none when the buckets came from
+    one ``bucket_*`` call over ``n`` sequences)."""
+    if len(buckets) != len(per_bucket):
+        raise ValueError("buckets and per_bucket differ in length")
+    out = None
+    for b, r in zip(buckets, per_bucket):
+        r = np.asarray(r)
+        if r.shape[0] != b.data.shape[0]:
+            raise ValueError(
+                f"result rows {r.shape[0]} != bucket rows "
+                f"{b.data.shape[0]}")
+        if out is None:
+            out = np.full((n,) + r.shape[1:], fill, dtype=r.dtype)
+        live = b.idx >= 0
+        out[b.idx[live]] = r[live]
+    if out is None:
+        out = np.full((n,), fill)
+    return out

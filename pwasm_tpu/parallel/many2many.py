@@ -109,3 +109,74 @@ def many2many_scores_pallas(qs: jax.Array, ts: jax.Array,
     return jax.lax.map(
         lambda q: banded_scores_pallas(q, ts, t_lens, band=band,
                                        params=params), qs)
+
+
+def many2many_scores_ragged(qs, ts, band: int = 64,
+                            params: ScoreParams = ScoreParams(),
+                            mesh: Mesh | None = None,
+                            kernel: str = "xla") -> np.ndarray:
+    """(Q, T) scores for RAGGED query/target sequence lists.
+
+    The shape preconditions of the rectangular entry points (queries
+    sharing one exact length, targets sharing one padded width, batch
+    axes dividing the mesh factors) are satisfied here via
+    ``parallel.bucketing``: queries bucket by exact length; for each
+    query bucket the targets dispatch in TWO width groups, because the
+    band placement ``band_dlo(m, n, band)`` couples the covered
+    diagonal window to the padded width:
+
+    - targets with ``t_len <= m`` at width ``m`` (dlo = -band//2, the
+      most negative placement the API admits — covers end diagonals
+      down to -band//2, no truncation possible);
+    - longer targets at width ``m + band - 2`` (dlo = -1, in-band
+      diagonals up to band-2); targets longer than that width are
+      clipped, which cannot change any score — their end diagonal is
+      provably out of band (NEG either way).
+
+    Results scatter back to input order.  With ``mesh`` each call is
+    the 2-D-sharded scorer (bucket row counts rounded up to the mesh
+    factors with filler rows).
+
+    ``qs``/``ts``: bytes/str or int8 code arrays.  Cells whose end
+    diagonal falls outside [-band//2, band-2] are NEG — the union of
+    what the two placements can cover.
+    """
+    import jax.numpy as jnp
+
+    from pwasm_tpu.ops.banded_dp import NEG
+    from pwasm_tpu.parallel.bucketing import (encode_seqs,
+                                              bucket_queries,
+                                              pad_to_width)
+
+    qs = list(qs)
+    ts_enc = encode_seqs(ts)
+    qmult = int(mesh.shape["query"]) if mesh is not None else 1
+    tmult = int(mesh.shape["target"]) if mesh is not None else 1
+    fn = make_many2many(mesh, band=band, params=params,
+                        kernel=kernel) if mesh is not None else None
+    out = np.full((len(qs), len(ts_enc)), NEG, dtype=np.int32)
+    for qb in bucket_queries(qs, batch_multiple=qmult):
+        m = qb.width
+        short = [k for k, t in enumerate(ts_enc) if len(t) <= m]
+        long_ = [k for k, t in enumerate(ts_enc) if len(t) > m]
+        for keep, n_eff, clip in ((short, m, False),
+                                  (long_, m + band - 2, True)):
+            if not keep:
+                continue
+            tb = pad_to_width([ts_enc[k] for k in keep], n_eff,
+                              batch_multiple=tmult, truncate=clip)
+            if fn is not None:
+                s = np.asarray(fn(jnp.asarray(qb.data),
+                                  jnp.asarray(tb.data),
+                                  jnp.asarray(tb.lens)))
+            else:
+                flat = many2many_scores_pallas if kernel == "pallas" \
+                    else many2many_scores
+                s = np.asarray(flat(
+                    jnp.asarray(qb.data), jnp.asarray(tb.data),
+                    jnp.asarray(tb.lens), band=band, params=params))
+            ql = qb.idx >= 0
+            tl = tb.idx >= 0
+            cols = np.asarray(keep)[tb.idx[tl]]
+            out[np.ix_(qb.idx[ql], cols)] = s[ql][:, tl]
+    return out

@@ -39,19 +39,28 @@ from pwasm_tpu.ops.banded_dp import (NEG, ScoreParams, band_dlo,
 def make_wavefront_sp(mesh: Mesh, m: int, n: int, T: int,
                       band: int = 64,
                       params: ScoreParams = ScoreParams(),
-                      axis: str = "seq"):
+                      axis: str = "seq", m_true: int | None = None):
     """Build the jitted sequence-parallel scorer for fixed shapes.
 
     Returns ``fn(q (m,) int, ts (T, n) int, t_lens (T,) int) -> (T,)
-    int32 scores``.  ``m`` must divide by the ``axis`` size of the mesh
-    (pad the query and widen the band upstream if it doesn't).
-    """
+    int32 scores``.  ``m`` must divide by the ``axis`` size of the mesh;
+    for a query that doesn't, pad it to the next multiple and pass its
+    real length as ``m_true`` — rows past ``m_true`` are carried
+    through unchanged (the pad content never touches the wavefront), so
+    scores stay bit-exact with the single-chip scan over the unpadded
+    query.  ``wavefront_sp_scores`` does this padding automatically
+    (the ``bucket_targets`` companion in ``parallel/bucketing.py``
+    handles the target side)."""
     D = mesh.shape[axis]
     if m % D != 0:
         raise ValueError(f"query length {m} must divide by mesh "
                          f"axis '{axis}' size {D}")
+    if m_true is None:
+        m_true = m
+    if not 0 < m_true <= m:
+        raise ValueError(f"m_true {m_true} outside (0, {m}]")
     chunk = m // D
-    dlo = band_dlo(m, n, band)
+    dlo = band_dlo(m_true, n, band)
     step = make_row_step(n, dlo, band, params)
     perm = [(i, i + 1) for i in range(D - 1)]
 
@@ -65,6 +74,10 @@ def make_wavefront_sp(mesh: Mesh, m: int, n: int, T: int,
             qi, k = args
             i = row0 + k + 1          # 1-based absolute query row
             out = step(prev_m, prev_ix, prev_iy, i, qi, t)
+            if m_true < m:            # pad rows: carry passthrough
+                out = jax.tree.map(
+                    lambda new, old: jnp.where(i <= m_true, new, old),
+                    out, carry)
             return out, None
 
         ks = jnp.arange(chunk, dtype=jnp.int32)
@@ -87,7 +100,7 @@ def make_wavefront_sp(mesh: Mesh, m: int, n: int, T: int,
             wf = jax.tree.map(
                 lambda a, b_: jnp.where(d == 0, a, b_), wf_init, wf_in)
             wf_out = run_chunk(q_loc, t, wf, row0)
-            score = final_score(*wf_out, t_lens[bc], m, dlo, band)
+            score = final_score(*wf_out, t_lens[bc], m_true, dlo, band)
             emit = active & (d == D - 1)   # last chunk completes row m
             # hand the wavefront edge to the right neighbor (ICI halo)
             wf_next = jax.tree.map(
@@ -121,7 +134,16 @@ def wavefront_sp_scores(q: jax.Array, ts: jax.Array, t_lens: jax.Array,
                         params: ScoreParams = ScoreParams(),
                         axis: str = "seq") -> jax.Array:
     """Convenience wrapper: sequence-parallel scores for one (q, ts)
-    workload (shapes specialize the compilation)."""
+    workload (shapes specialize the compilation).  A query length that
+    does not divide the mesh axis is padded up automatically; the pad
+    rows are masked out of the wavefront, so scores are identical to
+    the divisible case."""
     T, n = ts.shape
-    fn = make_wavefront_sp(mesh, q.shape[0], n, T, band, params, axis)
+    m = q.shape[0]
+    D = mesh.shape[axis]
+    m_pad = (m + D - 1) // D * D
+    if m_pad != m:
+        q = jnp.pad(q, (0, m_pad - m), constant_values=127)
+    fn = make_wavefront_sp(mesh, m_pad, n, T, band, params, axis,
+                           m_true=m)
     return fn(q, ts, t_lens)

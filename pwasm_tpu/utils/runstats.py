@@ -28,6 +28,12 @@ class RunStats:
         self.events = 0           # diff events reported
         self.device_batches = 0   # device flushes (--device=tpu)
         self.fallback_batches = 0  # device batches replayed on host
+        self.device_events = 0    # events analyzed by the device program
+        self.scalar_events = 0    # events analyzed on host: out of
+        #                           device scope (evtlen > MAX_EV) OR
+        #                           part of a fallback-replayed batch
+        #                           (then fallback_batches > 0 tells
+        #                           the two causes apart)
         self.realigned = 0        # alignments re-aligned (--realign)
         self.msa_dropped = 0      # reported alignments excluded from
         #                           the MSA (bad gap structure)
@@ -55,6 +61,8 @@ class RunStats:
             "events": self.events,
             "device_batches": self.device_batches,
             "fallback_batches": self.fallback_batches,
+            "device_events": self.device_events,
+            "scalar_events": self.scalar_events,
             "realigned": self.realigned,
             "msa_dropped": self.msa_dropped,
             "engine_fallbacks": self.engine_fallbacks,
