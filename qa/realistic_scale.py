@@ -1,0 +1,76 @@
+"""Realistic-scale CLI wall-clock capture (VERDICT r4 item 5).
+
+Runs the Nanopore-like corpus from tests/test_realistic_scale.py
+through the full CLI (report + summary + MSA + consensus) on
+--device=cpu and --device=tpu, printing wall times and the RunStats
+routing counters as one JSON line each — the numbers BASELINE.md's
+"realistic scale" section records.  Usage:
+
+    python qa/realistic_scale.py [n_aln]
+"""
+
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tests"))
+
+
+def main() -> int:
+    n_aln = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+    from test_realistic_scale import make_corpus
+
+    from pwasm_tpu.cli import run
+
+    t0 = time.perf_counter()
+    qseq, lines = make_corpus(n_aln=n_aln)
+    gen_s = time.perf_counter() - t0
+    with tempfile.TemporaryDirectory() as d:
+        fa = os.path.join(d, "cds.fa")
+        with open(fa, "w") as f:
+            f.write(f">cds1\n{qseq}\n")
+        paf = os.path.join(d, "in.paf")
+        with open(paf, "w") as f:
+            f.write("".join(l + "\n" for l in lines))
+        paf_mb = os.path.getsize(paf) / 1e6
+        for dev in ("cpu", "tpu"):
+            outs = {k: os.path.join(d, f"{dev}.{k}")
+                    for k in ("dfa", "sum", "mfa", "cons", "stats")}
+            err = io.StringIO()
+            t0 = time.perf_counter()
+            rc = run([paf, "-r", fa, "-o", outs["dfa"],
+                      "-s", outs["sum"], "-w", outs["mfa"],
+                      f"--cons={outs['cons']}", f"--device={dev}",
+                      f"--stats={outs['stats']}"], stderr=err)
+            wall = time.perf_counter() - t0
+            st = json.loads(open(outs["stats"]).read()) if rc == 0 \
+                else {}
+            print(json.dumps({
+                "corpus": {"n_aln": n_aln, "paf_mb": round(paf_mb, 2),
+                           "gen_s": round(gen_s, 2)},
+                "device": dev, "rc": rc,
+                "wall_s": round(wall, 3),
+                "aligned_bases": st.get("aligned_bases"),
+                "events": st.get("events"),
+                "device_events": st.get("device_events"),
+                "scalar_events": st.get("scalar_events"),
+                "fallback_batches": st.get("fallback_batches"),
+                "engine_fallbacks": st.get("engine_fallbacks"),
+                "bases_per_s": round(
+                    st.get("aligned_bases", 0) / wall) if rc == 0
+                else None,
+            }))
+            if rc != 0:
+                sys.stderr.write(err.getvalue()[-1000:])
+                return rc
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
