@@ -103,21 +103,25 @@ def test_realistic_scale_cpu_tpu_parity(tmp_path):
     paf = tmp_path / "in.paf"
     paf.write_text("".join(l + "\n" for l in lines))
     outs = {}
-    for dev in ("cpu", "tpu"):
-        rep = tmp_path / f"{dev}.dfa"
-        summ = tmp_path / f"{dev}.sum"
-        mfa = tmp_path / f"{dev}.mfa"
-        cons = tmp_path / f"{dev}.cons"
-        stats = tmp_path / f"{dev}.stats"
+    modes = {"cpu": ["--device=cpu"], "tpu": ["--device=tpu"],
+             "shard": ["--device=tpu", "--shard"]}
+    for tag, extra in modes.items():
+        rep = tmp_path / f"{tag}.dfa"
+        summ = tmp_path / f"{tag}.sum"
+        mfa = tmp_path / f"{tag}.mfa"
+        cons = tmp_path / f"{tag}.cons"
+        stats = tmp_path / f"{tag}.stats"
         err = io.StringIO()
         rc = run([str(paf), "-r", str(fa), "-o", str(rep),
                   "-s", str(summ), "-w", str(mfa),
-                  f"--cons={cons}", f"--device={dev}",
-                  f"--stats={stats}"], stderr=err)
+                  f"--cons={cons}", f"--stats={stats}"] + extra,
+                 stderr=err)
         assert rc == 0, err.getvalue()[:2000]
-        outs[dev] = (rep.read_bytes(), summ.read_bytes(),
+        outs[tag] = (rep.read_bytes(), summ.read_bytes(),
                      mfa.read_bytes(), cons.read_bytes())
     assert outs["cpu"] == outs["tpu"]
+    # the full 8-virtual-device mesh run is byte-identical too
+    assert outs["cpu"] == outs["shard"]
 
     st = json.loads((tmp_path / "tpu.stats").read_text())
     assert st["alignments"] == 200
