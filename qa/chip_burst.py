@@ -107,6 +107,12 @@ def main() -> int:
           "PWASM_BENCH_PROFILE": os.path.join(OUT, "cfg4_trace")},
          ["bench.py"], 1800, log)
 
+    # 6. realistic-scale CLI on chip (BASELINE.md's device wall is
+    # currently cpu-jax class; this replaces it with an on-chip
+    # number — the script's --device=tpu run reaches the chip through
+    # the same health gate as any user CLI run)
+    _run("realistic_scale", {}, ["qa/realistic_scale.py"], 1800, log)
+
     print(f"[burst] complete: {len(log)} steps, results in {OUT}",
           file=sys.stderr)
     return 0
