@@ -221,3 +221,18 @@ def test_many2many_ragged_matches_independent_full_dp():
             assert got[i, j] == want, (i, j, len(q), len(t))
             checked += 1
     assert checked == len(qs) * len(ts)
+
+
+def test_pad_to_width_truncation_contract():
+    from pwasm_tpu.parallel.bucketing import pad_to_width
+
+    seqs = [b"ACGT", b"ACGTACGTACGT"]          # 4 and 12 bases
+    with pytest.raises(ValueError):
+        pad_to_width(seqs, 8)                   # overflow w/o truncate
+    b = pad_to_width(seqs, 8, truncate=True, batch_multiple=4)
+    assert b.data.shape == (4, 8)
+    assert list(b.lens[:2]) == [4, 12]          # TRUE length kept
+    assert (b.data[1] == encode(b"ACGTACGT")).all()  # data clipped
+    assert (b.data[0][:4] == encode(b"ACGT")).all()
+    assert (b.data[0][4:] == PAD).all()
+    assert list(b.idx) == [0, 1, -1, -1]        # filler marked
