@@ -461,10 +461,70 @@ def _native_cli_parity_trials(cli, rng, trials) -> int:
     return bad
 
 
+def sweep_ragged_m2m(trials: int = 12) -> bool:
+    """Ragged many2many vs the per-pair banded oracle under adversarial
+    length distributions (duplicates, 1-base seqs, huge spread, counts
+    indivisible by mesh factors), flat AND 8-virtual-device mesh."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pwasm_tpu.core.dna import encode
+    from pwasm_tpu.ops.banded_dp import banded_score
+    from pwasm_tpu.parallel.bucketing import PAD
+    from pwasm_tpu.parallel.many2many import (make_mesh2d,
+                                              many2many_scores_ragged)
+
+    rng = random.Random(20260730)
+    bad = 0
+    mesh = make_mesh2d(8)
+    for trial in range(trials):
+        band = rng.choice([16, 64])
+        nq = rng.randint(1, 6)
+        nt = rng.randint(1, 10)
+        def seq(lo, hi):
+            n = rng.randint(lo, hi)
+            return bytes(rng.choice(b"ACGT") for _ in range(n))
+        qs = [seq(1, 80) for _ in range(nq)]
+        if nq > 1 and rng.random() < 0.5:
+            qs[1] = qs[0]                      # duplicate lengths
+        ts = [seq(1, 400) for _ in range(nt)]
+        got = many2many_scores_ragged(qs, ts, band=band)
+        got_mesh = many2many_scores_ragged(qs, ts, band=band,
+                                           mesh=mesh)
+        if (got != got_mesh).any():
+            bad += 1
+            print(f"[ragged-m2m] trial {trial}: mesh != flat")
+            continue
+        for i, q in enumerate(qs):
+            qe = encode(q.upper())
+            m = len(qe)
+            for j, t in enumerate(ts):
+                te = encode(t.upper())
+                n_eff = m if len(te) <= m else m + band - 2
+                tp = np.full(n_eff, PAD, dtype=np.int8)
+                tp[:min(len(te), n_eff)] = te[:n_eff]
+                want = int(banded_score(
+                    jnp.asarray(qe), jnp.asarray(tp),
+                    jnp.asarray(len(te)), band=band))
+                if int(got[i, j]) != want:
+                    bad += 1
+                    print(f"[ragged-m2m] trial {trial} cell "
+                          f"({i},{j}): {got[i, j]} != {want}")
+                    break
+            else:
+                continue
+            break
+    tag = "PASS" if bad == 0 else "FAIL"
+    print(f"[{tag}] ragged-m2m vs per-pair oracle (flat+mesh): "
+          f"{bad} bad trials / {trials}")
+    return bad == 0
+
+
 def main() -> int:
     results = [sweep_refine_batch(), sweep_realign_oracle(),
                sweep_fai_roundtrip(), sweep_paf_corruption(),
-               sweep_cli_parity(), sweep_native_cli_parity()]
+               sweep_cli_parity(), sweep_native_cli_parity(),
+               sweep_ragged_m2m()]
     return 0 if all(results) else 1
 
 

@@ -50,3 +50,7 @@ def test_slice_cli_parity(fuzz):
 
 def test_slice_native_cli_parity(fuzz):
     assert fuzz.sweep_native_cli_parity(trials=3)
+
+
+def test_slice_ragged_m2m(fuzz):
+    assert fuzz.sweep_ragged_m2m(trials=3)
