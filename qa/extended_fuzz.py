@@ -29,6 +29,10 @@ import tempfile
 if os.environ.get("PWASM_QA_REAL_CHIP", "") != "1":
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    # like tests/conftest.py: sweeps must not arm the process-global
+    # persistent compilation cache (hundreds of one-off oracle shapes
+    # would pollute the production cache dir)
+    os.environ.setdefault("PWASM_JAX_CACHE", "0")
     _flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in _flags:
         os.environ["XLA_FLAGS"] = (
@@ -495,11 +499,11 @@ def sweep_ragged_m2m(trials: int = 12) -> bool:
             bad += 1
             print(f"[ragged-m2m] trial {trial}: mesh != flat")
             continue
+        ts_enc = [encode(t.upper()) for t in ts]
         for i, q in enumerate(qs):
             qe = encode(q.upper())
             m = len(qe)
-            for j, t in enumerate(ts):
-                te = encode(t.upper())
+            for j, te in enumerate(ts_enc):
                 n_eff = m if len(te) <= m else m + band - 2
                 tp = np.full(n_eff, PAD, dtype=np.int8)
                 tp[:min(len(te), n_eff)] = te[:n_eff]

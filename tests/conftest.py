@@ -16,6 +16,8 @@ import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # no tunnel for subprocesses
+os.environ.setdefault("PWASM_JAX_CACHE", "0")  # tests must not arm the
+#                       process-global persistent compilation cache
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

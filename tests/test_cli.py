@@ -700,3 +700,39 @@ def test_device_share_counters_roll_back_on_fallback(tmp_path,
     assert st["device_events"] == 0
     assert st["scalar_events"] == st["events"] > 0
     assert st["fallback_batches"] >= 1
+
+
+def test_compilation_cache_arming(tmp_path, monkeypatch):
+    """enable_compilation_cache: sets the persistent-cache config keys
+    exactly once, honors PWASM_JAX_CACHE_DIR, and PWASM_JAX_CACHE=0
+    opts out — unit-tested against a captured config.update so the
+    process-global jax config stays untouched."""
+    import pwasm_tpu.ops as ops
+
+    calls = []
+
+    class FakeConfig:
+        def update(self, k, v):
+            calls.append((k, v))
+
+    class FakeJax:
+        config = FakeConfig()
+
+    monkeypatch.setattr(ops, "_cache_armed", False)
+    monkeypatch.setenv("PWASM_JAX_CACHE_DIR", str(tmp_path / "jc"))
+    monkeypatch.delenv("PWASM_JAX_CACHE", raising=False)
+    monkeypatch.setitem(sys.modules, "jax", FakeJax())
+    ops.enable_compilation_cache()
+    keys = dict(calls)
+    assert keys["jax_compilation_cache_dir"] == str(tmp_path / "jc")
+    assert (tmp_path / "jc").is_dir()
+    assert keys["jax_persistent_cache_min_compile_time_secs"] == 0.0
+    # idempotent: second call is a no-op
+    n = len(calls)
+    ops.enable_compilation_cache()
+    assert len(calls) == n
+    # opt-out
+    monkeypatch.setattr(ops, "_cache_armed", False)
+    monkeypatch.setenv("PWASM_JAX_CACHE", "0")
+    ops.enable_compilation_cache()
+    assert len(calls) == n
