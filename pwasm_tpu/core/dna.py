@@ -113,12 +113,19 @@ _CODON_TABLE = {
 }
 
 
+_CODON_TABLE_B = {k.encode(): v for k, v in _CODON_TABLE.items()}
+
+
 def translate_codon(seq: bytes, pos: int = 0) -> str:
     """Translate the codon starting at ``pos``; 'X' if short or ambiguous."""
-    codon = bytes(seq[pos:pos + 3]).upper().replace(b"U", b"T")
+    codon = bytes(seq[pos:pos + 3])
+    aa = _CODON_TABLE_B.get(codon)     # fast path: already upper ACGT
+    if aa is not None:
+        return aa
+    codon = codon.upper().replace(b"U", b"T")
     if len(codon) < 3:
         return "X"
-    return _CODON_TABLE.get(codon.decode("ascii", "replace"), "X")
+    return _CODON_TABLE_B.get(codon, "X")
 
 
 def _build_aa_lut() -> np.ndarray:

@@ -96,9 +96,10 @@ def predict_impact(di: DiffEvent, refseq: bytes, r_trloc: int) -> str:
                 aaofs = ao
                 aamods.append(ao)
         parts: list[str] = []
+        mod_b = bytes(modseq)   # one copy for all modified codons
         for ao in aamods:
             aa = translate_codon(r_trseq, ao * 3)
-            maa = translate_codon(bytes(modseq), ao * 3)
+            maa = translate_codon(mod_b, ao * 3)
             if aa != maa:  # not a synonymous codon
                 aapos = ao + di.rloc // 3
                 s = f"AA{aapos}|{aa}:{maa}"
@@ -120,8 +121,10 @@ def predict_impact(di: DiffEvent, refseq: bytes, r_trloc: int) -> str:
     maa4: list[str] = []
     txt = ""
     i = 0
-    while i + 2 < len(modseq):
-        aamod = translate_codon(bytes(modseq), i)
+    mod_b = bytes(modseq)   # ONE copy — the scan below is per codon,
+    #                         and modseq is the whole reference suffix
+    while i + 2 < len(mod_b):
+        aamod = translate_codon(mod_b, i)
         if aamod == ".":
             txt = f"premature stop at AA{1 + (i + r_trloc) // 3}"
             break
