@@ -919,9 +919,19 @@ def cfg8_realistic_scale() -> int:
     import tempfile
 
     repo = os.path.dirname(os.path.abspath(__file__))
-    sys.path.insert(0, repo)
-    sys.path.insert(0, os.path.join(repo, "tests"))
-    from test_realistic_scale import make_corpus
+    inserted = [repo, os.path.join(repo, "tests")]
+    for p in inserted:
+        sys.path.insert(0, p)
+    try:
+        from test_realistic_scale import make_corpus
+    finally:
+        # pop OUR insertions (first occurrence each) so later --all
+        # configs don't resolve imports through tests/ (ADVICE round 5)
+        for p in inserted:
+            try:
+                sys.path.remove(p)
+            except ValueError:
+                pass
 
     from pwasm_tpu.native import native_cli_path
     from pwasm_tpu.ops import on_tpu_backend
@@ -974,10 +984,12 @@ def cfg8_realistic_scale() -> int:
                 sys.stderr.write(r.stderr.decode()[:1000])
                 return _fail("realistic_pycli")
         if cli_bin is None:
-            # no toolchain: record the Python CLI wall alone (distinct
-            # situation, same metric name semantics as cfg1's fallback)
-            return _emit("realistic_pycli_wall_s", min(py_times), "s",
-                         1.0, cpu_metric=True)
+            # no toolchain: a DISTINCT metric name — reusing
+            # realistic_pycli_wall_s with vs_baseline=1.0 would let a
+            # toolchain regression masquerade as a perfect-parity run
+            # in cross-round comparisons (ADVICE round 5)
+            return _emit("realistic_pycli_wall_noref_s", min(py_times),
+                         "s", 1.0, cpu_metric=True)
         nat_body = readset("nat")
         if readset("py") != nat_body:
             return _fail("realistic_pycli_parity")

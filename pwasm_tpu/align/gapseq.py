@@ -460,7 +460,7 @@ def refine_clipping_batch(seqs: list[GapSeq], cons: bytes,
                           cposes: list[int],
                           skip_dels: bool = False,
                           device: bool = False,
-                          mesh=None) -> int:
+                          mesh=None, supervisor=None) -> int:
     """Refine the clipped ends of MANY members against the consensus in
     one vectorized pass (the refineMSA member loop,
     GapAssem.cpp:1133-1183, flattened into (members, layout) tensors).
@@ -561,18 +561,41 @@ def refine_clipping_batch(seqs: list[GapSeq], cons: bytes,
 
     demotions = 0
     if device:
-        try:
+        def _device_phases():
             from pwasm_tpu.ops.refine_clip import refine_phases_device
-            clipL, clipR, missR, missL = refine_phases_device(
+            return refine_phases_device(
                 gseq2, gxpos2, cons_arr, cpos, glen, totals, gclipL,
                 gclipR, clipL0, clipR0, seqlens, XDROP, MATCH_SC,
                 MISMATCH_SC, mesh=mesh)
+
+        try:
+            if supervisor is not None:
+                # supervised: bounded retries + clip-bound guardrails
+                # before the host demotion (resilience.supervisor)
+                from pwasm_tpu.resilience.guardrails import \
+                    check_refine_clips
+                clipL, clipR, missR, missL = supervisor.run(
+                    "refine", _device_phases,
+                    validate=lambda r: check_refine_clips(
+                        r[0], r[1], seqlens))
+            else:
+                clipL, clipR, missR, missL = _device_phases()
         except Exception as e:  # backend down / jax unavailable:
             # replay on the host phases (bit-exact), surfaced by count
-            from pwasm_tpu.utils import exc_detail
+            from pwasm_tpu.core.errors import PwasmError as _PErr
+            if isinstance(e, _PErr):
+                raise   # --fallback=fail (ResilienceError): abort loudly
+            if supervisor is not None:
+                # supervised give-up: count + warn through the
+                # supervisor so res_fallbacks reflects this degradation
+                supervisor.note_degraded(
+                    "refine", "degrading clip refinement to the host "
+                    f"phases ({e})")
+            else:
+                from pwasm_tpu.utils import exc_detail
 
-            print(f"pwasm: device clip refinement fell back to host "
-                  f"({exc_detail(e)})", file=sys.stderr)
+                print(f"pwasm: device clip refinement fell back to "
+                      f"host ({exc_detail(e)})", file=sys.stderr)
             demotions = 1
         else:
             for km in np.nonzero(missR)[0]:

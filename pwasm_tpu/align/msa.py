@@ -515,7 +515,8 @@ class Msa:
                 in self.column_contributors(col)
                 if not clipped and sym.upper() != want]
 
-    def build_msa(self, device: bool = False, mesh=None) -> None:
+    def build_msa(self, device: bool = False, mesh=None,
+                  supervisor=None) -> None:
         """(GSeqAlign::buildMSA, GapAssem.cpp:1088-1106).  With ``device``
         the column counts (and the consensus votes) come from one Pallas
         launch over ``pileup_matrix()`` (ops.consensus.consensus_pallas —
@@ -543,7 +544,8 @@ class Msa:
                 self.badseqs += 1
             self._seq_to_columns(s, self.msacolumns, count=not device)
         if device:
-            self._device_count_votes(mesh, pile=pile)
+            self._device_count_votes(mesh, pile=pile,
+                                     supervisor=supervisor)
 
     def _err_zero_cov(self, col: int) -> None:
         """(GSeqAlign::ErrZeroCov, GapAssem.cpp:1121-1131; exit 5)"""
@@ -554,7 +556,8 @@ class Msa:
             print(s.name, file=sys.stderr)
         raise ZeroCoverageError(f"zero-coverage column {col}")
 
-    def _device_count_votes(self, mesh=None, pile=None) -> None:
+    def _device_count_votes(self, mesh=None, pile=None,
+                            supervisor=None) -> None:
         """Fill the column counts AND the consensus votes from one device
         launch: ``pileup_matrix()`` → ``consensus_pallas`` (pileup counting
         + the bestChar vote fused in a single Pallas kernel).  This is the
@@ -572,14 +575,32 @@ class Msa:
         cols = self.msacolumns
         if pile is None:
             pile = self.pileup_matrix()
-        chars, counts = device_counts_votes(pile, mesh=mesh)
+        if supervisor is not None:
+            from pwasm_tpu.resilience.guardrails import check_consensus
+
+            def host_counts():
+                # TPU→CPU degradation: numpy class counts over the SAME
+                # pileup; chars=None routes refine_msa to its host vote
+                # over these counts — bit-exact by the vote contract
+                from pwasm_tpu.ops.consensus import host_class_counts
+                self.engine_fallbacks += 1
+                return None, host_class_counts(pile)
+
+            chars, counts = supervisor.run(
+                "consensus",
+                lambda: device_counts_votes(pile, mesh=mesh),
+                validate=lambda r: check_consensus(r[0], r[1], pile),
+                fallback=host_counts)
+        else:
+            chars, counts = device_counts_votes(pile, mesh=mesh)
         cols.counts[:] = counts
         cols.layers[:] = counts.sum(axis=1, dtype=np.int32)
         self._device_vote_chars = chars
 
     def refine_msa(self, remove_cons_gaps: bool = True,
                    refine_clipping: bool = True,
-                   device: bool = False, mesh=None) -> None:
+                   device: bool = False, mesh=None,
+                   supervisor=None) -> None:
         """Consensus construction + clipping refinement driver
         (GSeqAlign::refineMSA, GapAssem.cpp:1133-1183).  The two flags are
         the reference's MSAColumns statics; pafreport runs with
@@ -588,7 +609,7 @@ class Msa:
         the pileup tensor (see build_msa/_device_count_votes) instead of
         host scatter-adds + per-column votes (same integer rule,
         bit-exact)."""
-        self.build_msa(device=device, mesh=mesh)
+        self.build_msa(device=device, mesh=mesh, supervisor=supervisor)
         cols = self.msacolumns
         if device and self._device_vote_chars is not None:
             votes = self._device_vote_chars[cols.mincol:cols.maxcol + 1]
@@ -636,7 +657,8 @@ class Msa:
         if refine_clipping:
             self.engine_fallbacks += refine_clipping_batch(
                 self.seqs, bytes(self.consensus),
-                [_cpos(s) for s in self.seqs], device=device, mesh=mesh)
+                [_cpos(s) for s in self.seqs], device=device, mesh=mesh,
+                supervisor=supervisor)
         second: list = []
         for s in self.seqs:
             grem = s.remove_clip_gaps() if remove_cons_gaps else 0
@@ -646,7 +668,7 @@ class Msa:
             self.engine_fallbacks += refine_clipping_batch(
                 second, bytes(self.consensus),
                 [_cpos(s) for s in second], skip_dels=True,
-                device=device, mesh=mesh)
+                device=device, mesh=mesh, supervisor=supervisor)
         self.refined = True
 
     # ---- clipping transaction (library capability) ---------------------

@@ -53,9 +53,22 @@ USAGE = """Usage:
    --skip-bad-lines    warn and continue on malformed PAF lines
    --resume    append to an existing -o report, skipping alignments
                already emitted (a -s summary then covers only the
-               resumed portion)
+               resumed portion); a device-path run leaves atomic
+               batch-granular checkpoints (<report>.ckpt), so a killed
+               run resumes at the last completed batch exactly
    --profile=DIR  write a jax.profiler device trace for the run
    --stats=FILE   write run statistics as one JSON object
+   --max-retries=N    re-execute a failed/rejected device batch up to
+               N times (exponential backoff + jitter; default 2)
+   --device-deadline=S  per-batch device deadline in seconds — a hung
+               backend costs one timeout, not the run (default: none)
+   --fallback=cpu|fail  what exhausted retries do: degrade the batch
+               to the bit-exact host path (cpu, default) or abort the
+               run loudly (fail)
+   --inject-faults=SPEC  debug: deterministic seeded fault injection
+               into supervised device calls, e.g.
+               seed=7,rate=0.3,kinds=raise+hang+nan+corrupt
+               (see pwasm_tpu/resilience/faults.py for the spec)
    --shard[=N]    (with --device=tpu) shard the device work over a mesh
                of N chips (default: all visible): the analysis batch
                spreads over the mesh and consensus pileup counts are
@@ -135,6 +148,64 @@ def _parse_clipmax(s: str, verbose: bool) -> float:
     return float(c)
 
 
+def _ckpt_path(report_path: str) -> str:
+    return report_path + ".ckpt"
+
+
+def _load_checkpoint(report_path: str) -> tuple[int, int] | None:
+    """Read the batch-granular resume checkpoint for ``report_path``.
+    Returns ``(bytes, records)`` — the durable report prefix — or None
+    when absent, malformed, or inconsistent with the report file (the
+    ckpt must describe a prefix of what is actually on disk)."""
+    import json
+    import os
+
+    try:
+        with open(_ckpt_path(report_path)) as f:
+            ck = json.load(f)
+        nbytes, nrec = ck["bytes"], ck["records"]
+        if not (isinstance(nbytes, int) and isinstance(nrec, int)):
+            return None
+        if nbytes < 0 or nrec < 0 \
+                or nbytes > os.path.getsize(report_path):
+            return None
+        return nbytes, nrec
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def _write_checkpoint(freport, report_path: str, records: int) -> bool:
+    """Atomically persist the report's durable prefix after one
+    completed device batch: fsync the report, then tmp-write + rename
+    the ckpt JSON.  Best-effort — a failed write never stops the run
+    (returns False)."""
+    import json
+    import os
+
+    try:
+        freport.flush()
+        os.fsync(freport.fileno())
+        size = os.fstat(freport.fileno()).st_size
+        tmp = _ckpt_path(report_path) + ".tmp"
+        with open(tmp, "w") as cf:
+            json.dump({"bytes": size, "records": records}, cf)
+            cf.flush()
+            os.fsync(cf.fileno())
+        os.replace(tmp, _ckpt_path(report_path))
+        return True
+    except OSError:
+        return False
+
+
+def _unlink_checkpoint(report_path: str) -> None:
+    import os
+
+    try:
+        os.unlink(_ckpt_path(report_path))
+    except OSError:
+        pass
+
+
 def run(argv: list[str], stdout=None, stderr=None) -> int:
     stdout = stdout or sys.stdout
     stderr = stderr or sys.stderr
@@ -193,6 +264,9 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
 
     infile = positional[0] if positional else None
     inf = sys.stdin
+    opened: list = []   # output handles closed on ANY unwind: a killed
+    # run must not leave a buffered handle whose late GC flush could
+    # write stale bytes past a checkpoint-truncated report
     try:
         if infile:
             try:
@@ -209,6 +283,42 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
             cfg.clipmax = _parse_clipmax(str(opts["c"]), cfg.verbose)
         cfg.skip_bad_lines = bool(opts.get("skip-bad-lines"))
         cfg.resume = bool(opts.get("resume"))
+        if "max-retries" in opts:
+            val = opts["max-retries"]
+            if val is True or not str(val).isascii() \
+                    or not str(val).isdigit():
+                raise CliError(f"{USAGE}\nInvalid --max-retries value: "
+                               f"{val}\n")
+            cfg.max_retries = int(val)
+        if "device-deadline" in opts:
+            import math
+            try:
+                cfg.device_deadline = float(str(opts["device-deadline"]))
+                # nan survives a <= 0 check and would poison every
+                # thread join; inf is an unbounded "deadline" — both
+                # are usage errors, not policies
+                if cfg.device_deadline <= 0 \
+                        or not math.isfinite(cfg.device_deadline):
+                    raise ValueError
+            except (TypeError, ValueError):
+                raise CliError(f"{USAGE}\nInvalid --device-deadline "
+                               f"value: {opts['device-deadline']}\n")
+        if "fallback" in opts:
+            cfg.fallback = str(opts["fallback"])
+            if cfg.fallback not in ("cpu", "fail"):
+                raise CliError(f"{USAGE}\nInvalid --fallback value: "
+                               f"{cfg.fallback} (must be cpu or fail)\n")
+        if "inject-faults" in opts:
+            if opts["inject-faults"] is True:
+                raise CliError(
+                    f"{USAGE}\n--inject-faults requires a spec\n")
+            cfg.inject_faults = str(opts["inject-faults"])
+            from pwasm_tpu.resilience.faults import parse_fault_spec
+            try:
+                parse_fault_spec(cfg.inject_faults)
+            except ValueError as e:
+                raise CliError(f"{USAGE}\nInvalid --inject-faults: "
+                               f"{e}\n")
         for kind in ("profile", "stats"):
             if opts.get(kind) is True:
                 raise CliError(
@@ -221,6 +331,21 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
         if cfg.resume:
             if "o" not in opts:
                 raise CliError(f"{USAGE}\n--resume requires -o <report>\n")
+            # Checkpoint-first resume (the device/MSA-path durability
+            # journal): a batch-granular <report>.ckpt names the exact
+            # byte size and record count of the last COMPLETED batch —
+            # truncate any torn tail past it and skip exactly those
+            # records, no re-emission.  Falls through to the header-scan
+            # heuristic below when absent or inconsistent.
+            ck = _load_checkpoint(str(opts["o"]))
+            if ck is not None:
+                nbytes, resume_skip = ck
+                try:
+                    with open(str(opts["o"]), "ab") as f:
+                        f.truncate(nbytes)
+                except OSError:
+                    resume_skip = 0
+        if cfg.resume and resume_skip == 0:
             # The report is per-alignment independent in report mode:
             # resume = drop the LAST record (its event rows may be torn
             # by the interruption — a header alone doesn't prove the rows
@@ -262,9 +387,15 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
                         f.truncate(keep)
             except OSError:
                 resume_skip = 0  # nothing emitted yet: a fresh run
+        if not cfg.resume and "o" in opts:
+            # a fresh run invalidates any checkpoint left by a killed
+            # predecessor writing the same report path
+            _unlink_checkpoint(str(opts["o"]))
         try:
             mode = "a" if cfg.resume else "w"
             freport = open(str(opts["o"]), mode) if "o" in opts else stdout
+            if freport is not stdout:
+                opened.append(freport)
         except OSError:
             raise PwasmError(
                 f"Cannot open file {opts['o']} for writing!\n")
@@ -295,6 +426,7 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
             if "w" in opts:
                 try:
                     fmsa = open(str(opts["w"]), "w")
+                    opened.append(fmsa)
                 except OSError:
                     raise PwasmError(
                         f"Cannot open file {opts['w']} for writing!\n")
@@ -306,6 +438,7 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
                 if kind in opts:
                     try:
                         cons_outs[kind] = open(str(opts[kind]), "w")
+                        opened.append(cons_outs[kind])
                     except OSError:
                         raise PwasmError(
                             f"Cannot open file {opts[kind]} for writing!\n")
@@ -313,6 +446,8 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
         cfg.refine_clipping = not bool(opts.get("no-refine-clip"))
         try:
             fsummary = open(str(opts["s"]), "w") if "s" in opts else None
+            if fsummary is not None:
+                opened.append(fsummary)
         except OSError:
             raise PwasmError(
                 f"Cannot open file {opts['s']} for writing!\n")
@@ -329,11 +464,16 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
     finally:
         if inf is not sys.stdin:
             inf.close()
+        for fo in opened:
+            try:
+                fo.close()   # no-op when the normal path closed it
+            except Exception:
+                pass
 
 
 def _native_msa_outputs(nmsa, cfg, fmsa, cons_outs, stderr,
                         device: bool = False, mesh=None,
-                        stats=None) -> None:
+                        stats=None, supervisor=None) -> None:
     """End-of-run MSA outputs through the delegated native engine — the
     exact twin of the Python-engine block in _main_loop (debug layout,
     unrefined -w, then refine-once + ace/info/cons).  With ``device``
@@ -369,24 +509,43 @@ def _native_msa_outputs(nmsa, cfg, fmsa, cons_outs, stderr,
             depth, length = nmsa.dims()
             mat = np.empty((depth, length), dtype=np.int8)
             nmsa.render_pileup(mat)
-            try:
-                from pwasm_tpu.align.msa import device_counts_votes
-                chars, counts = device_counts_votes(mat, mesh=mesh)
-            except Exception as e:  # backend down mid-run: host replay
-                from pwasm_tpu.utils import exc_detail
-                print("pwasm: device consensus fell back to host "
-                      f"({exc_detail(e)})", file=stderr)
+
+            def host_vote():
+                # TPU→CPU degradation over the SAME rendered pileup —
+                # bit-exact by the kernel/host vote parity contract
                 if stats is not None:
                     stats.engine_fallbacks += 1
                 from pwasm_tpu.native import consensus_vote_counts
-                counts = np.stack(
-                    [(mat == k).sum(0, dtype=np.int32) for k in range(6)],
-                    axis=1)
+                from pwasm_tpu.ops.consensus import host_class_counts
+                counts = host_class_counts(mat)
                 layers = counts.sum(axis=1, dtype=np.int32)
                 chars = consensus_vote_counts(counts, layers)
                 if chars is None:  # native lib vanished mid-run: cannot
                     raise PwasmError(  # happen while nmsa is live
                         "native consensus vote unavailable\n")
+                return chars, counts
+
+            def device_vote():
+                from pwasm_tpu.align.msa import device_counts_votes
+                return device_counts_votes(mat, mesh=mesh)
+
+            if supervisor is not None:
+                # supervised: retries + pileup-count-conservation
+                # guardrail before the host demotion
+                from pwasm_tpu.resilience.guardrails import \
+                    check_consensus
+                chars, counts = supervisor.run(
+                    "consensus", device_vote,
+                    validate=lambda r: check_consensus(r[0], r[1], mat),
+                    fallback=host_vote)
+            else:
+                try:
+                    chars, counts = device_vote()
+                except Exception as e:  # backend down: host replay
+                    from pwasm_tpu.utils import exc_detail
+                    print("pwasm: device consensus fell back to host "
+                          f"({exc_detail(e)})", file=stderr)
+                    chars, counts = host_vote()
             nmsa.refine_external(counts, chars, cfg.remove_cons_gaps,
                                  cfg.refine_clipping)
         else:
@@ -412,6 +571,23 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
     from pwasm_tpu.utils import RunStats
 
     stats = RunStats()
+
+    # one supervisor per run: every device round-trip (report batches,
+    # --realign dispatches, the consensus/refine launches) goes through
+    # it — bounded retries, per-batch deadline, circuit breaker, and
+    # the --fallback degradation policy (pwasm_tpu.resilience)
+    from pwasm_tpu.resilience import BatchSupervisor, ResiliencePolicy
+    from pwasm_tpu.resilience.faults import parse_fault_spec, plan_from_env
+    fault_plan = parse_fault_spec(cfg.inject_faults) \
+        if cfg.inject_faults else plan_from_env()
+    if fault_plan is not None:
+        print(f"pwasm: fault injection armed (debug): {fault_plan}",
+              file=stderr)
+    supervisor = BatchSupervisor(
+        ResiliencePolicy(max_retries=cfg.max_retries,
+                         deadline_s=cfg.device_deadline or None,
+                         fallback=cfg.fallback),
+        stats=stats, stderr=stderr, faults=fault_plan)
 
     alnpairs: dict[str, int] = {}   # gene-mode (query~target) dedup counts
     ref_cache: dict[str, bytes] = {}
@@ -493,6 +669,21 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                   file=stderr)
 
     inflight: list = []   # at most one submitted-but-unformatted batch
+
+    # batch-granular durability (SURVEY.md §5 checkpoint/resume, device
+    # path): after each completed batch the report prefix is fsynced
+    # and its (bytes, records) recorded atomically in <report>.ckpt, so
+    # a killed run resumes at the last completed batch.  Records
+    # already in the file from a --resume count toward the total.
+    report_path = getattr(freport, "name", None) \
+        if freport not in (stdout, None) else None
+    emitted = [resume_skip]
+
+    def note_batch_done(nrecords: int) -> None:
+        emitted[0] += nrecords
+        if report_path is not None and use_device:
+            if _write_checkpoint(freport, report_path, emitted[0]):
+                stats.res_checkpoints += 1
 
     def msa_add(aln, tlabel: str, refseq_b: bytes, ord_num: int,
                 realigned: bool = False) -> None:
@@ -580,7 +771,7 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         items, re_pending[:] = re_pending[:], []
         results = realign_pairs(
             [(q_seg, bytes(aln.tseq)) for aln, _t, _r, _o, q_seg in items],
-            band=cfg.band, mesh=shard_mesh)
+            band=cfg.band, mesh=shard_mesh, supervisor=supervisor)
         for (aln, tlabel, refseq_b, ordn, _q), res in zip(items, results):
             al = aln.alninfo
             if res is None:  # outside realignment resource bounds:
@@ -614,20 +805,22 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         # below must not retry it (the retry would mask the live error)
         batch, pending[:] = pending[:], []
         if batch:
-            inflight.append(submit_diff_info_batch(
+            inflight.append((submit_diff_info_batch(
                 batch, freport, skip_codan=cfg.skip_codan,
                 motifs=cfg.motifs, summary=summary, stats=stats,
-                mesh=shard_mesh))
+                mesh=shard_mesh, supervisor=supervisor), len(batch)))
             stats.device_batches += 1
         while len(inflight) > (0 if drain else 1):
+            fin, nrec = inflight.pop(0)
             try:
-                inflight.pop(0)()
+                fin()
             except BaseException:
                 # a formatting failure mid-batch must leave the report a
                 # clean prefix of input order (--resume depends on it):
                 # drop everything submitted after the failure point
                 inflight.clear()
                 raise
+            note_batch_done(nrec)
 
     try:
         file_line = 0
@@ -764,7 +957,7 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
     if nmsa is not None:
         _native_msa_outputs(nmsa, cfg, fmsa, cons_outs, stderr,
                             device=use_device, mesh=shard_mesh,
-                            stats=stats)
+                            stats=stats, supervisor=supervisor)
     else:
         if cfg.debug and ref_msa is not None:
             print(f">MSA ({ref_msa.count()})", file=stderr)
@@ -781,7 +974,8 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
             ref_msa.finalize()
             ref_msa.refine_msa(remove_cons_gaps=cfg.remove_cons_gaps,
                                refine_clipping=cfg.refine_clipping,
-                               device=use_device, mesh=shard_mesh)
+                               device=use_device, mesh=shard_mesh,
+                               supervisor=supervisor)
             contig = ref_msa.seqs[0].name if ref_msa.seqs else "contig"
             if "ace" in cons_outs:
                 ref_msa.write_ace(cons_outs["ace"], contig)
@@ -797,6 +991,11 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         fsummary.close()
     if freport not in (stdout, None):
         freport.close()
+    if report_path is not None:
+        # the run completed: the report is whole, so the mid-run
+        # checkpoint is obsolete (a later --resume skips via the
+        # header scan, which now sees only complete records)
+        _unlink_checkpoint(report_path)
     if cfg.stats_path:
         try:
             with open(cfg.stats_path, "w") as f:

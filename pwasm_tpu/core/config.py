@@ -43,6 +43,14 @@ class Config:
     profile_dir: str = ""           # jax.profiler trace output directory
     stats_path: str = ""            # write run-stats JSON here
 
+    # resilience knobs (pwasm_tpu.resilience; no ref equivalent —
+    # the reference fails fast, SURVEY.md §2.5.12)
+    max_retries: int = 2            # --max-retries: device re-attempts
+    device_deadline: float = 0.0    # --device-deadline: s per batch
+    #                                 attempt (0 = unbounded)
+    fallback: str = "cpu"           # --fallback: cpu (degrade) | fail
+    inject_faults: str = ""         # --inject-faults=SPEC (debug)
+
 
 def load_motifs(path: str) -> tuple[str, ...]:
     """Load a motif table: one motif per line, '#' comments allowed.

@@ -49,6 +49,17 @@ def pileup_counts(bases: jax.Array) -> jax.Array:
     return counts.astype(jnp.int32)
 
 
+def host_class_counts(pile: np.ndarray) -> np.ndarray:
+    """Pure-numpy per-column class counts over a (depth, cols) int8
+    code pileup — the host twin of ``pileup_counts`` (codes outside
+    [0, 6) contribute nothing).  Returns (cols, 6) int32.  This is the
+    single degradation path the resilience layer falls back to when a
+    device consensus launch is given up on (align/msa.py and cli.py
+    both route here so the two fallbacks cannot drift)."""
+    return np.stack([(pile == k).sum(0, dtype=np.int32)
+                     for k in range(N_CLASSES)], axis=1)
+
+
 def consensus_vote_counts(counts: jax.Array) -> jax.Array:
     """Vote per column from (..., cols, 6) counts -> (..., cols) int8 codes
     (0..3 ACGT, 4 N, 5 gap, CODE_ZERO_COV for empty columns)."""

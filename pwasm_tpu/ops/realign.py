@@ -717,7 +717,7 @@ def _sharded_rows_static(qs, ts, q_lens, t_lens, mesh, band: int,
                          params: ScoreParams, dlo: int, kernel: str):
     """Sharded dispatch for the Pallas kernels (dlo is genuinely static
     there — the unsharded Pallas path recompiles per placement too)."""
-    from jax import shard_map
+    from pwasm_tpu.utils.jaxcompat import shard_map
 
     def block(qs_l, ts_l, ql_l, tl_l):
         return banded_realign_rows(qs_l, ts_l, ql_l, tl_l, band=band,
@@ -735,7 +735,7 @@ def _sharded_rows_traced(qs, ts, q_lens, t_lens, dlo, mesh, band: int,
     """Sharded dispatch for the XLA scan path: ``dlo`` stays a traced
     replicated scalar, so re-placing the band between flushes reuses
     the compiled program (same contract as the unsharded XLA path)."""
-    from jax import shard_map
+    from pwasm_tpu.utils.jaxcompat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def block(qs_l, ts_l, ql_l, tl_l, dlo_l):
@@ -1058,7 +1058,8 @@ _PTR_BYTES_LIMIT = 1 << 30
 
 
 def realign_pairs(pairs: list[tuple[bytes, bytes]], band: int = 64,
-                  params: ScoreParams = ScoreParams(), mesh=None):
+                  params: ScoreParams = ScoreParams(), mesh=None,
+                  supervisor=None):
     """Re-align a batch of (query_segment, target) byte-string pairs.
 
     Returns a list of (score, ops_fwd) — or ``None`` for pairs that
@@ -1074,6 +1075,11 @@ def realign_pairs(pairs: list[tuple[bytes, bytes]], band: int = 64,
 
     ``mesh``: a jax.sharding.Mesh (``pafreport --shard``) — lanes shard
     over every mesh axis, one fused-kernel launch per device shard.
+
+    ``supervisor``: a resilience.BatchSupervisor — each device dispatch
+    is retried/validated under its policy; on give-up the remaining
+    lanes degrade to the host oracle (bit-exact tie-break contract)
+    within its cell bounds instead of killing the run.
     """
     from pwasm_tpu.core.dna import encode
 
@@ -1085,12 +1091,14 @@ def realign_pairs(pairs: list[tuple[bytes, bytes]], band: int = 64,
     groups = group_by_shape(
         ((len(qc), len(tc)) for qc, tc in enc))
     for (mb, nb), idxs in sorted(groups.items()):
-        _realign_group(enc, idxs, mb, nb, band, params, out, mesh)
+        _realign_group(enc, idxs, mb, nb, band, params, out, mesh,
+                       supervisor)
     return out
 
 
 def _realign_group(enc, idxs: list[int], m_max: int, n: int, band: int,
-                   params: ScoreParams, out: list, mesh=None) -> None:
+                   params: ScoreParams, out: list, mesh=None,
+                   supervisor=None) -> None:
     """Dispatch one shape bucket of ``realign_pairs`` lanes (padded to
     (m_max, n)), writing results into ``out`` at their original
     indices."""
@@ -1109,9 +1117,11 @@ def _realign_group(enc, idxs: list[int], m_max: int, n: int, band: int,
     todo = np.arange(T)
     cur_band = max(1, band)
     first = True
+    device_dead = False
     # always try the caller's own band, even above the escalation
     # ceiling; the ceiling bounds only the automatic retries
-    while len(todo) and (first or cur_band <= _MAX_BAND):
+    while len(todo) and not device_dead \
+            and (first or cur_band <= _MAX_BAND):
         first = False
         lane_bytes = m_max * cur_band
         if lane_bytes > _PTR_BYTES_LIMIT:
@@ -1121,24 +1131,47 @@ def _realign_group(enc, idxs: list[int], m_max: int, n: int, band: int,
         for c0 in range(0, len(todo), chunk):
             sub = todo[c0:c0 + chunk]
             dlo = _pick_dlo(t_lens[sub] - q_lens[sub], cur_band)
-            if mesh is not None:
-                scores, leads, iy_runs, ops_rows, ok = \
-                    sharded_realign_rows(mesh, qs[sub], ts[sub],
-                                         q_lens[sub], t_lens[sub],
-                                         band=cur_band, params=params,
-                                         dlo=dlo)
-            else:
-                scores, leads, iy_runs, ops_rows, ok = \
-                    banded_realign_rows(
+
+            def dispatch(sub=sub, dlo=dlo, cur_band=cur_band):
+                if mesh is not None:
+                    res = sharded_realign_rows(
+                        mesh, qs[sub], ts[sub], q_lens[sub],
+                        t_lens[sub], band=cur_band, params=params,
+                        dlo=dlo)
+                else:
+                    res = banded_realign_rows(
                         jnp.asarray(qs[sub]), jnp.asarray(ts[sub]),
                         jnp.asarray(q_lens[sub]),
                         jnp.asarray(t_lens[sub]),
                         band=cur_band, params=params, dlo=dlo)
-            scores = np.asarray(scores)
-            leads = np.asarray(leads)
-            iy_runs = np.asarray(iy_runs)
-            ops_rows = np.asarray(ops_rows)
-            ok = np.asarray(ok)
+                return tuple(np.asarray(x) for x in res)
+
+            if supervisor is not None:
+                from pwasm_tpu.resilience.guardrails import check_realign
+                from pwasm_tpu.resilience.supervisor import \
+                    DeviceWorkFailed
+                try:
+                    scores, leads, iy_runs, ops_rows, ok = \
+                        supervisor.run(
+                            "realign", dispatch,
+                            validate=lambda r, sub=sub: check_realign(
+                                *r, q_lens=q_lens[sub],
+                                t_lens=t_lens[sub],
+                                match_score=params.match))
+                except DeviceWorkFailed as e:
+                    # device given up on: every unresolved lane (this
+                    # chunk and everything still queued) degrades to
+                    # the bounded host oracle below — counted + warned
+                    # like every other degradation
+                    supervisor.note_degraded(
+                        "realign",
+                        f"degrading {len(todo) - c0} lane(s) to the "
+                        f"host oracle ({e})")
+                    still.extend(todo[c0:])
+                    device_dead = True
+                    break
+            else:
+                scores, leads, iy_runs, ops_rows, ok = dispatch()
             for idx, k in enumerate(sub):
                 if ok[idx]:
                     out[idxs[k]] = (int(scores[idx]),

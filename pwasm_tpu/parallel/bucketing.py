@@ -162,14 +162,22 @@ def group_by_shape(shapes: Iterable[Sequence[int]],
 
 def scatter_results(buckets: Sequence[Bucket],
                     per_bucket: Sequence[np.ndarray], n: int,
-                    fill=0) -> np.ndarray:
+                    fill=0, trailing_shape: Sequence[int] = (),
+                    dtype=None) -> np.ndarray:
     """Reassemble per-bucket row results into input order.
 
     ``per_bucket[i]`` must have leading dimension equal to
     ``buckets[i].data.shape[0]``; filler rows (``idx == -1``) are
     dropped.  Returns an array of leading dimension ``n`` (rows never
     written stay ``fill`` — there are none when the buckets came from
-    one ``bucket_*`` call over ``n`` sequences)."""
+    one ``bucket_*`` call over ``n`` sequences).
+
+    When results exist, the trailing dimensions and dtype come from
+    ``per_bucket`` itself.  With EMPTY ``buckets`` there is nothing to
+    derive them from, so ``trailing_shape``/``dtype`` supply them
+    (ADVICE round 5: the old 1-D default-dtype fallback handed callers
+    an array whose shape/dtype silently disagreed with every non-empty
+    call)."""
     if len(buckets) != len(per_bucket):
         raise ValueError("buckets and per_bucket differ in length")
     out = None
@@ -184,5 +192,5 @@ def scatter_results(buckets: Sequence[Bucket],
         live = b.idx >= 0
         out[b.idx[live]] = r[live]
     if out is None:
-        out = np.full((n,), fill)
+        out = np.full((n,) + tuple(trailing_shape), fill, dtype=dtype)
     return out

@@ -21,7 +21,7 @@ import functools
 
 import jax
 import numpy as np
-from jax import shard_map
+from pwasm_tpu.utils.jaxcompat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from pwasm_tpu.ops.banded_dp import (ScoreParams, banded_scores_batch,
@@ -114,7 +114,8 @@ def many2many_scores_pallas(qs: jax.Array, ts: jax.Array,
 def many2many_scores_ragged(qs, ts, band: int = 64,
                             params: ScoreParams = ScoreParams(),
                             mesh: Mesh | None = None,
-                            kernel: str = "xla") -> np.ndarray:
+                            kernel: str = "xla",
+                            supervisor=None) -> np.ndarray:
     """(Q, T) scores for RAGGED query/target sequence lists.
 
     The shape preconditions of the rectangular entry points (queries
@@ -140,6 +141,12 @@ def many2many_scores_ragged(qs, ts, band: int = 64,
     ``qs``/``ts``: bytes/str or int8 code arrays.  Cells whose end
     diagonal falls outside [-band//2, band-2] are NEG — the union of
     what the two placements can cover.
+
+    ``supervisor`` (resilience.BatchSupervisor) supervises each bucket
+    dispatch: guardrail-validated scores, bounded retries, and on
+    give-up the TPU→CPU degradation — the identical program re-runs
+    pinned to the CPU backend (unsharded; bit-exact by the mesh/flat
+    parity contract above).
     """
     import jax.numpy as jnp
 
@@ -165,16 +172,56 @@ def many2many_scores_ragged(qs, ts, band: int = 64,
                 continue
             tb = pad_to_width([ts_enc[k] for k in keep], n_eff,
                               batch_multiple=tmult, truncate=clip)
-            if fn is not None:
-                s = np.asarray(fn(jnp.asarray(qb.data),
-                                  jnp.asarray(tb.data),
-                                  jnp.asarray(tb.lens)))
-            else:
+
+            def dispatch(qb=qb, tb=tb):
+                if fn is not None:
+                    return np.asarray(fn(jnp.asarray(qb.data),
+                                         jnp.asarray(tb.data),
+                                         jnp.asarray(tb.lens)))
                 flat = many2many_scores_pallas if kernel == "pallas" \
                     else many2many_scores
-                s = np.asarray(flat(
+                return np.asarray(flat(
                     jnp.asarray(qb.data), jnp.asarray(tb.data),
                     jnp.asarray(tb.lens), band=band, params=params))
+
+            if supervisor is not None:
+                from pwasm_tpu.resilience.guardrails import \
+                    check_scores_matrix
+
+                def on_cpu(qb=qb, tb=tb):
+                    # TPU→CPU degradation: the same scorer on the
+                    # (always-present) CPU backend — sharded over the
+                    # mesh's CPU twin when enough CPU devices exist,
+                    # unsharded otherwise (bit-exact either way by the
+                    # mesh/flat parity contract)
+                    import jax
+
+                    if mesh is not None:
+                        from pwasm_tpu.parallel.mesh import cpu_like_mesh
+                        cmesh = cpu_like_mesh(mesh)
+                        if cmesh is not None:
+                            cfn = make_many2many(cmesh, band=band,
+                                                 params=params,
+                                                 kernel=kernel)
+                            return np.asarray(cfn(
+                                jnp.asarray(qb.data),
+                                jnp.asarray(tb.data),
+                                jnp.asarray(tb.lens)))
+                    with jax.default_device(jax.devices("cpu")[0]):
+                        return np.asarray(many2many_scores(
+                            jnp.asarray(qb.data), jnp.asarray(tb.data),
+                            jnp.asarray(tb.lens), band=band,
+                            params=params))
+
+                s = supervisor.run(
+                    "many2many", dispatch,
+                    validate=lambda s, qb=qb, tb=tb, m=m:
+                        check_scores_matrix(
+                            s, qb.data.shape[0], tb.data.shape[0],
+                            params.match, m),
+                    fallback=on_cpu)
+            else:
+                s = dispatch()
             ql = qb.idx >= 0
             tl = tb.idx >= 0
             cols = np.asarray(keep)[tb.idx[tl]]

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from pwasm_tpu.utils.jaxcompat import shard_map
 
 from pwasm_tpu.ops.banded_dp import ScoreParams, banded_scores_batch
 from pwasm_tpu.ops.consensus import consensus_vote_counts, pileup_counts
@@ -47,15 +47,37 @@ def _inner_factor(n: int) -> int:
 
 
 def make_mesh(n_devices: int | None = None,
-              axis_names: tuple[str, str] = ("batch", "depth")) -> Mesh:
+              axis_names: tuple[str, str] = ("batch", "depth"),
+              platform: str | None = None) -> Mesh:
     """A 2-D mesh over the first ``n_devices`` devices.  The depth axis
-    gets the largest factor <= sqrt(n) so both axes are exercised."""
-    devs = jax.devices()
+    gets the largest factor <= sqrt(n) so both axes are exercised.
+    ``platform`` restricts the device pool (e.g. ``"cpu"`` builds the
+    degradation twin of a TPU mesh, see ``cpu_like_mesh``)."""
+    devs = jax.devices(platform) if platform else jax.devices()
     if n_devices is not None:
         devs = devs[:n_devices]
     n = len(devs)
     d = _inner_factor(n)
     return Mesh(np.asarray(devs).reshape(n // d, d), axis_names)
+
+
+def cpu_like_mesh(mesh: Mesh) -> Mesh | None:
+    """The CPU-backend twin of ``mesh``: same axis names and shape over
+    CPU devices, so a sharded program degrades to the host with its
+    partitioning (and bit-exact psum order) intact.  Returns None when
+    too few CPU devices exist — callers then degrade to the unsharded
+    path instead (same integers either way by the repo's mesh/flat
+    parity contracts)."""
+    shape = tuple(mesh.shape[a] for a in mesh.axis_names)
+    need = int(np.prod(shape))
+    try:
+        cpus = jax.devices("cpu")
+    except RuntimeError:
+        return None
+    if len(cpus) < need:
+        return None
+    return Mesh(np.asarray(cpus[:need]).reshape(shape),
+                tuple(mesh.axis_names))
 
 
 def sharded_consensus(mesh: Mesh, dp_axes=("batch",)):

@@ -28,7 +28,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from pwasm_tpu.utils.jaxcompat import pcast, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from pwasm_tpu.ops.banded_dp import (NEG, ScoreParams, band_dlo,
@@ -109,7 +109,7 @@ def make_wavefront_sp(mesh: Mesh, m: int, n: int, T: int,
                              emit.astype(jnp.int32))
 
         zeros = jax.tree.map(
-            lambda x: jax.lax.pcast(jnp.zeros_like(x), axis, to="varying"),
+            lambda x: pcast(jnp.zeros_like(x), axis, to="varying"),
             wf_init)
         _, (bs, scs, emits) = jax.lax.scan(
             stage, zeros, jnp.arange(T + D - 1, dtype=jnp.int32))

@@ -52,7 +52,7 @@ def _pad_axis0(v, n: int):
 def submit_events_device(refseq: bytes, events,
                          skip_codan: bool = False,
                          motifs=DEFAULT_MOTIFS, max_ev: int = MAX_EV,
-                         mesh=None, stats=None):
+                         mesh=None, stats=None, supervisor=None):
     """Launch the device analysis of a batch of DiffEvents and return a
     ``finish() -> list[tuple]`` closure that fetches and assembles the
     results.
@@ -62,6 +62,13 @@ def submit_events_device(refseq: bytes, events,
     batch k's device program with batch k-1's host formatting, which
     hides the transfer/launch latency entirely (one batch in flight).
     Events over ``max_ev`` bases take the scalar path inside finish().
+
+    ``supervisor`` (resilience.BatchSupervisor) supervises the device
+    round-trip: the fetched outputs are guardrail-validated, a failed
+    or rejected fetch RE-SUBMITS the whole program (bounded retries
+    with backoff), and exhaustion raises for the caller's scalar-path
+    degradation.  The happy path keeps the submit/finish overlap —
+    only retries lose it.
     """
     import jax.numpy as jnp
 
@@ -76,23 +83,8 @@ def submit_events_device(refseq: bytes, events,
     small = [ev for ev, ok in zip(events, fits) if ok]
     big = [ev for ev, ok in zip(events, fits) if not ok]
     out = None
+    launch = None
     if small:
-        packed = pack_events(small, max_ev)
-        if mesh is not None:
-            # --shard: spread the event batch over the mesh (all axes
-            # flattened — the analysis is embarrassingly parallel, so
-            # GSPMD partitions the fused program with no collectives)
-            import jax
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            n_mesh = int(np.prod(list(mesh.shape.values())))
-            packed = {
-                k: jax.device_put(
-                    _pad_axis0(v, n_mesh),
-                    NamedSharding(mesh, PartitionSpec(
-                        tuple(mesh.axis_names),
-                        *([None] * (v.ndim - 1)))))
-                for k, v in packed.items()}
         mot_codes, mot_lens = pack_motifs(motifs)
         # pad the reference to the (256-rounded) max_len so the jitted
         # program is keyed on the bucket, not the exact ref length — one
@@ -100,15 +92,57 @@ def submit_events_device(refseq: bytes, events,
         # which never matches a base and is masked by ref_len elsewhere
         ref_codes = np.full(max_len, PAD_CODE, dtype=np.int8)
         ref_codes[:ref_len] = encode(refseq.upper())
-        out = ctx_scan(jnp.asarray(ref_codes),
-                       jnp.int32(ref_len), packed, mot_codes, mot_lens,
-                       max_codons=max_ev // 3 + 2, max_len=max_len,
-                       skip_codan=skip_codan)
+
+        def launch():
+            packed = pack_events(small, max_ev)
+            if mesh is not None:
+                # --shard: spread the event batch over the mesh (all
+                # axes flattened — the analysis is embarrassingly
+                # parallel, so GSPMD partitions the fused program with
+                # no collectives)
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                n_mesh = int(np.prod(list(mesh.shape.values())))
+                packed = {
+                    k: jax.device_put(
+                        _pad_axis0(v, n_mesh),
+                        NamedSharding(mesh, PartitionSpec(
+                            tuple(mesh.axis_names),
+                            *([None] * (v.ndim - 1)))))
+                    for k, v in packed.items()}
+            return ctx_scan(jnp.asarray(ref_codes),
+                            jnp.int32(ref_len), packed, mot_codes,
+                            mot_lens, max_codons=max_ev // 3 + 2,
+                            max_len=max_len, skip_codan=skip_codan)
+
+        if supervisor is None:
+            out = launch()
+        else:
+            try:
+                out = launch()   # async submit; failures retried at
+            except Exception:    # finish inside the supervised attempt
+                out = None
 
     def finish() -> list[tuple]:
         results: dict[int, tuple] = {}
         if small:
-            host = {k: np.asarray(v) for k, v in out.items()}
+            if supervisor is not None:
+                from pwasm_tpu.resilience.guardrails import check_ctx_scan
+                pending = [out]
+
+                def attempt():
+                    o = pending.pop() if pending else None
+                    o = launch() if o is None else o
+                    return {k: np.asarray(v) for k, v in o.items()}
+
+                host = supervisor.run(
+                    "ctx_scan", attempt,
+                    validate=lambda h: check_ctx_scan(
+                        h, len(small), ref_len, len(motifs),
+                        skip_codan))
+            else:
+                host = {k: np.asarray(v) for k, v in out.items()}
             if stats is not None:
                 # per-event routing observability (VERDICT r4 weak #6):
                 # credited only AFTER the device fetch succeeded — a
@@ -153,7 +187,8 @@ def analyze_events_device(refseq: bytes, events, skip_codan: bool = False,
 
 def submit_diff_info_batch(batch, f, skip_codan: bool = False,
                            motifs=DEFAULT_MOTIFS, summary=None,
-                           max_ev: int = MAX_EV, stats=None, mesh=None):
+                           max_ev: int = MAX_EV, stats=None, mesh=None,
+                           supervisor=None):
     """Launch the device analysis for a report batch and return a
     ``finish() -> None`` closure that fetches the results and writes the
     rows (the SURVEY.md §3.1 TPU boundary: host parse -> batch -> one
@@ -178,6 +213,10 @@ def submit_diff_info_batch(batch, f, skip_codan: bool = False,
         global _warned_fallback
         if stats is not None:
             stats.fallback_batches += 1
+            if supervisor is not None and hasattr(stats, "res_fallbacks"):
+                # the supervised pipeline degraded this batch to the
+                # host: surface it in the resilience block too
+                stats.res_fallbacks += 1
             # every event of this batch is (re)analyzed on host
             stats.scalar_events += sum(
                 len(aln.tdiffs) for aln, _rl, _tl, _rs in batch)
@@ -201,7 +240,7 @@ def submit_diff_info_batch(batch, f, skip_codan: bool = False,
         for refseq, events in groups.items():
             finishes.append((events, submit_events_device(
                 refseq, events, skip_codan, motifs, max_ev, mesh=mesh,
-                stats=stats)))
+                stats=stats, supervisor=supervisor)))
     except Exception as e:
         err = e
 
@@ -211,6 +250,8 @@ def submit_diff_info_batch(batch, f, skip_codan: bool = False,
         return finish_failed
 
     def finish() -> None:
+        from pwasm_tpu.resilience.supervisor import ResilienceError
+
         analyzed: dict[int, tuple] = {}
         # snapshot the routing counters: if a later group fails after an
         # earlier one was credited, the whole batch replays on host and
@@ -222,6 +263,10 @@ def submit_diff_info_batch(batch, f, skip_codan: bool = False,
             for events, fin in finishes:
                 for ev, r in zip(events, fin()):
                     analyzed[id(ev)] = r
+        except ResilienceError:
+            # --fallback=fail: the policy forbids the scalar-path
+            # degradation below — abort the run instead
+            raise
         except Exception as e:
             if stats is not None:
                 stats.device_events, stats.scalar_events = snap

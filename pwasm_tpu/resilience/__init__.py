@@ -1,0 +1,31 @@
+"""Resilient device execution (SURVEY.md §5 failure handling, grown up).
+
+The reference fails fast — one ``GError`` and the process exits
+(core/errors.py mirrors it).  That is the right model for a CLI
+one-shot and the wrong one for a batch engine serving heavy traffic:
+a transient device fault mid-run must not discard hours of completed
+work.  This package supplies the three layers the device pipeline
+threads through:
+
+- ``faults``      deterministic, seeded fault injection (raise / hang /
+                  NaN / corrupt) armed by ``--inject-faults=SPEC`` or
+                  ``PWASM_INJECT_FAULTS`` — the harness that proves the
+                  rest of the package works before real hardware does;
+- ``supervisor``  per-batch deadlines, bounded retry with exponential
+                  backoff + jitter, and a circuit breaker that degrades
+                  device work to the CPU path (policy ``--fallback=cpu``)
+                  or aborts loudly (``--fallback=fail``);
+- ``guardrails``  cheap invariant validation of device outputs, so
+                  silent corruption is treated as a device fault and
+                  re-executed instead of written into the report.
+
+Counters flow into ``utils.runstats`` under the ``resilience`` block of
+the ``--stats`` JSON.
+"""
+
+from pwasm_tpu.resilience.faults import (  # noqa: F401
+    FaultPlan, InjectedFault, InjectedKill, parse_fault_spec)
+from pwasm_tpu.resilience.guardrails import GuardrailViolation  # noqa: F401
+from pwasm_tpu.resilience.supervisor import (  # noqa: F401
+    BatchSupervisor, DeadlineExceeded, DeviceWorkFailed, ResilienceError,
+    ResiliencePolicy)

@@ -1,0 +1,200 @@
+"""Deterministic fault injection for the device pipeline.
+
+Every supervised device call site (``resilience.supervisor``) consults
+the armed :class:`FaultPlan` before and after the real work.  Draws are
+seeded and keyed on ``(seed, site, per-site attempt counter)``, so a
+given spec produces the identical fault sequence on every run — the
+property the byte-parity acceptance test rests on — and retries of the
+same batch advance the counter, so a fault-free draw eventually lets
+the batch through.
+
+Spec format (``--inject-faults=SPEC`` / ``PWASM_INJECT_FAULTS``), a
+comma-separated ``key=value`` list:
+
+  ``seed=N``      RNG seed (default 0)
+  ``rate=P``      per-attempt fault probability in [0, 1] (default 0)
+  ``kinds=a+b``   fault mix, ``+``-separated from {raise, hang, nan,
+                  corrupt} (default all four), drawn uniformly
+  ``sites=x+y``   restrict injection to these site names (default all;
+                  site names: ``ctx_scan``, ``realign``, ``consensus``,
+                  ``many2many``, ``refine``)
+  ``hang_s=S``    simulated hang duration in seconds (default 30;
+                  meant to exceed ``--device-deadline``)
+  ``kill=K``      raise an uncatchable :class:`InjectedKill` on the
+                  K-th supervised attempt (counted across all sites) —
+                  simulates a mid-run process kill for checkpoint /
+                  resume testing
+
+Example: ``--inject-faults=seed=7,rate=0.3,kinds=raise+nan+corrupt``.
+
+Fault kinds:
+
+- ``raise``    the device call raises :class:`InjectedFault`;
+- ``hang``     the call sleeps ``hang_s`` seconds first (a supervisor
+               deadline turns that into ``DeadlineExceeded``);
+- ``nan``      float outputs get NaNs written into a seeded slice
+               (integer outputs get out-of-range garbage instead);
+- ``corrupt``  one output array gets a seeded slice overwritten with
+               out-of-domain values — the silent-corruption case the
+               guardrails must catch.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from dataclasses import dataclass, field
+
+import numpy as np
+
+KINDS = ("raise", "hang", "nan", "corrupt")
+
+# garbage written by corrupt/nan into integer arrays: far outside every
+# guarded domain (codes, flags, positions, scores) but well inside
+# int32, so the corruption is silent at the dtype level
+_INT_GARBAGE = 0x3FFFFFF0
+
+
+class InjectedFault(RuntimeError):
+    """The exception a ``raise`` fault throws inside a device call."""
+
+
+class InjectedKill(BaseException):
+    """Simulated process kill (``kill=K``).  Derives from BaseException
+    so no retry/fallback layer can swallow it — it unwinds the whole
+    run exactly like SIGKILL would end it, leaving only what the
+    batch checkpoints made durable."""
+
+
+@dataclass
+class FaultPlan:
+    seed: int = 0
+    rate: float = 0.0
+    kinds: tuple[str, ...] = KINDS
+    sites: frozenset[str] | None = None   # None = all sites
+    hang_s: float = 30.0
+    kill: int = 0                         # 0 = disabled; else 1-based
+    _site_counters: dict = field(default_factory=dict, repr=False)
+    _attempts: int = field(default=0, repr=False)
+
+    def draw(self, site: str) -> str | None:
+        """One deterministic fault draw for an attempt at ``site``.
+        Returns a kind from :data:`KINDS` or None, advancing the
+        per-site counter either way.  Raises :class:`InjectedKill` when
+        the global attempt counter reaches ``kill``."""
+        self._attempts += 1
+        if self.kill and self._attempts >= self.kill:
+            raise InjectedKill(
+                f"injected kill at supervised attempt {self._attempts} "
+                f"(site {site})")
+        k = self._site_counters.get(site, 0)
+        self._site_counters[site] = k + 1
+        if self.sites is not None and site not in self.sites:
+            return None
+        rng = random.Random(f"{self.seed}|{site}|{k}")
+        if rng.random() >= self.rate:
+            return None
+        return self.kinds[rng.randrange(len(self.kinds))]
+
+    def corrupt(self, obj, site: str, kind: str):
+        """Deterministically corrupt one numpy array inside ``obj``
+        (dicts/tuples/lists walked recursively; everything else passes
+        through untouched).  Returns a modified deep-ish copy — the
+        original arrays are never written, so a retry that reuses a
+        cached device result is not poisoned."""
+        leaves: list[tuple] = []
+        obj = _walk_copy(obj, leaves)
+        if not leaves:
+            return obj
+        k = self._site_counters.get(site, 0)
+        rng = random.Random(f"{self.seed}|corrupt|{site}|{k}")
+        _, arr = leaves[rng.randrange(len(leaves))]
+        flat = arr.reshape(-1)
+        # corrupt a PREFIX slice: device batches are padded to compile
+        # buckets, so a random offset would usually land in padding no
+        # consumer ever reads — corruption that cannot be consequential
+        # proves nothing about the guardrails
+        n = max(1, flat.shape[0] // 8)
+        start = 0
+        if kind == "nan" and np.issubdtype(arr.dtype, np.floating):
+            flat[start:start + n] = np.nan
+        else:
+            info = np.iinfo(arr.dtype) if np.issubdtype(
+                arr.dtype, np.integer) else None
+            val = _INT_GARBAGE if info is None or info.max >= _INT_GARBAGE \
+                else info.max
+            flat[start:start + n] = val
+        return obj
+
+
+def _walk_copy(obj, leaves: list):
+    """Copy containers and ndarray leaves, collecting (path, array)
+    pairs for the corruptible leaves (non-empty numeric/bool arrays)."""
+    if isinstance(obj, dict):
+        return {k: _walk_copy(v, leaves) for k, v in obj.items()}
+    if isinstance(obj, (tuple, list)):
+        out = [_walk_copy(v, leaves) for v in obj]
+        return tuple(out) if isinstance(obj, tuple) else out
+    if isinstance(obj, np.ndarray) and obj.size \
+            and obj.dtype.kind in "iuf":
+        # bool arrays are NOT corruption targets: a flipped flag is a
+        # legal value no domain invariant can reject — the modeled
+        # fault is out-of-domain garbage (bad DMA / stuck lanes), which
+        # the guardrails are built to catch
+        c = obj.copy()
+        leaves.append((None, c))
+        return c
+    return obj
+
+
+def parse_fault_spec(spec: str) -> FaultPlan:
+    """Parse an ``--inject-faults`` spec string (see module docstring).
+    Raises ValueError on malformed input."""
+    plan = FaultPlan()
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if "=" not in item:
+            raise ValueError(f"fault spec item without '=': {item!r}")
+        key, val = item.split("=", 1)
+        key = key.strip()
+        val = val.strip()
+        try:
+            if key == "seed":
+                plan.seed = int(val)
+            elif key == "rate":
+                plan.rate = float(val)
+                if not 0.0 <= plan.rate <= 1.0:
+                    raise ValueError
+            elif key == "kinds":
+                kinds = tuple(k for k in val.split("+") if k)
+                bad = [k for k in kinds if k not in KINDS]
+                if bad or not kinds:
+                    raise ValueError
+                plan.kinds = kinds
+            elif key == "sites":
+                plan.sites = frozenset(s for s in val.split("+") if s)
+            elif key == "hang_s":
+                plan.hang_s = float(val)
+                if plan.hang_s < 0:
+                    raise ValueError
+            elif key == "kill":
+                plan.kill = int(val)
+                if plan.kill < 0:
+                    raise ValueError
+            else:
+                raise ValueError
+        except ValueError:
+            raise ValueError(f"bad fault spec item: {item!r} "
+                             f"(keys: seed rate kinds sites hang_s kill)")
+    return plan
+
+
+def plan_from_env() -> FaultPlan | None:
+    """The env-armed plan (``PWASM_INJECT_FAULTS``), for subprocesses
+    that never see the CLI flag; None when unset/empty.  A malformed
+    env spec raises — a debug knob that silently disarms would be worse
+    than a crash."""
+    spec = os.environ.get("PWASM_INJECT_FAULTS", "")
+    return parse_fault_spec(spec) if spec else None

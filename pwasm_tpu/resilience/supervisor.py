@@ -1,0 +1,283 @@
+"""Supervised execution of device batches.
+
+The CLI's device pipeline (ctx_scan report batches, --realign DP
+dispatches, the MSA consensus launch, the many2many scorer) routes
+every device round-trip through :meth:`BatchSupervisor.run`, which
+adds the failure handling a long batch run needs and the reference's
+fail-fast model lacks (SURVEY.md §2.5.12 vs §5):
+
+- bounded **retry** with exponential backoff + jitter — transient
+  device faults re-execute instead of killing the run;
+- a per-attempt **deadline** (``--device-deadline``) — a hung tunnel
+  costs one timeout, not an indefinite stall (the attempt runs in a
+  worker thread that is abandoned on timeout, the only portable way to
+  walk away from a hung XLA call);
+- **guardrail validation** — out-of-domain output counts as a fault
+  and is re-executed, never formatted;
+- a **circuit breaker** — after N *consecutive* failures the device is
+  declared unhealthy (one bounded ``probe_backend`` check supplies the
+  diagnostic), and every later call degrades straight to its host
+  fallback without touching the device again.  A healthy probe
+  half-opens the breaker instead: the failures were computational, not
+  a dead backend, so device attempts continue;
+- the degradation **policy**: ``--fallback=cpu`` (default) runs the
+  bit-exact host path, ``--fallback=fail`` aborts the run loudly with
+  a :class:`ResilienceError` — for pipelines where silent CPU walls
+  are worse than a dead job.
+
+Every decision increments a counter on the shared ``RunStats`` and
+surfaces in the ``--stats`` JSON ``resilience`` block.
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+import threading
+import time
+from dataclasses import dataclass
+
+from pwasm_tpu.core.errors import PwasmError
+from pwasm_tpu.resilience.faults import FaultPlan
+from pwasm_tpu.resilience.guardrails import GuardrailViolation
+
+
+class DeadlineExceeded(Exception):
+    """A supervised attempt outlived the per-batch deadline."""
+
+
+class DeviceWorkFailed(Exception):
+    """Retries exhausted (or breaker open) and the caller owns the
+    degradation — raised only under ``fallback=cpu`` when ``run`` was
+    given no fallback callable.  Carries the last underlying error as
+    ``__cause__``."""
+
+
+class ResilienceError(PwasmError):
+    """Fatal under ``--fallback=fail``: device work failed after the
+    bounded retries and the policy forbids degrading to the host."""
+
+
+@dataclass
+class ResiliencePolicy:
+    max_retries: int = 2          # extra attempts after the first
+    backoff_s: float = 0.05       # first retry delay
+    backoff_cap_s: float = 2.0    # ceiling for the exponential delay
+    jitter: float = 0.5           # +[0, jitter) fraction of the delay
+    deadline_s: float | None = None  # per-attempt wall ceiling
+    fallback: str = "cpu"         # cpu = degrade to host; fail = abort
+    breaker_threshold: int = 5    # consecutive failures to trip
+
+
+class BatchSupervisor:
+    """One per run, shared by every supervised site (the breaker state
+    is global on purpose: a dead backend fails every site).
+
+    ``stats`` is the run's ``RunStats`` (resilience counters optional —
+    missing attributes are ignored so the class also works bare).
+    ``faults`` arms deterministic fault injection (``FaultPlan``).
+    ``probe`` overrides the breaker's backend health check (tests)."""
+
+    def __init__(self, policy: ResiliencePolicy | None = None,
+                 stats=None, stderr=None, faults: FaultPlan | None = None,
+                 probe=None):
+        self.policy = policy or ResiliencePolicy()
+        self.stats = stats
+        self.stderr = stderr if stderr is not None else sys.stderr
+        self.faults = faults
+        self._probe = probe
+        self._consecutive = 0
+        self.breaker_open = False
+        # jitter exists to de-synchronize retry storms across the many
+        # processes of a batch fleet, so it must be seeded per process
+        # (a fixed seed would make every process retry at the same
+        # instants — the exact storm jitter is meant to break).  It
+        # only perturbs sleep times, never results.
+        import os
+        self._rng = random.Random(os.getpid() ^ int(time.time() * 1e3))
+
+    # ---- counters ------------------------------------------------------
+    def _count(self, name: str, n: int = 1) -> None:
+        if self.stats is not None and hasattr(self.stats, name):
+            setattr(self.stats, name, getattr(self.stats, name) + n)
+
+    def _warn(self, msg: str) -> None:
+        print(f"pwasm: {msg}", file=self.stderr)
+
+    # ---- the supervised call -------------------------------------------
+    def run(self, site: str, attempt, validate=None, fallback=None):
+        """Execute ``attempt()`` under the policy and return its
+        (validated) result.
+
+        ``validate(result)`` raises ``GuardrailViolation`` to reject
+        output; rejection counts as a device fault and re-executes.
+        ``fallback()`` is the bit-exact host path used when the device
+        is given up on (``fallback=cpu`` policy); without one, gives up
+        by raising :class:`DeviceWorkFailed` so the caller can degrade.
+        Under ``--fallback=fail`` exhaustion raises
+        :class:`ResilienceError` instead (fatal)."""
+        if self.breaker_open:
+            return self._degrade(site, fallback, "circuit breaker open",
+                                 None)
+        delay = self.policy.backoff_s
+        last: BaseException | None = None
+        for k in range(self.policy.max_retries + 1):
+            if k:
+                self._count("res_retries")
+                time.sleep(min(delay * (1 + self.policy.jitter
+                                        * self._rng.random()),
+                               self.policy.backoff_cap_s))
+                delay *= 2
+            try:
+                result = self._attempt_once(site, attempt)
+                if validate is not None:
+                    validate(result)
+                self._consecutive = 0
+                return result
+            except GuardrailViolation as e:
+                self._count("res_guardrail_rejects")
+                self._warn(f"{site}: device output rejected by "
+                           f"guardrail ({e}); re-executing")
+                last = e
+            except DeadlineExceeded as e:
+                self._count("res_deadline_timeouts")
+                last = e
+            except Exception as e:
+                last = e
+            if self._note_failure(site, last):
+                break   # breaker opened: stop burning retries
+        return self._degrade(site, fallback, _detail(last), last)
+
+    def _attempt_once(self, site: str, attempt):
+        plan = self.faults
+
+        def body():
+            if plan is None:
+                return attempt()
+            kind = plan.draw(site)       # may raise InjectedKill
+            if kind is not None:
+                self._count("res_injected_faults")
+            if kind == "raise":
+                from pwasm_tpu.resilience.faults import InjectedFault
+                raise InjectedFault(f"injected device fault at {site}")
+            if kind == "hang":
+                time.sleep(plan.hang_s)
+            res = attempt()
+            if kind in ("nan", "corrupt"):
+                res = plan.corrupt(res, site, kind)
+            return res
+
+        deadline = self.policy.deadline_s
+        if deadline is None:
+            return body()
+        # a hand-rolled DAEMON thread, not a ThreadPoolExecutor: pool
+        # workers are non-daemon and joined by an atexit hook, so a
+        # genuinely hung XLA call would still block interpreter exit —
+        # exactly the stall the deadline exists to walk away from
+        box: dict = {}
+
+        def runner():
+            try:
+                box["ok"] = body()
+            except BaseException as e:
+                box["err"] = e
+
+        t = threading.Thread(target=runner, daemon=True,
+                             name=f"pwasm-{site}")
+        t.start()
+        t.join(deadline)
+        if t.is_alive():
+            raise DeadlineExceeded(
+                f"{site}: batch exceeded the {deadline:g}s device "
+                f"deadline") from None
+        if "err" in box:
+            raise box["err"]
+        return box["ok"]
+
+    # ---- failure accounting / breaker ----------------------------------
+    def _note_failure(self, site: str, err: BaseException) -> bool:
+        """Record one failed attempt; returns True when the breaker
+        just opened (stop retrying)."""
+        self._consecutive += 1
+        if self.breaker_open \
+                or self._consecutive < self.policy.breaker_threshold:
+            return False
+        ok, why = self._probe_backend()
+        if ok:
+            # backend is reachable: the failures are computational
+            # (bad batch, guardrail rejects) — half-open and keep
+            # attempting rather than walling off a healthy device
+            self._consecutive = 0
+            self._warn(f"{site}: {self._consecutive_msg()} but the "
+                       "backend probes healthy; breaker half-open")
+            return False
+        self.breaker_open = True
+        # counted only when the breaker actually OPENS — a healthy-probe
+        # half-open above is not a trip, and operators alert on this
+        self._count("res_breaker_trips")
+        self._warn(f"{site}: {self._consecutive_msg()}; backend probe "
+                   f"says: {why.strip() or 'unreachable'} — circuit "
+                   "breaker OPEN, degrading device work to the host "
+                   "path for the rest of the run")
+        return True
+
+    def _consecutive_msg(self) -> str:
+        return (f"{self.policy.breaker_threshold} consecutive device "
+                "failures")
+
+    def _probe_backend(self) -> tuple[bool, str]:
+        if self._probe is not None:
+            return self._probe()
+        # a REAL bounded subprocess probe, not device_backend_reachable:
+        # that gate short-circuits to healthy whenever jax is already
+        # initialized in-process (always true by the time a mid-run
+        # batch fails) and serves TTL-cached verdicts — either would
+        # report a freshly-dead tunnel as healthy and the breaker could
+        # never open
+        import os
+        if os.environ.get("PWASM_DEVICE_PROBE", "1") == "0":
+            # probing disabled: treat the backend as healthy, so the
+            # breaker only half-opens (same opt-out contract as the
+            # CLI's startup gate)
+            return True, ""
+        from pwasm_tpu.utils.backend import probe_backend
+        try:
+            timeout = float(os.environ.get(
+                "PWASM_DEVICE_PROBE_TIMEOUT", "150"))
+        except ValueError:
+            timeout = 150.0
+        platform, why = probe_backend(dict(os.environ), timeout)
+        return platform is not None, why
+
+    def note_degraded(self, site: str, detail: str) -> None:
+        """Record a CALLER-owned degradation — the ``DeviceWorkFailed``
+        path, where the host fallback lives at the call site (e.g. the
+        realign host oracle, the refine host phases).  Keeps the
+        observability contract: every degradation counts toward
+        ``res_fallbacks`` and leaves one stderr line, whichever side
+        executes the fallback."""
+        self._count("res_fallbacks")
+        self._warn(f"{site}: {detail}")
+
+    # ---- degradation ----------------------------------------------------
+    def _degrade(self, site: str, fallback, reason: str,
+                 err: BaseException | None):
+        if self.policy.fallback == "fail":
+            raise ResilienceError(
+                f"Error: device work '{site}' failed and --fallback="
+                f"fail forbids degrading ({reason})\n") from err
+        if fallback is not None:
+            self._count("res_fallbacks")
+            self._warn(f"{site}: degrading batch to the host path "
+                       f"({reason})")
+            return fallback()
+        # no fallback callable: the caller owns (and counts) the
+        # degradation — see e.g. device_report.scalar_replay
+        raise DeviceWorkFailed(f"{site}: {reason}") from err
+
+
+def _detail(e: BaseException | None) -> str:
+    if e is None:
+        return "no attempt made"
+    from pwasm_tpu.utils import exc_detail
+    return exc_detail(e)

@@ -68,6 +68,12 @@ def _success_marker() -> str | None:
         st = os.lstat(d)
         if not _stat.S_ISDIR(st.st_mode) or st.st_uid != _marker_uid():
             return None     # squatted by another user: no cache
+        if st.st_mode & 0o077:
+            # makedirs(mode=0o700) does NOT tighten a pre-existing
+            # directory: one we own but with group/world bits set (an
+            # old or foreign-created dir) would leak the trust the 0700
+            # design assumes — tighten it, or refuse the cache
+            os.chmod(d, 0o700)
     except OSError:
         return None
     key = "|".join(os.environ.get(k, "") for k in

@@ -1,0 +1,46 @@
+"""jax API compatibility shims.
+
+The container images this repo runs in pin different jax releases, and
+the ``shard_map`` surface moved twice across them: jax < 0.6 ships it as
+``jax.experimental.shard_map.shard_map`` with a ``check_rep`` flag,
+newer releases export it at top level with the flag renamed
+``check_vma``.  Every sharded entry point in the repo imports the
+wrapper below instead of touching either surface directly, so a jax
+pin change degrades nothing (the baseline container, jax 0.4.37, lost
+every ``parallel/`` test to this import before the shim existed).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+              check_vma: bool | None = None, **kw: Any):
+    """``jax.shard_map`` on new jax, ``jax.experimental.shard_map`` on
+    old — with ``check_vma`` translated to the old ``check_rep`` flag
+    (same meaning: verify the per-device replication/varying-axes
+    analysis; both callers here disable it for collective-free blocks
+    whose constant carries the checker rejects)."""
+    try:
+        from jax import shard_map as _sm  # jax >= 0.6
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               **kw)
+
+
+def pcast(x, axis_name, to: str = "varying"):
+    """``jax.lax.pcast`` where it exists (the explicit varying-axes
+    annotation newer shard_map type checking wants), identity on old
+    jax — whose ``check_rep`` analysis needs no annotation for values
+    that are about to vary per device."""
+    import jax
+
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_name, to=to)
+    return x

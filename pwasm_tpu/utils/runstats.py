@@ -39,6 +39,16 @@ class RunStats:
         #                           the MSA (bad gap structure)
         self.engine_fallbacks = 0  # engine-level device/native demotions
         #                            inside the MSA consensus path
+        # resilience counters (pwasm_tpu.resilience.supervisor): the
+        # supervised device pipeline's decisions, reported as one
+        # nested "resilience" block in the JSON
+        self.res_retries = 0           # re-executed device attempts
+        self.res_fallbacks = 0         # batches degraded to the host
+        self.res_guardrail_rejects = 0  # outputs rejected as corrupt
+        self.res_deadline_timeouts = 0  # attempts past --device-deadline
+        self.res_breaker_trips = 0     # circuit-breaker threshold hits
+        self.res_injected_faults = 0   # faults injected (--inject-faults)
+        self.res_checkpoints = 0       # durable batch checkpoints written
 
     @property
     def wall_s(self) -> float:
@@ -66,6 +76,15 @@ class RunStats:
             "realigned": self.realigned,
             "msa_dropped": self.msa_dropped,
             "engine_fallbacks": self.engine_fallbacks,
+            "resilience": {
+                "retries": self.res_retries,
+                "fallbacks": self.res_fallbacks,
+                "guardrail_rejects": self.res_guardrail_rejects,
+                "deadline_timeouts": self.res_deadline_timeouts,
+                "breaker_trips": self.res_breaker_trips,
+                "injected_faults": self.res_injected_faults,
+                "checkpoints": self.res_checkpoints,
+            },
             "wall_s": round(self.wall_s, 3),
             "aligned_bases_per_s": round(self.rate(), 1),
         }

@@ -8,6 +8,9 @@ import pytest
 
 import pwasm_tpu.utils.backend as B
 
+# captured before the autouse fixture below swaps it out per test
+_REAL_SUCCESS_MARKER = B._success_marker
+
 
 @pytest.fixture(autouse=True)
 def _fresh(monkeypatch, tmp_path):
@@ -150,3 +153,28 @@ def test_untrusted_marker_is_removed_so_cache_recovers(monkeypatch,
     monkeypatch.setattr(B, "_probe_cache", None)
     assert B.device_backend_reachable() == (True, "")
     assert len(calls) == 1
+
+
+def test_marker_dir_mode_is_tightened(monkeypatch, tmp_path):
+    """ADVICE round 5: ``makedirs(mode=0o700)`` does not tighten a
+    PRE-EXISTING marker directory — a group/world-accessible dir we own
+    must be chmod'd back to 0700 (or the cache refused) before any
+    marker inside it is trusted."""
+    import stat as _stat
+    import tempfile
+
+    monkeypatch.setattr(tempfile, "gettempdir", lambda: str(tmp_path))
+    d = tmp_path / f"pwasm_probe_{B._marker_uid()}"
+    d.mkdir(mode=0o777)
+    os.chmod(d, 0o775)          # pre-existing loose dir (umask-proof)
+    marker = _REAL_SUCCESS_MARKER()
+    assert marker is not None
+    mode = os.lstat(d).st_mode
+    assert _stat.S_IMODE(mode) == 0o700
+
+    # chmod failure → the cache is refused, not trusted loose
+    os.chmod(d, 0o775)
+    monkeypatch.setattr(B.os, "chmod",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            OSError("nope")))
+    assert _REAL_SUCCESS_MARKER() is None

@@ -81,6 +81,26 @@ def test_scatter_rejects_mismatched_rows():
         scatter_results([b], [np.zeros(b.data.shape[0] + 1)], 1)
 
 
+def test_scatter_empty_buckets_preserves_shape_and_dtype():
+    """ADVICE round 5: the empty-buckets fallback must agree with the
+    non-empty calls' trailing dimensions and dtype instead of handing
+    back a 1-D default-dtype array."""
+    out = scatter_results([], [], 3, fill=-1, trailing_shape=(4, 2),
+                          dtype=np.int32)
+    assert out.shape == (3, 4, 2)
+    assert out.dtype == np.int32
+    assert (out == -1).all()
+    # the defaults keep the old 1-D call shape for scalar-row results
+    out = scatter_results([], [], 2, fill=0, dtype=np.int64)
+    assert out.shape == (2,) and out.dtype == np.int64
+    # and a non-empty call still derives everything from per_bucket
+    b = bucket_targets([b"ACGT", b"AAAA"])[0]
+    r = np.ones((b.data.shape[0], 5), dtype=np.int16)
+    out = scatter_results([b], [r], 2, trailing_shape=(9,),
+                          dtype=np.float64)   # ignored: results exist
+    assert out.shape == (2, 5) and out.dtype == np.int16
+
+
 def test_many2many_ragged_matches_pairwise():
     """Ragged wrapper == per-pair banded_score over every (q, t)."""
     import jax.numpy as jnp

@@ -145,7 +145,7 @@ def test_device_consensus_matches_cpu_engine(seed):
 # ---------------------------------------------------------------------------
 def test_depth_sharded_consensus_psum():
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
+    from pwasm_tpu.utils.jaxcompat import shard_map
 
     devs = jax.devices()
     assert len(devs) >= 4, "conftest must provide 8 virtual devices"

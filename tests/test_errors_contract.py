@@ -1,0 +1,111 @@
+"""The error model's exit-code contract (core/errors.py) and the
+malformed-input paths the CLI promises to survive.
+
+The reference fails fast with distinct exit codes (SURVEY.md §2.5.12):
+usage/fatal = 1, the declared-but-never-raised parse path = 3, a
+zero-coverage MSA column = 5.  These tests pin the documented contract
+and the --skip-bad-lines behavior on truncated/garbage PAF input.
+"""
+
+import io
+import json
+
+import pytest
+
+from pwasm_tpu.cli import CliError, run
+from pwasm_tpu.core.errors import (EXIT_FATAL, EXIT_PARSE, EXIT_USAGE,
+                                   EXIT_ZERO_COVERAGE, ParseError,
+                                   PwasmError, ZeroCoverageError)
+from pwasm_tpu.core.fasta import write_fasta
+
+from helpers import make_paf_line
+
+Q = "ACGTACGTACGTACGTACGT"
+
+
+def test_exit_code_constants():
+    assert EXIT_USAGE == 1
+    assert EXIT_FATAL == 1
+    assert EXIT_PARSE == 3
+    assert EXIT_ZERO_COVERAGE == 5
+
+
+def test_exception_exit_codes():
+    assert PwasmError("x").exit_code == 1
+    assert PwasmError("x", exit_code=7).exit_code == 7
+    assert ParseError("x").exit_code == 3
+    assert ZeroCoverageError("x").exit_code == 5
+    assert CliError("x").exit_code == 1
+    # the class hierarchy: both special codes remain PwasmErrors, so
+    # the CLI's single except clause routes them to sys.exit
+    assert issubclass(ParseError, PwasmError)
+    assert issubclass(ZeroCoverageError, PwasmError)
+
+
+def _inputs(tmp_path, lines):
+    fa = tmp_path / "q.fa"
+    write_fasta(str(fa), [("q", Q.encode())])
+    paf = tmp_path / "in.paf"
+    paf.write_text("".join(ln + "\n" for ln in lines))
+    return str(paf), str(fa)
+
+
+def _bad_lines():
+    good1, _ = make_paf_line("q", Q, "a1", "+", [("=", len(Q))])
+    good2, _ = make_paf_line("q", Q, "a2", "+",
+                             [("=", 4), ("ins", "tt"), ("=", 16)])
+    truncated = "\t".join(good1.split("\t")[:6])    # cut mid-record
+    garbage = "\x00\xff not a paf line at all"
+    nocs = "\t".join(good1.split("\t")[:12])        # no cg/cs tags
+    return good1, good2, truncated, garbage, nocs
+
+
+def test_malformed_line_is_fatal_without_skip(tmp_path):
+    good1, _good2, truncated, _g, _n = _bad_lines()
+    paf, fa = _inputs(tmp_path, [good1, truncated])
+    err = io.StringIO()
+    rc = run([paf, "-r", fa], stdout=io.StringIO(), stderr=err)
+    assert rc == EXIT_FATAL == 1
+
+
+def test_skip_bad_lines_survives_truncated_and_garbage(tmp_path):
+    good1, good2, truncated, garbage, nocs = _bad_lines()
+    paf, fa = _inputs(tmp_path,
+                      [truncated, good1, garbage, nocs, good2])
+    out = io.StringIO()
+    err = io.StringIO()
+    stats = tmp_path / "s.json"
+    rc = run([paf, "-r", fa, "--skip-bad-lines", f"--stats={stats}"],
+             stdout=out, stderr=err)
+    assert rc == 0
+    body = out.getvalue()
+    assert ">a1" in body and ">a2" in body
+    warnings = [ln for ln in err.getvalue().splitlines()
+                if "skipping malformed PAF line" in ln]
+    assert len(warnings) == 3
+    d = json.loads(stats.read_text())
+    assert d["skipped_bad_lines"] == 3
+    assert d["alignments"] == 2
+
+
+def test_fatal_errors_report_exit_code_through_run(tmp_path):
+    good1, *_ = _bad_lines()
+    paf, fa = _inputs(tmp_path, [good1])
+    # usage error → 1
+    assert run([paf, "-r", fa, "-G", "-F"],
+               stderr=io.StringIO()) == EXIT_USAGE
+    # fatal error (bad -c) → 1
+    assert run([paf, "-r", fa, "-c", "0"],
+               stderr=io.StringIO()) == EXIT_FATAL
+    # a PwasmError subclass carries its own exit code out of run():
+    # the zero-coverage analog the library reserves exit 5 for
+    with pytest.raises(SystemExit) as ei:
+        import pwasm_tpu.cli as cli
+        orig = cli.run
+        try:
+            cli.run = lambda argv: (_ for _ in ()).throw(
+                ZeroCoverageError("zero-coverage column"))
+            cli.main()
+        finally:
+            cli.run = orig
+    assert ei.value.code == EXIT_ZERO_COVERAGE == 5

@@ -1,0 +1,491 @@
+"""Fault-injected resilient device execution (pwasm_tpu.resilience).
+
+The acceptance contract (ISSUE 1): with a seeded ~30%+ device-fault
+rate (raise/NaN/corrupt mix) injected into a CPU-backend device CLI
+run, the run completes with byte-identical -o/-w output vs the
+fault-free run and nonzero retry/fallback/guardrail counters in the
+--stats JSON; a run killed mid-batch resumes from the checkpoint
+without duplicating report lines.
+"""
+
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pwasm_tpu.cli import run
+from pwasm_tpu.core.fasta import write_fasta
+from pwasm_tpu.resilience import (BatchSupervisor, DeviceWorkFailed,
+                                  GuardrailViolation, InjectedKill,
+                                  ResilienceError, ResiliencePolicy,
+                                  parse_fault_spec)
+from pwasm_tpu.utils.runstats import RunStats
+
+from helpers import make_paf_line
+
+
+# ---------------------------------------------------------------------------
+# fault plan: spec parsing + determinism
+# ---------------------------------------------------------------------------
+def test_fault_spec_parsing():
+    p = parse_fault_spec("seed=7,rate=0.3,kinds=raise+corrupt,"
+                         "sites=ctx_scan+realign,hang_s=1.5,kill=9")
+    assert p.seed == 7 and p.rate == 0.3
+    assert p.kinds == ("raise", "corrupt")
+    assert p.sites == frozenset({"ctx_scan", "realign"})
+    assert p.hang_s == 1.5 and p.kill == 9
+    # defaults
+    d = parse_fault_spec("rate=1")
+    assert d.seed == 0 and len(d.kinds) == 4 and d.sites is None
+
+
+@pytest.mark.parametrize("bad", ["rate=2", "rate=x", "kinds=explode",
+                                 "kinds=", "nonsense", "seed=1.5",
+                                 "hang_s=-1", "kill=-2", "frob=1"])
+def test_fault_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_fault_spec(bad)
+
+
+def test_fault_draws_deterministic_and_seeded():
+    a = parse_fault_spec("seed=3,rate=0.5")
+    b = parse_fault_spec("seed=3,rate=0.5")
+    c = parse_fault_spec("seed=4,rate=0.5")
+    seq_a = [a.draw("s") for _ in range(40)]
+    assert seq_a == [b.draw("s") for _ in range(40)]
+    assert seq_a != [c.draw("s") for _ in range(40)]
+    assert any(seq_a), "a 50% rate must inject within 40 draws"
+    # sites= restricts injection but still advances counters
+    r = parse_fault_spec("seed=3,rate=1,sites=other")
+    assert [r.draw("s") for _ in range(5)] == [None] * 5
+
+
+def test_fault_kill_is_uncatchable_by_supervisor():
+    plan = parse_fault_spec("kill=3")
+    sup = BatchSupervisor(ResiliencePolicy(max_retries=5,
+                                           backoff_s=0.001),
+                          faults=plan)
+    sup.run("s", lambda: 1)
+    sup.run("s", lambda: 2)
+    with pytest.raises(InjectedKill):
+        sup.run("s", lambda: 3)
+
+
+# ---------------------------------------------------------------------------
+# supervisor: retry / deadline / breaker / policy
+# ---------------------------------------------------------------------------
+def _policy(**kw):
+    kw.setdefault("backoff_s", 0.001)
+    kw.setdefault("backoff_cap_s", 0.002)
+    return ResiliencePolicy(**kw)
+
+
+def test_supervisor_retries_then_succeeds():
+    st = RunStats()
+    calls = []
+    sup = BatchSupervisor(_policy(max_retries=3), stats=st)
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert sup.run("s", flaky) == "ok"
+    assert st.res_retries == 2 and st.res_fallbacks == 0
+    assert sup._consecutive == 0   # success resets the breaker window
+
+
+def test_supervisor_guardrail_reject_reexecutes():
+    st = RunStats()
+    sup = BatchSupervisor(_policy(max_retries=2), stats=st,
+                          stderr=io.StringIO())
+    results = iter([np.array([99]), np.array([1])])
+
+    def validate(r):
+        if r[0] > 10:
+            raise GuardrailViolation("out of range")
+
+    out = sup.run("s", lambda: next(results), validate=validate)
+    assert out[0] == 1
+    assert st.res_guardrail_rejects == 1 and st.res_retries == 1
+
+
+def test_supervisor_deadline_timeout():
+    import time
+
+    st = RunStats()
+    sup = BatchSupervisor(_policy(max_retries=1, deadline_s=0.05),
+                          stats=st, stderr=io.StringIO())
+    with pytest.raises(DeviceWorkFailed):
+        sup.run("s", lambda: time.sleep(0.5))
+    assert st.res_deadline_timeouts == 2   # initial attempt + 1 retry
+    # the host fallback is used when provided
+    st2 = RunStats()
+    sup2 = BatchSupervisor(_policy(max_retries=0, deadline_s=0.05),
+                           stats=st2, stderr=io.StringIO())
+    got = sup2.run("s", lambda: time.sleep(0.5), fallback=lambda: "host")
+    assert got == "host" and st2.res_fallbacks == 1
+
+
+def test_supervisor_breaker_opens_on_unhealthy_probe():
+    st = RunStats()
+    sup = BatchSupervisor(_policy(max_retries=0, breaker_threshold=3),
+                          stats=st, stderr=io.StringIO(),
+                          probe=lambda: (False, "tunnel down"))
+    calls = []
+
+    def dead():
+        calls.append(1)
+        raise RuntimeError("boom")
+
+    for _ in range(3):
+        with pytest.raises(DeviceWorkFailed):
+            sup.run("s", dead)
+    assert sup.breaker_open and st.res_breaker_trips == 1
+    n = len(calls)
+    # breaker open: the device is never touched again
+    assert sup.run("s", dead, fallback=lambda: "host") == "host"
+    assert len(calls) == n
+    assert st.res_fallbacks >= 1
+
+
+def test_supervisor_breaker_half_opens_on_healthy_probe():
+    st = RunStats()
+    sup = BatchSupervisor(_policy(max_retries=0, breaker_threshold=2),
+                          stats=st, stderr=io.StringIO(),
+                          probe=lambda: (True, ""))
+    for _ in range(2):
+        with pytest.raises(DeviceWorkFailed):
+            sup.run("s", lambda: (_ for _ in ()).throw(
+                RuntimeError("computational")))
+    # healthy probe: breaker half-opens instead of walling off a
+    # healthy device — attempts continue, and a half-open is NOT a
+    # trip (operators alert on the trip counter)
+    assert not sup.breaker_open
+    assert st.res_breaker_trips == 0
+    assert sup.run("s", lambda: "fine") == "fine"
+
+
+def test_supervisor_fallback_fail_policy_is_fatal():
+    sup = BatchSupervisor(_policy(max_retries=0, fallback="fail"),
+                          stderr=io.StringIO())
+    with pytest.raises(ResilienceError) as ei:
+        sup.run("s", lambda: (_ for _ in ()).throw(RuntimeError("x")),
+                fallback=lambda: "host")   # policy beats the fallback
+    assert ei.value.exit_code == 1
+
+
+# ---------------------------------------------------------------------------
+# guardrails: domain checks + conservation laws
+# ---------------------------------------------------------------------------
+def test_guardrail_consensus_conservation():
+    from pwasm_tpu.resilience.guardrails import check_consensus
+
+    rng = np.random.default_rng(0)
+    pile = rng.integers(0, 7, (16, 64)).astype(np.int8)
+    counts = np.stack([(pile == k).sum(0) for k in range(6)],
+                      axis=1).astype(np.int32)
+    chars = np.full(64, ord("A"), dtype=np.int64)
+    check_consensus(chars, counts, pile)     # clean passes
+    bad = counts.copy()
+    bad[5, 2] += 1                           # breaks conservation
+    with pytest.raises(GuardrailViolation):
+        check_consensus(chars, bad, pile)
+    weird = chars.copy()
+    weird[0] = ord("Z")                      # outside the alphabet
+    with pytest.raises(GuardrailViolation):
+        check_consensus(weird, counts, pile)
+
+
+def test_guardrail_realign_conservation():
+    from pwasm_tpu.ops.realign import banded_realign_rows
+    from pwasm_tpu.resilience.guardrails import check_realign
+
+    rng = np.random.default_rng(1)
+    q = rng.integers(0, 4, 24).astype(np.int8)
+    t = rng.integers(0, 4, 24).astype(np.int8)
+    qs, ts = q[None, :], t[None, :]
+    q_lens = np.array([24], dtype=np.int32)
+    t_lens = np.array([24], dtype=np.int32)
+    res = tuple(np.asarray(x) for x in banded_realign_rows(
+        qs, ts, q_lens, t_lens, band=8))
+    check_realign(*res, q_lens=q_lens, t_lens=t_lens, match_score=1)
+    scores, leads, iy, ops, ok = [x.copy() for x in res]
+    iy[0, 3] += 2                            # fake target consumption
+    with pytest.raises(GuardrailViolation):
+        check_realign(scores, leads, iy, ops, ok, q_lens=q_lens,
+                      t_lens=t_lens, match_score=1)
+
+
+def test_corrupted_outputs_always_caught_or_harmless():
+    """Every corrupt/nan injection into a ctx_scan-shaped output dict is
+    either rejected by the guardrail or lands outside the live rows the
+    report reads — the no-silent-corruption property."""
+    from pwasm_tpu.resilience.guardrails import check_ctx_scan
+
+    n_events, pad = 4, 64
+    host = {
+        "aa": np.full(pad, ord("M"), dtype=np.uint8),
+        "aapos": np.arange(pad, dtype=np.int32) % 7,
+        "hpoly": np.zeros(pad, dtype=bool),
+        "motif": np.ones(pad, dtype=np.int32),
+        "stop_aapos": np.full(pad, -1, dtype=np.int32),
+        "s_aapos": np.zeros((pad, 3), dtype=np.int32),
+    }
+    check_ctx_scan(host, n_events, ref_len=30, n_motifs=4,
+                   skip_codan=False)
+    caught = harmless = 0
+    for seed in range(30):
+        plan = parse_fault_spec(f"seed={seed},rate=1,kinds=corrupt")
+        bad = plan.corrupt({k: v.copy() for k, v in host.items()},
+                           "ctx_scan", "corrupt")
+        changed = any((bad[k] != host[k]).any() for k in host)
+        assert changed, "corrupt() must modify some array"
+        try:
+            check_ctx_scan(bad, n_events, ref_len=30, n_motifs=4,
+                           skip_codan=False)
+            # passed validation: the live prefix must be untouched
+            for k in host:
+                assert (np.asarray(bad[k])[:n_events]
+                        == host[k][:n_events]).all(), k
+            harmless += 1
+        except GuardrailViolation:
+            caught += 1
+    assert caught > 0
+
+
+# ---------------------------------------------------------------------------
+# CLI end-to-end: the acceptance contract
+# ---------------------------------------------------------------------------
+def _corpus(tmp_path, n=24, qlen=120):
+    rng = np.random.default_rng(3)
+    q = "".join("ACGT"[i] for i in rng.integers(0, 4, qlen))
+    lines = []
+    for i in range(n):
+        cut = 10 + int(rng.integers(0, qlen - 40))
+        qb = q[cut]
+        tb = "ACGT"[("ACGT".index(qb) + 1) % 4]
+        ops = [("=", cut), ("*", tb, qb), ("=", 20), ("ins", "gg"),
+               ("=", qlen - cut - 21)]
+        lines.append(make_paf_line("q", q, f"asm{i}", "+", ops)[0])
+    fa = tmp_path / "q.fa"
+    write_fasta(str(fa), [("q", q.encode())])
+    paf = tmp_path / "in.paf"
+    paf.write_text("".join(ln + "\n" for ln in lines))
+    return str(paf), str(fa)
+
+
+def _cli(tmp_path, tag, extra, paf, fa):
+    err = io.StringIO()
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / f"{tag}.dfa"),
+              "-w", str(tmp_path / f"{tag}.mfa"), "--device=tpu",
+              "--batch=2", f"--stats={tmp_path / f'{tag}.json'}"]
+             + extra, stderr=err)
+    return rc, err.getvalue()
+
+
+def _outs(tmp_path, tag):
+    return ((tmp_path / f"{tag}.dfa").read_bytes(),
+            (tmp_path / f"{tag}.mfa").read_bytes())
+
+
+def test_fault_injected_run_byte_identical(tmp_path, monkeypatch):
+    """The acceptance gate: ~35% seeded raise/NaN/corrupt faults on the
+    CPU-backend device pipeline — byte-identical report and MSA, with
+    nonzero retries / fallbacks / guardrail_rejects / checkpoints in
+    the --stats resilience block."""
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path)
+    rc, _ = _cli(tmp_path, "ref", [], paf, fa)
+    assert rc == 0
+    rc, _ = _cli(tmp_path, "fi",
+                 ["--inject-faults=seed=2,rate=0.35,"
+                  "kinds=raise+nan+corrupt", "--max-retries=1"],
+                 paf, fa)
+    assert rc == 0
+    assert _outs(tmp_path, "fi") == _outs(tmp_path, "ref")
+    res = json.loads((tmp_path / "fi.json").read_text())["resilience"]
+    assert res["injected_faults"] > 0
+    assert res["retries"] > 0
+    assert res["fallbacks"] > 0
+    assert res["guardrail_rejects"] > 0
+    assert res["checkpoints"] > 0
+    # the clean run reports all-zero resilience counters
+    ref = json.loads((tmp_path / "ref.json").read_text())["resilience"]
+    assert ref["retries"] == ref["fallbacks"] == 0
+    assert ref["injected_faults"] == 0
+
+
+def test_fault_injected_hang_deadline_byte_identical(tmp_path,
+                                                     monkeypatch):
+    """The hang member of the fault mix: injected hangs outlive the
+    --device-deadline, cost one timeout each, and the retried batches
+    keep the output byte-identical."""
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path, n=16)
+    rc, _ = _cli(tmp_path, "ref", [], paf, fa)   # warms the jit cache
+    assert rc == 0
+    # post-warm attempts take ~10 ms, so a 1 s deadline only ever trips
+    # on the injected 3 s hangs (each drawn hang costs one deadline)
+    rc, _ = _cli(tmp_path, "hg",
+                 ["--device-deadline=1", "--max-retries=3",
+                  "--inject-faults=seed=4,rate=0.25,kinds=hang,"
+                  "hang_s=3"],
+                 paf, fa)
+    assert rc == 0
+    assert _outs(tmp_path, "hg") == _outs(tmp_path, "ref")
+    res = json.loads((tmp_path / "hg.json").read_text())["resilience"]
+    assert res["deadline_timeouts"] > 0
+    assert res["retries"] > 0
+
+
+def test_fault_injected_realign_byte_identical(tmp_path, monkeypatch):
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path, n=12)
+    rc, _ = _cli(tmp_path, "rref", ["--realign", "--batch=4"], paf, fa)
+    assert rc == 0
+    rc, _ = _cli(tmp_path, "rfi",
+                 ["--realign", "--batch=4", "--max-retries=2",
+                  "--inject-faults=seed=5,rate=0.4,"
+                  "kinds=raise+nan+corrupt"], paf, fa)
+    assert rc == 0
+    assert _outs(tmp_path, "rfi") == _outs(tmp_path, "rref")
+    res = json.loads((tmp_path / "rfi.json").read_text())["resilience"]
+    assert res["injected_faults"] > 0
+
+
+def test_kill_mid_batch_resumes_from_checkpoint(tmp_path, monkeypatch):
+    """A run killed mid-batch leaves an atomic <report>.ckpt; --resume
+    continues at the last completed batch: byte-identical final output,
+    no duplicated report lines, and no re-emission of checkpointed
+    records."""
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path)
+    rc, _ = _cli(tmp_path, "ref", [], paf, fa)
+    assert rc == 0
+    with pytest.raises(InjectedKill):
+        _cli(tmp_path, "k", ["--inject-faults=kill=8"], paf, fa)
+    ckpt = tmp_path / "k.dfa.ckpt"
+    assert ckpt.exists()
+    ck = json.loads(ckpt.read_text())
+    assert ck["records"] > 0
+    assert ck["bytes"] == os.path.getsize(tmp_path / "k.dfa")
+    rc, _ = _cli(tmp_path, "k", ["--resume"], paf, fa)
+    assert rc == 0
+    assert _outs(tmp_path, "k") == _outs(tmp_path, "ref")
+    headers = [ln for ln in (tmp_path / "k.dfa").read_text().splitlines()
+               if ln.startswith(">")]
+    assert len(headers) == len(set(headers)) == 24
+    stats = json.loads((tmp_path / "k.json").read_text())
+    assert stats["resumed_past"] == ck["records"]
+    assert not ckpt.exists()   # completed run retires its checkpoint
+
+
+def test_fallback_fail_aborts_the_run(tmp_path, monkeypatch):
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path, n=6)
+    err = io.StringIO()
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / "f.dfa"),
+              "--device=tpu", "--batch=2", "--fallback=fail",
+              "--max-retries=0",
+              "--inject-faults=seed=2,rate=1,kinds=raise"], stderr=err)
+    assert rc == 1
+    assert "--fallback=fail forbids degrading" in err.getvalue()
+
+
+def test_resilience_flag_validation(tmp_path):
+    paf, fa = _corpus(tmp_path, n=2)
+    for bad in (["--max-retries=x"], ["--max-retries"],
+                ["--device-deadline=0"], ["--device-deadline=x"],
+                ["--device-deadline=nan"], ["--device-deadline=inf"],
+                ["--fallback=maybe"], ["--inject-faults"],
+                ["--inject-faults=rate=9"]):
+        err = io.StringIO()
+        assert run([paf, "-r", fa] + bad, stderr=err) == 1, bad
+        assert "Invalid" in err.getvalue() or "requires" in err.getvalue()
+
+
+def test_realign_supervised_degrades_to_oracle_with_counters():
+    """Total device failure during supervised realign: every lane takes
+    the bit-exact host oracle, and the degradation is visible (counted
+    in res_fallbacks + warned) — not silent."""
+    from pwasm_tpu.ops.realign import realign_pairs
+
+    rng = np.random.default_rng(7)
+    pairs = []
+    for n in (20, 26, 31):
+        q = bytes("".join("ACGT"[i] for i in rng.integers(0, 4, n)),
+                  "ascii")
+        t = bytearray(q)
+        t[5] = ord("ACGT"["ACGT".index(chr(t[5])) - 1])
+        pairs.append((q, bytes(t)))
+    want = realign_pairs(pairs, band=8)
+    st = RunStats()
+    err = io.StringIO()
+    sup = BatchSupervisor(
+        _policy(max_retries=0), stats=st, stderr=err,
+        faults=parse_fault_spec("seed=1,rate=1,kinds=raise"))
+    got = realign_pairs(pairs, band=8, supervisor=sup)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert g is not None and w is not None
+        assert g[0] == w[0]
+        np.testing.assert_array_equal(g[1], w[1])
+    assert st.res_fallbacks > 0
+    assert "host oracle" in err.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# many2many: supervised TPU→CPU degradation
+# ---------------------------------------------------------------------------
+def test_many2many_supervised_cpu_degradation():
+    from pwasm_tpu.parallel.many2many import many2many_scores_ragged
+
+    rng = np.random.default_rng(2)
+    qs = ["".join("ACGT"[i] for i in rng.integers(0, 4, 40))
+          for _ in range(3)]
+    ts = ["".join("ACGT"[i] for i in rng.integers(0, 4, n))
+          for n in (30, 45, 60)]
+    want = many2many_scores_ragged(qs, ts, band=16)
+    st = RunStats()
+    # every first attempt raises; max_retries=0 → every bucket degrades
+    # through the supervisor's cpu fallback, and scores stay identical
+    plan = parse_fault_spec("seed=1,rate=1,kinds=raise")
+    sup = BatchSupervisor(_policy(max_retries=0), stats=st, faults=plan,
+                          stderr=io.StringIO())
+    got = many2many_scores_ragged(qs, ts, band=16, supervisor=sup)
+    np.testing.assert_array_equal(got, want)
+    assert st.res_fallbacks > 0 and st.res_injected_faults > 0
+
+
+def test_many2many_supervised_mesh_degrades_to_cpu_twin():
+    """A SHARDED many2many under total device failure degrades through
+    the mesh's CPU twin (cpu_like_mesh) — partitioning preserved, same
+    integers."""
+    import jax
+
+    from pwasm_tpu.parallel.many2many import (make_mesh2d,
+                                              many2many_scores_ragged)
+    from pwasm_tpu.parallel.mesh import cpu_like_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the virtual 8-device CPU mesh")
+    mesh = make_mesh2d(4)
+    assert cpu_like_mesh(mesh) is not None
+    rng = np.random.default_rng(5)
+    qs = ["".join("ACGT"[i] for i in rng.integers(0, 4, 32))
+          for _ in range(4)]
+    ts = ["".join("ACGT"[i] for i in rng.integers(0, 4, n))
+          for n in (20, 30, 40, 28)]
+    want = many2many_scores_ragged(qs, ts, band=16, mesh=mesh)
+    st = RunStats()
+    sup = BatchSupervisor(
+        _policy(max_retries=0), stats=st, stderr=io.StringIO(),
+        faults=parse_fault_spec("seed=1,rate=1,kinds=raise"))
+    got = many2many_scores_ragged(qs, ts, band=16, mesh=mesh,
+                                  supervisor=sup)
+    np.testing.assert_array_equal(got, want)
+    assert st.res_fallbacks > 0
