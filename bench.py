@@ -914,7 +914,19 @@ def cfg8_realistic_scale() -> int:
     MSA + consensus).  The native binary is the single-core reference;
     the Python CLI (host path, CPU-pinned child) is byte-parity-gated
     against it.  On a real TPU backend the --device=tpu wall is also
-    captured (unpinned child, same parity gate)."""
+    captured (unpinned child, same parity gate).
+
+    Additional legs (all CPU-pinned children, backend-agnostic):
+    - dispatch budget: a --device=tpu --stats run (cpu-jax backend)
+      emits ``realistic_device_flushes`` — the per-run device
+      round-trip count the single-digit budget gates;
+    - chaos: the same run under seeded --inject-faults must stay
+      byte-identical to the clean outputs (resilience at realistic
+      scale, ROADMAP PR-1 follow-up);
+    - host engines: a 1k-alignment report+summary corpus A/Bs the
+      vectorized columnar host engine against the scalar ground-truth
+      engine (PWASM_HOST_COLUMNAR=0) — ``realistic_host_report_1k_s``
+      with vs_baseline = scalar/columnar speedup."""
     import subprocess
     import tempfile
 
@@ -983,20 +995,99 @@ def cfg8_realistic_scale() -> int:
             if r.returncode != 0:
                 sys.stderr.write(r.stderr.decode()[:1000])
                 return _fail("realistic_pycli")
+        py_body = readset("py")
         if cli_bin is None:
             # no toolchain: a DISTINCT metric name — reusing
             # realistic_pycli_wall_s with vs_baseline=1.0 would let a
             # toolchain regression masquerade as a perfect-parity run
-            # in cross-round comparisons (ADVICE round 5)
-            return _emit("realistic_pycli_wall_noref_s", min(py_times),
-                         "s", 1.0, cpu_metric=True)
-        nat_body = readset("nat")
-        if readset("py") != nat_body:
-            return _fail("realistic_pycli_parity")
-        _emit("realistic_native_wall_s", min(nat_times), "s", 1.0,
-              cpu_metric=True)
-        _emit("realistic_pycli_wall_s", min(py_times), "s",
-              min(nat_times) / min(py_times), cpu_metric=True)
+            # in cross-round comparisons (ADVICE round 5).  The
+            # dispatch-budget / chaos / host-engine legs below don't
+            # need the native reference (they parity-check against the
+            # host run) and still run.
+            _emit("realistic_pycli_wall_noref_s", min(py_times),
+                  "s", 1.0, cpu_metric=True)
+            parity_body = py_body
+        else:
+            nat_body = readset("nat")
+            if py_body != nat_body:
+                return _fail("realistic_pycli_parity")
+            parity_body = nat_body
+            _emit("realistic_native_wall_s", min(nat_times), "s", 1.0,
+                  cpu_metric=True)
+            _emit("realistic_pycli_wall_s", min(py_times), "s",
+                  min(nat_times) / min(py_times), cpu_metric=True)
+
+        # --- dispatch budget + chaos (device pipeline on the pinned
+        # cpu-jax backend: dispatch counting and fault injection are
+        # backend-agnostic, and bytes must match the host run) -------
+        stats_p = os.path.join(d, "dev.stats")
+        r = subprocess.run(
+            cmd + args("devcpu", ["--device=tpu",
+                                  f"--stats={stats_p}"]),
+            env=env, capture_output=True)
+        if r.returncode != 0:
+            sys.stderr.write(r.stderr.decode()[:1000])
+            return _fail("realistic_devpath")
+        if readset("devcpu") != parity_body:
+            return _fail("realistic_devpath_parity")
+        with open(stats_p) as f:
+            dev_stats = json.load(f)["device"]
+        # the single-digit dispatch budget (VERDICT r5 item 3)
+        budget_ok = 0 < dev_stats["flushes"] <= 9
+        _emit("realistic_device_flushes", dev_stats["flushes"],
+              "flushes", 1.0 if budget_ok else 0.0, cpu_metric=True)
+        r = subprocess.run(
+            cmd + args("chaos", ["--device=tpu", "--batch=16",
+                                 "--max-retries=4",
+                                 "--inject-faults=seed=11,rate=0.4,"
+                                 "kinds=raise+nan+corrupt"]),
+            env=env, capture_output=True)
+        if r.returncode != 0:
+            sys.stderr.write(r.stderr.decode()[:1000])
+            return _fail("realistic_chaos")
+        if readset("chaos") != parity_body:
+            return _fail("realistic_chaos_parity")
+
+        # --- host engine A/B: 1k-alignment report+summary corpus ----
+        qseq1k, lines1k = make_corpus(n_aln=1000)
+        fa1k = os.path.join(d, "cds1k.fa")
+        paf1k = os.path.join(d, "in1k.paf")
+        with open(fa1k, "w") as f:
+            f.write(f">cds1\n{qseq1k}\n")
+        with open(paf1k, "w") as f:
+            f.write("".join(l + "\n" for l in lines1k))
+
+        def host_once(tag, columnar):
+            env_h = dict(env, PWASM_HOST_COLUMNAR="1" if columnar
+                         else "0")
+            o = [os.path.join(d, f"{tag}.dfa"),
+                 os.path.join(d, f"{tag}.sum")]
+            t0 = time.perf_counter()
+            r = subprocess.run(
+                cmd + [paf1k, "-r", fa1k, "-o", o[0], "-s", o[1]],
+                env=env_h, capture_output=True)
+            wall = time.perf_counter() - t0
+            if r.returncode != 0:
+                sys.stderr.write(r.stderr.decode()[:1000])
+                return None, None
+            return wall, b"".join(open(p, "rb").read() for p in o)
+        # interleave the engines so shared-box load drift biases both
+        # arms equally
+        col_walls, sca_walls = [], []
+        col_body = sca_body = None
+        for _ in range(4):
+            w, col_body = host_once("h1kcol", True)
+            if w is None:
+                return _fail("realistic_host_1k")
+            col_walls.append(w)
+            w, sca_body = host_once("h1ksca", False)
+            if w is None:
+                return _fail("realistic_host_1k")
+            sca_walls.append(w)
+        if col_body != sca_body:
+            return _fail("realistic_host_engine_parity")
+        _emit("realistic_host_report_1k_s", min(col_walls), "s",
+              min(sca_walls) / min(col_walls), cpu_metric=True)
         if on_tpu_backend():
             dev_env = dict(os.environ, PYTHONPATH=env["PYTHONPATH"])
             dev_times = []
@@ -1008,10 +1099,13 @@ def cfg8_realistic_scale() -> int:
                 if r.returncode != 0:
                     sys.stderr.write(r.stderr.decode()[:1000])
                     return _fail("realistic_device")
-            if readset("dev") != nat_body:
+            if readset("dev") != parity_body:
                 return _fail("realistic_device_parity")
+            # no toolchain -> no native reference wall: vs_baseline 0
+            # marks "unreferenced", like the other no-baseline configs
             return _emit("realistic_device_wall_s", min(dev_times),
-                         "s", min(nat_times) / min(dev_times))
+                         "s", min(nat_times) / min(dev_times)
+                         if nat_times else 0.0)
     return 0
 
 

@@ -25,7 +25,7 @@ from pwasm_tpu.core.errors import EXIT_USAGE, PwasmError
 from pwasm_tpu.core.events import extract_alignment
 from pwasm_tpu.core.fasta import FastaFile
 from pwasm_tpu.core.paf import AlnInfo, _atoi, parse_paf_line
-from pwasm_tpu.report.diff_report import Summary, print_diff_info
+from pwasm_tpu.report.diff_report import Summary
 
 USAGE = """Usage:
  pafreport <paf_with_cg_cs> -r <refseq.fa> [-s <summary.txt>]
@@ -668,20 +668,23 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
             print(f"sharding over mesh {dict(shard_mesh.shape)}",
                   file=stderr)
 
-    inflight: list = []   # at most one submitted-but-unformatted batch
+    inflight: list = []   # submitted-but-unformatted batches (<= 2)
 
-    # batch-granular durability (SURVEY.md §5 checkpoint/resume, device
-    # path): after each completed batch the report prefix is fsynced
-    # and its (bytes, records) recorded atomically in <report>.ckpt, so
-    # a killed run resumes at the last completed batch.  Records
-    # already in the file from a --resume count toward the total.
+    # batch-granular durability (SURVEY.md §5 checkpoint/resume): after
+    # each completed batch the report prefix is fsynced and its
+    # (bytes, records) recorded atomically in <report>.ckpt, so a
+    # killed run resumes at the last completed batch.  Both report
+    # engines flush in batches now, so the CPU path gets the same
+    # durability the device path shipped in PR 1 (previously it could
+    # only header-scan resume).  Records already in the file from a
+    # --resume count toward the total.
     report_path = getattr(freport, "name", None) \
         if freport not in (stdout, None) else None
     emitted = [resume_skip]
 
     def note_batch_done(nrecords: int) -> None:
         emitted[0] += nrecords
-        if report_path is not None and use_device:
+        if report_path is not None:
             if _write_checkpoint(freport, report_path, emitted[0]):
                 stats.res_checkpoints += 1
 
@@ -790,27 +793,57 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                     realigned=res is not None)
 
     def flush_pending(drain: bool = False):
-        """Submit the pending batch, then format the PREVIOUS batch —
-        JAX dispatch is async, so batch k's device program runs while
-        batch k-1's rows are formatted and written (the launch/transfer
-        latency is hidden behind host work).  ``drain`` formats the last
-        in-flight batch at end of input."""
+        """Flush the pending report batch.
+
+        Device path: submit the batch, then format the OLDEST in-flight
+        batch — JAX dispatch is async, so a two-deep in-flight pipeline
+        keeps batch k's device program running while batches k-1/k-2
+        are formatted and written (launch/transfer latency hides behind
+        host work even when formatting is faster than the device).
+        ``drain`` formats every in-flight batch at end of input.
+
+        Host path: one vectorized columnar analysis over the whole
+        batch (report/columnar.py — the same formulas as the device
+        program under numpy), then the shared emit loop.  Never touches
+        the device module: the plain-CPU CLI must not initialize (or
+        even import) jax — a pinned-but-unhealthy TPU tunnel would hang
+        or kill an otherwise host-only run."""
         if not pending and not inflight:
-            return  # nothing buffered (always true in --device=cpu mode):
-            # never touch the device module — the plain-CPU CLI must not
-            # initialize jax (a pinned-but-unhealthy TPU tunnel would
-            # hang or kill an otherwise host-only run)
-        from pwasm_tpu.report.device_report import submit_diff_info_batch
+            return  # nothing buffered
         # take the batch first: if the flush itself raises, the finally
         # below must not retry it (the retry would mask the live error)
         batch, pending[:] = pending[:], []
+        if not use_device:
+            if batch:
+                import os as _os
+                if _os.environ.get("PWASM_HOST_COLUMNAR", "1") == "0":
+                    # scalar per-alignment loop (the ground-truth
+                    # engine): the columnar path's escape hatch, and
+                    # the bench's same-process A/B reference
+                    from pwasm_tpu.report.diff_report import \
+                        print_diff_info
+                    for aln, rlabel, tlabel, refseq in batch:
+                        print_diff_info(
+                            aln, rlabel, tlabel, freport, refseq,
+                            skip_codan=cfg.skip_codan,
+                            motifs=cfg.motifs, summary=summary)
+                else:
+                    from pwasm_tpu.report.columnar import \
+                        print_diff_info_batch_host
+                    print_diff_info_batch_host(
+                        batch, freport, skip_codan=cfg.skip_codan,
+                        motifs=cfg.motifs, summary=summary,
+                        stats=stats)
+                note_batch_done(len(batch))
+            return
+        from pwasm_tpu.report.device_report import submit_diff_info_batch
         if batch:
             inflight.append((submit_diff_info_batch(
                 batch, freport, skip_codan=cfg.skip_codan,
                 motifs=cfg.motifs, summary=summary, stats=stats,
                 mesh=shard_mesh, supervisor=supervisor), len(batch)))
             stats.device_batches += 1
-        while len(inflight) > (0 if drain else 1):
+        while len(inflight) > (0 if drain else 2):
             fin, nrec = inflight.pop(0)
             try:
                 fin()
@@ -928,14 +961,14 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                     # --resume cursor: this alignment's rows are already
                     # in the report from the interrupted run
                     stats.resumed_past += 1
-                elif use_device:
+                else:
+                    # both engines batch: the device path submits one
+                    # fused program per flush, the host path runs one
+                    # vectorized columnar analysis per flush — and both
+                    # leave a durable checkpoint per completed batch
                     pending.append((aln, rlabel, tlabel, refseq))
                     if len(pending) >= cfg.batch:
                         flush_pending()
-                else:
-                    print_diff_info(aln, rlabel, tlabel, freport, refseq,
-                                    skip_codan=cfg.skip_codan,
-                                    motifs=cfg.motifs, summary=summary)
             if build_msa_out:
                 if cfg.realign:
                     q_seg = refseq_aln[aln.offset:

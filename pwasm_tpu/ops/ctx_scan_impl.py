@@ -1,0 +1,379 @@
+"""Shared host/device implementation of the variant-context scan.
+
+The SAME formulas run under two array namespaces: ``jax.numpy`` inside
+the jitted device program (``ops/ctx_scan.py``) and plain ``numpy`` for
+the vectorized host path (``report/columnar.py``).  Every function takes
+the namespace as ``xp`` — host/device parity is therefore structural
+(one formula, two executors), not a pair of implementations kept in sync
+by tests alone.
+
+This module must stay FREE OF JAX IMPORTS: the plain-CPU CLI loads it
+on its hot path, and importing jax there would both pay the ~seconds
+import cost the CPU pin exists to avoid and risk touching an unhealthy
+tunnel backend.  (``ops/ctx_scan.py`` holds the jit wrappers.)
+
+Semantics ported bit-for-bit from the reference — see the docstrings in
+``ops/ctx_scan.py`` for the pafreport.cpp line citations (context
+windows with the right-edge quirk, homopolymer 4-run overlap rule,
+first-motif-wins scan, codon impact through the 5^3 LUT, frameshift
+stop scan over the whole modified suffix).
+
+Event tensor layout (produced by ``pack_events_np``):
+  rloc (E,) int32; evt (E,) int32 {0=S, 1=I, 2=D}; evtlen (E,) int32
+  (the reference's evtlen field — stays 1 for merged substitutions);
+  nbases (E,) actual evtbases length; evtbases/evtsub (E, MAXEV) int8
+  codes padded with PAD.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pwasm_tpu.core.dna import AA_LUT, CODE_N, encode
+
+PAD = 6
+EVT_S, EVT_I, EVT_D = 0, 1, 2
+CTX = 9          # reference-context window size
+MAX_MOTIF = 8    # max motif length supported by the device scan
+
+
+def next_pow2(n: int, floor: int = 256) -> int:
+    """Smallest power of two >= max(n, floor) — the shape-bucket rule
+    shared by the event axis, the reference tensor, and the stop-scan
+    window, so the jitted programs key on a SMALL FIXED SET of shapes
+    instead of recompiling (and re-dispatching) per exact size."""
+    return max(floor, 1 << (max(int(n), 1) - 1).bit_length())
+
+
+def ref_bucket_len(ref_len: int, max_ev: int) -> int:
+    """Power-of-two padded length for the reference tensor.  Must cover
+    ``ref_len + max_ev + 3`` (the frameshift stop-scan window reads the
+    whole modified suffix, which an insertion lengthens by up to
+    ``max_ev`` bases, plus one codon of slack)."""
+    return next_pow2(ref_len + max_ev + 3)
+
+
+def translate_codes(c0, c1, c2, xp=np):
+    """Codes (clipped to N) -> amino-acid ASCII via the 5^3 LUT; any code
+    outside [0,4) translates through N -> 'X'.
+
+    The LUT is materialized per call, not at module level as a device
+    array: under jit it constant-folds, and the numpy path pays one
+    cheap asarray (it is already a numpy array there)."""
+    lut = xp.asarray(AA_LUT)
+    c0 = xp.clip(c0, 0, CODE_N)
+    c1 = xp.clip(c1, 0, CODE_N)
+    c2 = xp.clip(c2, 0, CODE_N)
+    return lut[(c0 * 25 + c1 * 5 + c2).astype(xp.int32)]
+
+
+def pack_events_np(events, max_ev: int = 16, bucket: int = 256) -> dict:
+    """SoA-pack a list of DiffEvent into numpy tensors.  Events whose
+    bases exceed ``max_ev`` must take the scalar path (caller filters).
+
+    The event axis is padded to ``next_pow2`` of a multiple of
+    ``bucket`` so the jitted ctx_scan program is reused across flushes
+    from a small fixed set of compiled shapes (256, 512, 1024, ...)
+    instead of recompiling for every distinct event count; padding rows
+    are zeros (a 0-length 'S' event at rloc 0) and callers read only
+    the first ``len(events)`` results.  ``bucket=0`` skips padding (the
+    host path — no compile cache to key)."""
+    from pwasm_tpu.core.dna import ENCODE_TABLE
+
+    E = len(events)
+    E_pad = next_pow2(E, bucket) if bucket else E
+    if E == 0:
+        return dict(rloc=np.zeros(E_pad, np.int32),
+                    evt=np.zeros(E_pad, np.int32),
+                    evtlen=np.zeros(E_pad, np.int32),
+                    nbases=np.zeros(E_pad, np.int32),
+                    evtbases=np.full((E_pad, max_ev), PAD, np.int8),
+                    evtsub=np.full((E_pad, max_ev), PAD, np.int8))
+    evt_code = {"S": EVT_S, "I": EVT_I, "D": EVT_D}
+    rloc = np.zeros(E_pad, np.int32)
+    evt = np.zeros(E_pad, np.int32)
+    evtlen = np.zeros(E_pad, np.int32)
+    rloc[:E] = np.fromiter((ev.rloc for ev in events), np.int32, E)
+    evt[:E] = np.fromiter((evt_code[ev.evt] for ev in events),
+                          np.int32, E)
+    evtlen[:E] = np.fromiter((ev.evtlen for ev in events), np.int32, E)
+
+    def code_plane(raw: list[bytes]):
+        # one concatenated encode + a single scatter instead of one
+        # numpy round-trip per event (the realistic-scale report packs
+        # tens of thousands of events per flush)
+        lens = np.fromiter(map(len, raw), np.int64, E)
+        cat = np.frombuffer(b"".join(raw), dtype=np.uint8)
+        codes = ENCODE_TABLE[cat]
+        keep_lens = np.minimum(lens, max_ev)   # callers filter; clip
+        #                                        is belt-and-suspenders
+        starts = np.concatenate(([0], np.cumsum(lens)[:-1]))
+        idx_row = np.repeat(np.arange(E), lens)
+        idx_col = np.arange(len(cat)) - np.repeat(starts, lens)
+        plane = np.full((E_pad, max_ev), PAD, np.int8)
+        if (lens > max_ev).any():
+            sel = idx_col < max_ev
+            plane[idx_row[sel], idx_col[sel]] = codes[sel]
+        else:
+            plane[idx_row, idx_col] = codes
+        return plane, keep_lens.astype(np.int32)
+
+    evtbases, nb = code_plane([ev.evtbases.upper() for ev in events])
+    evtsub, _ = code_plane([ev.evtsub.upper() for ev in events])
+    nbases = np.zeros(E_pad, np.int32)
+    nbases[:E] = nb
+    return dict(rloc=rloc, evt=evt, evtlen=evtlen, nbases=nbases,
+                evtbases=evtbases, evtsub=evtsub)
+
+
+def pack_motifs_np(motifs) -> tuple[np.ndarray, np.ndarray]:
+    """Motif table -> (codes (NM, MAX_MOTIF) int8, lens (NM,) int32)."""
+    nm = len(motifs)
+    codes = np.full((nm, MAX_MOTIF), PAD, np.int8)
+    lens = np.zeros(nm, np.int32)
+    for i, mot in enumerate(motifs):
+        b = encode(mot.encode() if isinstance(mot, str) else mot)
+        if len(b) > MAX_MOTIF:
+            raise ValueError(f"motif longer than {MAX_MOTIF}: {mot}")
+        codes[i, :len(b)] = b
+        lens[i] = len(b)
+    return codes, lens
+
+
+def ref_context_windows(ref, ref_len, rloc, xp=np):
+    """(E,) event positions -> (E, 9) windows + (E,) local offsets,
+    mirroring get_ref_context exactly (including the right-edge quirk)."""
+    ctxstart = rloc - 4
+    evtloc = xp.full_like(rloc, 4)
+    left = ctxstart < 0
+    right = ~left & (ctxstart + 8 >= ref_len)
+    evtloc = xp.where(left, evtloc + ctxstart, evtloc)
+    # the right-edge branch uses the OLD ctxstart in its (sign-flipped)
+    # adjustment — reference behavior preserved
+    evtloc = xp.where(right, evtloc + ref_len - ctxstart - 9, evtloc)
+    ctxstart = xp.where(left, 0, ctxstart)
+    ctxstart = xp.where(right, ref_len - 9, ctxstart)
+    degen = right & (ctxstart < 0)
+    evtloc = xp.where(degen, evtloc + ctxstart, evtloc)
+    ctxstart = xp.where(degen, 0, ctxstart)
+    idx = ctxstart[:, None] + xp.arange(CTX)[None, :]
+    win = ref[xp.clip(idx, 0, ref.shape[0] - 1)]
+    return win, evtloc
+
+
+def hpoly_flags(evtbases, nbases, rctx, rctxloc, xp=np):
+    """Vectorized hpolyCheck: all event bases identical AND a 4-run of the
+    base inside the window overlapping the event offset."""
+    first = evtbases[:, 0]
+    kidx = xp.arange(evtbases.shape[1])[None, :]
+    valid = kidx < nbases[:, None]
+    all_same = xp.all((evtbases == first[:, None]) | ~valid, axis=1)
+    # seed positions l in [0, 6): window[l:l+4] all == first
+    l = xp.arange(CTX - 4 + 1)
+    runs = xp.all(
+        rctx[:, l[:, None] + xp.arange(4)[None, :]]
+        == first[:, None, None], axis=2)           # (E, 6)
+    # reference uses GStr::index -> FIRST run position only
+    has_run = xp.any(runs, axis=1)
+    lpos = xp.argmax(runs, axis=1)
+    overlap = (lpos <= rctxloc) & (rctxloc <= lpos + 4)
+    return all_same & has_run & overlap & (nbases > 0)
+
+
+def motif_hits(rctx, mot_codes, mot_lens, xp=np):
+    """First motif (table order) found anywhere in each window; returns
+    (E,) int32 1-based motif index, 0 = none."""
+    nm, mw = mot_codes.shape
+    starts = xp.arange(CTX)                        # candidate start pos
+    ks = xp.arange(mw)
+    idx = starts[:, None] + ks[None, :]            # (9, mw)
+    win = rctx[:, xp.clip(idx, 0, CTX - 1)]        # (E, 9, mw)
+    cmp = win[:, None] == mot_codes[None, :, None]  # (E, nm, 9, mw)
+    klt = ks[None, :] < mot_lens[:, None]           # (nm, mw)
+    ok = xp.all(cmp | ~klt[None, :, None, :], axis=3)  # (E, nm, 9)
+    fits = (starts[None, :] + mot_lens[:, None]) <= CTX  # (nm, 9)
+    found = xp.any(ok & fits[None], axis=2)        # (E, nm)
+    any_hit = xp.any(found, axis=1)
+    first = xp.argmax(found, axis=1)
+    return xp.where(any_hit, first + 1, 0).astype(xp.int32)
+
+
+def sub_impact(ref, rloc, nbases, evtbases, evtsub, r_trloc,
+               max_codons: int, xp=np):
+    """Substitution codon impact: for up to ``max_codons`` affected codons
+    return (orig_aa, new_aa, aapos, valid, sub_mismatch)."""
+    e_off = rloc - r_trloc                  # event offset in the window
+    ao_first = e_off // 3
+    ao_last = (e_off + xp.maximum(nbases, 1) - 1) // 3
+    d = xp.arange(max_codons, dtype=xp.int32)[None, :]
+    ao = ao_first[:, None] + d              # (E, K) codon window indices
+    kvalid = ao <= ao_last[:, None]
+    cpos = r_trloc[:, None, None] + ao[..., None] * 3 \
+        + xp.arange(3, dtype=xp.int32)[None, None, :]  # (E, K, 3) abs pos
+    Rn = ref.shape[0]
+    orig = ref[xp.clip(cpos, 0, Rn - 1)]
+    orig = xp.where(cpos < Rn, orig, PAD)
+    # overlay the substituted bases at [rloc, rloc+nbases)
+    rel = cpos - rloc[:, None, None]
+    inside = (rel >= 0) & (rel < nbases[:, None, None])
+    sub = evtbases[xp.arange(evtbases.shape[0])[:, None, None],
+                   xp.clip(rel, 0, evtbases.shape[1] - 1)]
+    mod = xp.where(inside, sub, orig)
+    orig_aa = translate_codes(orig[..., 0], orig[..., 1], orig[..., 2],
+                              xp=xp)
+    new_aa = translate_codes(mod[..., 0], mod[..., 1], mod[..., 2],
+                             xp=xp)
+    aapos = ao + (rloc // 3)[:, None]
+    # the reference verifies each substituted base against the query
+    # (pafreport.cpp:812-813); surface that as a flag the host turns fatal
+    kb = xp.arange(evtbases.shape[1])[None, :]
+    bvalid = kb < nbases[:, None]
+    refb = ref[xp.clip(rloc[:, None] + kb, 0, Rn - 1)]
+    mism = xp.any((refb != evtsub) & bvalid, axis=1)
+    return orig_aa, new_aa, aapos, kvalid, mism
+
+
+def indel_stop_scan(ref, ref_len, rloc, evt, evtlen, nbases, evtbases,
+                    r_trloc, max_len: int, xp=np):
+    """Frameshift analysis for I/D events: build the modified suffix
+    (insert/cut at the event), translate codon-by-codon, find the first
+    premature stop, and collect the reference's aa4/maa4 preview codons.
+
+    Returns (stop_aapos (E,) int32 or -1, aa4 (E,4) uint8, maa4 (E,4)
+    uint8, aa4_valid, maa4_valid).  ``max_len`` bounds the scanned
+    window; a stop past it is reported as -1 (the host driver rescans
+    unresolved lanes with a larger window — see report/columnar.py)."""
+    E = rloc.shape[0]
+    Rn = ref.shape[0]
+    e_off = rloc - r_trloc
+    is_ins = evt == EVT_I
+    nb = xp.where(is_ins, nbases, evtlen)
+    j = xp.arange(max_len, dtype=xp.int32)[None, :]  # (1, W) positions
+    # source index for each modified-sequence position
+    ins_src = xp.where(j < e_off[:, None], r_trloc[:, None] + j,
+                       r_trloc[:, None] + j - nb[:, None])
+    ins_inside = (j >= e_off[:, None]) & (j < (e_off + nb)[:, None])
+    del_src = xp.where(j < e_off[:, None], r_trloc[:, None] + j,
+                       r_trloc[:, None] + j + nb[:, None])
+    src = xp.where(is_ins[:, None], ins_src, del_src)
+    base = ref[xp.clip(src, 0, Rn - 1)]
+    base = xp.where(src < ref_len, base, PAD)
+    insb = evtbases[xp.arange(E)[:, None],
+                    xp.clip(j - e_off[:, None], 0,
+                            evtbases.shape[1] - 1)]
+    seq = xp.where(is_ins[:, None] & ins_inside, insb, base)
+    modlen = xp.where(is_ins, ref_len - r_trloc + nb,
+                      ref_len - r_trloc - nb)
+    n_cod = max_len // 3
+    cpos = xp.arange(n_cod, dtype=xp.int32)[None, :] * 3
+    cpos_b = xp.broadcast_to(cpos, (E, n_cod))
+    c0 = xp.take_along_axis(seq, cpos_b, axis=1)
+    c1 = xp.take_along_axis(seq, cpos_b + 1, axis=1)
+    c2 = xp.take_along_axis(seq, cpos_b + 2, axis=1)
+    aa = translate_codes(c0, c1, c2, xp=xp)  # (E, n_cod)
+    cvalid = (cpos + 2) < modlen[:, None]   # while i+2 < len(modseq)
+    stop = (aa == ord(".")) & cvalid
+    has_stop = xp.any(stop, axis=1)
+    cstar = xp.argmax(stop, axis=1)
+    stop_aapos = xp.where(has_stop, 1 + cstar + r_trloc // 3, -1)
+    # aa4/maa4: codons c = 1..4, before the stop, valid in each sequence
+    c14 = xp.arange(1, 5)[None, :]
+    before_stop = xp.where(has_stop[:, None], c14 < cstar[:, None], True)
+    c14_b = xp.broadcast_to(c14, (E, 4))
+    maa4_valid = before_stop & xp.take_along_axis(cvalid, c14_b, axis=1)
+    maa4 = xp.take_along_axis(aa, c14_b, axis=1)
+    # aa4 comes from the unmodified suffix (same positions)
+    opos = r_trloc[:, None] + c14 * 3
+    o0 = ref[xp.clip(opos, 0, Rn - 1)]
+    o1 = ref[xp.clip(opos + 1, 0, Rn - 1)]
+    o2 = ref[xp.clip(opos + 2, 0, Rn - 1)]
+    o0 = xp.where(opos < ref_len, o0, PAD)
+    o1 = xp.where(opos + 1 < ref_len, o1, PAD)
+    o2 = xp.where(opos + 2 < ref_len, o2, PAD)
+    aa4 = translate_codes(o0, o1, o2, xp=xp)
+    # reference guard: i+2 < len(r_trseq)  <=>  opos+2 < ref_len
+    aa4_valid = maa4_valid & ((opos + 2) < ref_len)
+    return stop_aapos.astype(xp.int32), aa4, maa4, aa4_valid, maa4_valid
+
+
+def ctx_scan_prologue(ref, ref_len, ev: dict, mot_codes, mot_lens,
+                      xp=np) -> tuple[dict, object]:
+    """The codan-independent half of the scan — context windows,
+    homopolymer/motif attribution, the event codon's amino acid — plus
+    the translation-window start ``r_trloc``.  ONE implementation
+    shared by the fused device program (``ctx_scan_calc``) and the
+    lane-filtered host driver (``report/columnar.host_ctx_scan``):
+    parity between them is structural, not hand-synced."""
+    rloc = ev["rloc"]
+    rctx, rctxloc = ref_context_windows(ref, ref_len, rloc, xp=xp)
+    hpoly = hpoly_flags(ev["evtbases"], ev["nbases"], rctx, rctxloc,
+                        xp=xp)
+    motif = motif_hits(rctx, mot_codes, mot_lens, xp=xp)
+    aapos0 = rloc // 3
+    ca = aapos0 * 3
+    aa = translate_codes(
+        ref[xp.clip(ca, 0, ref.shape[0] - 1)],
+        xp.where(ca + 1 < ref_len,
+                 ref[xp.clip(ca + 1, 0, ref.shape[0] - 1)], PAD),
+        xp.where(ca + 2 < ref_len,
+                 ref[xp.clip(ca + 2, 0, ref.shape[0] - 1)], PAD),
+        xp=xp)
+    out = dict(rctx=rctx, rctxloc=rctxloc, hpoly=hpoly, motif=motif,
+               aa=aa, aapos=aapos0 + 1)
+    r_trloc = xp.maximum(3 * (aapos0 + 1 - 2), 0)
+    return out, r_trloc
+
+
+def ctx_scan_calc(ref, ref_len, ev: dict, mot_codes, mot_lens,
+                  max_codons: int = 8, max_len: int = 4096,
+                  skip_codan: bool = False, xp=np) -> dict:
+    """The fused event-analysis program (host or device namespace).
+    Returns a dict of arrays; ``report/columnar.py`` turns them into
+    report rows."""
+    rloc = ev["rloc"]
+    out, r_trloc = ctx_scan_prologue(ref, ref_len, ev, mot_codes,
+                                     mot_lens, xp=xp)
+    if not skip_codan:
+        s_orig, s_new, s_pos, s_valid, s_mism = sub_impact(
+            ref, rloc, ev["nbases"], ev["evtbases"], ev["evtsub"],
+            r_trloc, max_codons, xp=xp)
+        stop_aapos, aa4, maa4, aa4_v, maa4_v = indel_stop_scan(
+            ref, ref_len, rloc, ev["evt"], ev["evtlen"], ev["nbases"],
+            ev["evtbases"], r_trloc, max_len, xp=xp)
+        out.update(s_orig_aa=s_orig, s_new_aa=s_new, s_aapos=s_pos,
+                   s_valid=s_valid, s_mismatch=s_mism,
+                   stop_aapos=stop_aapos, aa4=aa4, maa4=maa4,
+                   aa4_valid=aa4_v, maa4_valid=maa4_v)
+    return out
+
+
+def ctx_scan_layout(max_codons: int, skip_codan: bool) -> list:
+    """(field, per-event width) pairs of the scan output, in the fixed
+    order the packed single-tensor transfer uses (see
+    ``ops/ctx_scan.py ctx_scan_packed`` / ``unpack_ctx_scan``)."""
+    fields = [("rctx", CTX), ("rctxloc", 1), ("hpoly", 1), ("motif", 1),
+              ("aa", 1), ("aapos", 1)]
+    if not skip_codan:
+        K = max_codons
+        fields += [("s_orig_aa", K), ("s_new_aa", K), ("s_aapos", K),
+                   ("s_valid", K), ("s_mismatch", 1), ("stop_aapos", 1),
+                   ("aa4", 4), ("maa4", 4), ("aa4_valid", 4),
+                   ("maa4_valid", 4)]
+    return fields
+
+
+def unpack_ctx_scan(flat: np.ndarray, max_codons: int,
+                    skip_codan: bool) -> dict:
+    """Split the packed (E, total_width) int32 fetch back into the
+    per-field dict (numpy views — no copies).  Width-1 fields come back
+    as (E,) and the rest as (E, width), exactly the shapes the dict
+    form has."""
+    out = {}
+    col = 0
+    for name, width in ctx_scan_layout(max_codons, skip_codan):
+        if width == 1:
+            out[name] = flat[:, col]
+        else:
+            out[name] = flat[:, col:col + width]
+        col += width
+    return out

@@ -1,0 +1,352 @@
+"""Vectorized host event analysis + row assembly (jax-free).
+
+The host scalar path's per-event loop (``diff_report.analyze_event_host``
+— context window, homopolymer/motif attribution, codon impact) was the
+realistic-scale CLI's hot spot (VERDICT r5 item 8: report formatting and
+event assembly).  This module runs the SAME formulas as the device
+program — literally the same functions, ``ops/ctx_scan_impl.py`` under
+the numpy namespace — over a whole batch of alignments' events at once,
+then assembles rows with one writer call per batch.
+
+Byte-exactness contract: ``diff_report`` stays the scalar ground truth.
+Any event the columnar formulas cannot reproduce byte-for-byte is
+ROUTED to the scalar analyzer instead of approximated:
+
+- events longer than ``HOST_MAX_EV`` bases (the fixed-shape tensors cap
+  event width, like the device path's MAX_EV scope limit);
+- events carrying non-ACGT bases (the int8 code space collapses IUPAC
+  codes to N, so code-space compares could diverge from the scalar
+  path's byte compares — e.g. hpolyCheck on an 'RRRR' run);
+- when the reference itself holds non-ACGT bases, events whose 9bp
+  window touches them (same code-space concern for the motif scan);
+- flagged substitution mismatches (the reference's fatal
+  modseq-vs-evtsub verification): re-run through the scalar path so
+  the error message, indices and raise point are byte-identical.
+
+The frameshift stop scan is windowed on host: the device's dense
+whole-suffix scan is right for a TPU but O(ref_len) per event on a CPU,
+while the scalar reference usually stops within a few codons.  The
+first pass scans a short window; the rare lanes with no stop inside it
+re-scan with the full suffix — results are identical by construction
+(the window only bounds how far the SAME formula looks).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pwasm_tpu.core.config import DEFAULT_MOTIFS
+from pwasm_tpu.core.dna import CODE_N, encode
+from pwasm_tpu.core.errors import PwasmError
+from pwasm_tpu.ops.ctx_scan_impl import (EVT_S, PAD, indel_stop_scan,
+                                         pack_events_np, pack_motifs_np,
+                                         sub_impact)
+from pwasm_tpu.ops import ctx_scan_impl as _impl
+from pwasm_tpu.report.diff_report import (Summary, analyze_event_host,
+                                          format_event_row, format_header,
+                                          get_ref_context, print_diff_info)
+
+HOST_MAX_EV = 64       # events wider than this take the scalar path
+_STOP_WINDOW = 258     # first-pass stop-scan window (86 codons: the
+#                        expected stop arrives within ~21 codons on
+#                        random sequence, so ~98% of lanes resolve here)
+
+
+def host_ctx_scan(ref: np.ndarray, ref_len: int, ev: dict,
+                  mot_codes: np.ndarray, mot_lens: np.ndarray,
+                  max_codons: int, skip_codan: bool) -> dict:
+    """Numpy twin of ``ops/ctx_scan.ctx_scan`` over live (unpadded)
+    events — same formulas via ``ctx_scan_impl``, but lane-filtered the
+    way a CPU wants it: substitution impact only on S lanes, the stop
+    scan only on I/D lanes and windowed with escalation."""
+    rloc = ev["rloc"]
+    E = rloc.shape[0]
+    out, r_trloc = _impl.ctx_scan_prologue(ref, ref_len, ev, mot_codes,
+                                           mot_lens)
+    if skip_codan:
+        return out
+    K = max_codons
+    s_idx = np.nonzero(ev["evt"] == EVT_S)[0]
+    if s_idx.size:
+        # right-size the codon window to this batch's widest live
+        # substitution (identical results: codons past a sub's own
+        # span are invalid either way) — K tracks max_ev but real subs
+        # span 1-3 codons, so the dense (E, K) planes shrink ~8x
+        e_off = rloc[s_idx] - r_trloc[s_idx]
+        span = (e_off + np.maximum(ev["nbases"][s_idx], 1) - 1) // 3 \
+            - e_off // 3 + 1
+        K = min(K, int(span.max()))
+    out.update(
+        s_orig_aa=np.zeros((E, K), np.uint8),
+        s_new_aa=np.zeros((E, K), np.uint8),
+        s_aapos=np.zeros((E, K), np.int64),
+        s_valid=np.zeros((E, K), bool),
+        s_mismatch=np.zeros(E, bool),
+        stop_aapos=np.full(E, -1, np.int32),
+        aa4=np.zeros((E, 4), np.uint8), maa4=np.zeros((E, 4), np.uint8),
+        aa4_valid=np.zeros((E, 4), bool),
+        maa4_valid=np.zeros((E, 4), bool))
+    if s_idx.size:
+        so, sn, sp, sv, sm = sub_impact(
+            ref, rloc[s_idx], ev["nbases"][s_idx],
+            ev["evtbases"][s_idx], ev["evtsub"][s_idx], r_trloc[s_idx],
+            K)
+        out["s_orig_aa"][s_idx] = so
+        out["s_new_aa"][s_idx] = sn
+        out["s_aapos"][s_idx] = sp
+        out["s_valid"][s_idx] = sv
+        out["s_mismatch"][s_idx] = sm
+    sel = np.nonzero(ev["evt"] != EVT_S)[0]
+    window = _STOP_WINDOW
+    while sel.size:
+        stop, aa4, maa4, a4v, m4v = indel_stop_scan(
+            ref, ref_len, rloc[sel], ev["evt"][sel], ev["evtlen"][sel],
+            ev["nbases"][sel], ev["evtbases"][sel], r_trloc[sel],
+            window)
+        out["stop_aapos"][sel] = stop
+        out["aa4"][sel] = aa4
+        out["maa4"][sel] = maa4
+        out["aa4_valid"][sel] = a4v
+        out["maa4_valid"][sel] = m4v
+        # lanes with no stop inside the window whose modified suffix
+        # extends past it re-scan with the full suffix (identical
+        # formula, wider look) — the aa4/maa4 fields are already final
+        # (codons 1..4 sit inside any window, and a stop past codon 4
+        # gates them exactly like no stop at all)
+        is_ins = ev["evt"][sel] == _impl.EVT_I
+        nb = np.where(is_ins, ev["nbases"][sel], ev["evtlen"][sel])
+        modlen = np.where(is_ins, ref_len - r_trloc[sel] + nb,
+                          ref_len - r_trloc[sel] - nb)
+        scanned = 3 * (window // 3) + 2   # first unscanned codon's end
+        unresolved = (stop < 0) & (scanned < modlen)
+        sel = sel[unresolved]
+        if window >= int(ref_len) + HOST_MAX_EV + 3:
+            break
+        window = int(ref_len) + HOST_MAX_EV + 3
+    return out
+
+
+_SCALAR_FIELDS = ("aa", "aapos", "hpoly", "motif", "s_mismatch",
+                  "stop_aapos")
+
+
+def _impact_text_l(ev, k: int, L: dict, A: dict, strict_subs: bool,
+                   refseq: bytes, skip_codan: bool, motifs) -> str:
+    """predictImpact's text from analysis results (pafreport.cpp:804-883
+    semantics): scalar fields from the bulk-converted lists ``L``,
+    per-codon rows from the arrays ``A`` on demand (only the event's
+    own branch reads them).  With ``strict_subs`` a flagged
+    substitution mismatch re-runs the event through the scalar analyzer
+    so message/indices match the scalar ground truth byte-for-byte;
+    without it the device path's generic message is raised."""
+    if ev.evt == "S":
+        if L["s_mismatch"][k]:
+            if strict_subs:
+                # scalar replay raises the reference's exact error (or,
+                # if the byte-level check disagrees with the code-level
+                # flag, yields the scalar ground-truth result)
+                return analyze_event_host(ev, refseq, skip_codan,
+                                          motifs)[4]
+            raise PwasmError(
+                "Error: modseq not matching di.evtsub !\n")
+        if L["s_syn"][k]:
+            # vectorized fast path: no valid codon changed — the
+            # per-codon row walk below would emit no parts
+            return "synonymous"
+        parts = []
+        s_valid = A["s_valid"][k].tolist()
+        s_orig = A["s_orig_aa"][k].tolist()
+        s_new = A["s_new_aa"][k].tolist()
+        s_pos = None
+        for d in range(len(s_orig)):
+            if not s_valid[d]:
+                break
+            aa = chr(s_orig[d])
+            maa = chr(s_new[d])
+            if aa != maa:
+                if s_pos is None:
+                    s_pos = A["s_aapos"][k].tolist()
+                aapos = s_pos[d]
+                s = f"AA{aapos}|{aa}:{maa}"
+                if maa == ".":
+                    s += f"|premature stop at AA{aapos}"
+                parts.append(s)
+        return ", ".join(parts) if parts else "synonymous"
+    stop = L["stop_aapos"][k]
+    if stop >= 0:
+        return f"premature stop at AA{stop}"
+    aa4 = "".join(chr(c) for c, v in
+                  zip(A["aa4"][k].tolist(), A["aa4_valid"][k].tolist())
+                  if v)
+    maa4 = "".join(chr(c) for c, v in
+                   zip(A["maa4"][k].tolist(),
+                       A["maa4_valid"][k].tolist()) if v)
+    if aa4 and maa4:
+        return f"frame shift {aa4}+:{maa4}+"
+    return ""
+
+
+def assemble_results(events, host: dict, refseq: bytes, motifs,
+                     skip_codan: bool, defer=None,
+                     strict_subs: bool = False) -> list:
+    """Per-event ``(aa, aapos, rctx, status, impact)`` tuples — the
+    ``analyze_event_host`` contract — from an analysis dict (a device
+    fetch or ``host_ctx_scan`` output).  Upper-cases each event's
+    ``evtbases`` in place, matching printDiffInfo.  ``defer[k]`` routes
+    event ``k`` wholesale through the scalar analyzer (the columnar
+    path's byte-exactness escape hatch)."""
+    # bulk tolist for the per-event scalars (python-int indexing from
+    # lists is ~5x cheaper than numpy scalar extraction at report
+    # scale); the (E, K) codon planes stay arrays and convert per ROW
+    # on demand — most of their content is never read
+    A = {k: np.asarray(v) for k, v in host.items()
+         if k not in ("rctx", "rctxloc")}
+    L = {k: A[k].tolist() for k in _SCALAR_FIELDS if k in A}
+    if "s_valid" in A:
+        # synonymous = no valid codon changed (computed vectorized so
+        # the common case skips the per-codon row walk entirely)
+        changed = (A["s_orig_aa"] != A["s_new_aa"]) \
+            & (A["s_valid"] != 0)
+        L["s_syn"] = (~changed.any(axis=1)).tolist()
+    motif_text = ["[unknown]"] + [f"motif {m}" for m in motifs]
+    # the host slices the 9bp context strings (byte-faithful for IUPAC
+    # ambiguity characters the int8 code space collapses) — one
+    # vectorized gather for the whole batch; <9bp references keep the
+    # scalar degenerate-clamp path of get_ref_context
+    ref_len = len(refseq)
+    wb = None
+    if ref_len >= 9:
+        ru = np.frombuffer(refseq.upper(), np.uint8)
+        rl = np.fromiter((ev.rloc for ev in events), np.int64,
+                         len(events))
+        ctxstart = np.clip(rl - 4, 0, ref_len - 9)
+        wb = ru[ctxstart[:, None] + np.arange(9)].tobytes()
+    out = []
+    for k, ev in enumerate(events):
+        if defer is not None and defer[k]:
+            out.append(analyze_event_host(ev, refseq, skip_codan,
+                                          motifs))
+            continue
+        ev.evtbases = ev.evtbases.upper()
+        aa = chr(L["aa"][k])
+        aapos = L["aapos"][k]
+        if wb is not None:
+            k9 = 9 * k
+            rctx = wb[k9:k9 + 9]
+        else:
+            rctx = get_ref_context(refseq, ev.rloc)[0]
+        if L["hpoly"][k]:
+            status = "homopolymer"
+        else:
+            status = motif_text[L["motif"][k]]
+        impact = ""
+        if not skip_codan:
+            impact = _impact_text_l(ev, k, L, A, strict_subs, refseq,
+                                    skip_codan, motifs)
+        out.append((aa, aapos, rctx, status, impact))
+    return out
+
+
+def analyze_events_columnar(refseq: bytes, events,
+                            skip_codan: bool = False,
+                            motifs=DEFAULT_MOTIFS,
+                            max_ev: int = HOST_MAX_EV) -> list:
+    """Columnar host analysis of a batch of DiffEvents against one
+    reference: a list of (aa, aapos, rctx, status, impact) tuples in
+    event order, byte-identical to mapping ``analyze_event_host`` over
+    the batch (events the formulas can't reproduce exactly are routed
+    there — see the module docstring)."""
+    if not events:
+        return []
+    results: dict[int, tuple] = {}
+    small = [ev for ev in events
+             if len(ev.evtbases) <= max_ev and len(ev.evtsub) <= max_ev]
+    if small:
+        ref_len = len(refseq)
+        ev = pack_events_np(small, max_ev, bucket=0)
+        # scalar-route suspicious lanes: non-ACGT event bases always;
+        # windows touching non-ACGT reference bases when the reference
+        # holds any (code-space vs byte-space divergence, see module
+        # docstring)
+        suspicious = (
+            ((ev["evtbases"] >= CODE_N) & (ev["evtbases"] != PAD))
+            .any(axis=1)
+            | ((ev["evtsub"] >= CODE_N) & (ev["evtsub"] != PAD))
+            .any(axis=1))
+        ref_codes = encode(refseq.upper())
+        ref_h = np.full(ref_len + max_ev + 3, PAD, np.int8)
+        ref_h[:ref_len] = ref_codes
+        mot_codes, mot_lens = pack_motifs_np(motifs)
+        host = host_ctx_scan(ref_h, ref_len, ev, mot_codes, mot_lens,
+                             max_codons=max_ev // 3 + 2,
+                             skip_codan=skip_codan)
+        if (ref_codes >= CODE_N).any():
+            suspicious |= (host["rctx"] >= CODE_N).any(axis=1)
+        for e, r in zip(small, assemble_results(
+                small, host, refseq, motifs, skip_codan,
+                defer=suspicious.tolist(), strict_subs=True)):
+            results[id(e)] = r
+    for e in events:
+        if id(e) not in results:   # oversized: scalar path
+            results[id(e)] = analyze_event_host(e, refseq, skip_codan,
+                                                motifs)
+    return [results[id(e)] for e in events]
+
+
+def emit_batch_rows(batch, analyzed: dict, f,
+                    summary: Summary | None) -> None:
+    """Write one batch's report rows from per-event analysis results —
+    the emit loop shared by the device finish path and the host
+    columnar path.  One writer call per batch (the per-row write
+    syscalls were measurable at realistic scale)."""
+    rows: list[str] = []
+    for aln, rlabel, tlabel, _refseq in batch:
+        rows.append(format_header(aln, rlabel, tlabel))
+        if summary is not None:
+            summary.add_alignment(aln)
+            for di in aln.tdiffs:
+                aa, aapos, rctx, status, impact = analyzed[id(di)]
+                summary.add_event(di, status, impact)
+                rows.append(format_event_row(di, aa, aapos, rctx,
+                                             status, impact))
+        else:
+            for di in aln.tdiffs:
+                aa, aapos, rctx, status, impact = analyzed[id(di)]
+                rows.append(format_event_row(di, aa, aapos, rctx,
+                                             status, impact))
+    f.write("".join(rows))
+
+
+def print_diff_info_batch_host(batch, f, skip_codan: bool = False,
+                               motifs=DEFAULT_MOTIFS, summary=None,
+                               stats=None) -> None:
+    """Analyze and emit one report batch on the host, columnar: events
+    group per distinct refseq (like the device path), one vectorized
+    analysis per group, then rows in exactly the order the scalar path
+    would produce.  ``batch`` is a list of (aln, rlabel, tlabel,
+    refseq) in input order.
+
+    A PwasmError during analysis (the reference's fatal
+    modseq-vs-evtsub verification) replays the whole batch through the
+    scalar path, which writes rows progressively and raises at exactly
+    the failing event — the same observable behavior, bytes and
+    message, as the per-line scalar loop."""
+    groups: dict[bytes, list] = {}
+    for aln, _rl, _tl, refseq in batch:
+        groups.setdefault(refseq, []).extend(aln.tdiffs)
+    analyzed: dict[int, tuple] = {}
+    try:
+        for refseq, events in groups.items():
+            for ev, r in zip(events, analyze_events_columnar(
+                    refseq, events, skip_codan, motifs)):
+                analyzed[id(ev)] = r
+    except PwasmError:
+        # nothing has been written yet: the scalar replay reproduces
+        # the progressive writes up to the failing event, then raises
+        # the scalar-exact error
+        for aln, rlabel, tlabel, refseq in batch:
+            print_diff_info(aln, rlabel, tlabel, f, refseq,
+                            skip_codan=skip_codan, motifs=motifs,
+                            summary=summary)
+        raise   # unreachable in practice: the replay raises first
+    emit_batch_rows(batch, analyzed, f, summary)

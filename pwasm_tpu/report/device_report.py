@@ -8,6 +8,16 @@ program; the host slices the 9bp context strings (O(9) per event, and
 byte-faithful for IUPAC ambiguity characters that the int8 code space
 collapses to N) and formats rows with the shared formatter.
 
+Dispatch budget (VERDICT r5 item 3): through a tunnel every host<->device
+round-trip costs ~1-2 ms, so the flush path is transfer-lean by design —
+events ship as two stacked tensors, the reference pads to a power-of-two
+bucket (one compiled program per bucket, not per ref length), and the
+whole analysis returns as ONE packed int32 fetch
+(``ctx_scan_packed``/``unpack_ctx_scan``) instead of ~16 per-field
+round-trips.  Every launch/fetch is counted on ``RunStats``
+(``device_dispatches``/``device_flushes``) and gated at realistic scale
+by tests/test_realistic_scale.py.
+
 Scope limits (callers fall back to the scalar path per event when hit):
 - events longer than ``max_ev`` bases;
 - references longer than ``max_len - max_ev`` (the frameshift stop-scan
@@ -23,16 +33,14 @@ import numpy as np
 from pwasm_tpu.core.config import DEFAULT_MOTIFS
 from pwasm_tpu.core.dna import encode
 from pwasm_tpu.core.errors import PwasmError
-from pwasm_tpu.ops.ctx_scan import (PAD as PAD_CODE, ctx_scan, pack_events,
-                                    pack_motifs)
-from pwasm_tpu.report.diff_report import get_ref_context
+from pwasm_tpu.ops.ctx_scan import (PAD as PAD_CODE, ctx_scan_packed,
+                                    pack_events, pack_motifs,
+                                    ref_bucket_len, unpack_ctx_scan)
+from pwasm_tpu.report.columnar import assemble_results, emit_batch_rows
+from pwasm_tpu.report.diff_report import get_ref_context  # noqa: F401
 
 MAX_EV = 16
 _warned_fallback = False
-
-
-def _round_up(x: int, m: int) -> int:
-    return (x + m - 1) // m * m
 
 
 def _pad_axis0(v, n: int):
@@ -58,10 +66,11 @@ def submit_events_device(refseq: bytes, events,
     results.
 
     JAX dispatch is asynchronous, so between ``submit`` and ``finish``
-    the device computes while the host does other work — the CLI overlaps
-    batch k's device program with batch k-1's host formatting, which
-    hides the transfer/launch latency entirely (one batch in flight).
-    Events over ``max_ev`` bases take the scalar path inside finish().
+    the device computes while the host does other work — the CLI keeps a
+    two-deep in-flight pipeline, so batch k's device program overlaps the
+    host formatting of batches k-1/k-2, hiding the transfer/launch
+    latency.  Events over ``max_ev`` bases take the scalar path inside
+    finish().
 
     ``supervisor`` (resilience.BatchSupervisor) supervises the device
     round-trip: the fetched outputs are guardrail-validated, a failed
@@ -77,7 +86,13 @@ def submit_events_device(refseq: bytes, events,
     if not events:
         return lambda: []
     ref_len = len(refseq)
-    max_len = _round_up(ref_len + max_ev + 3, 256)
+    max_codons = max_ev // 3 + 2
+    # pad the reference to a power-of-two bucket so the jitted program
+    # is keyed on the bucket, not the exact ref length — a handful of
+    # compiled programs serve every flush and every reference;
+    # positions >= ref_len hold PAD, which never matches a base and is
+    # masked by ref_len elsewhere
+    max_len = ref_bucket_len(ref_len, max_ev)
     fits = [len(ev.evtbases) <= max_ev and len(ev.evtsub) <= max_ev
             for ev in events]
     small = [ev for ev, ok in zip(events, fits) if ok]
@@ -86,10 +101,6 @@ def submit_events_device(refseq: bytes, events,
     launch = None
     if small:
         mot_codes, mot_lens = pack_motifs(motifs)
-        # pad the reference to the (256-rounded) max_len so the jitted
-        # program is keyed on the bucket, not the exact ref length — one
-        # compilation serves every flush; positions >= ref_len hold PAD,
-        # which never matches a base and is masked by ref_len elsewhere
         ref_codes = np.full(max_len, PAD_CODE, dtype=np.int8)
         ref_codes[:ref_len] = encode(refseq.upper())
 
@@ -111,10 +122,11 @@ def submit_events_device(refseq: bytes, events,
                             tuple(mesh.axis_names),
                             *([None] * (v.ndim - 1)))))
                     for k, v in packed.items()}
-            return ctx_scan(jnp.asarray(ref_codes),
-                            jnp.int32(ref_len), packed, mot_codes,
-                            mot_lens, max_codons=max_ev // 3 + 2,
-                            max_len=max_len, skip_codan=skip_codan)
+            return ctx_scan_packed(jnp.asarray(ref_codes),
+                                   jnp.int32(ref_len), packed, mot_codes,
+                                   mot_lens, max_codons=max_codons,
+                                   max_len=max_len,
+                                   skip_codan=skip_codan)
 
         if supervisor is None:
             out = launch()
@@ -123,6 +135,10 @@ def submit_events_device(refseq: bytes, events,
                 out = launch()   # async submit; failures retried at
             except Exception:    # finish inside the supervised attempt
                 out = None
+
+    def fetch_unpack(o) -> dict:
+        # ONE host fetch for the whole analysis, then numpy views
+        return unpack_ctx_scan(np.asarray(o), max_codons, skip_codan)
 
     def finish() -> list[tuple]:
         results: dict[int, tuple] = {}
@@ -134,7 +150,7 @@ def submit_events_device(refseq: bytes, events,
                 def attempt():
                     o = pending.pop() if pending else None
                     o = launch() if o is None else o
-                    return {k: np.asarray(v) for k, v in o.items()}
+                    return fetch_unpack(o)
 
                 host = supervisor.run(
                     "ctx_scan", attempt,
@@ -142,28 +158,22 @@ def submit_events_device(refseq: bytes, events,
                         h, len(small), ref_len, len(motifs),
                         skip_codan))
             else:
-                host = {k: np.asarray(v) for k, v in out.items()}
+                if stats is not None \
+                        and hasattr(stats, "note_dispatch"):
+                    # unsupervised direct call: count the round-trip
+                    # here (supervised runs count inside supervisor.run)
+                    stats.note_dispatch("ctx_scan")
+                    stats.note_flush()
+                host = fetch_unpack(out)
             if stats is not None:
                 # per-event routing observability (VERDICT r4 weak #6):
                 # credited only AFTER the device fetch succeeded — a
                 # failed batch is replayed on host and must count as
                 # scalar there, not here
                 stats.device_events += len(small)
-            for k, ev in enumerate(small):
-                ev.evtbases = ev.evtbases.upper()
-                aa = chr(int(host["aa"][k]))
-                aapos = int(host["aapos"][k])
-                rctx, _ = get_ref_context(refseq, ev.rloc)
-                if host["hpoly"][k]:
-                    status = "homopolymer"
-                elif host["motif"][k] > 0:
-                    status = f"motif {motifs[int(host['motif'][k]) - 1]}"
-                else:
-                    status = "[unknown]"
-                impact = ""
-                if not skip_codan:
-                    impact = _impact_text(ev, k, host)
-                results[id(ev)] = (aa, aapos, rctx, status, impact)
+            for ev, r in zip(small, assemble_results(
+                    small, host, refseq, motifs, skip_codan)):
+                results[id(ev)] = r
         if big and stats is not None:
             stats.scalar_events += len(big)
         for ev in big:
@@ -193,16 +203,14 @@ def submit_diff_info_batch(batch, f, skip_codan: bool = False,
     ``finish() -> None`` closure that fetches the results and writes the
     rows (the SURVEY.md §3.1 TPU boundary: host parse -> batch -> one
     device program -> host format — with the device program of batch k
-    overlapping the host formatting of batch k-1, see the CLI).
+    overlapping the host formatting of earlier batches, see the CLI).
 
     ``batch`` is a list of (aln: PafAlignment, rlabel, tlabel,
     refseq: bytes) in input order.  Events are grouped per distinct
     refseq (the device program is specialized on the reference tensor),
     analyzed in one ``ctx_scan`` call per group, then rows are emitted in
     exactly the order the scalar path would produce."""
-    from pwasm_tpu.report.diff_report import (format_event_row,
-                                              format_header,
-                                              print_diff_info)
+    from pwasm_tpu.report.diff_report import print_diff_info
 
     def scalar_replay(e: Exception) -> None:
         # the batch analysis failed before any row was written; replay
@@ -272,16 +280,7 @@ def submit_diff_info_batch(batch, f, skip_codan: bool = False,
                 stats.device_events, stats.scalar_events = snap
             scalar_replay(e)
             return
-        for aln, rlabel, tlabel, refseq in batch:
-            f.write(format_header(aln, rlabel, tlabel))
-            if summary is not None:
-                summary.add_alignment(aln)
-            for di in aln.tdiffs:
-                aa, aapos, rctx, status, impact = analyzed[id(di)]
-                if summary is not None:
-                    summary.add_event(di, status, impact)
-                f.write(format_event_row(di, aa, aapos, rctx, status,
-                                         impact))
+        emit_batch_rows(batch, analyzed, f, summary)
 
     return finish
 
@@ -294,33 +293,6 @@ def print_diff_info_batch(batch, f, skip_codan: bool = False,
                            max_ev)()
 
 
-def _impact_text(ev, k: int, host: dict) -> str:
-    """Assemble predictImpact's text from the device outputs
-    (pafreport.cpp:804-883 semantics)."""
-    if ev.evt == "S":
-        if host["s_mismatch"][k]:
-            raise PwasmError(
-                "Error: modseq not matching di.evtsub !\n")
-        parts = []
-        for d in range(host["s_orig_aa"].shape[1]):
-            if not host["s_valid"][k, d]:
-                break
-            aa = chr(int(host["s_orig_aa"][k, d]))
-            maa = chr(int(host["s_new_aa"][k, d]))
-            if aa != maa:
-                aapos = int(host["s_aapos"][k, d])
-                s = f"AA{aapos}|{aa}:{maa}"
-                if maa == ".":
-                    s += f"|premature stop at AA{aapos}"
-                parts.append(s)
-        return ", ".join(parts) if parts else "synonymous"
-    stop = int(host["stop_aapos"][k])
-    if stop >= 0:
-        return f"premature stop at AA{stop}"
-    aa4 = "".join(chr(int(c)) for c, v in
-                  zip(host["aa4"][k], host["aa4_valid"][k]) if v)
-    maa4 = "".join(chr(int(c)) for c, v in
-                   zip(host["maa4"][k], host["maa4_valid"][k]) if v)
-    if aa4 and maa4:
-        return f"frame shift {aa4}+:{maa4}+"
-    return ""
+# (predictImpact text assembly lives in report/columnar.py
+# ``_impact_text_l``, shared by the device finish path and the host
+# columnar engine through ``assemble_results``.)

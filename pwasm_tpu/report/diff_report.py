@@ -161,9 +161,12 @@ class Summary:
         self.aligned_bases += al.r_alnend - al.r_alnstart
 
     def add_event(self, di: DiffEvent, status: str, impact: str) -> None:
-        self.events[di.evt] = self.events.get(di.evt, 0) + 1
-        nb = len(di.evtbases) if di.evt != "D" else di.evtlen
-        self.bases[di.evt] = self.bases.get(di.evt, 0) + nb
+        evt = di.evt
+        events = self.events
+        events[evt] = events.get(evt, 0) + 1
+        nb = len(di.evtbases) if evt != "D" else di.evtlen
+        bases = self.bases
+        bases[evt] = bases.get(evt, 0) + nb
         if status == "homopolymer":
             self.status["homopolymer"] += 1
         elif status.startswith("motif"):
@@ -171,10 +174,10 @@ class Summary:
         else:
             self.status["unknown"] += 1
         if impact:
-            if "premature stop" in impact:
-                self.impact["premature_stop"] += 1
-            elif impact == "synonymous":
+            if impact == "synonymous":
                 self.impact["synonymous"] += 1
+            elif "premature stop" in impact:
+                self.impact["premature_stop"] += 1
             elif impact.startswith("frame shift"):
                 self.impact["frame_shift"] += 1
             else:
@@ -237,13 +240,13 @@ def format_event_row(di: DiffEvent, aa: str, aapos: int, rctx: bytes,
         dlen = len(tcontext) - 10
         tcontext = (di.tctx[:5] + b"[" + str(dlen).encode() + b"]"
                     + di.tctx[-5:])
-    evtbases = _truncate_display(di.evtbases)
-    evtsub = _truncate_display(di.evtsub)
+    evtbases = di.evtbases if len(di.evtbases) <= MAX_EVLEN \
+        else _truncate_display(di.evtbases)
     tctx_s = tcontext.decode("ascii", "replace")
     rctx_s = rctx.decode("ascii", "replace")
     eb = evtbases.decode("ascii", "replace")
     if di.evt == "S":
-        es = evtsub.decode("ascii", "replace")
+        es = _truncate_display(di.evtsub).decode("ascii", "replace")
         mid = f"{es}:{eb}"
     elif di.evt == "I":
         mid = f":{eb}"

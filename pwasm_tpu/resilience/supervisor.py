@@ -14,12 +14,20 @@ fail-fast model lacks (SURVEY.md §2.5.12 vs §5):
   walk away from a hung XLA call);
 - **guardrail validation** — out-of-domain output counts as a fault
   and is re-executed, never formatted;
-- a **circuit breaker** — after N *consecutive* failures the device is
-  declared unhealthy (one bounded ``probe_backend`` check supplies the
-  diagnostic), and every later call degrades straight to its host
-  fallback without touching the device again.  A healthy probe
-  half-opens the breaker instead: the failures were computational, not
-  a dead backend, so device attempts continue;
+- a **circuit breaker** with PER-SITE failure windows — after N
+  *consecutive* failures at one site (ctx_scan / realign / consensus /
+  refine / many2many; thresholds overridable per site via
+  ``ResiliencePolicy.site_thresholds``) the device is suspected
+  unhealthy and one bounded ``probe_backend`` check supplies the
+  diagnostic.  An unreachable probe opens the breaker **globally** (a
+  dead backend fails every site) and every later call degrades
+  straight to its host fallback without touching the device again.  A
+  healthy probe half-opens that site instead: the failures were
+  computational, not a dead backend, so device attempts continue — but
+  a site that keeps exhausting its window (``site_trip_limit``
+  half-opens) trips its OWN breaker: a persistently-miscompiling
+  program must stop burning retries at that site while the other sites
+  keep their device path;
 - the degradation **policy**: ``--fallback=cpu`` (default) runs the
   bit-exact host path, ``--fallback=fail`` aborts the run loudly with
   a :class:`ResilienceError` — for pipelines where silent CPU walls
@@ -66,12 +74,27 @@ class ResiliencePolicy:
     jitter: float = 0.5           # +[0, jitter) fraction of the delay
     deadline_s: float | None = None  # per-attempt wall ceiling
     fallback: str = "cpu"         # cpu = degrade to host; fail = abort
-    breaker_threshold: int = 5    # consecutive failures to trip
+    breaker_threshold: int = 5    # consecutive failures (per site) to
+    #                               suspect the backend and probe it
+    site_thresholds: dict | None = None  # per-site overrides of
+    #                               breaker_threshold, e.g.
+    #                               {"ctx_scan": 3, "realign": 8}
+    site_trip_limit: int = 3      # healthy-probe half-opens before a
+    #                               site's OWN breaker trips (the
+    #                               persistently-failing-program case)
+
+    def threshold_for(self, site: str) -> int:
+        if self.site_thresholds:
+            return int(self.site_thresholds.get(
+                site, self.breaker_threshold))
+        return self.breaker_threshold
 
 
 class BatchSupervisor:
-    """One per run, shared by every supervised site (the breaker state
-    is global on purpose: a dead backend fails every site).
+    """One per run, shared by every supervised site.  Failure windows
+    are PER SITE (a guardrail storm at ctx_scan must not charge the
+    realign site's breaker); the probe-confirmed-dead-backend breaker
+    stays global on purpose: a dead backend fails every site.
 
     ``stats`` is the run's ``RunStats`` (resilience counters optional —
     missing attributes are ignored so the class also works bare).
@@ -86,8 +109,11 @@ class BatchSupervisor:
         self.stderr = stderr if stderr is not None else sys.stderr
         self.faults = faults
         self._probe = probe
-        self._consecutive = 0
-        self.breaker_open = False
+        self._consecutive: dict[str, int] = {}  # site -> failure window
+        self._half_opens: dict[str, int] = {}   # site -> healthy-probe
+        #                                         half-open count
+        self._site_open: set[str] = set()       # per-site open breakers
+        self.breaker_open = False               # global (backend dead)
         # jitter exists to de-synchronize retry storms across the many
         # processes of a batch fleet, so it must be seeded per process
         # (a fixed seed would make every process retry at the same
@@ -119,6 +145,9 @@ class BatchSupervisor:
         if self.breaker_open:
             return self._degrade(site, fallback, "circuit breaker open",
                                  None)
+        if site in self._site_open:
+            return self._degrade(site, fallback,
+                                 f"site breaker open ({site})", None)
         delay = self.policy.backoff_s
         last: BaseException | None = None
         for k in range(self.policy.max_retries + 1):
@@ -129,10 +158,17 @@ class BatchSupervisor:
                                self.policy.backoff_cap_s))
                 delay *= 2
             try:
+                if self.stats is not None \
+                        and hasattr(self.stats, "note_dispatch"):
+                    # dispatch-budget observability: every supervised
+                    # attempt is one device round-trip (launch + the
+                    # host-blocking fetch the attempt ends in)
+                    self.stats.note_dispatch(site)
+                    self.stats.note_flush()
                 result = self._attempt_once(site, attempt)
                 if validate is not None:
                     validate(result)
-                self._consecutive = 0
+                self._consecutive[site] = 0
                 return result
             except GuardrailViolation as e:
                 self._count("res_guardrail_rejects")
@@ -195,34 +231,59 @@ class BatchSupervisor:
         return box["ok"]
 
     # ---- failure accounting / breaker ----------------------------------
+    def consecutive(self, site: str) -> int:
+        """This site's current consecutive-failure window."""
+        return self._consecutive.get(site, 0)
+
+    def site_breaker_open(self, site: str) -> bool:
+        return site in self._site_open
+
     def _note_failure(self, site: str, err: BaseException) -> bool:
-        """Record one failed attempt; returns True when the breaker
-        just opened (stop retrying)."""
-        self._consecutive += 1
-        if self.breaker_open \
-                or self._consecutive < self.policy.breaker_threshold:
+        """Record one failed attempt at ``site``; returns True when a
+        breaker (global or this site's) just opened (stop retrying)."""
+        self._consecutive[site] = self.consecutive(site) + 1
+        threshold = self.policy.threshold_for(site)
+        if self.breaker_open or self.consecutive(site) < threshold:
             return False
         ok, why = self._probe_backend()
         if ok:
             # backend is reachable: the failures are computational
-            # (bad batch, guardrail rejects) — half-open and keep
-            # attempting rather than walling off a healthy device
-            self._consecutive = 0
-            self._warn(f"{site}: {self._consecutive_msg()} but the "
+            # (bad batch, guardrail rejects) — half-open THIS SITE and
+            # keep attempting rather than walling off a healthy device.
+            # A site that keeps exhausting its window is its own
+            # problem, though: after site_trip_limit half-opens its own
+            # breaker trips so a persistently-failing program stops
+            # burning retries while the other sites stay on device.
+            self._consecutive[site] = 0
+            self._half_opens[site] = self._half_opens.get(site, 0) + 1
+            if self._half_opens[site] >= self.policy.site_trip_limit:
+                self._site_open.add(site)
+                # counted SEPARATELY from the global trip: operators
+                # page on res_breaker_trips (dead backend); a site trip
+                # on a healthy backend is a different, softer alarm
+                self._count("res_site_breaker_trips")
+                self._warn(
+                    f"{site}: {self._consecutive_msg(site)} for the "
+                    f"{self._half_opens[site]}th time with a healthy "
+                    "backend — SITE breaker OPEN, degrading this "
+                    "site's device work to the host path for the rest "
+                    "of the run")
+                return True
+            self._warn(f"{site}: {self._consecutive_msg(site)} but the "
                        "backend probes healthy; breaker half-open")
             return False
         self.breaker_open = True
         # counted only when the breaker actually OPENS — a healthy-probe
         # half-open above is not a trip, and operators alert on this
         self._count("res_breaker_trips")
-        self._warn(f"{site}: {self._consecutive_msg()}; backend probe "
-                   f"says: {why.strip() or 'unreachable'} — circuit "
-                   "breaker OPEN, degrading device work to the host "
-                   "path for the rest of the run")
+        self._warn(f"{site}: {self._consecutive_msg(site)}; backend "
+                   f"probe says: {why.strip() or 'unreachable'} — "
+                   "circuit breaker OPEN, degrading device work to the "
+                   "host path for the rest of the run")
         return True
 
-    def _consecutive_msg(self) -> str:
-        return (f"{self.policy.breaker_threshold} consecutive device "
+    def _consecutive_msg(self, site: str) -> str:
+        return (f"{self.policy.threshold_for(site)} consecutive device "
                 "failures")
 
     def _probe_backend(self) -> tuple[bool, str]:

@@ -3,10 +3,16 @@
 Runs the Nanopore-like corpus from tests/test_realistic_scale.py
 through the full CLI (report + summary + MSA + consensus) on
 --device=cpu and --device=tpu, printing wall times and the RunStats
-routing counters as one JSON line each — the numbers BASELINE.md's
-"realistic scale" section records.  Usage:
+routing + dispatch-budget counters as one JSON line each — the numbers
+BASELINE.md's "realistic scale" section records.  Usage:
 
-    python qa/realistic_scale.py [n_aln]
+    python qa/realistic_scale.py [n_aln] [fault_spec]
+
+With ``fault_spec`` (e.g. ``seed=7,rate=0.3,kinds=raise+nan+corrupt``)
+a third CHAOS leg runs the device path under seeded fault injection at
+the same scale and asserts its output stays byte-identical to the
+clean device run (ROADMAP PR-1 follow-up: resilience exercised at
+realistic scale, not just in unit fixtures).
 """
 
 import io
@@ -24,6 +30,7 @@ sys.path.insert(0, os.path.join(ROOT, "tests"))
 
 def main() -> int:
     n_aln = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+    fault_spec = sys.argv[2] if len(sys.argv) > 2 else ""
     from test_realistic_scale import make_corpus
 
     from pwasm_tpu.cli import run
@@ -39,18 +46,35 @@ def main() -> int:
         with open(paf, "w") as f:
             f.write("".join(l + "\n" for l in lines))
         paf_mb = os.path.getsize(paf) / 1e6
-        for dev in ("cpu", "tpu"):
+        legs = [("cpu", []), ("tpu", [])]
+        if fault_spec:
+            # --batch=16: the dispatch-lean pipeline leaves only ~2
+            # supervised round-trips per run at the default batch, too
+            # few draw opportunities for the fault plan (see
+            # docs/RESILIENCE.md) — and batch size never changes bytes
+            legs.append(("chaos", ["--batch=16",
+                                   f"--inject-faults={fault_spec}",
+                                   "--max-retries=4"]))
+        body = {}
+        for dev, extra in legs:
+            plat = "tpu" if dev == "chaos" else dev
             outs = {k: os.path.join(d, f"{dev}.{k}")
                     for k in ("dfa", "sum", "mfa", "cons", "stats")}
             err = io.StringIO()
             t0 = time.perf_counter()
             rc = run([paf, "-r", fa, "-o", outs["dfa"],
                       "-s", outs["sum"], "-w", outs["mfa"],
-                      f"--cons={outs['cons']}", f"--device={dev}",
-                      f"--stats={outs['stats']}"], stderr=err)
+                      f"--cons={outs['cons']}", f"--device={plat}",
+                      f"--stats={outs['stats']}"] + extra, stderr=err)
             wall = time.perf_counter() - t0
             st = json.loads(open(outs["stats"]).read()) if rc == 0 \
                 else {}
+            body[dev] = b"".join(
+                open(outs[k], "rb").read()
+                for k in ("dfa", "sum", "mfa", "cons")) if rc == 0 \
+                else None
+            if dev == "chaos":
+                chaos_res = st.get("resilience", {})
             print(json.dumps({
                 "corpus": {"n_aln": n_aln, "paf_mb": round(paf_mb, 2),
                            "gen_s": round(gen_s, 2)},
@@ -62,6 +86,9 @@ def main() -> int:
                 "scalar_events": st.get("scalar_events"),
                 "fallback_batches": st.get("fallback_batches"),
                 "engine_fallbacks": st.get("engine_fallbacks"),
+                "device_dispatch": st.get("device"),
+                "resilience": st.get("resilience") if dev == "chaos"
+                else None,
                 "bases_per_s": round(
                     st.get("aligned_bases", 0) / wall) if rc == 0
                 else None,
@@ -69,6 +96,17 @@ def main() -> int:
             if rc != 0:
                 sys.stderr.write(err.getvalue()[-1000:])
                 return rc
+        if fault_spec:
+            ok = body["chaos"] == body["tpu"]
+            injected = chaos_res.get("injected_faults", 0)
+            print(json.dumps({"chaos_byte_identical": ok,
+                              "chaos_injected_faults": injected}))
+            if injected == 0:
+                print("warning: the fault plan never fired — raise "
+                      "rate= or lower --batch further",
+                      file=sys.stderr)
+            if not ok:
+                return 1
     return 0
 
 

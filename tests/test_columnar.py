@@ -1,0 +1,178 @@
+"""Columnar host analysis (report/columnar.py): byte-exact parity with
+the scalar ground truth, the scalar-routing escape hatches, the batch
+CLI engine switch, and the dispatch-budget counters."""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from pwasm_tpu.cli import run
+from pwasm_tpu.core.dna import revcomp
+from pwasm_tpu.core.errors import PwasmError
+from pwasm_tpu.core.events import DiffEvent, extract_alignment
+from pwasm_tpu.core.paf import parse_paf_line
+from pwasm_tpu.report.columnar import analyze_events_columnar
+from pwasm_tpu.report.diff_report import analyze_event_host
+
+from helpers import make_paf_line
+from test_events import _random_ops
+
+
+def _events_for(q, line):
+    rec = parse_paf_line(line)
+    refseq_aln = revcomp(q) if rec.alninfo.reverse else q
+    return extract_alignment(rec, refseq_aln).tdiffs, refseq_aln
+
+
+def _copy(events):
+    return [DiffEvent(evt=e.evt, evtlen=e.evtlen, evtbases=e.evtbases,
+                      evtsub=e.evtsub, rloc=e.rloc, tloc=e.tloc,
+                      tctx=e.tctx) for e in events]
+
+
+def _assert_parity(q, events, skip_codan=False, motifs=None):
+    kw = {} if motifs is None else {"motifs": motifs}
+    scalar_ev = _copy(events)
+    col = analyze_events_columnar(q, events, skip_codan, **kw)
+    scal = [analyze_event_host(e, q, skip_codan, **kw)
+            for e in scalar_ev]
+    assert col == scal
+    # both paths upper-case evtbases in place
+    for a, b in zip(events, scalar_ev):
+        assert a.evtbases == b.evtbases
+
+
+@pytest.mark.parametrize("strand", ["+", "-"])
+@pytest.mark.parametrize("skip_codan", [False, True])
+def test_columnar_fuzz_parity(strand, skip_codan):
+    rng = np.random.default_rng(42 if strand == "+" else 43)
+    for trial in range(25):
+        n = int(rng.integers(30, 220))
+        q = "".join(rng.choice(list("ACGT"), size=n))
+        q_aln = revcomp(q.encode()).decode() if strand == "-" else q
+        ops = _random_ops(rng, q_aln)
+        line, _ = make_paf_line("q", q, "t", strand, ops)
+        events, refseq_aln = _events_for(q.encode(), line)
+        if not events:
+            continue
+        _assert_parity(q.encode().upper(), events,
+                       skip_codan=skip_codan)
+
+
+def test_columnar_edge_positions():
+    # events at the very first/last bases exercise the context window
+    # edge clamps (incl. the reference's wrong-sign right-edge quirk)
+    q = b"ATGGCCTGGAAAGATCTGTACCTGACGT"
+    events = [DiffEvent(evt="S", evtlen=1, evtbases=b"A", evtsub=b"C",
+                        rloc=r, tloc=r, tctx=b"ACGTACGT")
+              for r, c in ((0, "G"), (1, "T"), (26, "A"), (27, "C"))]
+    for e, sub in zip(events, (b"A", b"T", b"G", b"T")):
+        e.evtsub = q[e.rloc:e.rloc + 1]     # consistent with the ref
+    _assert_parity(q, events)
+
+
+def test_columnar_degenerate_short_ref():
+    # <9bp reference: get_ref_context's degenerate clamp branch
+    q = b"ATGACG"
+    events = [DiffEvent(evt="S", evtlen=1, evtbases=b"C",
+                        evtsub=q[2:3], rloc=2, tloc=2, tctx=b"ATG"),
+              DiffEvent(evt="D", evtlen=2, evtbases=b"AC",
+                        evtsub=b"", rloc=3, tloc=3, tctx=b"ATG")]
+    _assert_parity(q, events)
+
+
+def test_columnar_iupac_routes_scalar():
+    # non-ACGT content must not change results: the code space
+    # collapses IUPAC to N, so these events route through the scalar
+    # analyzer — parity is the contract either way
+    q = b"ATGGNNCTGGAARRATCTGTACCTGA"
+    events = [
+        DiffEvent(evt="S", evtlen=1, evtbases=b"C", evtsub=q[4:5],
+                  rloc=4, tloc=4, tctx=b"GGNNC"),     # sub of an N
+        DiffEvent(evt="I", evtlen=3, evtbases=b"RRR", evtsub=b"",
+                  rloc=8, tloc=8, tctx=b"TGGAA"),     # IUPAC insert
+        DiffEvent(evt="S", evtlen=1, evtbases=b"T", evtsub=q[12:13],
+                  rloc=12, tloc=12, tctx=b"AARRA"),   # IUPAC window
+    ]
+    _assert_parity(q, events)
+
+
+def test_columnar_oversized_events_route_scalar():
+    q = bytes(np.random.default_rng(7).choice(list(b"ACGT"), 400))
+    big = b"A" * 80    # > HOST_MAX_EV: must take the scalar path
+    events = [DiffEvent(evt="I", evtlen=len(big), evtbases=big,
+                        evtsub=b"", rloc=200, tloc=200, tctx=b"ACGT"),
+              DiffEvent(evt="S", evtlen=1, evtbases=b"C",
+                        evtsub=q[100:101], rloc=100, tloc=100,
+                        tctx=b"ACGT")]
+    _assert_parity(q, events)
+
+
+def test_columnar_sub_mismatch_raises_scalar_message():
+    # the reference's fatal modseq-vs-evtsub verification: the columnar
+    # path must raise the scalar path's exact message (with indices)
+    q = b"ATGGCCTGGAAAGATCTGTACCTGA"
+    bad = DiffEvent(evt="S", evtlen=1, evtbases=b"C", evtsub=b"T",
+                    rloc=9, tloc=9, tctx=b"ACGT")  # q[9] is 'A' != 'T'
+    with pytest.raises(PwasmError) as col_err:
+        analyze_events_columnar(q, [bad])
+    bad2 = DiffEvent(evt="S", evtlen=1, evtbases=b"C", evtsub=b"T",
+                     rloc=9, tloc=9, tctx=b"ACGT")
+    with pytest.raises(PwasmError) as scal_err:
+        analyze_event_host(bad2, q, False)
+    assert str(col_err.value) == str(scal_err.value)
+    assert "modseq[" in str(col_err.value)
+
+
+def test_cli_host_engines_byte_identical(tmp_path, monkeypatch):
+    # the CLI's two host report engines (columnar default, scalar via
+    # PWASM_HOST_COLUMNAR=0) produce identical report+summary bytes
+    rng = np.random.default_rng(11)
+    q = "".join(rng.choice(list("ACGT"), size=180))
+    lines = []
+    for k in range(12):
+        strand = "-" if k % 3 == 0 else "+"
+        q_aln = revcomp(q.encode()).decode() if strand == "-" else q
+        ops = _random_ops(rng, q_aln)
+        lines.append(make_paf_line("q", q, f"t{k}", strand, ops)[0])
+    fa = tmp_path / "q.fa"
+    fa.write_text(f">q\n{q}\n")
+    paf = tmp_path / "in.paf"
+    paf.write_text("".join(l + "\n" for l in lines))
+    outs = {}
+    for tag, flag in (("col", "1"), ("scalar", "0")):
+        monkeypatch.setenv("PWASM_HOST_COLUMNAR", flag)
+        rep = tmp_path / f"{tag}.dfa"
+        summ = tmp_path / f"{tag}.sum"
+        rc = run([str(paf), "-r", str(fa), "-o", str(rep),
+                  "-s", str(summ), "--batch=5"], stderr=io.StringIO())
+        assert rc == 0
+        outs[tag] = rep.read_bytes() + summ.read_bytes()
+    assert outs["col"] == outs["scalar"]
+
+
+def test_cpu_path_batch_checkpoints(tmp_path):
+    # the CPU report path now leaves batch-granular checkpoints during
+    # the run (PR-1 durability extended beyond the device path); the
+    # completed run removes the ckpt and the stats count the writes
+    rng = np.random.default_rng(5)
+    q = "".join(rng.choice(list("ACGT"), size=120))
+    lines = []
+    for k in range(9):
+        ops = _random_ops(rng, q)
+        lines.append(make_paf_line("q", q, f"t{k}", "+", ops)[0])
+    fa = tmp_path / "q.fa"
+    fa.write_text(f">q\n{q}\n")
+    paf = tmp_path / "in.paf"
+    paf.write_text("".join(l + "\n" for l in lines))
+    rep = tmp_path / "r.dfa"
+    stats = tmp_path / "r.stats"
+    rc = run([str(paf), "-r", str(fa), "-o", str(rep), "--batch=2",
+              f"--stats={stats}"], stderr=io.StringIO())
+    assert rc == 0
+    st = json.loads(stats.read_text())
+    # 9 alignments at batch 2 -> 5 flushes, each checkpointed
+    assert st["resilience"]["checkpoints"] >= 4
+    assert not (tmp_path / "r.dfa.ckpt").exists()  # removed when whole

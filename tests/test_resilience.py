@@ -95,7 +95,7 @@ def test_supervisor_retries_then_succeeds():
 
     assert sup.run("s", flaky) == "ok"
     assert st.res_retries == 2 and st.res_fallbacks == 0
-    assert sup._consecutive == 0   # success resets the breaker window
+    assert sup.consecutive("s") == 0  # success resets the site window
 
 
 def test_supervisor_guardrail_reject_reexecutes():
@@ -167,6 +167,59 @@ def test_supervisor_breaker_half_opens_on_healthy_probe():
     assert not sup.breaker_open
     assert st.res_breaker_trips == 0
     assert sup.run("s", lambda: "fine") == "fine"
+
+
+def test_supervisor_per_site_windows_and_thresholds():
+    # failures at one site must not charge another site's window, and
+    # site_thresholds overrides the global breaker_threshold per site
+    st = RunStats()
+    sup = BatchSupervisor(
+        _policy(max_retries=0, breaker_threshold=5,
+                site_thresholds={"ctx_scan": 2}),
+        stats=st, stderr=io.StringIO(), probe=lambda: (True, ""))
+    with pytest.raises(DeviceWorkFailed):
+        sup.run("realign", lambda: (_ for _ in ()).throw(
+            RuntimeError("x")))
+    assert sup.consecutive("realign") == 1
+    assert sup.consecutive("ctx_scan") == 0
+    # ctx_scan's lower threshold (2) trips its probe independently
+    for _ in range(2):
+        with pytest.raises(DeviceWorkFailed):
+            sup.run("ctx_scan", lambda: (_ for _ in ()).throw(
+                RuntimeError("y")))
+    assert sup.consecutive("ctx_scan") == 0     # half-opened (healthy)
+    assert sup.consecutive("realign") == 1      # untouched
+
+
+def test_supervisor_site_breaker_trips_on_repeated_half_opens():
+    # a healthy backend + one persistently-failing site: after
+    # site_trip_limit exhausted windows that SITE's breaker opens while
+    # the other sites keep their device path
+    st = RunStats()
+    sup = BatchSupervisor(
+        _policy(max_retries=0, breaker_threshold=2, site_trip_limit=2),
+        stats=st, stderr=io.StringIO(), probe=lambda: (True, ""))
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise RuntimeError("miscompiled")
+
+    for _ in range(4):   # 2 windows of 2 failures -> 2 half-opens
+        with pytest.raises(DeviceWorkFailed):
+            sup.run("refine", bad)
+    assert sup.site_breaker_open("refine")
+    assert not sup.breaker_open                 # global stays closed
+    # a site trip on a healthy backend must NOT fire the operators'
+    # dead-backend alarm — it has its own counter
+    assert st.res_breaker_trips == 0
+    assert st.res_site_breaker_trips == 1
+    n = len(calls)
+    # the tripped site degrades without touching the device...
+    assert sup.run("refine", bad, fallback=lambda: "host") == "host"
+    assert len(calls) == n
+    # ...while other sites still run on device
+    assert sup.run("consensus", lambda: "dev") == "dev"
 
 
 def test_supervisor_fallback_fail_policy_is_fatal():
