@@ -923,6 +923,11 @@ def cfg8_realistic_scale() -> int:
     - chaos: the same run under seeded --inject-faults must stay
       byte-identical to the clean outputs (resilience at realistic
       scale, ROADMAP PR-1 follow-up);
+    - flap: a scripted outage window (down=2-4) must open the global
+      breaker mid-run AND be healed by the health monitor
+      (``realistic_flap_recovered_batches``, gated on
+      breaker_recloses >= 1 / recovered_batches > 0 / byte parity —
+      the ISSUE 3 acceptance contract);
     - host engines: a 1k-alignment report+summary corpus A/Bs the
       vectorized columnar host engine against the scalar ground-truth
       engine (PWASM_HOST_COLUMNAR=0) — ``realistic_host_report_1k_s``
@@ -1047,6 +1052,38 @@ def cfg8_realistic_scale() -> int:
             return _fail("realistic_chaos")
         if readset("chaos") != parity_body:
             return _fail("realistic_chaos_parity")
+
+        # --- flap chaos (PR 3 tentpole): a scripted outage window
+        # (down=2-4 over the supervised-call clock) must OPEN the
+        # global breaker mid-run, and the health monitor must RECLOSE
+        # it after the window and re-promote device work — gated on the
+        # recovery counters AND byte parity with the clean run.
+        # PWASM_DEVICE_PROBE=0 keeps the out-of-window probe verdict
+        # healthy without paying a subprocess jax import per re-probe
+        # (the scripted window dominates the in-window verdict either
+        # way).
+        stats_f = os.path.join(d, "flap.stats")
+        r = subprocess.run(
+            cmd + args("flap", ["--device=tpu", "--batch=16",
+                                "--max-retries=4",
+                                "--inject-faults=down=2-4",
+                                "--reprobe-interval=0",
+                                f"--stats={stats_f}"]),
+            env=dict(env, PWASM_DEVICE_PROBE="0"),
+            capture_output=True)
+        if r.returncode != 0:
+            sys.stderr.write(r.stderr.decode()[:1000])
+            return _fail("realistic_flap")
+        if readset("flap") != parity_body:
+            return _fail("realistic_flap_parity")
+        with open(stats_f) as f:
+            flap_res = json.load(f)["resilience"]
+        flap_ok = (flap_res["breaker_recloses"] >= 1
+                   and flap_res["recovered_batches"] > 0
+                   and flap_res["degraded_batches"] > 0)
+        _emit("realistic_flap_recovered_batches",
+              flap_res["recovered_batches"], "batches",
+              1.0 if flap_ok else 0.0, cpu_metric=True)
 
         # --- host engine A/B: 1k-alignment report+summary corpus ----
         qseq1k, lines1k = make_corpus(n_aln=1000)

@@ -68,7 +68,17 @@ USAGE = """Usage:
    --inject-faults=SPEC  debug: deterministic seeded fault injection
                into supervised device calls, e.g.
                seed=7,rate=0.3,kinds=raise+hang+nan+corrupt
+               or a scripted outage window down=A-B[+C-D]
                (see pwasm_tpu/resilience/faults.py for the spec)
+   --recover=auto|off  auto (default): once the circuit breaker
+               confirms a dead backend, keep re-probing it (bounded)
+               and re-promote device work when it recovers; off: an
+               open breaker degrades the rest of the run (PR-1
+               behavior)
+   --reprobe-interval=S  first re-probe delay after the breaker opens
+               (default 5; doubles per unhealthy probe)
+   --reprobe-max=S     ceiling of the capped-exponential re-probe
+               schedule (default 300)
    --shard[=N]    (with --device=tpu) shard the device work over a mesh
                of N chips (default: all visible): the analysis batch
                spreads over the mesh and consensus pileup counts are
@@ -152,11 +162,14 @@ def _ckpt_path(report_path: str) -> str:
     return report_path + ".ckpt"
 
 
-def _load_checkpoint(report_path: str) -> tuple[int, int] | None:
+def _load_checkpoint(report_path: str) \
+        -> tuple[int, int, dict | None] | None:
     """Read the batch-granular resume checkpoint for ``report_path``.
-    Returns ``(bytes, records)`` — the durable report prefix — or None
-    when absent, malformed, or inconsistent with the report file (the
-    ckpt must describe a prefix of what is actually on disk)."""
+    Returns ``(bytes, records, resilience_state)`` — the durable report
+    prefix plus the breaker/monitor state snapshot (None in a ckpt from
+    an older build) — or None when absent, malformed, or inconsistent
+    with the report file (the ckpt must describe a prefix of what is
+    actually on disk)."""
     import json
     import os
 
@@ -169,15 +182,19 @@ def _load_checkpoint(report_path: str) -> tuple[int, int] | None:
         if nbytes < 0 or nrec < 0 \
                 or nbytes > os.path.getsize(report_path):
             return None
-        return nbytes, nrec
+        res = ck.get("resilience")
+        return nbytes, nrec, res if isinstance(res, dict) else None
     except (OSError, ValueError, KeyError, TypeError):
         return None
 
 
-def _write_checkpoint(freport, report_path: str, records: int) -> bool:
+def _write_checkpoint(freport, report_path: str, records: int,
+                      res_state: dict | None = None) -> bool:
     """Atomically persist the report's durable prefix after one
     completed device batch: fsync the report, then tmp-write + rename
-    the ckpt JSON.  Best-effort — a failed write never stops the run
+    the ckpt JSON.  ``res_state`` rides along (breaker / monitor /
+    fault-plan snapshot) so a ``--resume`` after a kill inherits
+    mid-outage state.  Best-effort — a failed write never stops the run
     (returns False)."""
     import json
     import os
@@ -186,9 +203,12 @@ def _write_checkpoint(freport, report_path: str, records: int) -> bool:
         freport.flush()
         os.fsync(freport.fileno())
         size = os.fstat(freport.fileno()).st_size
+        ck = {"bytes": size, "records": records}
+        if res_state is not None:
+            ck["resilience"] = res_state
         tmp = _ckpt_path(report_path) + ".tmp"
         with open(tmp, "w") as cf:
-            json.dump({"bytes": size, "records": records}, cf)
+            json.dump(ck, cf)
             cf.flush()
             os.fsync(cf.fileno())
         os.replace(tmp, _ckpt_path(report_path))
@@ -308,6 +328,37 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
             if cfg.fallback not in ("cpu", "fail"):
                 raise CliError(f"{USAGE}\nInvalid --fallback value: "
                                f"{cfg.fallback} (must be cpu or fail)\n")
+        if "recover" in opts:
+            cfg.recover = str(opts["recover"])
+            if cfg.recover not in ("auto", "off"):
+                raise CliError(f"{USAGE}\nInvalid --recover value: "
+                               f"{cfg.recover} (must be auto or off)\n")
+        import math as _math
+        for knob, attr in (("reprobe-interval", "reprobe_interval"),
+                           ("reprobe-max", "reprobe_max")):
+            if knob in opts:
+                try:
+                    v = float(str(opts[knob]))
+                    if v < 0 or not _math.isfinite(v):
+                        raise ValueError
+                except (TypeError, ValueError):
+                    raise CliError(f"{USAGE}\nInvalid --{knob} value: "
+                                   f"{opts[knob]}\n")
+                setattr(cfg, attr, v)
+        if cfg.reprobe_max < cfg.reprobe_interval:
+            if "reprobe-max" in opts and "reprobe-interval" in opts:
+                raise CliError(
+                    f"{USAGE}\nInvalid --reprobe-max value: "
+                    f"{cfg.reprobe_max:g} (must be >= --reprobe-interval "
+                    f"{cfg.reprobe_interval:g})\n")
+            # only one side was set: move the DEFAULT of the other side
+            # to keep a self-consistent request consistent — a raised
+            # interval lifts the default ceiling, a lowered ceiling
+            # pulls the default first-probe delay down with it
+            if "reprobe-max" in opts:
+                cfg.reprobe_interval = cfg.reprobe_max
+            else:
+                cfg.reprobe_max = cfg.reprobe_interval
         if "inject-faults" in opts:
             if opts["inject-faults"] is True:
                 raise CliError(
@@ -328,6 +379,7 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
         if "stats" in opts:
             cfg.stats_path = str(opts["stats"])
         resume_skip = 0
+        resume_state: dict | None = None
         if cfg.resume:
             if "o" not in opts:
                 raise CliError(f"{USAGE}\n--resume requires -o <report>\n")
@@ -339,12 +391,13 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
             # heuristic below when absent or inconsistent.
             ck = _load_checkpoint(str(opts["o"]))
             if ck is not None:
-                nbytes, resume_skip = ck
+                nbytes, resume_skip, resume_state = ck
                 try:
                     with open(str(opts["o"]), "ab") as f:
                         f.truncate(nbytes)
                 except OSError:
                     resume_skip = 0
+                    resume_state = None
         if cfg.resume and resume_skip == 0:
             # The report is per-alignment independent in report mode:
             # resume = drop the LAST record (its event rows may be torn
@@ -457,7 +510,8 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
         with device_trace(cfg.profile_dir, stderr):
             return _main_loop(cfg, inf, freport, fmsa, fsummary, summary,
                               qfasta, stdout, stderr, cons_outs,
-                              resume_skip=resume_skip)
+                              resume_skip=resume_skip,
+                              resume_state=resume_state)
     except PwasmError as e:
         stderr.write(str(e))
         return e.exit_code
@@ -564,7 +618,8 @@ def _native_msa_outputs(nmsa, cfg, fmsa, cons_outs, stderr,
 def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                qfasta: FastaFile, stdout, stderr,
                cons_outs: dict | None = None,
-               resume_skip: int = 0) -> int:
+               resume_skip: int = 0,
+               resume_state: dict | None = None) -> int:
     """The per-PAF-line loop (pafreport.cpp:296-460)."""
     from pwasm_tpu.align.gapseq import FLAG_IS_REF, GapSeq
     from pwasm_tpu.align.msa import Msa
@@ -583,11 +638,28 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
     if fault_plan is not None:
         print(f"pwasm: fault injection armed (debug): {fault_plan}",
               file=stderr)
+    # --recover=auto (default): an open global breaker is re-probed on
+    # a capped-exponential schedule and RECLOSES after consecutive
+    # healthy probes — subsequent batches go back to the device
+    # (mid-run re-promotion).  --recover=off keeps PR-1's terminal
+    # breaker.
+    monitor = None
+    if cfg.recover == "auto":
+        from pwasm_tpu.resilience.health import BackendHealthMonitor
+        monitor = BackendHealthMonitor(
+            interval_s=cfg.reprobe_interval,
+            max_interval_s=cfg.reprobe_max, stats=stats, stderr=stderr)
     supervisor = BatchSupervisor(
         ResiliencePolicy(max_retries=cfg.max_retries,
                          deadline_s=cfg.device_deadline or None,
                          fallback=cfg.fallback),
-        stats=stats, stderr=stderr, faults=fault_plan)
+        stats=stats, stderr=stderr, faults=fault_plan, monitor=monitor)
+    if resume_state is not None:
+        # a --resume inherits the killed run's breaker/monitor/fault
+        # state: a run killed mid-outage must not re-trip (or worse,
+        # re-attempt a dead backend), and a scripted down= window
+        # continues at the supervised call it stopped at
+        supervisor.restore_state(resume_state)
 
     alnpairs: dict[str, int] = {}   # gene-mode (query~target) dedup counts
     ref_cache: dict[str, bytes] = {}
@@ -685,7 +757,8 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
     def note_batch_done(nrecords: int) -> None:
         emitted[0] += nrecords
         if report_path is not None:
-            if _write_checkpoint(freport, report_path, emitted[0]):
+            if _write_checkpoint(freport, report_path, emitted[0],
+                                 supervisor.export_state()):
                 stats.res_checkpoints += 1
 
     def msa_add(aln, tlabel: str, refseq_b: bytes, ord_num: int,
@@ -1029,6 +1102,8 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         # checkpoint is obsolete (a later --resume skips via the
         # header scan, which now sees only complete records)
         _unlink_checkpoint(report_path)
+    supervisor.finalize_stats()   # a run ENDING degraded still owes
+    #                               its open window to degraded_wall_s
     if cfg.stats_path:
         try:
             with open(cfg.stats_path, "w") as f:
@@ -1046,6 +1121,13 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         print(f"Warning: {stats.engine_fallbacks} engine/device stage(s) "
               "fell back from the requested device/native path",
               file=stderr)
+    if supervisor.breaker_open:
+        # ending degraded must be visible at exit, not only in a
+        # breaker-open line scrolled past hours earlier
+        print("Warning: run ended with the circuit breaker OPEN "
+              f"({stats.res_degraded_batches} batch(es) degraded to "
+              f"the host, {stats.res_degraded_wall_s:.1f}s degraded "
+              "wall)", file=stderr)
     if cfg.verbose:
         print(stats.brief(), file=stderr)
     return 0

@@ -50,6 +50,14 @@ class Config:
     #                                 attempt (0 = unbounded)
     fallback: str = "cpu"           # --fallback: cpu (degrade) | fail
     inject_faults: str = ""         # --inject-faults=SPEC (debug)
+    recover: str = "auto"           # --recover: auto (re-probe an open
+    #                                 global breaker and re-promote
+    #                                 device work on reclose) | off
+    #                                 (an open breaker is terminal)
+    reprobe_interval: float = 5.0   # --reprobe-interval: first re-probe
+    #                                 delay after the breaker opens (s)
+    reprobe_max: float = 300.0      # --reprobe-max: capped-exponential
+    #                                 re-probe schedule ceiling (s)
 
 
 def load_motifs(path: str) -> tuple[str, ...]:

@@ -17,14 +17,24 @@ threads through:
                   or aborts loudly (``--fallback=fail``);
 - ``guardrails``  cheap invariant validation of device outputs, so
                   silent corruption is treated as a device fault and
-                  re-executed instead of written into the report.
+                  re-executed instead of written into the report;
+- ``health``      recovery from an open global breaker: bounded
+                  re-probes on a capped-exponential schedule with
+                  hysteresis (``--reprobe-interval``/``--reprobe-max``,
+                  ``--recover=auto|off``) reclose the breaker and
+                  re-promote device work mid-run — the up-transition of
+                  a flapping backend, mirroring the supervisor's
+                  down-transition.
 
 Counters flow into ``utils.runstats`` under the ``resilience`` block of
 the ``--stats`` JSON.
 """
 
 from pwasm_tpu.resilience.faults import (  # noqa: F401
-    FaultPlan, InjectedFault, InjectedKill, parse_fault_spec)
+    FaultPlan, InjectedFault, InjectedKill, InjectedOutage,
+    parse_fault_spec)
+from pwasm_tpu.resilience.health import (  # noqa: F401
+    BackendHealthMonitor, wait_for_backend)
 from pwasm_tpu.resilience.guardrails import GuardrailViolation  # noqa: F401
 from pwasm_tpu.resilience.supervisor import (  # noqa: F401
     BatchSupervisor, DeadlineExceeded, DeviceWorkFailed, ResilienceError,

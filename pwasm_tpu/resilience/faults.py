@@ -19,13 +19,29 @@ comma-separated ``key=value`` list:
                   site names: ``ctx_scan``, ``realign``, ``consensus``,
                   ``many2many``, ``refine``)
   ``hang_s=S``    simulated hang duration in seconds (default 30;
-                  meant to exceed ``--device-deadline``)
+                  meant to exceed ``--device-deadline``).  NB the
+                  supervisor caps the slept time at a small multiple of
+                  the armed deadline (or ~1 s when no deadline is set),
+                  so an injected hang proves the timeout machinery
+                  without stalling a fast test suite — see
+                  :meth:`FaultPlan.effective_hang`
   ``kill=K``      raise an uncatchable :class:`InjectedKill` on the
-                  K-th supervised attempt (counted across all sites) —
-                  simulates a mid-run process kill for checkpoint /
-                  resume testing
+                  K-th supervised attempt (counted across all sites,
+                  and a batch skipped by an open global breaker counts
+                  as one attempt) — simulates a mid-run process kill
+                  for checkpoint / resume testing
+  ``down=A-B``    scripted OUTAGE WINDOWS, ``+``-separated inclusive
+                  1-based ranges over the global supervised-CALL
+                  counter (one tick per ``BatchSupervisor.run``
+                  invocation, degraded calls included): every device
+                  attempt made while the counter is inside a window
+                  fails with a tunnel-shaped :class:`InjectedOutage`,
+                  and backend probes report unreachable — so tests can
+                  script "device dies at batch A, returns after batch
+                  B" and assert the breaker opens AND recloses
 
-Example: ``--inject-faults=seed=7,rate=0.3,kinds=raise+nan+corrupt``.
+Example: ``--inject-faults=seed=7,rate=0.3,kinds=raise+nan+corrupt``;
+a flap: ``--inject-faults=down=2-4``.
 
 Fault kinds:
 
@@ -59,6 +75,13 @@ class InjectedFault(RuntimeError):
     """The exception a ``raise`` fault throws inside a device call."""
 
 
+class InjectedOutage(InjectedFault):
+    """The tunnel-shaped error a scripted ``down=A-B`` outage window
+    throws for every device attempt inside the window — distinct from
+    :class:`InjectedFault` so tests can tell a scripted backend outage
+    from a random computational fault."""
+
+
 class InjectedKill(BaseException):
     """Simulated process kill (``kill=K``).  Derives from BaseException
     so no retry/fallback layer can swallow it — it unwinds the whole
@@ -74,14 +97,61 @@ class FaultPlan:
     sites: frozenset[str] | None = None   # None = all sites
     hang_s: float = 30.0
     kill: int = 0                         # 0 = disabled; else 1-based
+    down: tuple[tuple[int, int], ...] = ()  # outage windows over _calls
     _site_counters: dict = field(default_factory=dict, repr=False)
     _attempts: int = field(default=0, repr=False)
+    _calls: int = field(default=0, repr=False)  # supervised-call clock
+    #          (one tick per BatchSupervisor.run invocation, degraded
+    #          calls included) — the down= windows are scripted on it,
+    #          and it is persisted in <report>.ckpt so a --resume lands
+    #          back inside the same scripted window
+
+    def note_call(self) -> None:
+        """Advance the supervised-call clock — called once at every
+        ``BatchSupervisor.run`` entry, whether or not the device is
+        attempted (an open breaker must not freeze a scripted outage
+        window, or a flap could never end)."""
+        self._calls += 1
+
+    def note_skipped(self, site: str) -> None:
+        """A supervised call skipped by an open breaker still counts as
+        one attempt toward ``kill=K`` — a kill scripted to land
+        mid-outage must fire even though no device draw happens."""
+        self._attempts += 1
+        if self.kill and self._attempts >= self.kill:
+            raise InjectedKill(
+                f"injected kill at supervised attempt {self._attempts} "
+                f"(site {site}, breaker open)")
+
+    def in_outage(self) -> bool:
+        """True while the supervised-call clock is inside a ``down=``
+        window."""
+        return any(a <= self._calls <= b for a, b in self.down)
+
+    def outage_probe(self) -> str | None:
+        """The scripted answer a backend probe must give: a diagnostic
+        while inside an outage window, None outside (fall through to
+        the real probe)."""
+        if self.in_outage():
+            return (f"injected outage (down window, supervised call "
+                    f"{self._calls})")
+        return None
+
+    def effective_hang(self, deadline_s: float | None) -> float:
+        """The capped sleep a ``hang`` fault actually performs: hangs
+        exist to prove the deadline machinery, so sleeping much past
+        the deadline (or for the full default 30 s when NO deadline is
+        armed) only stalls the suite without proving anything more —
+        cap at 4x the deadline, or ~1 s deadline-less."""
+        cap = 4.0 * deadline_s if deadline_s else 1.0
+        return min(self.hang_s, cap)
 
     def draw(self, site: str) -> str | None:
         """One deterministic fault draw for an attempt at ``site``.
-        Returns a kind from :data:`KINDS` or None, advancing the
-        per-site counter either way.  Raises :class:`InjectedKill` when
-        the global attempt counter reaches ``kill``."""
+        Returns a kind from :data:`KINDS` (or ``"down"`` inside a
+        scripted outage window) or None, advancing the per-site counter
+        either way.  Raises :class:`InjectedKill` when the global
+        attempt counter reaches ``kill``."""
         self._attempts += 1
         if self.kill and self._attempts >= self.kill:
             raise InjectedKill(
@@ -89,6 +159,10 @@ class FaultPlan:
                 f"(site {site})")
         k = self._site_counters.get(site, 0)
         self._site_counters[site] = k + 1
+        if self.in_outage():
+            # a dead tunnel fails every site, whatever sites= says —
+            # and deterministically, whatever rate= says
+            return "down"
         if self.sites is not None and site not in self.sites:
             return None
         rng = random.Random(f"{self.seed}|{site}|{k}")
@@ -183,11 +257,23 @@ def parse_fault_spec(spec: str) -> FaultPlan:
                 plan.kill = int(val)
                 if plan.kill < 0:
                     raise ValueError
+            elif key == "down":
+                wins = []
+                for rng_s in val.split("+"):
+                    a_s, _, b_s = rng_s.partition("-")
+                    a, b = int(a_s), int(b_s)
+                    if a < 1 or b < a:
+                        raise ValueError
+                    wins.append((a, b))
+                if not wins:
+                    raise ValueError
+                plan.down = tuple(wins)
             else:
                 raise ValueError
         except ValueError:
-            raise ValueError(f"bad fault spec item: {item!r} "
-                             f"(keys: seed rate kinds sites hang_s kill)")
+            raise ValueError(
+                f"bad fault spec item: {item!r} "
+                f"(keys: seed rate kinds sites hang_s kill down)")
     return plan
 
 

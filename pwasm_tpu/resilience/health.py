@@ -1,0 +1,187 @@
+"""Backend health monitoring: recovery from an open global breaker.
+
+PR 1's circuit breaker handles the *down*-transition of a flapping
+backend: after a probe-confirmed dead backend the GLOBAL breaker opens
+and every supervised site degrades to its bit-exact host path.  That
+used to be terminal — a 2-minute tunnel blip at batch 10 of a 10k-batch
+run walled the remaining 9,990 batches on the CPU path forever.  The
+:class:`BackendHealthMonitor` supplies the *up*-transition:
+
+- once the global breaker opens, the monitor re-probes the backend via
+  the existing bounded ``probe_backend`` on a capped-exponential
+  schedule (``--reprobe-interval`` start, doubling on each unhealthy
+  probe up to ``--reprobe-max``) — a dead backend costs a handful of
+  bounded probes per hour, never a poll storm;
+- recovery needs hysteresis, or one lucky probe in the middle of a
+  flap storm would bounce the run between paths: ``hysteresis``
+  consecutive healthy probes move the breaker open -> half-open ->
+  closed (the classic three-state breaker), and any unhealthy probe in
+  half-open falls straight back to open with the backoff re-doubled;
+- on the reclose, :meth:`BatchSupervisor._reclose` routes subsequent
+  batches back to the device (mid-run CPU->device re-promotion, the
+  mirror of the device->CPU degradation) and resets the per-site trip
+  state — the failures that opened the breaker were the outage's, not
+  the sites'.
+
+``--recover=off`` opts out: the breaker stays terminal (PR 1 behavior),
+for operators who prefer a degraded-but-steady run over path flapping.
+
+Every probe and transition is counted on the shared ``RunStats``
+(``reprobe_attempts``, ``breaker_recloses``, ``degraded_batches``,
+``recovered_batches``, ``degraded_wall_s``) and surfaces in the
+``--stats`` JSON ``resilience`` block.
+
+:func:`wait_for_backend` reuses the same schedule standalone — it is
+how ``qa/chip_burst.py --wait`` blocks (bounded) for the first healthy
+tunnel window instead of exiting 3.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+# monitor states (the classic breaker triple, from the breaker's
+# point of view: OPEN = degraded, CLOSED = recovered)
+OPEN = "open"
+HALF_OPEN = "half-open"
+CLOSED = "closed"
+
+
+class BackendHealthMonitor:
+    """Schedules bounded re-probes of a dead backend and decides when
+    the global breaker may reclose.
+
+    ``probe`` is a ``() -> (ok, why)`` callable — normally the
+    supervisor's ``_probe_backend`` (bounded subprocess probe, fault
+    plan consulted first so scripted outages dominate).  ``clock`` is
+    injectable for deterministic tests (defaults to
+    ``time.monotonic``).  The monitor never sleeps: :meth:`poll` is
+    called once per degraded batch and probes only when the schedule
+    says it is time, so a run with no work between probes just stays
+    degraded longer.
+    """
+
+    def __init__(self, probe=None, interval_s: float = 5.0,
+                 max_interval_s: float = 300.0, hysteresis: int = 2,
+                 stats=None, stderr=None, clock=None):
+        self.probe = probe
+        self.interval_s = max(0.0, float(interval_s))
+        self.max_interval_s = max(self.interval_s, float(max_interval_s))
+        self.hysteresis = max(1, int(hysteresis))
+        self.stats = stats
+        self.stderr = stderr if stderr is not None else sys.stderr
+        self._clock = clock or time.monotonic
+        self.state = CLOSED
+        self._streak = 0          # consecutive healthy probes
+        self._backoff = self.interval_s
+        self._next_probe = 0.0
+
+    # ---- counters ------------------------------------------------------
+    def _count(self, name: str, n=1) -> None:
+        if self.stats is not None and hasattr(self.stats, name):
+            setattr(self.stats, name, getattr(self.stats, name) + n)
+
+    def _warn(self, msg: str) -> None:
+        print(f"pwasm: {msg}", file=self.stderr)
+
+    # ---- lifecycle -----------------------------------------------------
+    def note_open(self) -> None:
+        """The global breaker just opened (or was restored open from a
+        checkpoint): arm the re-probe schedule from its base interval."""
+        self.state = OPEN
+        self._streak = 0
+        self._backoff = self.interval_s
+        self._next_probe = self._clock() + self._backoff
+
+    def next_probe_in(self) -> float:
+        """Seconds until the next scheduled probe (<= 0: due now)."""
+        return self._next_probe - self._clock()
+
+    def poll(self) -> bool:
+        """One recovery decision for one degraded batch.  Returns True
+        exactly when the breaker may reclose NOW (hysteresis met); the
+        caller owns the actual reclose.  Probes at most once per call,
+        and only when the schedule is due."""
+        if self.state == CLOSED:
+            return True
+        if self._clock() < self._next_probe:
+            return False
+        ok, why = self.probe() if self.probe is not None else (False, "")
+        self._count("res_reprobe_attempts")
+        # schedule from the POST-probe clock: a real probe of a hung
+        # tunnel blocks for its full subprocess timeout (150 s default),
+        # far past any early backoff step — timed from the pre-probe
+        # instant the schedule would already be due again on return and
+        # every degraded batch would stall on a back-to-back inline
+        # probe, exactly the poll storm the backoff exists to prevent
+        now = self._clock()
+        if not ok:
+            if self.state == HALF_OPEN:
+                self._warn("backend re-probe unhealthy in half-open "
+                           f"({(why or '').strip() or 'unreachable'}); "
+                           "breaker back to open")
+            self.state = OPEN
+            self._streak = 0
+            # capped exponential: each unhealthy probe doubles the wait
+            # (min 1 s step so interval 0 — poll-every-batch in tests —
+            # cannot wedge the doubling at zero forever on real runs
+            # where it matters; with interval 0 the cap stays 0 too, so
+            # tests keep probe-per-batch determinism)
+            if self.interval_s > 0:
+                self._backoff = min(max(self._backoff * 2, 1.0),
+                                    self.max_interval_s)
+            self._next_probe = now + self._backoff
+            return False
+        self._streak += 1
+        if self._streak == 1 and self.state == OPEN:
+            self.state = HALF_OPEN
+            self._warn("backend re-probe healthy; breaker half-open "
+                       f"({self._streak}/{self.hysteresis} consecutive "
+                       "healthy probes needed)")
+        if self._streak >= self.hysteresis:
+            self.state = CLOSED
+            self._backoff = self.interval_s
+            return True
+        # healthy but hysteresis unmet: re-probe at the base interval,
+        # not the backed-off one — the backend looks alive, confirm fast
+        self._next_probe = now + self.interval_s
+        return False
+
+
+def wait_for_backend(budget_s: float, interval_s: float = 15.0,
+                     max_interval_s: float = 120.0, hysteresis: int = 1,
+                     probe=None, stderr=None) -> bool:
+    """Block (bounded by ``budget_s`` seconds) until the backend probes
+    healthy, on the monitor's capped-exponential schedule.  Returns
+    True on the first healthy window, False when the budget ran out —
+    the ``qa/chip_burst.py --wait`` primitive.  ``probe`` defaults to
+    the real bounded ``probe_backend`` under the current env."""
+    import os
+
+    stderr = stderr if stderr is not None else sys.stderr
+    if probe is None:
+        from pwasm_tpu.utils.backend import probe_backend
+
+        def probe():
+            try:
+                timeout = float(os.environ.get(
+                    "PWASM_DEVICE_PROBE_TIMEOUT", "150"))
+            except ValueError:
+                timeout = 150.0
+            platform, why = probe_backend(dict(os.environ), timeout)
+            return platform is not None, why
+
+    mon = BackendHealthMonitor(probe=probe, interval_s=interval_s,
+                               max_interval_s=max_interval_s,
+                               hysteresis=hysteresis, stderr=stderr)
+    deadline = time.monotonic() + max(0.0, float(budget_s))
+    mon.note_open()
+    mon._next_probe = time.monotonic()   # first probe immediately
+    while True:
+        if mon.poll():
+            return True
+        now = time.monotonic()
+        if now >= deadline:
+            return False
+        time.sleep(max(0.0, min(mon.next_probe_in(), deadline - now)))

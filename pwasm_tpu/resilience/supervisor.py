@@ -31,7 +31,17 @@ fail-fast model lacks (SURVEY.md §2.5.12 vs §5):
 - the degradation **policy**: ``--fallback=cpu`` (default) runs the
   bit-exact host path, ``--fallback=fail`` aborts the run loudly with
   a :class:`ResilienceError` — for pipelines where silent CPU walls
-  are worse than a dead job.
+  are worse than a dead job;
+- **recovery** (``resilience.health``): an open global breaker is no
+  longer terminal — a :class:`BackendHealthMonitor` re-probes the
+  backend on a capped-exponential schedule and, after its hysteresis
+  of consecutive healthy probes, the breaker RECLOSES: subsequent
+  batches route back to the device (mid-run CPU->device re-promotion)
+  and the per-site trip state resets, because the failures that opened
+  the breaker belonged to the outage, not the sites.  Breaker and
+  fault-plan state are exportable (:meth:`BatchSupervisor.export_state`)
+  into the ``<report>.ckpt`` so a ``--resume`` after a kill inherits
+  them.
 
 Every decision increments a counter on the shared ``RunStats`` and
 surfaces in the ``--stats`` JSON ``resilience`` block.
@@ -99,21 +109,32 @@ class BatchSupervisor:
     ``stats`` is the run's ``RunStats`` (resilience counters optional —
     missing attributes are ignored so the class also works bare).
     ``faults`` arms deterministic fault injection (``FaultPlan``).
-    ``probe`` overrides the breaker's backend health check (tests)."""
+    ``probe`` overrides the breaker's backend health check (tests).
+    ``monitor`` is a ``resilience.health.BackendHealthMonitor`` — when
+    given, an open global breaker is re-probed and can RECLOSE
+    (mid-run device re-promotion); without one the breaker stays
+    terminal (``--recover=off``)."""
 
     def __init__(self, policy: ResiliencePolicy | None = None,
                  stats=None, stderr=None, faults: FaultPlan | None = None,
-                 probe=None):
+                 probe=None, monitor=None):
         self.policy = policy or ResiliencePolicy()
         self.stats = stats
         self.stderr = stderr if stderr is not None else sys.stderr
         self.faults = faults
         self._probe = probe
+        self.monitor = monitor
+        if monitor is not None and monitor.probe is None:
+            # the monitor re-probes through the same (bounded,
+            # fault-plan-aware) check the breaker trips on
+            monitor.probe = self._probe_backend
         self._consecutive: dict[str, int] = {}  # site -> failure window
         self._half_opens: dict[str, int] = {}   # site -> healthy-probe
         #                                         half-open count
         self._site_open: set[str] = set()       # per-site open breakers
         self.breaker_open = False               # global (backend dead)
+        self.recloses = 0                       # global breaker recloses
+        self._degraded_t0: float | None = None  # breaker-open wall start
         # jitter exists to de-synchronize retry storms across the many
         # processes of a batch fleet, so it must be seeded per process
         # (a fixed seed would make every process retry at the same
@@ -142,9 +163,20 @@ class BatchSupervisor:
         by raising :class:`DeviceWorkFailed` so the caller can degrade.
         Under ``--fallback=fail`` exhaustion raises
         :class:`ResilienceError` instead (fatal)."""
+        if self.faults is not None:
+            # the scripted-outage clock ticks once per supervised call,
+            # INCLUDING degraded ones — an open breaker must not freeze
+            # a down= window, or a scripted flap could never end
+            self.faults.note_call()
         if self.breaker_open:
-            return self._degrade(site, fallback, "circuit breaker open",
-                                 None)
+            if self.monitor is not None and self.monitor.poll():
+                self._reclose()
+            else:
+                self._count("res_degraded_batches")
+                if self.faults is not None:
+                    self.faults.note_skipped(site)  # may InjectedKill
+                return self._degrade(site, fallback,
+                                     "circuit breaker open", None)
         if site in self._site_open:
             return self._degrade(site, fallback,
                                  f"site breaker open ({site})", None)
@@ -169,6 +201,10 @@ class BatchSupervisor:
                 if validate is not None:
                     validate(result)
                 self._consecutive[site] = 0
+                if self.recloses:
+                    # a successful device batch after a reclose IS the
+                    # recovery the monitor promised — gate on this
+                    self._count("res_recovered_batches")
                 return result
             except GuardrailViolation as e:
                 self._count("res_guardrail_rejects")
@@ -193,11 +229,18 @@ class BatchSupervisor:
             kind = plan.draw(site)       # may raise InjectedKill
             if kind is not None:
                 self._count("res_injected_faults")
+            if kind == "down":
+                from pwasm_tpu.resilience.faults import InjectedOutage
+                raise InjectedOutage(
+                    f"injected backend outage at {site} (tunnel down — "
+                    "scripted down= window)")
             if kind == "raise":
                 from pwasm_tpu.resilience.faults import InjectedFault
                 raise InjectedFault(f"injected device fault at {site}")
             if kind == "hang":
-                time.sleep(plan.hang_s)
+                # capped so an injected hang proves the deadline
+                # machinery without stalling a deadline-less fast suite
+                time.sleep(plan.effective_hang(self.policy.deadline_s))
             res = attempt()
             if kind in ("nan", "corrupt"):
                 res = plan.corrupt(res, site, kind)
@@ -272,21 +315,124 @@ class BatchSupervisor:
             self._warn(f"{site}: {self._consecutive_msg(site)} but the "
                        "backend probes healthy; breaker half-open")
             return False
-        self.breaker_open = True
+        self._open_breaker()
         # counted only when the breaker actually OPENS — a healthy-probe
         # half-open above is not a trip, and operators alert on this
         self._count("res_breaker_trips")
         self._warn(f"{site}: {self._consecutive_msg(site)}; backend "
                    f"probe says: {why.strip() or 'unreachable'} — "
                    "circuit breaker OPEN, degrading device work to the "
-                   "host path for the rest of the run")
+                   "host path"
+                   + (" until it probes healthy again"
+                      if self.monitor is not None
+                      else " for the rest of the run"))
         return True
+
+    def _open_breaker(self) -> None:
+        self.breaker_open = True
+        if self._degraded_t0 is None:
+            self._degraded_t0 = time.perf_counter()
+        if self.monitor is not None:
+            self.monitor.note_open()
+        # a freshly-confirmed-dead backend invalidates any cached
+        # healthy probe verdict (TTL marker) — sibling processes must
+        # not inherit a stale "healthy" and hang on their first touch
+        try:
+            from pwasm_tpu.utils.backend import invalidate_probe_cache
+            invalidate_probe_cache()
+        except Exception:
+            pass
+
+    def _reclose(self) -> None:
+        """The monitor confirmed recovery: reclose the global breaker
+        and re-promote device work.  Per-site trip state resets too —
+        the failures that opened the breaker belonged to the outage,
+        not the sites."""
+        self.breaker_open = False
+        self.recloses += 1
+        self._count("res_breaker_recloses")
+        self._flush_degraded_wall()
+        self._consecutive.clear()
+        self._half_opens.clear()
+        self._site_open.clear()
+        self._warn("backend recovered — circuit breaker RECLOSED, "
+                   "re-promoting device work (degraded batch state "
+                   "reset)")
+
+    def _flush_degraded_wall(self) -> None:
+        if self._degraded_t0 is not None:
+            self._count("res_degraded_wall_s",
+                        time.perf_counter() - self._degraded_t0)
+            self._degraded_t0 = None
+
+    def finalize_stats(self) -> None:
+        """End-of-run accounting hook: a run that ENDS degraded still
+        owes its open window to ``degraded_wall_s``."""
+        self._flush_degraded_wall()
+
+    # ---- checkpointed state --------------------------------------------
+    def export_state(self) -> dict:
+        """Breaker/monitor/fault-plan state for the ``<report>.ckpt``,
+        written after every completed batch so a ``--resume`` after a
+        kill inherits mid-outage state instead of re-tripping (and a
+        scripted ``down=`` window continues where it stopped)."""
+        st = {
+            "breaker_open": self.breaker_open,
+            "recloses": self.recloses,
+            "site_open": sorted(self._site_open),
+            "half_opens": dict(self._half_opens),
+            "consecutive": {k: v for k, v in self._consecutive.items()
+                            if v},
+        }
+        if self.faults is not None:
+            st["fault_calls"] = self.faults._calls
+        return st
+
+    def restore_state(self, st: dict) -> None:
+        """Inherit checkpointed breaker state on ``--resume`` (inverse
+        of :meth:`export_state`).  Each field restores independently —
+        one malformed field (older build, hand-edited ckpt) must drop
+        only itself, not abort the rest: losing e.g. ``fault_calls``
+        while keeping ``breaker_open`` would replay a scripted outage
+        window from call 1 against an already-open breaker."""
+        def field(restore):
+            try:
+                restore()
+            except (TypeError, ValueError, AttributeError, KeyError):
+                pass
+
+        if st.get("breaker_open"):
+            field(self._open_breaker)
+        field(lambda: setattr(
+            self, "recloses", int(st.get("recloses", 0) or 0)))
+        field(lambda: setattr(
+            self, "_site_open",
+            {str(s) for s in st.get("site_open", [])}))
+        field(lambda: setattr(
+            self, "_half_opens",
+            {str(k): int(v) for k, v
+             in dict(st.get("half_opens", {})).items()}))
+        field(lambda: setattr(
+            self, "_consecutive",
+            {str(k): int(v) for k, v
+             in dict(st.get("consecutive", {})).items()}))
+        if self.faults is not None and "fault_calls" in st:
+            field(lambda: setattr(
+                self.faults, "_calls", int(st["fault_calls"])))
 
     def _consecutive_msg(self, site: str) -> str:
         return (f"{self.policy.threshold_for(site)} consecutive device "
                 "failures")
 
     def _probe_backend(self) -> tuple[bool, str]:
+        if self.faults is not None:
+            # scripted outage windows dominate every other verdict —
+            # the probe must look dead INSIDE the window (so the
+            # breaker can open on a healthy CI backend) and healthy
+            # outside it (so the monitor can reclose)
+            why = self.faults.outage_probe()
+            if why is not None:
+                return False, why
         if self._probe is not None:
             return self._probe()
         # a REAL bounded subprocess probe, not device_backend_reachable:

@@ -97,6 +97,22 @@ def _backend_already_initialized() -> bool:
         return False
 
 
+def invalidate_probe_cache() -> None:
+    """Drop every cached healthy-probe verdict — the in-process tuple
+    AND the cross-process TTL marker.  Called when the circuit breaker
+    confirms a dead backend mid-run: a sibling process (or the next
+    run inside the TTL) must re-probe instead of inheriting a stale
+    "healthy" and hanging on its first device touch."""
+    global _probe_cache
+    _probe_cache = None
+    marker = _success_marker()
+    if marker is not None:
+        try:
+            os.unlink(marker)
+        except OSError:
+            pass
+
+
 def device_backend_reachable() -> tuple[bool, str]:
     """Bounded health check before the CLI's first device touch.
 

@@ -54,6 +54,24 @@ class RunStats:
         #                                a healthy backend)
         self.res_injected_faults = 0   # faults injected (--inject-faults)
         self.res_checkpoints = 0       # durable batch checkpoints written
+        # recovery counters (pwasm_tpu.resilience.health): the
+        # flap-recovery layer's decisions — a degraded run that heals
+        # shows recloses/recovered > 0; one that stays walled shows
+        # degraded_batches growing with recloses == 0
+        self.res_breaker_recloses = 0  # global breaker RECLOSES (the
+        #                                mid-run CPU->device
+        #                                re-promotion operators watch
+        #                                for after an outage page)
+        self.res_reprobe_attempts = 0  # bounded backend re-probes made
+        #                                while the global breaker was
+        #                                open (capped-exponential)
+        self.res_degraded_batches = 0  # batches skipped straight to the
+        #                                host because the global breaker
+        #                                was open
+        self.res_recovered_batches = 0  # successful device batches
+        #                                 executed after a reclose
+        self.res_degraded_wall_s = 0.0  # wall seconds spent with the
+        #                                 global breaker open
         # dispatch-budget counters (VERDICT r5 item 3): every device
         # round-trip costs a host<->device dispatch (~1-2 ms through a
         # tunnel), so the device path must stay dispatch-lean at scale.
@@ -117,6 +135,11 @@ class RunStats:
                 "site_breaker_trips": self.res_site_breaker_trips,
                 "injected_faults": self.res_injected_faults,
                 "checkpoints": self.res_checkpoints,
+                "breaker_recloses": self.res_breaker_recloses,
+                "reprobe_attempts": self.res_reprobe_attempts,
+                "degraded_batches": self.res_degraded_batches,
+                "recovered_batches": self.res_recovered_batches,
+                "degraded_wall_s": round(self.res_degraded_wall_s, 3),
             },
             "wall_s": round(self.wall_s, 3),
             "aligned_bases_per_s": round(self.rate(), 1),

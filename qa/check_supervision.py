@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Static supervision-coverage check (tier-1).
+
+The resilience layer only protects device work that is ROUTED THROUGH
+it: a new module that calls ``jax.jit`` / ``jax.device_put`` /
+``.block_until_ready`` directly, outside a ``BatchSupervisor.run``
+site, silently re-opens the fail-fast hole PR 1 closed (no retries, no
+breaker, no fallback policy, no counters).  This check greps
+``pwasm_tpu/`` for device round-trip entry points and fails when any
+hit lives in a module that is not in the REGISTRY below — forcing the
+author of new device code to either thread it through a supervised
+site or register (and justify) the exemption.
+
+Registry semantics, per module (repo-relative path):
+
+- ``site:<name>``   the module's device work is reached only through a
+                    ``BatchSupervisor.run`` call at that site (the
+                    supervised callers are listed in
+                    docs/RESILIENCE.md);
+- ``exempt:<why>``  deliberately unsupervised (probes, one-shot debug
+                    tools, the compat shim) — the justification is the
+                    registry entry itself.
+
+Run standalone (``python qa/check_supervision.py``, exit 1 on
+violations) or through ``tests/test_supervision_coverage.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# device round-trip entry points: program definitions (jit) and
+# explicit host<->device transfers.  ``np.asarray``/``jnp.asarray`` are
+# deliberately NOT patterns — they are ubiquitous and ambiguous; every
+# blocking fetch in this codebase happens inside a function built
+# around one of these markers.
+PATTERNS = re.compile(
+    r"jax\.jit\s*\(|@jax\.jit\b|partial\s*\(\s*jax\.jit"
+    r"|jax\.device_put\s*\(|jax\.device_get\s*\("
+    r"|\.block_until_ready\s*\(")
+
+# module -> justification (see module docstring for the grammar)
+REGISTRY = {
+    # jitted device programs, reached only via supervised call sites
+    "pwasm_tpu/ops/pack.py": "site:ctx_scan",
+    "pwasm_tpu/ops/ctx_scan.py": "site:ctx_scan",
+    "pwasm_tpu/report/device_report.py": "site:ctx_scan",
+    "pwasm_tpu/ops/banded_dp.py": "site:realign",
+    "pwasm_tpu/ops/realign.py": "site:realign",
+    "pwasm_tpu/ops/consensus.py": "site:consensus",
+    "pwasm_tpu/ops/refine_clip.py": "site:refine",
+    "pwasm_tpu/parallel/many2many.py": "site:many2many",
+    "pwasm_tpu/parallel/mesh.py":
+        "site:consensus+refine (sharded twins of supervised programs)",
+    "pwasm_tpu/parallel/wavefront_sp.py":
+        "exempt:bench-only long-read kernel (no CLI entry point; "
+        "bench.py owns its bounded subprocess)",
+}
+
+
+def find_hits(root: str = REPO) -> list[tuple[str, int, str]]:
+    """Every (relpath, lineno, line) in pwasm_tpu/ matching PATTERNS,
+    comment-only lines skipped."""
+    hits = []
+    pkg = os.path.join(root, "pwasm_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                for i, line in enumerate(f, 1):
+                    if line.lstrip().startswith("#"):
+                        continue
+                    if PATTERNS.search(line):
+                        hits.append((rel, i, line.strip()))
+    return hits
+
+
+def find_unregistered(root: str = REPO) -> list[str]:
+    """Human-readable violation lines; empty = covered."""
+    out = []
+    for rel, lineno, line in find_hits(root):
+        if rel not in REGISTRY:
+            out.append(f"{rel}:{lineno}: unsupervised device entry "
+                       f"point: {line}")
+    return out
+
+
+def stale_registry_entries(root: str = REPO) -> list[str]:
+    """Registry rows whose module no longer has any hit (or vanished) —
+    kept accurate so the registry stays a map, not a fossil."""
+    live = {rel for rel, _l, _s in find_hits(root)}
+    return [rel for rel in REGISTRY if rel not in live]
+
+
+def main() -> int:
+    bad = find_unregistered()
+    stale = stale_registry_entries()
+    for line in bad:
+        print(line, file=sys.stderr)
+    for rel in stale:
+        print(f"{rel}: stale registry entry (no device entry points "
+              "left — remove it)", file=sys.stderr)
+    if bad:
+        print(f"\n{len(bad)} device entry point(s) outside the "
+              "BatchSupervisor site registry.  Either route the work "
+              "through a supervised site (resilience/supervisor.py) or "
+              "register the module in qa/check_supervision.py with a "
+              "justification.", file=sys.stderr)
+    return 1 if (bad or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,6 +10,12 @@ Order: smoke (gate) -> full bench table -> cfg4 column-tile sweep ->
 cfg2 Iy-chain A/B -> cfg7 on chip -> cfg4 profiled launch.  Exit 3 =
 backend down or not a real TPU (nothing ran); exit 0 = burst completed
 (individual steps may still record failures in the JSONL).
+
+``--wait[=S]``: instead of exiting 3 on a down tunnel, block (bounded
+by S seconds, default 3600) on the resilience layer's capped-
+exponential re-probe schedule (``resilience.health.wait_for_backend``)
+and fire the burst in the FIRST healthy window — the mode a cron
+driver wants during a flapping-tunnel stretch.
 """
 
 from __future__ import annotations
@@ -27,14 +33,34 @@ sys.path.insert(0, REPO)
 from bench import _json_rows  # noqa: E402  (one shared stdout parser)
 
 
+# probe TUNING passes through the scrub: these bound the health checks
+# (how long a probe may take / how long a healthy verdict is cached) —
+# they change no run behavior or result bytes, and on a slow tunnel the
+# operator's raised PWASM_DEVICE_PROBE_TIMEOUT is the difference
+# between a burst firing and a spurious exit 3.  NB PWASM_DEVICE_PROBE
+# itself (=0 disables probing entirely) IS run behavior and stays
+# scrubbed.
+_SCRUB_KEEP = ("PWASM_DEVICE_PROBE_TIMEOUT", "PWASM_DEVICE_PROBE_TTL",
+               "PWASM_BENCH_PROBE_TIMEOUT")
+
+
+def _scrub_env(environ) -> dict:
+    """Each step fully controls its PWASM knobs: ANY run-behavior
+    ``PWASM_*`` value lingering in the operator's shell — a
+    ``PWASM_INJECT_FAULTS`` left armed after a chaos session, a
+    ``PWASM_HOST_COLUMNAR=0`` escape hatch, a ``PWASM_BENCH_CONFIG``
+    pin — would silently poison every burst step, so the scrub strips
+    the whole ``PWASM_`` namespace except the probe-tuning allowlist
+    (steps re-add exactly what they need via ``env_extra``).
+    Backend-selecting vars (``JAX_*``, ``PALLAS_*``) pass through:
+    they are what point the burst at the chip."""
+    return {k: v for k, v in environ.items()
+            if not k.startswith("PWASM_") or k in _SCRUB_KEEP}
+
+
 def _run(name: str, env_extra: dict, args: list[str], timeout: float,
          log: list) -> dict:
-    # each step fully controls its PWASM knobs: stray operator-shell
-    # values (a lingering PWASM_BENCH_CONFIG pin, a profile dir, ...)
-    # must not leak into the children
-    env = {k: v for k, v in os.environ.items()
-           if not (k.startswith("PWASM_BENCH_")
-                   or k.startswith("PWASM_DP_"))}
+    env = _scrub_env(os.environ)
     env.update({k: str(v) for k, v in env_extra.items()})
     t0 = time.time()
     try:
@@ -67,9 +93,48 @@ def _run(name: str, env_extra: dict, args: list[str], timeout: float,
     return rec
 
 
-def main() -> int:
+def _parse_wait(argv: list[str]) -> float | None:
+    """``--wait`` / ``--wait=S`` -> wait budget in seconds (default
+    3600); None when not asked to wait.  Raises SystemExit(2) on a
+    malformed value — a silent typo must not turn a bounded wait into
+    an immediate exit 3."""
+    for a in argv:
+        if a == "--wait":
+            return 3600.0
+        if a.startswith("--wait="):
+            try:
+                s = float(a.split("=", 1)[1])
+                if s < 0 or s != s:
+                    raise ValueError
+            except ValueError:
+                print(f"[burst] bad --wait value: {a!r}",
+                      file=sys.stderr)
+                raise SystemExit(2)
+            return s
+    return None
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
     os.makedirs(OUT, exist_ok=True)
     log: list = []
+
+    wait_s = _parse_wait(argv)
+    if wait_s is not None:
+        # block (bounded) for the first healthy tunnel window instead
+        # of burning the invocation on a down backend — the re-probe
+        # schedule and its bounded subprocess probe come from the
+        # resilience layer (ROADMAP: "the first healthy chip window")
+        from pwasm_tpu.resilience.health import wait_for_backend
+        print(f"[burst] --wait: probing for a healthy backend "
+              f"(budget {wait_s:.0f}s)", file=sys.stderr)
+        t0 = time.time()
+        if not wait_for_backend(wait_s):
+            print(f"[burst] backend still down after "
+                  f"{time.time() - t0:.0f}s; giving up", file=sys.stderr)
+            return 3
+        print(f"[burst] backend healthy after {time.time() - t0:.0f}s; "
+              "firing burst", file=sys.stderr)
 
     smoke = _run("smoke", {}, ["tpu_smoke.py"], 700, log)
     verdict = smoke["rows"][-1] if smoke["rows"] else {}

@@ -1,0 +1,62 @@
+"""qa/chip_burst.py contracts that must not regress silently: the
+env scrub (a lingering operator ``PWASM_*`` knob must never poison a
+burst step) and the ``--wait`` argument surface."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def chip_burst():
+    for p in (REPO, os.path.join(REPO, "qa")):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    import chip_burst as cb
+    return cb
+
+
+def test_env_scrub_strips_all_pwasm_knobs(chip_burst):
+    # the satellite contract: not just PWASM_BENCH_*/PWASM_DP_* — ANY
+    # run-behavior PWASM_* knob (fault injection, host-engine escape
+    # hatch, probe opt-outs) is stripped, while the backend-selecting
+    # env passes through
+    poisoned = {
+        "PWASM_BENCH_CONFIG": "4",
+        "PWASM_DP_IYCHAIN": "log",
+        "PWASM_INJECT_FAULTS": "rate=1,kinds=raise",
+        "PWASM_HOST_COLUMNAR": "0",
+        "PWASM_NATIVE_MSA": "0",
+        "PWASM_DEVICE_PROBE": "0",
+        "PWASM_DEVICE_PROBE_TIMEOUT": "1",
+        "PWASM_JAX_CACHE": "0",
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "10.0.0.1",
+        "PATH": "/usr/bin",
+        "HOME": "/root",
+    }
+    out = chip_burst._scrub_env(poisoned)
+    assert not any(k.startswith("PWASM_")
+                   and k not in chip_burst._SCRUB_KEEP
+                   for k in out), out
+    # probe TUNING (bounds on the health checks, no result impact)
+    # survives: a slow tunnel needs the operator's raised timeout
+    assert out["PWASM_DEVICE_PROBE_TIMEOUT"] == "1"
+    # ...but the probe OPT-OUT is run behavior and is scrubbed
+    assert "PWASM_DEVICE_PROBE" not in out
+    for keep in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS", "PATH",
+                 "HOME"):
+        assert out[keep] == poisoned[keep]
+
+
+def test_parse_wait(chip_burst):
+    assert chip_burst._parse_wait([]) is None
+    assert chip_burst._parse_wait(["--wait"]) == 3600.0
+    assert chip_burst._parse_wait(["--wait=90"]) == 90.0
+    assert chip_burst._parse_wait(["--wait=0"]) == 0.0
+    for bad in (["--wait=x"], ["--wait=-5"], ["--wait=nan"]):
+        with pytest.raises(SystemExit):
+            chip_burst._parse_wait(bad)

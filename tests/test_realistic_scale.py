@@ -189,3 +189,57 @@ def test_realistic_scale_fault_injected_byte_parity(tmp_path):
     # degradations somewhere in the supervised pipeline
     assert (st["resilience"]["retries"] > 0
             or st["resilience"]["fallbacks"] > 0), st
+
+
+def test_realistic_scale_flap_recovery_byte_parity(tmp_path,
+                                                   monkeypatch):
+    """The ISSUE 3 acceptance gate at realistic scale: a scripted
+    outage window (``down=2-4`` over the supervised-call clock) on the
+    200-alignment corpus opens the global breaker mid-run, the health
+    monitor recloses it after the window, and the re-promoted device
+    batches finish the run — byte-identical to the fault-free run,
+    with ``breaker_recloses >= 1`` and ``recovered_batches > 0``.
+    The ``--recover=off`` arm stays degraded (``breaker_recloses ==
+    0``) and STILL matches bytes: recovery changes wall time and
+    counters, never output."""
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    qseq, lines = make_corpus()
+    fa = tmp_path / "cds.fa"
+    fa.write_text(f">cds1\n{qseq}\n")
+    paf = tmp_path / "in.paf"
+    paf.write_text("".join(l + "\n" for l in lines))
+    outs = {}
+    stats = {}
+    for tag, extra in (
+            ("clean", []),
+            ("flap", ["--inject-faults=down=2-4", "--max-retries=4",
+                      "--reprobe-interval=0"]),
+            ("off", ["--inject-faults=down=2-4", "--max-retries=4",
+                     "--recover=off"])):
+        rep = tmp_path / f"{tag}.dfa"
+        summ = tmp_path / f"{tag}.sum"
+        mfa = tmp_path / f"{tag}.mfa"
+        cons = tmp_path / f"{tag}.cons"
+        stj = tmp_path / f"{tag}.stats"
+        err = io.StringIO()
+        rc = run([str(paf), "-r", str(fa), "-o", str(rep), "-s",
+                  str(summ), "-w", str(mfa), f"--cons={cons}",
+                  "--device=tpu", "--batch=16", f"--stats={stj}"]
+                 + extra, stderr=err)
+        assert rc == 0, err.getvalue()[:2000]
+        outs[tag] = (rep.read_bytes(), summ.read_bytes(),
+                     mfa.read_bytes(), cons.read_bytes())
+        stats[tag] = json.loads(stj.read_text())["resilience"]
+    assert outs["clean"] == outs["flap"]
+    assert outs["clean"] == outs["off"]
+    flap = stats["flap"]
+    assert flap["breaker_trips"] == 1, flap
+    assert flap["breaker_recloses"] >= 1, flap
+    assert flap["recovered_batches"] > 0, flap
+    assert flap["degraded_batches"] > 0, flap
+    off = stats["off"]
+    assert off["breaker_trips"] == 1, off
+    assert off["breaker_recloses"] == 0, off
+    assert off["recovered_batches"] == 0, off
+    assert off["degraded_batches"] > flap["degraded_batches"], (off,
+                                                                flap)

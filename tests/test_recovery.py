@@ -1,0 +1,489 @@
+"""Backend flap recovery (pwasm_tpu.resilience.health, ISSUE 3).
+
+The acceptance contract: a scripted outage window
+(``--inject-faults=down=A-B``) on the device CLI path opens the global
+breaker mid-run, the health monitor re-probes on a capped-exponential
+schedule, hysteresis recloses the breaker after the window, and
+subsequent batches run on the device again — with ``-o``/``-w`` output
+byte-identical to the fault-free run and ``breaker_recloses >= 1`` /
+``recovered_batches > 0`` in ``--stats``.  ``--recover=off`` keeps
+PR 1's terminal breaker.  Breaker/monitor/fault-clock state rides the
+``<report>.ckpt`` so a ``--resume`` after a mid-outage kill re-promotes
+inside the same scripted window.
+"""
+
+import io
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from pwasm_tpu.cli import run
+from pwasm_tpu.core.fasta import write_fasta
+from pwasm_tpu.resilience import (BatchSupervisor, InjectedKill,
+                                  ResiliencePolicy, parse_fault_spec)
+from pwasm_tpu.resilience.health import (BackendHealthMonitor,
+                                         wait_for_backend)
+from pwasm_tpu.utils.runstats import RunStats
+
+from helpers import make_paf_line
+
+
+def _policy(**kw):
+    kw.setdefault("backoff_s", 0.001)
+    kw.setdefault("backoff_cap_s", 0.002)
+    return ResiliencePolicy(**kw)
+
+
+# ---------------------------------------------------------------------------
+# fault plan: down= windows
+# ---------------------------------------------------------------------------
+def test_down_spec_parsing():
+    p = parse_fault_spec("down=3-6")
+    assert p.down == ((3, 6),)
+    p = parse_fault_spec("down=2-4+9-12,seed=5")
+    assert p.down == ((2, 4), (9, 12)) and p.seed == 5
+
+
+@pytest.mark.parametrize("bad", ["down=", "down=5", "down=0-3",
+                                 "down=6-2", "down=a-b", "down=1-2+"])
+def test_down_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_fault_spec(bad)
+
+
+def test_down_window_scripts_outage_on_call_clock():
+    p = parse_fault_spec("down=2-3")
+    # call clock, not draw clock: retries inside one call share the
+    # window membership of that call
+    p.note_call()
+    assert p.draw("s") is None and not p.in_outage()
+    p.note_call()
+    assert p.in_outage() and p.outage_probe() is not None
+    assert p.draw("s") == "down" == p.draw("s")   # retries fail too
+    p.note_call()
+    assert p.draw("s") == "down"
+    p.note_call()
+    assert not p.in_outage() and p.outage_probe() is None
+    assert p.draw("s") is None
+
+
+def test_down_window_dominates_sites_and_rate():
+    # a dead tunnel fails every site regardless of sites=/rate=
+    p = parse_fault_spec("down=1-2,rate=0,sites=other")
+    p.note_call()
+    assert p.draw("ctx_scan") == "down"
+
+
+def test_effective_hang_cap():
+    p = parse_fault_spec("hang_s=30")
+    assert p.effective_hang(None) == 1.0          # deadline-less cap
+    assert p.effective_hang(0.05) == pytest.approx(0.2)   # 4x deadline
+    assert parse_fault_spec("hang_s=0.01").effective_hang(5) == 0.01
+
+
+def test_injected_hang_capped_without_deadline():
+    # the satellite contract: a default-30s hang must not stall a
+    # deadline-less suite — the supervisor sleeps the capped time only
+    st = RunStats()
+    sup = BatchSupervisor(_policy(max_retries=0), stats=st,
+                          stderr=io.StringIO(),
+                          faults=parse_fault_spec("rate=1,kinds=hang"))
+    t0 = time.perf_counter()
+    assert sup.run("s", lambda: "ok") == "ok"
+    assert time.perf_counter() - t0 < 5.0
+    assert st.res_injected_faults == 1
+
+
+# ---------------------------------------------------------------------------
+# BackendHealthMonitor: schedule + hysteresis
+# ---------------------------------------------------------------------------
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_monitor_capped_exponential_schedule():
+    clk = _Clock()
+    probes = []
+
+    def probe():
+        probes.append(clk.t)
+        return False, "down"
+
+    st = RunStats()
+    mon = BackendHealthMonitor(probe=probe, interval_s=2.0,
+                               max_interval_s=10.0, stats=st,
+                               stderr=io.StringIO(), clock=clk)
+    mon.note_open()
+    for _ in range(200):
+        clk.t += 1.0
+        mon.poll()
+    # probes at +2, then doubling 4, 8, capped at 10
+    gaps = [round(b - a) for a, b in zip(probes, probes[1:])]
+    assert probes[0] == 2.0
+    assert gaps[:3] == [4, 8, 10]
+    assert set(gaps[3:]) == {10}
+    assert st.res_reprobe_attempts == len(probes)
+
+
+def test_monitor_schedules_from_post_probe_clock():
+    # a real probe of a HUNG tunnel blocks for its full subprocess
+    # timeout (150 s default) — the next probe must be scheduled from
+    # the post-probe clock, or every early-backoff step would already
+    # be due on return and degraded batches would stall back-to-back
+    clk = _Clock()
+    probes = []
+
+    def hung_probe():
+        probes.append(clk.t)
+        clk.t += 150.0           # the probe itself eats wall time
+        return False, "hang"
+
+    mon = BackendHealthMonitor(probe=hung_probe, interval_s=5.0,
+                               max_interval_s=300.0,
+                               stderr=io.StringIO(), clock=clk)
+    mon.note_open()
+    for _ in range(2000):
+        clk.t += 1.0
+        mon.poll()
+    gaps = [b - a for a, b in zip(probes, probes[1:])]
+    assert len(probes) >= 3
+    # every inter-probe gap spans the probe wall PLUS a real backoff
+    assert all(g >= 150 + 5 for g in gaps), gaps
+
+
+def test_monitor_hysteresis_and_halfopen_regression():
+    clk = _Clock()
+    verdicts = iter([False, True, False,        # healthy blip: no heal
+                     True, True])               # 2 consecutive: reclose
+    mon = BackendHealthMonitor(probe=lambda: (next(verdicts), ""),
+                               interval_s=1.0, max_interval_s=8.0,
+                               hysteresis=2, stderr=io.StringIO(),
+                               clock=clk)
+    mon.note_open()
+    healed = []
+    for _ in range(60):
+        clk.t += 1.0
+        if mon.poll():
+            healed.append(clk.t)
+            break
+    assert healed, "monitor never healed"
+    # the lone healthy probe half-opened, the next unhealthy one fell
+    # back to open (streak reset) — only the final two healthy probes
+    # in a row reclosed
+    assert mon.state == "closed"
+
+
+def test_wait_for_backend_bounded():
+    # healthy on the 3rd probe: returns True well inside the budget
+    verdicts = iter([False, False, True])
+    assert wait_for_backend(5.0, interval_s=0.01, max_interval_s=0.02,
+                            probe=lambda: (next(verdicts), ""),
+                            stderr=io.StringIO())
+    # never healthy: bounded False, no hang
+    t0 = time.monotonic()
+    assert not wait_for_backend(0.3, interval_s=0.05,
+                                max_interval_s=0.1,
+                                probe=lambda: (False, "down"),
+                                stderr=io.StringIO())
+    assert time.monotonic() - t0 < 5.0
+
+
+# ---------------------------------------------------------------------------
+# supervisor: open -> half-open -> closed, re-promotion, state export
+# ---------------------------------------------------------------------------
+def _flap_supervisor(spec="down=2-4", hysteresis=2, **kw):
+    st = RunStats()
+    err = io.StringIO()
+    mon = BackendHealthMonitor(interval_s=0, max_interval_s=0,
+                               hysteresis=hysteresis, stats=st,
+                               stderr=err)
+    sup = BatchSupervisor(_policy(max_retries=4, **kw), stats=st,
+                          stderr=err, faults=parse_fault_spec(spec),
+                          probe=lambda: (True, ""), monitor=mon)
+    return sup, st, err
+
+
+def test_supervisor_flap_open_then_reclose():
+    sup, st, err = _flap_supervisor()
+    got = [sup.run("ctx_scan", lambda i=i: f"dev{i}",
+                   fallback=lambda i=i: f"host{i}")
+           for i in range(1, 11)]
+    # call 1 device; calls 2-5 host (window 2-4 opens the breaker at
+    # call 2, probes stay scripted-dead through call 4, hysteresis
+    # needs 2 healthy probes: calls 5+6 probe healthy, reclose DURING
+    # call 6); calls 6-10 device again
+    assert got == ["dev1", "host2", "host3", "host4", "host5",
+                   "dev6", "dev7", "dev8", "dev9", "dev10"]
+    assert not sup.breaker_open and sup.recloses == 1
+    assert st.res_breaker_trips == 1
+    assert st.res_breaker_recloses == 1
+    assert st.res_degraded_batches == 3     # calls 3, 4, 5
+    assert st.res_recovered_batches == 5    # calls 6-10
+    assert st.res_reprobe_attempts >= 3
+    assert st.res_degraded_wall_s > 0
+    assert "RECLOSED" in err.getvalue()
+
+
+def test_supervisor_reclose_resets_site_trip_state():
+    sup, st, _ = _flap_supervisor()
+    for i in range(1, 7):
+        sup.run("ctx_scan", lambda: "dev", fallback=lambda: "host")
+    assert sup.recloses == 1
+    # the outage charged ctx_scan's window; the reclose must have
+    # cleared it so post-recovery failures start a fresh window
+    assert sup.consecutive("ctx_scan") == 0
+    assert not sup.site_breaker_open("ctx_scan")
+
+
+def test_supervisor_without_monitor_stays_degraded():
+    # --recover=off: PR-1 behavior, the open breaker is terminal
+    st = RunStats()
+    sup = BatchSupervisor(_policy(max_retries=4), stats=st,
+                          stderr=io.StringIO(),
+                          faults=parse_fault_spec("down=2-4"),
+                          probe=lambda: (True, ""))
+    got = [sup.run("s", lambda: "dev", fallback=lambda: "host")
+           for _ in range(8)]
+    assert got == ["dev"] + ["host"] * 7
+    assert sup.breaker_open and sup.recloses == 0
+    assert st.res_breaker_recloses == 0
+    assert st.res_reprobe_attempts == 0
+    assert st.res_degraded_batches == 6
+
+
+def test_supervisor_state_export_restore_roundtrip():
+    sup, st, _ = _flap_supervisor()
+    for _ in range(3):   # leave the breaker OPEN mid-window
+        sup.run("ctx_scan", lambda: "dev", fallback=lambda: "host")
+    assert sup.breaker_open
+    exp = sup.export_state()
+    assert exp["breaker_open"] and exp["fault_calls"] == 3
+    json.dumps(exp)   # must be ckpt-serializable
+
+    # a fresh supervisor (the --resume process) inherits the state:
+    # no re-trip, the window continues at call 4, and it recovers
+    st2 = RunStats()
+    err2 = io.StringIO()
+    mon2 = BackendHealthMonitor(interval_s=0, max_interval_s=0,
+                                stats=st2, stderr=err2)
+    sup2 = BatchSupervisor(_policy(max_retries=4), stats=st2,
+                           stderr=err2,
+                           faults=parse_fault_spec("down=2-4"),
+                           probe=lambda: (True, ""), monitor=mon2)
+    sup2.restore_state(exp)
+    assert sup2.breaker_open
+    got = [sup2.run("ctx_scan", lambda: "dev", fallback=lambda: "host")
+           for _ in range(4)]
+    assert got == ["host", "host", "dev", "dev"]   # calls 4,5 / 6,7
+    assert st2.res_breaker_trips == 0              # inherited, not new
+    assert st2.res_breaker_recloses == 1
+
+    # malformed/old-build state must not kill the resume
+    sup3 = BatchSupervisor(_policy(), stderr=io.StringIO())
+    sup3.restore_state({"breaker_open": 0, "half_opens": "junk"})
+    assert not sup3.breaker_open
+    # ...and each field restores INDEPENDENTLY: one malformed field
+    # drops only itself — fault_calls after it must still land, or a
+    # scripted window would replay from call 1 on an open breaker
+    sup4 = BatchSupervisor(_policy(), stderr=io.StringIO(),
+                           faults=parse_fault_spec("down=2-4"))
+    sup4.restore_state({"breaker_open": True,
+                        "half_opens": {"s": "junk"},
+                        "fault_calls": 7})
+    assert sup4.breaker_open
+    assert sup4.faults._calls == 7
+    assert sup4._half_opens == {}
+
+
+def test_kill_fires_during_degraded_batches():
+    # kill=K counts breaker-skipped calls as attempts, so a kill can be
+    # scripted to land mid-outage (the resume test's setup)
+    sup, st, _ = _flap_supervisor("down=2-9,kill=8")
+    sup.run("s", lambda: "dev", fallback=lambda: "host")   # attempt 1
+    sup.run("s", lambda: "dev", fallback=lambda: "host")   # 2-6 (retry)
+    with pytest.raises(InjectedKill):
+        for _ in range(5):   # skipped calls tick 7, 8 -> kill
+            sup.run("s", lambda: "dev", fallback=lambda: "host")
+    assert sup.breaker_open
+
+
+# ---------------------------------------------------------------------------
+# CLI end-to-end: the acceptance contract
+# ---------------------------------------------------------------------------
+def _corpus(tmp_path, n=24, qlen=120):
+    rng = np.random.default_rng(3)
+    q = "".join("ACGT"[i] for i in rng.integers(0, 4, qlen))
+    lines = []
+    for i in range(n):
+        cut = 10 + int(rng.integers(0, qlen - 40))
+        qb = q[cut]
+        tb = "ACGT"[("ACGT".index(qb) + 1) % 4]
+        ops = [("=", cut), ("*", tb, qb), ("=", 20), ("ins", "gg"),
+               ("=", qlen - cut - 21)]
+        lines.append(make_paf_line("q", q, f"asm{i}", "+", ops)[0])
+    fa = tmp_path / "q.fa"
+    write_fasta(str(fa), [("q", q.encode())])
+    paf = tmp_path / "in.paf"
+    paf.write_text("".join(ln + "\n" for ln in lines))
+    return str(paf), str(fa)
+
+
+def _cli(tmp_path, tag, extra, paf, fa):
+    err = io.StringIO()
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / f"{tag}.dfa"),
+              "-w", str(tmp_path / f"{tag}.mfa"), "--device=tpu",
+              "--batch=2", f"--stats={tmp_path / f'{tag}.json'}"]
+             + extra, stderr=err)
+    return rc, err.getvalue()
+
+
+def _outs(tmp_path, tag):
+    return ((tmp_path / f"{tag}.dfa").read_bytes(),
+            (tmp_path / f"{tag}.mfa").read_bytes())
+
+
+def _res(tmp_path, tag):
+    return json.loads((tmp_path / f"{tag}.json").read_text())["resilience"]
+
+
+def test_cli_flap_recovers_byte_identical(tmp_path, monkeypatch):
+    """The acceptance gate: a scripted 4-call outage window on the
+    device CLI path — byte-identical report and MSA, with a breaker
+    trip AND a reclose, degraded AND recovered batches in --stats."""
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path)
+    rc, _ = _cli(tmp_path, "ref", [], paf, fa)
+    assert rc == 0
+    rc, err = _cli(tmp_path, "flap",
+                   ["--inject-faults=down=3-6", "--max-retries=4",
+                    "--reprobe-interval=0"], paf, fa)
+    assert rc == 0, err
+    assert _outs(tmp_path, "flap") == _outs(tmp_path, "ref")
+    res = _res(tmp_path, "flap")
+    assert res["breaker_trips"] == 1
+    assert res["breaker_recloses"] >= 1
+    assert res["degraded_batches"] > 0
+    assert res["recovered_batches"] > 0
+    assert res["reprobe_attempts"] > 0
+    assert res["degraded_wall_s"] > 0
+    assert "RECLOSED" in err
+    # the clean run reports all-zero recovery counters
+    ref = _res(tmp_path, "ref")
+    assert ref["breaker_recloses"] == ref["degraded_batches"] == 0
+    assert ref["recovered_batches"] == ref["reprobe_attempts"] == 0
+
+
+def test_cli_flap_recover_off_stays_degraded(tmp_path, monkeypatch):
+    """--recover=off: same scripted flap, same bytes, but the breaker
+    never recloses — the run ends degraded and says so."""
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path)
+    rc, _ = _cli(tmp_path, "ref", [], paf, fa)
+    assert rc == 0
+    rc, err = _cli(tmp_path, "off",
+                   ["--inject-faults=down=3-6", "--max-retries=4",
+                    "--recover=off"], paf, fa)
+    assert rc == 0, err
+    assert _outs(tmp_path, "off") == _outs(tmp_path, "ref")
+    res = _res(tmp_path, "off")
+    assert res["breaker_trips"] == 1
+    assert res["breaker_recloses"] == 0
+    assert res["recovered_batches"] == 0
+    assert res["reprobe_attempts"] == 0
+    assert res["degraded_batches"] > 0
+    assert "ended with the circuit breaker OPEN" in err
+
+
+def test_resume_mid_outage_repromotes_in_window(tmp_path, monkeypatch):
+    """Satellite: kill mid-outage (kill= lands while the breaker is
+    open), --resume inherits the ckpt's breaker + fault-clock state —
+    the resumed run continues INSIDE the same scripted window (no
+    re-trip: breaker_trips == 0), recloses after it, re-promotes, and
+    the final output is byte-identical."""
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path)
+    rc, _ = _cli(tmp_path, "ref", [], paf, fa)
+    assert rc == 0
+    with pytest.raises(InjectedKill):
+        _cli(tmp_path, "k",
+             ["--inject-faults=down=2-9,kill=10", "--max-retries=4",
+              "--reprobe-interval=0"], paf, fa)
+    ckpt = tmp_path / "k.dfa.ckpt"
+    assert ckpt.exists()
+    ck = json.loads(ckpt.read_text())
+    st = ck["resilience"]
+    assert st["breaker_open"] is True      # killed while degraded
+    assert 2 <= st["fault_calls"] <= 9     # ...inside the window
+    rc, err = _cli(tmp_path, "k",
+                   ["--resume", "--inject-faults=down=2-9",
+                    "--max-retries=4", "--reprobe-interval=0"],
+                   paf, fa)
+    assert rc == 0, err
+    assert _outs(tmp_path, "k") == _outs(tmp_path, "ref")
+    res = _res(tmp_path, "k")
+    assert res["breaker_trips"] == 0       # inherited open, no re-trip
+    assert res["breaker_recloses"] == 1
+    assert res["recovered_batches"] > 0
+    assert not ckpt.exists()
+
+
+def test_fallback_fail_abort_leaves_durable_prefix(tmp_path,
+                                                   monkeypatch):
+    """Satellite: the durability contract AFTER a --fallback=fail
+    abort — the <report>.ckpt names a valid durable prefix (exactly
+    what is on disk, whole records) and a --resume completes the run
+    byte-identically."""
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path)
+    rc, _ = _cli(tmp_path, "ref", [], paf, fa)
+    assert rc == 0
+    rc, err = _cli(tmp_path, "ff",
+                   ["--fallback=fail", "--max-retries=0",
+                    "--inject-faults=down=3-999"], paf, fa)
+    assert rc == 1
+    assert "--fallback=fail forbids degrading" in err
+    ckpt = tmp_path / "ff.dfa.ckpt"
+    assert ckpt.exists()
+    ck = json.loads(ckpt.read_text())
+    report = tmp_path / "ff.dfa"
+    # valid durable prefix: the ckpt byte count is exactly the file,
+    # and it holds exactly the checkpointed records, all complete
+    assert ck["bytes"] == os.path.getsize(report)
+    body = report.read_bytes()
+    assert ck["records"] > 0
+    assert body.count(b"\n>") + (1 if body.startswith(b">") else 0) \
+        == ck["records"]
+    assert body.endswith(b"\n")
+    rc, err = _cli(tmp_path, "ff", ["--resume"], paf, fa)
+    assert rc == 0, err
+    assert _outs(tmp_path, "ff") == _outs(tmp_path, "ref")
+    headers = [ln for ln in
+               (tmp_path / "ff.dfa").read_text().splitlines()
+               if ln.startswith(">")]
+    assert len(headers) == len(set(headers)) == 24
+
+
+def test_recovery_flag_validation(tmp_path):
+    paf, fa = _corpus(tmp_path, n=2)
+    for bad in (["--recover=maybe"], ["--recover"],
+                ["--reprobe-interval=x"], ["--reprobe-interval=-1"],
+                ["--reprobe-interval=inf"], ["--reprobe-max=x"],
+                ["--reprobe-interval=10", "--reprobe-max=5"],
+                ["--inject-faults=down=9-2"]):
+        err = io.StringIO()
+        assert run([paf, "-r", fa] + bad, stderr=err) == 1, bad
+        assert "Invalid" in err.getvalue(), bad
+    # setting only one side moves the other side's DEFAULT with it:
+    # a raised interval lifts the ceiling, a lowered ceiling pulls the
+    # first-probe delay down — neither consistent request errors
+    for ok in (["--reprobe-interval=600"], ["--reprobe-max=2"]):
+        err = io.StringIO()
+        assert run([paf, "-r", fa, "-o", str(tmp_path / "ok.dfa")]
+                   + ok, stderr=err) == 0, (ok, err.getvalue())

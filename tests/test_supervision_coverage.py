@@ -1,0 +1,47 @@
+"""Tier-1 gate for the static supervision-coverage check: every device
+round-trip entry point in ``pwasm_tpu/`` (jit programs, explicit
+host<->device transfers) must live in a module registered against a
+``BatchSupervisor.run`` site — new device code cannot silently bypass
+the resilience layer (ISSUE 3 satellite)."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def checker():
+    for p in (REPO, os.path.join(REPO, "qa")):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    import check_supervision
+    return check_supervision
+
+
+def test_all_device_entry_points_registered(checker):
+    bad = checker.find_unregistered()
+    assert bad == [], "\n".join(bad)
+
+
+def test_registry_has_no_stale_entries(checker):
+    stale = checker.stale_registry_entries()
+    assert stale == [], stale
+
+
+def test_checker_detects_patterns(checker, tmp_path):
+    # the check must actually SEE a violation, or a pattern regression
+    # (e.g. jax API rename) would silently pass forever
+    pkg = tmp_path / "pwasm_tpu"
+    pkg.mkdir()
+    (pkg / "rogue.py").write_text(
+        "import jax\n"
+        "f = jax.jit(lambda x: x)\n"
+        "y = jax.device_put(1)\n"
+        "# jax.device_get(y) in a comment is NOT a hit\n"
+        "z = f(y).block_until_ready()\n")
+    bad = checker.find_unregistered(str(tmp_path))
+    assert len(bad) == 3, bad
+    assert all("rogue.py" in b for b in bad)
