@@ -928,6 +928,13 @@ def cfg8_realistic_scale() -> int:
       (``realistic_flap_recovered_batches``, gated on
       breaker_recloses >= 1 / recovered_batches > 0 / byte parity —
       the ISSUE 3 acceptance contract);
+    - preempt: a scripted preemption (preempt=3) must drain at a batch
+      boundary, exit 75 with a CRC-valid ckpt, and --resume must
+      complete byte-identically (``realistic_preempt_resume_parity``);
+    - OOM: a simulated memory ceiling (oom=192) must finish on-device
+      via batch bisection — splits > 0, demotions > 0, NO breaker
+      trip, NO host fallback, byte parity (``realistic_oom_bisect``) —
+      the ISSUE 4 acceptance contract;
     - host engines: a 1k-alignment report+summary corpus A/Bs the
       vectorized columnar host engine against the scalar ground-truth
       engine (PWASM_HOST_COLUMNAR=0) — ``realistic_host_report_1k_s``
@@ -1084,6 +1091,68 @@ def cfg8_realistic_scale() -> int:
         _emit("realistic_flap_recovered_batches",
               flap_res["recovered_batches"], "batches",
               1.0 if flap_ok else 0.0, cpu_metric=True)
+
+        # --- preemption drain + resume (ISSUE 4 tentpole): a scripted
+        # preempt=3 over the supervised-call clock drains at a batch
+        # boundary — the run must exit 75 ("preempted, resumable")
+        # leaving a CRC-valid <report>.ckpt, and --resume must complete
+        # it BYTE-IDENTICALLY to the uninterrupted run.  The -s summary
+        # is excluded from the parity set by contract (a resumed
+        # summary covers only the resumed portion).
+        def read_nosum(tag):
+            o = outset(tag)
+            return b"".join(open(p, "rb").read()
+                            for p in (o[0], o[2], o[3]))
+
+        expected_nosum = read_nosum("py")
+        r = subprocess.run(
+            cmd + args("pre", ["--device=tpu", "--batch=16",
+                               "--inject-faults=preempt=3"]),
+            env=env, capture_output=True)
+        if r.returncode != 75:
+            sys.stderr.write(r.stderr.decode()[:1000])
+            return _fail("realistic_preempt")
+        if not os.path.exists(os.path.join(d, "pre.dfa.ckpt")):
+            return _fail("realistic_preempt_ckpt")
+        r = subprocess.run(
+            cmd + args("pre", ["--device=tpu", "--batch=16",
+                               "--resume"]),
+            env=env, capture_output=True)
+        if r.returncode != 0:
+            sys.stderr.write(r.stderr.decode()[:1000])
+            return _fail("realistic_preempt_resume")
+        if read_nosum("pre") != expected_nosum:
+            return _fail("realistic_preempt_resume_parity")
+        _emit("realistic_preempt_resume_parity", 1, "ok", 1.0,
+              cpu_metric=True)
+
+        # --- OOM bisection (ISSUE 4 tentpole): a simulated device
+        # memory ceiling (oom=192 items) makes every realistic flush
+        # too big — the supervisor must bisect down and demote the
+        # pow2 batch ceiling instead of retrying the shape, tripping
+        # the breaker, or degrading to the host: the run finishes
+        # ON-DEVICE, byte-identical to the clean arm.
+        stats_o = os.path.join(d, "oomb.stats")
+        r = subprocess.run(
+            cmd + args("oomb", ["--device=tpu", "--batch=16",
+                                "--inject-faults=oom=192",
+                                f"--stats={stats_o}"]),
+            env=env, capture_output=True)
+        if r.returncode != 0:
+            sys.stderr.write(r.stderr.decode()[:1000])
+            return _fail("realistic_oom")
+        if readset("oomb") != parity_body:
+            return _fail("realistic_oom_bisect_parity")
+        with open(stats_o) as f:
+            oom_js = json.load(f)
+        oom_res = oom_js["resilience"]
+        oom_ok = (oom_res["oom_events"] > 0
+                  and oom_res["batch_splits"] > 0
+                  and oom_res["bucket_demotions"] > 0
+                  and oom_res["breaker_trips"] == 0
+                  and oom_js["fallback_batches"] == 0)
+        _emit("realistic_oom_bisect", oom_res["batch_splits"],
+              "splits", 1.0 if oom_ok else 0.0, cpu_metric=True)
 
         # --- host engine A/B: 1k-alignment report+summary corpus ----
         qseq1k, lines1k = make_corpus(n_aln=1000)

@@ -53,9 +53,15 @@ USAGE = """Usage:
    --skip-bad-lines    warn and continue on malformed PAF lines
    --resume    append to an existing -o report, skipping alignments
                already emitted (a -s summary then covers only the
-               resumed portion); a device-path run leaves atomic
-               batch-granular checkpoints (<report>.ckpt), so a killed
-               run resumes at the last completed batch exactly
+               resumed portion); both report engines leave atomic
+               batch-granular checkpoints (<report>.ckpt, versioned +
+               CRC-validated), so a killed run resumes at the last
+               completed batch exactly — a ckpt that fails
+               verification is quarantined to <report>.ckpt.bad and
+               the run restarts cleanly.  SIGTERM/SIGINT drain
+               gracefully: the in-flight batch completes, a final
+               checkpoint lands, and the run exits 75 ("preempted,
+               resumable"; a second signal hard-aborts)
    --profile=DIR  write a jax.profiler device trace for the run
    --stats=FILE   write run statistics as one JSON object
    --max-retries=N    re-execute a failed/rejected device batch up to
@@ -68,7 +74,9 @@ USAGE = """Usage:
    --inject-faults=SPEC  debug: deterministic seeded fault injection
                into supervised device calls, e.g.
                seed=7,rate=0.3,kinds=raise+hang+nan+corrupt
-               or a scripted outage window down=A-B[+C-D]
+               a scripted outage window down=A-B[+C-D], a scripted
+               preemption preempt=N (graceful drain at supervised
+               call N), or a simulated memory ceiling oom=N
                (see pwasm_tpu/resilience/faults.py for the spec)
    --recover=auto|off  auto (default): once the circuit breaker
                confirms a dead backend, keep re-probing it (bounded)
@@ -162,56 +170,159 @@ def _ckpt_path(report_path: str) -> str:
     return report_path + ".ckpt"
 
 
+# Checkpoint format v2 (self-validating): the v1 ckpt was unversioned,
+# unchecksummed JSON — a torn or bit-rotted remnant that still parsed
+# could silently poison a resumed run.  v2 wraps the payload
+# ({bytes, records, resilience}) with a version tag and a CRC32 over
+# the payload's canonical JSON encoding, and _load_checkpoint verifies
+# BOTH plus a report-tail boundary check (the recorded byte offset must
+# land exactly on a record boundary of the actual report file).  Any
+# failure quarantines the ckpt to <report>.ckpt.bad and the run
+# restarts cleanly — never resumes onto garbage.
+CKPT_VERSION = 2
+_CKPT_META = ("version", "crc")   # non-payload keys, excluded from CRC
+
+
+def _ckpt_crc(ck: dict) -> int:
+    """CRC32 over the ckpt's payload fields in canonical JSON form
+    (sorted keys, no whitespace) — stable across write/parse
+    round-trips because the payload is ints/strings/bools/containers
+    only."""
+    import json
+    import zlib
+
+    payload = {k: v for k, v in ck.items() if k not in _CKPT_META}
+    return zlib.crc32(json.dumps(
+        payload, sort_keys=True, separators=(",", ":")).encode())
+
+
+def _on_record_boundary(report_path: str, nbytes: int) -> bool:
+    """True when byte offset ``nbytes`` of the report is a record
+    boundary: 0, or preceded by a newline with either EOF or the next
+    record's ``>`` header right after (a ckpt whose offset lands
+    mid-record describes a prefix that was never durable as claimed)."""
+    import os
+
+    try:
+        size = os.path.getsize(report_path)
+        if nbytes == 0:
+            return True
+        if nbytes > size:
+            return False
+        with open(report_path, "rb") as f:
+            if f.read(1) != b">":
+                return False     # not a report of this tool
+            f.seek(nbytes - 1)
+            if f.read(1) != b"\n":
+                return False
+            if nbytes < size and f.read(1) != b">":
+                return False
+        return True
+    except OSError:
+        return False
+
+
 def _load_checkpoint(report_path: str) \
-        -> tuple[int, int, dict | None] | None:
-    """Read the batch-granular resume checkpoint for ``report_path``.
-    Returns ``(bytes, records, resilience_state)`` — the durable report
-    prefix plus the breaker/monitor state snapshot (None in a ckpt from
-    an older build) — or None when absent, malformed, or inconsistent
-    with the report file (the ckpt must describe a prefix of what is
-    actually on disk)."""
+        -> tuple[int, int, dict | None] | str | None:
+    """Read and VERIFY the batch-granular resume checkpoint for
+    ``report_path``.  Returns ``(bytes, records, resilience_state)``
+    when the ckpt is whole (version + CRC verified, offset on a record
+    boundary of the actual report); ``None`` when no ckpt file exists
+    (the header-scan heuristic applies); or a ``str`` diagnostic when a
+    ckpt EXISTS but is torn/corrupt/inconsistent — the caller must
+    quarantine it and restart cleanly rather than resume onto
+    garbage."""
     import json
     import os
 
     try:
         with open(_ckpt_path(report_path)) as f:
-            ck = json.load(f)
+            raw = f.read()
+    except OSError:
+        return None
+    try:
+        ck = json.loads(raw)
+        if not isinstance(ck, dict):
+            raise ValueError("not an object")
+    except ValueError as e:
+        return f"unparseable ckpt JSON ({e})"
+    if ck.get("version") != CKPT_VERSION:
+        return f"ckpt version {ck.get('version')!r} != {CKPT_VERSION}"
+    try:
+        crc = int(ck["crc"])
         nbytes, nrec = ck["bytes"], ck["records"]
         if not (isinstance(nbytes, int) and isinstance(nrec, int)):
-            return None
-        if nbytes < 0 or nrec < 0 \
-                or nbytes > os.path.getsize(report_path):
-            return None
-        res = ck.get("resilience")
-        return nbytes, nrec, res if isinstance(res, dict) else None
-    except (OSError, ValueError, KeyError, TypeError):
-        return None
+            raise TypeError("bytes/records not ints")
+    except (KeyError, TypeError, ValueError) as e:
+        return f"malformed ckpt fields ({e})"
+    if crc != _ckpt_crc(ck):
+        return "ckpt payload CRC mismatch"
+    if nbytes < 0 or nrec < 0 or (nbytes == 0) != (nrec == 0):
+        return f"inconsistent ckpt counts (bytes={nbytes}, " \
+               f"records={nrec})"
+    try:
+        if nbytes > os.path.getsize(report_path):
+            return f"ckpt bytes {nbytes} past the report's " \
+                   f"{os.path.getsize(report_path)}"
+    except OSError as e:
+        return f"report unreadable ({e})"
+    if not _on_record_boundary(report_path, nbytes):
+        return f"ckpt offset {nbytes} is not a record boundary of " \
+               "the report"
+    res = ck.get("resilience")
+    return nbytes, nrec, res if isinstance(res, dict) else None
+
+
+def _quarantine_checkpoint(report_path: str, why: str, stderr) -> None:
+    """Move a failed-verification ckpt aside to ``<report>.ckpt.bad``
+    (preserved for post-mortem, out of every future resume's way) and
+    say so loudly."""
+    import os
+
+    from pwasm_tpu.utils.fsio import replace_durable
+
+    try:
+        replace_durable(_ckpt_path(report_path),
+                        _ckpt_path(report_path) + ".bad")
+    except OSError:
+        try:
+            os.unlink(_ckpt_path(report_path))
+        except OSError:
+            pass
+    print(f"Warning: checkpoint failed verification ({why}); "
+          f"quarantined to {_ckpt_path(report_path)}.bad — "
+          "restarting the run from scratch instead of resuming onto "
+          "a corrupt prefix", file=stderr)
 
 
 def _write_checkpoint(freport, report_path: str, records: int,
                       res_state: dict | None = None) -> bool:
-    """Atomically persist the report's durable prefix after one
-    completed device batch: fsync the report, then tmp-write + rename
-    the ckpt JSON.  ``res_state`` rides along (breaker / monitor /
-    fault-plan snapshot) so a ``--resume`` after a kill inherits
+    """Atomically AND durably persist the report's durable prefix after
+    one completed batch: fsync the report, then publish the v2
+    (versioned, CRC'd) ckpt JSON via the audited fsync-then-replace
+    (``utils.fsio``: tmp write + tmp fsync + rename + parent-dir
+    fsync — a crash at any instant leaves the old ckpt or the new one,
+    never a torn or empty file that merely *looks* atomic).
+    ``res_state`` rides along (breaker / monitor / fault-plan /
+    bucket-ceiling snapshot) so a ``--resume`` after a kill inherits
     mid-outage state.  Best-effort — a failed write never stops the run
     (returns False)."""
     import json
     import os
 
+    from pwasm_tpu.utils.fsio import write_durable_text
+
     try:
         freport.flush()
         os.fsync(freport.fileno())
         size = os.fstat(freport.fileno()).st_size
-        ck = {"bytes": size, "records": records}
+        ck = {"version": CKPT_VERSION, "bytes": size,
+              "records": records}
         if res_state is not None:
             ck["resilience"] = res_state
-        tmp = _ckpt_path(report_path) + ".tmp"
-        with open(tmp, "w") as cf:
-            json.dump(ck, cf)
-            cf.flush()
-            os.fsync(cf.fileno())
-        os.replace(tmp, _ckpt_path(report_path))
+        ck["crc"] = _ckpt_crc(ck)
+        write_durable_text(_ckpt_path(report_path), json.dumps(ck),
+                           tmp_suffix=".tmp")
         return True
     except OSError:
         return False
@@ -380,6 +491,7 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
             cfg.stats_path = str(opts["stats"])
         resume_skip = 0
         resume_state: dict | None = None
+        ckpt_quarantined = False
         if cfg.resume:
             if "o" not in opts:
                 raise CliError(f"{USAGE}\n--resume requires -o <report>\n")
@@ -387,18 +499,30 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
             # journal): a batch-granular <report>.ckpt names the exact
             # byte size and record count of the last COMPLETED batch —
             # truncate any torn tail past it and skip exactly those
-            # records, no re-emission.  Falls through to the header-scan
-            # heuristic below when absent or inconsistent.
+            # records, no re-emission.  The ckpt is SELF-VALIDATING
+            # (version + payload CRC + record-boundary check against
+            # the actual report): a ckpt that exists but fails any
+            # check is quarantined to <report>.ckpt.bad and the run
+            # RESTARTS CLEANLY — a bad journal must never half-resume
+            # via the header-scan heuristic below, which only applies
+            # when no ckpt was written at all.
             ck = _load_checkpoint(str(opts["o"]))
-            if ck is not None:
+            from pwasm_tpu.utils.fsio import truncate_durable
+            if isinstance(ck, str):
+                _quarantine_checkpoint(str(opts["o"]), ck, stderr)
+                ckpt_quarantined = True
+                try:
+                    truncate_durable(str(opts["o"]), 0)
+                except OSError:
+                    pass
+            elif ck is not None:
                 nbytes, resume_skip, resume_state = ck
                 try:
-                    with open(str(opts["o"]), "ab") as f:
-                        f.truncate(nbytes)
+                    truncate_durable(str(opts["o"]), nbytes)
                 except OSError:
                     resume_skip = 0
                     resume_state = None
-        if cfg.resume and resume_skip == 0:
+        if cfg.resume and resume_skip == 0 and not ckpt_quarantined:
             # The report is per-alignment independent in report mode:
             # resume = drop the LAST record (its event rows may be torn
             # by the interruption — a header alone doesn't prove the rows
@@ -436,8 +560,11 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
                 else:
                     keep, resume_skip = 0, 0  # not a report of this tool
                 if keep != size:
-                    with open(str(opts["o"]), "ab") as f:
-                        f.truncate(keep)
+                    # same durability contract as the ckpt-driven
+                    # truncate above: the dropped torn record must
+                    # stay dropped across a crash
+                    from pwasm_tpu.utils.fsio import truncate_durable
+                    truncate_durable(str(opts["o"]), keep)
             except OSError:
                 resume_skip = 0  # nothing emitted yet: a fresh run
         if not cfg.resume and "o" in opts:
@@ -506,12 +633,19 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
                 f"Cannot open file {opts['s']} for writing!\n")
         summary = Summary() if fsummary else None
 
+        from pwasm_tpu.resilience.lifecycle import SignalDrain
         from pwasm_tpu.utils import device_trace
-        with device_trace(cfg.profile_dir, stderr):
+        # graceful drain (SURVEY.md §5 / docs/RESILIENCE.md): the first
+        # SIGTERM/SIGINT only raises a flag the batch loop honors at
+        # the next batch boundary — in-flight work completes, a final
+        # checkpoint + partial --stats land, and the exit code says
+        # "preempted, resumable" (75); a second signal hard-aborts
+        with device_trace(cfg.profile_dir, stderr), \
+                SignalDrain(stderr=stderr) as drain:
             return _main_loop(cfg, inf, freport, fmsa, fsummary, summary,
                               qfasta, stdout, stderr, cons_outs,
                               resume_skip=resume_skip,
-                              resume_state=resume_state)
+                              resume_state=resume_state, drain=drain)
     except PwasmError as e:
         stderr.write(str(e))
         return e.exit_code
@@ -619,7 +753,7 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                qfasta: FastaFile, stdout, stderr,
                cons_outs: dict | None = None,
                resume_skip: int = 0,
-               resume_state: dict | None = None) -> int:
+               resume_state: dict | None = None, drain=None) -> int:
     """The per-PAF-line loop (pafreport.cpp:296-460)."""
     from pwasm_tpu.align.gapseq import FLAG_IS_REF, GapSeq
     from pwasm_tpu.align.msa import Msa
@@ -638,6 +772,10 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
     if fault_plan is not None:
         print(f"pwasm: fault injection armed (debug): {fault_plan}",
               file=stderr)
+        if fault_plan.preempt and drain is not None:
+            # the scripted preemption (preempt=N) pulls the SAME drain
+            # flag a real SIGTERM sets — one code path, two triggers
+            fault_plan.on_preempt = drain.request
     # --recover=auto (default): an open global breaker is re-probed on
     # a capped-exponential schedule and RECLOSES after consecutive
     # healthy probes — subsequent batches go back to the device
@@ -931,6 +1069,13 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
     try:
         file_line = 0
         for line in inf:
+            if drain is not None and drain.requested:
+                # graceful drain: stop consuming input at this batch
+                # boundary — the finally below completes the in-flight
+                # pipeline and checkpoints it, then the run exits
+                # "preempted, resumable" (the next --resume continues
+                # exactly here)
+                break
             file_line += 1
             line = line.rstrip("\n")
             if not line or line.startswith("#"):
@@ -1059,12 +1204,21 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         # dropped (the cpu path writes them progressively)
         flush_pending(drain=True)
 
-    flush_realign()
-    if nmsa is not None:
-        _native_msa_outputs(nmsa, cfg, fmsa, cons_outs, stderr,
-                            device=use_device, mesh=shard_mesh,
-                            stats=stats, supervisor=supervisor)
-    else:
+    # a drain requested during the final flushes still counts: the
+    # in-flight batches completed (and checkpointed) above, but the
+    # end-of-run MSA/consensus work is exactly the multi-second tail a
+    # preemption deadline cannot afford — skip it, exit resumable, and
+    # let the --resume run (which replays the MSA from the full input)
+    # produce the complete outputs
+
+    def _output_tail() -> None:
+        if nmsa is not None:
+            flush_realign()
+            _native_msa_outputs(nmsa, cfg, fmsa, cons_outs, stderr,
+                                device=use_device, mesh=shard_mesh,
+                                stats=stats, supervisor=supervisor)
+            return
+        flush_realign()
         if cfg.debug and ref_msa is not None:
             print(f">MSA ({ref_msa.count()})", file=stderr)
             ref_msa.print_layout(stderr, "v")
@@ -1090,20 +1244,46 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
             if "cons" in cons_outs:
                 ref_msa.write_cons(cons_outs["cons"], contig)
             stats.engine_fallbacks += ref_msa.engine_fallbacks
+
+    preempted = drain is not None and drain.requested
+    if not preempted:
+        # the tail runs in the drain's INTERRUPTIBLE phase: past the
+        # batch loop there is no next batch boundary to drain at, so a
+        # signal landing mid-consensus aborts the phase (PreemptedError)
+        # instead of being silently ignored until the model finishes —
+        # the tail's outputs are rebuilt whole by --resume, so an
+        # aborted tail loses nothing
+        from contextlib import nullcontext
+
+        from pwasm_tpu.resilience.lifecycle import PreemptedError
+        try:
+            with (drain.interrupting() if drain is not None
+                  else nullcontext()):
+                _output_tail()
+        except PreemptedError:
+            preempted = True
+    if preempted and nmsa is not None:
+        nmsa.close()   # no-op when the completed tail closed it
     for f in cons_outs.values():
         f.close()
     if fsummary is not None:
+        # on a preempted run this is the PARTIAL summary of the batches
+        # that completed before the drain — the --resume run rewrites
+        # it (documented: a resumed -s covers the resumed portion)
         summary.write(fsummary)
         fsummary.close()
     if freport not in (stdout, None):
         freport.close()
-    if report_path is not None:
+    if report_path is not None and not preempted:
         # the run completed: the report is whole, so the mid-run
         # checkpoint is obsolete (a later --resume skips via the
-        # header scan, which now sees only complete records)
+        # header scan, which now sees only complete records).  A
+        # PREEMPTED run keeps its checkpoint — it is the resume
+        # contract the drain just paid for.
         _unlink_checkpoint(report_path)
     supervisor.finalize_stats()   # a run ENDING degraded still owes
     #                               its open window to degraded_wall_s
+    stats.preempted = preempted
     if cfg.stats_path:
         try:
             with open(cfg.stats_path, "w") as f:
@@ -1130,6 +1310,14 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
               "wall)", file=stderr)
     if cfg.verbose:
         print(stats.brief(), file=stderr)
+    if preempted:
+        from pwasm_tpu.core.errors import EXIT_PREEMPTED
+        done = f"{emitted[0]} record(s) durable" if report_path \
+            else "no -o report (nothing checkpointed)"
+        print(f"pwasm: preempted ({drain.reason}) — drained cleanly, "
+              f"{done}; rerun with --resume to complete "
+              f"(exit {EXIT_PREEMPTED})", file=stderr)
+        return EXIT_PREEMPTED
     return 0
 
 

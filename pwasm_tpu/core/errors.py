@@ -17,6 +17,12 @@ EXIT_USAGE = 1
 EXIT_FATAL = 1  # GError's default exit status
 EXIT_PARSE = 3
 EXIT_ZERO_COVERAGE = 5
+# Ours, not the reference's: a run that caught SIGTERM/SIGINT (or the
+# scripted preempt= fault leg), drained its in-flight batch, flushed a
+# final checkpoint, and exited RESUMABLE — sysexits.h EX_TEMPFAIL, the
+# conventional "temporary failure; retry" status, which is exactly what
+# a preempted-but-checkpointed batch run is (--resume completes it).
+EXIT_PREEMPTED = 75
 
 
 class PwasmError(Exception):

@@ -184,17 +184,15 @@ class FastaFile:
                         return
                     rows.append(f"{name}\t{ent.length}\t{ent.offset}"
                                 f"\t{lb}\t{lw}\n")
-            # atomic publish: a concurrent reader must see either no
-            # sidecar or a complete one, never a prefix
-            tmp = self._fai_path + f".tmp.{os.getpid()}"
-            with open(tmp, "w") as f:
-                f.writelines(rows)
-            os.replace(tmp, self._fai_path)
+            # atomic + durable publish (utils.fsio): a concurrent
+            # reader must see either no sidecar or a complete one,
+            # never a prefix — and a crash right after the rename must
+            # not leave a complete rename of an unwritten file
+            from pwasm_tpu.utils.fsio import write_durable_text
+            write_durable_text(self._fai_path, "".join(rows))
         except OSError:
-            try:
-                os.unlink(tmp)
-            except (OSError, UnboundLocalError):
-                pass
+            # best-effort sidecar: write_durable_text cleans up its
+            # own tmp file on failure
             return
 
     def _full_scan(self) -> None:

@@ -50,7 +50,19 @@ def _compile(extra_args: list[str], dest: str, what: str) -> bool:
         except OSError:
             pass
         return False
-    os.replace(tmp, dest)
+    # durable publish (utils.fsio): the compiled artifact is cached
+    # state a sibling process may dlopen seconds later — it must never
+    # appear complete-but-empty after a crash
+    from pwasm_tpu.utils.fsio import replace_durable
+    try:
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass
+    replace_durable(tmp, dest)
     return True
 
 

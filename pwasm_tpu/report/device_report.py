@@ -98,14 +98,15 @@ def submit_events_device(refseq: bytes, events,
     small = [ev for ev, ok in zip(events, fits) if ok]
     big = [ev for ev, ok in zip(events, fits) if not ok]
     out = None
-    launch = None
+    chunks: list[list] = []
+    pre: list = []
     if small:
         mot_codes, mot_lens = pack_motifs(motifs)
         ref_codes = np.full(max_len, PAD_CODE, dtype=np.int8)
         ref_codes[:ref_len] = encode(refseq.upper())
 
-        def launch():
-            packed = pack_events(small, max_ev)
+        def launch_for(evs):
+            packed = pack_events(evs, max_ev)
             if mesh is not None:
                 # --shard: spread the event batch over the mesh (all
                 # axes flattened — the analysis is embarrassingly
@@ -129,34 +130,72 @@ def submit_events_device(refseq: bytes, events,
                                    skip_codan=skip_codan)
 
         if supervisor is None:
-            out = launch()
+            out = launch_for(small)
         else:
-            try:
-                out = launch()   # async submit; failures retried at
-            except Exception:    # finish inside the supervised attempt
-                out = None
+            # a prior OOM demoted the run's pow2 batch ceiling: pre-
+            # chunk this flush to it so the allocation that failed is
+            # never launched again (one bisection per run, not one per
+            # flush); each chunk is supervised independently below
+            ceil = supervisor.bucket_ceiling
+            if ceil and len(small) > ceil:
+                chunks = [small[i:i + ceil]
+                          for i in range(0, len(small), ceil)]
+            else:
+                chunks = [small]
+            for evs in chunks:
+                try:
+                    pre.append(launch_for(evs))  # async submit;
+                except Exception:    # failures retried at finish
+                    pre.append(None)  # inside the supervised attempt
 
     def fetch_unpack(o) -> dict:
         # ONE host fetch for the whole analysis, then numpy views
         return unpack_ctx_scan(np.asarray(o), max_codons, skip_codan)
+
+    def merge_parts(parts) -> dict:
+        """Reassemble per-part ctx_scan host dicts in item order: each
+        part contributes exactly its live rows (its arrays are padded
+        to a compile bucket, so slice before concatenating)."""
+        if len(parts) == 1:
+            return parts[0][1]
+        keys = list(parts[0][1].keys())
+        return {k: np.concatenate(
+            [np.asarray(r[k])[:len(evs)] for evs, r in parts], axis=0)
+            for k in keys}
 
     def finish() -> list[tuple]:
         results: dict[int, tuple] = {}
         if small:
             if supervisor is not None:
                 from pwasm_tpu.resilience.guardrails import check_ctx_scan
-                pending = [out]
+                from pwasm_tpu.resilience.supervisor import \
+                    BisectableBatch
 
-                def attempt():
-                    o = pending.pop() if pending else None
-                    o = launch() if o is None else o
-                    return fetch_unpack(o)
+                def validate_for(h, evs):
+                    check_ctx_scan(h, len(evs), ref_len, len(motifs),
+                                   skip_codan)
 
-                host = supervisor.run(
-                    "ctx_scan", attempt,
-                    validate=lambda h: check_ctx_scan(
-                        h, len(small), ref_len, len(motifs),
-                        skip_codan))
+                parts = []
+                for evs, submitted in zip(chunks, pre):
+                    pending = [submitted]
+
+                    def attempt(evs=evs, pending=pending):
+                        o = pending.pop() if pending else None
+                        o = launch_for(evs) if o is None else o
+                        return fetch_unpack(o)
+
+                    part = supervisor.run(
+                        "ctx_scan", attempt,
+                        validate=lambda h, evs=evs: validate_for(
+                            h, evs),
+                        bisect=BisectableBatch(
+                            items=evs,
+                            attempt_for=lambda e: fetch_unpack(
+                                launch_for(e)),
+                            combine=merge_parts,
+                            validate_for=validate_for))
+                    parts.append((evs, part))
+                host = merge_parts(parts)
             else:
                 if stats is not None \
                         and hasattr(stats, "note_dispatch"):

@@ -31,11 +31,13 @@ the ``--stats`` JSON.
 """
 
 from pwasm_tpu.resilience.faults import (  # noqa: F401
-    FaultPlan, InjectedFault, InjectedKill, InjectedOutage,
+    FaultPlan, InjectedFault, InjectedKill, InjectedOOM, InjectedOutage,
     parse_fault_spec)
 from pwasm_tpu.resilience.health import (  # noqa: F401
     BackendHealthMonitor, wait_for_backend)
 from pwasm_tpu.resilience.guardrails import GuardrailViolation  # noqa: F401
+from pwasm_tpu.resilience.lifecycle import (  # noqa: F401
+    PreemptedError, SignalDrain)
 from pwasm_tpu.resilience.supervisor import (  # noqa: F401
-    BatchSupervisor, DeadlineExceeded, DeviceWorkFailed, ResilienceError,
-    ResiliencePolicy)
+    BatchSupervisor, BisectableBatch, DeadlineExceeded, DeviceWorkFailed,
+    ResilienceError, ResiliencePolicy, is_oom_error)

@@ -30,6 +30,19 @@ comma-separated ``key=value`` list:
                   and a batch skipped by an open global breaker counts
                   as one attempt) — simulates a mid-run process kill
                   for checkpoint / resume testing
+  ``preempt=N``   request a GRACEFUL DRAIN (``resilience.lifecycle``)
+                  at the N-th supervised call: the run finishes its
+                  in-flight batch, flushes a final checkpoint and a
+                  partial ``--stats``, and exits with the documented
+                  preempted-resumable code (75) — the scripted twin of
+                  a fleet scheduler's SIGTERM, deterministic for
+                  drain/resume parity tests
+  ``oom=N``       simulated device memory ceiling, in batch items: any
+                  supervised attempt over a batch LARGER than N items
+                  raises a ``RESOURCE_EXHAUSTED``-shaped
+                  :class:`InjectedOOM` — deterministic by size, so the
+                  supervisor's batch bisection provably converges (the
+                  halves at or under N succeed)
   ``down=A-B``    scripted OUTAGE WINDOWS, ``+``-separated inclusive
                   1-based ranges over the global supervised-CALL
                   counter (one tick per ``BatchSupervisor.run``
@@ -82,6 +95,15 @@ class InjectedOutage(InjectedFault):
     from a random computational fault."""
 
 
+class InjectedOOM(InjectedFault):
+    """The ``RESOURCE_EXHAUSTED``-shaped error the ``oom=N`` leg throws
+    for any supervised attempt whose batch exceeds the simulated memory
+    ceiling.  The message deliberately carries the real XLA marker so
+    the supervisor's OOM *classifier* (not an isinstance check) is what
+    the injection exercises — the same code path a live chip's
+    allocation failure takes."""
+
+
 class InjectedKill(BaseException):
     """Simulated process kill (``kill=K``).  Derives from BaseException
     so no retry/fallback layer can swallow it — it unwinds the whole
@@ -97,7 +119,14 @@ class FaultPlan:
     sites: frozenset[str] | None = None   # None = all sites
     hang_s: float = 30.0
     kill: int = 0                         # 0 = disabled; else 1-based
+    preempt: int = 0                      # 0 = disabled; else 1-based
+    #          supervised call at which a graceful drain is requested
+    oom: int = 0                          # 0 = disabled; else the
+    #          simulated device memory ceiling in batch items
     down: tuple[tuple[int, int], ...] = ()  # outage windows over _calls
+    on_preempt: object = field(default=None, repr=False)  # drain hook:
+    #          (reason: str) -> None, wired to SignalDrain.request by
+    #          the CLI so preempt= drives the same flag a SIGTERM sets
     _site_counters: dict = field(default_factory=dict, repr=False)
     _attempts: int = field(default=0, repr=False)
     _calls: int = field(default=0, repr=False)  # supervised-call clock
@@ -105,6 +134,7 @@ class FaultPlan:
     #          calls included) — the down= windows are scripted on it,
     #          and it is persisted in <report>.ckpt so a --resume lands
     #          back inside the same scripted window
+    _preempted: bool = field(default=False, repr=False)
 
     def note_call(self) -> None:
         """Advance the supervised-call clock — called once at every
@@ -112,6 +142,22 @@ class FaultPlan:
         attempted (an open breaker must not freeze a scripted outage
         window, or a flap could never end)."""
         self._calls += 1
+        if self.preempt and not self._preempted \
+                and self._calls >= self.preempt:
+            # fires once; >= (not ==) so a --resume whose restored
+            # clock already passed the mark still drains rather than
+            # silently disarming the scripted preemption
+            self._preempted = True
+            if self.on_preempt is not None:
+                self.on_preempt(f"injected preemption at supervised "
+                                f"call {self._calls}")
+
+    def oom_for(self, size: int | None) -> bool:
+        """True when an attempt over ``size`` batch items must raise
+        :class:`InjectedOOM` (deterministic by size — retrying the same
+        shape can never succeed, which is the scenario the supervisor's
+        bisection exists for)."""
+        return bool(self.oom) and size is not None and size > self.oom
 
     def note_skipped(self, site: str) -> None:
         """A supervised call skipped by an open breaker still counts as
@@ -257,6 +303,14 @@ def parse_fault_spec(spec: str) -> FaultPlan:
                 plan.kill = int(val)
                 if plan.kill < 0:
                     raise ValueError
+            elif key == "preempt":
+                plan.preempt = int(val)
+                if plan.preempt < 0:
+                    raise ValueError
+            elif key == "oom":
+                plan.oom = int(val)
+                if plan.oom < 0:
+                    raise ValueError
             elif key == "down":
                 wins = []
                 for rng_s in val.split("+"):
@@ -273,7 +327,8 @@ def parse_fault_spec(spec: str) -> FaultPlan:
         except ValueError:
             raise ValueError(
                 f"bad fault spec item: {item!r} "
-                f"(keys: seed rate kinds sites hang_s kill down)")
+                f"(keys: seed rate kinds sites hang_s kill preempt "
+                f"oom down)")
     return plan
 
 

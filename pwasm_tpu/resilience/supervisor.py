@@ -32,6 +32,19 @@ fail-fast model lacks (SURVEY.md §2.5.12 vs §5):
   bit-exact host path, ``--fallback=fail`` aborts the run loudly with
   a :class:`ResilienceError` — for pipelines where silent CPU walls
   are worse than a dead job;
+- **OOM-aware bisection**: a device allocation failure
+  (``RESOURCE_EXHAUSTED`` / XLA OOM, classified by
+  :func:`is_oom_error`) is a different animal from every fault above —
+  retrying the identical shape re-fails deterministically, and the
+  backend is healthy, so charging the breaker (or degrading to the
+  host) would be wrong while a smaller batch can succeed.  A site that
+  declares a :class:`BisectableBatch` gets its batch split in half
+  recursively (each half re-supervised in full) down to a floor, the
+  run's pow2 batch ceiling is demoted (``bucket_ceiling``, persisted
+  in the checkpoint) so future flushes pre-chunk instead of re-OOMing,
+  and ``oom_events``/``batch_splits``/``bucket_demotions`` land in the
+  stats — the host fallback is reached only when floor-size splits
+  still OOM;
 - **recovery** (``resilience.health``): an open global breaker is no
   longer terminal — a :class:`BackendHealthMonitor` re-probes the
   backend on a capped-exponential schedule and, after its hysteresis
@@ -53,11 +66,33 @@ import random
 import sys
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 
 from pwasm_tpu.core.errors import PwasmError
 from pwasm_tpu.resilience.faults import FaultPlan
 from pwasm_tpu.resilience.guardrails import GuardrailViolation
+
+# substrings that mark a device ALLOCATION failure, lower-cased: the
+# XLA status name every jax backend surfaces on OOM, plus the two
+# free-text forms seen from the TPU allocator and the BFC allocator.
+# Classification is textual on purpose — jaxlib's exception classes
+# moved across releases (jaxcompat shields us elsewhere), and the
+# injected InjectedOOM carries the same marker so the fault leg proves
+# the LIVE classifier, not a parallel isinstance path.
+_OOM_MARKERS = ("resource_exhausted", "out of memory",
+                "failed to allocate")
+
+
+def is_oom_error(e: BaseException | None) -> bool:
+    """True when ``e`` is a device allocation failure — the failure
+    class where retrying the identical shape is pointless (the
+    allocation will fail again) and the breaker must stay untouched
+    (the backend is healthy, the *batch* is too big): the supervisor
+    bisects instead."""
+    if e is None:
+        return False
+    s = f"{type(e).__name__}: {e}".lower()
+    return any(m in s for m in _OOM_MARKERS)
 
 
 class DeadlineExceeded(Exception):
@@ -74,6 +109,25 @@ class DeviceWorkFailed(Exception):
 class ResilienceError(PwasmError):
     """Fatal under ``--fallback=fail``: device work failed after the
     bounded retries and the policy forbids degrading to the host."""
+
+
+@dataclass
+class BisectableBatch:
+    """How a supervised site lets the supervisor SPLIT its batch when
+    the device reports ``RESOURCE_EXHAUSTED``: the ordered item list
+    the attempt covers, a factory building a fresh attempt over any
+    sub-list, a per-part validator, and the combiner that reassembles
+    the per-part results in item order.  Bisection recurses through
+    ``BatchSupervisor.run`` itself, so every sub-attempt keeps the full
+    supervision contract (retries, deadline, guardrails, injection) —
+    only the shape shrinks."""
+
+    items: list                 # the batch, in result order
+    attempt_for: object         # (items) -> result (launch + fetch)
+    combine: object             # (list[(items, result)]) -> result
+    validate_for: object = None  # (result, items) -> None, may raise
+    #                              GuardrailViolation
+    floor: int = 1              # never split below this many items
 
 
 @dataclass
@@ -135,6 +189,12 @@ class BatchSupervisor:
         self.breaker_open = False               # global (backend dead)
         self.recloses = 0                       # global breaker recloses
         self._degraded_t0: float | None = None  # breaker-open wall start
+        self.bucket_ceiling: int | None = None  # pow2 batch-size
+        #          ceiling demoted by a device OOM: call sites that
+        #          declare a BisectableBatch pre-chunk their batches to
+        #          it for the rest of the run (and it persists in the
+        #          <report>.ckpt), so one RESOURCE_EXHAUSTED costs one
+        #          bisection, not one per future flush
         # jitter exists to de-synchronize retry storms across the many
         # processes of a batch fleet, so it must be seeded per process
         # (a fixed seed would make every process retry at the same
@@ -152,7 +212,9 @@ class BatchSupervisor:
         print(f"pwasm: {msg}", file=self.stderr)
 
     # ---- the supervised call -------------------------------------------
-    def run(self, site: str, attempt, validate=None, fallback=None):
+    def run(self, site: str, attempt, validate=None, fallback=None,
+            bisect: BisectableBatch | None = None,
+            size: int | None = None):
         """Execute ``attempt()`` under the policy and return its
         (validated) result.
 
@@ -162,7 +224,20 @@ class BatchSupervisor:
         is given up on (``fallback=cpu`` policy); without one, gives up
         by raising :class:`DeviceWorkFailed` so the caller can degrade.
         Under ``--fallback=fail`` exhaustion raises
-        :class:`ResilienceError` instead (fatal)."""
+        :class:`ResilienceError` instead (fatal).
+
+        ``bisect`` (a :class:`BisectableBatch`) makes the attempt
+        OOM-recoverable: an allocation failure (``is_oom_error`` — real
+        ``RESOURCE_EXHAUSTED``/XLA OOM or the injected ``oom=`` leg) is
+        NOT retried at the same shape and NEVER charges the breaker
+        window; the batch is bisected recursively (down to
+        ``bisect.floor``) and the pow2 batch ceiling is demoted for the
+        rest of the run.  Degradation to the fallback happens only when
+        even floor-size splits fail.  ``size`` declares the attempt's
+        batch item count for the ``oom=`` injection (defaults to
+        ``len(bisect.items)`` when a bisect spec is given)."""
+        if size is None and bisect is not None:
+            size = len(bisect.items)
         if self.faults is not None:
             # the scripted-outage clock ticks once per supervised call,
             # INCLUDING degraded ones — an open breaker must not freeze
@@ -197,7 +272,7 @@ class BatchSupervisor:
                     # host-blocking fetch the attempt ends in)
                     self.stats.note_dispatch(site)
                     self.stats.note_flush()
-                result = self._attempt_once(site, attempt)
+                result = self._attempt_once(site, attempt, size)
                 if validate is not None:
                     validate(result)
                 self._consecutive[site] = 0
@@ -215,25 +290,119 @@ class BatchSupervisor:
                 self._count("res_deadline_timeouts")
                 last = e
             except Exception as e:
+                if is_oom_error(e):
+                    # allocation failure: retrying the IDENTICAL shape
+                    # is pointless and the backend is not sick — hand
+                    # over to the bisection path, outside both the
+                    # retry loop and the breaker's failure window
+                    return self._handle_oom(site, e, bisect, fallback)
                 last = e
             if self._note_failure(site, last):
                 break   # breaker opened: stop burning retries
         return self._degrade(site, fallback, _detail(last), last)
 
-    def _attempt_once(self, site: str, attempt):
+    # ---- OOM: bisect, never trip ---------------------------------------
+    def _handle_oom(self, site: str, err: BaseException,
+                    bisect: BisectableBatch | None, fallback):
+        """A device allocation failure: count it, demote the batch
+        ceiling, and bisect when the site declared how — the breaker is
+        NEVER charged (the backend is healthy; the shape was too big)
+        and the host fallback is reached only when no smaller split can
+        succeed."""
+        self._count("res_oom_events")
+        if bisect is not None and len(bisect.items) > max(1, bisect.floor):
+            self._demote_bucket(site, len(bisect.items))
+            try:
+                return self._bisect(site, bisect)
+            except ResilienceError:
+                raise  # --fallback=fail is fatal at any depth
+            except Exception as e2:
+                # a half exhausted its own policy (DeviceWorkFailed) or
+                # the recombine failed: the WHOLE batch degrades here,
+                # through the caller's fallback — halves never fall
+                # back alone
+                return self._degrade(site, fallback, _detail(e2), e2)
+        self._warn(f"{site}: device allocation failed "
+                   f"({_detail(err)}) and the batch cannot be split "
+                   "further; degrading")
+        return self._degrade(site, fallback, _detail(err), err)
+
+    def _bisect(self, site: str, spec: BisectableBatch):
+        """Split ``spec.items`` in half and re-run each half through
+        the FULL supervised path (so halves keep retries, deadlines,
+        guardrails, injection — and recursively bisect on further
+        OOM), then recombine in item order."""
+        items = spec.items
+        mid = (len(items) + 1) // 2
+        self._count("res_batch_splits")
+        self._warn(f"{site}: bisecting {len(items)}-item batch into "
+                   f"{mid}+{len(items) - mid} after device OOM")
+        parts = []
+        for sub in (items[:mid], items[mid:]):
+            if not sub:
+                continue
+            sub_spec = replace(spec, items=sub)
+            validate = None
+            if spec.validate_for is not None:
+                validate = (lambda r, _s=sub:
+                            spec.validate_for(r, _s))
+            r = self.run(
+                site,
+                (lambda _s=sub_spec: _s.attempt_for(_s.items)),
+                validate=validate,
+                fallback=None,   # a failed half raises
+                #  DeviceWorkFailed and the TOP-level _handle_oom /
+                #  caller owns the whole-batch degradation — a half
+                #  must never fall back alone (order would survive,
+                #  but the caller's fallback replays the full batch)
+                bisect=sub_spec if len(sub) > max(1, spec.floor)
+                else None,
+                size=len(sub))
+            parts.append((sub, r))
+        return spec.combine(parts)
+
+    def _demote_bucket(self, site: str, failed_size: int) -> None:
+        """An attempt over ``failed_size`` items OOMed: the rest of the
+        run must stop launching that pow2 bucket.  The new ceiling is
+        half the bucket that failed; only an actual lowering counts
+        (recursive bisection demotes step by step, once per level)."""
+        bucket = 1 << max(0, int(failed_size) - 1).bit_length()
+        new = max(1, bucket // 2)
+        if self.bucket_ceiling is None or new < self.bucket_ceiling:
+            self.bucket_ceiling = new
+            self._count("res_bucket_demotions")
+            self._warn(f"{site}: batch bucket ceiling demoted to "
+                       f"{new} items for the rest of the run "
+                       f"(device OOM at {failed_size})")
+
+    def _attempt_once(self, site: str, attempt, size: int | None = None):
         plan = self.faults
 
         def body():
             if plan is None:
                 return attempt()
             kind = plan.draw(site)       # may raise InjectedKill
-            if kind is not None:
-                self._count("res_injected_faults")
             if kind == "down":
+                self._count("res_injected_faults")
                 from pwasm_tpu.resilience.faults import InjectedOutage
                 raise InjectedOutage(
                     f"injected backend outage at {site} (tunnel down — "
                     "scripted down= window)")
+            if plan.oom_for(size):
+                # the simulated memory ceiling: allocation fails before
+                # any compute, like the real allocator — it DOMINATES a
+                # drawn compute-stage kind (which never fires and is
+                # not counted: exactly one count per observable fault),
+                # while the outage above dominates the OOM (a dead
+                # tunnel cannot even try to allocate)
+                from pwasm_tpu.resilience.faults import InjectedOOM
+                self._count("res_injected_faults")
+                raise InjectedOOM(
+                    f"injected RESOURCE_EXHAUSTED at {site}: batch of "
+                    f"{size} items exceeds the simulated device memory "
+                    f"ceiling ({plan.oom})")
+            if kind is not None:
+                self._count("res_injected_faults")
             if kind == "raise":
                 from pwasm_tpu.resilience.faults import InjectedFault
                 raise InjectedFault(f"injected device fault at {site}")
@@ -383,6 +552,7 @@ class BatchSupervisor:
             "half_opens": dict(self._half_opens),
             "consecutive": {k: v for k, v in self._consecutive.items()
                             if v},
+            "bucket_ceiling": self.bucket_ceiling,
         }
         if self.faults is not None:
             st["fault_calls"] = self.faults._calls
@@ -416,6 +586,13 @@ class BatchSupervisor:
             self, "_consecutive",
             {str(k): int(v) for k, v
              in dict(st.get("consecutive", {})).items()}))
+        if st.get("bucket_ceiling") is not None:
+            # a demoted batch ceiling is a fact about the DEVICE, not
+            # the killed process: a --resume must not re-OOM its way
+            # back down to it one bisection at a time
+            field(lambda: setattr(
+                self, "bucket_ceiling",
+                max(1, int(st["bucket_ceiling"]))))
         if self.faults is not None and "fault_calls" in st:
             field(lambda: setattr(
                 self.faults, "_calls", int(st["fault_calls"])))

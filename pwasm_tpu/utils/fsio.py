@@ -1,0 +1,88 @@
+"""Durable state writes: the one audited fsync-then-replace.
+
+Every file this tree treats as *state* — the ``<report>.ckpt`` resume
+journal, the ``.fai`` FASTA sidecar, the published native build
+artifacts — must survive a crash at any instant with either the old
+content or the new content on disk, never a torn prefix.  ``os.replace``
+alone does NOT give that: without an fsync of the tmp file the rename
+can land before the data blocks do (a crash then leaves a *complete
+rename of an empty file*), and without an fsync of the parent directory
+the rename itself may not be durable.  The full pattern is
+
+    write tmp -> flush -> fsync(tmp) -> os.replace(tmp, dest)
+              -> fsync(parent dir)
+
+and it lives HERE, once: ``qa/check_durability.py`` (tier-1) fails any
+``os.replace``/``os.rename`` call site elsewhere in the tree, so a new
+state writer cannot quietly ship the torn-file bug this module exists
+to close.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def fsync_dir(path: str) -> None:
+    """Best-effort fsync of a directory (makes a just-landed rename
+    durable).  Silently a no-op where directories cannot be opened or
+    fsynced (some filesystems, non-POSIX platforms) — the rename is
+    still atomic there, just not crash-durable, which is the best the
+    platform offers."""
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def replace_durable(tmp: str, dest: str) -> None:
+    """``os.replace`` + parent-directory fsync.  The caller owns the
+    tmp file's own fsync (``write_durable_*`` below do it; a caller
+    publishing e.g. a freshly compiled artifact does it on its own
+    handle)."""
+    os.replace(tmp, dest)
+    fsync_dir(os.path.dirname(os.path.abspath(dest)))
+
+
+def write_durable_bytes(dest: str, data: bytes,
+                        tmp_suffix: str | None = None) -> None:
+    """Atomically and durably publish ``data`` at ``dest`` via the full
+    tmp-write/fsync/replace/dir-fsync pattern.  ``tmp_suffix`` names
+    the tmp file (default ``.<pid>.tmp`` — process-unique so
+    concurrent writers of the same dest never share a tmp)."""
+    tmp = dest + (tmp_suffix if tmp_suffix is not None
+                  else f".{os.getpid()}.tmp")
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        replace_durable(tmp, dest)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def write_durable_text(dest: str, text: str,
+                       tmp_suffix: str | None = None) -> None:
+    write_durable_bytes(dest, text.encode("utf-8"), tmp_suffix)
+
+
+def truncate_durable(path: str, nbytes: int) -> None:
+    """Truncate ``path`` to ``nbytes`` and fsync.  A truncation is a
+    state write too: the resume path uses it to drop a torn report
+    tail past the checkpointed prefix, and without the fsync a crash
+    could resurrect the very bytes the checkpoint said were gone."""
+    with open(path, "ab") as f:
+        f.truncate(nbytes)
+        f.flush()
+        os.fsync(f.fileno())

@@ -54,6 +54,22 @@ class RunStats:
         #                                a healthy backend)
         self.res_injected_faults = 0   # faults injected (--inject-faults)
         self.res_checkpoints = 0       # durable batch checkpoints written
+        # memory-pressure counters (OOM-aware bisection): allocation
+        # failures are classified apart from transient faults — they
+        # bisect the batch instead of retrying the same shape, and they
+        # never trip the breaker
+        self.res_oom_events = 0        # device allocation failures seen
+        #                                (real RESOURCE_EXHAUSTED or the
+        #                                injected oom= leg)
+        self.res_batch_splits = 0      # batches bisected after an OOM
+        self.res_bucket_demotions = 0  # pow2 batch-ceiling lowerings
+        #                                (each one shrinks every later
+        #                                flush for the rest of the run)
+        self.preempted = False         # the run exited via a graceful
+        #                                drain (SIGTERM/SIGINT or the
+        #                                preempt= leg): stats are
+        #                                PARTIAL and the report is a
+        #                                resumable prefix
         # recovery counters (pwasm_tpu.resilience.health): the
         # flap-recovery layer's decisions — a degraded run that heals
         # shows recloses/recovered > 0; one that stays walled shows
@@ -135,12 +151,16 @@ class RunStats:
                 "site_breaker_trips": self.res_site_breaker_trips,
                 "injected_faults": self.res_injected_faults,
                 "checkpoints": self.res_checkpoints,
+                "oom_events": self.res_oom_events,
+                "batch_splits": self.res_batch_splits,
+                "bucket_demotions": self.res_bucket_demotions,
                 "breaker_recloses": self.res_breaker_recloses,
                 "reprobe_attempts": self.res_reprobe_attempts,
                 "degraded_batches": self.res_degraded_batches,
                 "recovered_batches": self.res_recovered_batches,
                 "degraded_wall_s": round(self.res_degraded_wall_s, 3),
             },
+            "preempted": self.preempted,
             "wall_s": round(self.wall_s, 3),
             "aligned_bases_per_s": round(self.rate(), 1),
         }

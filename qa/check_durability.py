@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Static durability-coverage check (tier-1).
+
+The checkpoint layer is only as durable as its WEAKEST state writer: a
+new module that calls ``os.replace``/``os.rename`` directly looks
+atomic in code review but ships the classic torn-file bug — without an
+fsync of the tmp file the rename can land before the data blocks do,
+and without an fsync of the parent directory the rename itself may not
+survive a crash.  ``pwasm_tpu/utils/fsio.py`` holds the one audited
+fsync-then-replace implementation; this check greps ``pwasm_tpu/``,
+``qa/`` and ``bench.py`` for rename-publish entry points and fails
+when any hit lives outside that module (or a registered, justified
+exemption) — forcing the author of a new state writer to route through
+``fsio.replace_durable``/``write_durable_*`` or to argue the exemption
+in the registry below.
+
+Registry semantics, per module (repo-relative path):
+
+- ``impl:<why>``    the audited implementation itself (must contain
+                    both ``os.fsync`` and ``os.replace`` — verified);
+- ``exempt:<why>``  deliberately undurable (scratch files whose loss
+                    costs a rebuild, not correctness) — the
+                    justification is the registry entry itself.
+
+Run standalone (``python qa/check_durability.py``, exit 1 on
+violations) or through ``tests/test_durability.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# rename-publish entry points: the calls that make a tmp file LOOK
+# atomically published.  shutil.move is included because it degrades
+# to copy+rename across filesystems — same review trap, worse window.
+PATTERNS = re.compile(
+    r"os\.replace\s*\(|os\.rename\s*\(|shutil\.move\s*\(")
+
+_FSIO = "pwasm_tpu/utils/fsio.py"
+
+# module -> justification (see module docstring for the grammar)
+REGISTRY = {
+    _FSIO: "impl: the one audited fsync-then-replace "
+           "(write tmp -> fsync tmp -> os.replace -> fsync parent dir)",
+}
+
+# directories scanned, relative to the repo root
+SCAN_ROOTS = ("pwasm_tpu", "qa", "tests")
+SCAN_FILES = ("bench.py", "tpu_smoke.py")
+
+
+def _iter_py(root: str):
+    for base in SCAN_ROOTS:
+        top = os.path.join(root, base)
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+    for fn in SCAN_FILES:
+        path = os.path.join(root, fn)
+        if os.path.exists(path):
+            yield path
+
+
+def find_hits(root: str = REPO) -> list[tuple[str, int, str]]:
+    """Every (relpath, lineno, line) matching PATTERNS, comment-only
+    lines skipped."""
+    hits = []
+    for path in _iter_py(root):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                if line.lstrip().startswith("#"):
+                    continue
+                if PATTERNS.search(line):
+                    hits.append((rel, i, line.strip()))
+    return hits
+
+
+def find_unregistered(root: str = REPO) -> list[str]:
+    """Human-readable violation lines; empty = covered."""
+    out = []
+    for rel, lineno, line in find_hits(root):
+        if rel not in REGISTRY:
+            out.append(f"{rel}:{lineno}: rename-publish outside the "
+                       f"durable-write module ({_FSIO}): {line}")
+    return out
+
+
+def stale_registry_entries(root: str = REPO) -> list[str]:
+    """Registry rows whose module no longer has any hit (or vanished)."""
+    live = {rel for rel, _l, _s in find_hits(root)}
+    return [rel for rel in REGISTRY if rel not in live]
+
+
+def impl_self_check(root: str = REPO) -> list[str]:
+    """The registered implementation must actually contain the fsync —
+    a refactor that drops it would otherwise pass the gate."""
+    path = os.path.join(root, _FSIO)
+    try:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    except OSError:
+        return [f"{_FSIO}: missing (the durable-write module itself)"]
+    out = []
+    for needle in ("os.fsync", "os.replace"):
+        if needle not in src:
+            out.append(f"{_FSIO}: no {needle} call — the audited "
+                       "pattern is gone")
+    return out
+
+
+def main() -> int:
+    bad = find_unregistered()
+    stale = stale_registry_entries()
+    broken = impl_self_check()
+    for line in bad + broken:
+        print(line, file=sys.stderr)
+    for rel in stale:
+        print(f"{rel}: stale registry entry (no rename-publish left — "
+              "remove it)", file=sys.stderr)
+    if bad:
+        print(f"\n{len(bad)} rename-publish call(s) outside "
+              "pwasm_tpu/utils/fsio.py.  Route state writes through "
+              "fsio.replace_durable / write_durable_* (fsync tmp, "
+              "replace, fsync dir) or register a justified exemption "
+              "in qa/check_durability.py.", file=sys.stderr)
+    return 1 if (bad or stale or broken) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

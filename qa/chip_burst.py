@@ -8,8 +8,10 @@ by the full bench step exactly as a bare ``python bench.py`` would.
 
 Order: smoke (gate) -> full bench table -> cfg4 column-tile sweep ->
 cfg2 Iy-chain A/B -> cfg7 on chip -> cfg4 profiled launch.  Exit 3 =
-backend down or not a real TPU (nothing ran); exit 0 = burst completed
-(individual steps may still record failures in the JSONL).
+backend down or not a real TPU (nothing ran); exit 130 = interrupted
+(Ctrl-C while blocking on ``--wait`` — the conventional 128+SIGINT
+status, not a traceback); exit 0 = burst completed (individual steps
+may still record failures in the JSONL).
 
 ``--wait[=S]``: instead of exiting 3 on a down tunnel, block (bounded
 by S seconds, default 3600) on the resilience layer's capped-
@@ -129,7 +131,18 @@ def main(argv: list[str] | None = None) -> int:
         print(f"[burst] --wait: probing for a healthy backend "
               f"(budget {wait_s:.0f}s)", file=sys.stderr)
         t0 = time.time()
-        if not wait_for_backend(wait_s):
+        try:
+            healthy = wait_for_backend(wait_s)
+        except KeyboardInterrupt:
+            # an operator's Ctrl-C during the (potentially hour-long)
+            # block is a normal way to end a wait — it gets the
+            # documented interrupted status, not a traceback that
+            # reads like a crash in a cron log
+            print(f"[burst] interrupted after {time.time() - t0:.0f}s "
+                  "waiting for a healthy backend; exiting 130",
+                  file=sys.stderr)
+            return 130
+        if not healthy:
             print(f"[burst] backend still down after "
                   f"{time.time() - t0:.0f}s; giving up", file=sys.stderr)
             return 3

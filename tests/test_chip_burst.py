@@ -60,3 +60,20 @@ def test_parse_wait(chip_burst):
     for bad in (["--wait=x"], ["--wait=-5"], ["--wait=nan"]):
         with pytest.raises(SystemExit):
             chip_burst._parse_wait(bad)
+
+
+def test_wait_interrupt_exits_documented_code(chip_burst, monkeypatch,
+                                              capsys):
+    """Ctrl-C while blocking on --wait must exit with the documented
+    interrupted status (130 = 128+SIGINT), not spill a KeyboardInterrupt
+    traceback into a cron log."""
+    import pwasm_tpu.resilience.health as health
+
+    def interrupted(*a, **k):
+        raise KeyboardInterrupt
+
+    monkeypatch.setattr(health, "wait_for_backend", interrupted)
+    rc = chip_burst.main(["--wait=30"])
+    assert rc == 130
+    err = capsys.readouterr().err
+    assert "interrupted" in err

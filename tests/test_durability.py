@@ -1,0 +1,562 @@
+"""Preemption-grade durability (ISSUE 4).
+
+Acceptance contracts:
+
+- **self-validating checkpoints**: the ``<report>.ckpt`` is versioned,
+  CRC'd, and boundary-checked against the actual report; the
+  corrupted-ckpt matrix (truncated JSON, wrong CRC, bytes past the
+  report, offset mid-record, unversioned legacy) must each quarantine
+  to ``<report>.ckpt.bad`` and restart cleanly — never resume wrong;
+- **kill-at-every-batch-boundary sweep**: wherever an ``InjectedKill``
+  lands, the resumed report is byte-identical to an uninterrupted run;
+- **graceful drain**: a scripted preemption (``preempt=N``, the
+  deterministic twin of SIGTERM) exits with the documented
+  "preempted, resumable" code (75) after flushing a final valid
+  checkpoint + partial ``--stats``; ``--resume`` completes
+  byte-identically; a second signal hard-aborts;
+- **OOM-aware bisection**: an injected device memory ceiling
+  (``oom=N``) finishes ON-DEVICE via recursive batch bisection —
+  ``batch_splits > 0``, ``breaker_trips == 0``, no host degradation,
+  byte parity with the fault-free arm (incl. a 200-alignment
+  realistic corpus);
+- **static gate**: every rename-publish in the tree routes through
+  the audited fsync-then-replace (``qa/check_durability.py``).
+"""
+
+import io
+import json
+import os
+import signal
+import sys
+
+import numpy as np
+import pytest
+
+from pwasm_tpu.cli import (CKPT_VERSION, _ckpt_crc, _load_checkpoint,
+                           run)
+from pwasm_tpu.core.errors import EXIT_PREEMPTED
+from pwasm_tpu.core.fasta import write_fasta
+from pwasm_tpu.resilience import (BatchSupervisor, BisectableBatch,
+                                  InjectedKill, InjectedOOM,
+                                  PreemptedError, ResiliencePolicy,
+                                  SignalDrain, is_oom_error,
+                                  parse_fault_spec)
+from pwasm_tpu.utils.runstats import RunStats
+
+from helpers import make_paf_line
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# fault-spec legs + OOM classifier
+# ---------------------------------------------------------------------------
+def test_fault_spec_preempt_and_oom_legs():
+    plan = parse_fault_spec("preempt=4,oom=128")
+    assert plan.preempt == 4
+    assert plan.oom == 128
+    assert plan.oom_for(129)
+    assert not plan.oom_for(128)
+    assert not plan.oom_for(None)
+    for bad in ("preempt=-1", "oom=-2", "preempt=x", "oom="):
+        with pytest.raises(ValueError):
+            parse_fault_spec(bad)
+
+
+def test_preempt_leg_pulls_the_drain_hook_once():
+    plan = parse_fault_spec("preempt=3")
+    pulled = []
+    plan.on_preempt = pulled.append
+    for _ in range(5):
+        plan.note_call()
+    assert len(pulled) == 1
+    assert "supervised call 3" in pulled[0]
+
+
+def test_is_oom_classifier():
+    assert is_oom_error(RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        "123456 bytes"))
+    assert is_oom_error(RuntimeError("Failed to allocate 8.0G hbm"))
+    assert is_oom_error(InjectedOOM(
+        "injected RESOURCE_EXHAUSTED at ctx_scan"))
+    assert not is_oom_error(RuntimeError("INTERNAL: something else"))
+    assert not is_oom_error(None)
+
+
+# ---------------------------------------------------------------------------
+# supervisor bisection (unit)
+# ---------------------------------------------------------------------------
+def _bisect_supervisor(**policy):
+    st = RunStats()
+    sup = BatchSupervisor(
+        ResiliencePolicy(max_retries=1, backoff_s=0.0,
+                         **policy), stats=st, stderr=io.StringIO(),
+        probe=lambda: (True, ""))
+    return sup, st
+
+
+def test_supervisor_bisects_oom_to_floor_and_demotes():
+    sup, st = _bisect_supervisor()
+    items = list(range(10))
+
+    def attempt_for(sub):
+        if len(sub) > 2:
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: out of memory allocating batch")
+        return list(sub)
+
+    spec = BisectableBatch(
+        items=items, attempt_for=attempt_for,
+        combine=lambda parts: [x for _s, r in parts for x in r])
+    out = sup.run("ctx_scan", lambda: attempt_for(items), bisect=spec)
+    assert out == items               # order preserved through splits
+    assert st.res_oom_events > 0
+    assert st.res_batch_splits > 0
+    assert st.res_bucket_demotions > 0
+    assert sup.bucket_ceiling == 2    # demoted to the working size
+    assert st.res_breaker_trips == 0  # OOM NEVER charges the breaker
+    assert st.res_retries == 0        # and never retries the shape
+    assert not sup.breaker_open
+
+
+def test_supervisor_oom_without_bisect_degrades_without_trip():
+    sup, st = _bisect_supervisor(breaker_threshold=2)
+    calls = []
+
+    def attempt():
+        calls.append(1)
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+    for _ in range(6):   # far past the breaker threshold
+        assert sup.run("consensus", attempt,
+                       fallback=lambda: "host") == "host"
+    assert st.res_oom_events == 6
+    assert st.res_breaker_trips == 0
+    assert not sup.breaker_open
+    assert len(calls) == 6            # one attempt each: no same-shape
+    #                                   retries for an allocation error
+
+
+def test_supervisor_oom_floor_exhaustion_degrades_whole_batch():
+    sup, st = _bisect_supervisor()
+
+    def attempt_for(sub):   # even single items OOM
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+    spec = BisectableBatch(
+        items=[1, 2, 3, 4], attempt_for=attempt_for,
+        combine=lambda parts: [x for _s, r in parts for x in r])
+    out = sup.run("ctx_scan", lambda: attempt_for(spec.items),
+                  bisect=spec, fallback=lambda: "whole-batch-host")
+    assert out == "whole-batch-host"  # the CALLER's fallback ran once,
+    #                                   for the whole batch — halves
+    #                                   never degrade alone
+    assert st.res_breaker_trips == 0
+
+
+def test_injected_oom_leg_fires_by_declared_size():
+    st = RunStats()
+    sup = BatchSupervisor(
+        ResiliencePolicy(max_retries=0, backoff_s=0.0), stats=st,
+        stderr=io.StringIO(), probe=lambda: (True, ""),
+        faults=parse_fault_spec("oom=4"))
+
+    def attempt_for(sub):
+        return list(sub)
+
+    spec = BisectableBatch(
+        items=list(range(6)), attempt_for=attempt_for,
+        combine=lambda parts: [x for _s, r in parts for x in r])
+    out = sup.run("ctx_scan", lambda: attempt_for(spec.items),
+                  bisect=spec)
+    assert out == list(range(6))      # 6 OOMs, 3+3 succeeds
+    assert st.res_injected_faults > 0
+    assert st.res_oom_events == 1
+    assert st.res_batch_splits == 1
+
+
+def test_bucket_ceiling_rides_the_checkpoint_state():
+    sup, _ = _bisect_supervisor()
+    sup.bucket_ceiling = 128
+    st = sup.export_state()
+    assert st["bucket_ceiling"] == 128
+    sup2, _ = _bisect_supervisor()
+    sup2.restore_state(st)
+    assert sup2.bucket_ceiling == 128
+    # absent/None restores to None, and garbage drops only itself
+    sup3, _ = _bisect_supervisor()
+    sup3.restore_state({"bucket_ceiling": None})
+    assert sup3.bucket_ceiling is None
+    sup3.restore_state({"bucket_ceiling": "x"})
+    assert sup3.bucket_ceiling is None
+
+
+# ---------------------------------------------------------------------------
+# graceful drain: the SignalDrain manager
+# ---------------------------------------------------------------------------
+def test_signal_drain_first_flags_second_hard_aborts():
+    err = io.StringIO()
+    exits = []
+    with SignalDrain(stderr=err, hard_exit=exits.append) as drain:
+        assert not drain.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert drain.requested
+        assert "SIGTERM" in drain.reason
+        assert not exits
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert exits == [128 + signal.SIGTERM]
+    # handlers restored on exit
+    assert signal.getsignal(signal.SIGTERM) != drain._on_signal
+    assert "draining" in err.getvalue()
+    assert "hard abort" in err.getvalue()
+
+
+def test_signal_drain_request_is_idempotent():
+    err = io.StringIO()
+    drain = SignalDrain(stderr=err, hard_exit=lambda c: None)
+    drain.request("first")
+    drain.request("second")
+    assert drain.reason == "first"
+
+
+def test_interrupting_phase_aborts_on_request():
+    """Inside the interruptible phase (the end-of-run MSA tail) a
+    drain request raises immediately instead of waiting for a batch
+    boundary the phase will never reach; a request already pending
+    raises on phase entry; outside the phase, requests only flag."""
+    drain = SignalDrain(stderr=io.StringIO(), hard_exit=lambda c: None)
+    with pytest.raises(PreemptedError):
+        with drain.interrupting():
+            drain.request("mid-tail")
+    assert not drain._interrupt       # phase disarmed by the unwind
+    drain.request("after")            # outside: flag only, no raise
+    with pytest.raises(PreemptedError):
+        with drain.interrupting():    # pending request raises on entry
+            raise AssertionError("phase body must not run")
+
+
+# ---------------------------------------------------------------------------
+# CLI end-to-end fixtures (mirrors tests/test_resilience.py)
+# ---------------------------------------------------------------------------
+def _corpus(tmp_path, n=24, qlen=120):
+    rng = np.random.default_rng(3)
+    q = "".join("ACGT"[i] for i in rng.integers(0, 4, qlen))
+    lines = []
+    for i in range(n):
+        cut = 10 + int(rng.integers(0, qlen - 40))
+        qb = q[cut]
+        tb = "ACGT"[("ACGT".index(qb) + 1) % 4]
+        ops = [("=", cut), ("*", tb, qb), ("=", 20), ("ins", "gg"),
+               ("=", qlen - cut - 21)]
+        lines.append(make_paf_line("q", q, f"asm{i}", "+", ops)[0])
+    fa = tmp_path / "q.fa"
+    write_fasta(str(fa), [("q", q.encode())])
+    paf = tmp_path / "in.paf"
+    paf.write_text("".join(ln + "\n" for ln in lines))
+    return str(paf), str(fa)
+
+
+def _cli(tmp_path, tag, extra, paf, fa):
+    err = io.StringIO()
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / f"{tag}.dfa"),
+              "-w", str(tmp_path / f"{tag}.mfa"), "--device=tpu",
+              "--batch=2", f"--stats={tmp_path / f'{tag}.json'}"]
+             + extra, stderr=err)
+    return rc, err.getvalue()
+
+
+def _outs(tmp_path, tag):
+    return ((tmp_path / f"{tag}.dfa").read_bytes(),
+            (tmp_path / f"{tag}.mfa").read_bytes())
+
+
+# ---------------------------------------------------------------------------
+# checkpoint format v2
+# ---------------------------------------------------------------------------
+def test_ckpt_v2_versioned_crc_on_record_boundary(tmp_path,
+                                                  monkeypatch):
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path)
+    with pytest.raises(InjectedKill):
+        _cli(tmp_path, "k", ["--inject-faults=kill=8"], paf, fa)
+    ck = json.loads((tmp_path / "k.dfa.ckpt").read_text())
+    assert ck["version"] == CKPT_VERSION == 2
+    assert ck["crc"] == _ckpt_crc(ck)
+    assert ck["records"] > 0
+    # the recorded offset is a record boundary of the actual report
+    body = (tmp_path / "k.dfa").read_bytes()
+    assert ck["bytes"] <= len(body)
+    assert ck["bytes"] == 0 or body[ck["bytes"] - 1:ck["bytes"]] == b"\n"
+    # and the verifying loader accepts it whole
+    got = _load_checkpoint(str(tmp_path / "k.dfa"))
+    assert isinstance(got, tuple)
+    assert got[0] == ck["bytes"] and got[1] == ck["records"]
+
+
+def _corrupt_ckpt(path: str, report: str, how: str) -> None:
+    """Apply one corruption from the matrix to a VALID ckpt at
+    ``path``."""
+    text = open(path).read()
+    ck = json.loads(text)
+    if how == "truncated":
+        open(path, "w").write(text[:max(1, len(text) // 2)])
+        return
+    if how == "badcrc":
+        ck["records"] += 1          # payload changed, stale crc
+    elif how == "bytes_past_eof":
+        ck["bytes"] = os.path.getsize(report) + 999
+        ck["crc"] = _ckpt_crc(ck)   # crc VALID: only the boundary
+        #                             check can reject it
+    elif how == "mid_record":
+        ck["bytes"] -= 3            # lands inside a record's rows
+        ck["crc"] = _ckpt_crc(ck)
+    elif how == "legacy_v1":
+        ck = {"bytes": ck["bytes"], "records": ck["records"]}
+    else:
+        raise AssertionError(how)
+    open(path, "w").write(json.dumps(ck))
+
+
+@pytest.mark.parametrize("how", ["truncated", "badcrc",
+                                 "bytes_past_eof", "mid_record",
+                                 "legacy_v1"])
+def test_corrupted_ckpt_quarantines_and_restarts(tmp_path, monkeypatch,
+                                                 how):
+    """The matrix: every corrupt/torn/mismatched ckpt must be
+    quarantined to <report>.ckpt.bad and the run RESTARTED cleanly —
+    resumed output byte-identical to an uninterrupted run, never a
+    half-resume onto garbage."""
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path)
+    rc, _ = _cli(tmp_path, "ref", [], paf, fa)
+    assert rc == 0
+    with pytest.raises(InjectedKill):
+        _cli(tmp_path, how, ["--inject-faults=kill=8"], paf, fa)
+    report = str(tmp_path / f"{how}.dfa")
+    ckpt = report + ".ckpt"
+    _corrupt_ckpt(ckpt, report, how)
+    # the verifying loader must already reject it with a diagnostic
+    assert isinstance(_load_checkpoint(report), str)
+    rc, err = _cli(tmp_path, how, ["--resume"], paf, fa)
+    assert rc == 0, err
+    assert "quarantined" in err
+    assert os.path.exists(ckpt + ".bad")
+    assert not os.path.exists(ckpt)   # completed run retires its ckpt
+    assert _outs(tmp_path, how) == _outs(tmp_path, "ref")
+    headers = [ln for ln in open(report) if ln.startswith(">")]
+    assert len(headers) == len(set(headers)) == 24
+
+
+def test_kill_at_every_batch_boundary_resume_parity(tmp_path,
+                                                    monkeypatch):
+    """The sweep: wherever the kill lands on the supervised-attempt
+    clock, the checkpointed prefix + --resume reproduce the
+    uninterrupted run byte-for-byte (no lost, duplicated, or reordered
+    records)."""
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path, n=12)
+    rc, _ = _cli(tmp_path, "ref", [], paf, fa)
+    assert rc == 0
+    killed = 0
+    for k in range(1, 9):
+        tag = f"k{k}"
+        try:
+            rc, err = _cli(tmp_path, tag,
+                           [f"--inject-faults=kill={k}"], paf, fa)
+            assert rc == 0, err   # kill clock ran past the run's
+            #                       supervised attempts: a clean finish
+        except InjectedKill:
+            killed += 1
+            rc, err = _cli(tmp_path, tag, ["--resume"], paf, fa)
+            assert rc == 0, err
+        assert _outs(tmp_path, tag) == _outs(tmp_path, "ref"), k
+        headers = [ln for ln in open(tmp_path / f"{tag}.dfa")
+                   if ln.startswith(">")]
+        assert len(headers) == len(set(headers)) == 12, k
+    assert killed >= 4   # the sweep must actually cover mid-run kills
+
+
+# ---------------------------------------------------------------------------
+# graceful drain: CLI end-to-end (scripted preemption)
+# ---------------------------------------------------------------------------
+def test_preempt_drains_checkpoints_and_resumes_byte_identical(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path)
+    rc, _ = _cli(tmp_path, "ref", [], paf, fa)
+    assert rc == 0
+    rc, err = _cli(tmp_path, "pre", ["--inject-faults=preempt=2"],
+                   paf, fa)
+    assert rc == EXIT_PREEMPTED == 75
+    assert "draining" in err and "preempted" in err
+    # the final checkpoint is whole and CRC-valid
+    got = _load_checkpoint(str(tmp_path / "pre.dfa"))
+    assert isinstance(got, tuple) and got[1] > 0
+    # partial --stats landed, flagged as such
+    st = json.loads((tmp_path / "pre.json").read_text())
+    assert st["preempted"] is True
+    assert 0 < st["alignments"] < 24
+    rc, err = _cli(tmp_path, "pre", ["--resume"], paf, fa)
+    assert rc == 0, err
+    assert _outs(tmp_path, "pre") == _outs(tmp_path, "ref")
+    st = json.loads((tmp_path / "pre.json").read_text())
+    assert st["preempted"] is False
+    assert not os.path.exists(tmp_path / "pre.dfa.ckpt")
+
+
+def test_preempt_during_output_tail_aborts_resumable(tmp_path,
+                                                     monkeypatch):
+    """A drain landing AFTER the last report batch — during the
+    end-of-run MSA/consensus tail — must still exit 75 (the tail runs
+    in the drain's interruptible phase), with the full report already
+    durable; --resume rebuilds the tail outputs whole."""
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path, n=8)
+
+    def cli_cons(tag, extra):
+        err = io.StringIO()
+        rc = run([paf, "-r", fa, "-o", str(tmp_path / f"{tag}.dfa"),
+                  "-w", str(tmp_path / f"{tag}.mfa"),
+                  f"--cons={tmp_path / f'{tag}.cons'}", "--device=tpu",
+                  "--batch=2", f"--stats={tmp_path / f'{tag}.json'}"]
+                 + extra, stderr=err)
+        return rc, err.getvalue()
+
+    def outs3(tag):
+        return tuple((tmp_path / f"{tag}.{k}").read_bytes()
+                     for k in ("dfa", "mfa", "cons"))
+
+    rc, _ = cli_cons("ref", [])
+    assert rc == 0
+    # supervised-call clock: every ctx_scan flush of the clean run,
+    # then the consensus call inside the tail — aim preempt just past
+    # the report flushes so it fires mid-tail (on the consensus call)
+    ref_st = json.loads((tmp_path / "ref.json").read_text())
+    n_report_calls = ref_st["device"]["by_site"]["ctx_scan"]
+    assert ref_st["device"]["by_site"]["consensus"] >= 1
+    rc, err = cli_cons(
+        "tail", [f"--inject-faults=preempt={n_report_calls + 1}"])
+    assert rc == EXIT_PREEMPTED, err
+    # the report itself is COMPLETE (all batches checkpointed before
+    # the tail began) — only the MSA/consensus outputs were aborted
+    got = _load_checkpoint(str(tmp_path / "tail.dfa"))
+    assert isinstance(got, tuple) and got[1] == 8
+    rc, err = cli_cons("tail", ["--resume"])
+    assert rc == 0, err
+    assert outs3("tail") == outs3("ref")
+
+
+def test_preempt_without_report_still_exits_resumable(tmp_path,
+                                                      monkeypatch):
+    """No -o report (stdout mode): nothing to checkpoint, but the drain
+    contract (exit 75, no crash) holds."""
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path, n=8)
+    err = io.StringIO()
+    out = io.StringIO()
+    rc = run([paf, "-r", fa, "--device=tpu", "--batch=2",
+              "--inject-faults=preempt=1"], stdout=out, stderr=err)
+    assert rc == EXIT_PREEMPTED
+    assert "nothing checkpointed" in err.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# OOM bisection: CLI end-to-end
+# ---------------------------------------------------------------------------
+def test_oom_injected_run_bisects_on_device_byte_identical(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path)
+    rc, _ = _cli(tmp_path, "ref", [], paf, fa)
+    assert rc == 0
+    rc, err = _cli(tmp_path, "oom", ["--inject-faults=oom=2"],
+                   paf, fa)
+    assert rc == 0, err
+    assert _outs(tmp_path, "oom") == _outs(tmp_path, "ref")
+    st = json.loads((tmp_path / "oom.json").read_text())
+    res = st["resilience"]
+    assert res["oom_events"] > 0
+    assert res["batch_splits"] > 0
+    assert res["breaker_trips"] == 0
+    assert st["fallback_batches"] == 0   # finished ON-DEVICE
+
+
+@pytest.mark.parametrize("n_aln", [200])
+def test_oom_bisection_realistic_scale_byte_parity(tmp_path,
+                                                   monkeypatch, n_aln):
+    """The ISSUE 4 OOM acceptance gate at realistic scale: a simulated
+    device memory ceiling (oom=192 items — every realistic flush is
+    bigger) on the 200-alignment Nanopore-like corpus must finish
+    ON-DEVICE via bisection + bucket demotion, byte-identical to the
+    fault-free arm, with the breaker untouched."""
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    from test_realistic_scale import make_corpus
+    qseq, lines = make_corpus(n_aln=n_aln)
+    fa = tmp_path / "cds.fa"
+    fa.write_text(f">cds1\n{qseq}\n")
+    paf = tmp_path / "in.paf"
+    paf.write_text("".join(l + "\n" for l in lines))
+    outs = {}
+    stats = {}
+    for tag, extra in (("clean", []),
+                       ("oom", ["--inject-faults=oom=192"])):
+        rep = tmp_path / f"{tag}.dfa"
+        summ = tmp_path / f"{tag}.sum"
+        mfa = tmp_path / f"{tag}.mfa"
+        cons = tmp_path / f"{tag}.cons"
+        stj = tmp_path / f"{tag}.stats"
+        err = io.StringIO()
+        rc = run([str(paf), "-r", str(fa), "-o", str(rep), "-s",
+                  str(summ), "-w", str(mfa), f"--cons={cons}",
+                  "--device=tpu", "--batch=16", f"--stats={stj}"]
+                 + extra, stderr=err)
+        assert rc == 0, err.getvalue()[:2000]
+        outs[tag] = (rep.read_bytes(), summ.read_bytes(),
+                     mfa.read_bytes(), cons.read_bytes())
+        stats[tag] = json.loads(stj.read_text())
+    assert outs["clean"] == outs["oom"]
+    st = stats["oom"]
+    res = st["resilience"]
+    assert res["oom_events"] > 0, res
+    assert res["batch_splits"] > 0, res
+    assert res["bucket_demotions"] > 0, res
+    assert res["breaker_trips"] == 0, res
+    assert st["fallback_batches"] == 0, st
+    clean = stats["clean"]["resilience"]
+    assert clean["oom_events"] == clean["batch_splits"] == 0
+
+
+# ---------------------------------------------------------------------------
+# static gate: every rename-publish uses the audited pattern
+# ---------------------------------------------------------------------------
+def _check_durability_mod():
+    qa = os.path.join(REPO, "qa")
+    if qa not in sys.path:
+        sys.path.insert(0, qa)
+    import check_durability
+    return check_durability
+
+
+def test_every_state_writer_uses_fsync_then_replace():
+    cd = _check_durability_mod()
+    assert cd.find_unregistered() == []
+    assert cd.stale_registry_entries() == []
+    assert cd.impl_self_check() == []
+
+
+def test_durability_gate_catches_a_naked_replace(tmp_path):
+    """The gate actually bites: a module with a bare os.replace outside
+    the registry is reported."""
+    cd = _check_durability_mod()
+    pkg = tmp_path / "pwasm_tpu"
+    pkg.mkdir()
+    (pkg / "rogue.py").write_text(
+        "import os\n\ndef save(tmp, dest):\n"
+        "    os." + "replace(tmp, dest)\n")  # split so the gate's
+    # scan of THIS test file does not match the fixture string
+    (tmp_path / "qa").mkdir()
+    (tmp_path / "tests").mkdir()
+    bad = cd.find_unregistered(str(tmp_path))
+    assert len(bad) == 1
+    assert "rogue.py" in bad[0]
