@@ -935,6 +935,11 @@ def cfg8_realistic_scale() -> int:
       via batch bisection — splits > 0, demotions > 0, NO breaker
       trip, NO host fallback, byte parity (``realistic_oom_bisect``) —
       the ISSUE 4 acceptance contract;
+    - serve: 3 jobs through ONE warm `serve` daemon vs 3 cold runs —
+      byte parity for every job, jobs 2..3 pay zero backend probes
+      (warm-hit counters > 0), daemon drains to exit 75
+      (``realistic_serve_warm_jobs`` — the ISSUE 5 acceptance
+      contract);
     - host engines: a 1k-alignment report+summary corpus A/Bs the
       vectorized columnar host engine against the scalar ground-truth
       engine (PWASM_HOST_COLUMNAR=0) — ``realistic_host_report_1k_s``
@@ -1153,6 +1158,58 @@ def cfg8_realistic_scale() -> int:
                   and oom_js["fallback_batches"] == 0)
         _emit("realistic_oom_bisect", oom_res["batch_splits"],
               "splits", 1.0 if oom_ok else 0.0, cpu_metric=True)
+
+        # --- warm-pool serve (ISSUE 5 tentpole): the SAME corpus as 3
+        # consecutive jobs through ONE `serve` daemon must stay
+        # byte-identical to the cold runs, AND jobs 2..3 must pay ZERO
+        # additional backend probes (the per-job --stats "backend"
+        # block: probes == 0, warm_hits > 0 — the warm-pool promise,
+        # gated).  The daemon then drains on the protocol command and
+        # exits 75 like a SIGTERM would.
+        from pwasm_tpu.service.client import (ServiceClient,
+                                              wait_for_socket)
+        svc_sock = os.path.join(d, "svc.sock")
+        sp = subprocess.Popen(
+            cmd + ["serve", f"--socket={svc_sock}", "--max-queue=8"],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE)
+        serve_rc = None
+        warm_ok = True
+        try:
+            if not wait_for_socket(svc_sock, 120):
+                return _fail("realistic_serve_up")
+            for j in (1, 2, 3):
+                stats_j = os.path.join(d, f"srv{j}.stats")
+                with ServiceClient(svc_sock) as c:
+                    sub = c.submit(args(
+                        f"srv{j}", ["--device=tpu",
+                                    f"--stats={stats_j}"]))
+                    if not sub.get("ok"):
+                        return _fail("realistic_serve_submit")
+                    res = c.result(sub["job_id"], timeout=600)
+                if not res.get("ok") or res.get("rc") != 0:
+                    sys.stderr.write(str(res)[:1000])
+                    return _fail("realistic_serve_job")
+                if readset(f"srv{j}") != parity_body:
+                    return _fail("realistic_serve_parity")
+                with open(stats_j) as f:
+                    bk = json.load(f).get("backend", {})
+                if j > 1 and not (bk.get("probes", 1) == 0
+                                  and bk.get("warm_hits", 0) > 0):
+                    warm_ok = False
+            with ServiceClient(svc_sock) as c:
+                c.drain()
+            serve_rc = sp.wait(timeout=120)
+        except Exception as e:
+            sys.stderr.write(f"serve leg: {e}\n")
+            return _fail("realistic_serve")
+        finally:
+            if sp.poll() is None:
+                sp.kill()
+                sp.wait()
+        serve_ok = warm_ok and serve_rc == 75
+        _emit("realistic_serve_warm_jobs", 3, "jobs",
+              1.0 if serve_ok else 0.0, cpu_metric=True)
 
         # --- host engine A/B: 1k-alignment report+summary corpus ----
         qseq1k, lines1k = make_corpus(n_aln=1000)

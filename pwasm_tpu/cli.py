@@ -91,12 +91,25 @@ USAGE = """Usage:
                of N chips (default: all visible): the analysis batch
                spreads over the mesh and consensus pileup counts are
                psum-reduced over the depth axis before the vote
+
+ Warm-pool service (docs/SERVICE.md): a resident daemon that keeps the
+ process warm (one backend probe, one compile cache, one breaker +
+ health monitor) and multiplexes report jobs over a unix socket:
+   pwasm-tpu serve --socket=PATH [--max-queue=N] [--max-concurrent=N]
+   pwasm-tpu submit --socket=PATH [--no-wait] [--] <cli args...>
+   pwasm-tpu svc-stats --socket=PATH [--drain]
 """
 
 # reference optstring: "DGFCNvd:p:r:o:m:w:c:s:" — -d/-p/-m take a value but
 # are never read (quirk SURVEY.md §2.5.2)
 _BOOL_FLAGS = set("DGFCNvh")
 _VALUE_FLAGS = set("dprmowcs")
+
+# warm-pool service subcommands (pwasm_tpu/service/, docs/SERVICE.md):
+# `pwasm-tpu serve` starts the resident daemon, `submit`/`svc-stats`
+# are the client side — dispatched on the FIRST argv token so the
+# classic flag grammar stays untouched for plain runs
+_SERVICE_CMDS = ("serve", "submit", "svc-stats")
 
 
 class CliError(PwasmError):
@@ -337,9 +350,24 @@ def _unlink_checkpoint(report_path: str) -> None:
         pass
 
 
-def run(argv: list[str], stdout=None, stderr=None) -> int:
+def run(argv: list[str], stdout=None, stderr=None, warm=None) -> int:
+    """One CLI invocation.  ``warm`` is the warm-pool service hook
+    (``service.daemon.WarmContext`` shape): a resident serve process
+    passes one per job so consecutive jobs share the drain flag, the
+    backend health monitor, and the supervisor's breaker/ceiling state
+    — a cold run (warm=None) behaves exactly as before."""
     stdout = stdout or sys.stdout
     stderr = stderr or sys.stderr
+    if argv and argv[0] in _SERVICE_CMDS:
+        try:
+            if argv[0] == "serve":
+                from pwasm_tpu.service.daemon import serve_main
+                return serve_main(argv[1:], stdout, stderr)
+            from pwasm_tpu.service.client import client_main
+            return client_main(argv[0], argv[1:], stdout, stderr)
+        except PwasmError as e:
+            stderr.write(str(e))
+            return e.exit_code
     opts, positional = _parse_args(argv)
     if opts.get("h"):
         stderr.write(USAGE + "\n")
@@ -639,13 +667,18 @@ def run(argv: list[str], stdout=None, stderr=None) -> int:
         # SIGTERM/SIGINT only raises a flag the batch loop honors at
         # the next batch boundary — in-flight work completes, a final
         # checkpoint + partial --stats land, and the exit code says
-        # "preempted, resumable" (75); a second signal hard-aborts
-        with device_trace(cfg.profile_dir, stderr), \
-                SignalDrain(stderr=stderr) as drain:
+        # "preempted, resumable" (75); a second signal hard-aborts.
+        # A warm serve process supplies the drain itself (per job, its
+        # signal surface is the DAEMON's handler fanning out to these
+        # flags — install() is a no-op off the main thread anyway).
+        drain_cm = warm.drain if warm is not None \
+            and warm.drain is not None else SignalDrain(stderr=stderr)
+        with device_trace(cfg.profile_dir, stderr), drain_cm as drain:
             return _main_loop(cfg, inf, freport, fmsa, fsummary, summary,
                               qfasta, stdout, stderr, cons_outs,
                               resume_skip=resume_skip,
-                              resume_state=resume_state, drain=drain)
+                              resume_state=resume_state, drain=drain,
+                              warm=warm)
     except PwasmError as e:
         stderr.write(str(e))
         return e.exit_code
@@ -753,7 +786,8 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                qfasta: FastaFile, stdout, stderr,
                cons_outs: dict | None = None,
                resume_skip: int = 0,
-               resume_state: dict | None = None, drain=None) -> int:
+               resume_state: dict | None = None, drain=None,
+               warm=None) -> int:
     """The per-PAF-line loop (pafreport.cpp:296-460)."""
     from pwasm_tpu.align.gapseq import FLAG_IS_REF, GapSeq
     from pwasm_tpu.align.msa import Msa
@@ -784,19 +818,38 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
     monitor = None
     if cfg.recover == "auto":
         from pwasm_tpu.resilience.health import BackendHealthMonitor
-        monitor = BackendHealthMonitor(
-            interval_s=cfg.reprobe_interval,
-            max_interval_s=cfg.reprobe_max, stats=stats, stderr=stderr)
+        if warm is not None and warm.monitor is not None:
+            # the warm serve process owns ONE monitor for its whole
+            # life: job N+1 inherits job N's probe schedule and
+            # open/half-open/closed state, re-bound to this job's
+            # stats sink (the first job's --reprobe-* knobs win)
+            monitor = warm.monitor.attach(stats=stats, stderr=stderr)
+        else:
+            monitor = BackendHealthMonitor(
+                interval_s=cfg.reprobe_interval,
+                max_interval_s=cfg.reprobe_max, stats=stats,
+                stderr=stderr)
+            if warm is not None:
+                warm.monitor = monitor
     supervisor = BatchSupervisor(
         ResiliencePolicy(max_retries=cfg.max_retries,
                          deadline_s=cfg.device_deadline or None,
                          fallback=cfg.fallback),
         stats=stats, stderr=stderr, faults=fault_plan, monitor=monitor)
+    if warm is not None and warm.supervisor_state:
+        # a warm serve process: inherit the previous job's breaker /
+        # site-trip / bucket-ceiling end state — a flap that opened
+        # the breaker in job N must not be re-discovered (and re-paid)
+        # by job N+1, and a reclose re-promotes every subsequent job
+        supervisor.restore_state(warm.supervisor_state)
     if resume_state is not None:
         # a --resume inherits the killed run's breaker/monitor/fault
         # state: a run killed mid-outage must not re-trip (or worse,
         # re-attempt a dead backend), and a scripted down= window
-        # continues at the supervised call it stopped at
+        # continues at the supervised call it stopped at.  Restored
+        # AFTER any warm-service state on purpose: the job's own ckpt
+        # is the more specific fact (it carries the fault clock a
+        # scripted window needs; warm state never does)
         supervisor.restore_state(resume_state)
 
     alnpairs: dict[str, int] = {}   # gene-mode (query~target) dedup counts
@@ -817,8 +870,19 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         # unreachable tunnel must cost seconds and a loud CPU demotion,
         # not an indefinite hang at backend init (SURVEY.md §5 failure
         # detection; PWASM_DEVICE_PROBE=0 skips)
+        from pwasm_tpu.utils import backend as _backend
         from pwasm_tpu.utils.backend import device_backend_reachable
+        # per-run probe accounting (the warm-pool reuse gate): diff the
+        # process-wide counters around the gate so the job's --stats
+        # says whether it PAID a subprocess probe or answered from the
+        # warm process state (backend.probes / backend.warm_hits)
+        _p0 = _backend.probe_counters["probes"]
+        _w0 = _backend.probe_counters["warm_hits"]
         ok, why = device_backend_reachable()
+        stats.backend_probes += \
+            _backend.probe_counters["probes"] - _p0
+        stats.backend_warm_hits += \
+            _backend.probe_counters["warm_hits"] - _w0
         if not ok:
             print(f"Warning: jax backend unreachable ({why.strip()}); "
                   "running with --device=cpu", file=stderr)
@@ -1283,6 +1347,15 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         _unlink_checkpoint(report_path)
     supervisor.finalize_stats()   # a run ENDING degraded still owes
     #                               its open window to degraded_wall_s
+    if warm is not None:
+        # hand the end-state breaker/ceiling snapshot to the warm
+        # process for the NEXT job.  The fault clock is stripped:
+        # scripted fault windows (--inject-faults) are a per-job
+        # debug contract — one job's clock must never advance (or
+        # disarm) another job's scripted windows.
+        warm.supervisor_state = {
+            k: v for k, v in supervisor.export_state().items()
+            if k != "fault_calls"}
     stats.preempted = preempted
     if cfg.stats_path:
         try:

@@ -108,7 +108,11 @@ class InjectedKill(BaseException):
     """Simulated process kill (``kill=K``).  Derives from BaseException
     so no retry/fallback layer can swallow it — it unwinds the whole
     run exactly like SIGKILL would end it, leaving only what the
-    batch checkpoints made durable."""
+    batch checkpoints made durable.  Inside a warm ``serve`` process
+    the blast radius is the JOB, not the daemon: the worker catches it
+    at the job boundary and marks the job failed (its checkpointed
+    prefix stays resumable), because a scripted kill must never take
+    out the other tenants of a shared process."""
 
 
 @dataclass

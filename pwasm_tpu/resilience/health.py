@@ -86,6 +86,22 @@ class BackendHealthMonitor:
         print(f"pwasm: {msg}", file=self.stderr)
 
     # ---- lifecycle -----------------------------------------------------
+    def attach(self, stats=None, stderr=None) -> "BackendHealthMonitor":
+        """Re-bind the per-run sinks and return self.  A warm serve
+        process shares ONE monitor (one probe schedule, one
+        open/half-open/closed state) across consecutive jobs, but each
+        job owns its RunStats and stderr — the daemon re-attaches them
+        at job start so reprobe/reclose counters land on the job that
+        observed them.  The probe callable is also dropped: each job's
+        supervisor re-wires its own (fault-plan-aware) probe, and a
+        stale one would consult a finished job's fault plan."""
+        if stats is not None:
+            self.stats = stats
+        if stderr is not None:
+            self.stderr = stderr
+        self.probe = None
+        return self
+
     def note_open(self) -> None:
         """The global breaker just opened (or was restored open from a
         checkpoint): arm the re-probe schedule from its base interval."""

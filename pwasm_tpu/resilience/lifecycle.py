@@ -32,6 +32,7 @@ from __future__ import annotations
 import os
 import signal
 import sys
+import threading
 
 from pwasm_tpu.core.errors import EXIT_PREEMPTED
 
@@ -66,6 +67,15 @@ class SignalDrain:
         self._prev: dict = {}
         self._interrupt = False   # inside an interruptible phase:
         #                           request() raises PreemptedError
+        self._interrupt_tid: int | None = None  # the thread that ARMED
+        #   the phase: only a request() made on that same thread may
+        #   raise into it.  In the one-shot CLI both are the main
+        #   thread (signal handlers run there), so behavior is
+        #   unchanged; in a serve daemon, the daemon thread requesting
+        #   a worker-thread job's drain must only set the flag — an
+        #   exception raised in the DAEMON thread would kill the
+        #   service, not the job (the job still honors the flag at its
+        #   next batch boundary)
 
     # ---- state ---------------------------------------------------------
     @property
@@ -86,7 +96,8 @@ class SignalDrain:
                       "in-flight batch, flushing a final checkpoint, "
                       f"then exiting resumable (exit {EXIT_PREEMPTED})"
                       "; a second signal hard-aborts")
-        if self._interrupt:
+        if self._interrupt \
+                and threading.get_ident() == self._interrupt_tid:
             raise PreemptedError(self.reason)
 
     def _say(self, msg: str) -> None:
@@ -160,6 +171,7 @@ class _Interrupting:
 
     def __enter__(self):
         self._drain._interrupt = True
+        self._drain._interrupt_tid = threading.get_ident()
         if self._drain.requested:
             # the drain landed between the batch loop's last check and
             # this phase starting: honor it before any tail work
@@ -168,3 +180,4 @@ class _Interrupting:
 
     def __exit__(self, *exc) -> None:
         self._drain._interrupt = False
+        self._drain._interrupt_tid = None

@@ -146,6 +146,13 @@ class ResiliencePolicy:
     site_trip_limit: int = 3      # healthy-probe half-opens before a
     #                               site's OWN breaker trips (the
     #                               persistently-failing-program case)
+    repromote_after: int = 8      # consecutive clean sized flushes at
+    #                               a demoted bucket_ceiling before it
+    #                               probation-raises one pow2 step —
+    #                               so a long run (or a long-lived
+    #                               serve process) that OOMed once
+    #                               does not stay chunked forever.
+    #                               0 disables re-promotion.
 
     def threshold_for(self, site: str) -> int:
         if self.site_thresholds:
@@ -195,6 +202,17 @@ class BatchSupervisor:
         #          it for the rest of the run (and it persists in the
         #          <report>.ckpt), so one RESOURCE_EXHAUSTED costs one
         #          bisection, not one per future flush
+        self._ceiling_clean = 0                 # consecutive clean
+        #          sized flushes since the last OOM/re-promotion —
+        #          the probation counter behind repromote_after
+        self._ceiling_origin: int | None = None  # the largest pow2
+        #          bucket an OOM demoted FROM: re-promotion that
+        #          climbs back to it RESTORES the ceiling to None
+        #          (undemoted) instead of doubling past what ever
+        #          failed — the up-transition terminates
+        self._in_bisect = 0                     # bisection recursion
+        #          depth: halves run right after an OOM and must not
+        #          count toward the ceiling's probation
         # jitter exists to de-synchronize retry storms across the many
         # processes of a batch fleet, so it must be seeded per process
         # (a fixed seed would make every process retry at the same
@@ -276,6 +294,7 @@ class BatchSupervisor:
                 if validate is not None:
                     validate(result)
                 self._consecutive[site] = 0
+                self._note_clean_flush(site, size)
                 if self.recloses:
                     # a successful device batch after a reclose IS the
                     # recovery the monitor promised — gate on this
@@ -310,6 +329,8 @@ class BatchSupervisor:
         and the host fallback is reached only when no smaller split can
         succeed."""
         self._count("res_oom_events")
+        self._ceiling_clean = 0   # an OOM restarts the ceiling's
+        #                           re-promotion probation from zero
         if bisect is not None and len(bisect.items) > max(1, bisect.floor):
             self._demote_bucket(site, len(bisect.items))
             try:
@@ -338,27 +359,31 @@ class BatchSupervisor:
         self._warn(f"{site}: bisecting {len(items)}-item batch into "
                    f"{mid}+{len(items) - mid} after device OOM")
         parts = []
-        for sub in (items[:mid], items[mid:]):
-            if not sub:
-                continue
-            sub_spec = replace(spec, items=sub)
-            validate = None
-            if spec.validate_for is not None:
-                validate = (lambda r, _s=sub:
-                            spec.validate_for(r, _s))
-            r = self.run(
-                site,
-                (lambda _s=sub_spec: _s.attempt_for(_s.items)),
-                validate=validate,
-                fallback=None,   # a failed half raises
-                #  DeviceWorkFailed and the TOP-level _handle_oom /
-                #  caller owns the whole-batch degradation — a half
-                #  must never fall back alone (order would survive,
-                #  but the caller's fallback replays the full batch)
-                bisect=sub_spec if len(sub) > max(1, spec.floor)
-                else None,
-                size=len(sub))
-            parts.append((sub, r))
+        self._in_bisect += 1
+        try:
+            for sub in (items[:mid], items[mid:]):
+                if not sub:
+                    continue
+                sub_spec = replace(spec, items=sub)
+                validate = None
+                if spec.validate_for is not None:
+                    validate = (lambda r, _s=sub:
+                                spec.validate_for(r, _s))
+                r = self.run(
+                    site,
+                    (lambda _s=sub_spec: _s.attempt_for(_s.items)),
+                    validate=validate,
+                    fallback=None,   # a failed half raises
+                    #  DeviceWorkFailed and the TOP-level _handle_oom /
+                    #  caller owns the whole-batch degradation — a half
+                    #  must never fall back alone (order would survive,
+                    #  but the caller's fallback replays the full batch)
+                    bisect=sub_spec if len(sub) > max(1, spec.floor)
+                    else None,
+                    size=len(sub))
+                parts.append((sub, r))
+        finally:
+            self._in_bisect -= 1
         return spec.combine(parts)
 
     def _demote_bucket(self, site: str, failed_size: int) -> None:
@@ -367,6 +392,11 @@ class BatchSupervisor:
         half the bucket that failed; only an actual lowering counts
         (recursive bisection demotes step by step, once per level)."""
         bucket = 1 << max(0, int(failed_size) - 1).bit_length()
+        if self._ceiling_origin is None or bucket > self._ceiling_origin:
+            # remember the largest bucket that ever failed: it is the
+            # re-promotion's restore point (climbing back to it means
+            # the demotion is fully probed away)
+            self._ceiling_origin = bucket
         new = max(1, bucket // 2)
         if self.bucket_ceiling is None or new < self.bucket_ceiling:
             self.bucket_ceiling = new
@@ -374,6 +404,49 @@ class BatchSupervisor:
             self._warn(f"{site}: batch bucket ceiling demoted to "
                        f"{new} items for the rest of the run "
                        f"(device OOM at {failed_size})")
+
+    def _note_clean_flush(self, site: str, size: int | None) -> None:
+        """One SIZED supervised attempt succeeded while the bucket
+        ceiling is demoted: advance the re-promotion probation.  After
+        ``policy.repromote_after`` consecutive clean flushes the
+        ceiling probation-raises ONE pow2 step — the up-transition of
+        the OOM demotion, so a long run (or a long-lived serve
+        process) that hit one memory ceiling does not pre-chunk every
+        flush forever.  Guards keeping this bounded and honest:
+        bisection halves are excluded (they succeed right after the
+        OOM that demoted the ceiling); only flushes that actually FILL
+        the current bucket count (``size * 2 > ceiling`` — a tiny
+        flush under a big ceiling proves nothing about memory at the
+        ceiling); climbing back to the bucket that originally OOMed
+        RESTORES the ceiling to None rather than doubling forever; and
+        any new OOM resets the probation AND re-demotes, so a
+        genuinely tight ceiling just oscillates one probe per
+        ``repromote_after`` flushes instead of thrashing."""
+        if (self.bucket_ceiling is None or size is None
+                or self._in_bisect or self.policy.repromote_after <= 0
+                or size * 2 <= self.bucket_ceiling):
+            return
+        self._ceiling_clean += 1
+        if self._ceiling_clean < self.policy.repromote_after:
+            return
+        old = self.bucket_ceiling
+        new = old * 2
+        self._ceiling_clean = 0
+        self._count("res_bucket_repromotions")
+        if self._ceiling_origin is not None \
+                and new >= self._ceiling_origin:
+            # fully probed back to the bucket that failed: the
+            # demotion is retired, flushes stop pre-chunking entirely
+            self.bucket_ceiling = None
+            self._warn(f"{site}: batch bucket ceiling RESTORED "
+                       f"(probation passed back to the {old}-item "
+                       "bucket; an OOM re-demotes it)")
+            return
+        self.bucket_ceiling = new
+        self._warn(f"{site}: batch bucket ceiling probation-raised "
+                   f"{old} -> {new} items after "
+                   f"{self.policy.repromote_after} consecutive clean "
+                   "flushes (an OOM re-demotes it)")
 
     def _attempt_once(self, site: str, attempt, size: int | None = None):
         plan = self.faults
@@ -553,6 +626,8 @@ class BatchSupervisor:
             "consecutive": {k: v for k, v in self._consecutive.items()
                             if v},
             "bucket_ceiling": self.bucket_ceiling,
+            "bucket_clean_flushes": self._ceiling_clean,
+            "bucket_demoted_from": self._ceiling_origin,
         }
         if self.faults is not None:
             st["fault_calls"] = self.faults._calls
@@ -593,6 +668,16 @@ class BatchSupervisor:
             field(lambda: setattr(
                 self, "bucket_ceiling",
                 max(1, int(st["bucket_ceiling"]))))
+        # the re-promotion probation rides along: a --resume (or the
+        # next warm-service job) continues the clean-flush count and
+        # keeps the restore point instead of restarting the probation
+        field(lambda: setattr(
+            self, "_ceiling_clean",
+            max(0, int(st.get("bucket_clean_flushes", 0) or 0))))
+        if st.get("bucket_demoted_from") is not None:
+            field(lambda: setattr(
+                self, "_ceiling_origin",
+                max(1, int(st["bucket_demoted_from"]))))
         if self.faults is not None and "fault_calls" in st:
             field(lambda: setattr(
                 self.faults, "_calls", int(st["fault_calls"])))

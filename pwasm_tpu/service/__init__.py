@@ -1,0 +1,34 @@
+"""Warm-pool job service: the resident serving layer (ISSUE 5).
+
+Every CLI invocation is a cold one-shot: it re-pays the interpreter
+imports, the bounded backend probe, and the XLA compiles before the
+first alignment is touched — the exact cost profile the dispatch-lean
+pipeline and the persistent compile cache were built to amortize, but
+which nothing amortizes *across* runs.  This package adds the missing
+layer between "fast single run" and "serving": one resident daemon
+(``pwasm-tpu serve`` == ``python -m pwasm_tpu.cli serve``) that keeps
+the process warm and multiplexes report jobs over a unix socket:
+
+- ``protocol``  the newline-delimited-JSON frame format and the error
+                vocabulary (``queue_full``, ``draining``, ...);
+- ``queue``     the bounded FIFO job queue with admission control and
+                the service-level counters;
+- ``daemon``    the server: accept loop, worker pool, the shared
+                :class:`~pwasm_tpu.service.daemon.WarmContext` every
+                job's ``cli.run`` threads through (one backend probe,
+                one jit cache, one health monitor + global breaker,
+                one drain), and the SIGTERM drain that finishes
+                in-flight jobs at batch boundaries and exits 75;
+- ``client``    the client side (``pwasm-tpu submit`` /
+                ``pwasm-tpu svc-stats``) and the
+                :class:`~pwasm_tpu.service.client.ServiceClient`
+                library the bench and tests drive.
+
+Jobs execute through the EXISTING ``cli.run`` path, so outputs stay
+byte-identical to a cold CLI run — the serve process changes wall
+time and counters, never bytes.  See ``docs/SERVICE.md``.
+"""
+
+from pwasm_tpu.service.queue import (  # noqa: F401
+    JOB_CANCELLED, JOB_DONE, JOB_FAILED, JOB_PREEMPTED, JOB_QUEUED,
+    JOB_RUNNING, Draining, Job, JobQueue, QueueFull, ServiceStats)

@@ -1,0 +1,261 @@
+"""Client side of the warm-pool service (``pwasm-tpu submit`` /
+``pwasm-tpu svc-stats``) and the :class:`ServiceClient` library the
+bench, QA drills and tests drive.
+
+A client is one unix-socket connection speaking the newline-delimited
+JSON protocol (``service.protocol``).  ``submit`` is the cold-CLI
+drop-in: the job argv after ``--`` (or after the client flags) is
+exactly what a cold ``python -m pwasm_tpu.cli`` invocation would take,
+and the client's exit code is the job's exit code — so a fleet wrapper
+can switch between cold runs and warm submissions by prefixing
+``submit --socket=PATH --`` and nothing else changes.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import sys
+import time
+
+from pwasm_tpu.core.errors import EXIT_FATAL, EXIT_USAGE
+from pwasm_tpu.service import protocol
+
+_CLIENT_USAGE = """Usage:
+ pwasm-tpu submit --socket=PATH [--no-wait] [--timeout=S]
+                  [--] <cli args...>
+     submit one report job (the argv a cold CLI run would take; -o is
+     required — the socket carries control, not report bytes).  By
+     default waits for the job and exits with the JOB's exit code
+     (0 done, 75 preempted/cancelled-resumable, else failed); with
+     --no-wait prints the job id and exits 0.  A full queue
+     (queue_full) exits 11 so wrappers can back off and retry.
+
+ pwasm-tpu svc-stats --socket=PATH [--drain]
+     print the service-level stats JSON (versioned schema); with
+     --drain, ask the daemon to drain gracefully first (running jobs
+     finish at batch boundaries, queued jobs report resumable, daemon
+     exits 75).
+"""
+
+# distinct from every CLI exit code (1/3/5/75): "the service queue is
+# full, back off and retry" — the shell-visible twin of HTTP 429
+EXIT_QUEUE_FULL = 11
+
+
+class ServiceError(Exception):
+    """A protocol-level failure talking to the daemon."""
+
+
+class ServiceClient:
+    """One connection to a serve daemon.  Context-manager; every
+    command is one request/response frame pair on this connection."""
+
+    def __init__(self, socket_path: str, timeout: float | None = None,
+                 max_frame_bytes: int = protocol.MAX_FRAME_BYTES):
+        self.socket_path = socket_path
+        self.max_frame_bytes = max_frame_bytes
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        if timeout is not None:
+            self._sock.settimeout(timeout)
+        try:
+            self._sock.connect(socket_path)
+        except OSError as e:
+            self._sock.close()
+            raise ServiceError(
+                f"cannot connect to service socket {socket_path}: "
+                f"{e}") from e
+        self._rfile = self._sock.makefile("rb")
+        self._wfile = self._sock.makefile("wb")
+
+    # ---- plumbing ------------------------------------------------------
+    def request(self, obj: dict) -> dict:
+        try:
+            protocol.write_frame(self._wfile, obj)
+            resp = protocol.read_frame(self._rfile,
+                                       self.max_frame_bytes)
+        except (OSError, protocol.FrameError) as e:
+            raise ServiceError(f"service connection failed: {e}") \
+                from e
+        if resp is None:
+            raise ServiceError(
+                "service closed the connection mid-request")
+        return resp
+
+    def close(self) -> None:
+        for f in (self._rfile, self._wfile):
+            try:
+                f.close()
+            except OSError:
+                pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "ServiceClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---- commands ------------------------------------------------------
+    def ping(self) -> dict:
+        return self.request({"cmd": "ping"})
+
+    def submit(self, argv: list[str], cwd: str | None = None) -> dict:
+        """Submit one job.  ``cwd`` (default: this process's cwd) is
+        sent along so relative paths in the argv resolve against the
+        CLIENT's directory, not the daemon's — what a cold run would
+        do."""
+        import os
+        return self.request({"cmd": "submit", "args": list(argv),
+                             "cwd": cwd if cwd is not None
+                             else os.getcwd()})
+
+    def status(self, job_id: str) -> dict:
+        return self.request({"cmd": "status", "job_id": job_id})
+
+    def result(self, job_id: str, wait: bool = True,
+               timeout: float | None = None) -> dict:
+        req: dict = {"cmd": "result", "job_id": job_id, "wait": wait}
+        if timeout is not None:
+            req["timeout"] = timeout
+        return self.request(req)
+
+    def cancel(self, job_id: str) -> dict:
+        return self.request({"cmd": "cancel", "job_id": job_id})
+
+    def stats(self) -> dict:
+        return self.request({"cmd": "stats"})
+
+    def drain(self) -> dict:
+        return self.request({"cmd": "drain"})
+
+
+def wait_for_socket(path: str, budget_s: float = 30.0) -> bool:
+    """Block (bounded) until a daemon answers on ``path`` — the
+    "did the serve process come up" primitive for the bench and the
+    subprocess tests."""
+    deadline = time.monotonic() + max(0.0, budget_s)
+    while True:
+        try:
+            with ServiceClient(path, timeout=1.0) as c:
+                if c.ping().get("ok"):
+                    return True
+        except ServiceError:
+            pass
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(0.05)
+
+
+def _parse_client_argv(argv: list[str]) -> tuple[dict, list[str]]:
+    """Split client flags from the job argv: client flags are read
+    until the first ``--`` or the first token that is not a recognized
+    client flag (so both ``submit --socket=S -- in.paf ...`` and
+    ``submit --socket=S in.paf ...`` work)."""
+    opts: dict = {}
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--":
+            i += 1
+            break
+        if a.startswith("--socket="):
+            opts["socket"] = a.split("=", 1)[1]
+        elif a == "--no-wait":
+            opts["no_wait"] = True
+        elif a == "--drain":
+            opts["drain"] = True
+        elif a.startswith("--timeout="):
+            opts["timeout"] = a.split("=", 1)[1]
+        else:
+            break
+        i += 1
+    return opts, argv[i:]
+
+
+def client_main(cmd: str, argv: list[str], stdout=None,
+                stderr=None) -> int:
+    """The ``pwasm-tpu submit`` / ``pwasm-tpu svc-stats`` entry
+    point."""
+    stdout = stdout if stdout is not None else sys.stdout
+    stderr = stderr if stderr is not None else sys.stderr
+    opts, job_argv = _parse_client_argv(argv)
+    sock = opts.get("socket")
+    if not sock:
+        stderr.write(f"{_CLIENT_USAGE}\nError: --socket=PATH is "
+                     "required\n")
+        return EXIT_USAGE
+    timeout: float | None = None
+    if "timeout" in opts:
+        try:
+            timeout = float(opts["timeout"])
+            if timeout <= 0:
+                raise ValueError
+        except (TypeError, ValueError):
+            stderr.write(f"{_CLIENT_USAGE}\nInvalid --timeout value: "
+                         f"{opts['timeout']}\n")
+            return EXIT_USAGE
+    try:
+        if cmd == "svc-stats":
+            with ServiceClient(sock) as c:
+                if opts.get("drain"):
+                    resp = c.drain()
+                    if not resp.get("ok"):
+                        stderr.write(f"Error: drain rejected: "
+                                     f"{resp}\n")
+                        return EXIT_FATAL
+                resp = c.stats()
+            if not resp.get("ok"):
+                stderr.write(f"Error: stats failed: {resp}\n")
+                return EXIT_FATAL
+            json.dump(resp["stats"], stdout)
+            stdout.write("\n")
+            return 0
+        # submit
+        if not job_argv:
+            stderr.write(f"{_CLIENT_USAGE}\nError: submit needs the "
+                         "job's CLI arguments\n")
+            return EXIT_USAGE
+        with ServiceClient(sock) as c:
+            resp = c.submit(job_argv)
+            if not resp.get("ok"):
+                code = resp.get("error")
+                stderr.write(f"Error: submission rejected "
+                             f"({code}): {resp.get('detail', '')}\n")
+                if code == protocol.ERR_QUEUE_FULL:
+                    hint = resp.get("retry_after_s")
+                    if hint is not None:
+                        stderr.write(f"(retry after ~{hint}s)\n")
+                    return EXIT_QUEUE_FULL
+                return EXIT_FATAL
+            job_id = resp["job_id"]
+            if opts.get("no_wait"):
+                json.dump({"job_id": job_id, "state": "queued"},
+                          stdout)
+                stdout.write("\n")
+                return 0
+            resp = c.result(job_id, wait=True, timeout=timeout)
+        if not resp.get("ok"):
+            stderr.write(f"Error: result failed: {resp}\n")
+            return EXIT_FATAL
+        if resp.get("pending"):
+            stderr.write(f"Error: job {job_id} still "
+                         f"{resp['job']['state']} after the "
+                         "--timeout\n")
+            return EXIT_FATAL
+        job = resp["job"]
+        json.dump({"job_id": job_id, "state": job["state"],
+                   "rc": resp.get("rc"), "detail": job.get("detail")},
+                  stdout)
+        stdout.write("\n")
+        tail = resp.get("stderr_tail") or ""
+        if tail and job["state"] != "done":
+            stderr.write(tail)
+        rc = resp.get("rc")
+        return rc if isinstance(rc, int) else EXIT_FATAL
+    except ServiceError as e:
+        stderr.write(f"Error: {e}\n")
+        return EXIT_FATAL

@@ -1,0 +1,684 @@
+"""The warm-pool serving daemon (``pwasm-tpu serve``).
+
+One resident process, one unix socket, a bounded FIFO queue, and a
+small worker pool executing jobs through the EXISTING ``cli.run`` path
+— so a served job's outputs are byte-identical to a cold CLI run of
+the same argv.  What the daemon adds is everything a cold run cannot
+amortize:
+
+- **one warm process**: imports, the jit/compile caches, and the
+  bounded backend probe are paid once — jobs after the first answer
+  the probe from warm state (``backend.warm_hits`` in each job's
+  ``--stats``, gated by the bench's ``realistic_serve_warm_jobs``);
+- **one resilience stack**: the :class:`WarmContext` carries the
+  supervisor's breaker/ceiling state and the single
+  ``BackendHealthMonitor`` across jobs — a flap that opens the breaker
+  in job N leaves it open for job N+1 (no re-trip, no doomed device
+  attempts), and a reclose re-promotes every subsequent job;
+- **one drain**: the first SIGTERM/SIGINT (or the ``drain`` protocol
+  command) latches admission shut, pulls every running job's drain
+  flag (each finishes its in-flight batch, checkpoints, and exits 75
+  "preempted, resumable"), marks still-queued jobs preempted without
+  starting them, and the daemon itself exits 75.  A second signal
+  hard-aborts, exactly like the CLI.
+
+Concurrency model: the accept loop and each client connection run on
+their own threads; ``--max-concurrent`` worker threads execute jobs.
+Worker threads can never install signal handlers
+(``SignalDrain.install`` no-ops off the main thread by design), so the
+daemon's OWN drain — installed on the main thread — is the one signal
+surface, fanned out to per-job drain flags.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import tempfile
+import threading
+import time
+
+from pwasm_tpu.core.errors import EXIT_PREEMPTED, EXIT_USAGE, PwasmError
+from pwasm_tpu.resilience.lifecycle import SignalDrain
+from pwasm_tpu.service import protocol
+from pwasm_tpu.service.queue import (JOB_CANCELLED, JOB_DONE, JOB_FAILED,
+                                     JOB_PREEMPTED, JOB_QUEUED,
+                                     JOB_RUNNING, TERMINAL_STATES,
+                                     Draining, Job, JobQueue, QueueFull,
+                                     ServiceStats)
+
+_SERVE_USAGE = """Usage:
+ pwasm-tpu serve --socket=PATH [--max-queue=N] [--max-concurrent=N]
+                 [--max-frame-bytes=N]
+
+   --socket=PATH        unix socket to listen on (required)
+   --max-queue=N        admission control: queued-job ceiling, beyond
+                        which submit answers queue_full (default 16)
+   --max-concurrent=N   worker threads executing jobs (default 1 —
+                        serial jobs share the device cleanly; raise it
+                        only for host-path workloads)
+   --max-frame-bytes=N  protocol frame ceiling (default 8 MiB)
+
+ SIGTERM/SIGINT (or the `drain` protocol command) drains gracefully:
+ in-flight jobs finish at their next batch boundary and checkpoint,
+ queued jobs are reported preempted-resumable, new submissions are
+ rejected, and the daemon exits 75.  A second signal hard-aborts.
+"""
+
+
+class WarmContext:
+    """The state ONE warm process shares across consecutive
+    ``cli.run`` invocations.  ``cli.run(..., warm=ctx)`` reads/writes:
+
+    - ``drain``             the SignalDrain the run must honor (the
+                            daemon supplies a per-job one via
+                            :class:`_JobWarm`);
+    - ``monitor``           the single ``BackendHealthMonitor``,
+                            re-attached to each job's RunStats;
+    - ``supervisor_state``  the breaker/ceiling snapshot exported at
+                            each job's end and restored into the next
+                            job's supervisor (fault clock stripped —
+                            scripted fault windows are per-job).
+    """
+
+    def __init__(self) -> None:
+        self.drain = None
+        self.monitor = None
+        self.supervisor_state: dict | None = None
+        self.lock = threading.Lock()
+
+
+class _JobWarm:
+    """Per-job view of the shared :class:`WarmContext`: shared
+    supervisor state (lock-guarded snapshot swap), this job's own
+    drain flag, and the monitor shared ONLY when jobs are serial
+    (``--max-concurrent=1``, the device default).  A monitor is one
+    probe schedule with per-run sinks — two concurrent jobs calling
+    ``attach()`` on it would rebind each other's stats mid-run and
+    reset the probe callable under the other's feet, so with a wider
+    worker pool each job runs its own monitor and only the
+    breaker/ceiling snapshot (an atomic dict swap) is inherited."""
+
+    def __init__(self, shared: WarmContext, drain: SignalDrain,
+                 share_monitor: bool = True):
+        self._shared = shared
+        self.drain = drain
+        self._share_monitor = share_monitor
+        self._own_monitor = None
+
+    @property
+    def monitor(self):
+        if self._share_monitor:
+            return self._shared.monitor
+        return self._own_monitor
+
+    @monitor.setter
+    def monitor(self, m) -> None:
+        if self._share_monitor:
+            self._shared.monitor = m
+        else:
+            self._own_monitor = m
+
+    @property
+    def supervisor_state(self):
+        with self._shared.lock:
+            return self._shared.supervisor_state
+
+    @supervisor_state.setter
+    def supervisor_state(self, st) -> None:
+        with self._shared.lock:
+            self._shared.supervisor_state = st
+
+
+class Daemon:
+    """The serving daemon.  ``runner`` is injectable for tests and
+    defaults to ``pwasm_tpu.cli.run``."""
+
+    def __init__(self, socket_path: str, max_queue: int = 16,
+                 max_concurrent: int = 1,
+                 max_frame_bytes: int = protocol.MAX_FRAME_BYTES,
+                 stderr=None, runner=None):
+        self.socket_path = socket_path
+        self.max_concurrent = max(1, int(max_concurrent))
+        self.max_frame_bytes = int(max_frame_bytes)
+        self.stderr = stderr if stderr is not None else sys.stderr
+        self._runner = runner
+        self.queue = JobQueue(max_queue)
+        self.jobs: dict[str, Job] = {}
+        self.stats = ServiceStats()
+        self.warm = WarmContext()
+        self.drain = SignalDrain(stderr=self.stderr)
+        self._lock = threading.Lock()
+        self._running: dict[str, Job] = {}
+        self._draining = False
+        self._closing = threading.Event()
+        self._next_id = 0
+        self._jobdir: tempfile.TemporaryDirectory | None = None
+        from collections import deque
+        self._job_walls: deque = deque(maxlen=8)  # recent finished-job
+        #                       walls (the retry_after_s hint) — only
+        #                       the recent window matters, so bounded
+
+    # ---- lifecycle -----------------------------------------------------
+    def serve(self) -> int:
+        """Bind, accept, and run until drained.  Returns the process
+        exit code: 75 after a graceful drain (the daemon's own
+        "preempted, resumable" — queued jobs were reported resumable),
+        matching the per-job contract."""
+        if self._runner is None:
+            from pwasm_tpu.cli import run as cli_run
+            self._runner = cli_run
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            if os.path.exists(self.socket_path):
+                # a stale socket from a dead daemon: binding over it
+                # needs the unlink; a LIVE daemon still holds the
+                # listener, so connecting first tells the two apart
+                if _socket_alive(self.socket_path):
+                    raise PwasmError(
+                        f"Error: a daemon is already serving on "
+                        f"{self.socket_path}\n")
+                os.unlink(self.socket_path)
+            sock.bind(self.socket_path)
+        except OSError as e:
+            sock.close()
+            raise PwasmError(
+                f"Error: cannot bind service socket "
+                f"{self.socket_path}: {e}\n")
+        sock.listen(16)
+        sock.settimeout(0.2)
+        self._jobdir = tempfile.TemporaryDirectory(prefix="pwasm_svc_")
+        workers = [threading.Thread(target=self._worker, daemon=True,
+                                    name=f"pwasm-svc-worker-{i}")
+                   for i in range(self.max_concurrent)]
+        drained_at: float | None = None
+        with self.drain:     # signal handlers (main thread only)
+            for w in workers:
+                w.start()
+            self._say(f"serving on {self.socket_path} "
+                      f"(max-queue {self.queue.max_queue}, "
+                      f"max-concurrent {self.max_concurrent})")
+            try:
+                while True:
+                    if self.drain.requested:
+                        self._begin_drain(self.drain.reason
+                                          or "drain requested")
+                        if self._drained():
+                            # linger briefly so waiters blocked in
+                            # `result` get their final frames before
+                            # the process goes away
+                            if drained_at is None:
+                                drained_at = time.monotonic()
+                            elif time.monotonic() - drained_at > 0.5:
+                                break
+                    try:
+                        conn, _ = sock.accept()
+                    except socket.timeout:
+                        continue
+                    except OSError:
+                        break
+                    t = threading.Thread(target=self._handle_conn,
+                                         args=(conn,), daemon=True)
+                    t.start()
+            finally:
+                self._closing.set()
+                for w in workers:
+                    w.join(timeout=5.0)
+                sock.close()
+                try:
+                    os.unlink(self.socket_path)
+                except OSError:
+                    pass
+                if self._jobdir is not None:
+                    self._jobdir.cleanup()
+        if self.drain.requested:
+            self._say(f"drained — exiting resumable "
+                      f"(exit {EXIT_PREEMPTED}); resubmit preempted "
+                      "jobs with --resume to complete them")
+            return EXIT_PREEMPTED
+        return 0
+
+    def _say(self, msg: str) -> None:
+        print(f"pwasm: {msg}", file=self.stderr)
+
+    def _drained(self) -> bool:
+        with self._lock:
+            return self._draining and not self._running \
+                and self.queue.depth() == 0
+
+    def _begin_drain(self, reason: str) -> None:
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+            running = list(self._running.values())
+        waiting = self.queue.drain()
+        for job in waiting:
+            job.state = JOB_PREEMPTED
+            job.rc = EXIT_PREEMPTED
+            job.detail = ("preempted before start (service drained); "
+                          "resubmit to a live service — with --resume "
+                          "if a previous attempt checkpointed")
+            job.finished_s = time.time()
+            self.stats.jobs_preempted += 1
+            job.done.set()
+        for job in running:
+            if job.drain is not None:
+                job.drain.request(reason)
+        self._say(f"draining ({reason}): {len(running)} in-flight "
+                  f"job(s) finishing at their batch boundaries, "
+                  f"{len(waiting)} queued job(s) preempted, new "
+                  "submissions rejected")
+
+    # ---- workers -------------------------------------------------------
+    def _worker(self) -> None:
+        while not self._closing.is_set():
+            job = self.queue.take(timeout=0.1)
+            if job is None:
+                if self._draining:
+                    return
+                continue
+            with self._lock:
+                self._running[job.id] = job
+            try:
+                self._run_job(job)
+            finally:
+                with self._lock:
+                    self._running.pop(job.id, None)
+                job.done.set()
+
+    def _run_job(self, job: Job) -> None:
+        job.state = JOB_RUNNING
+        job.started_s = time.time()
+        # a drain latched between this job's dequeue and here must
+        # still reach its flag (the _begin_drain snapshot may have
+        # missed it)
+        if self.drain.requested and job.drain is not None \
+                and not job.drain.requested:
+            job.drain.request(self.drain.reason or "service draining")
+        warm = _JobWarm(self.warm, job.drain,
+                        share_monitor=self.max_concurrent == 1)
+        rc: int | None = None
+        try:
+            rc = self._runner(job.argv, stdout=job.outbuf,
+                              stderr=job.errbuf, warm=warm)
+        except BaseException as e:   # InjectedKill, stray PwasmError —
+            # a dying job must never take the daemon down with it
+            job.detail = f"job raised {type(e).__name__}: {e}"
+        job.rc = rc
+        job.finished_s = time.time()
+        self._job_walls.append(job.finished_s - job.started_s)
+        job.stderr_tail = job.errbuf.getvalue()[-4000:]
+        # a resident daemon must not retain every finished job's full
+        # output buffers for its whole life: keep only the served tail
+        # and drop the StringIOs (re-pointing the job's drain at the
+        # daemon stderr first — a late message must not hit a dropped
+        # buffer)
+        if job.drain is not None:
+            job.drain.stderr = self.stderr
+        job.errbuf = job.outbuf = None
+        job.stats = self._read_job_stats(job)
+        if rc == 0:
+            job.state = JOB_DONE
+            self.stats.jobs_completed += 1
+        elif rc == EXIT_PREEMPTED and job.cancel_requested:
+            job.state = JOB_CANCELLED
+            job.detail = ("cancelled at a batch boundary; the partial "
+                          "report is checkpointed (resumable)")
+            self.stats.jobs_cancelled += 1
+        elif rc == EXIT_PREEMPTED:
+            job.state = JOB_PREEMPTED
+            job.detail = ("preempted by service drain; --resume "
+                          "completes it")
+            self.stats.jobs_preempted += 1
+        else:
+            job.state = JOB_FAILED
+            if not job.detail:
+                job.detail = f"exit {rc}"
+            self.stats.jobs_failed += 1
+        self.stats.rollup_job(job.stats)
+
+    def _read_job_stats(self, job: Job) -> dict | None:
+        if job.stats_path is None:
+            return None
+        try:
+            import json
+            with open(job.stats_path) as f:
+                st = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if job.stats_injected:
+            try:
+                os.unlink(job.stats_path)
+            except OSError:
+                pass
+        return st if isinstance(st, dict) else None
+
+    # ---- admission -----------------------------------------------------
+    def submit(self, argv: list, cwd: str | None = None) -> Job:
+        """Validate + admit one job (raises Draining/QueueFull/
+        ValueError).  Also the in-process API the tests drive.
+        ``cwd`` is the CLIENT's working directory: relative paths in
+        the job argv are resolved against it, not the daemon's cwd —
+        the cold-to-warm drop-in contract (the client sends it
+        automatically)."""
+        if not isinstance(argv, list) \
+                or not all(isinstance(a, str) for a in argv) \
+                or not argv:
+            raise ValueError("args must be a non-empty list of strings")
+        from pwasm_tpu.cli import _SERVICE_CMDS, _parse_args, CliError
+        if argv[0] in _SERVICE_CMDS:
+            raise ValueError(
+                f"nested service command {argv[0]!r} not allowed")
+        if cwd is not None:
+            if not isinstance(cwd, str) or not os.path.isabs(cwd):
+                raise ValueError("cwd must be an absolute path")
+            argv = _absolutize_argv(argv, cwd)
+        # parse with the REAL CLI grammar (clustered short flags like
+        # `-Do out` included) so the cold-to-warm drop-in contract
+        # cannot drift from what cli.run would accept
+        try:
+            job_opts, _pos = _parse_args(list(argv))
+        except CliError as e:
+            raise ValueError(f"unparseable job argv: "
+                             f"{str(e).splitlines()[-1]}")
+        if "o" not in job_opts:
+            raise ValueError(
+                "service jobs must write their report to a file "
+                "(-o <report>): the socket carries control frames, "
+                "not report bytes")
+        if self.drain.requested:
+            raise Draining("service is draining")
+        with self._lock:
+            self._next_id += 1
+            job = Job(id=f"job-{self._next_id:04d}", argv=list(argv))
+        job.drain = SignalDrain(stderr=job.errbuf,
+                                hard_exit=lambda code: None)
+        stats_path = next(
+            (a.split("=", 1)[1] for a in argv
+             if a.startswith("--stats=")), None)
+        if stats_path is None:
+            # the daemon needs every job's RunStats for the roll-up
+            # and the warm-hit gates: inject a stats sink the client
+            # didn't ask for (daemon-owned, deleted after reading)
+            stats_path = os.path.join(self._jobdir.name,
+                                      f"{job.id}.stats.json")
+            job.argv = job.argv + [f"--stats={stats_path}"]
+            job.stats_injected = True
+        job.stats_path = stats_path
+        self.queue.submit(job)     # may raise Draining/QueueFull
+        with self._lock:
+            self.jobs[job.id] = job
+        self.stats.jobs_accepted += 1
+        return job
+
+    def _retry_after_s(self) -> float:
+        """The queue_full backoff hint: roughly one recent job's wall
+        (the deque's maxlen already bounds the window)."""
+        walls = list(self._job_walls)
+        return round(max(0.5, sum(walls) / len(walls)), 3) if walls \
+            else 1.0
+
+    # ---- protocol ------------------------------------------------------
+    def _handle_conn(self, conn: socket.socket) -> None:
+        rfile = conn.makefile("rb")
+        wfile = conn.makefile("wb")
+        try:
+            while True:
+                try:
+                    req = protocol.read_frame(rfile,
+                                              self.max_frame_bytes)
+                except protocol.FrameError as e:
+                    protocol.write_frame(
+                        wfile, protocol.err(e.code, str(e)))
+                    if e.fatal:
+                        return
+                    continue
+                if req is None:
+                    return
+                try:
+                    resp = self._dispatch(req)
+                except Exception as e:
+                    # client-controlled field TYPES can reach stdlib
+                    # calls (a string `timeout` into Event.wait, an
+                    # unhashable job_id into a dict lookup): a bad
+                    # request must cost the CLIENT an error frame,
+                    # never the daemon a dead connection thread
+                    resp = protocol.err(
+                        protocol.ERR_BAD_REQUEST,
+                        f"{type(e).__name__}: {e}")
+                protocol.write_frame(wfile, resp)
+        except (BrokenPipeError, ConnectionResetError, OSError,
+                ValueError):
+            # the peer went away (possibly mid-result): their problem,
+            # never the daemon's — the job keeps running and the next
+            # connection can fetch the result
+            pass
+        finally:
+            for f in (rfile, wfile):
+                try:
+                    f.close()
+                except OSError:
+                    pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, req: dict) -> dict:
+        cmd = req.get("cmd")
+        if cmd == "ping":
+            return protocol.ok(
+                protocol_version=protocol.PROTOCOL_VERSION,
+                draining=self._draining)
+        if cmd == "submit":
+            try:
+                job = self.submit(req.get("args"),
+                                  cwd=req.get("cwd"))
+            except ValueError as e:
+                return protocol.err(protocol.ERR_BAD_REQUEST, str(e))
+            except Draining as e:
+                self.stats.jobs_rejected_draining += 1
+                return protocol.err(protocol.ERR_DRAINING, str(e))
+            except QueueFull as e:
+                # the 429: a well-behaved client backs off and retries
+                self.stats.jobs_rejected += 1
+                return protocol.err(
+                    protocol.ERR_QUEUE_FULL, str(e),
+                    queue_depth=self.queue.depth(),
+                    max_queue=self.queue.max_queue,
+                    retry_after_s=self._retry_after_s())
+            return protocol.ok(job_id=job.id,
+                               queue_depth=self.queue.depth())
+        if cmd == "stats":
+            with self._lock:
+                running = len(self._running)
+            return protocol.ok(stats=self.stats.as_dict(
+                queue_depth=self.queue.depth(), running=running,
+                draining=self._draining,
+                max_queue=self.queue.max_queue,
+                max_concurrent=self.max_concurrent))
+        if cmd == "drain":
+            self.drain.request("drain requested by client")
+            self._begin_drain(self.drain.reason)
+            with self._lock:
+                # snapshot under the lock: a concurrent submit mutates
+                # self.jobs, and iterating it bare would raise mid-
+                # drain (answering bad_request for a drain that DID
+                # latch)
+                running = sorted(self._running)
+                preempted = sorted(
+                    j.id for j in self.jobs.values()
+                    if j.state == JOB_PREEMPTED
+                    and j.started_s is None)
+            return protocol.ok(draining=True, running=running,
+                               preempted_queued=preempted)
+        if cmd in ("status", "result", "cancel"):
+            job = self.jobs.get(req.get("job_id"))
+            if job is None:
+                return protocol.err(
+                    protocol.ERR_UNKNOWN_JOB,
+                    f"unknown job_id {req.get('job_id')!r}")
+            if cmd == "status":
+                return protocol.ok(job=job.describe(),
+                                   queue_depth=self.queue.depth())
+            if cmd == "result":
+                if req.get("wait", True):
+                    job.done.wait(req.get("timeout"))
+                d = job.describe()
+                if job.state not in TERMINAL_STATES:
+                    return protocol.ok(job=d, pending=True)
+                return protocol.ok(job=d, rc=job.rc, stats=job.stats,
+                                   stderr_tail=job.stderr_tail)
+            return self._cancel(job)
+        return protocol.err(protocol.ERR_UNKNOWN_CMD,
+                            f"unknown cmd {cmd!r}")
+
+    def _cancel(self, job: Job) -> dict:
+        if job.state == JOB_QUEUED and self.queue.remove(job):
+            job.state = JOB_CANCELLED
+            job.rc = None
+            job.detail = "cancelled while queued (never started)"
+            job.finished_s = time.time()
+            self.stats.jobs_cancelled += 1
+            job.done.set()
+            return protocol.ok(state=JOB_CANCELLED, was="queued")
+        if job.state in TERMINAL_STATES:
+            return protocol.ok(state=job.state, was="terminal")
+        # running — or QUEUED-but-already-dequeued (the worker holds
+        # it between take() and the RUNNING transition, so the queue
+        # removal above missed): a per-job graceful drain either way.
+        # The job stops at its next batch boundary with a valid
+        # checkpoint — a mid-batch kill would only throw away
+        # finished work, and the pre-armed drain flag catches the
+        # about-to-run case at its first boundary.
+        job.cancel_requested = True
+        if job.drain is not None:
+            job.drain.request("cancelled by client")
+        return protocol.ok(state="cancelling", was="running")
+
+
+# the argv slots that hold PATHS, resolved against the client's cwd:
+# short value flags (from cli._VALUE_FLAGS; -c is clipmax, -d/-p/-m are
+# the reference's parsed-but-unread quirks), --long=FILE options, and
+# the positional PAF input.
+_PATH_SHORT = frozenset("rows")
+_PATH_LONG = frozenset(("stats", "profile", "motifs",
+                        "ace", "info", "cons"))
+
+
+def _absolutize_argv(argv: list[str], cwd: str) -> list[str]:
+    """Rewrite relative paths in a job argv against the CLIENT's
+    ``cwd``, walking tokens with the same grammar as
+    ``cli._parse_args`` (clustered short flags, joined or separated
+    values, ``--long=value``) so the rewrite cannot disagree with what
+    the run will parse.  Unknown flags pass through untouched — the
+    submit-time validation rejects the argv right after with the CLI's
+    own diagnostic."""
+    from pwasm_tpu.cli import _BOOL_FLAGS, _VALUE_FLAGS
+
+    def ab(v: str) -> str:
+        # "-" is the conventional stdin marker, not a path
+        if not v or v == "-" or os.path.isabs(v):
+            return v
+        return os.path.join(cwd, v)
+
+    out: list[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a.startswith("--"):
+            if "=" in a:
+                k, v = a[2:].split("=", 1)
+                if k in _PATH_LONG:
+                    a = f"--{k}={ab(v)}"
+            out.append(a)
+        elif a.startswith("-") and len(a) > 1:
+            j = 1
+            rebuilt = "-"
+            value_flag = None      # set when the flag's value is the
+            #                        NEXT argv token
+            while j < len(a):
+                ch = a[j]
+                if ch in _BOOL_FLAGS:
+                    rebuilt += ch
+                    j += 1
+                elif ch in _VALUE_FLAGS:
+                    rebuilt += ch
+                    if j + 1 < len(a):     # joined value: -oFILE
+                        v = a[j + 1:]
+                        rebuilt += ab(v) if ch in _PATH_SHORT else v
+                    else:
+                        value_flag = ch
+                    j = len(a)
+                else:
+                    rebuilt = a            # unknown flag: untouched
+                    j = len(a)
+            out.append(rebuilt)
+            if value_flag is not None and i + 1 < len(argv):
+                i += 1
+                v = argv[i]
+                out.append(ab(v) if value_flag in _PATH_SHORT else v)
+        else:
+            out.append(ab(a))              # positional: the PAF input
+        i += 1
+    return out
+
+
+def _socket_alive(path: str) -> bool:
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(0.5)
+    try:
+        s.connect(path)
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
+def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
+    """The ``pwasm-tpu serve`` entry point."""
+    stderr = stderr if stderr is not None else sys.stderr
+    opts: dict[str, str] = {}
+    for a in argv:
+        if a.startswith("--") and "=" in a:
+            k, v = a[2:].split("=", 1)
+            opts[k] = v
+        elif a in ("-h", "--help"):
+            stderr.write(_SERVE_USAGE)
+            return EXIT_USAGE
+        else:
+            stderr.write(f"{_SERVE_USAGE}\nInvalid argument: {a}\n")
+            return EXIT_USAGE
+    sock = opts.pop("socket", None)
+    if not sock:
+        stderr.write(f"{_SERVE_USAGE}\nError: --socket=PATH is "
+                     "required\n")
+        return EXIT_USAGE
+    nums = {}
+    for knob, dflt in (("max-queue", 16), ("max-concurrent", 1),
+                       ("max-frame-bytes", protocol.MAX_FRAME_BYTES)):
+        val = opts.pop(knob, None)
+        if val is None:
+            nums[knob] = dflt
+        elif val.isascii() and val.isdigit() and int(val) >= 1:
+            nums[knob] = int(val)
+        else:
+            stderr.write(f"{_SERVE_USAGE}\nInvalid --{knob} value: "
+                         f"{val}\n")
+            return EXIT_USAGE
+    if opts:
+        stderr.write(f"{_SERVE_USAGE}\nInvalid argument: "
+                     f"--{next(iter(opts))}\n")
+        return EXIT_USAGE
+    daemon = Daemon(sock, max_queue=nums["max-queue"],
+                    max_concurrent=nums["max-concurrent"],
+                    max_frame_bytes=nums["max-frame-bytes"],
+                    stderr=stderr)
+    try:
+        return daemon.serve()
+    except PwasmError as e:
+        stderr.write(str(e))
+        return e.exit_code

@@ -1,0 +1,124 @@
+"""The serve wire protocol: newline-delimited JSON over a unix socket.
+
+One request frame per line, one response frame per line — a format a
+shell one-liner (``printf ... | nc -U``) can speak, trivially
+greppable in a packet capture, and with no length-prefix framing to
+get subtly wrong on either side.  Every frame is a single JSON object
+terminated by ``\\n``; a frame longer than :data:`MAX_FRAME_BYTES` is
+rejected (``frame_too_large``) and the connection closed, because once
+a reader has consumed a partial oversized line the stream can no
+longer be resynchronized safely.
+
+Commands (``{"cmd": ...}``):
+
+=============  ==========================================================
+``submit``     ``{"cmd":"submit","args":[...cli argv...],
+               "cwd":ABS_DIR}`` — enqueue a report job; relative
+               paths in ``args`` resolve against the client's
+               ``cwd`` (what a cold run would do), never the
+               daemon's.  Admission control answers ``queue_full``
+               (the 429 of this protocol: back off and retry) when the
+               bounded queue is at capacity, and ``draining`` once a
+               drain began.  Jobs must write their outputs to files
+               (``-o`` required): the socket carries control, not bulk
+               report bytes.
+``status``     ``{"cmd":"status","job_id":...}`` — non-blocking state.
+``result``     ``{"cmd":"result","job_id":...[,"wait":bool,
+               "timeout":s]}`` — the terminal verdict (rc, per-job
+               RunStats, stderr tail); by default blocks until the job
+               finishes.
+``cancel``     queued job: removed immediately; running job: a graceful
+               drain is requested — the job stops at its next batch
+               boundary, leaving a valid resumable checkpoint.
+``stats``      the service-level counters (versioned schema).
+``drain``      begin the same graceful drain a SIGTERM triggers: reject
+               new submissions, finish in-flight jobs at batch
+               boundaries, mark queued jobs preempted-resumable, exit
+               75.
+``ping``       liveness + protocol version.
+=============  ==========================================================
+
+Error responses are ``{"ok": false, "error": <code>, "detail": ...}``
+with codes from the ``ERR_*`` constants below.
+"""
+
+from __future__ import annotations
+
+import json
+
+PROTOCOL_VERSION = 1
+
+# one frame = one JSON line.  8 MiB is far above any control payload
+# (a submit carries argv, not report bytes) while still bounding what a
+# misbehaving client can make the daemon buffer.
+MAX_FRAME_BYTES = 8 << 20
+
+# error vocabulary (the "HTTP status codes" of the protocol)
+ERR_QUEUE_FULL = "queue_full"        # admission control: back off+retry
+ERR_DRAINING = "draining"            # drain in progress: no new jobs
+ERR_BAD_JSON = "bad_json"            # unparseable frame (conn survives)
+ERR_FRAME_TOO_LARGE = "frame_too_large"  # conn closed: stream unsynced
+ERR_BAD_REQUEST = "bad_request"      # parsed, but semantically invalid
+ERR_UNKNOWN_CMD = "unknown_cmd"
+ERR_UNKNOWN_JOB = "unknown_job"
+
+
+class FrameError(Exception):
+    """A frame-level protocol violation.  ``code`` is the ``ERR_*``
+    wire code; ``fatal`` says whether the connection can keep being
+    used (a malformed JSON line is recoverable — the next line is a
+    fresh frame; an oversized line is not, the reader lost sync)."""
+
+    def __init__(self, code: str, detail: str, fatal: bool = False):
+        super().__init__(detail)
+        self.code = code
+        self.fatal = fatal
+
+
+def ok(**fields) -> dict:
+    out = {"ok": True}
+    out.update(fields)
+    return out
+
+
+def err(code: str, detail: str = "", **fields) -> dict:
+    out = {"ok": False, "error": code, "detail": detail}
+    out.update(fields)
+    return out
+
+
+def write_frame(wfile, obj: dict) -> None:
+    """Serialize one frame onto a buffered binary writer and flush —
+    the peer blocks on the newline, so a buffered-but-unflushed frame
+    is a hang, not a latency."""
+    wfile.write(json.dumps(obj, separators=(",", ":")).encode("utf-8")
+                + b"\n")
+    wfile.flush()
+
+
+def read_frame(rfile, max_bytes: int = MAX_FRAME_BYTES) -> dict | None:
+    """Read and parse one frame from a buffered binary reader.
+
+    Returns the parsed object, or ``None`` on a clean EOF (peer closed
+    between frames).  Raises :class:`FrameError` for an oversized line
+    (fatal — the connection must be closed), a truncated final line
+    (peer died mid-frame), a line that is not JSON, or JSON that is not
+    an object."""
+    line = rfile.readline(max_bytes + 1)
+    if not line:
+        return None
+    if len(line) > max_bytes:
+        raise FrameError(
+            ERR_FRAME_TOO_LARGE,
+            f"frame exceeds {max_bytes} bytes", fatal=True)
+    if not line.endswith(b"\n"):
+        # EOF mid-line: the peer vanished mid-frame — nothing usable
+        raise FrameError(ERR_BAD_JSON, "truncated frame at EOF",
+                         fatal=True)
+    try:
+        obj = json.loads(line)
+    except ValueError as e:
+        raise FrameError(ERR_BAD_JSON, f"unparseable frame: {e}")
+    if not isinstance(obj, dict):
+        raise FrameError(ERR_BAD_JSON, "frame is not a JSON object")
+    return obj

@@ -1,0 +1,235 @@
+"""Bounded FIFO job queue with admission control + service counters.
+
+The queue is the daemon's *admission control* point: a serving process
+that accepts unboundedly is just an OOM with extra steps, so ``submit``
+fails fast with :class:`QueueFull` (the protocol's ``queue_full`` —
+429-shaped: the caller backs off and retries) once ``max_queue`` jobs
+wait, and with :class:`Draining` once a drain began.  FIFO on purpose:
+report jobs are peers, and predictable completion order is worth more
+to a batch fleet than any priority scheme.
+
+:class:`ServiceStats` is the service-level mirror of the per-job
+``RunStats``: admission/outcome counters plus a numeric roll-up of
+every finished job's stats JSON — the ``stats`` protocol response is
+versioned (``stats_version``) because a service consumer reads it
+programmatically, not a human eyeball.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+JOB_QUEUED = "queued"
+JOB_RUNNING = "running"
+JOB_DONE = "done"
+JOB_FAILED = "failed"
+JOB_PREEMPTED = "preempted"    # drained mid-run (or before starting):
+#                                resumable via --resume
+JOB_CANCELLED = "cancelled"
+
+TERMINAL_STATES = (JOB_DONE, JOB_FAILED, JOB_PREEMPTED, JOB_CANCELLED)
+
+SERVICE_STATS_VERSION = 1
+
+
+class QueueFull(Exception):
+    """Admission rejected: the bounded queue is at capacity."""
+
+
+class Draining(Exception):
+    """Admission rejected: the service is draining (no new jobs)."""
+
+
+@dataclass
+class Job:
+    """One submitted report job and its whole lifecycle record."""
+
+    id: str
+    argv: list
+    state: str = JOB_QUEUED
+    rc: int | None = None
+    detail: str = ""
+    cancel_requested: bool = False
+    submitted_s: float = field(default_factory=time.time)
+    started_s: float | None = None
+    finished_s: float | None = None
+    stats: dict | None = None          # the job's RunStats JSON
+    stats_path: str | None = None
+    stats_injected: bool = False       # daemon-owned stats tmp file
+    stderr_tail: str = ""
+    # per-job drain flag: the daemon's SIGTERM (or a cancel) requests
+    # it, and the job's cli.run honors it at the next batch boundary —
+    # created at submit time so a drain arriving before the job starts
+    # still has a flag to pull
+    drain: object = field(default=None, repr=False)
+    errbuf: io.StringIO = field(default_factory=io.StringIO, repr=False)
+    outbuf: io.StringIO = field(default_factory=io.StringIO, repr=False)
+    done: threading.Event = field(default_factory=threading.Event,
+                                  repr=False)
+
+    def describe(self) -> dict:
+        return {
+            "id": self.id,
+            "state": self.state,
+            "rc": self.rc,
+            "detail": self.detail,
+            "cancel_requested": self.cancel_requested,
+            "submitted_s": round(self.submitted_s, 3),
+            "started_s": round(self.started_s, 3)
+            if self.started_s else None,
+            "finished_s": round(self.finished_s, 3)
+            if self.finished_s else None,
+        }
+
+
+class JobQueue:
+    """Thread-safe bounded FIFO with a draining latch."""
+
+    def __init__(self, max_queue: int = 16):
+        self.max_queue = max(1, int(max_queue))
+        self._q: deque[Job] = deque()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._draining = False
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def submit(self, job: Job) -> int:
+        """Admit ``job``; returns its 0-based queue position.  Raises
+        :class:`Draining` / :class:`QueueFull` — admission decisions
+        are exceptions, not silent drops, so the protocol layer can
+        answer with the right wire code."""
+        with self._cond:
+            if self._draining:
+                raise Draining("service is draining")
+            if len(self._q) >= self.max_queue:
+                raise QueueFull(
+                    f"queue at capacity ({self.max_queue})")
+            self._q.append(job)
+            pos = len(self._q) - 1
+            self._cond.notify()
+            return pos
+
+    def take(self, timeout: float | None = None) -> Job | None:
+        """Pop the oldest queued job (FIFO); None on timeout or when
+        draining emptied the queue."""
+        with self._cond:
+            if not self._q:
+                self._cond.wait(timeout)
+            if not self._q:
+                return None
+            return self._q.popleft()
+
+    def remove(self, job: Job) -> bool:
+        """Remove a still-queued job (the queued-cancel path)."""
+        with self._lock:
+            try:
+                self._q.remove(job)
+                return True
+            except ValueError:
+                return False
+
+    def drain(self) -> list[Job]:
+        """Latch the draining state (every later ``submit`` raises
+        :class:`Draining`) and return the jobs that were still queued —
+        the daemon marks them preempted-resumable, never starts them."""
+        with self._cond:
+            self._draining = True
+            waiting = list(self._q)
+            self._q.clear()
+            self._cond.notify_all()
+            return waiting
+
+
+class ServiceStats:
+    """Service-level counters + the numeric roll-up of job RunStats."""
+
+    def __init__(self) -> None:
+        self.t0 = time.time()
+        self.jobs_accepted = 0
+        self.jobs_rejected = 0        # queue_full admissions
+        self.jobs_rejected_draining = 0
+        self.jobs_completed = 0
+        self.jobs_failed = 0
+        self.jobs_preempted = 0
+        self.jobs_cancelled = 0
+        self._rollup: dict = {}
+        self._lock = threading.Lock()
+
+    def rollup_job(self, stats: dict | None) -> None:
+        """Fold one finished job's RunStats JSON into the service
+        roll-up (numeric leaves summed, dicts recursed, the schema tag
+        and derived rates skipped — summing versions or rates would be
+        nonsense)."""
+        if not isinstance(stats, dict):
+            return
+        with self._lock:
+            _sum_numeric(self._rollup, stats,
+                         skip=("stats_version", "aligned_bases_per_s",
+                               "preempted"))
+
+    def as_dict(self, queue_depth: int = 0, running: int = 0,
+                draining: bool = False, max_queue: int = 0,
+                max_concurrent: int = 0) -> dict:
+        from pwasm_tpu.service.protocol import PROTOCOL_VERSION
+        with self._lock:
+            rollup = _copy_tree(self._rollup)
+        backend = rollup.get("backend", {})
+        return {
+            "stats_version": SERVICE_STATS_VERSION,
+            "protocol_version": PROTOCOL_VERSION,
+            "uptime_s": round(time.time() - self.t0, 3),
+            "draining": draining,
+            "queue_depth": queue_depth,
+            "running": running,
+            "max_queue": max_queue,
+            "max_concurrent": max_concurrent,
+            "jobs": {
+                "accepted": self.jobs_accepted,
+                "rejected": self.jobs_rejected,
+                "rejected_draining": self.jobs_rejected_draining,
+                "completed": self.jobs_completed,
+                "failed": self.jobs_failed,
+                "preempted": self.jobs_preempted,
+                "cancelled": self.jobs_cancelled,
+            },
+            # the warm-pool promise, observable: probes paid vs probe
+            # checks answered from the warm process state
+            "warm": {
+                "backend_probes": backend.get("probes", 0),
+                "backend_warm_hits": backend.get("warm_hits", 0),
+            },
+            "rollup": rollup,
+        }
+
+
+def _sum_numeric(dst: dict, src: dict, skip: tuple = ()) -> None:
+    for k, v in src.items():
+        if k in skip:
+            continue
+        if isinstance(v, dict):
+            sub = dst.setdefault(k, {})
+            if isinstance(sub, dict):
+                _sum_numeric(sub, v, skip)
+        elif isinstance(v, bool):
+            continue
+        elif isinstance(v, (int, float)):
+            prev = dst.get(k, 0)
+            if isinstance(prev, (int, float)) \
+                    and not isinstance(prev, bool):
+                dst[k] = prev + v
+
+
+def _copy_tree(d: dict) -> dict:
+    return {k: _copy_tree(v) if isinstance(v, dict) else v
+            for k, v in d.items()}

@@ -14,6 +14,13 @@ import json
 import time
 from typing import IO
 
+# The --stats JSON is a VERSIONED schema now that programs (the serve
+# daemon's roll-up, the bench gates, fleet wrappers) read it, not just
+# eyeballs: additive changes (new keys/blocks) keep the version; a
+# renamed/retyped/removed key must bump it.  Documented in
+# docs/SERVICE.md ("--stats as an interface").
+STATS_VERSION = 1
+
 
 class RunStats:
     def __init__(self) -> None:
@@ -39,6 +46,12 @@ class RunStats:
         #                           the MSA (bad gap structure)
         self.engine_fallbacks = 0  # engine-level device/native demotions
         #                            inside the MSA consensus path
+        # backend-probe accounting (utils.backend.probe_counters,
+        # diffed around the CLI's startup gate): the warm-pool reuse
+        # gate — a job served by a warm process records warm_hits > 0
+        # and probes == 0 once the first job initialized the backend
+        self.backend_probes = 0     # bounded subprocess probes PAID
+        self.backend_warm_hits = 0  # probe checks answered warm
         # resilience counters (pwasm_tpu.resilience.supervisor): the
         # supervised device pipeline's decisions, reported as one
         # nested "resilience" block in the JSON
@@ -65,6 +78,12 @@ class RunStats:
         self.res_bucket_demotions = 0  # pow2 batch-ceiling lowerings
         #                                (each one shrinks every later
         #                                flush for the rest of the run)
+        self.res_bucket_repromotions = 0  # probation-raises of a
+        #                                demoted ceiling after N
+        #                                consecutive clean flushes —
+        #                                the up-transition, so one OOM
+        #                                does not chunk a long-lived
+        #                                run (or serve process) forever
         self.preempted = False         # the run exited via a graceful
         #                                drain (SIGTERM/SIGINT or the
         #                                preempt= leg): stats are
@@ -122,6 +141,7 @@ class RunStats:
 
     def as_dict(self) -> dict:
         return {
+            "stats_version": STATS_VERSION,
             "lines": self.lines,
             "alignments": self.alignments,
             "skipped_bad_lines": self.skipped_bad,
@@ -137,6 +157,10 @@ class RunStats:
             "realigned": self.realigned,
             "msa_dropped": self.msa_dropped,
             "engine_fallbacks": self.engine_fallbacks,
+            "backend": {
+                "probes": self.backend_probes,
+                "warm_hits": self.backend_warm_hits,
+            },
             "device": {
                 "dispatches": self.device_dispatches,
                 "flushes": self.device_flushes,
@@ -154,6 +178,7 @@ class RunStats:
                 "oom_events": self.res_oom_events,
                 "batch_splits": self.res_batch_splits,
                 "bucket_demotions": self.res_bucket_demotions,
+                "bucket_repromotions": self.res_bucket_repromotions,
                 "breaker_recloses": self.res_breaker_recloses,
                 "reprobe_attempts": self.res_reprobe_attempts,
                 "degraded_batches": self.res_degraded_batches,

@@ -61,6 +61,19 @@ REGISTRY = {
         "bench.py owns its bounded subprocess)",
 }
 
+# The service layer (pwasm_tpu/service/: the warm-pool daemon, ISSUE
+# 5) is held to a STRICTER rule than the registry: it must not touch
+# jax AT ALL — not even an import.  Every served job reaches the
+# device exclusively through cli.run's supervised sites, so any direct
+# jax use in service code would be a device entry point outside BOTH
+# the supervision contract and the per-job fault-injection/guardrail
+# machinery.  (The generic PATTERNS above still apply to service
+# modules too; this adds the import-level tripwire.)
+SERVICE_DIR = "pwasm_tpu/service"
+SERVICE_PATTERNS = re.compile(
+    r"^\s*(?:import\s+jax\b|from\s+jax[.\s])|jax\.jit|jax\.device_put"
+    r"|jax\.device_get|\.block_until_ready\s*\(")
+
 
 def find_hits(root: str = REPO) -> list[tuple[str, int, str]]:
     """Every (relpath, lineno, line) in pwasm_tpu/ matching PATTERNS,
@@ -93,6 +106,33 @@ def find_unregistered(root: str = REPO) -> list[str]:
     return out
 
 
+def find_service_violations(root: str = REPO) -> list[str]:
+    """Service-side device entry points (see SERVICE_PATTERNS): the
+    daemon/client/queue/protocol modules must stay jax-free — device
+    work belongs behind cli.run's BatchSupervisor sites."""
+    out = []
+    svc = os.path.join(root, *SERVICE_DIR.split("/"))
+    if not os.path.isdir(svc):
+        return out
+    for dirpath, dirnames, filenames in os.walk(svc):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                for i, line in enumerate(f, 1):
+                    if line.lstrip().startswith("#"):
+                        continue
+                    if SERVICE_PATTERNS.search(line):
+                        out.append(
+                            f"{rel}:{i}: service module touches jax "
+                            f"directly: {line.strip()} — route device "
+                            "work through cli.run's supervised sites")
+    return out
+
+
 def stale_registry_entries(root: str = REPO) -> list[str]:
     """Registry rows whose module no longer has any hit (or vanished) —
     kept accurate so the registry stays a map, not a fossil."""
@@ -103,18 +143,26 @@ def stale_registry_entries(root: str = REPO) -> list[str]:
 def main() -> int:
     bad = find_unregistered()
     stale = stale_registry_entries()
+    svc = find_service_violations()
     for line in bad:
         print(line, file=sys.stderr)
     for rel in stale:
         print(f"{rel}: stale registry entry (no device entry points "
               "left — remove it)", file=sys.stderr)
+    for line in svc:
+        print(line, file=sys.stderr)
     if bad:
         print(f"\n{len(bad)} device entry point(s) outside the "
               "BatchSupervisor site registry.  Either route the work "
               "through a supervised site (resilience/supervisor.py) or "
               "register the module in qa/check_supervision.py with a "
               "justification.", file=sys.stderr)
-    return 1 if (bad or stale) else 0
+    if svc:
+        print(f"\n{len(svc)} direct jax use(s) in pwasm_tpu/service/. "
+              "The warm-pool daemon reaches the device only through "
+              "cli.run's supervised sites — move the device work "
+              "there.", file=sys.stderr)
+    return 1 if (bad or stale or svc) else 0
 
 
 if __name__ == "__main__":

@@ -176,6 +176,169 @@ def test_injected_oom_leg_fires_by_declared_size():
     assert st.res_batch_splits == 1
 
 
+def test_bucket_ceiling_repromotes_after_clean_flushes():
+    """ISSUE 5 satellite (ROADMAP open item from PR 4): after N
+    consecutive clean SIZED flushes at a demoted ceiling, the ceiling
+    probation-raises one pow2 step — a long run (or a long-lived serve
+    process) that OOMed once must not stay chunked forever."""
+    sup, st = _bisect_supervisor(repromote_after=3)
+    sup.bucket_ceiling = 2
+    for _ in range(2):
+        sup.run("ctx_scan", lambda: "ok", size=2)
+    assert sup.bucket_ceiling == 2
+    assert st.res_bucket_repromotions == 0
+    sup.run("ctx_scan", lambda: "ok", size=2)     # the 3rd clean flush
+    assert sup.bucket_ceiling == 4
+    assert st.res_bucket_repromotions == 1
+    # unsized successes (consensus-style launches) never count
+    sup.run("consensus", lambda: "ok")
+    assert sup._ceiling_clean == 0
+    # nor do flushes far below the ceiling: a 1-item success under a
+    # 4-item ceiling proves nothing about memory at the ceiling
+    sup.run("ctx_scan", lambda: "ok", size=1)
+    assert sup._ceiling_clean == 0
+    # probation repeats: another 3 clean flushes raise one more step
+    for _ in range(3):
+        sup.run("ctx_scan", lambda: "ok", size=4)
+    assert sup.bucket_ceiling == 8
+    assert st.res_bucket_repromotions == 2
+
+
+def test_oom_resets_repromotion_probation_and_redemotes():
+    sup, st = _bisect_supervisor(repromote_after=2)
+    sup.bucket_ceiling = 4
+    sup.run("ctx_scan", lambda: "ok", size=4)     # 1 clean flush
+
+    def oom():
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+    assert sup.run("ctx_scan", oom, fallback=lambda: "host") == "host"
+    assert sup._ceiling_clean == 0                # probation restarted
+    sup.run("ctx_scan", lambda: "ok", size=4)
+    assert sup.bucket_ceiling == 4                # 1 clean ≠ 2 yet
+    sup.run("ctx_scan", lambda: "ok", size=4)
+    assert sup.bucket_ceiling == 8                # probation met anew
+    # a raised ceiling can still be demoted back by a fresh OOM
+    items = list(range(8))
+    spec = BisectableBatch(
+        items=items,
+        attempt_for=lambda sub: (_ for _ in ()).throw(RuntimeError(
+            "RESOURCE_EXHAUSTED: oom")) if len(sub) > 2 else list(sub),
+        combine=lambda parts: [x for _s, r in parts for x in r])
+    assert sup.run("ctx_scan", lambda: spec.attempt_for(items),
+                   bisect=spec) == items
+    assert sup.bucket_ceiling == 2
+
+
+def test_bisection_halves_do_not_count_toward_probation():
+    """The halves that succeed right after an OOM are not 'clean
+    flushes at the ceiling' — counting them would re-raise the ceiling
+    while the allocator is still the problem."""
+    sup, st = _bisect_supervisor(repromote_after=2)
+    items = list(range(8))
+
+    def attempt_for(sub):
+        if len(sub) > 2:
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        return list(sub)
+
+    spec = BisectableBatch(
+        items=items, attempt_for=attempt_for,
+        combine=lambda parts: [x for _s, r in parts for x in r])
+    out = sup.run("ctx_scan", lambda: attempt_for(items), bisect=spec)
+    assert out == items
+    # 4 successful 2-item halves ran, yet the probation is untouched
+    assert sup._ceiling_clean == 0
+    assert st.res_bucket_repromotions == 0
+    assert sup.bucket_ceiling == 2
+
+
+def test_repromotion_restores_at_origin_instead_of_doubling_forever():
+    """The up-transition terminates: climbing back to the pow2 bucket
+    that originally OOMed RESTORES the ceiling to None (undemoted) —
+    it never doubles past what actually failed, and a long-lived
+    process stops paying the probation warn/counter churn."""
+    sup, st = _bisect_supervisor(repromote_after=2)
+    items = list(range(8))
+
+    def attempt_for(sub):
+        if len(sub) > 4:
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        return list(sub)
+
+    spec = BisectableBatch(
+        items=items, attempt_for=attempt_for,
+        combine=lambda parts: [x for _s, r in parts for x in r])
+    assert sup.run("ctx_scan", lambda: attempt_for(items),
+                   bisect=spec) == items
+    assert sup.bucket_ceiling == 4
+    assert sup._ceiling_origin == 8       # the bucket that failed
+    # two ceiling-filling clean flushes meet the probation; the next
+    # step would reach the origin bucket, so the ceiling is RESTORED
+    for _ in range(2):
+        sup.run("ctx_scan", lambda: "ok", size=4)
+    assert sup.bucket_ceiling is None
+    assert st.res_bucket_repromotions == 1
+    # the restore point rides the ckpt so a --resume (or the next warm
+    # job) keeps it
+    exported = sup.export_state()
+    assert exported["bucket_demoted_from"] == 8
+    sup2, _ = _bisect_supervisor(repromote_after=2)
+    sup2.restore_state(exported)
+    assert sup2._ceiling_origin == 8
+    # fully restored: clean flushes no longer touch counters or warns
+    for _ in range(10):
+        sup.run("ctx_scan", lambda: "ok", size=4)
+    assert st.res_bucket_repromotions == 1
+    assert sup.bucket_ceiling is None
+
+
+def test_repromotion_disabled_at_zero():
+    sup, st = _bisect_supervisor(repromote_after=0)
+    sup.bucket_ceiling = 2
+    for _ in range(20):
+        sup.run("ctx_scan", lambda: "ok", size=2)
+    assert sup.bucket_ceiling == 2
+    assert st.res_bucket_repromotions == 0
+
+
+def test_repromotion_probation_rides_the_checkpoint_state():
+    sup, _ = _bisect_supervisor(repromote_after=5)
+    sup.bucket_ceiling = 2
+    sup._ceiling_clean = 3
+    st = sup.export_state()
+    assert st["bucket_clean_flushes"] == 3
+    sup2, _ = _bisect_supervisor(repromote_after=5)
+    sup2.restore_state(st)
+    assert sup2._ceiling_clean == 3
+    # garbage drops only itself
+    sup2.restore_state({"bucket_clean_flushes": "x"})
+    assert sup2._ceiling_clean == 3
+
+
+def test_oom_cli_run_repromotes_and_stays_byte_identical(tmp_path,
+                                                         monkeypatch):
+    """End to end: an oom=2 run demotes the ceiling, then the stream
+    of clean pre-chunked flushes probation-raises it (the raise
+    re-OOMs once, re-demotes, and the oscillation never changes
+    bytes)."""
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path)
+    rc, _ = _cli(tmp_path, "ref", [], paf, fa)
+    assert rc == 0
+    rc, err = _cli(tmp_path, "repro", ["--inject-faults=oom=2"],
+                   paf, fa)
+    assert rc == 0, err
+    assert _outs(tmp_path, "repro") == _outs(tmp_path, "ref")
+    st = json.loads((tmp_path / "repro.json").read_text())
+    res = st["resilience"]
+    assert res["bucket_repromotions"] >= 1, res
+    assert res["bucket_demotions"] >= 2, res   # demoted, raised, re-
+    #                                            demoted by the probe
+    assert res["breaker_trips"] == 0, res
+    assert st["fallback_batches"] == 0, st
+
+
 def test_bucket_ceiling_rides_the_checkpoint_state():
     sup, _ = _bisect_supervisor()
     sup.bucket_ceiling = 128

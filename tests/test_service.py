@@ -1,0 +1,711 @@
+"""Warm-pool job service (ISSUE 5).
+
+Acceptance contracts:
+
+- **byte parity**: jobs submitted to one warm ``serve`` process
+  produce outputs byte-identical to cold CLI runs of the same argv —
+  the service changes wall time and counters, never bytes (incl. the
+  200-alignment realistic corpus as 3 consecutive jobs);
+- **warm reuse**: jobs after the first pay ZERO backend probes
+  (``backend.probes == 0`` with ``backend.warm_hits > 0`` in their
+  ``--stats``);
+- **shared resilience state**: a flap that opens the global breaker in
+  job N leaves it open for job N+1 (inherited, not re-tripped), and a
+  reclose re-promotes subsequent jobs;
+- **admission control**: a full queue answers ``queue_full`` (the
+  protocol's 429 — back off and retry), a draining service answers
+  ``draining``;
+- **drain**: SIGTERM (or the ``drain`` command) finishes in-flight
+  jobs at batch boundaries with valid resumable checkpoints, marks
+  queued jobs preempted, rejects new submissions, and exits 75;
+- **protocol edges**: malformed JSON frame, oversized frame, cancel of
+  queued vs running jobs, client disconnect mid-result.
+"""
+
+import io
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from contextlib import contextmanager
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from pwasm_tpu.cli import _load_checkpoint, run
+from pwasm_tpu.core.errors import EXIT_PREEMPTED
+from pwasm_tpu.core.fasta import write_fasta
+from pwasm_tpu.resilience.lifecycle import SignalDrain
+from pwasm_tpu.service import protocol
+from pwasm_tpu.service.client import (ServiceClient, ServiceError,
+                                      wait_for_socket)
+from pwasm_tpu.service.daemon import Daemon
+from pwasm_tpu.service.queue import (Draining, Job, JobQueue,
+                                     QueueFull, ServiceStats)
+
+from helpers import make_paf_line
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# a deterministic SLOW job: every supervised device call sleeps 0.25 s
+# (injected hang, deadline-less cap) — bytes unchanged, wall stretched,
+# so cancel/drain/disconnect tests have a live mid-run window to hit
+SLOW = "--inject-faults=seed=1,rate=1,kinds=hang,hang_s=0.25"
+
+
+def _corpus(tmp_path, n=24, qlen=120, seed=3):
+    rng = np.random.default_rng(seed)
+    q = "".join("ACGT"[i] for i in rng.integers(0, 4, qlen))
+    lines = []
+    for i in range(n):
+        cut = 10 + int(rng.integers(0, qlen - 40))
+        qb = q[cut]
+        tb = "ACGT"[("ACGT".index(qb) + 1) % 4]
+        ops = [("=", cut), ("*", tb, qb), ("=", 20), ("ins", "gg"),
+               ("=", qlen - cut - 21)]
+        lines.append(make_paf_line("q", q, f"asm{i}", "+", ops)[0])
+    fa = tmp_path / "q.fa"
+    write_fasta(str(fa), [("q", q.encode())])
+    paf = tmp_path / "in.paf"
+    paf.write_text("".join(ln + "\n" for ln in lines))
+    return str(paf), str(fa)
+
+
+def _job_args(tmp_path, tag, paf, fa, extra=()):
+    return [paf, "-r", fa, "-o", str(tmp_path / f"{tag}.dfa"),
+            "--device=tpu", "--batch=2",
+            f"--stats={tmp_path / f'{tag}.json'}"] + list(extra)
+
+
+def _cold(tmp_path, tag, paf, fa, extra=()):
+    err = io.StringIO()
+    rc = run(_job_args(tmp_path, tag, paf, fa, extra), stderr=err)
+    assert rc == 0, err.getvalue()[:2000]
+    return (tmp_path / f"{tag}.dfa").read_bytes()
+
+
+@contextmanager
+def _daemon(**kw):
+    """An in-process daemon on a short-lived socket (serve() runs on a
+    background thread; SignalDrain.install is a no-op there, so the
+    drain is driven via drain.request / the protocol command — the
+    same flag the main-thread SIGTERM handler pulls)."""
+    sockdir = tempfile.mkdtemp(prefix="pwsvc")
+    sock = os.path.join(sockdir, "s")
+    err = io.StringIO()
+    dm = Daemon(sock, stderr=err, **kw)
+    rcbox: list = []
+    t = threading.Thread(target=lambda: rcbox.append(dm.serve()),
+                         daemon=True)
+    t.start()
+    assert wait_for_socket(sock, 15), err.getvalue()
+    try:
+        yield SimpleNamespace(daemon=dm, sock=sock, rc=rcbox, err=err,
+                              thread=t)
+    finally:
+        if not dm.drain.requested:
+            dm.drain.request("test teardown")
+        t.join(20)
+        shutil.rmtree(sockdir, ignore_errors=True)
+
+
+def _submit_and_wait(sock, argv, timeout=120):
+    with ServiceClient(sock) as c:
+        sub = c.submit(argv)
+        assert sub.get("ok"), sub
+        return c.result(sub["job_id"], timeout=timeout)
+
+
+def _wait_mid_run(client, job_id, ckpt_path, budget_s=60):
+    """Block until ``job_id`` is demonstrably MID-RUN: running, with at
+    least one durable batch checkpoint on disk — the earliest instant
+    a cancel/drain/SIGTERM can prove the 'valid resumable ckpt'
+    contract rather than racing the job's warmup."""
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        state = client.status(job_id)["job"]["state"]
+        if state == "running" and os.path.exists(ckpt_path):
+            return True
+        if state not in ("queued", "running"):
+            return False       # already terminal: the caller decides
+        time.sleep(0.02)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# queue + protocol units
+# ---------------------------------------------------------------------------
+def test_job_queue_admission_and_drain_unit():
+    q = JobQueue(max_queue=2)
+    j1, j2, j3 = (Job(id=f"j{i}", argv=["x"]) for i in (1, 2, 3))
+    assert q.submit(j1) == 0
+    assert q.submit(j2) == 1
+    with pytest.raises(QueueFull):
+        q.submit(j3)
+    assert q.depth() == 2
+    assert q.take(0.01) is j1          # FIFO
+    assert q.remove(j2) and not q.remove(j2)
+    assert q.submit(j3) == 0
+    waiting = q.drain()
+    assert waiting == [j3]
+    assert q.draining and q.depth() == 0
+    with pytest.raises(Draining):
+        q.submit(Job(id="j4", argv=["x"]))
+    assert q.take(0.01) is None
+
+
+def test_protocol_frames_roundtrip_and_errors():
+    buf = io.BytesIO()
+    protocol.write_frame(buf, {"cmd": "ping", "n": 1})
+    buf.seek(0)
+    assert protocol.read_frame(buf) == {"cmd": "ping", "n": 1}
+    assert protocol.read_frame(buf) is None          # clean EOF
+    with pytest.raises(protocol.FrameError) as e:
+        protocol.read_frame(io.BytesIO(b"not json\n"))
+    assert e.value.code == protocol.ERR_BAD_JSON
+    assert not e.value.fatal                         # conn survives
+    with pytest.raises(protocol.FrameError) as e:
+        protocol.read_frame(io.BytesIO(b"[1,2]\n"))
+    assert e.value.code == protocol.ERR_BAD_JSON
+    big = b"{" + b" " * 64 + b"}\n"
+    with pytest.raises(protocol.FrameError) as e:
+        protocol.read_frame(io.BytesIO(big), max_bytes=32)
+    assert e.value.code == protocol.ERR_FRAME_TOO_LARGE
+    assert e.value.fatal                             # stream unsynced
+    with pytest.raises(protocol.FrameError):
+        protocol.read_frame(io.BytesIO(b'{"x":1}'))  # truncated at EOF
+
+
+def test_service_stats_rollup_skips_versions_and_bools():
+    st = ServiceStats()
+    st.rollup_job({"stats_version": 1, "alignments": 3,
+                   "preempted": True,
+                   "backend": {"probes": 1, "warm_hits": 0}})
+    st.rollup_job({"stats_version": 1, "alignments": 2,
+                   "preempted": False,
+                   "backend": {"probes": 0, "warm_hits": 1}})
+    d = st.as_dict()
+    assert d["stats_version"] == 1
+    assert d["rollup"]["alignments"] == 5
+    assert "stats_version" not in d["rollup"]
+    assert "preempted" not in d["rollup"]
+    assert d["warm"] == {"backend_probes": 1, "backend_warm_hits": 1}
+
+
+def test_cross_thread_drain_request_only_flags():
+    """A drain requested from ANOTHER thread while an interruptible
+    phase is armed must only set the flag — raising PreemptedError in
+    the requester (the daemon thread) would kill the service instead
+    of the job."""
+    drain = SignalDrain(stderr=io.StringIO(), hard_exit=lambda c: None)
+    raised: list = []
+
+    def other():
+        try:
+            drain.request("from the daemon thread")
+        except BaseException as e:   # pragma: no cover - the bug
+            raised.append(e)
+
+    with drain.interrupting():
+        t = threading.Thread(target=other)
+        t.start()
+        t.join(5)
+    assert not raised
+    assert drain.requested
+
+
+# ---------------------------------------------------------------------------
+# protocol edges against a live daemon
+# ---------------------------------------------------------------------------
+def _raw_conn(sock_path):
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(10)
+    s.connect(sock_path)
+    return s
+
+
+def test_malformed_json_frame_answers_and_connection_survives():
+    with _daemon(max_queue=2) as h:
+        s = _raw_conn(h.sock)
+        try:
+            s.sendall(b"this is not json\n")
+            f = s.makefile("rb")
+            resp = json.loads(f.readline())
+            assert resp["ok"] is False
+            assert resp["error"] == protocol.ERR_BAD_JSON
+            # the SAME connection keeps working: the next line is a
+            # fresh frame
+            s.sendall(b'{"cmd":"ping"}\n')
+            resp = json.loads(f.readline())
+            assert resp["ok"] is True
+        finally:
+            s.close()
+
+
+def test_oversized_frame_rejected_and_connection_closed():
+    with _daemon(max_queue=2, max_frame_bytes=1024) as h:
+        s = _raw_conn(h.sock)
+        try:
+            s.sendall(b'{"pad":"' + b"x" * 4096 + b'"}\n')
+            f = s.makefile("rb")
+            resp = json.loads(f.readline())
+            assert resp["ok"] is False
+            assert resp["error"] == protocol.ERR_FRAME_TOO_LARGE
+            # oversized = unsynced stream: the daemon closes the
+            # connection after answering
+            assert f.readline() == b""
+        finally:
+            s.close()
+        # ...but the SERVICE is fine: a fresh connection works
+        with ServiceClient(h.sock) as c:
+            assert c.ping().get("ok")
+
+
+def test_unknown_cmd_unknown_job_bad_request():
+    with _daemon(max_queue=2) as h:
+        with ServiceClient(h.sock) as c:
+            r = c.request({"cmd": "frobnicate"})
+            assert r["error"] == protocol.ERR_UNKNOWN_CMD
+            r = c.status("job-9999")
+            assert r["error"] == protocol.ERR_UNKNOWN_JOB
+            r = c.request({"cmd": "submit", "args": "not-a-list"})
+            assert r["error"] == protocol.ERR_BAD_REQUEST
+            r = c.request({"cmd": "submit", "args": []})
+            assert r["error"] == protocol.ERR_BAD_REQUEST
+            # jobs must write to files: the socket carries control,
+            # not report bytes
+            r = c.submit(["in.paf", "-r", "q.fa"])
+            assert r["error"] == protocol.ERR_BAD_REQUEST
+            assert "-o" in r["detail"]
+            # nested service commands are refused
+            r = c.submit(["serve", "--socket=/x", "-o", "r"])
+            assert r["error"] == protocol.ERR_BAD_REQUEST
+
+
+# ---------------------------------------------------------------------------
+# the warm-pool promise: parity + probe reuse + shared breaker
+# ---------------------------------------------------------------------------
+def test_warm_jobs_byte_identical_and_probe_free(tmp_path):
+    paf, fa = _corpus(tmp_path)
+    cold = _cold(tmp_path, "cold", paf, fa)
+    with _daemon(max_queue=4) as h:
+        for j in (1, 2, 3):
+            res = _submit_and_wait(
+                h.sock, _job_args(tmp_path, f"warm{j}", paf, fa))
+            assert res.get("ok") and res["rc"] == 0, res
+            assert (tmp_path / f"warm{j}.dfa").read_bytes() == cold
+            bk = json.loads(
+                (tmp_path / f"warm{j}.json").read_text())["backend"]
+            if j > 1:
+                # the warm-pool reuse gate: no additional backend
+                # probe after the first job initialized the process
+                assert bk["probes"] == 0, bk
+                assert bk["warm_hits"] > 0, bk
+        with ServiceClient(h.sock) as c:
+            st = c.stats()["stats"]
+        assert st["jobs"]["accepted"] == 3
+        assert st["jobs"]["completed"] == 3
+        assert st["rollup"]["alignments"] == 72
+        assert st["warm"]["backend_warm_hits"] >= 2
+
+
+def test_relative_paths_resolve_against_client_cwd(tmp_path):
+    """The cold-to-warm drop-in contract for relative paths: a cold
+    run resolves them against the CALLER's cwd, so a served job must
+    too (the client sends its cwd; the daemon rewrites the argv with
+    the CLI's own flag grammar — clustered short flags included)."""
+    paf, fa = _corpus(tmp_path, n=6)
+    cold = _cold(tmp_path, "cold", paf, fa)
+    with _daemon(max_queue=4) as h:
+        with ServiceClient(h.sock) as c:
+            sub = c.submit(["in.paf", "-r", "q.fa", "-Do", "rel.dfa",
+                            "--stats=rel.json", "--device=tpu",
+                            "--batch=2"], cwd=str(tmp_path))
+            assert sub.get("ok"), sub
+            res = c.result(sub["job_id"], timeout=120)
+            assert res.get("ok") and res["rc"] == 0, res
+        assert (tmp_path / "rel.dfa").read_bytes() == cold
+        assert (tmp_path / "rel.json").exists()
+        # a non-absolute client cwd is a bad request, never a guess
+        with ServiceClient(h.sock) as c:
+            r = c.request({"cmd": "submit",
+                           "args": ["in.paf", "-r", "q.fa", "-o",
+                                    "x.dfa"],
+                           "cwd": "relative/dir"})
+            assert r["error"] == protocol.ERR_BAD_REQUEST
+
+
+def test_two_concurrent_submitters_byte_identical(tmp_path):
+    paf, fa = _corpus(tmp_path)
+    cold = _cold(tmp_path, "cold", paf, fa)
+    results: dict = {}
+
+    def submitter(tag, sock):
+        results[tag] = _submit_and_wait(
+            sock, _job_args(tmp_path, tag, paf, fa))
+
+    with _daemon(max_queue=4) as h:
+        ts = [threading.Thread(target=submitter, args=(t, h.sock))
+              for t in ("ca", "cb")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(120)
+    for tag in ("ca", "cb"):
+        assert results[tag].get("ok") and results[tag]["rc"] == 0, \
+            results[tag]
+        assert (tmp_path / f"{tag}.dfa").read_bytes() == cold
+
+
+def test_breaker_state_inherited_across_jobs(tmp_path, monkeypatch):
+    """The shared-resilience contract: job 1's scripted outage opens
+    the global breaker and the warm process carries it — job 2 starts
+    degraded WITHOUT re-tripping (breaker_trips == 0), and job 3 under
+    --recover=auto recloses and re-promotes.  All three byte-identical
+    to the cold run."""
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path)
+    cold = _cold(tmp_path, "cold", paf, fa)
+    with _daemon(max_queue=4) as h:
+        r1 = _submit_and_wait(h.sock, _job_args(
+            tmp_path, "flap", paf, fa,
+            ["--inject-faults=down=1-999", "--max-retries=0",
+             "--recover=off"]))
+        assert r1["rc"] == 0, r1
+        st1 = json.loads(
+            (tmp_path / "flap.json").read_text())["resilience"]
+        assert st1["breaker_trips"] == 1, st1
+        assert st1["degraded_batches"] > 0, st1
+
+        r2 = _submit_and_wait(h.sock, _job_args(
+            tmp_path, "inherit", paf, fa, ["--recover=off"]))
+        assert r2["rc"] == 0, r2
+        st2 = json.loads(
+            (tmp_path / "inherit.json").read_text())["resilience"]
+        # inherited open breaker: degraded from batch 1, NO new trip
+        assert st2["breaker_trips"] == 0, st2
+        assert st2["degraded_batches"] > 0, st2
+
+        r3 = _submit_and_wait(h.sock, _job_args(
+            tmp_path, "heal", paf, fa,
+            ["--recover=auto", "--reprobe-interval=0"]))
+        assert r3["rc"] == 0, r3
+        st3 = json.loads(
+            (tmp_path / "heal.json").read_text())["resilience"]
+        # the reclose re-promotes this and every later job
+        assert st3["breaker_recloses"] == 1, st3
+        assert st3["recovered_batches"] > 0, st3
+    for tag in ("flap", "inherit", "heal"):
+        assert (tmp_path / f"{tag}.dfa").read_bytes() == cold, tag
+
+
+def test_service_realistic_three_jobs_parity(tmp_path):
+    """The acceptance gate in-process: the 200-alignment realistic
+    corpus as 3 consecutive jobs through one warm daemon — every
+    output byte-identical to the cold run, jobs 2..3 probe-free."""
+    from test_realistic_scale import make_corpus
+    qseq, lines = make_corpus()
+    fa = tmp_path / "cds.fa"
+    fa.write_text(f">cds1\n{qseq}\n")
+    paf = tmp_path / "in.paf"
+    paf.write_text("".join(ln + "\n" for ln in lines))
+
+    def args(tag):
+        return [str(paf), "-r", str(fa),
+                "-o", str(tmp_path / f"{tag}.dfa"),
+                "-s", str(tmp_path / f"{tag}.sum"),
+                "-w", str(tmp_path / f"{tag}.mfa"),
+                f"--cons={tmp_path / f'{tag}.cons'}", "--device=tpu",
+                f"--stats={tmp_path / f'{tag}.json'}"]
+
+    def outs(tag):
+        return tuple((tmp_path / f"{tag}.{k}").read_bytes()
+                     for k in ("dfa", "sum", "mfa", "cons"))
+
+    err = io.StringIO()
+    assert run(args("cold"), stderr=err) == 0, err.getvalue()[:2000]
+    with _daemon(max_queue=4) as h:
+        for j in (1, 2, 3):
+            res = _submit_and_wait(h.sock, args(f"sv{j}"),
+                                   timeout=600)
+            assert res.get("ok") and res["rc"] == 0, res
+            assert outs(f"sv{j}") == outs("cold"), j
+            bk = json.loads(
+                (tmp_path / f"sv{j}.json").read_text())["backend"]
+            if j > 1:
+                assert bk["probes"] == 0, (j, bk)
+                assert bk["warm_hits"] > 0, (j, bk)
+
+
+# ---------------------------------------------------------------------------
+# admission control + cancel + drain
+# ---------------------------------------------------------------------------
+def test_queue_full_rejection_is_429_shaped(tmp_path):
+    paf, fa = _corpus(tmp_path, n=16)
+    with _daemon(max_queue=1, max_concurrent=1) as h:
+        with ServiceClient(h.sock) as c:
+            # a slow job occupies the worker; the queue holds ONE more
+            s1 = c.submit(_job_args(tmp_path, "s1", paf, fa, [SLOW]))
+            assert s1.get("ok"), s1
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                # wait for the worker to pick s1 up, so the queue slot
+                # below is deterministically the ONLY one
+                if c.status(s1["job_id"])["job"]["state"] == "running":
+                    break
+                time.sleep(0.02)
+            s2 = c.submit(_job_args(tmp_path, "s2", paf, fa))
+            assert s2.get("ok"), s2
+            rej = c.submit(_job_args(tmp_path, "s3", paf, fa))
+            assert rej["ok"] is False
+            assert rej["error"] == protocol.ERR_QUEUE_FULL
+            assert rej["retry_after_s"] > 0
+            assert rej["max_queue"] == 1
+            # back off, retry once capacity frees: both queued jobs
+            # complete and the retry is accepted
+            r1 = c.result(s1["job_id"], timeout=120)
+            assert r1["rc"] == 0, r1
+            s3 = c.submit(_job_args(tmp_path, "s3", paf, fa))
+            assert s3.get("ok"), s3
+            assert c.result(s3["job_id"], timeout=120)["rc"] == 0
+        with ServiceClient(h.sock) as c:
+            st = c.stats()["stats"]
+        assert st["jobs"]["rejected"] == 1
+
+
+def test_cancel_queued_vs_running(tmp_path):
+    paf, fa = _corpus(tmp_path)
+    cold = _cold(tmp_path, "cold", paf, fa)
+    with _daemon(max_queue=4, max_concurrent=1) as h:
+        with ServiceClient(h.sock) as c:
+            slow = c.submit(_job_args(tmp_path, "run", paf, fa,
+                                      [SLOW]))
+            queued = c.submit(_job_args(tmp_path, "qd", paf, fa))
+            # wait for the slow job to be mid-run (first batch ckpt
+            # durable) so the running-cancel exercises a real drain
+            assert _wait_mid_run(c, slow["job_id"],
+                                 str(tmp_path / "run.dfa.ckpt"))
+            # cancel the QUEUED job: removed immediately, never runs
+            r = c.cancel(queued["job_id"])
+            assert r["ok"] and r["was"] == "queued"
+            assert c.status(queued["job_id"])["job"]["state"] \
+                == "cancelled"
+            assert not (tmp_path / "qd.dfa").exists()
+            # cancel the RUNNING job: a graceful per-job drain — it
+            # stops at the next batch boundary with rc 75 and a valid
+            # resumable checkpoint
+            r = c.cancel(slow["job_id"])
+            assert r["ok"] and r["was"] == "running"
+            res = c.result(slow["job_id"], timeout=120)
+            assert res["job"]["state"] == "cancelled", res
+            assert res["rc"] == EXIT_PREEMPTED
+        got = _load_checkpoint(str(tmp_path / "run.dfa"))
+        assert isinstance(got, tuple), got
+        # the cancelled job is RESUMABLE: a cold --resume completes it
+        # byte-identically
+        err = io.StringIO()
+        rc = run(_job_args(tmp_path, "run", paf, fa, ["--resume"]),
+                 stderr=err)
+        assert rc == 0, err.getvalue()[:2000]
+        assert (tmp_path / "run.dfa").read_bytes() == cold
+
+
+def test_drain_finishes_inflight_rejects_new_exits_75(tmp_path):
+    """The drain contract end-to-end (protocol-command flavor; the
+    SIGTERM flavor is the subprocess test below): the in-flight job
+    finishes at a batch boundary with a valid ckpt and rc 75, the
+    queued job is preempted without starting, a submit during the
+    drain answers ``draining``, and the daemon exits 75."""
+    paf, fa = _corpus(tmp_path)
+    cold = _cold(tmp_path, "cold", paf, fa)
+    with _daemon(max_queue=4, max_concurrent=1) as h:
+        with ServiceClient(h.sock) as c:
+            slow = c.submit(_job_args(tmp_path, "infl", paf, fa,
+                                      [SLOW]))
+            queued = c.submit(_job_args(tmp_path, "quep", paf, fa))
+            assert _wait_mid_run(c, slow["job_id"],
+                                 str(tmp_path / "infl.dfa.ckpt"))
+            d = c.drain()
+            assert d["ok"] and d["draining"]
+            assert queued["job_id"] in d["preempted_queued"]
+            # submit DURING the drain: rejected with the draining code
+            rej = c.submit(_job_args(tmp_path, "late", paf, fa))
+            assert rej["ok"] is False
+            assert rej["error"] == protocol.ERR_DRAINING
+            res = c.result(slow["job_id"], timeout=120)
+            assert res["job"]["state"] == "preempted", res
+            assert res["rc"] == EXIT_PREEMPTED
+            qres = c.result(queued["job_id"], timeout=30)
+            assert qres["job"]["state"] == "preempted"
+            assert "resum" in qres["job"]["detail"]
+        h.thread.join(30)
+        assert h.rc == [EXIT_PREEMPTED], h.err.getvalue()[-2000:]
+    # the in-flight job drained onto a valid, resumable checkpoint
+    got = _load_checkpoint(str(tmp_path / "infl.dfa"))
+    assert isinstance(got, tuple), got
+    err = io.StringIO()
+    rc = run(_job_args(tmp_path, "infl", paf, fa, ["--resume"]),
+             stderr=err)
+    assert rc == 0, err.getvalue()[:2000]
+    assert (tmp_path / "infl.dfa").read_bytes() == cold
+
+
+def test_client_disconnect_mid_result_never_kills_daemon(tmp_path):
+    paf, fa = _corpus(tmp_path)
+    cold = _cold(tmp_path, "cold", paf, fa)
+    with _daemon(max_queue=4) as h:
+        s = _raw_conn(h.sock)
+        f = s.makefile("rb")
+        protocol.write_frame(
+            s.makefile("wb"),
+            {"cmd": "submit",
+             "args": _job_args(tmp_path, "dj", paf, fa, [SLOW])})
+        sub = json.loads(f.readline())
+        assert sub["ok"], sub
+        # ask for the (blocking) result, then vanish mid-wait: the
+        # daemon's response hits a dead socket — its problem must end
+        # at that connection
+        protocol.write_frame(s.makefile("wb"),
+                             {"cmd": "result",
+                              "job_id": sub["job_id"]})
+        s.close()
+        # the job keeps running and a FRESH connection collects it
+        with ServiceClient(h.sock) as c:
+            res = c.result(sub["job_id"], timeout=120)
+        assert res.get("ok") and res["rc"] == 0, res
+        assert (tmp_path / "dj.dfa").read_bytes() == cold
+        with ServiceClient(h.sock) as c:
+            assert c.ping().get("ok")
+
+
+def test_failed_job_is_contained(tmp_path):
+    """A job whose argv is garbage fails — the daemon survives and
+    says why."""
+    paf, fa = _corpus(tmp_path, n=4)
+    with _daemon(max_queue=4) as h:
+        res = _submit_and_wait(
+            h.sock, ["/nonexistent.paf", "-r", fa, "-o",
+                     str(tmp_path / "x.dfa")])
+        assert res["job"]["state"] == "failed", res
+        assert res["rc"] not in (0, None)
+        assert "Cannot open input file" in res["stderr_tail"]
+        # a scripted kill (BaseException) is contained at the job
+        # boundary too: the job fails, the daemon lives
+        res = _submit_and_wait(
+            h.sock, _job_args(tmp_path, "kill", paf, fa,
+                              ["--inject-faults=kill=1"]))
+        assert res["job"]["state"] == "failed", res
+        assert "InjectedKill" in res["job"]["detail"]
+        # and the next job is fine
+        res = _submit_and_wait(h.sock,
+                               _job_args(tmp_path, "ok", paf, fa))
+        assert res["rc"] == 0, res
+
+
+# ---------------------------------------------------------------------------
+# subprocess: the real `pwasm-tpu serve` + SIGTERM drill
+# ---------------------------------------------------------------------------
+def _serve_env():
+    old_pp = os.environ.get("PYTHONPATH", "")
+    return dict(os.environ, JAX_PLATFORMS="cpu",
+                PWASM_DEVICE_PROBE="0",
+                PYTHONPATH=REPO + (os.pathsep + old_pp if old_pp
+                                   else ""))
+
+
+def test_serve_subprocess_sigterm_drains_exit75_resumable(tmp_path):
+    """The acceptance drill with a REAL signal: SIGTERM to a live
+    `pwasm-tpu serve` process mid-job → daemon exits 75, the in-flight
+    job's checkpoint verifies, and a cold ``--resume`` completes it
+    byte-identically.  Timing-tolerant: the job is slowed by injected
+    hangs and the signal is sent only once the job reports running."""
+    paf, fa = _corpus(tmp_path)
+    cold = _cold(tmp_path, "cold", paf, fa)
+    sockdir = tempfile.mkdtemp(prefix="pwsvc")
+    sock = os.path.join(sockdir, "s")
+    sp = subprocess.Popen(
+        [sys.executable, "-m", "pwasm_tpu.cli", "serve",
+         f"--socket={sock}"],
+        env=_serve_env(), stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE, text=True)
+    try:
+        assert wait_for_socket(sock, 60)
+        with ServiceClient(sock) as c:
+            sub = c.submit(_job_args(tmp_path, "sig", paf, fa,
+                                     [SLOW]))
+            assert sub.get("ok"), sub
+            caught_mid_run = _wait_mid_run(
+                c, sub["job_id"], str(tmp_path / "sig.dfa.ckpt"))
+        sp.send_signal(signal.SIGTERM)
+        rc = sp.wait(timeout=120)
+        _, stderr_tail = "", sp.stderr.read()[-3000:]
+        assert rc == EXIT_PREEMPTED, (rc, stderr_tail)
+        if caught_mid_run:
+            # the in-flight job's final checkpoint must verify whole
+            got = _load_checkpoint(str(tmp_path / "sig.dfa"))
+            if os.path.exists(tmp_path / "sig.dfa.ckpt"):
+                assert isinstance(got, tuple), got
+        # resumable either way: a cold --resume completes the report
+        # byte-identically (via the ckpt when one survived, via the
+        # header scan when the drain landed before/after every batch)
+        err = io.StringIO()
+        rc = run(_job_args(tmp_path, "sig", paf, fa, ["--resume"]),
+                 stderr=err)
+        assert rc == 0, err.getvalue()[:2000]
+        assert (tmp_path / "sig.dfa").read_bytes() == cold
+    finally:
+        if sp.poll() is None:
+            sp.kill()
+            sp.wait()
+        sp.stderr.close()
+        shutil.rmtree(sockdir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# CLI surface: subcommand dispatch + client mains
+# ---------------------------------------------------------------------------
+def test_cli_dispatch_usage_errors():
+    err = io.StringIO()
+    assert run(["serve"], stderr=err) == 1
+    assert "--socket" in err.getvalue()
+    err = io.StringIO()
+    assert run(["serve", "--socket=/x", "--max-queue=frog"],
+               stderr=err) == 1
+    err = io.StringIO()
+    assert run(["submit"], stderr=err) == 1
+    assert "--socket" in err.getvalue()
+    err = io.StringIO()
+    assert run(["svc-stats"], stderr=err) == 1
+    err = io.StringIO()
+    assert run(["submit", "--socket=/nonexistent.sock", "--", "x",
+                "-o", "y"], stderr=err) == 1
+    assert "cannot connect" in err.getvalue()
+
+
+def test_submit_and_svc_stats_client_mains(tmp_path):
+    paf, fa = _corpus(tmp_path, n=8)
+    cold = _cold(tmp_path, "cold", paf, fa)
+    with _daemon(max_queue=4) as h:
+        out = io.StringIO()
+        err = io.StringIO()
+        rc = run(["submit", f"--socket={h.sock}", "--"]
+                 + _job_args(tmp_path, "cm", paf, fa),
+                 stdout=out, stderr=err)
+        assert rc == 0, err.getvalue()
+        line = json.loads(out.getvalue())
+        assert line["state"] == "done" and line["rc"] == 0
+        assert (tmp_path / "cm.dfa").read_bytes() == cold
+        out = io.StringIO()
+        rc = run(["svc-stats", f"--socket={h.sock}"], stdout=out,
+                 stderr=io.StringIO())
+        assert rc == 0
+        st = json.loads(out.getvalue())
+        assert st["stats_version"] == 1
+        assert st["jobs"]["completed"] == 1

@@ -31,6 +31,30 @@ def test_registry_has_no_stale_entries(checker):
     assert stale == [], stale
 
 
+def test_service_modules_stay_jax_free(checker):
+    """ISSUE 5 satellite: the warm-pool service layer reaches the
+    device ONLY through cli.run's supervised sites — no direct jax
+    use (not even an import) in pwasm_tpu/service/."""
+    bad = checker.find_service_violations()
+    assert bad == [], "\n".join(bad)
+
+
+def test_service_rule_detects_direct_jax(checker, tmp_path):
+    svc = tmp_path / "pwasm_tpu" / "service"
+    svc.mkdir(parents=True)
+    (svc / "rogue.py").write_text(
+        "import jax\n"
+        "from pwasm_tpu import cli\n"     # not a hit
+        "# import jax in a comment is NOT a hit\n"
+        "y = jax.device_get(1)\n")
+    bad = checker.find_service_violations(str(tmp_path))
+    assert len(bad) == 2, bad
+    assert all("rogue.py" in b for b in bad)
+    # a tree without a service dir is trivially clean
+    assert checker.find_service_violations(str(tmp_path / "empty")) \
+        == []
+
+
 def test_checker_detects_patterns(checker, tmp_path):
     # the check must actually SEE a violation, or a pattern regression
     # (e.g. jax API rename) would silently pass forever
