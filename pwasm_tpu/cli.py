@@ -64,6 +64,18 @@ USAGE = """Usage:
                resumable"; a second signal hard-aborts)
    --profile=DIR  write a jax.profiler device trace for the run
    --stats=FILE   write run statistics as one JSON object
+   --trace-json=FILE  write host-side phase/batch spans (monotonic
+               clock) as Chrome trace-event JSON — viewable in
+               chrome://tracing / Perfetto alongside the --profile
+               device dump (docs/OBSERVABILITY.md)
+   --log-json=FILE|-  append structured NDJSON run-lifecycle events
+               (breaker trips/recloses, OOM demotions, fallbacks,
+               checkpoint writes, drains) with wall+monotonic
+               timestamps and a run id; "-" streams to stdout
+               (requires -o so events never share the report stream)
+   --metrics-textfile=PATH  write the run's metrics as Prometheus
+               text exposition at end of run (atomic publish) for a
+               node-exporter textfile collector
    --max-retries=N    re-execute a failed/rejected device batch up to
                N times (exponential backoff + jitter; default 2)
    --device-deadline=S  per-batch device deadline in seconds — a hung
@@ -98,6 +110,7 @@ USAGE = """Usage:
    pwasm-tpu serve --socket=PATH [--max-queue=N] [--max-concurrent=N]
    pwasm-tpu submit --socket=PATH [--no-wait] [--] <cli args...>
    pwasm-tpu svc-stats --socket=PATH [--drain]
+   pwasm-tpu metrics --socket=PATH   (Prometheus text exposition)
 """
 
 # reference optstring: "DGFCNvd:p:r:o:m:w:c:s:" — -d/-p/-m take a value but
@@ -109,7 +122,7 @@ _VALUE_FLAGS = set("dprmowcs")
 # `pwasm-tpu serve` starts the resident daemon, `submit`/`svc-stats`
 # are the client side — dispatched on the FIRST argv token so the
 # classic flag grammar stays untouched for plain runs
-_SERVICE_CMDS = ("serve", "submit", "svc-stats")
+_SERVICE_CMDS = ("serve", "submit", "svc-stats", "metrics")
 
 
 class CliError(PwasmError):
@@ -423,6 +436,7 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None) -> int:
 
     infile = positional[0] if positional else None
     inf = sys.stdin
+    obs = None          # the observability bundle (closed on unwind)
     opened: list = []   # output handles closed on ANY unwind: a killed
     # run must not leave a buffered handle whose late GC flush could
     # write stale bytes past a checkpoint-truncated report
@@ -509,7 +523,8 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None) -> int:
             except ValueError as e:
                 raise CliError(f"{USAGE}\nInvalid --inject-faults: "
                                f"{e}\n")
-        for kind in ("profile", "stats"):
+        for kind in ("profile", "stats", "trace-json", "log-json",
+                     "metrics-textfile"):
             if opts.get(kind) is True:
                 raise CliError(
                     f"{USAGE}\n--{kind} requires a file argument\n")
@@ -517,6 +532,15 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None) -> int:
             cfg.profile_dir = str(opts["profile"])
         if "stats" in opts:
             cfg.stats_path = str(opts["stats"])
+        cfg.trace_json = str(opts.get("trace-json", ""))
+        cfg.log_json = str(opts.get("log-json", ""))
+        cfg.metrics_textfile = str(opts.get("metrics-textfile", ""))
+        if cfg.log_json == "-" and "o" not in opts:
+            # without -o the report itself streams to stdout — event
+            # lines interleaved with report rows would corrupt both
+            raise CliError(
+                f"{USAGE}\n--log-json=- requires -o <report> (stdout "
+                "already carries the report)\n")
         resume_skip = 0
         resume_state: dict | None = None
         ckpt_quarantined = False
@@ -661,8 +685,23 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None) -> int:
                 f"Cannot open file {opts['s']} for writing!\n")
         summary = Summary() if fsummary else None
 
+        from pwasm_tpu.obs import make_observability
         from pwasm_tpu.resilience.lifecycle import SignalDrain
         from pwasm_tpu.utils import device_trace
+        # --trace-json / --log-json / --metrics-textfile: the jax-free
+        # observability bundle (pwasm_tpu.obs).  Strictly additive: it
+        # writes only to its own sinks, never the report stream — the
+        # byte-parity test (flags on vs off) holds by construction.
+        try:
+            obs = make_observability(cfg.trace_json or None,
+                                     cfg.log_json or None,
+                                     cfg.metrics_textfile or None,
+                                     stdout=stdout)
+        except OSError:
+            raise PwasmError(
+                f"Cannot open file {cfg.log_json} for writing!\n")
+        if obs.enabled:
+            obs.event("run_start", device=cfg.device, argv=list(argv))
         # graceful drain (SURVEY.md §5 / docs/RESILIENCE.md): the first
         # SIGTERM/SIGINT only raises a flag the batch loop honors at
         # the next batch boundary — in-flight work completes, a final
@@ -673,16 +712,35 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None) -> int:
         # flags — install() is a no-op off the main thread anyway).
         drain_cm = warm.drain if warm is not None \
             and warm.drain is not None else SignalDrain(stderr=stderr)
+        if obs.enabled:
+            drain_cm.obs = obs   # the drain request itself is a
+            #                      lifecycle event worth logging
         with device_trace(cfg.profile_dir, stderr), drain_cm as drain:
-            return _main_loop(cfg, inf, freport, fmsa, fsummary, summary,
-                              qfasta, stdout, stderr, cons_outs,
-                              resume_skip=resume_skip,
-                              resume_state=resume_state, drain=drain,
-                              warm=warm)
+            with obs.span("run", device=cfg.device):
+                return _main_loop(cfg, inf, freport, fmsa, fsummary,
+                                  summary, qfasta, stdout, stderr,
+                                  cons_outs, resume_skip=resume_skip,
+                                  resume_state=resume_state,
+                                  drain=drain, warm=warm, obs=obs)
     except PwasmError as e:
         stderr.write(str(e))
+        if obs is not None and obs.enabled:
+            # failed runs terminate their timeline too — an operator
+            # joining on run_finish must not see a crashed run as
+            # still-running forever
+            obs.event("run_finish", rc=e.exit_code,
+                      error=str(e).strip()[:200])
         return e.exit_code
     finally:
+        if obs is not None and obs.enabled:
+            # a job's drain outlives the run inside a warm daemon:
+            # un-bind the (about-to-close) event log first
+            from pwasm_tpu.obs import NULL_OBS
+            try:
+                drain_cm.obs = NULL_OBS
+            except NameError:
+                pass
+            obs.close(stderr)
         if inf is not sys.stdin:
             inf.close()
         for fo in opened:
@@ -787,12 +845,14 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                cons_outs: dict | None = None,
                resume_skip: int = 0,
                resume_state: dict | None = None, drain=None,
-               warm=None) -> int:
+               warm=None, obs=None) -> int:
     """The per-PAF-line loop (pafreport.cpp:296-460)."""
     from pwasm_tpu.align.gapseq import FLAG_IS_REF, GapSeq
     from pwasm_tpu.align.msa import Msa
+    from pwasm_tpu.obs import NULL_OBS
     from pwasm_tpu.utils import RunStats
 
+    obs = obs if obs is not None else NULL_OBS
     stats = RunStats()
 
     # one supervisor per run: every device round-trip (report batches,
@@ -823,19 +883,21 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
             # life: job N+1 inherits job N's probe schedule and
             # open/half-open/closed state, re-bound to this job's
             # stats sink (the first job's --reprobe-* knobs win)
-            monitor = warm.monitor.attach(stats=stats, stderr=stderr)
+            monitor = warm.monitor.attach(stats=stats, stderr=stderr,
+                                          obs=obs)
         else:
             monitor = BackendHealthMonitor(
                 interval_s=cfg.reprobe_interval,
                 max_interval_s=cfg.reprobe_max, stats=stats,
-                stderr=stderr)
+                stderr=stderr, obs=obs)
             if warm is not None:
                 warm.monitor = monitor
     supervisor = BatchSupervisor(
         ResiliencePolicy(max_retries=cfg.max_retries,
                          deadline_s=cfg.device_deadline or None,
                          fallback=cfg.fallback),
-        stats=stats, stderr=stderr, faults=fault_plan, monitor=monitor)
+        stats=stats, stderr=stderr, faults=fault_plan, monitor=monitor,
+        obs=obs)
     if warm is not None and warm.supervisor_state:
         # a warm serve process: inherit the previous job's breaker /
         # site-trip / bucket-ceiling end state — a flap that opened
@@ -962,6 +1024,8 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
             if _write_checkpoint(freport, report_path, emitted[0],
                                  supervisor.export_state()):
                 stats.res_checkpoints += 1
+                obs.event("ckpt_write", records=emitted[0],
+                          batch=nrecords)
 
     def msa_add(aln, tlabel: str, refseq_b: bytes, ord_num: int,
                 realigned: bool = False) -> None:
@@ -1091,37 +1155,42 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         if not use_device:
             if batch:
                 import os as _os
-                if _os.environ.get("PWASM_HOST_COLUMNAR", "1") == "0":
-                    # scalar per-alignment loop (the ground-truth
-                    # engine): the columnar path's escape hatch, and
-                    # the bench's same-process A/B reference
-                    from pwasm_tpu.report.diff_report import \
-                        print_diff_info
-                    for aln, rlabel, tlabel, refseq in batch:
-                        print_diff_info(
-                            aln, rlabel, tlabel, freport, refseq,
-                            skip_codan=cfg.skip_codan,
-                            motifs=cfg.motifs, summary=summary)
-                else:
-                    from pwasm_tpu.report.columnar import \
-                        print_diff_info_batch_host
-                    print_diff_info_batch_host(
-                        batch, freport, skip_codan=cfg.skip_codan,
-                        motifs=cfg.motifs, summary=summary,
-                        stats=stats)
+                with obs.span("flush_host", n=len(batch)):
+                    if _os.environ.get("PWASM_HOST_COLUMNAR", "1") \
+                            == "0":
+                        # scalar per-alignment loop (the ground-truth
+                        # engine): the columnar path's escape hatch, and
+                        # the bench's same-process A/B reference
+                        from pwasm_tpu.report.diff_report import \
+                            print_diff_info
+                        for aln, rlabel, tlabel, refseq in batch:
+                            print_diff_info(
+                                aln, rlabel, tlabel, freport, refseq,
+                                skip_codan=cfg.skip_codan,
+                                motifs=cfg.motifs, summary=summary)
+                    else:
+                        from pwasm_tpu.report.columnar import \
+                            print_diff_info_batch_host
+                        print_diff_info_batch_host(
+                            batch, freport, skip_codan=cfg.skip_codan,
+                            motifs=cfg.motifs, summary=summary,
+                            stats=stats)
                 note_batch_done(len(batch))
             return
         from pwasm_tpu.report.device_report import submit_diff_info_batch
         if batch:
-            inflight.append((submit_diff_info_batch(
-                batch, freport, skip_codan=cfg.skip_codan,
-                motifs=cfg.motifs, summary=summary, stats=stats,
-                mesh=shard_mesh, supervisor=supervisor), len(batch)))
+            with obs.span("flush_submit", n=len(batch)):
+                inflight.append((submit_diff_info_batch(
+                    batch, freport, skip_codan=cfg.skip_codan,
+                    motifs=cfg.motifs, summary=summary, stats=stats,
+                    mesh=shard_mesh, supervisor=supervisor),
+                    len(batch)))
             stats.device_batches += 1
         while len(inflight) > (0 if drain else 2):
             fin, nrec = inflight.pop(0)
             try:
-                fin()
+                with obs.span("flush_format", n=nrec):
+                    fin()
             except BaseException:
                 # a formatting failure mid-batch must leave the report a
                 # clean prefix of input order (--resume depends on it):
@@ -1130,6 +1199,7 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                 raise
             note_batch_done(nrec)
 
+    t_loop = obs.clock()   # the parse/extract/flush phase span
     try:
         file_line = 0
         for line in inf:
@@ -1267,6 +1337,8 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         # a later bad line raises, so earlier alignments' rows aren't
         # dropped (the cpu path writes them progressively)
         flush_pending(drain=True)
+        obs.span_complete("input_loop", t_loop, lines=stats.lines,
+                          alignments=stats.alignments)
 
     # a drain requested during the final flushes still counts: the
     # in-flight batches completed (and checkpointed) above, but the
@@ -1322,7 +1394,7 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         from pwasm_tpu.resilience.lifecycle import PreemptedError
         try:
             with (drain.interrupting() if drain is not None
-                  else nullcontext()):
+                  else nullcontext()), obs.span("msa_tail"):
                 _output_tail()
         except PreemptedError:
             preempted = True
@@ -1357,6 +1429,17 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
             k: v for k, v in supervisor.export_state().items()
             if k != "fault_calls"}
     stats.preempted = preempted
+    if obs.registry is not None:
+        # the metrics surface is a pure function of the SAME versioned
+        # --stats schema (obs/catalog.py): fold the finished run in and
+        # stamp the breaker-state gauge; run()'s close publishes the
+        # textfile atomically
+        from pwasm_tpu.obs.catalog import (breaker_state_value,
+                                           fold_run_stats)
+        fold_run_stats(obs.run_metrics, stats.as_dict())
+        obs.set_gauge("breaker_state", breaker_state_value(
+            supervisor.breaker_open,
+            monitor.state if monitor is not None else None))
     if cfg.stats_path:
         try:
             with open(cfg.stats_path, "w") as f:
@@ -1390,7 +1473,13 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         print(f"pwasm: preempted ({drain.reason}) — drained cleanly, "
               f"{done}; rerun with --resume to complete "
               f"(exit {EXIT_PREEMPTED})", file=stderr)
+        obs.event("run_finish", rc=EXIT_PREEMPTED, preempted=True,
+                  reason=drain.reason, records=emitted[0],
+                  alignments=stats.alignments)
         return EXIT_PREEMPTED
+    obs.event("run_finish", rc=0, preempted=False,
+              alignments=stats.alignments, events=stats.events,
+              wall_s=round(stats.wall_s, 3))
     return 0
 
 

@@ -42,6 +42,13 @@ class Config:
     resume: bool = False            # append to -o, skipping emitted alns
     profile_dir: str = ""           # jax.profiler trace output directory
     stats_path: str = ""            # write run-stats JSON here
+    trace_json: str = ""            # --trace-json: Chrome trace-event
+    #                                 JSON of the host-side phase spans
+    log_json: str = ""              # --log-json: NDJSON run-lifecycle
+    #                                 event log ("-" = stdout)
+    metrics_textfile: str = ""      # --metrics-textfile: Prometheus
+    #                                 text exposition, written atomically
+    #                                 at end of run (pwasm_tpu.obs)
 
     # resilience knobs (pwasm_tpu.resilience; no ref equivalent —
     # the reference fails fast, SURVEY.md §2.5.12)

@@ -1,0 +1,160 @@
+"""Fleet-grade observability (ISSUE 6): metrics, spans, event log.
+
+One jax-free subsystem behind three CLI flags and one service command:
+
+- ``--metrics-textfile=PATH``  a :class:`~pwasm_tpu.obs.metrics.
+  MetricsRegistry` rendered in Prometheus text exposition, published
+  atomically for a node-exporter textfile collector (the serve daemon
+  additionally answers the same exposition over its socket via the
+  ``metrics`` command / ``pwasm-tpu metrics`` verb);
+- ``--trace-json=FILE``  monotonic-clock phase/batch spans as Chrome
+  trace-event JSON (:mod:`pwasm_tpu.obs.tracing`), complementing the
+  jax-side ``--profile=DIR`` device trace;
+- ``--log-json=FILE|-``  the structured NDJSON run-lifecycle event log
+  (:mod:`pwasm_tpu.obs.events`).
+
+The :class:`Observability` facade is what gets threaded through the
+run (cli -> supervisor/monitor/drain): a null instance (every hook a
+cheap no-op) when no flag asked for anything, so the hot path carries
+one attribute check per hook and the byte-parity contract — report
+bytes identical with observability on and off — holds by construction
+(observability writes only to its own sinks, never the report stream).
+Metric NAMES live in :mod:`pwasm_tpu.obs.catalog`, the single
+registration namespace the static lint (``qa/check_supervision.py``)
+enforces.
+"""
+
+from __future__ import annotations
+
+from contextlib import nullcontext
+
+from pwasm_tpu.obs.events import EventLog, new_run_id  # noqa: F401
+from pwasm_tpu.obs.metrics import MetricsRegistry  # noqa: F401
+from pwasm_tpu.obs.tracing import TraceRecorder  # noqa: F401
+
+
+class Observability:
+    """The per-run observability bundle.  Any of the three sinks may be
+    absent; every hook degrades to a no-op so call sites never branch.
+
+    ``registry``/``run_metrics`` — the metrics registry and the built
+    run-metric families (``obs/catalog.py``); ``tracer`` — the span
+    recorder; ``events`` — the NDJSON event log.  ``trace_path`` /
+    ``metrics_path`` are written by :meth:`close`.
+    """
+
+    def __init__(self, registry=None, run_metrics=None, tracer=None,
+                 events=None, trace_path=None, metrics_path=None,
+                 run_id=None):
+        self.registry = registry
+        self.run_metrics = run_metrics
+        self.tracer = tracer
+        self.events = events
+        self.trace_path = trace_path
+        self.metrics_path = metrics_path
+        self.run_id = run_id or (events.run_id if events is not None
+                                 else new_run_id())
+
+    @property
+    def enabled(self) -> bool:
+        return (self.registry is not None or self.tracer is not None
+                or self.events is not None)
+
+    # ---- hooks (all no-ops when the sink is absent) --------------------
+    def span(self, name: str, **args):
+        if self.tracer is None:
+            return nullcontext()
+        return self.tracer.span(name, **args)
+
+    def event(self, event: str, **fields) -> None:
+        """One lifecycle event: an NDJSON line and (when tracing) an
+        instant mark on the trace timeline, so the two views line up."""
+        if self.events is not None:
+            self.events.emit(event, **fields)
+        if self.tracer is not None:
+            self.tracer.instant(event, **fields)
+
+    def clock(self) -> float:
+        """The tracer's monotonic clock (0.0 when not tracing) — pair
+        with :meth:`span_complete` for manually-extents phases."""
+        return self.tracer.now() if self.tracer is not None else 0.0
+
+    def span_complete(self, name: str, t0: float, **args) -> None:
+        if self.tracer is not None:
+            self.tracer.complete(name, t0, **args)
+
+    def observe(self, key: str, value: float, **labels) -> None:
+        if self.run_metrics is not None and key in self.run_metrics:
+            self.run_metrics[key].observe(value, **labels)
+
+    def set_gauge(self, key: str, value: float, **labels) -> None:
+        if self.run_metrics is not None and key in self.run_metrics:
+            self.run_metrics[key].set(value, **labels)
+
+    # ---- end of run ----------------------------------------------------
+    def close(self, stderr=None) -> None:
+        """Flush the file-backed sinks (atomic writes) and close the
+        event log.  Best-effort by contract: a failed trace write costs
+        a warning, never the run's exit code."""
+        import sys
+        stderr = stderr if stderr is not None else sys.stderr
+        if self.tracer is not None and self.trace_path:
+            try:
+                self.tracer.write(self.trace_path)
+                print(f"pwasm: trace written to {self.trace_path}",
+                      file=stderr)
+            except OSError as e:
+                print(f"Warning: cannot write --trace-json "
+                      f"{self.trace_path}: {e}", file=stderr)
+        if self.registry is not None and self.metrics_path:
+            try:
+                self.registry.write_textfile(self.metrics_path)
+            except OSError as e:
+                print(f"Warning: cannot write --metrics-textfile "
+                      f"{self.metrics_path}: {e}", file=stderr)
+        if self.events is not None:
+            self.events.close()
+
+
+class _NullObservability(Observability):
+    """The shared do-nothing instance (default for every ``obs=``
+    parameter): hooks resolve to the base no-ops, and it is never
+    closed."""
+
+    def __init__(self):
+        super().__init__(run_id="null")
+
+
+NULL_OBS = _NullObservability()
+
+
+def make_observability(trace_json: str | None = None,
+                       log_json: str | None = None,
+                       metrics_textfile: str | None = None,
+                       stdout=None) -> Observability:
+    """Build the run's bundle from the three CLI flags (any subset).
+    ``--log-json=-`` streams events to ``stdout`` (the conventional
+    stdin/stdout marker; report writers targeting stdout should use
+    ``-o`` with a file).  Raises ``OSError`` when a log file cannot be
+    opened — the caller maps it to the usual cannot-open diagnostic."""
+    registry = run_metrics = tracer = events = None
+    if metrics_textfile:
+        from pwasm_tpu.obs.catalog import build_run_metrics
+        registry = MetricsRegistry()
+        run_metrics = build_run_metrics(registry)
+    if trace_json:
+        tracer = TraceRecorder()
+    if log_json:
+        if log_json == "-":
+            import sys
+            events = EventLog(stdout if stdout is not None
+                              else sys.stdout, owns_stream=False)
+        else:
+            # append, as documented: a restarted daemon (or a fleet
+            # of runs sharing one log) must extend the incident
+            # timeline, never wipe it
+            events = EventLog(open(log_json, "a"), owns_stream=True)
+    return Observability(registry=registry, run_metrics=run_metrics,
+                         tracer=tracer, events=events,
+                         trace_path=trace_json,
+                         metrics_path=metrics_textfile)

@@ -1,0 +1,246 @@
+"""The metric catalog: every pwasm metric, registered in ONE place.
+
+This module is the namespace of record for the fleet-facing metric
+surface (documented operator-side in ``docs/OBSERVABILITY.md``).  The
+static lint (``qa/check_supervision.py``, tier-1) enforces two rules
+that keep it authoritative:
+
+- every registration call (``registry.counter/gauge/histogram``) in
+  ``pwasm_tpu/`` lives HERE — call sites elsewhere receive the built
+  metric objects, never invent names inline;
+- every name literal here matches the grammar (snake_case, ``pwasm_``
+  prefix) and appears exactly once — a duplicate is a lint failure
+  before it is a runtime ``ValueError``.
+
+Two builders: :func:`build_run_metrics` (the per-run families — the
+one-shot CLI registers them for ``--metrics-textfile``, and the serve
+daemon registers the same families once and FOLDS every finished job's
+``--stats`` JSON into them via :func:`fold_run_stats`, so the cumulative
+fleet counters and the per-run stats schema cannot drift) and
+:func:`build_service_metrics` (the daemon-only families: queue/admission
+gauges, job outcome counters, wall/queue-wait histograms, the result
+eviction counter).
+"""
+
+from __future__ import annotations
+
+from pwasm_tpu.obs.metrics import MetricsRegistry
+
+# histogram buckets for per-job queue wait (admission latency: instant
+# under a drained queue, up to many job-walls when saturated)
+_WAIT_BUCKETS = (0.001, 0.005, 0.025, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0,
+                 300.0)
+
+# breaker-state gauge encoding (both surfaces use it; see
+# docs/OBSERVABILITY.md)
+BREAKER_CLOSED = 0
+BREAKER_HALF_OPEN = 1
+BREAKER_OPEN = 2
+
+
+def breaker_state_value(breaker_open: bool,
+                        monitor_state: str | None = None) -> int:
+    """The gauge encoding of the breaker triple: 0 closed (device
+    path live), 1 half-open (open but probing healthy — recovery in
+    progress), 2 open (degraded to host)."""
+    if not breaker_open:
+        return BREAKER_CLOSED
+    if monitor_state == "half-open":
+        return BREAKER_HALF_OPEN
+    return BREAKER_OPEN
+
+
+def build_run_metrics(reg: MetricsRegistry,
+                      include_live: bool = True) -> dict:
+    """Register the per-run metric families; returns them keyed by the
+    short names :func:`fold_run_stats` and the supervisor's observe
+    hook use.  ``include_live=False`` (the serve daemon) registers
+    only the foldable counters: the live instruments — the per-attempt
+    histogram and the run breaker gauge — are fed by the RUN's own obs
+    bundle, which a served job only has when it passed obs flags
+    itself, and an advertised family that can never carry a sample
+    would just feed no-data alerts."""
+    m = {}
+    if include_live:
+        m["batch_attempt_seconds"] = reg.histogram(
+            "pwasm_run_batch_attempt_seconds",
+            "Wall seconds per supervised device-batch attempt, by "
+            "site (one-shot CLI runs)", labels=("site",))
+        m["breaker_state"] = reg.gauge(
+            "pwasm_run_breaker_state",
+            "Global circuit breaker: 0 closed, 1 half-open, 2 open "
+            "(one-shot CLI runs)")
+    m["runs"] = reg.counter(
+        "pwasm_run_finished_total",
+        "Finished runs folded into this registry, by outcome",
+        labels=("outcome",))
+    m["wall_seconds"] = reg.counter(
+        "pwasm_run_wall_seconds_total",
+        "Cumulative run wall seconds")
+    m["alignments"] = reg.counter(
+        "pwasm_run_alignments_total",
+        "Alignments accepted for analysis")
+    m["events"] = reg.counter(
+        "pwasm_run_events_total", "Diff events reported")
+    m["aligned_bases"] = reg.counter(
+        "pwasm_run_aligned_bases_total",
+        "Sum of per-alignment target span bases")
+    m["device_dispatches"] = reg.counter(
+        "pwasm_device_dispatches_total", "Device program launches")
+    m["device_flushes"] = reg.counter(
+        "pwasm_device_flushes_total",
+        "Host-blocking device round-trips")
+    m["fallback_batches"] = reg.counter(
+        "pwasm_device_fallback_batches_total",
+        "Device batches replayed on the host scalar path")
+    m["engine_fallbacks"] = reg.counter(
+        "pwasm_engine_fallbacks_total",
+        "Engine/device stage demotions in the MSA consensus path")
+    m["backend_probes"] = reg.counter(
+        "pwasm_backend_probes_total",
+        "Bounded subprocess backend probes paid")
+    m["backend_warm_hits"] = reg.counter(
+        "pwasm_backend_warm_hits_total",
+        "Backend reachability checks answered from warm state")
+    m["retries"] = reg.counter(
+        "pwasm_resilience_retries_total",
+        "Re-executed supervised device attempts")
+    m["fallbacks"] = reg.counter(
+        "pwasm_resilience_fallbacks_total",
+        "Supervised batches degraded to the host path")
+    m["guardrail_rejects"] = reg.counter(
+        "pwasm_resilience_guardrail_rejects_total",
+        "Device outputs rejected as corrupt by guardrails")
+    m["deadline_timeouts"] = reg.counter(
+        "pwasm_resilience_deadline_timeouts_total",
+        "Attempts abandoned past --device-deadline")
+    m["breaker_trips"] = reg.counter(
+        "pwasm_breaker_trips_total",
+        "Global breaker opens (probe-confirmed dead backend)")
+    m["site_breaker_trips"] = reg.counter(
+        "pwasm_site_breaker_trips_total",
+        "Per-site breaker opens on a healthy backend")
+    m["breaker_recloses"] = reg.counter(
+        "pwasm_breaker_recloses_total",
+        "Global breaker recloses (mid-run device re-promotion)")
+    m["reprobe_attempts"] = reg.counter(
+        "pwasm_reprobe_attempts_total",
+        "Bounded backend re-probes while the breaker was open")
+    m["degraded_batches"] = reg.counter(
+        "pwasm_degraded_batches_total",
+        "Batches skipped straight to the host (breaker open)")
+    m["recovered_batches"] = reg.counter(
+        "pwasm_recovered_batches_total",
+        "Device batches executed after a reclose")
+    m["degraded_wall_seconds"] = reg.counter(
+        "pwasm_degraded_wall_seconds_total",
+        "Wall seconds spent with the global breaker open")
+    m["injected_faults"] = reg.counter(
+        "pwasm_injected_faults_total",
+        "Faults injected by --inject-faults (debug)")
+    m["checkpoints"] = reg.counter(
+        "pwasm_checkpoints_total",
+        "Durable batch checkpoints written")
+    m["oom_events"] = reg.counter(
+        "pwasm_oom_events_total",
+        "Device allocation failures (real or injected)")
+    m["batch_splits"] = reg.counter(
+        "pwasm_batch_splits_total", "Batches bisected after an OOM")
+    m["bucket_demotions"] = reg.counter(
+        "pwasm_bucket_demotions_total",
+        "Pow2 batch-ceiling demotions after an OOM")
+    m["bucket_repromotions"] = reg.counter(
+        "pwasm_bucket_repromotions_total",
+        "Probation-raises of a demoted batch ceiling")
+    return m
+
+
+def build_service_metrics(reg: MetricsRegistry) -> dict:
+    """Register the serve-daemon families (queue, admission, job
+    outcomes, result eviction) keyed by short names the daemon uses."""
+    m = {}
+    m["queue_depth"] = reg.gauge(
+        "pwasm_service_queue_depth", "Jobs waiting in the admission queue")
+    m["inflight"] = reg.gauge(
+        "pwasm_service_jobs_inflight", "Jobs currently executing")
+    m["draining"] = reg.gauge(
+        "pwasm_service_draining",
+        "1 while the service drain is latched, else 0")
+    m["breaker_state"] = reg.gauge(
+        "pwasm_service_breaker_state",
+        "Warm-pool breaker: 0 closed, 1 half-open, 2 open")
+    m["max_queue"] = reg.gauge(
+        "pwasm_service_max_queue", "Admission-control queue ceiling")
+    m["max_concurrent"] = reg.gauge(
+        "pwasm_service_max_concurrent", "Worker-pool width")
+    m["results_held"] = reg.gauge(
+        "pwasm_service_results_held",
+        "Terminal job results currently retained for pickup")
+    m["jobs"] = reg.counter(
+        "pwasm_service_jobs_total",
+        "Job admissions and outcomes, by outcome "
+        "(accepted/rejected/rejected_draining/done/failed/"
+        "preempted/cancelled)", labels=("outcome",))
+    m["results_evicted"] = reg.counter(
+        "pwasm_service_results_evicted_total",
+        "Terminal job results evicted by --result-ttl-s/--max-results")
+    m["job_wall_seconds"] = reg.histogram(
+        "pwasm_service_job_wall_seconds",
+        "Per-job wall seconds (start to finish)")
+    m["queue_wait_seconds"] = reg.histogram(
+        "pwasm_service_job_queue_wait_seconds",
+        "Per-job queue wait seconds (submit to start)",
+        buckets=_WAIT_BUCKETS)
+    return m
+
+
+def fold_run_stats(m: dict, st: dict | None) -> None:
+    """Fold one run's ``--stats`` JSON (the versioned ``stats_version``
+    schema) into the run-metric families.  The one-shot CLI calls it
+    once at end of run; the daemon calls it per finished job — so the
+    Prometheus surface is a pure function of the same schema the
+    ``--stats``/``svc-stats`` surfaces report, and the two cannot
+    drift.  Unknown/missing keys fold as zero (additive-schema rule)."""
+    if not isinstance(st, dict):
+        return
+
+    def n(d: dict, key: str) -> float:
+        v = d.get(key, 0)
+        return v if isinstance(v, (int, float)) \
+            and not isinstance(v, bool) and v > 0 else 0
+
+    res = st.get("resilience")
+    res = res if isinstance(res, dict) else {}
+    backend = st.get("backend")
+    backend = backend if isinstance(backend, dict) else {}
+    device = st.get("device")
+    device = device if isinstance(device, dict) else {}
+    m["runs"].inc(1, outcome="preempted" if st.get("preempted")
+                  else "completed")
+    m["wall_seconds"].inc(n(st, "wall_s"))
+    m["alignments"].inc(n(st, "alignments"))
+    m["events"].inc(n(st, "events"))
+    m["aligned_bases"].inc(n(st, "aligned_bases"))
+    m["device_dispatches"].inc(n(device, "dispatches"))
+    m["device_flushes"].inc(n(device, "flushes"))
+    m["fallback_batches"].inc(n(st, "fallback_batches"))
+    m["engine_fallbacks"].inc(n(st, "engine_fallbacks"))
+    m["backend_probes"].inc(n(backend, "probes"))
+    m["backend_warm_hits"].inc(n(backend, "warm_hits"))
+    m["retries"].inc(n(res, "retries"))
+    m["fallbacks"].inc(n(res, "fallbacks"))
+    m["guardrail_rejects"].inc(n(res, "guardrail_rejects"))
+    m["deadline_timeouts"].inc(n(res, "deadline_timeouts"))
+    m["breaker_trips"].inc(n(res, "breaker_trips"))
+    m["site_breaker_trips"].inc(n(res, "site_breaker_trips"))
+    m["breaker_recloses"].inc(n(res, "breaker_recloses"))
+    m["reprobe_attempts"].inc(n(res, "reprobe_attempts"))
+    m["degraded_batches"].inc(n(res, "degraded_batches"))
+    m["recovered_batches"].inc(n(res, "recovered_batches"))
+    m["degraded_wall_seconds"].inc(n(res, "degraded_wall_s"))
+    m["injected_faults"].inc(n(res, "injected_faults"))
+    m["checkpoints"].inc(n(res, "checkpoints"))
+    m["oom_events"].inc(n(res, "oom_events"))
+    m["batch_splits"].inc(n(res, "batch_splits"))
+    m["bucket_demotions"].inc(n(res, "bucket_demotions"))
+    m["bucket_repromotions"].inc(n(res, "bucket_repromotions"))

@@ -1,0 +1,83 @@
+"""Structured NDJSON event log: run-lifecycle events as they happen.
+
+``--log-json=FILE|-`` turns the run's lifecycle into one append-only
+stream of JSON lines a fleet can tail, ship and replay: breaker
+trip/half-open/reclose, OOM demotion/re-promotion, fallbacks,
+checkpoint writes, drains, and (in the serve daemon) job
+admit/start/finish/evict.  Every record carries both clocks —
+``ts_wall`` (epoch seconds, for correlation across machines) and
+``ts_mono`` (monotonic seconds, for intra-run ordering that survives
+NTP steps) — plus the run/job id, so one grep over a fleet's logs
+reconstructs any incident timeline.
+
+The log is strictly additive observability: emission never raises
+(a full disk or closed pipe must not kill the run it observes), lines
+are flushed as written (a crashed run's log ends at its last whole
+event), and nothing here ever touches the report stream — the
+byte-parity contract (`-o`/`-s`/`-w` identical with logging on or
+off) is part of the test suite.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+
+def new_run_id() -> str:
+    """A short unique id stamped on every event of one run (and handed
+    to operators in incident timelines) — uuid4-derived, no coordination
+    needed between the fleet's processes."""
+    import uuid
+    return uuid.uuid4().hex[:12]
+
+
+class EventLog:
+    """One NDJSON event sink.  ``stream`` is any text file object;
+    ``owns_stream`` says whether :meth:`close` closes it (False for
+    ``-`` = the run's stdout).  Thread-safe: daemon workers and the
+    accept loop emit concurrently, one whole line per event."""
+
+    def __init__(self, stream, run_id: str | None = None,
+                 owns_stream: bool = True):
+        self._lock = threading.Lock()
+        self._fh = stream
+        self._owns = owns_stream
+        self.run_id = run_id or new_run_id()
+
+    def emit(self, event: str, **fields) -> None:
+        """Append one event line.  Never raises — and is safe to call
+        from a signal handler's drain path: the lock acquire is
+        BOUNDED, because a handler running on the very thread that
+        holds the (non-reentrant) lock mid-write would otherwise
+        deadlock the drain it is trying to log.  On timeout — self-
+        reentrancy or a wedged sink — the line is dropped, never the
+        run."""
+        fh = self._fh
+        if fh is None:
+            return
+        rec = {"event": event, "run_id": self.run_id,
+               "ts_wall": round(time.time(), 6),
+               "ts_mono": round(time.perf_counter(), 6)}
+        for k, v in fields.items():
+            if v is not None:
+                rec[k] = v
+        if not self._lock.acquire(timeout=0.2):
+            return
+        try:
+            fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            fh.flush()
+        except Exception:
+            pass
+        finally:
+            self._lock.release()
+
+    def close(self) -> None:
+        with self._lock:
+            fh, self._fh = self._fh, None
+        if fh is not None and self._owns:
+            try:
+                fh.close()
+            except Exception:
+                pass

@@ -64,13 +64,15 @@ class BackendHealthMonitor:
 
     def __init__(self, probe=None, interval_s: float = 5.0,
                  max_interval_s: float = 300.0, hysteresis: int = 2,
-                 stats=None, stderr=None, clock=None):
+                 stats=None, stderr=None, clock=None, obs=None):
+        from pwasm_tpu.obs import NULL_OBS
         self.probe = probe
         self.interval_s = max(0.0, float(interval_s))
         self.max_interval_s = max(self.interval_s, float(max_interval_s))
         self.hysteresis = max(1, int(hysteresis))
         self.stats = stats
         self.stderr = stderr if stderr is not None else sys.stderr
+        self.obs = obs if obs is not None else NULL_OBS
         self._clock = clock or time.monotonic
         self.state = CLOSED
         self._streak = 0          # consecutive healthy probes
@@ -86,7 +88,8 @@ class BackendHealthMonitor:
         print(f"pwasm: {msg}", file=self.stderr)
 
     # ---- lifecycle -----------------------------------------------------
-    def attach(self, stats=None, stderr=None) -> "BackendHealthMonitor":
+    def attach(self, stats=None, stderr=None,
+               obs=None) -> "BackendHealthMonitor":
         """Re-bind the per-run sinks and return self.  A warm serve
         process shares ONE monitor (one probe schedule, one
         open/half-open/closed state) across consecutive jobs, but each
@@ -94,11 +97,16 @@ class BackendHealthMonitor:
         at job start so reprobe/reclose counters land on the job that
         observed them.  The probe callable is also dropped: each job's
         supervisor re-wires its own (fault-plan-aware) probe, and a
-        stale one would consult a finished job's fault plan."""
+        stale one would consult a finished job's fault plan.  The obs
+        sink is ALWAYS rebound (to the given one or the null sink) —
+        a finished job's closed event log must never receive the next
+        job's transitions."""
+        from pwasm_tpu.obs import NULL_OBS
         if stats is not None:
             self.stats = stats
         if stderr is not None:
             self.stderr = stderr
+        self.obs = obs if obs is not None else NULL_OBS
         self.probe = None
         return self
 
@@ -125,6 +133,9 @@ class BackendHealthMonitor:
             return False
         ok, why = self.probe() if self.probe is not None else (False, "")
         self._count("res_reprobe_attempts")
+        self.obs.event("reprobe", ok=ok,
+                       why=(why or "").strip() or None,
+                       state=self.state)
         # schedule from the POST-probe clock: a real probe of a hung
         # tunnel blocks for its full subprocess timeout (150 s default),
         # far past any early backoff step — timed from the pre-probe
@@ -152,6 +163,8 @@ class BackendHealthMonitor:
         self._streak += 1
         if self._streak == 1 and self.state == OPEN:
             self.state = HALF_OPEN
+            self.obs.event("breaker_half_open", streak=self._streak,
+                           hysteresis=self.hysteresis)
             self._warn("backend re-probe healthy; breaker half-open "
                        f"({self._streak}/{self.hysteresis} consecutive "
                        "healthy probes needed)")

@@ -61,8 +61,15 @@ class SignalDrain:
     """
 
     def __init__(self, stderr=None, hard_exit=None):
+        from pwasm_tpu.obs import NULL_OBS
         self.stderr = stderr if stderr is not None else sys.stderr
         self._hard_exit = hard_exit if hard_exit is not None else os._exit
+        self.obs = NULL_OBS   # rebound by cli.run / the daemon so the
+        #   drain request lands in the structured event log too;
+        #   EventLog.emit never raises and bounds its lock acquire
+        #   (a handler interrupting the thread that holds the lock
+        #   drops the line instead of deadlocking), so this is
+        #   signal-handler-safe like _say below
         self.reason: str | None = None
         self._prev: dict = {}
         self._interrupt = False   # inside an interruptible phase:
@@ -92,6 +99,7 @@ class SignalDrain:
         if self.reason is None:
             self.reason = reason   # the flag FIRST: the drain must
             #                        survive a failed message below
+            self.obs.event("drain", reason=reason)
             self._say(f"pwasm: {reason} — draining: finishing the "
                       "in-flight batch, flushing a final checkpoint, "
                       f"then exiting resumable (exit {EXIT_PREEMPTED})"

@@ -69,6 +69,7 @@ import time
 from dataclasses import dataclass, replace
 
 from pwasm_tpu.core.errors import PwasmError
+from pwasm_tpu.obs import NULL_OBS
 from pwasm_tpu.resilience.faults import FaultPlan
 from pwasm_tpu.resilience.guardrails import GuardrailViolation
 
@@ -178,10 +179,11 @@ class BatchSupervisor:
 
     def __init__(self, policy: ResiliencePolicy | None = None,
                  stats=None, stderr=None, faults: FaultPlan | None = None,
-                 probe=None, monitor=None):
+                 probe=None, monitor=None, obs=None):
         self.policy = policy or ResiliencePolicy()
         self.stats = stats
         self.stderr = stderr if stderr is not None else sys.stderr
+        self.obs = obs if obs is not None else NULL_OBS
         self.faults = faults
         self._probe = probe
         self.monitor = monitor
@@ -282,6 +284,18 @@ class BatchSupervisor:
                                         * self._rng.random()),
                                self.policy.backoff_cap_s))
                 delay *= 2
+            # every attempt — clean, rejected, timed out, OOMed —
+            # lands exactly ONE wall observation on the per-site
+            # histogram, taken at the attempt's own boundary (NOT in a
+            # finally: the OOM path re-enters run() for each bisected
+            # half before unwinding, and a finally would fold the
+            # whole recovery into the parent attempt's sample)
+            t_att = time.perf_counter()
+
+            def _attempt_wall(_t0=t_att) -> None:
+                self.obs.observe("batch_attempt_seconds",
+                                 time.perf_counter() - _t0, site=site)
+
             try:
                 if self.stats is not None \
                         and hasattr(self.stats, "note_dispatch"):
@@ -290,9 +304,12 @@ class BatchSupervisor:
                     # host-blocking fetch the attempt ends in)
                     self.stats.note_dispatch(site)
                     self.stats.note_flush()
-                result = self._attempt_once(site, attempt, size)
-                if validate is not None:
-                    validate(result)
+                with self.obs.span("device_batch", site=site,
+                                   attempt=k, items=size):
+                    result = self._attempt_once(site, attempt, size)
+                    if validate is not None:
+                        validate(result)
+                _attempt_wall()
                 self._consecutive[site] = 0
                 self._note_clean_flush(site, size)
                 if self.recloses:
@@ -301,14 +318,17 @@ class BatchSupervisor:
                     self._count("res_recovered_batches")
                 return result
             except GuardrailViolation as e:
+                _attempt_wall()
                 self._count("res_guardrail_rejects")
                 self._warn(f"{site}: device output rejected by "
                            f"guardrail ({e}); re-executing")
                 last = e
             except DeadlineExceeded as e:
+                _attempt_wall()
                 self._count("res_deadline_timeouts")
                 last = e
             except Exception as e:
+                _attempt_wall()
                 if is_oom_error(e):
                     # allocation failure: retrying the IDENTICAL shape
                     # is pointless and the backend is not sick — hand
@@ -329,6 +349,8 @@ class BatchSupervisor:
         and the host fallback is reached only when no smaller split can
         succeed."""
         self._count("res_oom_events")
+        self.obs.event("oom", site=site, detail=_detail(err),
+                       items=len(bisect.items) if bisect else None)
         self._ceiling_clean = 0   # an OOM restarts the ceiling's
         #                           re-promotion probation from zero
         if bisect is not None and len(bisect.items) > max(1, bisect.floor):
@@ -356,6 +378,8 @@ class BatchSupervisor:
         items = spec.items
         mid = (len(items) + 1) // 2
         self._count("res_batch_splits")
+        self.obs.event("batch_split", site=site, items=len(items),
+                       halves=[mid, len(items) - mid])
         self._warn(f"{site}: bisecting {len(items)}-item batch into "
                    f"{mid}+{len(items) - mid} after device OOM")
         parts = []
@@ -401,6 +425,8 @@ class BatchSupervisor:
         if self.bucket_ceiling is None or new < self.bucket_ceiling:
             self.bucket_ceiling = new
             self._count("res_bucket_demotions")
+            self.obs.event("bucket_demotion", site=site, ceiling=new,
+                           failed_size=int(failed_size))
             self._warn(f"{site}: batch bucket ceiling demoted to "
                        f"{new} items for the rest of the run "
                        f"(device OOM at {failed_size})")
@@ -438,11 +464,15 @@ class BatchSupervisor:
             # fully probed back to the bucket that failed: the
             # demotion is retired, flushes stop pre-chunking entirely
             self.bucket_ceiling = None
+            self.obs.event("bucket_repromotion", site=site,
+                           ceiling=None, restored=True)
             self._warn(f"{site}: batch bucket ceiling RESTORED "
                        f"(probation passed back to the {old}-item "
                        "bucket; an OOM re-demotes it)")
             return
         self.bucket_ceiling = new
+        self.obs.event("bucket_repromotion", site=site, ceiling=new,
+                       restored=False)
         self._warn(f"{site}: batch bucket ceiling probation-raised "
                    f"{old} -> {new} items after "
                    f"{self.policy.repromote_after} consecutive clean "
@@ -547,6 +577,8 @@ class BatchSupervisor:
                 # page on res_breaker_trips (dead backend); a site trip
                 # on a healthy backend is a different, softer alarm
                 self._count("res_site_breaker_trips")
+                self.obs.event("site_breaker_trip", site=site,
+                               half_opens=self._half_opens[site])
                 self._warn(
                     f"{site}: {self._consecutive_msg(site)} for the "
                     f"{self._half_opens[site]}th time with a healthy "
@@ -554,6 +586,8 @@ class BatchSupervisor:
                     "site's device work to the host path for the rest "
                     "of the run")
                 return True
+            self.obs.event("site_breaker_half_open", site=site,
+                           half_opens=self._half_opens[site])
             self._warn(f"{site}: {self._consecutive_msg(site)} but the "
                        "backend probes healthy; breaker half-open")
             return False
@@ -561,6 +595,8 @@ class BatchSupervisor:
         # counted only when the breaker actually OPENS — a healthy-probe
         # half-open above is not a trip, and operators alert on this
         self._count("res_breaker_trips")
+        self.obs.event("breaker_trip", site=site,
+                       why=(why or "unreachable").strip())
         self._warn(f"{site}: {self._consecutive_msg(site)}; backend "
                    f"probe says: {why.strip() or 'unreachable'} — "
                    "circuit breaker OPEN, degrading device work to the "
@@ -593,6 +629,7 @@ class BatchSupervisor:
         self.breaker_open = False
         self.recloses += 1
         self._count("res_breaker_recloses")
+        self.obs.event("breaker_reclose", recloses=self.recloses)
         self._flush_degraded_wall()
         self._consecutive.clear()
         self._half_opens.clear()
@@ -726,6 +763,7 @@ class BatchSupervisor:
         ``res_fallbacks`` and leaves one stderr line, whichever side
         executes the fallback."""
         self._count("res_fallbacks")
+        self.obs.event("fallback", site=site, reason=detail)
         self._warn(f"{site}: {detail}")
 
     # ---- degradation ----------------------------------------------------
@@ -737,6 +775,7 @@ class BatchSupervisor:
                 f"fail forbids degrading ({reason})\n") from err
         if fallback is not None:
             self._count("res_fallbacks")
+            self.obs.event("fallback", site=site, reason=reason)
             self._warn(f"{site}: degrading batch to the host path "
                        f"({reason})")
             return fallback()

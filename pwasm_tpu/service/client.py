@@ -36,6 +36,12 @@ _CLIENT_USAGE = """Usage:
      --drain, ask the daemon to drain gracefully first (running jobs
      finish at batch boundaries, queued jobs report resumable, daemon
      exits 75).
+
+ pwasm-tpu metrics --socket=PATH
+     print the daemon's metrics as Prometheus text exposition (queue
+     depth, in-flight jobs, breaker state, job wall/queue-wait
+     histograms, cumulative per-run counters) — the socket twin of
+     `serve --metrics-textfile=PATH` (docs/OBSERVABILITY.md).
 """
 
 # distinct from every CLI exit code (1/3/5/75): "the service queue is
@@ -129,6 +135,9 @@ class ServiceClient:
     def stats(self) -> dict:
         return self.request({"cmd": "stats"})
 
+    def metrics(self) -> dict:
+        return self.request({"cmd": "metrics"})
+
     def drain(self) -> dict:
         return self.request({"cmd": "drain"})
 
@@ -199,6 +208,14 @@ def client_main(cmd: str, argv: list[str], stdout=None,
                          f"{opts['timeout']}\n")
             return EXIT_USAGE
     try:
+        if cmd == "metrics":
+            with ServiceClient(sock) as c:
+                resp = c.metrics()
+            if not resp.get("ok"):
+                stderr.write(f"Error: metrics failed: {resp}\n")
+                return EXIT_FATAL
+            stdout.write(resp.get("metrics", ""))
+            return 0
         if cmd == "svc-stats":
             with ServiceClient(sock) as c:
                 if opts.get("drain"):

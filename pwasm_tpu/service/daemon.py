@@ -50,7 +50,8 @@ from pwasm_tpu.service.queue import (JOB_CANCELLED, JOB_DONE, JOB_FAILED,
 
 _SERVE_USAGE = """Usage:
  pwasm-tpu serve --socket=PATH [--max-queue=N] [--max-concurrent=N]
-                 [--max-frame-bytes=N]
+                 [--max-frame-bytes=N] [--metrics-textfile=PATH]
+                 [--log-json=FILE] [--result-ttl-s=S] [--max-results=N]
 
    --socket=PATH        unix socket to listen on (required)
    --max-queue=N        admission control: queued-job ceiling, beyond
@@ -59,6 +60,20 @@ _SERVE_USAGE = """Usage:
                         serial jobs share the device cleanly; raise it
                         only for host-path workloads)
    --max-frame-bytes=N  protocol frame ceiling (default 8 MiB)
+   --metrics-textfile=PATH  publish the daemon's Prometheus text
+                        exposition here (atomic rewrite after every
+                        job) for a node-exporter textfile collector;
+                        the same exposition answers the `metrics`
+                        protocol command / `pwasm-tpu metrics` verb
+   --log-json=FILE      append structured NDJSON service events (job
+                        admit/start/finish/evict, drains, breaker
+                        transitions inside jobs go to each job's own
+                        --log-json)
+   --result-ttl-s=S     evict a finished job's result S seconds after
+                        it finished (default: keep forever); evicted
+                        job ids answer unknown_job
+   --max-results=N      keep at most N finished-job results (least-
+                        recently-accessed evicted first)
 
  SIGTERM/SIGINT (or the `drain` protocol command) drains gracefully:
  in-flight jobs finish at their next batch boundary and checkpoint,
@@ -138,7 +153,9 @@ class Daemon:
     def __init__(self, socket_path: str, max_queue: int = 16,
                  max_concurrent: int = 1,
                  max_frame_bytes: int = protocol.MAX_FRAME_BYTES,
-                 stderr=None, runner=None):
+                 stderr=None, runner=None, metrics_textfile=None,
+                 log_json=None, result_ttl_s: float | None = None,
+                 max_results: int | None = None):
         self.socket_path = socket_path
         self.max_concurrent = max(1, int(max_concurrent))
         self.max_frame_bytes = int(max_frame_bytes)
@@ -159,6 +176,44 @@ class Daemon:
         self._job_walls: deque = deque(maxlen=8)  # recent finished-job
         #                       walls (the retry_after_s hint) — only
         #                       the recent window matters, so bounded
+        # ---- observability (ISSUE 6): ONE metrics registry for the
+        # daemon's life — queue/admission gauges + job histograms
+        # (obs/catalog.py build_service_metrics) plus the cumulative
+        # run-level families every finished job's --stats JSON is
+        # folded into (fold_run_stats), exposed over the `metrics`
+        # protocol command and, optionally, a node-exporter textfile.
+        from pwasm_tpu.obs import (EventLog, MetricsRegistry,
+                                   Observability)
+        from pwasm_tpu.obs.catalog import (build_run_metrics,
+                                           build_service_metrics)
+        self.registry = MetricsRegistry()
+        self.svc_metrics = build_service_metrics(self.registry)
+        # foldable counters only: the live run instruments (attempt
+        # histogram, run breaker gauge) belong to each run's own obs
+        # bundle — the daemon's breaker view is the
+        # pwasm_service_breaker_state gauge
+        self.run_metrics = build_run_metrics(self.registry,
+                                             include_live=False)
+        self.svc_metrics["max_queue"].set(self.queue.max_queue)
+        self.svc_metrics["max_concurrent"].set(self.max_concurrent)
+        self.metrics_textfile = metrics_textfile
+        self._textfile_lock = threading.Lock()  # fsio's tmp name is
+        #   pid-unique, not thread-unique: two workers finishing at
+        #   once must not interleave on the same tmp file
+        events = None
+        if log_json:
+            # append (documented): a restarted daemon extends the
+            # incident timeline instead of wiping the previous one
+            events = EventLog(open(log_json, "a"))
+        self.obs = Observability(registry=self.registry,
+                                 events=events)
+        self.drain.obs = self.obs   # SIGTERM/drain lands in the log
+        # ---- result eviction (the PR 5 "results live forever" gap):
+        # TTL and/or LRU ceiling over TERMINAL jobs only — running and
+        # queued jobs are never touched; an evicted id answers
+        # unknown_job exactly like one that never existed
+        self.result_ttl_s = result_ttl_s
+        self.max_results = max_results
 
     # ---- lifecycle -----------------------------------------------------
     def serve(self) -> int:
@@ -199,8 +254,13 @@ class Daemon:
             self._say(f"serving on {self.socket_path} "
                       f"(max-queue {self.queue.max_queue}, "
                       f"max-concurrent {self.max_concurrent})")
+            self.obs.event("daemon_start", socket=self.socket_path,
+                           max_queue=self.queue.max_queue,
+                           max_concurrent=self.max_concurrent)
+            self._write_textfile()   # scrapers see a file immediately
             try:
                 while True:
+                    self._evict_results()
                     if self.drain.requested:
                         self._begin_drain(self.drain.reason
                                           or "drain requested")
@@ -232,6 +292,12 @@ class Daemon:
                     pass
                 if self._jobdir is not None:
                     self._jobdir.cleanup()
+        rc = EXIT_PREEMPTED if self.drain.requested else 0
+        self.obs.event("daemon_exit", rc=rc,
+                       drained=self.drain.requested)
+        self._write_textfile()       # final snapshot for the scraper
+        if self.obs.events is not None:
+            self.obs.events.close()
         if self.drain.requested:
             self._say(f"drained — exiting resumable "
                       f"(exit {EXIT_PREEMPTED}); resubmit preempted "
@@ -241,6 +307,73 @@ class Daemon:
 
     def _say(self, msg: str) -> None:
         print(f"pwasm: {msg}", file=self.stderr)
+
+    # ---- observability -------------------------------------------------
+    def _refresh_gauges(self) -> None:
+        """Stamp the point-in-time gauges from the live state.  Called
+        before every exposition/stats read and after every job, so the
+        Prometheus surface and svc-stats both read the SAME registry
+        (they cannot drift — the svc-stats satellite contract)."""
+        from pwasm_tpu.obs.catalog import breaker_state_value
+        m = self.svc_metrics
+        m["queue_depth"].set(self.queue.depth())
+        with self._lock:
+            running = len(self._running)
+            held = sum(1 for j in self.jobs.values()
+                       if j.state in TERMINAL_STATES)
+            st = self.warm.supervisor_state
+        m["inflight"].set(running)
+        m["draining"].set(1 if self._draining else 0)
+        m["results_held"].set(held)
+        mon = self.warm.monitor
+        m["breaker_state"].set(breaker_state_value(
+            bool(st.get("breaker_open")) if st else False,
+            mon.state if mon is not None else None))
+
+    def _write_textfile(self) -> None:
+        """Atomic textfile publish (fsync-then-replace via
+        ``utils.fsio``) — best-effort: a full disk costs a warning,
+        never the serving loop."""
+        if not self.metrics_textfile:
+            return
+        try:
+            with self._textfile_lock:
+                self._refresh_gauges()
+                self.registry.write_textfile(self.metrics_textfile)
+        except OSError as e:
+            self._say(f"warning: cannot write --metrics-textfile "
+                      f"{self.metrics_textfile}: {e}")
+
+    def _evict_results(self) -> None:
+        """Drop TERMINAL job results past ``--result-ttl-s`` and/or
+        beyond ``--max-results`` (least-recently-accessed first).
+        Running/queued jobs are never candidates; a client holding the
+        Job object (blocked in ``result``) keeps its reference — only
+        the id lookup goes away."""
+        if self.result_ttl_s is None and self.max_results is None:
+            return
+        now = time.time()
+        with self._lock:
+            terminal = [j for j in self.jobs.values()
+                        if j.state in TERMINAL_STATES
+                        and j.done.is_set()]
+            victims = []
+            if self.result_ttl_s is not None:
+                victims = [j for j in terminal
+                           if now - (j.finished_s or j.submitted_s)
+                           > self.result_ttl_s]
+            if self.max_results is not None:
+                keep = [j for j in terminal if j not in victims]
+                excess = len(keep) - self.max_results
+                if excess > 0:
+                    keep.sort(key=lambda j: j.accessed_s)
+                    victims += keep[:excess]
+            for j in victims:
+                self.jobs.pop(j.id, None)
+        for j in victims:
+            self.stats.jobs_evicted += 1
+            self.svc_metrics["results_evicted"].inc()
+            self.obs.event("job_evict", job_id=j.id, state=j.state)
 
     def _drained(self) -> bool:
         with self._lock:
@@ -262,10 +395,13 @@ class Daemon:
                           "if a previous attempt checkpointed")
             job.finished_s = time.time()
             self.stats.jobs_preempted += 1
+            self.svc_metrics["jobs"].inc(outcome="preempted")
             job.done.set()
         for job in running:
             if job.drain is not None:
                 job.drain.request(reason)
+        self.obs.event("service_drain", reason=reason,
+                       running=len(running), preempted=len(waiting))
         self._say(f"draining ({reason}): {len(running)} in-flight "
                   f"job(s) finishing at their batch boundaries, "
                   f"{len(waiting)} queued job(s) preempted, new "
@@ -291,6 +427,9 @@ class Daemon:
     def _run_job(self, job: Job) -> None:
         job.state = JOB_RUNNING
         job.started_s = time.time()
+        self.obs.event("job_start", job_id=job.id,
+                       queue_wait_s=round(job.started_s
+                                          - job.submitted_s, 6))
         # a drain latched between this job's dequeue and here must
         # still reach its flag (the _begin_drain snapshot may have
         # missed it)
@@ -338,6 +477,22 @@ class Daemon:
                 job.detail = f"exit {rc}"
             self.stats.jobs_failed += 1
         self.stats.rollup_job(job.stats)
+        # fold the finished job into the Prometheus surface: outcome
+        # counter, wall + queue-wait histograms, and the job's --stats
+        # JSON into the cumulative run-level families (the same fold
+        # the one-shot CLI applies to itself — obs/catalog.py)
+        from pwasm_tpu.obs.catalog import fold_run_stats
+        self.svc_metrics["jobs"].inc(outcome=job.state)
+        self.svc_metrics["job_wall_seconds"].observe(
+            job.finished_s - job.started_s)
+        self.svc_metrics["queue_wait_seconds"].observe(
+            max(0.0, job.started_s - job.submitted_s))
+        fold_run_stats(self.run_metrics, job.stats)
+        self.obs.event(
+            "job_finish", job_id=job.id, state=job.state, rc=rc,
+            wall_s=round(job.finished_s - job.started_s, 6),
+            detail=job.detail or None)
+        self._write_textfile()
 
     def _read_job_stats(self, job: Job) -> dict | None:
         if job.stats_path is None:
@@ -411,6 +566,9 @@ class Daemon:
         with self._lock:
             self.jobs[job.id] = job
         self.stats.jobs_accepted += 1
+        self.svc_metrics["jobs"].inc(outcome="accepted")
+        self.obs.event("job_admit", job_id=job.id,
+                       queue_depth=self.queue.depth())
         return job
 
     def _retry_after_s(self) -> float:
@@ -468,6 +626,11 @@ class Daemon:
 
     def _dispatch(self, req: dict) -> dict:
         cmd = req.get("cmd")
+        # eviction runs on every request (plus the accept-loop tick
+        # and each admission), so reads observe a deterministic
+        # post-eviction view: an id past its TTL/LRU budget answers
+        # unknown_job on the very next request, not a tick later
+        self._evict_results()
         if cmd == "ping":
             return protocol.ok(
                 protocol_version=protocol.PROTOCOL_VERSION,
@@ -480,10 +643,13 @@ class Daemon:
                 return protocol.err(protocol.ERR_BAD_REQUEST, str(e))
             except Draining as e:
                 self.stats.jobs_rejected_draining += 1
+                self.svc_metrics["jobs"].inc(
+                    outcome="rejected_draining")
                 return protocol.err(protocol.ERR_DRAINING, str(e))
             except QueueFull as e:
                 # the 429: a well-behaved client backs off and retries
                 self.stats.jobs_rejected += 1
+                self.svc_metrics["jobs"].inc(outcome="rejected")
                 return protocol.err(
                     protocol.ERR_QUEUE_FULL, str(e),
                     queue_depth=self.queue.depth(),
@@ -492,13 +658,23 @@ class Daemon:
             return protocol.ok(job_id=job.id,
                                queue_depth=self.queue.depth())
         if cmd == "stats":
-            with self._lock:
-                running = len(self._running)
+            # queue depth / in-flight / breaker state read back from
+            # the SAME registry gauges the `metrics` exposition serves
+            # — the two operator surfaces cannot drift (ISSUE 6)
+            self._refresh_gauges()
+            m = self.svc_metrics
             return protocol.ok(stats=self.stats.as_dict(
-                queue_depth=self.queue.depth(), running=running,
+                queue_depth=int(m["queue_depth"].value()),
+                running=int(m["inflight"].value()),
                 draining=self._draining,
                 max_queue=self.queue.max_queue,
-                max_concurrent=self.max_concurrent))
+                max_concurrent=self.max_concurrent,
+                breaker_state=int(m["breaker_state"].value())))
+        if cmd == "metrics":
+            self._refresh_gauges()
+            return protocol.ok(
+                metrics=self.registry.expose(),
+                content_type="text/plain; version=0.0.4")
         if cmd == "drain":
             self.drain.request("drain requested by client")
             self._begin_drain(self.drain.reason)
@@ -517,9 +693,12 @@ class Daemon:
         if cmd in ("status", "result", "cancel"):
             job = self.jobs.get(req.get("job_id"))
             if job is None:
+                # unknown OR evicted (--result-ttl-s/--max-results):
+                # indistinguishable by design
                 return protocol.err(
                     protocol.ERR_UNKNOWN_JOB,
                     f"unknown job_id {req.get('job_id')!r}")
+            job.accessed_s = time.time()   # the LRU clock
             if cmd == "status":
                 return protocol.ok(job=job.describe(),
                                    queue_depth=self.queue.depth())
@@ -542,6 +721,8 @@ class Daemon:
             job.detail = "cancelled while queued (never started)"
             job.finished_s = time.time()
             self.stats.jobs_cancelled += 1
+            self.svc_metrics["jobs"].inc(outcome="cancelled")
+            self.obs.event("job_cancel", job_id=job.id, was="queued")
             job.done.set()
             return protocol.ok(state=JOB_CANCELLED, was="queued")
         if job.state in TERMINAL_STATES:
@@ -556,6 +737,7 @@ class Daemon:
         job.cancel_requested = True
         if job.drain is not None:
             job.drain.request("cancelled by client")
+        self.obs.event("job_cancel", job_id=job.id, was="running")
         return protocol.ok(state="cancelling", was="running")
 
 
@@ -565,7 +747,8 @@ class Daemon:
 # the positional PAF input.
 _PATH_SHORT = frozenset("rows")
 _PATH_LONG = frozenset(("stats", "profile", "motifs",
-                        "ace", "info", "cons"))
+                        "ace", "info", "cons",
+                        "trace-json", "log-json", "metrics-textfile"))
 
 
 def _absolutize_argv(argv: list[str], cwd: str) -> list[str]:
@@ -669,14 +852,44 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
             stderr.write(f"{_SERVE_USAGE}\nInvalid --{knob} value: "
                          f"{val}\n")
             return EXIT_USAGE
+    metrics_textfile = opts.pop("metrics-textfile", None)
+    log_json = opts.pop("log-json", None)
+    result_ttl_s = None
+    val = opts.pop("result-ttl-s", None)
+    if val is not None:
+        import math
+        try:
+            result_ttl_s = float(val)
+            if result_ttl_s < 0 or not math.isfinite(result_ttl_s):
+                raise ValueError
+        except (TypeError, ValueError):
+            stderr.write(f"{_SERVE_USAGE}\nInvalid --result-ttl-s "
+                         f"value: {val}\n")
+            return EXIT_USAGE
+    max_results = None
+    val = opts.pop("max-results", None)
+    if val is not None:
+        if val.isascii() and val.isdigit():
+            max_results = int(val)
+        else:
+            stderr.write(f"{_SERVE_USAGE}\nInvalid --max-results "
+                         f"value: {val}\n")
+            return EXIT_USAGE
     if opts:
         stderr.write(f"{_SERVE_USAGE}\nInvalid argument: "
                      f"--{next(iter(opts))}\n")
         return EXIT_USAGE
-    daemon = Daemon(sock, max_queue=nums["max-queue"],
-                    max_concurrent=nums["max-concurrent"],
-                    max_frame_bytes=nums["max-frame-bytes"],
-                    stderr=stderr)
+    try:
+        daemon = Daemon(sock, max_queue=nums["max-queue"],
+                        max_concurrent=nums["max-concurrent"],
+                        max_frame_bytes=nums["max-frame-bytes"],
+                        stderr=stderr,
+                        metrics_textfile=metrics_textfile,
+                        log_json=log_json, result_ttl_s=result_ttl_s,
+                        max_results=max_results)
+    except OSError:
+        stderr.write(f"Cannot open file {log_json} for writing!\n")
+        return EXIT_USAGE
     try:
         return daemon.serve()
     except PwasmError as e:

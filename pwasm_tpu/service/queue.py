@@ -57,6 +57,8 @@ class Job:
     submitted_s: float = field(default_factory=time.time)
     started_s: float | None = None
     finished_s: float | None = None
+    accessed_s: float = field(default_factory=time.time)  # last
+    #   status/result touch — the LRU clock for --max-results eviction
     stats: dict | None = None          # the job's RunStats JSON
     stats_path: str | None = None
     stats_injected: bool = False       # daemon-owned stats tmp file
@@ -163,6 +165,8 @@ class ServiceStats:
         self.jobs_failed = 0
         self.jobs_preempted = 0
         self.jobs_cancelled = 0
+        self.jobs_evicted = 0         # terminal results dropped by
+        #                               --result-ttl-s / --max-results
         self._rollup: dict = {}
         self._lock = threading.Lock()
 
@@ -180,7 +184,8 @@ class ServiceStats:
 
     def as_dict(self, queue_depth: int = 0, running: int = 0,
                 draining: bool = False, max_queue: int = 0,
-                max_concurrent: int = 0) -> dict:
+                max_concurrent: int = 0,
+                breaker_state: int = 0) -> dict:
         from pwasm_tpu.service.protocol import PROTOCOL_VERSION
         with self._lock:
             rollup = _copy_tree(self._rollup)
@@ -190,8 +195,13 @@ class ServiceStats:
             "protocol_version": PROTOCOL_VERSION,
             "uptime_s": round(time.time() - self.t0, 3),
             "draining": draining,
+            # queue_depth / running / breaker_state are SOURCED FROM
+            # the daemon's metrics registry (the Prometheus surface):
+            # one producer, two renderings, so svc-stats and a scrape
+            # cannot disagree (ISSUE 6 satellite)
             "queue_depth": queue_depth,
             "running": running,
+            "breaker_state": breaker_state,
             "max_queue": max_queue,
             "max_concurrent": max_concurrent,
             "jobs": {
@@ -202,6 +212,7 @@ class ServiceStats:
                 "failed": self.jobs_failed,
                 "preempted": self.jobs_preempted,
                 "cancelled": self.jobs_cancelled,
+                "evicted": self.jobs_evicted,
             },
             # the warm-pool promise, observable: probes paid vs probe
             # checks answered from the warm process state

@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Bench regression gate (ROADMAP item 4: "make the bench a
+regression gate").
+
+Compares a FRESH bench run's config legs against the committed
+trajectory (``BENCH_ALL.json``) and fails — exit 1 — on any
+unexplained regression beyond a tolerance:
+
+- wall-clock metrics (unit ``s``): regression = new wall slower than
+  ``old * (1 + tolerance)``;
+- rate metrics (unit ending in ``/s``): regression = new rate below
+  ``old * (1 - tolerance)``;
+- boolean/parity legs (unit ``bool``): regression = a leg that WAS
+  passing (truthy) now failing — a gained capability (0 -> 1) never
+  regresses the gate.
+
+Metrics present on only one side are reported as informational skips,
+never failures: a new bench leg must be able to land before its first
+trajectory entry exists, and a retired leg must not wedge the gate
+forever.  ``--allow=metric1,metric2`` waives named metrics for one run
+(an EXPLAINED slowdown — e.g. a deliberate precision/throughput trade
+— is waived explicitly, in the PR that explains it, not silently
+absorbed by a looser tolerance).
+
+Usage:
+    python bench.py ... > /tmp/fresh.json   # or PWASM_BENCH_OUT
+    python qa/bench_gate.py NEW.json [--baseline=BENCH_ALL.json]
+        [--tolerance=0.25] [--allow=metric_a,metric_b]
+
+``NEW.json`` may be either the aggregate array (BENCH_ALL.json shape)
+or a stream of one-JSON-object lines (bench.py stdout shape); rows
+need ``metric``/``value``/``unit``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+DEFAULT_BASELINE = os.path.join(ROOT, "BENCH_ALL.json")
+DEFAULT_TOLERANCE = 0.25   # bench walls on shared CPU runners are
+#   noisy at the ±10-15% level; 25% is past noise but well under the
+#   2x-class regressions the gate exists to catch
+
+
+def load_rows(path: str) -> list[dict]:
+    """Load bench rows from an aggregate JSON array or an NDJSON
+    stream of per-leg objects (both shapes bench.py produces)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        data = json.loads(text)
+        if isinstance(data, dict):
+            data = [data]
+        return [r for r in data if isinstance(r, dict)]
+    except ValueError:
+        rows = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict) and "metric" in obj:
+                rows.append(obj)
+        return rows
+
+
+def index_rows(rows: list[dict]) -> dict[str, dict]:
+    out = {}
+    for r in rows:
+        name = r.get("metric")
+        if isinstance(name, str) and isinstance(
+                r.get("value"), (int, float)):
+            out[name] = r   # last occurrence wins (latest leg)
+    return out
+
+
+def _direction(unit: str) -> str:
+    """lower = lower-is-better (walls), higher = higher-is-better
+    (rates), bool = pass/fail leg, none = ungated (counts, ids)."""
+    if unit == "s":
+        return "lower"
+    if unit.endswith("/s"):
+        return "higher"
+    if unit == "bool":
+        return "bool"
+    return "none"
+
+
+def compare(new_rows: list[dict], base_rows: list[dict],
+            tolerance: float = DEFAULT_TOLERANCE,
+            allow: frozenset[str] | set[str] = frozenset()) -> dict:
+    """Pure comparison (the testable core): returns
+    ``{"regressions": [...], "waived": [...], "improved": [...],
+    "skipped": [...], "checked": N}`` where each entry is a dict with
+    metric/unit/old/new/ratio."""
+    new = index_rows(new_rows)
+    base = index_rows(base_rows)
+    regressions, waived, improved, skipped = [], [], [], []
+    checked = 0
+    for name in sorted(set(new) | set(base)):
+        if name not in new or name not in base:
+            skipped.append({"metric": name,
+                            "why": "missing from "
+                            + ("baseline" if name in new else "run")})
+            continue
+        unit = str(base[name].get("unit", ""))
+        d = _direction(unit)
+        if d == "none":
+            continue
+        old_v, new_v = base[name]["value"], new[name]["value"]
+        checked += 1
+        entry = {"metric": name, "unit": unit, "old": old_v,
+                 "new": new_v}
+        bad = False
+        if d == "bool":
+            bad = bool(old_v) and not bool(new_v)
+        elif old_v <= 0:
+            skipped.append({"metric": name,
+                            "why": f"non-positive baseline {old_v}"})
+            checked -= 1
+            continue
+        elif d == "lower":
+            entry["ratio"] = round(new_v / old_v, 4)
+            bad = new_v > old_v * (1.0 + tolerance)
+            if new_v < old_v:
+                improved.append(entry)
+        else:
+            entry["ratio"] = round(new_v / old_v, 4)
+            bad = new_v < old_v * (1.0 - tolerance)
+            if new_v > old_v:
+                improved.append(entry)
+        if bad:
+            (waived if name in allow else regressions).append(entry)
+    return {"regressions": regressions, "waived": waived,
+            "improved": improved, "skipped": skipped,
+            "checked": checked}
+
+
+def main(argv: list[str]) -> int:
+    new_path = None
+    baseline = DEFAULT_BASELINE
+    tolerance = DEFAULT_TOLERANCE
+    allow: set[str] = set()
+    for a in argv:
+        if a.startswith("--baseline="):
+            baseline = a.split("=", 1)[1]
+        elif a.startswith("--tolerance="):
+            import math
+            try:
+                tolerance = float(a.split("=", 1)[1])
+                # nan/inf would make every comparison False — a gate
+                # silently disabled by a CI templating typo
+                if tolerance < 0 or not math.isfinite(tolerance):
+                    raise ValueError
+            except ValueError:
+                print(f"bench_gate: bad --tolerance: {a}",
+                      file=sys.stderr)
+                return 2
+        elif a.startswith("--allow="):
+            allow |= {s for s in a.split("=", 1)[1].split(",") if s}
+        elif a.startswith("-"):
+            print(__doc__, file=sys.stderr)
+            return 2
+        elif new_path is None:
+            new_path = a
+        else:
+            print(__doc__, file=sys.stderr)
+            return 2
+    if new_path is None:
+        print(__doc__, file=sys.stderr)
+        return 2
+    try:
+        new_rows = load_rows(new_path)
+        base_rows = load_rows(baseline)
+    except OSError as e:
+        print(f"bench_gate: {e}", file=sys.stderr)
+        return 2
+    res = compare(new_rows, base_rows, tolerance, frozenset(allow))
+    for e in res["skipped"]:
+        print(f"bench_gate: skip {e['metric']} ({e['why']})")
+    for e in res["improved"]:
+        print(f"bench_gate: improved {e['metric']}: {e['old']} -> "
+              f"{e['new']} {e['unit']}")
+    for e in res["waived"]:
+        print(f"bench_gate: WAIVED regression {e['metric']}: "
+              f"{e['old']} -> {e['new']} {e['unit']} (--allow)")
+    for e in res["regressions"]:
+        print(f"bench_gate: REGRESSION {e['metric']}: {e['old']} -> "
+              f"{e['new']} {e['unit']} "
+              f"(ratio {e.get('ratio', 'n/a')}, tolerance "
+              f"{tolerance:g})", file=sys.stderr)
+    n = len(res["regressions"])
+    print(f"bench_gate: {res['checked']} metric(s) checked, "
+          f"{n} regression(s), {len(res['waived'])} waived")
+    return 1 if n else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

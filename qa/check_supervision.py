@@ -68,11 +68,30 @@ REGISTRY = {
 # jax use in service code would be a device entry point outside BOTH
 # the supervision contract and the per-job fault-injection/guardrail
 # machinery.  (The generic PATTERNS above still apply to service
-# modules too; this adds the import-level tripwire.)
+# modules too; this adds the import-level tripwire.)  The same rule
+# covers pwasm_tpu/obs/ (ISSUE 6): the observability layer runs on
+# the plain-CPU path, inside signal-handler-adjacent code and in the
+# jax-free daemon — an obs module importing jax would smuggle backend
+# init into all three.
 SERVICE_DIR = "pwasm_tpu/service"
+OBS_DIR = "pwasm_tpu/obs"
 SERVICE_PATTERNS = re.compile(
     r"^\s*(?:import\s+jax\b|from\s+jax[.\s])|jax\.jit|jax\.device_put"
     r"|jax\.device_get|\.block_until_ready\s*\(")
+
+# ---- metric-name lint (ISSUE 6 satellite) -----------------------------
+# Every metric registration (registry.counter/gauge/histogram) in
+# pwasm_tpu/ must live in obs/catalog.py — the catalog IS the metric
+# namespace, so an operator reading docs/OBSERVABILITY.md sees every
+# series that can exist.  Within the catalog, names must be snake_case
+# with the pwasm_ prefix and appear exactly once (a duplicate would
+# alias two meanings onto one time series; the registry also raises at
+# runtime, but the lint fails at review time).
+METRIC_CATALOG = "pwasm_tpu/obs/catalog.py"
+METRIC_REGISTER_RE = re.compile(
+    r"\.\s*(?:counter|gauge|histogram)\s*\(")
+METRIC_NAME_RE = re.compile(r"^pwasm_[a-z0-9]+(_[a-z0-9]+)*$")
+METRIC_LITERAL_RE = re.compile(r"""["'](pwasm_[A-Za-z0-9_]*)["']""")
 
 
 def find_hits(root: str = REPO) -> list[tuple[str, int, str]]:
@@ -106,15 +125,13 @@ def find_unregistered(root: str = REPO) -> list[str]:
     return out
 
 
-def find_service_violations(root: str = REPO) -> list[str]:
-    """Service-side device entry points (see SERVICE_PATTERNS): the
-    daemon/client/queue/protocol modules must stay jax-free — device
-    work belongs behind cli.run's BatchSupervisor sites."""
+def _find_jaxfree_violations(root: str, subdir: str,
+                             what: str) -> list[str]:
     out = []
-    svc = os.path.join(root, *SERVICE_DIR.split("/"))
-    if not os.path.isdir(svc):
+    top = os.path.join(root, *subdir.split("/"))
+    if not os.path.isdir(top):
         return out
-    for dirpath, dirnames, filenames in os.walk(svc):
+    for dirpath, dirnames, filenames in os.walk(top):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
         for fn in sorted(filenames):
             if not fn.endswith(".py"):
@@ -127,9 +144,74 @@ def find_service_violations(root: str = REPO) -> list[str]:
                         continue
                     if SERVICE_PATTERNS.search(line):
                         out.append(
-                            f"{rel}:{i}: service module touches jax "
+                            f"{rel}:{i}: {what} module touches jax "
                             f"directly: {line.strip()} — route device "
                             "work through cli.run's supervised sites")
+    return out
+
+
+def find_service_violations(root: str = REPO) -> list[str]:
+    """Service-side device entry points (see SERVICE_PATTERNS): the
+    daemon/client/queue/protocol modules must stay jax-free — device
+    work belongs behind cli.run's BatchSupervisor sites."""
+    return _find_jaxfree_violations(root, SERVICE_DIR, "service")
+
+
+def find_obs_violations(root: str = REPO) -> list[str]:
+    """Observability-side jax use (ISSUE 6): pwasm_tpu/obs/ must stay
+    jax-free — it runs on the plain-CPU path, in the daemon, and in
+    signal-handler-adjacent code."""
+    return _find_jaxfree_violations(root, OBS_DIR, "obs")
+
+
+def find_metric_lint(root: str = REPO) -> list[str]:
+    """The metric-name lint (module docstring): registrations only in
+    the catalog; catalog names snake_case, ``pwasm_``-prefixed, unique."""
+    out: list[str] = []
+    pkg = os.path.join(root, "pwasm_tpu")
+    catalog_path = os.path.join(root, *METRIC_CATALOG.split("/"))
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            if rel == METRIC_CATALOG \
+                    or rel == OBS_DIR + "/metrics.py":
+                continue   # the catalog itself + the registry impl
+            with open(path, encoding="utf-8") as f:
+                for i, line in enumerate(f, 1):
+                    if line.lstrip().startswith("#"):
+                        continue
+                    # the CALL alone is the violation — requiring the
+                    # name literal on the same line would let any
+                    # multi-line registration (the repo's normal
+                    # style) slip past the lint
+                    if METRIC_REGISTER_RE.search(line):
+                        out.append(
+                            f"{rel}:{i}: metric registered outside "
+                            f"the catalog: {line.strip()} — move the "
+                            f"registration to {METRIC_CATALOG}")
+    if not os.path.isfile(catalog_path):
+        return out
+    seen: dict[str, int] = {}
+    with open(catalog_path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            if line.lstrip().startswith("#"):
+                continue
+            for name in METRIC_LITERAL_RE.findall(line):
+                if not METRIC_NAME_RE.match(name):
+                    out.append(
+                        f"{METRIC_CATALOG}:{i}: metric name {name!r} "
+                        "violates the grammar (snake_case, pwasm_ "
+                        "prefix)")
+                if name in seen:
+                    out.append(
+                        f"{METRIC_CATALOG}:{i}: duplicate metric "
+                        f"name {name!r} (first at line {seen[name]})")
+                else:
+                    seen[name] = i
     return out
 
 
@@ -144,12 +226,14 @@ def main() -> int:
     bad = find_unregistered()
     stale = stale_registry_entries()
     svc = find_service_violations()
+    obs = find_obs_violations()
+    metric = find_metric_lint()
     for line in bad:
         print(line, file=sys.stderr)
     for rel in stale:
         print(f"{rel}: stale registry entry (no device entry points "
               "left — remove it)", file=sys.stderr)
-    for line in svc:
+    for line in svc + obs + metric:
         print(line, file=sys.stderr)
     if bad:
         print(f"\n{len(bad)} device entry point(s) outside the "
@@ -157,12 +241,17 @@ def main() -> int:
               "through a supervised site (resilience/supervisor.py) or "
               "register the module in qa/check_supervision.py with a "
               "justification.", file=sys.stderr)
-    if svc:
-        print(f"\n{len(svc)} direct jax use(s) in pwasm_tpu/service/. "
-              "The warm-pool daemon reaches the device only through "
-              "cli.run's supervised sites — move the device work "
-              "there.", file=sys.stderr)
-    return 1 if (bad or stale or svc) else 0
+    if svc or obs:
+        print(f"\n{len(svc) + len(obs)} direct jax use(s) in "
+              "pwasm_tpu/service/ or pwasm_tpu/obs/.  These layers "
+              "reach the device only through cli.run's supervised "
+              "sites — move the device work there.", file=sys.stderr)
+    if metric:
+        print(f"\n{len(metric)} metric-name lint failure(s): all "
+              "registrations live in pwasm_tpu/obs/catalog.py with "
+              "snake_case pwasm_-prefixed unique names.",
+              file=sys.stderr)
+    return 1 if (bad or stale or svc or obs or metric) else 0
 
 
 if __name__ == "__main__":

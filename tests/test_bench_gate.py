@@ -1,0 +1,111 @@
+"""Bench regression gate (``qa/bench_gate.py``, ROADMAP item 4): a
+fresh bench run's legs compared against the committed BENCH_ALL.json
+trajectory — wall slowdowns and rate drops beyond tolerance fail, lost
+boolean/parity legs fail, new/retired legs skip, ``--allow`` waives an
+explained regression explicitly."""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def gate():
+    for p in (REPO, os.path.join(REPO, "qa")):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    import bench_gate
+    return bench_gate
+
+
+def _rows(**vals):
+    out = []
+    for name, (value, unit) in vals.items():
+        out.append({"metric": name, "value": value, "unit": unit,
+                    "config": 8})
+    return out
+
+
+def test_wall_slowdown_fails_within_tolerance_passes(gate):
+    base = _rows(wall=(1.0, "s"))
+    ok = gate.compare(_rows(wall=(1.2, "s")), base, tolerance=0.25)
+    assert ok["regressions"] == [] and ok["checked"] == 1
+    bad = gate.compare(_rows(wall=(1.3, "s")), base, tolerance=0.25)
+    assert [r["metric"] for r in bad["regressions"]] == ["wall"]
+    assert bad["regressions"][0]["ratio"] == pytest.approx(1.3)
+
+
+def test_rate_drop_fails_gain_improves(gate):
+    base = _rows(rate=(1000.0, "bases/s"))
+    bad = gate.compare(_rows(rate=(700.0, "bases/s")), base,
+                       tolerance=0.25)
+    assert [r["metric"] for r in bad["regressions"]] == ["rate"]
+    good = gate.compare(_rows(rate=(2000.0, "bases/s")), base)
+    assert good["regressions"] == []
+    assert [r["metric"] for r in good["improved"]] == ["rate"]
+
+
+def test_bool_leg_lost_fails_gained_passes(gate):
+    base = _rows(parity=(1, "bool"), lowering=(0, "bool"))
+    res = gate.compare(_rows(parity=(0, "bool"), lowering=(1, "bool")),
+                       base)
+    assert [r["metric"] for r in res["regressions"]] == ["parity"]
+
+
+def test_missing_metrics_skip_not_fail(gate):
+    base = _rows(wall=(1.0, "s"), retired=(2.0, "s"))
+    res = gate.compare(_rows(wall=(1.0, "s"), fresh=(3.0, "s")), base)
+    assert res["regressions"] == []
+    skipped = {e["metric"] for e in res["skipped"]}
+    assert skipped == {"retired", "fresh"}
+
+
+def test_allow_waives_named_regression(gate):
+    base = _rows(wall=(1.0, "s"))
+    res = gate.compare(_rows(wall=(9.0, "s")), base,
+                       allow=frozenset({"wall"}))
+    assert res["regressions"] == [] \
+        and [r["metric"] for r in res["waived"]] == ["wall"]
+
+
+def test_ungated_units_and_bad_baseline_skip(gate):
+    base = _rows(count=(5, "alignments"), zero=(0.0, "s"))
+    res = gate.compare(_rows(count=(50, "alignments"),
+                             zero=(1.0, "s")), base)
+    assert res["regressions"] == [] and res["checked"] == 0
+
+
+def test_load_rows_both_shapes(gate, tmp_path):
+    rows = _rows(wall=(1.0, "s"))
+    agg = tmp_path / "agg.json"
+    agg.write_text(json.dumps(rows))
+    nd = tmp_path / "nd.json"
+    nd.write_text("not json\n" + "".join(
+        json.dumps(r) + "\n" for r in rows))
+    assert gate.index_rows(gate.load_rows(str(agg))).keys() == {"wall"}
+    assert gate.index_rows(gate.load_rows(str(nd))).keys() == {"wall"}
+
+
+def test_cli_self_compare_committed_trajectory_passes(gate, capsys):
+    """The committed BENCH_ALL.json gates cleanly against itself —
+    the invariant every PR's fresh run is compared under."""
+    rc = gate.main([os.path.join(REPO, "BENCH_ALL.json")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "0 regression(s)" in out
+
+
+def test_cli_exit_codes(gate, tmp_path, capsys):
+    new = tmp_path / "new.json"
+    new.write_text(json.dumps(_rows(
+        realistic_pycli_wall_s=(99.0, "s"))))
+    assert gate.main([str(new)]) == 1
+    assert gate.main([str(new),
+                      "--allow=realistic_pycli_wall_s"]) == 0
+    assert gate.main([]) == 2
+    for bad in ("bogus", "nan", "inf", "-0.5"):
+        assert gate.main([str(new), f"--tolerance={bad}"]) == 2

@@ -1,0 +1,557 @@
+"""Fleet-grade observability (ISSUE 6): the pwasm_tpu.obs subsystem.
+
+Acceptance contracts exercised here:
+
+- **exposition format**: the MetricsRegistry renders valid Prometheus
+  text exposition — HELP/TYPE headers, label escaping, histogram
+  bucket CUMULATIVITY (each ``le`` counts observations at-or-under,
+  ``+Inf`` equals ``_count``), gauge set/reset;
+- **trace schema**: ``--trace-json`` writes Chrome trace-event JSON
+  whose complete spans nest monotonically (a child's ``[ts, ts+dur]``
+  interval sits inside its parent's on the same thread);
+- **event-log replay**: a scripted flap (``down=A-B``) shows
+  breaker_trip -> reprobe -> breaker_half_open -> breaker_reclose in
+  the NDJSON log, and an ``oom=N`` leg shows
+  oom/batch_split/bucket_demotion — the resilience machinery is
+  observable WHILE it happens, not just in end-of-run counters;
+- **byte parity**: every report output is byte-identical with all
+  observability flags on vs off (observability writes only to its own
+  sinks), and the ``--stats`` schema is unchanged.
+"""
+
+import io
+import json
+import re
+
+import numpy as np
+import pytest
+
+from pwasm_tpu.cli import run
+from pwasm_tpu.core.fasta import write_fasta
+from pwasm_tpu.obs import (EventLog, MetricsRegistry, Observability,
+                           TraceRecorder, make_observability)
+from pwasm_tpu.obs.catalog import (breaker_state_value,
+                                   build_run_metrics,
+                                   build_service_metrics,
+                                   fold_run_stats)
+
+from helpers import make_paf_line
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+def test_metric_name_grammar_enforced():
+    reg = MetricsRegistry()
+    for bad in ("queue_depth", "pwasm_Queue", "pwasm_", "pwasm_a-b",
+                "Pwasm_x", "pwasm_x__y"):
+        with pytest.raises(ValueError):
+            reg.counter(bad, "h")
+    assert reg.counter("pwasm_ok_total", "h").name == "pwasm_ok_total"
+
+
+def test_duplicate_registration_raises():
+    reg = MetricsRegistry()
+    reg.gauge("pwasm_depth", "h")
+    with pytest.raises(ValueError):
+        reg.counter("pwasm_depth", "h")
+
+
+def test_counter_monotonic():
+    reg = MetricsRegistry()
+    c = reg.counter("pwasm_jobs_total", "h", labels=("outcome",))
+    c.inc(outcome="done")
+    c.inc(2, outcome="done")
+    c.inc(outcome="failed")
+    assert c.value(outcome="done") == 3
+    assert c.value(outcome="failed") == 1
+    with pytest.raises(ValueError):
+        c.inc(-1, outcome="done")
+    with pytest.raises(ValueError):
+        c.inc(1)   # missing declared label
+
+
+def test_gauge_set_inc_reset_exposed():
+    reg = MetricsRegistry()
+    g = reg.gauge("pwasm_depth", "queue depth")
+    g.set(5)
+    g.inc()
+    g.dec(2)
+    assert g.value() == 4
+    assert "pwasm_depth 4" in reg.expose().splitlines()
+    g.reset()
+    assert g.value() == 0
+    assert "pwasm_depth 0" in reg.expose().splitlines()
+
+
+def test_histogram_bucket_cumulativity():
+    reg = MetricsRegistry()
+    h = reg.histogram("pwasm_wall_seconds", "h",
+                      buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    lines = reg.expose().splitlines()
+    sample = {}
+    for ln in lines:
+        if ln.startswith("#"):
+            continue
+        k, v = ln.rsplit(" ", 1)
+        sample[k] = float(v)
+    # CUMULATIVE buckets: le=0.1 holds 2, le=1 holds those plus 0.5...
+    assert sample['pwasm_wall_seconds_bucket{le="0.1"}'] == 2
+    assert sample['pwasm_wall_seconds_bucket{le="1"}'] == 3
+    assert sample['pwasm_wall_seconds_bucket{le="10"}'] == 4
+    assert sample['pwasm_wall_seconds_bucket{le="+Inf"}'] == 5
+    assert sample["pwasm_wall_seconds_count"] == 5
+    assert sample["pwasm_wall_seconds_sum"] == pytest.approx(55.6)
+    # buckets must be declared sorted+unique
+    with pytest.raises(ValueError):
+        reg.histogram("pwasm_bad_seconds", "h", buckets=(1.0, 0.5))
+
+
+def test_exposition_escaping():
+    reg = MetricsRegistry()
+    c = reg.counter("pwasm_esc_total", 'help with \\ and\nnewline',
+                    labels=("path",))
+    c.inc(path='a"b\\c\nd')
+    text = reg.expose()
+    assert "# HELP pwasm_esc_total help with \\\\ and\\nnewline" \
+        in text.splitlines()
+    assert 'pwasm_esc_total{path="a\\"b\\\\c\\nd"} 1' \
+        in text.splitlines()
+
+
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z0-9_]+="(\\.|[^"\\])*"'
+    r'(,[a-zA-Z0-9_]+="(\\.|[^"\\])*")*\})? -?[0-9.e+Inf-]+$')
+
+
+def assert_valid_exposition(text: str) -> None:
+    """Minimal independent grammar check of the text exposition: every
+    line is a comment (HELP/TYPE) or a sample, every sample's family
+    was TYPEd first."""
+    typed = set()
+    assert text.endswith("\n")
+    for ln in text.splitlines():
+        if ln.startswith("# HELP ") or ln.startswith("# TYPE "):
+            parts = ln.split(" ", 3)
+            assert len(parts) >= 3
+            if parts[1] == "TYPE":
+                typed.add(parts[2])
+            continue
+        assert _SAMPLE_RE.match(ln), ln
+        name = re.split(r"[{ ]", ln, 1)[0]
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert name in typed or base in typed, ln
+
+
+def test_catalog_builds_valid_exposition():
+    reg = MetricsRegistry()
+    rm = build_run_metrics(reg)
+    sm = build_service_metrics(reg)
+    rm["batch_attempt_seconds"].observe(0.2, site="ctx_scan")
+    sm["jobs"].inc(outcome="done")
+    sm["job_wall_seconds"].observe(1.5)
+    fold_run_stats(rm, {"alignments": 3, "wall_s": 0.5,
+                        "resilience": {"breaker_trips": 1},
+                        "backend": {"probes": 1, "warm_hits": 2},
+                        "device": {"dispatches": 4, "flushes": 2}})
+    text = reg.expose()
+    assert_valid_exposition(text)
+    assert "pwasm_run_alignments_total 3" in text.splitlines()
+    assert "pwasm_breaker_trips_total 1" in text.splitlines()
+    assert "pwasm_backend_warm_hits_total 2" in text.splitlines()
+    # a malformed stats dict folds as zeros, never raises
+    fold_run_stats(rm, {"alignments": "gibberish",
+                        "resilience": "not-a-dict"})
+    fold_run_stats(rm, None)
+
+
+def test_breaker_state_encoding():
+    assert breaker_state_value(False) == 0
+    assert breaker_state_value(False, "half-open") == 0
+    assert breaker_state_value(True, "half-open") == 1
+    assert breaker_state_value(True, "open") == 2
+    assert breaker_state_value(True, None) == 2
+
+
+# ---------------------------------------------------------------------------
+# trace recorder
+# ---------------------------------------------------------------------------
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_trace_spans_nest_monotonically():
+    clk = _Clock()
+    rec = TraceRecorder(clock=clk)
+    with rec.span("outer", phase="run"):
+        clk.t = 1.0
+        with rec.span("inner", site="ctx_scan"):
+            clk.t = 2.0
+        clk.t = 3.0
+    doc = rec.to_dict()
+    evs = {e["name"]: e for e in doc["traceEvents"]}
+    inner, outer = evs["inner"], evs["outer"]
+    for e in (inner, outer):
+        assert e["ph"] == "X"
+        for key in ("ts", "dur", "pid", "tid", "args", "name"):
+            assert key in e
+    # containment: the child's interval sits inside the parent's
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
+    assert outer["dur"] == 3_000_000 and inner["dur"] == 1_000_000
+
+
+def test_trace_span_records_error_and_instant():
+    rec = TraceRecorder(clock=_Clock())
+    with pytest.raises(RuntimeError):
+        with rec.span("doomed"):
+            raise RuntimeError("x")
+    rec.instant("breaker_trip", site="ctx_scan")
+    evs = {e["name"]: e for e in rec.to_dict()["traceEvents"]}
+    assert evs["doomed"]["args"]["error"] == "RuntimeError"
+    assert evs["breaker_trip"]["ph"] == "i"
+
+
+def test_trace_event_cap_bounds_memory():
+    rec = TraceRecorder(clock=_Clock(), max_events=3)
+    for i in range(5):
+        rec.instant(f"e{i}")
+    assert len(rec.to_dict()["traceEvents"]) == 3
+    assert rec.to_dict()["otherData"]["dropped_events"] == 2
+
+
+# ---------------------------------------------------------------------------
+# event log
+# ---------------------------------------------------------------------------
+def test_event_log_lines_and_clocks():
+    buf = io.StringIO()
+    log = EventLog(buf, owns_stream=False)
+    log.emit("run_start", device="cpu")
+    log.emit("ckpt_write", records=4, skipme=None)
+    recs = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+    assert [r["event"] for r in recs] == ["run_start", "ckpt_write"]
+    assert all(r["run_id"] == log.run_id for r in recs)
+    assert all("ts_wall" in r and "ts_mono" in r for r in recs)
+    assert recs[0]["ts_mono"] <= recs[1]["ts_mono"]
+    assert "skipme" not in recs[1]   # None fields dropped
+    assert recs[1]["records"] == 4
+
+
+def test_event_log_never_raises():
+    class Dead:
+        def write(self, *_a):
+            raise OSError("gone")
+
+        def flush(self):
+            raise OSError("gone")
+
+    log = EventLog(Dead(), owns_stream=False)
+    log.emit("run_start")        # swallowed
+    log.close()
+    log.emit("after_close")      # no-op
+
+
+# ---------------------------------------------------------------------------
+# CLI wiring
+# ---------------------------------------------------------------------------
+def _corpus(tmp_path, n=24, qlen=120):
+    rng = np.random.default_rng(3)
+    q = "".join("ACGT"[i] for i in rng.integers(0, 4, qlen))
+    lines = []
+    for i in range(n):
+        cut = 10 + int(rng.integers(0, qlen - 40))
+        qb = q[cut]
+        tb = "ACGT"[("ACGT".index(qb) + 1) % 4]
+        ops = [("=", cut), ("*", tb, qb), ("=", 20), ("ins", "gg"),
+               ("=", qlen - cut - 21)]
+        lines.append(make_paf_line("q", q, f"asm{i}", "+", ops)[0])
+    fa = tmp_path / "q.fa"
+    write_fasta(str(fa), [("q", q.encode())])
+    paf = tmp_path / "in.paf"
+    paf.write_text("".join(ln + "\n" for ln in lines))
+    return str(paf), str(fa)
+
+
+def _cli(tmp_path, tag, extra, paf, fa, device="cpu"):
+    err = io.StringIO()
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / f"{tag}.dfa"),
+              "-s", str(tmp_path / f"{tag}.sum"),
+              "-w", str(tmp_path / f"{tag}.mfa"),
+              f"--device={device}", "--batch=2",
+              f"--stats={tmp_path / f'{tag}.json'}"] + extra, stderr=err)
+    return rc, err.getvalue()
+
+
+def _outs(tmp_path, tag):
+    return tuple((tmp_path / f"{tag}.{ext}").read_bytes()
+                 for ext in ("dfa", "sum", "mfa"))
+
+
+def _events(path):
+    return [json.loads(ln) for ln in open(path)]
+
+
+def test_cli_byte_parity_with_all_obs_flags(tmp_path):
+    """THE acceptance bar: -o/-s/-w bytes identical with every
+    observability flag armed vs none, and the --stats schema keys
+    unchanged (observability is additive, never perturbing)."""
+    paf, fa = _corpus(tmp_path, n=12)
+    rc, err = _cli(tmp_path, "off", [], paf, fa)
+    assert rc == 0, err
+    rc, err = _cli(tmp_path, "on", [
+        f"--trace-json={tmp_path / 't.json'}",
+        f"--log-json={tmp_path / 'ev.ndjson'}",
+        f"--metrics-textfile={tmp_path / 'm.prom'}"], paf, fa)
+    assert rc == 0, err
+    assert _outs(tmp_path, "on") == _outs(tmp_path, "off")
+
+    def keys(d, pre=""):
+        out = set()
+        for k, v in d.items():
+            out.add(pre + k)
+            if isinstance(v, dict):
+                out |= keys(v, pre + k + ".")
+        return out
+
+    off = json.loads((tmp_path / "off.json").read_text())
+    on = json.loads((tmp_path / "on.json").read_text())
+    assert keys(on) == keys(off)
+    assert on["stats_version"] == off["stats_version"]
+    # all three sinks landed
+    assert (tmp_path / "t.json").is_file()
+    assert (tmp_path / "ev.ndjson").is_file()
+    assert (tmp_path / "m.prom").is_file()
+
+
+def test_cli_metrics_textfile_matches_stats(tmp_path):
+    paf, fa = _corpus(tmp_path, n=8)
+    rc, err = _cli(tmp_path, "m", [
+        f"--metrics-textfile={tmp_path / 'm.prom'}"], paf, fa)
+    assert rc == 0, err
+    text = (tmp_path / "m.prom").read_text()
+    assert_valid_exposition(text)
+    st = json.loads((tmp_path / "m.json").read_text())
+    lines = text.splitlines()
+    assert f"pwasm_run_alignments_total {st['alignments']}" in lines
+    assert f"pwasm_run_events_total {st['events']}" in lines
+    assert "pwasm_run_breaker_state 0" in lines
+    assert 'pwasm_run_finished_total{outcome="completed"} 1' in lines
+    # no tmp remnant from the atomic publish
+    leftovers = [p.name for p in tmp_path.iterdir()
+                 if ".prom." in p.name]
+    assert leftovers == []
+
+
+def test_cli_log_json_stdout_dash(tmp_path):
+    paf, fa = _corpus(tmp_path, n=4)
+    out = io.StringIO()
+    err = io.StringIO()
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / "d.dfa"),
+              "--log-json=-"], stdout=out, stderr=err)
+    assert rc == 0, err.getvalue()
+    evs = [json.loads(ln) for ln in out.getvalue().splitlines()]
+    assert evs[0]["event"] == "run_start"
+    assert evs[-1]["event"] == "run_finish"
+    assert evs[-1]["rc"] == 0
+
+
+@pytest.mark.parametrize("flag", ["--trace-json", "--log-json",
+                                  "--metrics-textfile"])
+def test_obs_flags_require_value(tmp_path, flag):
+    paf, fa = _corpus(tmp_path, n=2)
+    err = io.StringIO()
+    rc = run([paf, "-r", fa, flag], stderr=err)
+    assert rc == 1
+    assert "requires a file argument" in err.getvalue()
+
+
+def test_log_json_dash_requires_report_file(tmp_path):
+    """Without -o the report itself streams to stdout — event lines
+    interleaved with report rows would corrupt both, so the
+    combination is a usage error, not a footgun."""
+    paf, fa = _corpus(tmp_path, n=2)
+    err = io.StringIO()
+    rc = run([paf, "-r", fa, "--log-json=-"], stderr=err)
+    assert rc == 1
+    assert "--log-json=- requires -o" in err.getvalue()
+
+
+def test_log_json_appends_across_runs(tmp_path):
+    """The event log is append-only as documented: a second run (or a
+    restarted daemon) extends the incident timeline, never wipes it."""
+    paf, fa = _corpus(tmp_path, n=2)
+    log = tmp_path / "runs.ndjson"
+    for _ in range(2):
+        err = io.StringIO()
+        rc = run([paf, "-r", fa, "-o", str(tmp_path / "a.dfa"),
+                  f"--log-json={log}"], stderr=err)
+        assert rc == 0, err.getvalue()
+    evs = _events(log)
+    assert [e["event"] for e in evs].count("run_start") == 2
+    assert len({e["run_id"] for e in evs}) == 2
+
+
+def test_cli_flap_replay_in_event_log(tmp_path, monkeypatch):
+    """The scripted flap (down=3-6) replayed from the NDJSON log: the
+    trip, the bounded re-probes, the half-open and the reclose appear
+    AS EVENTS in order — and bytes stay identical to the clean run."""
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path)
+    rc, err = _cli(tmp_path, "ref", [], paf, fa, device="tpu")
+    assert rc == 0, err
+    rc, err = _cli(tmp_path, "flap", [
+        "--inject-faults=down=3-6", "--max-retries=4",
+        "--reprobe-interval=0",
+        f"--log-json={tmp_path / 'flap.ndjson'}",
+        f"--trace-json={tmp_path / 'flap.trace'}"], paf, fa,
+        device="tpu")
+    assert rc == 0, err
+    assert _outs(tmp_path, "flap") == _outs(tmp_path, "ref")
+    evs = _events(tmp_path / "flap.ndjson")
+    kinds = [e["event"] for e in evs]
+    assert "breaker_trip" in kinds
+    assert "reprobe" in kinds
+    assert "breaker_half_open" in kinds
+    assert "breaker_reclose" in kinds
+    # ordering: trip before half-open before reclose
+    assert kinds.index("breaker_trip") \
+        < kinds.index("breaker_half_open") \
+        < kinds.index("breaker_reclose")
+    # every event shares the run id and monotonic time never regresses
+    assert len({e["run_id"] for e in evs}) == 1
+    monos = [e["ts_mono"] for e in evs]
+    assert monos == sorted(monos)
+    trip = next(e for e in evs if e["event"] == "breaker_trip")
+    assert trip["site"] == "ctx_scan" and trip["why"]
+    st = json.loads((tmp_path / "flap.json").read_text())["resilience"]
+    assert st["breaker_trips"] == 1 and st["breaker_recloses"] >= 1
+    # the same transitions land on the trace timeline as instant marks
+    tr = json.loads((tmp_path / "flap.trace").read_text())
+    instants = {e["name"] for e in tr["traceEvents"]
+                if e["ph"] == "i"}
+    assert {"breaker_trip", "breaker_reclose"} <= instants
+
+
+def test_cli_oom_bisection_replay_in_event_log(tmp_path, monkeypatch):
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path, n=16)
+    err = io.StringIO()
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / "oom.dfa"),
+              "--device=tpu", "--batch=8", "--inject-faults=oom=2",
+              f"--log-json={tmp_path / 'oom.ndjson'}",
+              f"--stats={tmp_path / 'oom.json'}"], stderr=err)
+    assert rc == 0, err.getvalue()
+    kinds = [e["event"] for e in _events(tmp_path / "oom.ndjson")]
+    assert "oom" in kinds and "batch_split" in kinds \
+        and "bucket_demotion" in kinds
+    assert kinds.index("oom") < kinds.index("bucket_demotion")
+    res = json.loads((tmp_path / "oom.json").read_text())["resilience"]
+    assert res["oom_events"] > 0 and res["batch_splits"] > 0
+    assert res["breaker_trips"] == 0
+
+
+def test_cli_trace_json_schema_and_nesting(tmp_path, monkeypatch):
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path, n=8)
+    rc, err = _cli(tmp_path, "tr", [
+        f"--trace-json={tmp_path / 'tr.trace'}"], paf, fa,
+        device="tpu")
+    assert rc == 0, err
+    doc = json.loads((tmp_path / "tr.trace").read_text())
+    evs = doc["traceEvents"]
+    assert isinstance(evs, list) and evs
+    for e in evs:
+        assert e["ph"] in ("X", "i")
+        assert isinstance(e["ts"], int) and e["ts"] >= 0
+        if e["ph"] == "X":
+            assert isinstance(e["dur"], int) and e["dur"] >= 0
+    names = {e["name"] for e in evs}
+    assert {"run", "input_loop", "device_batch",
+            "msa_tail"} <= names
+    # monotonic nesting: every same-thread span sits inside the run
+    # span, and each device_batch sits inside some flush/run interval
+    spans = [e for e in evs if e["ph"] == "X"]
+    runs = [e for e in spans if e["name"] == "run"]
+    assert len(runs) == 1
+    r0, r1 = runs[0]["ts"], runs[0]["ts"] + runs[0]["dur"]
+    for e in spans:
+        if e["tid"] == runs[0]["tid"] and e is not runs[0]:
+            assert r0 <= e["ts"] and e["ts"] + e["dur"] <= r1, e
+
+
+def test_cli_ckpt_write_and_preempt_events(tmp_path, monkeypatch):
+    """A scripted preemption drains at a batch boundary: the log shows
+    the drain request and a run_finish with rc 75 — the incident
+    timeline an operator replays after a fleet preemption."""
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path, n=16)
+    err = io.StringIO()
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / "p.dfa"),
+              "--device=tpu", "--batch=2",
+              "--inject-faults=preempt=3",
+              f"--log-json={tmp_path / 'p.ndjson'}"], stderr=err)
+    assert rc == 75, err.getvalue()
+    evs = _events(tmp_path / "p.ndjson")
+    kinds = [e["event"] for e in evs]
+    assert "ckpt_write" in kinds
+    assert "drain" in kinds
+    fin = evs[-1]
+    assert fin["event"] == "run_finish" and fin["rc"] == 75 \
+        and fin["preempted"] is True
+
+
+def test_observability_facade_null_hooks():
+    """The null bundle must absorb every hook cheaply (the default
+    wiring for every run without obs flags)."""
+    from pwasm_tpu.obs import NULL_OBS
+    assert not NULL_OBS.enabled
+    with NULL_OBS.span("x", a=1):
+        NULL_OBS.event("anything", n=3)
+    NULL_OBS.observe("batch_attempt_seconds", 0.1, site="s")
+    NULL_OBS.set_gauge("breaker_state", 2)
+    NULL_OBS.span_complete("y", NULL_OBS.clock())
+
+
+def test_make_observability_subsets(tmp_path):
+    obs = make_observability()
+    assert not obs.enabled
+    obs = make_observability(log_json=str(tmp_path / "e.ndjson"))
+    assert obs.enabled and obs.registry is None
+    obs.event("run_start")
+    obs.close(io.StringIO())
+    assert _events(tmp_path / "e.ndjson")[0]["event"] == "run_start"
+    obs = make_observability(
+        metrics_textfile=str(tmp_path / "m.prom"))
+    assert obs.registry is not None and obs.run_metrics
+    obs.close(io.StringIO())
+    assert_valid_exposition((tmp_path / "m.prom").read_text())
+
+
+def test_observability_wraps_into_supervisor_histogram():
+    """The supervisor observes every attempt's wall into the per-site
+    histogram — success AND failure attempts."""
+    from pwasm_tpu.resilience import BatchSupervisor, ResiliencePolicy
+    reg = MetricsRegistry()
+    rm = build_run_metrics(reg)
+    obs = Observability(registry=reg, run_metrics=rm)
+    sup = BatchSupervisor(
+        ResiliencePolicy(max_retries=1, backoff_s=0.001,
+                         backoff_cap_s=0.002),
+        stderr=io.StringIO(), obs=obs, probe=lambda: (True, ""))
+    assert sup.run("ctx_scan", lambda: "ok") == "ok"
+    boom = [True]
+
+    def flaky():
+        if boom.pop() if boom else False:
+            raise RuntimeError("transient")
+        return "ok2"
+
+    assert sup.run("ctx_scan", flaky) == "ok2"
+    h = rm["batch_attempt_seconds"]
+    assert h.count(site="ctx_scan") == 3   # 1 + (1 failed + 1 retry)

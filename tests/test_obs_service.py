@@ -1,0 +1,249 @@
+"""Daemon-side observability + result eviction (ISSUE 6).
+
+Contracts:
+
+- the ``metrics`` protocol command (and the ``pwasm-tpu metrics``
+  client verb) answer the daemon's full Prometheus text exposition —
+  queue depth, in-flight gauge, breaker state, per-job wall and
+  queue-wait histograms, job outcome counters, and the cumulative
+  fold of every finished job's ``--stats``;
+- ``serve --metrics-textfile=PATH`` republishes the same exposition
+  atomically after every job (no tmp remnant, always a whole
+  document);
+- ``svc-stats`` sources queue-depth/in-flight/breaker-state from the
+  SAME registry gauges, so the two operator surfaces cannot drift;
+- ``--result-ttl-s`` / ``--max-results`` evict TERMINAL job results
+  (LRU by last access); evicted ids answer ``unknown_job`` and the
+  eviction is counted on both surfaces.
+"""
+
+import io
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+from contextlib import contextmanager
+from types import SimpleNamespace
+
+from pwasm_tpu.cli import run
+from pwasm_tpu.service.client import ServiceClient, wait_for_socket
+from pwasm_tpu.service.daemon import Daemon
+
+from test_obs import _corpus as _obs_corpus
+from test_obs import assert_valid_exposition
+
+
+def _corpus(tmp_path, n=8, qlen=120):
+    return _obs_corpus(tmp_path, n=n, qlen=qlen)
+
+
+@contextmanager
+def _daemon(**kw):
+    sockdir = tempfile.mkdtemp(prefix="pwobs")
+    sock = os.path.join(sockdir, "s")
+    err = io.StringIO()
+    dm = Daemon(sock, stderr=err, **kw)
+    rcbox: list = []
+    t = threading.Thread(target=lambda: rcbox.append(dm.serve()),
+                         daemon=True)
+    t.start()
+    assert wait_for_socket(sock, 15), err.getvalue()
+    try:
+        yield SimpleNamespace(daemon=dm, sock=sock, rc=rcbox, err=err,
+                              thread=t)
+    finally:
+        if not dm.drain.requested:
+            dm.drain.request("test teardown")
+        t.join(20)
+        shutil.rmtree(sockdir, ignore_errors=True)
+
+
+def _submit_ok(c, tmp_path, tag, paf, fa):
+    sub = c.submit([paf, "-r", fa,
+                    "-o", str(tmp_path / f"{tag}.dfa"), "--batch=2"])
+    assert sub.get("ok"), sub
+    res = c.result(sub["job_id"], timeout=120)
+    assert res.get("ok") and res.get("rc") == 0, res
+    return sub["job_id"]
+
+
+def test_metrics_over_socket_covers_required_families(tmp_path):
+    paf, fa = _corpus(tmp_path)
+    with _daemon() as h:
+        with ServiceClient(h.sock) as c:
+            _submit_ok(c, tmp_path, "a", paf, fa)
+            resp = c.metrics()
+        assert resp.get("ok"), resp
+        text = resp["metrics"]
+    assert_valid_exposition(text)
+    lines = text.splitlines()
+    # the acceptance quartet: queue depth, warm-hit rate inputs,
+    # breaker state, per-job wall histogram
+    assert "pwasm_service_queue_depth 0" in lines
+    assert "pwasm_service_jobs_inflight 0" in lines
+    assert "pwasm_service_breaker_state 0" in lines
+    assert any(ln.startswith("pwasm_service_job_wall_seconds_bucket")
+               for ln in lines)
+    assert any(ln.startswith(
+        "pwasm_service_job_queue_wait_seconds_bucket")
+        for ln in lines)
+    assert "pwasm_backend_probes_total" in text
+    assert "pwasm_backend_warm_hits_total" in text
+    assert 'pwasm_service_jobs_total{outcome="accepted"} 1' in lines
+    assert 'pwasm_service_jobs_total{outcome="done"} 1' in lines
+    # the finished job's --stats folded into the cumulative families
+    assert "pwasm_run_alignments_total 8" in lines
+    assert 'pwasm_run_finished_total{outcome="completed"} 1' in lines
+
+
+def test_metrics_client_verb(tmp_path):
+    paf, fa = _corpus(tmp_path, n=4)
+    with _daemon() as h:
+        with ServiceClient(h.sock) as c:
+            _submit_ok(c, tmp_path, "a", paf, fa)
+        out, err = io.StringIO(), io.StringIO()
+        rc = run(["metrics", f"--socket={h.sock}"], stdout=out,
+                 stderr=err)
+    assert rc == 0, err.getvalue()
+    assert_valid_exposition(out.getvalue())
+    assert "pwasm_service_queue_depth" in out.getvalue()
+
+
+def test_svc_stats_sources_registry_and_versions(tmp_path):
+    paf, fa = _corpus(tmp_path, n=4)
+    with _daemon() as h:
+        with ServiceClient(h.sock) as c:
+            _submit_ok(c, tmp_path, "a", paf, fa)
+            st = c.stats()["stats"]
+            text = c.metrics()["metrics"]
+    assert st["stats_version"] == 1
+    # same registry, two renderings: the JSON fields must equal the
+    # gauge samples in the exposition taken in the same quiet window
+    lines = text.splitlines()
+    assert f"pwasm_service_queue_depth {st['queue_depth']}" in lines
+    assert f"pwasm_service_jobs_inflight {st['running']}" in lines
+    assert f"pwasm_service_breaker_state {st['breaker_state']}" \
+        in lines
+    assert st["jobs"]["evicted"] == 0
+
+
+def test_metrics_textfile_republished_atomically(tmp_path):
+    paf, fa = _corpus(tmp_path, n=4)
+    prom = tmp_path / "svc.prom"
+    with _daemon(metrics_textfile=str(prom)) as h:
+        assert prom.is_file()   # published at daemon start
+        with ServiceClient(h.sock) as c:
+            _submit_ok(c, tmp_path, "a", paf, fa)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if 'pwasm_service_jobs_total{outcome="done"} 1' \
+                    in prom.read_text():
+                break
+            time.sleep(0.05)
+    text = prom.read_text()
+    assert_valid_exposition(text)
+    assert 'pwasm_service_jobs_total{outcome="done"} 1' \
+        in text.splitlines()
+    # atomic publish: no tmp remnant beside the textfile
+    assert [p.name for p in tmp_path.iterdir()
+            if "svc.prom." in p.name] == []
+
+
+def test_log_json_service_events(tmp_path):
+    paf, fa = _corpus(tmp_path, n=4)
+    log = tmp_path / "svc.ndjson"
+    with _daemon(log_json=str(log)) as h:
+        with ServiceClient(h.sock) as c:
+            jid = _submit_ok(c, tmp_path, "a", paf, fa)
+    evs = [json.loads(ln) for ln in log.read_text().splitlines()]
+    kinds = [e["event"] for e in evs]
+    assert kinds[0] == "daemon_start"
+    assert ["job_admit", "job_start", "job_finish"] == \
+        [k for k in kinds if k.startswith("job_")]
+    fin = next(e for e in evs if e["event"] == "job_finish")
+    assert fin["job_id"] == jid and fin["state"] == "done" \
+        and fin["rc"] == 0 and fin["wall_s"] > 0
+    # the drain (teardown) and the daemon exit are on the record too
+    assert "drain" in kinds and "daemon_exit" in kinds
+    assert evs[-1]["event"] == "daemon_exit"
+    assert evs[-1]["rc"] == 75 and evs[-1]["drained"] is True
+
+
+def test_result_eviction_lru_max_results(tmp_path):
+    paf, fa = _corpus(tmp_path, n=4)
+    with _daemon(max_results=1) as h:
+        with ServiceClient(h.sock) as c:
+            ids = [_submit_ok(c, tmp_path, t, paf, fa)
+                   for t in ("a", "b", "c")]
+            # only the most recent terminal result survives the LRU
+            r_old = c.status(ids[0])
+            r_new = c.status(ids[2])
+            st = c.stats()["stats"]
+            text = c.metrics()["metrics"]
+    assert r_old.get("error") == "unknown_job"
+    assert r_new.get("ok"), r_new
+    assert st["jobs"]["evicted"] == 2
+    assert "pwasm_service_results_evicted_total 2" \
+        in text.splitlines()
+    assert "pwasm_service_results_held 1" in text.splitlines()
+
+
+def test_result_eviction_ttl(tmp_path):
+    paf, fa = _corpus(tmp_path, n=4)
+    with _daemon(result_ttl_s=0.2) as h:
+        with ServiceClient(h.sock) as c:
+            jid = _submit_ok(c, tmp_path, "a", paf, fa)
+            assert c.status(jid).get("ok")
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if c.status(jid).get("error") == "unknown_job":
+                    break
+                time.sleep(0.05)
+            assert c.status(jid).get("error") == "unknown_job"
+            st = c.stats()["stats"]
+    assert st["jobs"]["evicted"] == 1
+
+
+def test_eviction_never_touches_queued_or_running(tmp_path):
+    """Eviction candidates are TERMINAL jobs only: a queued job under
+    a 0-TTL daemon still runs and answers its result."""
+    paf, fa = _corpus(tmp_path, n=4)
+    slow = "--inject-faults=seed=1,rate=1,kinds=hang,hang_s=0.25"
+    with _daemon(result_ttl_s=0.0, max_results=0) as h:
+        with ServiceClient(h.sock) as c:
+            sub = c.submit([paf, "-r", fa, "--device=tpu",
+                            "-o", str(tmp_path / "s.dfa"),
+                            "--batch=2", slow])
+            assert sub.get("ok"), sub
+            res = c.result(sub["job_id"], timeout=120)
+            # the job ran to completion (it may already be evicted by
+            # the time we ask again — but the blocking result call
+            # held the Job object and must see the real rc)
+            assert res.get("ok") and res.get("rc") == 0, res
+
+
+def test_serve_main_flag_validation(tmp_path):
+    from pwasm_tpu.service.daemon import serve_main
+    for bad in (["--socket=s", "--result-ttl-s=abc"],
+                ["--socket=s", "--result-ttl-s=-1"],
+                ["--socket=s", "--max-results=x"]):
+        err = io.StringIO()
+        assert serve_main(bad, stderr=err) == 1
+        assert "Invalid" in err.getvalue()
+
+
+def test_accessed_s_is_the_lru_clock(tmp_path):
+    """Touching an old result via status refreshes its LRU slot, so
+    the OTHER result is the eviction victim."""
+    paf, fa = _corpus(tmp_path, n=4)
+    with _daemon(max_results=2) as h:
+        with ServiceClient(h.sock) as c:
+            a = _submit_ok(c, tmp_path, "a", paf, fa)
+            b = _submit_ok(c, tmp_path, "b", paf, fa)
+            time.sleep(0.02)
+            assert c.status(a).get("ok")   # refresh a's access time
+            _submit_ok(c, tmp_path, "c", paf, fa)   # b becomes LRU
+            assert c.status(a).get("ok")
+            assert c.status(b).get("error") == "unknown_job"

@@ -55,6 +55,55 @@ def test_service_rule_detects_direct_jax(checker, tmp_path):
         == []
 
 
+def test_obs_modules_stay_jax_free(checker):
+    """ISSUE 6 satellite: pwasm_tpu/obs/ must stay jax-free — the
+    observability layer runs on the plain-CPU path, inside the jax-free
+    daemon, and in signal-handler-adjacent code."""
+    bad = checker.find_obs_violations()
+    assert bad == [], "\n".join(bad)
+
+
+def test_obs_rule_detects_direct_jax(checker, tmp_path):
+    obs = tmp_path / "pwasm_tpu" / "obs"
+    obs.mkdir(parents=True)
+    (obs / "rogue.py").write_text(
+        "import jax\n"
+        "# import jax in a comment is NOT a hit\n"
+        "y = jax.device_get(1)\n")
+    bad = checker.find_obs_violations(str(tmp_path))
+    assert len(bad) == 2 and all("rogue.py" in b for b in bad)
+
+
+def test_metric_lint_clean_on_this_tree(checker):
+    """ISSUE 6 satellite: every metric registration lives in
+    obs/catalog.py, with snake_case pwasm_-prefixed unique names."""
+    bad = checker.find_metric_lint()
+    assert bad == [], "\n".join(bad)
+
+
+def test_metric_lint_detects_violations(checker, tmp_path):
+    pkg = tmp_path / "pwasm_tpu"
+    (pkg / "obs").mkdir(parents=True)
+    # registrations outside the catalog — the CALL alone is the
+    # violation, so a multi-line registration (the repo's normal
+    # style, name literal on the next line) must be caught too
+    (pkg / "rogue.py").write_text(
+        'c = reg.counter("pwasm_rogue_total", "h")\n'
+        '# reg.counter("pwasm_commented_total") is NOT a hit\n'
+        'h = reg.histogram(\n'
+        '    "pwasm_sneaky_seconds", "multi-line style")\n')
+    # a catalog with a bad name and a duplicate
+    (pkg / "obs" / "catalog.py").write_text(
+        'a = reg.gauge("pwasm_ok_depth", "h")\n'
+        'b = reg.gauge("pwasm_BadName", "h")\n'
+        'c = reg.counter("pwasm_ok_depth", "h")\n')
+    bad = checker.find_metric_lint(str(tmp_path))
+    assert len(bad) == 4, bad
+    assert sum("outside the catalog" in b for b in bad) == 2
+    assert any("violates the grammar" in b for b in bad)
+    assert any("duplicate metric name" in b for b in bad)
+
+
 def test_checker_detects_patterns(checker, tmp_path):
     # the check must actually SEE a violation, or a pattern regression
     # (e.g. jax API rename) would silently pass forever
