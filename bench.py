@@ -1033,6 +1033,15 @@ def cfg8_realistic_scale() -> int:
                   cpu_metric=True)
             _emit("realistic_pycli_wall_s", min(py_times), "s",
                   min(nat_times) / min(py_times), cpu_metric=True)
+            # Python-CLI-vs-native multiplier.  vs_baseline records
+            # whether the aspirational 1.5x target is met (1.0) or not
+            # (0.0), like the other budget-style legs; the enforced
+            # regression gate is qa/bench_gate.py comparing the ratio
+            # against the committed trajectory (unit "x" =
+            # lower-is-better, wall rule)
+            ratio = min(py_times) / min(nat_times)
+            _emit("realistic_pycli_vs_native_ratio", ratio, "x",
+                  1.0 if ratio <= 1.5 else 0.0, cpu_metric=True)
 
         # --- dispatch budget + chaos (device pipeline on the pinned
         # cpu-jax backend: dispatch counting and fault injection are

@@ -582,7 +582,8 @@ class Msa:
                 # TPU→CPU degradation: numpy class counts over the SAME
                 # pileup; chars=None routes refine_msa to its host vote
                 # over these counts — bit-exact by the vote contract
-                from pwasm_tpu.ops.consensus import host_class_counts
+                from pwasm_tpu.ops.consensus_host import \
+                    host_class_counts
                 self.engine_fallbacks += 1
                 return None, host_class_counts(pile)
 

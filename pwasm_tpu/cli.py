@@ -795,7 +795,8 @@ def _native_msa_outputs(nmsa, cfg, fmsa, cons_outs, stderr,
                 if stats is not None:
                     stats.engine_fallbacks += 1
                 from pwasm_tpu.native import consensus_vote_counts
-                from pwasm_tpu.ops.consensus import host_class_counts
+                from pwasm_tpu.ops.consensus_host import \
+                    host_class_counts
                 counts = host_class_counts(mat)
                 layers = counts.sum(axis=1, dtype=np.int32)
                 chars = consensus_vote_counts(counts, layers)
@@ -1006,6 +1007,36 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
 
     inflight: list = []   # submitted-but-unformatted batches (<= 2)
 
+    # host stage pipeline (ISSUE 7): the host report engine mirrors the
+    # device path's two-deep in-flight flush pipeline — ONE worker
+    # thread runs batch k's columnar analysis + block formatting while
+    # the main thread parses/extracts batch k+1 and merges the MSA.
+    # The native extraction (ctypes) and the large numpy analysis ops
+    # release the GIL, so the stages genuinely overlap.
+    # PWASM_HOST_PIPELINE=0 degrades to the synchronous path (the
+    # bisect hatch; byte parity either way by construction — finish
+    # closures write in submit order).
+    host_pool = None
+    host_pool_owned = False
+    if not use_device and freport is not None:
+        import os as _os
+        if _os.environ.get("PWASM_HOST_PIPELINE", "1") != "0" \
+                and _os.environ.get("PWASM_HOST_COLUMNAR", "1") != "0":
+            # (the scalar-engine hatch never submits to the pool —
+            # don't spawn an idle worker for its A/B arm)
+            if warm is not None and hasattr(warm, "host_executor"):
+                # warm-serve: the daemon's ONE persistent pipeline
+                # worker (and its thread-local FormatBuffers scratch,
+                # report/rowbytes.py) is shared across consecutive
+                # jobs — no per-job thread spawn or buffer allocation
+                # spike in the daemon
+                host_pool = warm.host_executor()
+            else:
+                from concurrent.futures import ThreadPoolExecutor
+                host_pool = ThreadPoolExecutor(
+                    1, thread_name_prefix="pwasm-hostpipe")
+                host_pool_owned = True
+
     # batch-granular durability (SURVEY.md §5 checkpoint/resume): after
     # each completed batch the report prefix is fsynced and its
     # (bytes, records) recorded atomically in <report>.ckpt, so a
@@ -1134,51 +1165,50 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
     def flush_pending(drain: bool = False):
         """Flush the pending report batch.
 
-        Device path: submit the batch, then format the OLDEST in-flight
-        batch — JAX dispatch is async, so a two-deep in-flight pipeline
-        keeps batch k's device program running while batches k-1/k-2
-        are formatted and written (launch/transfer latency hides behind
-        host work even when formatting is faster than the device).
-        ``drain`` formats every in-flight batch at end of input.
-
-        Host path: one vectorized columnar analysis over the whole
-        batch (report/columnar.py — the same formulas as the device
-        program under numpy), then the shared emit loop.  Never touches
-        the device module: the plain-CPU CLI must not initialize (or
-        even import) jax — a pinned-but-unhealthy TPU tunnel would hang
-        or kill an otherwise host-only run."""
+        BOTH engines pipeline two-deep now.  Device path: submit the
+        batch, then format the OLDEST in-flight batch — JAX dispatch is
+        async, so batch k's device program runs while batches k-1/k-2
+        are formatted and written.  Host path: batch k's columnar
+        analysis + block assembly run on the host pipeline worker
+        (report/columnar.py submit_diff_info_batch_host) while the main
+        thread parses/extracts the next batch; finish closures write in
+        submit order, so the report stays a clean prefix of input
+        order.  ``drain`` formats every in-flight batch at end of
+        input.  The host path never touches the device module: the
+        plain-CPU CLI must not initialize (or even import) jax — a
+        pinned-but-unhealthy TPU tunnel would hang or kill an otherwise
+        host-only run."""
         if not pending and not inflight:
             return  # nothing buffered
         # take the batch first: if the flush itself raises, the finally
         # below must not retry it (the retry would mask the live error)
         batch, pending[:] = pending[:], []
-        if not use_device:
-            if batch:
-                import os as _os
+        if not use_device and batch:
+            import os as _os
+            if _os.environ.get("PWASM_HOST_COLUMNAR", "1") == "0":
+                # scalar per-alignment loop (the ground-truth engine):
+                # the columnar path's escape hatch, and the bench's
+                # same-process A/B reference — synchronous on purpose
                 with obs.span("flush_host", n=len(batch)):
-                    if _os.environ.get("PWASM_HOST_COLUMNAR", "1") \
-                            == "0":
-                        # scalar per-alignment loop (the ground-truth
-                        # engine): the columnar path's escape hatch, and
-                        # the bench's same-process A/B reference
-                        from pwasm_tpu.report.diff_report import \
-                            print_diff_info
-                        for aln, rlabel, tlabel, refseq in batch:
-                            print_diff_info(
-                                aln, rlabel, tlabel, freport, refseq,
-                                skip_codan=cfg.skip_codan,
-                                motifs=cfg.motifs, summary=summary)
-                    else:
-                        from pwasm_tpu.report.columnar import \
-                            print_diff_info_batch_host
-                        print_diff_info_batch_host(
-                            batch, freport, skip_codan=cfg.skip_codan,
-                            motifs=cfg.motifs, summary=summary,
-                            stats=stats)
+                    from pwasm_tpu.report.diff_report import \
+                        print_diff_info
+                    for aln, rlabel, tlabel, refseq in batch:
+                        print_diff_info(
+                            aln, rlabel, tlabel, freport, refseq,
+                            skip_codan=cfg.skip_codan,
+                            motifs=cfg.motifs, summary=summary)
                 note_batch_done(len(batch))
-            return
-        from pwasm_tpu.report.device_report import submit_diff_info_batch
-        if batch:
+                return
+            from pwasm_tpu.report.columnar import \
+                submit_diff_info_batch_host
+            with obs.span("flush_submit", n=len(batch)):
+                inflight.append((submit_diff_info_batch_host(
+                    batch, freport, skip_codan=cfg.skip_codan,
+                    motifs=cfg.motifs, summary=summary, stats=stats,
+                    executor=host_pool), len(batch)))
+        elif batch:
+            from pwasm_tpu.report.device_report import \
+                submit_diff_info_batch
             with obs.span("flush_submit", n=len(batch)):
                 inflight.append((submit_diff_info_batch(
                     batch, freport, skip_codan=cfg.skip_codan,
@@ -1200,6 +1230,11 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
             note_batch_done(nrec)
 
     t_loop = obs.clock()   # the parse/extract/flush phase span
+    # per-stage host walls (--stats "host" block): parse and extract
+    # accumulate here on the main loop; analyze/format accumulate on
+    # the pipeline worker (disjoint RunStats fields, so the threads
+    # never tear each other's sums)
+    from time import perf_counter as _pc
     try:
         file_line = 0
         for line in inf:
@@ -1216,7 +1251,9 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                 continue
             stats.lines += 1
             try:
+                t_st = _pc()
                 rec = parse_paf_line(line)
+                stats.host_parse_s += _pc() - t_st
             except PwasmError:
                 if not cfg.skip_bad_lines:
                     raise
@@ -1285,7 +1322,9 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                     f"from loaded sequence length({len(refseq)})!\n{line}\n")
             refseq_aln = refseq_rc if al.reverse else refseq
             try:
+                t_st = _pc()
                 aln = extract_alignment(rec, refseq_aln)
+                stats.host_extract_s += _pc() - t_st
             except PwasmError:
                 if not cfg.skip_bad_lines:
                     raise
@@ -1333,12 +1372,19 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                 else:
                     msa_add(aln, tlabel, refseq, numalns)
     finally:
-        # emit whatever the device batch buffer holds — including when
-        # a later bad line raises, so earlier alignments' rows aren't
-        # dropped (the cpu path writes them progressively)
-        flush_pending(drain=True)
-        obs.span_complete("input_loop", t_loop, lines=stats.lines,
-                          alignments=stats.alignments)
+        # emit whatever the batch buffers hold — including when a later
+        # bad line raises, so earlier alignments' rows aren't dropped —
+        # then retire the host pipeline worker if this run owns it (a
+        # warm-serve run borrows the daemon's persistent worker and
+        # must leave it running for the next job; the drain above
+        # already joined every future this run submitted)
+        try:
+            flush_pending(drain=True)
+            obs.span_complete("input_loop", t_loop, lines=stats.lines,
+                              alignments=stats.alignments)
+        finally:
+            if host_pool_owned:
+                host_pool.shutdown(wait=True)
 
     # a drain requested during the final flushes still counts: the
     # in-flight batches completed (and checkpointed) above, but the

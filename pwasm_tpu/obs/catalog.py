@@ -85,6 +85,10 @@ def build_run_metrics(reg: MetricsRegistry,
     m["aligned_bases"] = reg.counter(
         "pwasm_run_aligned_bases_total",
         "Sum of per-alignment target span bases")
+    m["host_stage_seconds"] = reg.counter(
+        "pwasm_host_stage_seconds_total",
+        "Cumulative host report-path stage wall seconds, by stage "
+        "(parse/extract/analyze/format)", labels=("stage",))
     m["device_dispatches"] = reg.counter(
         "pwasm_device_dispatches_total", "Device program launches")
     m["device_flushes"] = reg.counter(
@@ -223,6 +227,11 @@ def fold_run_stats(m: dict, st: dict | None) -> None:
     m["aligned_bases"].inc(n(st, "aligned_bases"))
     m["device_dispatches"].inc(n(device, "dispatches"))
     m["device_flushes"].inc(n(device, "flushes"))
+    host = st.get("host")
+    host = host if isinstance(host, dict) else {}
+    for stage in ("parse", "extract", "analyze", "format"):
+        m["host_stage_seconds"].inc(n(host, stage + "_s"),
+                                    stage=stage)
     m["fallback_batches"].inc(n(st, "fallback_batches"))
     m["engine_fallbacks"].inc(n(st, "engine_fallbacks"))
     m["backend_probes"].inc(n(backend, "probes"))

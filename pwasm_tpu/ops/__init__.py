@@ -13,14 +13,24 @@ All integer math end-to-end: the parity contract with the CPU engine is
 bit-exactness, not tolerance (SURVEY.md §7.3).
 """
 
-from pwasm_tpu.ops.consensus import (  # noqa: F401
-    pileup_counts,
-    consensus_vote_counts,
-    consensus_votes,
-    consensus_pallas,
-    votes_to_chars,
-    CODE_ZERO_COV,
-)
+# Consensus re-exports are LAZY (PEP 562): `pwasm_tpu.ops.consensus`
+# imports jax at module top, and eager re-exporting here made ANY
+# submodule import — including the jax-free `ctx_scan_impl` the host
+# columnar engine runs on — pay the full ~1.2 s jax import.  That was
+# the single largest term in the plain-CPU CLI's cold wall (the
+# realistic_pycli_vs_native_ratio bench leg); the host path must not
+# import jax at all (tests/test_rowbytes.py gates it).
+_CONSENSUS_EXPORTS = ("pileup_counts", "consensus_vote_counts",
+                      "consensus_votes", "consensus_pallas",
+                      "votes_to_chars", "CODE_ZERO_COV")
+
+
+def __getattr__(name: str):
+    if name in _CONSENSUS_EXPORTS:
+        from pwasm_tpu.ops import consensus
+        return getattr(consensus, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
 
 
 _cache_armed = False

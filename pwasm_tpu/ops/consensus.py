@@ -27,9 +27,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-N_CLASSES = 6
-CODE_ZERO_COV = -1
-PAD_CODE = 6  # any code >= 6 contributes nothing to the pileup
+# the host twin lives in the jax-free ops/consensus_host.py (the CPU
+# CLI must not import this module); re-exported here for compatibility
+from pwasm_tpu.ops.consensus_host import (  # noqa: F401
+    CODE_ZERO_COV, N_CLASSES, PAD_CODE, host_class_counts)
 
 
 def pileup_counts(bases: jax.Array) -> jax.Array:
@@ -47,17 +48,6 @@ def pileup_counts(bases: jax.Array) -> jax.Array:
                         axis=-1)  # (..., depth, cols, 6); invalid -> all 0
     counts = jnp.sum(oh, axis=-3)
     return counts.astype(jnp.int32)
-
-
-def host_class_counts(pile: np.ndarray) -> np.ndarray:
-    """Pure-numpy per-column class counts over a (depth, cols) int8
-    code pileup — the host twin of ``pileup_counts`` (codes outside
-    [0, 6) contribute nothing).  Returns (cols, 6) int32.  This is the
-    single degradation path the resilience layer falls back to when a
-    device consensus launch is given up on (align/msa.py and cli.py
-    both route here so the two fallbacks cannot drift)."""
-    return np.stack([(pile == k).sum(0, dtype=np.int32)
-                     for k in range(N_CLASSES)], axis=1)
 
 
 def consensus_vote_counts(counts: jax.Array) -> jax.Array:

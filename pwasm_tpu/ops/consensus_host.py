@@ -1,0 +1,29 @@
+"""Jax-free host consensus helpers.
+
+The plain-CPU CLI must never import jax (a pinned-but-unhealthy TPU
+tunnel would hang an otherwise host-only run, and the cold jax import
+alone costs ~1.2 s — the dominant term in the Python-CLI-vs-native
+bench ratio before it moved here).  The pure-numpy twins of the device
+consensus ops live in this module so the host report/MSA/consensus
+paths can reach them without touching ``ops/consensus.py``'s jax
+imports; the device module re-exports them for compatibility.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+N_CLASSES = 6
+CODE_ZERO_COV = -1
+PAD_CODE = 6  # any code >= 6 contributes nothing to the pileup
+
+
+def host_class_counts(pile: np.ndarray) -> np.ndarray:
+    """Pure-numpy per-column class counts over a (depth, cols) int8
+    code pileup — the host twin of ``pileup_counts`` (codes outside
+    [0, 6) contribute nothing).  Returns (cols, 6) int32.  This is the
+    single degradation path the resilience layer falls back to when a
+    device consensus launch is given up on (align/msa.py and cli.py
+    both route here so the two fallbacks cannot drift)."""
+    return np.stack([(pile == k).sum(0, dtype=np.int32)
+                     for k in range(N_CLASSES)], axis=1)

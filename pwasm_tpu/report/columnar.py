@@ -130,15 +130,17 @@ _SCALAR_FIELDS = ("aa", "aapos", "hpoly", "motif", "s_mismatch",
                   "stop_aapos")
 
 
-def _impact_text_l(ev, k: int, L: dict, A: dict, strict_subs: bool,
+def _impact_text_l(ev, k: int, L: dict, strict_subs: bool,
                    refseq: bytes, skip_codan: bool, motifs) -> str:
     """predictImpact's text from analysis results (pafreport.cpp:804-883
-    semantics): scalar fields from the bulk-converted lists ``L``,
-    per-codon rows from the arrays ``A`` on demand (only the event's
-    own branch reads them).  With ``strict_subs`` a flagged
-    substitution mismatch re-runs the event through the scalar analyzer
-    so message/indices match the scalar ground truth byte-for-byte;
-    without it the device path's generic message is raised."""
+    semantics), all fields from the bulk-converted lists ``L`` — the
+    per-codon planes are converted ONCE per batch in
+    :func:`assemble_results` (the former per-row ``.tolist()``
+    extraction cost 4-8 numpy calls per indel event).  With
+    ``strict_subs`` a flagged substitution mismatch re-runs the event
+    through the scalar analyzer so message/indices match the scalar
+    ground truth byte-for-byte; without it the device path's generic
+    message is raised."""
     if ev.evt == "S":
         if L["s_mismatch"][k]:
             if strict_subs:
@@ -154,9 +156,9 @@ def _impact_text_l(ev, k: int, L: dict, A: dict, strict_subs: bool,
             # per-codon row walk below would emit no parts
             return "synonymous"
         parts = []
-        s_valid = A["s_valid"][k].tolist()
-        s_orig = A["s_orig_aa"][k].tolist()
-        s_new = A["s_new_aa"][k].tolist()
+        s_valid = L["s_valid"][k]
+        s_orig = L["s_orig_aa"][k]
+        s_new = L["s_new_aa"][k]
         s_pos = None
         for d in range(len(s_orig)):
             if not s_valid[d]:
@@ -165,7 +167,7 @@ def _impact_text_l(ev, k: int, L: dict, A: dict, strict_subs: bool,
             maa = chr(s_new[d])
             if aa != maa:
                 if s_pos is None:
-                    s_pos = A["s_aapos"][k].tolist()
+                    s_pos = L["s_aapos"][k]
                 aapos = s_pos[d]
                 s = f"AA{aapos}|{aa}:{maa}"
                 if maa == ".":
@@ -176,11 +178,9 @@ def _impact_text_l(ev, k: int, L: dict, A: dict, strict_subs: bool,
     if stop >= 0:
         return f"premature stop at AA{stop}"
     aa4 = "".join(chr(c) for c, v in
-                  zip(A["aa4"][k].tolist(), A["aa4_valid"][k].tolist())
-                  if v)
+                  zip(L["aa4"][k], L["aa4_valid"][k]) if v)
     maa4 = "".join(chr(c) for c, v in
-                   zip(A["maa4"][k].tolist(),
-                       A["maa4_valid"][k].tolist()) if v)
+                   zip(L["maa4"][k], L["maa4_valid"][k]) if v)
     if aa4 and maa4:
         return f"frame shift {aa4}+:{maa4}+"
     return ""
@@ -208,6 +208,13 @@ def assemble_results(events, host: dict, refseq: bytes, motifs,
         changed = (A["s_orig_aa"] != A["s_new_aa"]) \
             & (A["s_valid"] != 0)
         L["s_syn"] = (~changed.any(axis=1)).tolist()
+        # bulk-convert the small per-codon planes ONCE: the (E, K)/
+        # (E, 4) rows used to be extracted per event inside
+        # _impact_text_l — 4-8 numpy row+tolist calls per indel/sub
+        for plane in ("s_valid", "s_orig_aa", "s_new_aa", "s_aapos",
+                      "aa4", "maa4", "aa4_valid", "maa4_valid"):
+            if plane in A:
+                L[plane] = A[plane].tolist()
     motif_text = ["[unknown]"] + [f"motif {m}" for m in motifs]
     # the host slices the 9bp context strings (byte-faithful for IUPAC
     # ambiguity characters the int8 code space collapses) — one
@@ -241,7 +248,7 @@ def assemble_results(events, host: dict, refseq: bytes, motifs,
             status = motif_text[L["motif"][k]]
         impact = ""
         if not skip_codan:
-            impact = _impact_text_l(ev, k, L, A, strict_subs, refseq,
+            impact = _impact_text_l(ev, k, L, strict_subs, refseq,
                                     skip_codan, motifs)
         out.append((aa, aapos, rctx, status, impact))
     return out
@@ -296,9 +303,19 @@ def analyze_events_columnar(refseq: bytes, events,
 def emit_batch_rows(batch, analyzed: dict, f,
                     summary: Summary | None) -> None:
     """Write one batch's report rows from per-event analysis results —
-    the emit loop shared by the device finish path and the host
-    columnar path.  One writer call per batch (the per-row write
-    syscalls were measurable at realistic scale)."""
+    the emit path shared by the device finish path and the host
+    columnar path.  One writer call per batch; the default assembly is
+    the fused batch formatter (``report/rowbytes.py``) with the
+    per-event truncation rules and summary counting inlined, and
+    ``PWASM_HOST_FORMAT=0`` routes back to the scalar
+    ``format_event_row`` loop (mirroring ``PWASM_HOST_COLUMNAR=0``) so
+    a formatting regression is bisectable in production."""
+    from pwasm_tpu.report.rowbytes import (format_batch_block,
+                                           vector_format_enabled)
+
+    if vector_format_enabled():
+        f.write(format_batch_block(batch, analyzed, summary))
+        return
     rows: list[str] = []
     for aln, rlabel, tlabel, _refseq in batch:
         rows.append(format_header(aln, rlabel, tlabel))
@@ -317,36 +334,95 @@ def emit_batch_rows(batch, analyzed: dict, f,
     f.write("".join(rows))
 
 
-def print_diff_info_batch_host(batch, f, skip_codan: bool = False,
-                               motifs=DEFAULT_MOTIFS, summary=None,
-                               stats=None) -> None:
-    """Analyze and emit one report batch on the host, columnar: events
-    group per distinct refseq (like the device path), one vectorized
-    analysis per group, then rows in exactly the order the scalar path
-    would produce.  ``batch`` is a list of (aln, rlabel, tlabel,
-    refseq) in input order.
-
-    A PwasmError during analysis (the reference's fatal
-    modseq-vs-evtsub verification) replays the whole batch through the
-    scalar path, which writes rows progressively and raises at exactly
-    the failing event — the same observable behavior, bytes and
-    message, as the per-line scalar loop."""
+def _analyze_batch(batch, skip_codan: bool, motifs) -> dict:
+    """Columnar analysis of one report batch: events group per distinct
+    refseq (like the device path), one vectorized analysis per group;
+    returns ``{id(event): (aa, aapos, rctx, status, impact)}``."""
     groups: dict[bytes, list] = {}
     for aln, _rl, _tl, refseq in batch:
         groups.setdefault(refseq, []).extend(aln.tdiffs)
     analyzed: dict[int, tuple] = {}
-    try:
-        for refseq, events in groups.items():
-            for ev, r in zip(events, analyze_events_columnar(
-                    refseq, events, skip_codan, motifs)):
-                analyzed[id(ev)] = r
-    except PwasmError:
-        # nothing has been written yet: the scalar replay reproduces
-        # the progressive writes up to the failing event, then raises
-        # the scalar-exact error
-        for aln, rlabel, tlabel, refseq in batch:
-            print_diff_info(aln, rlabel, tlabel, f, refseq,
-                            skip_codan=skip_codan, motifs=motifs,
-                            summary=summary)
-        raise   # unreachable in practice: the replay raises first
-    emit_batch_rows(batch, analyzed, f, summary)
+    for refseq, events in groups.items():
+        for ev, r in zip(events, analyze_events_columnar(
+                refseq, events, skip_codan, motifs)):
+            analyzed[id(ev)] = r
+    return analyzed
+
+
+def submit_diff_info_batch_host(batch, f, skip_codan: bool = False,
+                                motifs=DEFAULT_MOTIFS, summary=None,
+                                stats=None, executor=None):
+    """Stage one host report batch through the analyze→format pipeline
+    and return a ``finish() -> None`` closure that writes the assembled
+    block.
+
+    With ``executor`` (the CLI's single host-pipeline worker) the
+    columnar analysis and the block assembly of batch k run on the
+    worker thread while the main thread parses/extracts batch k+1 and
+    merges the MSA — the host twin of the device path's two-deep
+    in-flight flush pipeline.  The big numpy analysis ops and the
+    native extraction release the GIL, so the overlap is real.  finish
+    closures are called in submit order, so rows land in input order
+    and the ``--resume`` clean-prefix contract holds.  ``executor=None``
+    runs everything synchronously (the ``PWASM_HOST_PIPELINE=0``
+    hatch).
+
+    The run ``summary`` is folded on the worker (batches are FIFO
+    through ONE worker, so the folds are ordered); the per-stage walls
+    land in ``stats`` (``host_analyze_s``/``host_format_s``).
+
+    A PwasmError during analysis (the reference's fatal
+    modseq-vs-evtsub verification) surfaces in finish(): nothing of
+    this batch has been written yet, so the scalar replay reproduces
+    the progressive writes up to the failing event, then raises the
+    scalar-exact error — the same observable behavior, bytes and
+    message, as the per-line scalar loop."""
+    import time as _time
+
+    from pwasm_tpu.report.rowbytes import (format_batch_block,
+                                           vector_format_enabled)
+
+    def work() -> str:
+        t0 = _time.perf_counter()
+        analyzed = _analyze_batch(batch, skip_codan, motifs)
+        t1 = _time.perf_counter()
+        if vector_format_enabled():
+            block = format_batch_block(batch, analyzed, summary)
+        else:
+            # scalar-format hatch: the per-row loop assembles into an
+            # in-memory sink — the write itself stays in finish(), in
+            # submit order
+            import io
+            sink = io.StringIO()
+            emit_batch_rows(batch, analyzed, sink, summary)
+            block = sink.getvalue()
+        t2 = _time.perf_counter()
+        if stats is not None:
+            stats.host_analyze_s += t1 - t0
+            stats.host_format_s += t2 - t1
+        return block
+
+    fut = executor.submit(work) if executor is not None else None
+
+    def finish() -> None:
+        try:
+            block = fut.result() if fut is not None else work()
+        except PwasmError:
+            for aln, rlabel, tlabel, refseq in batch:
+                print_diff_info(aln, rlabel, tlabel, f, refseq,
+                                skip_codan=skip_codan, motifs=motifs,
+                                summary=summary)
+            raise   # unreachable in practice: the replay raises first
+        f.write(block)
+
+    return finish
+
+
+def print_diff_info_batch_host(batch, f, skip_codan: bool = False,
+                               motifs=DEFAULT_MOTIFS, summary=None,
+                               stats=None) -> None:
+    """Synchronous analyze+emit of one host report batch (the
+    pipeline's submit+finish fused — kept as the direct-call surface
+    for tests and library users)."""
+    submit_diff_info_batch_host(batch, f, skip_codan, motifs, summary,
+                                stats, executor=None)()

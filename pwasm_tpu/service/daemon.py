@@ -94,14 +94,42 @@ class WarmContext:
     - ``supervisor_state``  the breaker/ceiling snapshot exported at
                             each job's end and restored into the next
                             job's supervisor (fault clock stripped —
-                            scripted fault windows are per-job).
+                            scripted fault windows are per-job);
+    - ``host_executor()``   the single persistent host-pipeline worker
+                            (report analyze→format stage) shared by
+                            consecutive jobs, so the warm path pays no
+                            per-job thread spawn and the worker's
+                            thread-local ``FormatBuffers`` scratch
+                            (report/rowbytes.py) survives job→job.
     """
 
     def __init__(self) -> None:
         self.drain = None
         self.monitor = None
         self.supervisor_state: dict | None = None
+        self.host_pool = None
         self.lock = threading.Lock()
+
+    def host_executor(self):
+        """The warm process's host report-pipeline worker, created on
+        first use and REUSED across jobs (cli._main_loop asks for it
+        instead of spawning its own per run).  One single-thread
+        executor is correct even with a wider job-worker pool: each
+        batch's finish closure joins its own future, so interleaved
+        jobs only share the worker's time, never its results."""
+        with self.lock:
+            if self.host_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self.host_pool = ThreadPoolExecutor(
+                    1, thread_name_prefix="pwasm-hostpipe-warm")
+            return self.host_pool
+
+    def close(self) -> None:
+        """Retire the shared pipeline worker (daemon shutdown)."""
+        with self.lock:
+            pool, self.host_pool = self.host_pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
 
 
 class _JobWarm:
@@ -144,6 +172,9 @@ class _JobWarm:
     def supervisor_state(self, st) -> None:
         with self._shared.lock:
             self._shared.supervisor_state = st
+
+    def host_executor(self):
+        return self._shared.host_executor()
 
 
 class Daemon:
@@ -285,6 +316,7 @@ class Daemon:
                 self._closing.set()
                 for w in workers:
                     w.join(timeout=5.0)
+                self.warm.close()
                 sock.close()
                 try:
                     os.unlink(self.socket_path)

@@ -107,6 +107,18 @@ class RunStats:
         #                                 executed after a reclose
         self.res_degraded_wall_s = 0.0  # wall seconds spent with the
         #                                 global breaker open
+        # host stage walls (the where-the-time-goes breakdown of the
+        # host report path, BASELINE.md ceiling analysis): parse
+        # (PAF/cs), event extraction, columnar analysis, byte
+        # formatting.  parse/extract accumulate on the main input
+        # loop, analyze/format on the host pipeline worker — disjoint
+        # fields, so the two threads never tear each other's sums.
+        # Reported as one nested "host" block in the JSON and folded
+        # into pwasm_host_stage_seconds_total{stage} (obs/catalog.py).
+        self.host_parse_s = 0.0
+        self.host_extract_s = 0.0
+        self.host_analyze_s = 0.0
+        self.host_format_s = 0.0
         # dispatch-budget counters (VERDICT r5 item 3): every device
         # round-trip costs a host<->device dispatch (~1-2 ms through a
         # tunnel), so the device path must stay dispatch-lean at scale.
@@ -165,6 +177,12 @@ class RunStats:
                 "dispatches": self.device_dispatches,
                 "flushes": self.device_flushes,
                 "by_site": dict(self.dispatches_by_site),
+            },
+            "host": {
+                "parse_s": round(self.host_parse_s, 6),
+                "extract_s": round(self.host_extract_s, 6),
+                "analyze_s": round(self.host_analyze_s, 6),
+                "format_s": round(self.host_format_s, 6),
             },
             "resilience": {
                 "retries": self.res_retries,

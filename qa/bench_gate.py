@@ -8,6 +8,8 @@ unexplained regression beyond a tolerance:
 
 - wall-clock metrics (unit ``s``): regression = new wall slower than
   ``old * (1 + tolerance)``;
+- ratio metrics (unit ``x``, lower-is-better multipliers like
+  ``realistic_pycli_vs_native_ratio``): same rule as walls;
 - rate metrics (unit ending in ``/s``): regression = new rate below
   ``old * (1 - tolerance)``;
 - boolean/parity legs (unit ``bool``): regression = a leg that WAS
@@ -82,9 +84,10 @@ def index_rows(rows: list[dict]) -> dict[str, dict]:
 
 
 def _direction(unit: str) -> str:
-    """lower = lower-is-better (walls), higher = higher-is-better
-    (rates), bool = pass/fail leg, none = ungated (counts, ids)."""
-    if unit == "s":
+    """lower = lower-is-better (walls, ratio multipliers), higher =
+    higher-is-better (rates), bool = pass/fail leg, none = ungated
+    (counts, ids)."""
+    if unit in ("s", "x"):
         return "lower"
     if unit.endswith("/s"):
         return "higher"

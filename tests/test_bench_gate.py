@@ -39,6 +39,18 @@ def test_wall_slowdown_fails_within_tolerance_passes(gate):
     assert bad["regressions"][0]["ratio"] == pytest.approx(1.3)
 
 
+def test_ratio_unit_gated_lower_is_better(gate):
+    # unit "x" (lower-is-better multipliers, e.g.
+    # realistic_pycli_vs_native_ratio): gated exactly like a wall
+    base = _rows(ratio=(1.2, "x"))
+    ok = gate.compare(_rows(ratio=(1.4, "x")), base, tolerance=0.25)
+    assert ok["regressions"] == [] and ok["checked"] == 1
+    bad = gate.compare(_rows(ratio=(1.6, "x")), base, tolerance=0.25)
+    assert [r["metric"] for r in bad["regressions"]] == ["ratio"]
+    good = gate.compare(_rows(ratio=(1.0, "x")), base, tolerance=0.25)
+    assert [r["metric"] for r in good["improved"]] == ["ratio"]
+
+
 def test_rate_drop_fails_gain_improves(gate):
     base = _rows(rate=(1000.0, "bases/s"))
     bad = gate.compare(_rows(rate=(700.0, "bases/s")), base,

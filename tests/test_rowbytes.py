@@ -1,0 +1,301 @@
+"""Vectorized report byte assembly (report/rowbytes.py): byte-exact
+parity with the scalar ``format_event_row`` emit loop over adversarial
+corpora (IUPAC, oversize events, reverse-strand clips, empty batches),
+the ``PWASM_HOST_FORMAT``/``PWASM_HOST_PIPELINE`` escape hatches, the
+batched ``-s`` summary writer, and the warm-serve format-buffer reuse."""
+
+import io
+import threading
+
+import numpy as np
+import pytest
+
+from pwasm_tpu.cli import run
+from pwasm_tpu.core.dna import revcomp
+from pwasm_tpu.core.events import DiffEvent, extract_alignment
+from pwasm_tpu.core.paf import parse_paf_line
+from pwasm_tpu.report.columnar import _analyze_batch, emit_batch_rows
+from pwasm_tpu.report.diff_report import (Summary, format_event_row,
+                                          format_header)
+from pwasm_tpu.report.rowbytes import (FormatBuffers, format_batch_block,
+                                       get_buffers,
+                                       vector_format_enabled)
+
+from helpers import make_paf_line
+from test_events import _random_ops
+
+
+def _alignment(q, line):
+    rec = parse_paf_line(line)
+    refseq_aln = revcomp(q) if rec.alninfo.reverse else q
+    return extract_alignment(rec, refseq_aln), refseq_aln
+
+
+def _scalar_block(batch, analyzed, summary):
+    """The ground-truth scalar emit loop (format_header +
+    Summary.add_event + format_event_row, per row)."""
+    rows = []
+    for aln, rlabel, tlabel, _refseq in batch:
+        rows.append(format_header(aln, rlabel, tlabel))
+        if summary is not None:
+            summary.add_alignment(aln)
+        for di in aln.tdiffs:
+            aa, aapos, rctx, status, impact = analyzed[id(di)]
+            if summary is not None:
+                summary.add_event(di, status, impact)
+            rows.append(format_event_row(di, aa, aapos, rctx, status,
+                                         impact))
+    return "".join(rows)
+
+
+def _assert_block_parity(batch, analyzed):
+    s_vec, s_sca = Summary(), Summary()
+    vec = format_batch_block(batch, analyzed, s_vec)
+    sca = _scalar_block(batch, analyzed, s_sca)
+    assert vec == sca
+    assert s_vec == s_sca          # dataclass: all counter fields
+    # the no-summary arm must produce the same bytes
+    assert format_batch_block(batch, analyzed, None) == sca
+
+
+def _fuzz_batch(rng, n_aln, with_clips=False):
+    batch = []
+    for k in range(n_aln):
+        n = int(rng.integers(40, 200))
+        q = "".join(rng.choice(list("ACGT"), size=n))
+        strand = "-" if k % 2 else "+"
+        q_aln = revcomp(q.encode()).decode() if strand == "-" else q
+        kw = {}
+        if with_clips and n > 60:
+            # reverse-strand clips: aligned window strictly inside the
+            # query, so the extraction path sees soft-clipped ends
+            kw = {"q_start": 9, "q_end": n - 12}
+            q_aln = q_aln[12:n - 9] if strand == "-" \
+                else q_aln[9:n - 12]
+        ops = _random_ops(rng, q_aln)
+        line, _ = make_paf_line("q", q, f"t{k}", strand, ops, **kw)
+        aln, _refseq_aln = _alignment(q.encode(), line)
+        batch.append((aln, "q", f"t{k}", q.encode().upper()))
+    return batch
+
+
+@pytest.mark.parametrize("skip_codan", [False, True])
+@pytest.mark.parametrize("with_clips", [False, True])
+def test_fuzz_parity_vectorized_vs_scalar(skip_codan, with_clips):
+    rng = np.random.default_rng(17 if with_clips else 23)
+    for trial in range(6):
+        batch = _fuzz_batch(rng, int(rng.integers(1, 9)), with_clips)
+        analyzed = _analyze_batch(batch, skip_codan, ["GGCGG"])
+        _assert_block_parity(batch, analyzed)
+
+
+def test_parity_iupac_and_oversize_events():
+    # IUPAC bytes must survive the assembly verbatim, and the three
+    # [len] truncation rules (evtbases, evtsub, tctx) must reproduce
+    # the scalar path's exact output — analysis tuples are fabricated
+    # so every branch is pinned regardless of analyzer routing
+    rng = np.random.default_rng(3)
+    batch = _fuzz_batch(rng, 2)
+    aln = batch[0][0]
+    aln.tdiffs = [
+        DiffEvent(evt="S", evtlen=1, evtbases=b"R", evtsub=b"N",
+                  rloc=4, tloc=4, tctx=b"GGNNC"),
+        DiffEvent(evt="I", evtlen=30, evtbases=b"Y" * 30, evtsub=b"",
+                  rloc=8, tloc=8, tctx=b"A" * 40),   # both oversize
+        DiffEvent(evt="S", evtlen=1, evtbases=b"C" * 13,
+                  evtsub=b"G" * 13, rloc=12, tloc=12,
+                  tctx=b"ACGTACGTACGTACGTACGTAC"),    # 22 == limit
+        DiffEvent(evt="D", evtlen=44, evtbases=b"T" * 44, evtsub=b"",
+                  rloc=15, tloc=15, tctx=b"ACGRYSWKMBDHVN" * 4),
+        DiffEvent(evt="I", evtlen=12, evtbases=b"A" * 12, evtsub=b"",
+                  rloc=18, tloc=18, tctx=b"ACGTACGTACGTACGTACGTACG"),
+    ]
+    impacts = ["synonymous", "premature stop at AA7",
+               "frame shift MK+:M.+", "AA3|K:R", ""]
+    statuses = ["homopolymer", "motif GGCGG", "[unknown]",
+                "motif AAA", "[unknown]"]
+    analyzed = {}
+    for di, st, im in zip(aln.tdiffs, statuses, impacts):
+        analyzed[id(di)] = ("K", 3, b"ACNRYACGT", st, im)
+    for di in batch[1][0].tdiffs:
+        analyzed[id(di)] = ("M", 1, b"ATGGCCTGG", "[unknown]", "")
+    _assert_block_parity(batch, analyzed)
+
+
+def test_parity_empty_batches():
+    assert format_batch_block([], {}, Summary()) == ""
+    # header-only alignment (no events): the summary still counts it
+    q = "ACGT" * 30
+    line, _ = make_paf_line("q", q, "t0", "+", [("=", len(q))])
+    aln, _ = _alignment(q.encode(), line)
+    assert aln.tdiffs == []
+    batch = [(aln, "q", "t0", q.encode())]
+    _assert_block_parity(batch, {})
+
+
+def test_emit_batch_rows_env_hatch(monkeypatch):
+    # PWASM_HOST_FORMAT=0 routes emit_batch_rows through the scalar
+    # loop; both routes produce the same bytes and summary
+    rng = np.random.default_rng(29)
+    batch = _fuzz_batch(rng, 4)
+    analyzed = _analyze_batch(batch, False, ["GGCGG"])
+    outs = {}
+    for flag in ("1", "0"):
+        monkeypatch.setenv("PWASM_HOST_FORMAT", flag)
+        assert vector_format_enabled() == (flag == "1")
+        sink, summ = io.StringIO(), Summary()
+        emit_batch_rows(batch, analyzed, sink, summ)
+        outs[flag] = (sink.getvalue(), summ)
+    assert outs["1"] == outs["0"]
+
+
+def _cli_corpus(tmp_path, rng, n=14):
+    q = "".join(rng.choice(list("ACGT"), size=240))
+    lines = []
+    for k in range(n):
+        strand = "-" if k % 3 == 0 else "+"
+        kw = {"q_start": 6, "q_end": 228} if k % 4 == 0 else {}
+        q_aln = revcomp(q.encode()).decode() if strand == "-" else q
+        if kw:
+            q_aln = q_aln[12:234] if strand == "-" else q_aln[6:228]
+        ops = _random_ops(rng, q_aln)
+        lines.append(make_paf_line("q", q, f"t{k}", strand, ops,
+                                   **kw)[0])
+    fa = tmp_path / "q.fa"
+    fa.write_text(f">q\n{q}\n")
+    paf = tmp_path / "in.paf"
+    paf.write_text("".join(l + "\n" for l in lines))
+    return fa, paf
+
+
+def test_cli_hatches_byte_identical(tmp_path, monkeypatch):
+    # end-to-end A/B/C: vectorized+pipelined (default), scalar format
+    # (PWASM_HOST_FORMAT=0), synchronous (PWASM_HOST_PIPELINE=0) —
+    # report, -s and -w bytes identical across all arms
+    fa, paf = _cli_corpus(tmp_path, np.random.default_rng(31))
+    outs = {}
+    for tag, fmt, pipe in (("vec", "1", "1"), ("sca", "0", "1"),
+                           ("sync", "1", "0"), ("scasync", "0", "0")):
+        monkeypatch.setenv("PWASM_HOST_FORMAT", fmt)
+        monkeypatch.setenv("PWASM_HOST_PIPELINE", pipe)
+        rep = tmp_path / f"{tag}.dfa"
+        summ = tmp_path / f"{tag}.sum"
+        msa = tmp_path / f"{tag}.mfa"
+        rc = run([str(paf), "-r", str(fa), "-o", str(rep),
+                  "-s", str(summ), "-w", str(msa), "--batch=5"],
+                 stderr=io.StringIO())
+        assert rc == 0
+        outs[tag] = (rep.read_bytes() + summ.read_bytes()
+                     + msa.read_bytes())
+    assert len(set(outs.values())) == 1
+
+
+def test_summary_write_batched_single_call():
+    # the -s writer assembles one block and issues ONE write (the same
+    # batching contract as the report emit path)
+    s = Summary()
+    s.fold_event_counts({"S": 3, "I": 1, "D": 2},
+                        {"S": 3, "I": 4, "D": 9},
+                        {"homopolymer": 2, "motif": 1, "unknown": 3},
+                        {"synonymous": 1, "nonsynonymous": 1,
+                         "premature_stop": 0, "frame_shift": 1})
+
+    class CountingIO(io.StringIO):
+        writes = 0
+
+        def write(self, s_):
+            CountingIO.writes += 1
+            return super().write(s_)
+
+    sink = CountingIO()
+    s.write(sink)
+    assert CountingIO.writes == 1
+    body = sink.getvalue()
+    assert body.startswith("# pwasm-tpu event summary\n")
+    assert "events_total\t6\n" in body
+    assert "substitutions\t3\t3 bases\n" in body
+    assert "deletions\t2\t9 bases\n" in body
+    assert "cause_homopolymer\t2\n" in body
+    assert "impact_frame_shift\t1\n" in body
+
+
+def test_format_buffers_thread_local_reuse():
+    # the per-thread scratch list persists across batches — steady
+    # state does zero list reallocations — and threads never share it
+    rng = np.random.default_rng(41)
+    batch = _fuzz_batch(rng, 2)
+    analyzed = _analyze_batch(batch, False, ["GGCGG"])
+    buf = get_buffers()
+    assert isinstance(buf, FormatBuffers)
+    n0, rows_obj = buf.batches, buf.rows
+    format_batch_block(batch, analyzed, None)
+    format_batch_block(batch, analyzed, None)
+    assert buf.batches == n0 + 2
+    assert buf.rows is rows_obj        # same grown list object
+    assert buf.rows == []              # transient contents dropped
+    other = []
+    t = threading.Thread(target=lambda: other.append(get_buffers()))
+    t.start()
+    t.join()
+    assert other[0] is not buf
+
+
+def test_host_cli_never_imports_jax(tmp_path):
+    # the cold host wall's biggest term was an accidental ~1.2 s jax
+    # import (report/columnar.py -> pwasm_tpu.ops.__init__ ->
+    # ops/consensus.py); the ops re-exports are lazy now and the numpy
+    # consensus twin lives in ops/consensus_host.py — gate the full
+    # host output set (report, -s, -w, --cons) staying jax-free
+    import os
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fa, paf = _cli_corpus(tmp_path, np.random.default_rng(47), n=6)
+    code = (
+        "import sys, io\n"
+        "from pwasm_tpu.cli import run\n"
+        f"rc = run([{str(paf)!r}, '-r', {str(fa)!r},"
+        f" '-o', {str(tmp_path / 'j.dfa')!r},"
+        f" '-s', {str(tmp_path / 'j.sum')!r},"
+        f" '-w', {str(tmp_path / 'j.mfa')!r},"
+        f" '--cons={tmp_path / 'j.cons'}'], stderr=io.StringIO())\n"
+        "assert rc == 0\n"
+        "assert 'jax' not in sys.modules, 'host path imported jax'\n")
+    env = dict(os.environ, PYTHONPATH=repo)
+    r = subprocess.run([_sys.executable, "-c", code],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+
+def test_warm_context_shares_host_executor(tmp_path):
+    # consecutive warm jobs reuse ONE host-pipeline worker (and its
+    # thread-local FormatBuffers): no per-job thread/buffer allocation
+    from pwasm_tpu.service.daemon import WarmContext
+
+    fa, paf = _cli_corpus(tmp_path, np.random.default_rng(43), n=8)
+    ctx = WarmContext()
+    bodies = []
+    for j in (1, 2):
+        rep = tmp_path / f"warm{j}.dfa"
+        summ = tmp_path / f"warm{j}.sum"
+        rc = run([str(paf), "-r", str(fa), "-o", str(rep),
+                  "-s", str(summ), "--batch=3"],
+                 stderr=io.StringIO(), warm=ctx)
+        assert rc == 0
+        bodies.append(rep.read_bytes() + summ.read_bytes())
+        assert ctx.host_pool is not None
+        if j == 1:
+            pool = ctx.host_pool
+        else:
+            assert ctx.host_pool is pool   # job 2 reused job 1's
+    assert bodies[0] == bodies[1]
+    # the worker's scratch saw both jobs' batches (cross-job reuse)
+    seen = pool.submit(lambda: get_buffers().batches).result()
+    assert seen >= 2
+    ctx.close()
+    assert ctx.host_pool is None
+    # cold runs own (and retire) their worker — warm state untouched
+    rc = run([str(paf), "-r", str(fa), "-o",
+              str(tmp_path / "cold.dfa")], stderr=io.StringIO())
+    assert rc == 0 and ctx.host_pool is None
