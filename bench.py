@@ -1206,6 +1206,27 @@ def cfg8_realistic_scale() -> int:
                 if j > 1 and not (bk.get("probes", 1) == 0
                                   and bk.get("warm_hits", 0) > 0):
                     warm_ok = False
+            # warm-serve ratio (ISSUE 8 satellite / ROADMAP item 2
+            # lever c): the serving HOT path — a host-path job through
+            # the already-warm daemon skips the ~0.44 s
+            # interpreter+numpy startup floor every cold CLI run pays
+            # — measured against the native binary.  Client-side
+            # submit->result wall on an empty queue IS the per-job
+            # serving latency.
+            warm_walls = []
+            for j in (1, 2):
+                t0 = time.perf_counter()
+                with ServiceClient(svc_sock) as c:
+                    sub = c.submit(args(f"srvh{j}", []))
+                    if not sub.get("ok"):
+                        return _fail("realistic_serve_submit")
+                    res = c.result(sub["job_id"], timeout=600)
+                warm_walls.append(time.perf_counter() - t0)
+                if not res.get("ok") or res.get("rc") != 0:
+                    sys.stderr.write(str(res)[:1000])
+                    return _fail("realistic_serve_warm_job")
+                if readset(f"srvh{j}") != parity_body:
+                    return _fail("realistic_serve_warm_parity")
             with ServiceClient(svc_sock) as c:
                 c.drain()
             serve_rc = sp.wait(timeout=120)
@@ -1219,6 +1240,99 @@ def cfg8_realistic_scale() -> int:
         serve_ok = warm_ok and serve_rc == 75
         _emit("realistic_serve_warm_jobs", 3, "jobs",
               1.0 if serve_ok else 0.0, cpu_metric=True)
+        if cli_bin is not None:
+            # unit "x" = lower-is-better in qa/bench_gate.py (the wall
+            # rule); vs_baseline records the aspirational 2x flag like
+            # the pycli ratio's 1.5x
+            wr = min(warm_walls) / min(nat_times)
+            _emit("realistic_serve_warm_ratio", wr, "x",
+                  1.0 if wr <= 2.0 else 0.0, cpu_metric=True)
+
+        # --- device-lease lanes (ISSUE 8 tentpole): a 2-lane daemon
+        # (--max-concurrent=2) must run jobs CONCURRENTLY on disjoint
+        # lanes with byte parity for every job, and concurrency must
+        # not LOSE throughput vs the same jobs serialized through the
+        # same warm daemon — the floor the cpu-like twin can certify
+        # (the K*0.8x per-chip scale-UP on a real mesh is
+        # qa/chip_burst.py --multichip's to measure).
+        import threading
+
+        svc2 = os.path.join(d, "svc2.sock")
+        sp2 = subprocess.Popen(
+            cmd + ["serve", f"--socket={svc2}", "--max-queue=8",
+                   "--max-concurrent=2"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        lanes_rc = None
+        lane_jobs: list[int] = []
+        errs: list[str] = []
+
+        def lane_job(tag):
+            try:
+                with ServiceClient(svc2) as c:
+                    sub = c.submit(args(tag, []))
+                    if not sub.get("ok"):
+                        raise RuntimeError(f"submit: {sub}")
+                    res = c.result(sub["job_id"], timeout=600)
+                if not res.get("ok") or res.get("rc") != 0:
+                    raise RuntimeError(str(res)[:300])
+            except Exception as e:
+                errs.append(f"{tag}: {e}")
+
+        try:
+            if not wait_for_socket(svc2, 120):
+                return _fail("realistic_serve_lanes_up")
+            lane_job("lwarm")     # shared warmup: probe + native lib
+            if errs:
+                sys.stderr.write("\n".join(errs)[:1000])
+                return _fail("realistic_serve_lanes_warm")
+            t0 = time.perf_counter()
+            ts = [threading.Thread(target=lane_job, args=(f"lc{k}",))
+                  for k in range(4)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            conc_wall = time.perf_counter() - t0
+            with ServiceClient(svc2) as c:
+                lane_jobs = [r["jobs_run"]
+                             for r in c.stats()["stats"]["lanes"]]
+            t0 = time.perf_counter()
+            for k in range(4):
+                lane_job(f"ls{k}")
+            seq_wall = time.perf_counter() - t0
+            if errs:
+                sys.stderr.write("\n".join(errs)[:1000])
+                return _fail("realistic_serve_lanes_job")
+            for k in range(4):
+                if (readset(f"lc{k}") != parity_body
+                        or readset(f"ls{k}") != parity_body):
+                    return _fail("realistic_serve_lanes_parity")
+            with ServiceClient(svc2) as c:
+                c.drain()
+            lanes_rc = sp2.wait(timeout=120)
+        except Exception as e:
+            sys.stderr.write(f"lanes leg: {e}\n")
+            return _fail("realistic_serve_lanes")
+        finally:
+            if sp2.poll() is None:
+                sp2.kill()
+                sp2.wait()
+        jps1 = 4 / seq_wall
+        jps2 = 4 / conc_wall
+        # the bool leg gates only deterministic facts: byte parity
+        # (checked above), both lanes actually scheduled jobs, clean
+        # drain rc.  The jps2-vs-jps1 throughput floor is a TIMING
+        # claim — a loaded box can miss it with every byte correct —
+        # so it lives in the gated rate legs below (bench_gate fails
+        # a >25% rate drop), not folded into a "parity" bool.
+        lanes_ok = (lanes_rc == 75 and len(lane_jobs) == 2
+                    and min(lane_jobs) >= 1)
+        _emit("realistic_serve_jobs_per_s_1lane", jps1, "jobs/s",
+              1.0, cpu_metric=True)
+        _emit("realistic_serve_jobs_per_s_2lane", jps2, "jobs/s",
+              jps2 / jps1, cpu_metric=True)
+        _emit("realistic_serve_lanes_parity", 1 if lanes_ok else 0,
+              "bool", 1.0 if lanes_ok else 0.0, cpu_metric=True)
 
         # --- host engine A/B: 1k-alignment report+summary corpus ----
         qseq1k, lines1k = make_corpus(n_aln=1000)

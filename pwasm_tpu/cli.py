@@ -17,6 +17,7 @@ Usage:
 from __future__ import annotations
 
 import sys
+from contextlib import contextmanager
 
 from pwasm_tpu.core.config import (AUTO_FULLGENOME_FASTA_BYTES, Config,
                                    load_motifs)
@@ -716,7 +717,8 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None) -> int:
             drain_cm.obs = obs   # the drain request itself is a
             #                      lifecycle event worth logging
         with device_trace(cfg.profile_dir, stderr), drain_cm as drain:
-            with obs.span("run", device=cfg.device):
+            with obs.span("run", device=cfg.device), \
+                    _lane_device_scope(cfg, warm, stderr):
                 return _main_loop(cfg, inf, freport, fmsa, fsummary,
                                   summary, qfasta, stdout, stderr,
                                   cons_outs, resume_skip=resume_skip,
@@ -748,6 +750,74 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None) -> int:
                 fo.close()   # no-op when the normal path closed it
             except Exception:
                 pass
+
+
+def _lane_devices(warm):
+    """The device-index span ``[lo, hi)`` of the job's device lease,
+    or None for a cold run / single-lane daemon (the daemon only
+    exposes the span when it actually runs multiple lanes, so classic
+    serving is untouched)."""
+    return getattr(warm, "lease_devices", None) \
+        if warm is not None else None
+
+
+def _lane_device_pool(span, stderr=None, warn: bool = True):
+    """Map a lease's device-index span onto live jax devices (callable
+    only after the backend probe passed).  Clamps when fewer devices
+    exist than the lane layout assumes — on the single-CPU test
+    backend every lane degrades to device 0 and the lease is a plain
+    concurrency token (bytes are placement-independent).  On a REAL
+    multi-device backend a clamp means the daemon's lane layout
+    (lanes x devices-per-job) oversubscribes the inventory and
+    'disjoint' lanes now overlap on a chip, so it is warned, not
+    silent — the operator sized the lanes wrong.  ``warn=False`` for
+    a rebuild of a pool the run already warned about (the shard-mesh
+    site, inside ``_lane_device_scope``)."""
+    import jax
+
+    devs = jax.devices()
+    lo, hi = span
+    pool = devs[lo:hi]
+    clamped = len(pool) < hi - lo
+    if not pool:
+        pool = [devs[lo % len(devs)]]
+    if warn and clamped and len(devs) > 1:
+        print(f"Warning: device lease [{lo},{hi}) exceeds the "
+              f"{len(devs)}-device inventory — lane layout "
+              "oversubscribes the mesh and lanes may share a chip; "
+              "size --lanes*--devices-per-job to the real device "
+              "count", file=stderr if stderr is not None
+              else sys.stderr)
+    return pool
+
+
+@contextmanager
+def _lane_device_scope(cfg, warm, stderr=None):
+    """Pin a leased job's default device placement to its lane
+    (ISSUE 8): two jobs holding different leases place their programs
+    on disjoint chips instead of both landing on ``jax.devices()[0]``.
+    ``jax.default_device`` is thread-local, so the daemon's concurrent
+    worker threads scope independently.  Inert for cold runs, host
+    jobs, and single-lane daemons; guarded by the same bounded backend
+    probe as the main loop (never the first unprotected jax touch).
+    ``stderr`` is the JOB's stderr (a served job's is a capture buffer
+    the submitter reads — the oversubscription warning must land
+    there, not on the daemon's global sys.stderr); the scope is the
+    ONE place that warns, so the shard-mesh rebuild of the same pool
+    below stays silent."""
+    span = _lane_devices(warm)
+    if span is None or cfg.device != "tpu":
+        yield
+        return
+    from pwasm_tpu.utils.backend import device_backend_reachable
+    ok, _why = device_backend_reachable()
+    if not ok:
+        yield      # the loop's own gate demotes to cpu right after
+        return
+    import jax
+
+    with jax.default_device(_lane_device_pool(span, stderr)[0]):
+        yield
 
 
 def _native_msa_outputs(nmsa, cfg, fmsa, cons_outs, stderr,
@@ -971,6 +1041,7 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
     # its votes (the north-star flow with the native merge).
     # PWASM_NATIVE_MSA=0 opts out (and the parity tests use it).
     nmsa = None
+    nmsa_batch = False
     if build_msa_out:
         import os as _os
 
@@ -985,22 +1056,43 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
             print("pwasm: native MSA engine unavailable; using the "
                   "Python engine", file=stderr)
             stats.engine_fallbacks += 1
+        # batched add marshalling (ROADMAP item 2 lever a): buffer the
+        # per-alignment native inserts and marshal a whole flush in ONE
+        # ffi crossing (pw_msa_add_batch).  PWASM_NATIVE_MSA_BATCH=0 is
+        # the per-alignment A/B hatch (mirrors PWASM_HOST_FORMAT /
+        # PWASM_HOST_COLUMNAR: regressions stay bisectable).
+        nmsa_batch = nmsa is not None and _os.environ.get(
+            "PWASM_NATIVE_MSA_BATCH", "1") != "0"
+    # (al_key, tlabel, realigned, refseq_b, add_batch item) rows
+    # awaiting the next batched native merge; keys mirror the buffered
+    # pair slots so the gene-mode dedup logic can force a flush when it
+    # needs a pending pair's verdict (a dropped insert frees its slot)
+    msa_pending: list[tuple] = []
+    msa_pending_keys: set[str] = set()
 
     # --shard: one mesh for the whole run (device work spreads over it;
     # consensus counts psum over its depth axis).  Built lazily so a
-    # plain run never initializes jax.
+    # plain run never initializes jax.  A job holding a multi-device
+    # lease (ISSUE 8) shards over EXACTLY its lane's devices — the
+    # ICI-sharded big-batch path with the psum'd consensus counts
+    # stays inside the lease, never touching a neighbor job's chips.
     shard_mesh = None
     if use_device and cfg.shard:
         import jax
 
         from pwasm_tpu.parallel.mesh import make_mesh
-        n_dev = len(jax.devices())
+        span = _lane_devices(warm)
+        pool = _lane_device_pool(span, stderr, warn=False) \
+            if span is not None else jax.devices()
+        n_dev = len(pool)
         want = n_dev if cfg.shard < 0 else cfg.shard
         if want > n_dev:
+            where = f"the job's device lease holds {n_dev}" \
+                if span is not None else f"only {n_dev} devices are " \
+                "visible"
             raise PwasmError(
-                f"Error: --shard={want} but only {n_dev} devices are "
-                "visible!\n")
-        shard_mesh = make_mesh(want)
+                f"Error: --shard={want} but {where}!\n")
+        shard_mesh = make_mesh(want, devices=pool)
         if cfg.verbose:
             print(f"sharding over mesh {dict(shard_mesh.shape)}",
                   file=stderr)
@@ -1058,6 +1150,59 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                 obs.event("ckpt_write", records=emitted[0],
                           batch=nrecords)
 
+    def _drop_msa(key: str, tlabel: str, realigned: bool) -> None:
+        # NB the alignment's report rows were already emitted — it
+        # is only excluded from the MSA, so it counts under
+        # msa_dropped, not skipped_bad_lines; the freed dedup slot
+        # lets a later valid alignment of the pair take its place
+        stats.msa_dropped += 1
+        src = ("re-aligned gap structure — possible re-aligner "
+               "defect" if realigned else "out-of-layout gap "
+               "structure in the input")
+        print(f"Warning: excluding alignment {tlabel} from the MSA "
+              f"({src})", file=stderr)
+        alnpairs.pop(key, None)
+
+    def flush_msa_pending() -> None:
+        """Merge the buffered alignments into the native MSA through
+        ONE ``pw_msa_add_batch`` crossing.  Every buffered item shares
+        the current query (the buffer flushes on query change), so
+        rid/refseq/r_len marshal once; per-item failures keep the
+        sequential semantics — the engine stops at the failing item
+        and the drop hook below either raises (the fatal
+        non-``--skip-bad-lines`` path) or replays the per-alignment
+        drop bookkeeping in input order.
+
+        Parity contract vs the ``PWASM_NATIVE_MSA_BATCH=0`` per-item
+        hatch: byte-identical OUTPUT FILES (report/-w/-s) on every run
+        that completes (clean corpora and ``--skip-bad-lines`` drops).
+        stderr is ordering-equivalent, not byte-equivalent: a drop
+        warning surfaces at this flush boundary, so it can land after
+        later lines' warnings that per-item mode would print after it.
+        On the fatal path the error itself is identical (same
+        PwasmError, same rc) but also surfaces at the flush boundary
+        instead of mid-input, so alignments buffered AFTER the failing
+        one may already have report rows/warnings out when the run
+        aborts — inherent to batching a failure only the native engine
+        can detect, and moot for the aborted run's (invalid) partial
+        output."""
+        if not msa_pending:
+            return
+        items, msa_pending[:] = msa_pending[:], []
+        msa_pending_keys.clear()
+        rid, r_len = items[0][0]
+        refseq_b = items[0][3]
+
+        def on_drop(idx: int, msg: str) -> None:
+            if not cfg.skip_bad_lines:
+                raise PwasmError(msg)
+            _key, tlab, realig = (items[idx][1], items[idx][2],
+                                  items[idx][4])
+            _drop_msa(_key, tlab, realig)
+
+        nmsa.add_batch(rid, refseq_b, r_len,
+                       [it[5] for it in items], on_drop)
+
     def msa_add(aln, tlabel: str, refseq_b: bytes, ord_num: int,
                 realigned: bool = False) -> None:
         """Insert one alignment into the progressive MSA (the per-line
@@ -1066,19 +1211,20 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         al = aln.alninfo
 
         def drop_from_msa():
-            # NB the alignment's report rows were already emitted — it
-            # is only excluded from the MSA, so it counts under
-            # msa_dropped, not skipped_bad_lines; the freed dedup slot
-            # lets a later valid alignment of the pair take its place
-            stats.msa_dropped += 1
-            src = ("re-aligned gap structure — possible re-aligner "
-                   "defect" if realigned else "out-of-layout gap "
-                   "structure in the input")
-            print(f"Warning: excluding alignment {tlabel} from the MSA "
-                  f"({src})", file=stderr)
-            alnpairs.pop(f"{al.r_id}~{al.t_id}", None)
+            _drop_msa(f"{al.r_id}~{al.t_id}", tlabel, realigned)
 
         if nmsa is not None:
+            if nmsa_batch:
+                key = f"{al.r_id}~{al.t_id}"
+                msa_pending.append(
+                    ((al.r_id, al.r_len), key, tlabel, refseq_b,
+                     realigned,
+                     (tlabel, bytes(aln.tseq), al.r_alnstart,
+                      aln.reverse, aln.rgaps, aln.tgaps, ord_num)))
+                msa_pending_keys.add(key)
+                if len(msa_pending) >= cfg.batch:
+                    flush_msa_pending()
+                return
             ok = nmsa.add(tlabel, bytes(aln.tseq), al.r_alnstart,
                           aln.reverse, al.r_id, refseq_b, al.r_len,
                           aln.rgaps, aln.tgaps, ord_num)
@@ -1271,6 +1417,12 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
             new_pair = None
             if not cfg.fullgenome:  # gene CDS mode: first q~t alignment only
                 key = f"{al.r_id}~{al.t_id}"
+                if key in msa_pending_keys:
+                    # a buffered native insert of this pair may still
+                    # be DROPPED (out-of-layout gaps free its dedup
+                    # slot for this very line): resolve the batch
+                    # before the dup verdict
+                    flush_msa_pending()
                 if key not in alnpairs:
                     alnpairs[key] = 0
                     new_pair = key
@@ -1299,8 +1451,11 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                 continue
             if refseq_id is None or refseq_id != al.r_id:
                 # buffered re-alignments belong to the previous query's
-                # MSA: merge them before the layout state resets
+                # MSA: merge them before the layout state resets (and
+                # the batched native inserts with them — the add-batch
+                # buffer never spans a query boundary)
                 flush_realign()
+                flush_msa_pending()
                 if al.r_id in ref_cache:
                     refseq = ref_cache[al.r_id]
                 else:
@@ -1396,6 +1551,7 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
     def _output_tail() -> None:
         if nmsa is not None:
             flush_realign()
+            flush_msa_pending()
             _native_msa_outputs(nmsa, cfg, fmsa, cons_outs, stderr,
                                 device=use_device, mesh=shard_mesh,
                                 stats=stats, supervisor=supervisor)

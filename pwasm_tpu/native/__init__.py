@@ -136,6 +136,19 @@ def get_lib():
             ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_char_p, ctypes.c_int32]
+        lib.pw_msa_add_batch.restype = ctypes.c_int
+        lib.pw_msa_add_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_void_p,    # labels + offsets
+            ctypes.c_char_p, ctypes.c_void_p,    # tseq blob + offsets
+            ctypes.c_void_p, ctypes.c_void_p,    # t_offsets, reverses
+            ctypes.c_void_p,                     # ord_nums
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_int64,                      # rid, refseq(+len), r_len
+            ctypes.c_void_p, ctypes.c_void_p,    # rgaps + pair offsets
+            ctypes.c_void_p, ctypes.c_void_p,    # tgaps + pair offsets
+            ctypes.c_void_p,                     # done_out
+            ctypes.c_char_p, ctypes.c_int32]
         lib.pw_msa_refine.restype = ctypes.c_int
         lib.pw_msa_refine.argtypes = [
             ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
@@ -608,6 +621,80 @@ class NativeMsa:
         if rc != 0:
             self._raise(rc)
         return True
+
+    def add_batch(self, rid: str, refseq: bytes, r_len: int, items,
+                  on_drop) -> None:
+        """Insert a whole flush of alignments for ONE query through a
+        single ``pw_msa_add_batch`` crossing (ROADMAP item 2 lever a:
+        the per-alignment ctypes marshalling was the largest surviving
+        in-loop host term).  ``items`` is a list of
+        ``(tlabel, tseq, t_offset, reverse, rgaps, tgaps, ord_num)``
+        in insertion order; all share ``rid``/``refseq``/``r_len`` —
+        the caller flushes its buffer on query change.  Insertion is
+        strictly sequential on the native side and stops at the first
+        failing item, so the semantics match per-item :meth:`add`
+        exactly: ``on_drop(idx, msg)`` fires, in input order, for each
+        item whose gap structure does not fit the layout (nothing
+        mutated for it) — raise inside it to abort like the fatal
+        non-``--skip-bad-lines`` path, or return to skip the item and
+        continue with the rest.  Other engine errors raise as usual."""
+        n = len(items)
+        if n == 0:
+            return
+        label_bs = [it[0].encode() for it in items]
+        labels = b"".join(label_bs)
+        label_off = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum([len(b) for b in label_bs], out=label_off[1:])
+        tseq_blob = b"".join(bytes(it[1]) for it in items)
+        tseq_off = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum([len(it[1]) for it in items], out=tseq_off[1:])
+        t_offsets = np.asarray([it[2] for it in items], dtype=np.int64)
+        reverses = np.asarray([int(it[3]) for it in items],
+                              dtype=np.int32)
+        ord_nums = np.asarray([it[6] for it in items], dtype=np.int64)
+        rg_flat: list[int] = []
+        tg_flat: list[int] = []
+        rg_off = np.zeros(n + 1, dtype=np.int64)
+        tg_off = np.zeros(n + 1, dtype=np.int64)
+        for i, it in enumerate(items):
+            for g in it[4]:
+                rg_flat.append(g.pos)
+                rg_flat.append(g.len)
+            for g in it[5]:
+                tg_flat.append(g.pos)
+                tg_flat.append(g.len)
+            rg_off[i + 1] = len(rg_flat) // 2
+            tg_off[i + 1] = len(tg_flat) // 2
+        rg = np.asarray(rg_flat, dtype=np.int32)
+        tg = np.asarray(tg_flat, dtype=np.int32)
+        done = np.zeros(1, dtype=np.int64)
+        rid_b = rid.encode()
+        start = 0
+        while start < n:
+            rc = self._lib.pw_msa_add_batch(
+                self._h, n, start, labels,
+                label_off.ctypes.data_as(ctypes.c_void_p), tseq_blob,
+                tseq_off.ctypes.data_as(ctypes.c_void_p),
+                t_offsets.ctypes.data_as(ctypes.c_void_p),
+                reverses.ctypes.data_as(ctypes.c_void_p),
+                ord_nums.ctypes.data_as(ctypes.c_void_p), rid_b,
+                refseq, len(refseq), r_len,
+                rg.ctypes.data_as(ctypes.c_void_p),
+                rg_off.ctypes.data_as(ctypes.c_void_p),
+                tg.ctypes.data_as(ctypes.c_void_p),
+                tg_off.ctypes.data_as(ctypes.c_void_p),
+                done.ctypes.data_as(ctypes.c_void_p),
+                self._err, len(self._err))
+            start += int(done[0])
+            if rc == 0:
+                return
+            if rc == 1:
+                self.gap_err = self._err.value.decode(
+                    "utf-8", "replace")
+                on_drop(start, self.gap_err)
+                start += 1
+                continue
+            self._raise(rc)
 
     def refine(self, remove_cons_gaps: bool, refine_clipping: bool) -> None:
         rc = self._lib.pw_msa_refine(

@@ -180,6 +180,26 @@ def build_service_metrics(reg: MetricsRegistry) -> dict:
     m["results_held"] = reg.gauge(
         "pwasm_service_results_held",
         "Terminal job results currently retained for pickup")
+    # device-lease scheduler (ISSUE 8): lane inventory + wait surface
+    m["lanes"] = reg.gauge(
+        "pwasm_service_lanes",
+        "Device-lease lanes the daemon schedules jobs onto")
+    m["lanes_busy"] = reg.gauge(
+        "pwasm_service_lanes_busy", "Lanes currently leased to a job")
+    m["lease_waiting"] = reg.gauge(
+        "pwasm_service_lease_waiting_jobs",
+        "Dequeued jobs waiting for a free device lease")
+    m["lane_breaker_state"] = reg.gauge(
+        "pwasm_service_lane_breaker_state",
+        "Per-lane breaker: 0 closed, 1 half-open, 2 open",
+        labels=("lane",))
+    m["lane_jobs"] = reg.counter(
+        "pwasm_service_lane_jobs_total",
+        "Jobs completed per device-lease lane", labels=("lane",))
+    m["lease_wait_seconds"] = reg.histogram(
+        "pwasm_service_lease_wait_seconds",
+        "Per-job device-lease wait seconds (dequeue to grant)",
+        buckets=_WAIT_BUCKETS)
     m["jobs"] = reg.counter(
         "pwasm_service_jobs_total",
         "Job admissions and outcomes, by outcome "

@@ -33,6 +33,18 @@ def round_up(x: int, step: int = 128) -> int:
     return max(step, (x + step - 1) // step * step)
 
 
+def mesh_multiple(mesh) -> int:
+    """Total device count of a mesh (product of its axis sizes) — the
+    ``batch_multiple`` a fully-flattened sharded dispatch needs so
+    every shard receives equal rows.  Accepts None (1: unsharded) or
+    any Mesh-shaped object with a ``.shape`` mapping; deliberately
+    jax-free so host-only callers can import it."""
+    if mesh is None:
+        return 1
+    return max(1, int(np.prod([int(v)
+                               for v in dict(mesh.shape).values()])))
+
+
 def encode_seqs(seqs) -> list[np.ndarray]:
     """Normalize a ragged sequence list to int8 code arrays: bytes/str
     encode upper-case via ``core.dna.encode``; arrays pass through."""

@@ -29,11 +29,14 @@ from pwasm_tpu.ops.banded_dp import (ScoreParams, banded_scores_batch,
 
 
 def make_mesh2d(n_devices: int | None = None,
-                axis_names: tuple[str, str] = ("query", "target")) -> Mesh:
+                axis_names: tuple[str, str] = ("query", "target"),
+                devices=None) -> Mesh:
     """A 2-D mesh over the first ``n_devices`` devices; the query axis
     gets the largest factor <= sqrt(n) (targets usually outnumber
-    queries, so the target axis gets the bigger factor)."""
-    devs = jax.devices()
+    queries, so the target axis gets the bigger factor).  ``devices``
+    pins the pool to an explicit device list (a served job's device
+    lease), like ``mesh.make_mesh``."""
+    devs = list(devices) if devices is not None else jax.devices()
     if n_devices is not None:
         devs = devs[:n_devices]
     n = len(devs)

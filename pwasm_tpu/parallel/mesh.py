@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from pwasm_tpu.utils.jaxcompat import shard_map
+from pwasm_tpu.utils.jaxcompat import psum, shard_map
 
 from pwasm_tpu.ops.banded_dp import ScoreParams, banded_scores_batch
 from pwasm_tpu.ops.consensus import consensus_vote_counts, pileup_counts
@@ -48,12 +48,20 @@ def _inner_factor(n: int) -> int:
 
 def make_mesh(n_devices: int | None = None,
               axis_names: tuple[str, str] = ("batch", "depth"),
-              platform: str | None = None) -> Mesh:
+              platform: str | None = None,
+              devices=None) -> Mesh:
     """A 2-D mesh over the first ``n_devices`` devices.  The depth axis
     gets the largest factor <= sqrt(n) so both axes are exercised.
     ``platform`` restricts the device pool (e.g. ``"cpu"`` builds the
-    degradation twin of a TPU mesh, see ``cpu_like_mesh``)."""
-    devs = jax.devices(platform) if platform else jax.devices()
+    degradation twin of a TPU mesh, see ``cpu_like_mesh``).
+    ``devices`` pins the pool to an EXPLICIT device list instead of the
+    global order — the device-lease scheduler hands each served job its
+    lane's slice of ``jax.devices()`` this way, so two concurrent jobs'
+    meshes never overlap on a chip."""
+    if devices is not None:
+        devs = list(devices)
+    else:
+        devs = jax.devices(platform) if platform else jax.devices()
     if n_devices is not None:
         devs = devs[:n_devices]
     n = len(devs)
@@ -90,7 +98,7 @@ def sharded_consensus(mesh: Mesh, dp_axes=("batch",)):
 
     def block(b_local):
         local = pileup_counts(b_local)
-        total = jax.lax.psum(local, "depth")
+        total = psum(local, "depth")
         return consensus_vote_counts(total)
 
     fn = shard_map(block, mesh=mesh,
@@ -113,7 +121,7 @@ def sharded_counts_votes(mesh: Mesh, dp_axes=("batch",)):
     dp = dp_axes if len(dp_axes) > 1 else dp_axes[0]
 
     def block(b_local):
-        total = jax.lax.psum(pileup_counts(b_local), "depth")
+        total = psum(pileup_counts(b_local), "depth")
         return consensus_vote_counts(total), total
 
     fn = shard_map(block, mesh=mesh,

@@ -28,7 +28,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from pwasm_tpu.utils.jaxcompat import pcast, shard_map
+from pwasm_tpu.utils.jaxcompat import pcast, ppermute, psum, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from pwasm_tpu.ops.banded_dp import (NEG, ScoreParams, band_dlo,
@@ -104,7 +104,7 @@ def make_wavefront_sp(mesh: Mesh, m: int, n: int, T: int,
             emit = active & (d == D - 1)   # last chunk completes row m
             # hand the wavefront edge to the right neighbor (ICI halo)
             wf_next = jax.tree.map(
-                lambda x: jax.lax.ppermute(x, axis, perm), wf_out)
+                lambda x: ppermute(x, axis, perm), wf_out)
             return wf_next, (bc, jnp.where(emit, score, 0),
                              emit.astype(jnp.int32))
 
@@ -117,8 +117,8 @@ def make_wavefront_sp(mesh: Mesh, m: int, n: int, T: int,
             jnp.where(emits == 1, scs, 0))
         got = jnp.zeros((T,), jnp.int32).at[bs].add(emits)
         # only the last device emitted real scores; share them ringwide
-        scores = jax.lax.psum(scores, axis)
-        got = jax.lax.psum(got, axis)
+        scores = psum(scores, axis)
+        got = psum(got, axis)
         return jnp.where(got > 0, scores, NEG)
 
     fn = shard_map(local, mesh=mesh,

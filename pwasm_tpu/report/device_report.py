@@ -115,7 +115,8 @@ def submit_events_device(refseq: bytes, events,
                 import jax
                 from jax.sharding import NamedSharding, PartitionSpec
 
-                n_mesh = int(np.prod(list(mesh.shape.values())))
+                from pwasm_tpu.parallel.bucketing import mesh_multiple
+                n_mesh = mesh_multiple(mesh)
                 packed = {
                     k: jax.device_put(
                         _pad_axis0(v, n_mesh),

@@ -42,6 +42,7 @@ import time
 from pwasm_tpu.core.errors import EXIT_PREEMPTED, EXIT_USAGE, PwasmError
 from pwasm_tpu.resilience.lifecycle import SignalDrain
 from pwasm_tpu.service import protocol
+from pwasm_tpu.service.leases import LeaseManager
 from pwasm_tpu.service.queue import (JOB_CANCELLED, JOB_DONE, JOB_FAILED,
                                      JOB_PREEMPTED, JOB_QUEUED,
                                      JOB_RUNNING, TERMINAL_STATES,
@@ -50,15 +51,28 @@ from pwasm_tpu.service.queue import (JOB_CANCELLED, JOB_DONE, JOB_FAILED,
 
 _SERVE_USAGE = """Usage:
  pwasm-tpu serve --socket=PATH [--max-queue=N] [--max-concurrent=N]
+                 [--devices-per-job=N] [--lanes=N]
                  [--max-frame-bytes=N] [--metrics-textfile=PATH]
                  [--log-json=FILE] [--result-ttl-s=S] [--max-results=N]
 
    --socket=PATH        unix socket to listen on (required)
    --max-queue=N        admission control: queued-job ceiling, beyond
                         which submit answers queue_full (default 16)
-   --max-concurrent=N   worker threads executing jobs (default 1 —
-                        serial jobs share the device cleanly; raise it
-                        only for host-path workloads)
+   --max-concurrent=N   worker threads executing jobs (default 1).
+                        Each running job also holds a DEVICE LEASE
+                        (one lane of the device inventory), so K
+                        concurrent jobs run on K disjoint lanes — a
+                        v5e-8 with --max-concurrent=8 runs 8 jobs on
+                        8 chips, not 8 jobs interleaved on chip 0
+   --devices-per-job=N  devices granted per lease (default 1): a big
+                        job leases N chips and its --shard work spans
+                        exactly its lane (ICI-sharded batch + psum'd
+                        consensus counts over the leased devices)
+   --lanes=N            lease-lane count (default: --max-concurrent).
+                        Set it to chips/devices-per-job on a real
+                        mesh; with lanes < max-concurrent a dequeued
+                        job WAITS for a free lease (FIFO, measured by
+                        the lease-wait histogram), not just a thread
    --max-frame-bytes=N  protocol frame ceiling (default 8 MiB)
    --metrics-textfile=PATH  publish the daemon's Prometheus text
                         exposition here (atomic rewrite after every
@@ -89,12 +103,13 @@ class WarmContext:
     - ``drain``             the SignalDrain the run must honor (the
                             daemon supplies a per-job one via
                             :class:`_JobWarm`);
-    - ``monitor``           the single ``BackendHealthMonitor``,
-                            re-attached to each job's RunStats;
-    - ``supervisor_state``  the breaker/ceiling snapshot exported at
-                            each job's end and restored into the next
-                            job's supervisor (fault clock stripped —
-                            scripted fault windows are per-job);
+    - ``monitor`` / ``supervisor_state``  legacy slots for a bare
+                            warm context (tests, embedding callers).
+                            Under the daemon these now live on the
+                            per-lane :class:`DeviceLease` instead
+                            (service/leases.py) so a flap on lane 0
+                            cannot degrade lane 1 — ``_JobWarm``
+                            redirects both to the job's lease;
     - ``host_executor()``   the single persistent host-pipeline worker
                             (report analyze→format stage) shared by
                             consecutive jobs, so the warm path pays no
@@ -133,45 +148,45 @@ class WarmContext:
 
 
 class _JobWarm:
-    """Per-job view of the shared :class:`WarmContext`: shared
-    supervisor state (lock-guarded snapshot swap), this job's own
-    drain flag, and the monitor shared ONLY when jobs are serial
-    (``--max-concurrent=1``, the device default).  A monitor is one
-    probe schedule with per-run sinks — two concurrent jobs calling
-    ``attach()`` on it would rebind each other's stats mid-run and
-    reset the probe callable under the other's feet, so with a wider
-    worker pool each job runs its own monitor and only the
-    breaker/ceiling snapshot (an atomic dict swap) is inherited."""
+    """Per-job view of the warm process: this job's own drain flag,
+    the shared host-pipeline executor, and — NEW with the device-lease
+    scheduler (ISSUE 8) — the LANE's warm state.  The supervisor's
+    breaker/ceiling snapshot and the health monitor live on the
+    :class:`~pwasm_tpu.service.leases.DeviceLease` the job holds, not
+    on the daemon: a flap that opens lane 0's breaker degrades only
+    the jobs that later run on lane 0, never lane 1's healthy chip.
+    The lease is held exclusively for the job's duration, so the
+    monitor ``attach()`` rebinding that made cross-job sharing unsafe
+    under a wide worker pool is race-free per lane by construction.
+
+    ``lease_devices`` (a ``(lo, hi)`` device-index span, or None) is
+    what ``cli.run`` reads to scope the job's device placement — set
+    only when the daemon actually runs multiple lanes or grants more
+    than one device, so a classic single-lane daemon behaves exactly
+    as before."""
 
     def __init__(self, shared: WarmContext, drain: SignalDrain,
-                 share_monitor: bool = True):
+                 lease, expose_devices: bool = False):
         self._shared = shared
         self.drain = drain
-        self._share_monitor = share_monitor
-        self._own_monitor = None
+        self.lease = lease
+        self.lease_devices = lease.devices if expose_devices else None
 
     @property
     def monitor(self):
-        if self._share_monitor:
-            return self._shared.monitor
-        return self._own_monitor
+        return self.lease.monitor
 
     @monitor.setter
     def monitor(self, m) -> None:
-        if self._share_monitor:
-            self._shared.monitor = m
-        else:
-            self._own_monitor = m
+        self.lease.monitor = m
 
     @property
     def supervisor_state(self):
-        with self._shared.lock:
-            return self._shared.supervisor_state
+        return self.lease.supervisor_state
 
     @supervisor_state.setter
     def supervisor_state(self, st) -> None:
-        with self._shared.lock:
-            self._shared.supervisor_state = st
+        self.lease.supervisor_state = st
 
     def host_executor(self):
         return self._shared.host_executor()
@@ -186,9 +201,26 @@ class Daemon:
                  max_frame_bytes: int = protocol.MAX_FRAME_BYTES,
                  stderr=None, runner=None, metrics_textfile=None,
                  log_json=None, result_ttl_s: float | None = None,
-                 max_results: int | None = None):
+                 max_results: int | None = None,
+                 lanes: int | None = None, devices_per_job: int = 1):
         self.socket_path = socket_path
         self.max_concurrent = max(1, int(max_concurrent))
+        # device-lease scheduler (ISSUE 8): every running job holds one
+        # lane of the device inventory.  lanes defaults to the worker
+        # count (each worker always finds a lease, wait ~0); an
+        # explicit --lanes below the worker count makes admission
+        # genuinely lease-gated — a dequeued job waits (FIFO) for a
+        # free lane, measured by the lease-wait histogram.
+        self.devices_per_job = max(1, int(devices_per_job))
+        self.leases = LeaseManager(
+            lanes if lanes is not None else self.max_concurrent,
+            self.devices_per_job)
+        # expose the lane's device span to jobs only when the operator
+        # actually asked for multi-lane/multi-device serving — a
+        # classic 1-lane daemon must behave byte-and-counter
+        # identically to PR 5
+        self._expose_devices = (self.leases.n_lanes > 1
+                                or self.devices_per_job > 1)
         self.max_frame_bytes = int(max_frame_bytes)
         self.stderr = stderr if stderr is not None else sys.stderr
         self._runner = runner
@@ -227,6 +259,7 @@ class Daemon:
                                              include_live=False)
         self.svc_metrics["max_queue"].set(self.queue.max_queue)
         self.svc_metrics["max_concurrent"].set(self.max_concurrent)
+        self.svc_metrics["lanes"].set(self.leases.n_lanes)
         self.metrics_textfile = metrics_textfile
         self._textfile_lock = threading.Lock()  # fsio's tmp name is
         #   pid-unique, not thread-unique: two workers finishing at
@@ -284,10 +317,15 @@ class Daemon:
                 w.start()
             self._say(f"serving on {self.socket_path} "
                       f"(max-queue {self.queue.max_queue}, "
-                      f"max-concurrent {self.max_concurrent})")
+                      f"max-concurrent {self.max_concurrent}, "
+                      f"lanes {self.leases.n_lanes}"
+                      + (f" x {self.devices_per_job} device(s)"
+                         if self.devices_per_job > 1 else "") + ")")
             self.obs.event("daemon_start", socket=self.socket_path,
                            max_queue=self.queue.max_queue,
-                           max_concurrent=self.max_concurrent)
+                           max_concurrent=self.max_concurrent,
+                           lanes=self.leases.n_lanes,
+                           devices_per_job=self.devices_per_job)
             self._write_textfile()   # scrapers see a file immediately
             try:
                 while True:
@@ -346,21 +384,24 @@ class Daemon:
         before every exposition/stats read and after every job, so the
         Prometheus surface and svc-stats both read the SAME registry
         (they cannot drift — the svc-stats satellite contract)."""
-        from pwasm_tpu.obs.catalog import breaker_state_value
         m = self.svc_metrics
         m["queue_depth"].set(self.queue.depth())
         with self._lock:
             running = len(self._running)
             held = sum(1 for j in self.jobs.values()
                        if j.state in TERMINAL_STATES)
-            st = self.warm.supervisor_state
         m["inflight"].set(running)
         m["draining"].set(1 if self._draining else 0)
         m["results_held"].set(held)
-        mon = self.warm.monitor
-        m["breaker_state"].set(breaker_state_value(
-            bool(st.get("breaker_open")) if st else False,
-            mon.state if mon is not None else None))
+        # the daemon-level breaker gauge is the WORST lane (one number
+        # for "is anything degraded"); the per-lane vector carries the
+        # which
+        m["breaker_state"].set(self.leases.breaker_rollup())
+        m["lanes_busy"].set(self.leases.busy_count())
+        m["lease_waiting"].set(self.leases.waiting_count())
+        for row in self.leases.lane_states():
+            m["lane_breaker_state"].set(row["breaker_state"],
+                                        lane=str(row["lane"]))
 
     def _write_textfile(self) -> None:
         """Atomic textfile publish (fsync-then-replace via
@@ -418,6 +459,8 @@ class Daemon:
                 return
             self._draining = True
             running = list(self._running.values())
+        self.leases.drain()    # wake lease-waiters empty-handed: their
+        #                        jobs are preempted below by the worker
         waiting = self.queue.drain()
         for job in waiting:
             job.state = JOB_PREEMPTED
@@ -447,19 +490,52 @@ class Daemon:
                 if self._draining:
                     return
                 continue
+            # lease-aware admission (ISSUE 8): a dequeued job runs only
+            # once it holds a device lane — with lanes < workers this
+            # wait is real (and measured); a drain while waiting
+            # preempts the job exactly like one still queued.  ONE
+            # blocking acquire holds ONE FIFO ticket for the whole
+            # wait (a short-timeout retry loop would re-enqueue at the
+            # back each round, reordering two waiting jobs); drain
+            # wakes the ticket empty-handed, and should_abort covers
+            # the drain-less close path
+            t_wait = time.monotonic()
+            lease = self.leases.acquire(
+                should_abort=self._closing.is_set)
+            if lease is None:        # drained, or closing mid-wait
+                self._preempt_leaseless(job)
+                continue
+            self.svc_metrics["lease_wait_seconds"].observe(
+                time.monotonic() - t_wait)
             with self._lock:
                 self._running[job.id] = job
             try:
-                self._run_job(job)
+                self._run_job(job, lease)
             finally:
+                self.leases.release(lease)
                 with self._lock:
                     self._running.pop(job.id, None)
                 job.done.set()
 
-    def _run_job(self, job: Job) -> None:
+    def _preempt_leaseless(self, job: Job) -> None:
+        """A dequeued job the drain caught BEFORE it got a lease: same
+        contract as one still queued — preempted, resumable, never
+        started."""
+        job.state = JOB_PREEMPTED
+        job.rc = EXIT_PREEMPTED
+        job.detail = ("preempted waiting for a device lease (service "
+                      "drained); resubmit to a live service — with "
+                      "--resume if a previous attempt checkpointed")
+        job.finished_s = time.time()
+        self.stats.jobs_preempted += 1
+        self.svc_metrics["jobs"].inc(outcome="preempted")
+        self.obs.event("job_preempt_leaseless", job_id=job.id)
+        job.done.set()
+
+    def _run_job(self, job: Job, lease) -> None:
         job.state = JOB_RUNNING
         job.started_s = time.time()
-        self.obs.event("job_start", job_id=job.id,
+        self.obs.event("job_start", job_id=job.id, lane=lease.lane,
                        queue_wait_s=round(job.started_s
                                           - job.submitted_s, 6))
         # a drain latched between this job's dequeue and here must
@@ -468,8 +544,8 @@ class Daemon:
         if self.drain.requested and job.drain is not None \
                 and not job.drain.requested:
             job.drain.request(self.drain.reason or "service draining")
-        warm = _JobWarm(self.warm, job.drain,
-                        share_monitor=self.max_concurrent == 1)
+        warm = _JobWarm(self.warm, job.drain, lease,
+                        expose_devices=self._expose_devices)
         rc: int | None = None
         try:
             rc = self._runner(job.argv, stdout=job.outbuf,
@@ -515,6 +591,7 @@ class Daemon:
         # the one-shot CLI applies to itself — obs/catalog.py)
         from pwasm_tpu.obs.catalog import fold_run_stats
         self.svc_metrics["jobs"].inc(outcome=job.state)
+        self.svc_metrics["lane_jobs"].inc(lane=str(lease.lane))
         self.svc_metrics["job_wall_seconds"].observe(
             job.finished_s - job.started_s)
         self.svc_metrics["queue_wait_seconds"].observe(
@@ -522,6 +599,7 @@ class Daemon:
         fold_run_stats(self.run_metrics, job.stats)
         self.obs.event(
             "job_finish", job_id=job.id, state=job.state, rc=rc,
+            lane=lease.lane,
             wall_s=round(job.finished_s - job.started_s, 6),
             detail=job.detail or None)
         self._write_textfile()
@@ -695,13 +773,26 @@ class Daemon:
             # — the two operator surfaces cannot drift (ISSUE 6)
             self._refresh_gauges()
             m = self.svc_metrics
-            return protocol.ok(stats=self.stats.as_dict(
+            st = self.stats.as_dict(
                 queue_depth=int(m["queue_depth"].value()),
                 running=int(m["inflight"].value()),
                 draining=self._draining,
                 max_queue=self.queue.max_queue,
                 max_concurrent=self.max_concurrent,
-                breaker_state=int(m["breaker_state"].value())))
+                breaker_state=int(m["breaker_state"].value()))
+            # additive (stats_version unchanged): the device-lease
+            # lane table — span, busy, per-lane breaker — plus the
+            # grant/wait roll-up
+            st["lanes"] = self.leases.lane_states()
+            st["leases"] = {
+                "lanes": self.leases.n_lanes,
+                "devices_per_job": self.devices_per_job,
+                "busy": self.leases.busy_count(),
+                "waiting": self.leases.waiting_count(),
+                "grants": self.leases.grants,
+                "wait_s_total": round(self.leases.wait_s_total, 6),
+            }
+            return protocol.ok(stats=st)
         if cmd == "metrics":
             self._refresh_gauges()
             return protocol.ok(
@@ -874,7 +965,8 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
         return EXIT_USAGE
     nums = {}
     for knob, dflt in (("max-queue", 16), ("max-concurrent", 1),
-                       ("max-frame-bytes", protocol.MAX_FRAME_BYTES)):
+                       ("max-frame-bytes", protocol.MAX_FRAME_BYTES),
+                       ("devices-per-job", 1), ("lanes", None)):
         val = opts.pop(knob, None)
         if val is None:
             nums[knob] = dflt
@@ -918,7 +1010,9 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
                         stderr=stderr,
                         metrics_textfile=metrics_textfile,
                         log_json=log_json, result_ttl_s=result_ttl_s,
-                        max_results=max_results)
+                        max_results=max_results,
+                        lanes=nums["lanes"],
+                        devices_per_job=nums["devices-per-job"])
     except OSError:
         stderr.write(f"Cannot open file {log_json} for writing!\n")
         return EXIT_USAGE

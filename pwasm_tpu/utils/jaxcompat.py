@@ -8,6 +8,14 @@ newer releases export it at top level with the flag renamed
 wrapper below instead of touching either surface directly, so a jax
 pin change degrades nothing (the baseline container, jax 0.4.37, lost
 every ``parallel/`` test to this import before the shim existed).
+
+The same rule covers the COLLECTIVES the sharded programs use:
+``psum``/``ppermute`` (and the ``pcast`` annotation) are re-exported
+here, and a static gate (``qa/check_supervision.py``
+``find_sharding_violations``, tier-1) fails any module outside this
+shim that imports ``shard_map`` or calls ``jax.lax.psum``/
+``jax.lax.ppermute`` directly — so the next ``jax.lax`` surface move
+is one edit here, not an archaeology pass over ``parallel/``.
 """
 
 from __future__ import annotations
@@ -32,6 +40,25 @@ def shard_map(f, mesh=None, in_specs=None, out_specs=None,
             kw["check_rep"] = check_vma
     return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                **kw)
+
+
+def psum(x, axis_name):
+    """``jax.lax.psum`` behind the shim: the ICI all-reduce every
+    depth-sharded consensus program uses (per-column base counts summed
+    over the device axis before the vote).  One indirection so a
+    ``jax.lax`` surface move costs one edit here, enforced by the
+    static sharding-API gate."""
+    import jax
+
+    return jax.lax.psum(x, axis_name)
+
+
+def ppermute(x, axis_name, perm):
+    """``jax.lax.ppermute`` behind the shim (the wavefront ring's halo
+    exchange)."""
+    import jax
+
+    return jax.lax.ppermute(x, axis_name, perm=perm)
 
 
 def pcast(x, axis_name, to: str = "varying"):

@@ -79,6 +79,23 @@ SERVICE_PATTERNS = re.compile(
     r"^\s*(?:import\s+jax\b|from\s+jax[.\s])|jax\.jit|jax\.device_put"
     r"|jax\.device_get|\.block_until_ready\s*\(")
 
+# ---- sharding-API routing gate (ISSUE 8 satellite) --------------------
+# Every sharding/collective surface the repo touches is shimmed in
+# utils/jaxcompat.py (shard_map + check_vma/check_rep, psum, ppermute,
+# pcast): the baseline container's jax pin change took out every
+# parallel/ test before the shim existed, so a NEW module importing
+# jax's shard_map directly — or calling jax.lax.psum/ppermute/pcast
+# bare — re-opens exactly that hole.  This gate fails any such use in
+# pwasm_tpu/ outside the shim itself.
+JAXCOMPAT = "pwasm_tpu/utils/jaxcompat.py"
+SHARDING_PATTERNS = re.compile(
+    r"from\s+jax\.experimental\.shard_map"           # old import path
+    r"|from\s+jax\.experimental\s+import\s+[^#\n]*"  # module-import
+    r"\bshard_map\b"                                 #   spelling
+    r"|from\s+jax\s+import\s+[^#\n]*\bshard_map\b"   # new import path
+    r"|jax\.shard_map\s*\("
+    r"|(?:jax\.)?lax\.(?:psum|ppermute|pcast)\s*\(")
+
 # ---- metric-name lint (ISSUE 6 satellite) -----------------------------
 # Every metric registration (registry.counter/gauge/histogram) in
 # pwasm_tpu/ must live in obs/catalog.py — the catalog IS the metric
@@ -164,6 +181,32 @@ def find_obs_violations(root: str = REPO) -> list[str]:
     return _find_jaxfree_violations(root, OBS_DIR, "obs")
 
 
+def find_sharding_violations(root: str = REPO) -> list[str]:
+    """Bare sharding/collective API use outside the jaxcompat shim
+    (module docstring: the ISSUE 8 routing rule)."""
+    out: list[str] = []
+    pkg = os.path.join(root, "pwasm_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            if rel == JAXCOMPAT:
+                continue
+            with open(path, encoding="utf-8") as f:
+                for i, line in enumerate(f, 1):
+                    if line.lstrip().startswith("#"):
+                        continue
+                    if SHARDING_PATTERNS.search(line):
+                        out.append(
+                            f"{rel}:{i}: bare sharding/collective API "
+                            f"use: {line.strip()} — route it through "
+                            f"{JAXCOMPAT}")
+    return out
+
+
 def find_metric_lint(root: str = REPO) -> list[str]:
     """The metric-name lint (module docstring): registrations only in
     the catalog; catalog names snake_case, ``pwasm_``-prefixed, unique."""
@@ -228,12 +271,13 @@ def main() -> int:
     svc = find_service_violations()
     obs = find_obs_violations()
     metric = find_metric_lint()
+    sharding = find_sharding_violations()
     for line in bad:
         print(line, file=sys.stderr)
     for rel in stale:
         print(f"{rel}: stale registry entry (no device entry points "
               "left — remove it)", file=sys.stderr)
-    for line in svc + obs + metric:
+    for line in svc + obs + metric + sharding:
         print(line, file=sys.stderr)
     if bad:
         print(f"\n{len(bad)} device entry point(s) outside the "
@@ -251,7 +295,13 @@ def main() -> int:
               "registrations live in pwasm_tpu/obs/catalog.py with "
               "snake_case pwasm_-prefixed unique names.",
               file=sys.stderr)
-    return 1 if (bad or stale or svc or obs or metric) else 0
+    if sharding:
+        print(f"\n{len(sharding)} bare sharding/collective API "
+              f"use(s): import shard_map/psum/ppermute/pcast from "
+              f"{JAXCOMPAT} instead, so a jax pin change costs one "
+              "edit there.", file=sys.stderr)
+    return 1 if (bad or stale or svc or obs or metric
+                 or sharding) else 0
 
 
 if __name__ == "__main__":

@@ -18,6 +18,19 @@ by S seconds, default 3600) on the resilience layer's capped-
 exponential re-probe schedule (``resilience.health.wait_for_backend``)
 and fire the burst in the FIRST healthy window — the mode a cron
 driver wants during a flapping-tunnel stretch.
+
+``--multichip``: the scale-out throughput sweep (ISSUE 8): run the
+full sharded pipeline step (banded DP + psum'd consensus vote, the
+``dryrun_multichip`` program) at a FIXED workload over 1, 2, 4, ...
+devices and stamp the per-chip-count throughput table into the latest
+``MULTICHIP_r*.json``.  On a real TPU mesh the sweep uses the chips;
+anywhere else it degrades to the cpu-like twin (virtual host devices
+via ``--xla_force_host_platform_device_count``, the same twin
+``cpu_like_mesh`` builds) so CI can always run it — the stamped table
+then carries ``cpu_fallback: true``.  This is the leg that certifies
+the K-lane scale-up claim (jobs/s at K lanes >= ~K*0.8x single-lane)
+on real silicon; the bench's cpu-twin lanes leg only certifies the
+no-lost-throughput floor.
 """
 
 from __future__ import annotations
@@ -95,6 +108,164 @@ def _run(name: str, env_extra: dict, args: list[str], timeout: float,
     return rec
 
 
+# ---------------------------------------------------------------------------
+# --multichip: per-chip-count throughput sweep (ISSUE 8 satellite)
+# ---------------------------------------------------------------------------
+def _multichip_counts(n_max: int) -> list[int]:
+    """1, 2, 4, ... up to the device inventory (pow2 so the 2-D mesh
+    factorization exercises both axes at every point)."""
+    counts, k = [], 1
+    while k <= max(1, n_max):
+        counts.append(k)
+        k *= 2
+    return counts
+
+
+def _multichip_child(n: int) -> int:
+    """Measure ONE chip count in a fresh backend: jit the sharded
+    pipeline step (DP + depth-psum consensus) over an n-device mesh at
+    a fixed workload, assert bit-parity vs the single-device program,
+    and print the throughput row as the last stdout line."""
+    import numpy as np
+
+    import jax
+
+    if len(jax.devices()) < n:
+        print(json.dumps({"n_devices": n, "error":
+                          f"only {len(jax.devices())} devices"}))
+        return 1
+    from pwasm_tpu.ops.banded_dp import banded_scores_batch
+    from pwasm_tpu.ops.consensus import consensus_votes
+    from pwasm_tpu.parallel.mesh import make_mesh, make_pipeline_step
+
+    # fixed TOTAL workload for every chip count (so rows compare):
+    # 32 targets x 1024-base query, band 64 (dryrun_multichip's
+    # realistic shapes — the 48-diagonal m/n spread fits the band);
+    # 64-deep pileup, 4096 cols
+    T, m, nlen, band, depth, cols = 32, 1024, 1072, 64, 64, 4096
+    rng = np.random.default_rng(5)
+    q = rng.integers(0, 4, m).astype(np.int8)
+    ts = np.full((T, nlen), 127, dtype=np.int8)
+    t_lens = np.full(T, nlen - 16, dtype=np.int32)
+    for k in range(T):
+        ts[k, :t_lens[k]] = rng.integers(0, 4, t_lens[k])
+    pileup = rng.integers(0, 7, size=(depth, cols)).astype(np.int8)
+    mesh = make_mesh(n)
+    step = make_pipeline_step(mesh, band=band)
+    scores, votes = step(q, ts, t_lens, pileup)   # compile + warm
+    scores.block_until_ready()
+    votes.block_until_ready()
+    parity = (np.array_equal(
+        np.asarray(scores),
+        np.asarray(banded_scores_batch(q, ts, t_lens, band=band)))
+        and np.array_equal(np.asarray(votes),
+                           np.asarray(consensus_votes(pileup))))
+    walls = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        s2, v2 = step(q, ts, t_lens, pileup)
+        s2.block_until_ready()
+        v2.block_until_ready()
+        walls.append(time.perf_counter() - t0)
+    wall = min(walls)
+    print(json.dumps({
+        "n_devices": n, "mesh": dict(mesh.shape),
+        "backend": jax.default_backend(), "parity_ok": parity,
+        "wall_s": round(wall, 6),
+        "steps_per_s": round(1.0 / wall, 3),
+        "dp_cells_per_s": round(T * m * band / wall, 1),
+        "consensus_cols_per_s": round(cols / wall, 1)}))
+    return 0 if parity else 1
+
+
+def stamp_multichip(rows: list[dict], cpu_fallback: bool,
+                    repo: str = REPO) -> str:
+    """Merge the sweep's ``throughput`` table into the LATEST
+    ``MULTICHIP_r*.json`` (the driver's dryrun artifact — the stamp
+    rides the round it measured), creating ``MULTICHIP_r01.json`` when
+    no round artifact exists yet.  Durable fsync-then-replace write:
+    a crash mid-stamp must not tear the driver's artifact."""
+    import glob
+
+    from pwasm_tpu.utils.fsio import write_durable_text
+
+    cands = sorted(glob.glob(os.path.join(repo, "MULTICHIP_r*.json")))
+    path = cands[-1] if cands \
+        else os.path.join(repo, "MULTICHIP_r01.json")
+    doc: dict = {}
+    try:
+        with open(path) as f:
+            loaded = json.load(f)
+        doc = loaded if isinstance(loaded, dict) else {"rows": loaded}
+    except (OSError, ValueError):
+        pass
+    doc["throughput"] = {"cpu_fallback": bool(cpu_fallback),
+                         "stamped_unix": int(time.time()),
+                         "rows": rows}
+    write_durable_text(path, json.dumps(doc, indent=1) + "\n")
+    return path
+
+
+def run_multichip() -> int:
+    """The --multichip mode: probe for a real mesh (bounded — a dead
+    tunnel costs the timeout, not a hang), sweep chip counts in fresh
+    child backends, stamp the table."""
+    os.makedirs(OUT, exist_ok=True)
+    env0 = _scrub_env(os.environ)
+    real, ndev = False, 0
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import json, jax; print(json.dumps("
+             "{'backend': jax.default_backend(),"
+             " 'n': len(jax.devices())}))"],
+            env=env0, capture_output=True, text=True, timeout=120)
+        if r.returncode == 0 and r.stdout.strip():
+            info = json.loads(r.stdout.strip().splitlines()[-1])
+            ndev = int(info.get("n", 0))
+            real = info.get("backend") == "tpu" and ndev >= 2
+    except Exception:
+        pass
+    if not real:
+        ndev = 8   # the cpu-like twin mirrors a v5e-8
+        print("[multichip] no real TPU mesh; sweeping the cpu-like "
+              f"twin ({ndev} virtual host devices)", file=sys.stderr)
+    rows = []
+    for n in _multichip_counts(ndev):
+        env = dict(env0)
+        if not real:
+            env["JAX_PLATFORMS"] = "cpu"
+            flags = " ".join(
+                t for t in env.get("XLA_FLAGS", "").split()
+                if "xla_force_host_platform_device_count" not in t)
+            env["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 f"--multichip-child={n}"],
+                env=env, cwd=REPO, capture_output=True, text=True,
+                timeout=900)
+            row = json.loads(r.stdout.strip().splitlines()[-1]) \
+                if r.stdout.strip() else {"n_devices": n,
+                                          "error": "no output"}
+            if r.returncode != 0 and "error" not in row:
+                row["error"] = f"rc {r.returncode}"
+                sys.stderr.write(r.stderr[-1000:])
+        except Exception as e:
+            row = {"n_devices": n,
+                   "error": f"{type(e).__name__}: {e}"}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    path = stamp_multichip(rows, cpu_fallback=not real)
+    ok = all("error" not in r and r.get("parity_ok") for r in rows)
+    print(f"[multichip] stamped {len(rows)} row(s) into {path}"
+          + ("" if ok else " (with failures)"), file=sys.stderr)
+    return 0 if ok else 1
+
+
 def _parse_wait(argv: list[str]) -> float | None:
     """``--wait`` / ``--wait=S`` -> wait budget in seconds (default
     3600); None when not asked to wait.  Raises SystemExit(2) on a
@@ -118,6 +289,19 @@ def _parse_wait(argv: list[str]) -> float | None:
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    for a in argv:
+        if a.startswith("--multichip-child="):
+            try:
+                n = int(a.split("=", 1)[1])
+                if n < 1:
+                    raise ValueError
+            except ValueError:
+                print(f"[burst] bad --multichip-child value: {a!r}",
+                      file=sys.stderr)
+                return 2
+            return _multichip_child(n)
+    if "--multichip" in argv:
+        return run_multichip()
     os.makedirs(OUT, exist_ok=True)
     log: list = []
 
@@ -162,6 +346,11 @@ def main(argv: list[str] | None = None) -> int:
 
     # 1. the driver-style full table (writes BENCH_ALL.json/TPU_SMOKE.json)
     _run("bench_all", {}, ["bench.py"], 5400, log)
+
+    # 1b. per-chip-count scale-out throughput (ISSUE 8): the real-mesh
+    # numbers the lease scheduler's K-lane scaling claim rests on
+    _run("multichip", {}, ["qa/chip_burst.py", "--multichip"], 1800,
+         log)
 
     # 2. cfg4 column-tile sweep with the chunk-wise kernel
     for t in (2048, 4096, 8192):

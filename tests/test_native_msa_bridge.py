@@ -355,3 +355,118 @@ def test_engine_warnings_go_to_callers_stderr_stream():
             nmsa2.close()
     finally:
         nmsa.close()
+
+
+# ---------------------------------------------------------------------------
+# batched add marshalling (ISSUE 8 satellite / ROADMAP item 2 lever a)
+# ---------------------------------------------------------------------------
+def _extract_items(lines, Q):
+    """PAF lines -> the (tlabel, tseq, t_offset, reverse, rgaps, tgaps,
+    ord_num) rows cli.py buffers for add_batch (same extraction path)."""
+    from pwasm_tpu.core.events import extract_alignment
+    from pwasm_tpu.core.paf import parse_paf_line
+
+    refseq = Q.encode()
+    refseq_rc = revcomp(refseq)
+    items = []
+    for k, line in enumerate(lines, 1):
+        rec = parse_paf_line(line)
+        al = rec.alninfo
+        aln = extract_alignment(
+            rec, refseq_rc if al.reverse else refseq)
+        tlabel = (f"{al.t_id}:{al.t_alnstart}-{al.t_alnend}"
+                  + ("-" if al.reverse else "+"))
+        items.append((tlabel, bytes(aln.tseq), al.r_alnstart,
+                      aln.reverse, aln.rgaps, aln.tgaps, k))
+    return items
+
+
+def test_add_batch_matches_sequential_adds(tmp_path):
+    """ONE pw_msa_add_batch crossing produces the same engine state —
+    byte-identical writers — as per-item add() calls."""
+    rng = np.random.default_rng(31)
+    Q = "".join("ACGT"[i] for i in rng.integers(0, 4, 100))
+    items = _extract_items(_rand_lines(rng, "q", Q, 6), Q)
+    outs = {}
+    for tag in ("seq", "batch"):
+        nmsa = native_msa()
+        try:
+            if tag == "seq":
+                for (tl, ts, toff, rev, rg, tg, k) in items:
+                    assert nmsa.add(tl, ts, toff, rev, "q", Q.encode(),
+                                    len(Q), rg, tg, k)
+            else:
+                dropped = []
+                nmsa.add_batch("q", Q.encode(), len(Q), items,
+                               lambda i, m: dropped.append(i))
+                assert dropped == []
+            assert nmsa.count() == len(items) + 1  # + the reference row
+            body = b""
+            for kind in ("mfa", "ace", "cons"):
+                p = tmp_path / f"{tag}.{kind}"
+                nmsa.write(kind, str(p))
+                body += p.read_bytes()
+            outs[tag] = body
+        finally:
+            nmsa.close()
+    assert outs["seq"] == outs["batch"]
+
+
+def test_add_batch_drop_hook_skips_in_order_or_raises(tmp_path):
+    """A mid-batch out-of-layout item fires on_drop with its index and
+    the engine's message, nothing of IT is mutated, and the rest of the
+    batch still lands; a raising hook aborts exactly at the item."""
+    rng = np.random.default_rng(37)
+    Q = "".join("ACGT"[i] for i in rng.integers(0, 4, 60))
+    good = _extract_items(_rand_lines(rng, "q", Q, 4), Q)
+    bad_line, _ = make_paf_line("q", Q, "tbad", "-",
+                                [("del", 2), ("=", 58)])
+    bad = _extract_items([bad_line], Q)[0]
+    items = good[:2] + [bad] + good[2:]
+    nmsa = native_msa()
+    try:
+        drops = []
+        nmsa.add_batch("q", Q.encode(), len(Q), items,
+                       lambda i, m: drops.append((i, m)))
+        assert [i for i, _ in drops] == [2]
+        assert "invalid gap position" in drops[0][1]
+        assert nmsa.count() == len(good) + 1   # bad never inserted
+    finally:
+        nmsa.close()
+    from pwasm_tpu.core.errors import PwasmError
+
+    nmsa = native_msa()
+    try:
+        def fatal(i, m):
+            raise PwasmError(m)
+        with pytest.raises(PwasmError, match="invalid gap position"):
+            nmsa.add_batch("q", Q.encode(), len(Q), items, fatal)
+        assert nmsa.count() == 3   # the two items before the bad one
+    finally:
+        nmsa.close()
+
+
+def test_batch_marshalling_hatch_byte_identical(tmp_path, monkeypatch):
+    """PWASM_NATIVE_MSA_BATCH=0 (the per-alignment A/B hatch) and the
+    default batched path produce byte-identical outputs end to end."""
+    rng = np.random.default_rng(41)
+    Q1 = "".join("ACGT"[i] for i in rng.integers(0, 4, 90))
+    Q2 = "".join("ACGT"[i] for i in rng.integers(0, 4, 70))
+    lines = (_rand_lines(rng, "q1", Q1, 5, "a")
+             + _rand_lines(rng, "q2", Q2, 4, "b"))
+    paf, fa = _write_inputs(tmp_path, lines,
+                            [("q1", Q1.encode()), ("q2", Q2.encode())])
+    outs = {}
+    monkeypatch.setenv("PWASM_NATIVE_MSA", "1")
+    for tag, env in (("batched", "1"), ("peritem", "0")):
+        monkeypatch.setenv("PWASM_NATIVE_MSA_BATCH", env)
+        err = io.StringIO()
+        rc = run([paf, "-r", fa, "-o", str(tmp_path / f"{tag}.dfa"),
+                  "-w", str(tmp_path / f"{tag}.mfa"),
+                  f"--cons={tmp_path / tag}.cons", "--batch=3"],
+                 stderr=err)
+        outs[tag] = (rc, err.getvalue(), b"".join(
+            (tmp_path / f"{tag}.{e}").read_bytes()
+            for e in ("dfa", "mfa", "cons")))
+    assert outs["batched"] == outs["peritem"]
+    assert outs["batched"][0] == 0

@@ -22,6 +22,25 @@ def test_make_mesh_shapes():
     assert dict(mesh4.shape) == {"batch": 2, "depth": 2}
 
 
+def test_make_mesh_explicit_devices():
+    # the device-lease path: a leased job builds its mesh over EXACTLY
+    # the device list it is handed (its lane's slice of jax.devices()),
+    # not the global pool — both mesh factories take devices=
+    from pwasm_tpu.parallel.many2many import make_mesh2d
+
+    devs = jax.devices()
+    lane = devs[2:6]
+    mesh = make_mesh(devices=lane)
+    assert set(np.asarray(mesh.devices).ravel()) == set(lane)
+    assert mesh.shape["batch"] * mesh.shape["depth"] == 4
+    mesh2d = make_mesh2d(devices=lane)
+    assert set(np.asarray(mesh2d.devices).ravel()) == set(lane)
+    assert mesh2d.shape["query"] * mesh2d.shape["target"] == 4
+    # n_devices= still truncates an explicit list, like the global pool
+    mesh2 = make_mesh(2, devices=lane)
+    assert set(np.asarray(mesh2.devices).ravel()) == set(lane[:2])
+
+
 def test_sharded_consensus_matches_single():
     mesh = make_mesh(8)
     rng = np.random.default_rng(0)

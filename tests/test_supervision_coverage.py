@@ -118,3 +118,31 @@ def test_checker_detects_patterns(checker, tmp_path):
     bad = checker.find_unregistered(str(tmp_path))
     assert len(bad) == 3, bad
     assert all("rogue.py" in b for b in bad)
+
+
+def test_sharding_api_routed_through_jaxcompat(checker):
+    """ISSUE 8 satellite: every sharding/collective API use in
+    pwasm_tpu/ goes through utils/jaxcompat.py — no bare shard_map
+    imports or jax.lax.psum/ppermute/pcast calls outside the shim, so
+    the next jax surface move costs one edit there."""
+    bad = checker.find_sharding_violations()
+    assert bad == [], "\n".join(bad)
+
+
+def test_sharding_rule_detects_bare_collectives(checker, tmp_path):
+    pkg = tmp_path / "pwasm_tpu"
+    (pkg / "utils").mkdir(parents=True)
+    (pkg / "rogue.py").write_text(
+        "from jax.experimental.shard_map import shard_map\n"
+        "from jax import jit, shard_map\n"
+        "from pwasm_tpu.utils.jaxcompat import shard_map  # NOT a hit\n"
+        "# jax.lax.psum(x, 'd') in a comment is NOT a hit\n"
+        "t = jax.lax.psum(x, 'depth')\n"
+        "u = lax.ppermute(x, 'seq', perm)\n"
+        "v = jax.shard_map(f, mesh=m)\n")
+    # the shim itself is exempt — it IS the one place the raw APIs live
+    (pkg / "utils" / "jaxcompat.py").write_text(
+        "from jax.experimental.shard_map import shard_map\n")
+    bad = checker.find_sharding_violations(str(tmp_path))
+    assert len(bad) == 5, bad
+    assert all("rogue.py" in b for b in bad)
