@@ -1334,6 +1334,136 @@ def cfg8_realistic_scale() -> int:
         _emit("realistic_serve_lanes_parity", 1 if lanes_ok else 0,
               "bool", 1.0 if lanes_ok else 0.0, cpu_metric=True)
 
+        # --- crash recovery (ISSUE 9 tentpole): kill -9 a live serve
+        # daemon mid-job (after its first durable ckpt) with a second
+        # job still queued; a fresh daemon on the same socket replays
+        # the journal — the interrupted job resumes from its ckpt, the
+        # queued one re-runs whole — and both reports end
+        # byte-identical to the never-crashed arm (the resumed job's
+        # -s summary excluded by the documented --resume contract).
+        svc3 = os.path.join(d, "svc3.sock")
+        sp3 = subprocess.Popen(
+            cmd + ["serve", f"--socket={svc3}", "--max-queue=8"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        sp3b = None
+        crash_ok = False
+        try:
+            if not wait_for_socket(svc3, 120):
+                return _fail("realistic_serve_crash_up")
+            slow = ("--inject-faults=seed=1,rate=1,kinds=hang,"
+                    "hang_s=0.25")
+            with ServiceClient(svc3) as c:
+                ja = c.submit(args("cra", ["--batch=16", slow]))
+                jb = c.submit(args("crb", []))
+                if not (ja.get("ok") and jb.get("ok")):
+                    return _fail("realistic_serve_crash_submit")
+                ck = os.path.join(d, "cra.dfa.ckpt")
+                deadline = time.monotonic() + 120
+                mid = False
+                while time.monotonic() < deadline:
+                    st = c.status(ja["job_id"])["job"]["state"]
+                    if st == "running" and os.path.exists(ck):
+                        mid = True
+                        break
+                    if st not in ("queued", "running"):
+                        break
+                    time.sleep(0.02)
+            if not mid:
+                return _fail("realistic_serve_crash_window")
+            sp3.kill()              # SIGKILL: no drain, no cleanup
+            sp3.wait(timeout=60)
+            sp3b = subprocess.Popen(
+                cmd + ["serve", f"--socket={svc3}", "--max-queue=8"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE)
+            if not wait_for_socket(svc3, 120):
+                return _fail("realistic_serve_crash_restart")
+            with ServiceClient(svc3) as c:
+                ra = c.result(ja["job_id"], timeout=600)
+                rb = c.result(jb["job_id"], timeout=600)
+                svc_st = c.stats()["stats"]
+                c.drain()
+            crash_rc = sp3b.wait(timeout=120)
+            crash_ok = (
+                ra.get("rc") == 0 and rb.get("rc") == 0
+                and svc_st["journal"]["replays"] == 1
+                and read_nosum("cra") == read_nosum("py")
+                and readset("crb") == parity_body
+                and crash_rc == 75
+                and not os.path.exists(svc3 + ".journal"))
+        except Exception as e:
+            sys.stderr.write(f"crash-recovery leg: {e}\n")
+            return _fail("realistic_serve_crash_recovery")
+        finally:
+            for p in (sp3, sp3b):
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait()
+        _emit("realistic_serve_crash_recovery_parity",
+              1 if crash_ok else 0, "bool",
+              1.0 if crash_ok else 0.0, cpu_metric=True)
+
+        # --- fair-share admission (ISSUE 9 tentpole): a LIGHT client
+        # submitting one job while a HEAVY co-submitter holds a deep
+        # backlog must be round-robined in after at most ~one running
+        # job, not serialized behind the whole backlog.  The leg
+        # reports the light client's p50 daemon-side queue wait
+        # (submit->start, ms, lower-is-better in qa/bench_gate.py);
+        # under the old global FIFO this is the heavy backlog's whole
+        # drain time.
+        svc4 = os.path.join(d, "svc4.sock")
+        sp4 = subprocess.Popen(
+            cmd + ["serve", f"--socket={svc4}", "--max-queue=16"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        light_waits = []
+        heavy_walls = []
+        try:
+            if not wait_for_socket(svc4, 120):
+                return _fail("realistic_serve_fairshare_up")
+            with ServiceClient(svc4) as c:
+                heavy = []
+                for k in range(8):
+                    s = c.submit(args(f"fh{k}", []), client="heavy")
+                    if not s.get("ok"):
+                        return _fail("realistic_serve_fairshare_submit")
+                    heavy.append(s["job_id"])
+                for k in range(3):
+                    s = c.submit(args(f"fl{k}", []), client="light")
+                    if not s.get("ok"):
+                        return _fail("realistic_serve_fairshare_light")
+                    r = c.result(s["job_id"], timeout=600)
+                    if not r.get("ok") or r.get("rc") != 0:
+                        return _fail("realistic_serve_fairshare_job")
+                    job = r["job"]
+                    light_waits.append(
+                        (job["started_s"] - job["submitted_s"]) * 1e3)
+                for jid in heavy:
+                    r = c.result(jid, timeout=600)
+                    if not r.get("ok") or r.get("rc") != 0:
+                        return _fail("realistic_serve_fairshare_heavy")
+                    job = r["job"]
+                    heavy_walls.append(job["finished_s"]
+                                       - job["started_s"])
+                c.drain()
+            sp4.wait(timeout=120)
+            if (readset("fl0") != parity_body
+                    or readset("fh0") != parity_body):
+                return _fail("realistic_serve_fairshare_parity")
+        except Exception as e:
+            sys.stderr.write(f"fair-share leg: {e}\n")
+            return _fail("realistic_serve_fairshare")
+        finally:
+            if sp4.poll() is None:
+                sp4.kill()
+                sp4.wait()
+        light_p50 = sorted(light_waits)[len(light_waits) // 2]
+        # the acceptance flag: the light client waited at most ~2
+        # heavy job walls (the running job + one DRR rotation), far
+        # under the ~8-wall FIFO backlog drain
+        fair_flag = light_p50 <= 2.5 * max(heavy_walls) * 1e3
+        _emit("realistic_serve_fairshare_p50_light_ms", light_p50,
+              "ms", 1.0 if fair_flag else 0.0, cpu_metric=True)
+
         # --- host engine A/B: 1k-alignment report+summary corpus ----
         qseq1k, lines1k = make_corpus(n_aln=1000)
         fa1k = os.path.join(d, "cds1k.fa")

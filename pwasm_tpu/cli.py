@@ -212,15 +212,12 @@ _CKPT_META = ("version", "crc")   # non-payload keys, excluded from CRC
 
 def _ckpt_crc(ck: dict) -> int:
     """CRC32 over the ckpt's payload fields in canonical JSON form
-    (sorted keys, no whitespace) — stable across write/parse
-    round-trips because the payload is ints/strings/bools/containers
-    only."""
-    import json
-    import zlib
+    (``fsio.payload_crc`` — the shared self-validating-state
+    checksum)."""
+    from pwasm_tpu.utils.fsio import payload_crc
 
-    payload = {k: v for k, v in ck.items() if k not in _CKPT_META}
-    return zlib.crc32(json.dumps(
-        payload, sort_keys=True, separators=(",", ":")).encode())
+    return payload_crc({k: v for k, v in ck.items()
+                        if k not in _CKPT_META})
 
 
 def _on_record_boundary(report_path: str, nbytes: int) -> bool:

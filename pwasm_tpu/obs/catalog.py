@@ -200,6 +200,23 @@ def build_service_metrics(reg: MetricsRegistry) -> dict:
         "pwasm_service_lease_wait_seconds",
         "Per-job device-lease wait seconds (dequeue to grant)",
         buckets=_WAIT_BUCKETS)
+    # crash-safe serving (ISSUE 9): journal, spool, fair-share
+    m["journal_records"] = reg.counter(
+        "pwasm_service_journal_records_total",
+        "Durable job-journal records appended, by record type "
+        "(admit/start/finish/cancel/evict)", labels=("rec",))
+    m["journal_replays"] = reg.counter(
+        "pwasm_service_journal_replays_total",
+        "Journal replays performed at daemon start (each one is a "
+        "recovery from a hard crash)")
+    m["spool_bytes"] = reg.gauge(
+        "pwasm_service_spool_bytes",
+        "Bytes of finished-job results spooled to disk "
+        "(RAM holds only index entries for these)")
+    m["client_queue_depth"] = reg.gauge(
+        "pwasm_service_client_queue_depth",
+        "Queued jobs per fair-share client identity",
+        labels=("client",))
     m["jobs"] = reg.counter(
         "pwasm_service_jobs_total",
         "Job admissions and outcomes, by outcome "

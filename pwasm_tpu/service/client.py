@@ -23,13 +23,20 @@ from pwasm_tpu.service import protocol
 
 _CLIENT_USAGE = """Usage:
  pwasm-tpu submit --socket=PATH [--no-wait] [--timeout=S]
+                  [--retry[=N]] [--client=NAME] [--priority=LANE]
                   [--] <cli args...>
      submit one report job (the argv a cold CLI run would take; -o is
      required — the socket carries control, not report bytes).  By
      default waits for the job and exits with the JOB's exit code
      (0 done, 75 preempted/cancelled-resumable, else failed); with
      --no-wait prints the job id and exits 0.  A full queue
-     (queue_full) exits 11 so wrappers can back off and retry.
+     (queue_full) exits 11 so wrappers can back off and retry — or
+     pass --retry[=N] (default 5 attempts) and the client backs off
+     ITSELF: capped-exponential waits seeded by the daemon's
+     retry_after_s hint, exiting 11 only once the budget is spent.
+     --client=NAME overrides the fair-share identity (default: the
+     socket-peer uid); --priority=LANE targets a --priority-lanes
+     tier on the daemon.
 
  pwasm-tpu svc-stats --socket=PATH [--drain]
      print the service-level stats JSON (versioned schema); with
@@ -109,15 +116,23 @@ class ServiceClient:
     def ping(self) -> dict:
         return self.request({"cmd": "ping"})
 
-    def submit(self, argv: list[str], cwd: str | None = None) -> dict:
+    def submit(self, argv: list[str], cwd: str | None = None,
+               client: str | None = None,
+               priority: str | None = None) -> dict:
         """Submit one job.  ``cwd`` (default: this process's cwd) is
         sent along so relative paths in the argv resolve against the
         CLIENT's directory, not the daemon's — what a cold run would
-        do."""
+        do.  ``client`` overrides the fair-share identity the daemon
+        would otherwise derive from the socket-peer uid; ``priority``
+        names a ``--priority-lanes`` tier."""
         import os
-        return self.request({"cmd": "submit", "args": list(argv),
-                             "cwd": cwd if cwd is not None
-                             else os.getcwd()})
+        req: dict = {"cmd": "submit", "args": list(argv),
+                     "cwd": cwd if cwd is not None else os.getcwd()}
+        if client is not None:
+            req["client"] = client
+        if priority is not None:
+            req["priority"] = priority
+        return self.request(req)
 
     def status(self, job_id: str) -> dict:
         return self.request({"cmd": "status", "job_id": job_id})
@@ -140,6 +155,21 @@ class ServiceClient:
 
     def drain(self) -> dict:
         return self.request({"cmd": "drain"})
+
+
+def retry_backoff_s(attempt: int, hint_s: float | None,
+                    base_s: float = 0.5, cap_s: float = 30.0) -> float:
+    """The ``submit --retry`` backoff schedule: wait before retry
+    number ``attempt`` (0-based) after a ``queue_full``.  The daemon's
+    ``retry_after_s`` hint (~one recent job wall) seeds the first
+    wait; each consecutive rejection doubles it, capped at ``cap_s``
+    so a long outage polls steadily instead of going silent for
+    minutes.  Pure and deterministic — the unit-tested contract; the
+    caller adds no jitter because the daemon's hint already differs
+    per client (it tracks that daemon's own job walls)."""
+    if not isinstance(hint_s, (int, float)) or not hint_s > 0:
+        hint_s = base_s
+    return min(float(cap_s), float(hint_s) * (2.0 ** max(0, attempt)))
 
 
 def wait_for_socket(path: str, budget_s: float = 30.0) -> bool:
@@ -179,6 +209,14 @@ def _parse_client_argv(argv: list[str]) -> tuple[dict, list[str]]:
             opts["drain"] = True
         elif a.startswith("--timeout="):
             opts["timeout"] = a.split("=", 1)[1]
+        elif a == "--retry":
+            opts["retry"] = "5"
+        elif a.startswith("--retry="):
+            opts["retry"] = a.split("=", 1)[1]
+        elif a.startswith("--client="):
+            opts["client"] = a.split("=", 1)[1]
+        elif a.startswith("--priority="):
+            opts["priority"] = a.split("=", 1)[1]
         else:
             break
         i += 1
@@ -236,8 +274,31 @@ def client_main(cmd: str, argv: list[str], stdout=None,
             stderr.write(f"{_CLIENT_USAGE}\nError: submit needs the "
                          "job's CLI arguments\n")
             return EXIT_USAGE
+        retries = 0
+        if "retry" in opts:
+            val = opts["retry"]
+            if not (val.isascii() and val.isdigit() and int(val) >= 1):
+                stderr.write(f"{_CLIENT_USAGE}\nInvalid --retry "
+                             f"value: {val}\n")
+                return EXIT_USAGE
+            retries = int(val)
         with ServiceClient(sock) as c:
-            resp = c.submit(job_argv)
+            for attempt in range(retries + 1):
+                resp = c.submit(job_argv, client=opts.get("client"),
+                                priority=opts.get("priority"))
+                if resp.get("ok") \
+                        or resp.get("error") != protocol.ERR_QUEUE_FULL \
+                        or attempt >= retries:
+                    break
+                # the 429 dance: honor the daemon's hint, doubling per
+                # consecutive rejection (capped — see retry_backoff_s)
+                wait = retry_backoff_s(attempt,
+                                       resp.get("retry_after_s"))
+                stderr.write(f"pwasm: queue full "
+                             f"({resp.get('detail', '')}); retry "
+                             f"{attempt + 1}/{retries} in "
+                             f"{wait:.2f}s\n")
+                time.sleep(wait)
             if not resp.get("ok"):
                 code = resp.get("error")
                 stderr.write(f"Error: submission rejected "

@@ -33,6 +33,7 @@ surface, fanned out to per-job drain flags.
 from __future__ import annotations
 
 import os
+import re
 import socket
 import sys
 import tempfile
@@ -42,6 +43,10 @@ import time
 from pwasm_tpu.core.errors import EXIT_PREEMPTED, EXIT_USAGE, PwasmError
 from pwasm_tpu.resilience.lifecycle import SignalDrain
 from pwasm_tpu.service import protocol
+from pwasm_tpu.service.journal import (JOURNAL_VERSION, REC_ADMIT,
+                                       REC_CANCEL, REC_EVICT,
+                                       REC_FINISH, REC_START,
+                                       JobJournal, fold_records)
 from pwasm_tpu.service.leases import LeaseManager
 from pwasm_tpu.service.queue import (JOB_CANCELLED, JOB_DONE, JOB_FAILED,
                                      JOB_PREEMPTED, JOB_QUEUED,
@@ -50,14 +55,46 @@ from pwasm_tpu.service.queue import (JOB_CANCELLED, JOB_DONE, JOB_FAILED,
                                      ServiceStats)
 
 _SERVE_USAGE = """Usage:
- pwasm-tpu serve --socket=PATH [--max-queue=N] [--max-concurrent=N]
+ pwasm-tpu serve --socket=PATH [--max-queue=N] [--max-queue-total=N]
+                 [--max-concurrent=N] [--priority-lanes=hi,lo]
                  [--devices-per-job=N] [--lanes=N]
+                 [--journal=PATH|off] [--spool-threshold-bytes=N]
+                 [--spool-dir=DIR]
                  [--max-frame-bytes=N] [--metrics-textfile=PATH]
                  [--log-json=FILE] [--result-ttl-s=S] [--max-results=N]
 
    --socket=PATH        unix socket to listen on (required)
-   --max-queue=N        admission control: queued-job ceiling, beyond
-                        which submit answers queue_full (default 16)
+   --max-queue=N        admission control: PER-CLIENT queued-job
+                        quota (client = socket-peer uid, or the
+                        submit frame's client= field), beyond which
+                        that client's submit answers queue_full
+                        (default 16); other clients keep their own
+                        quota — one heavy submitter cannot eat the
+                        whole queue
+   --max-queue-total=N  global queued-job backstop across all clients
+                        (default 8 x max-queue)
+   --priority-lanes=A,B strict priority tiers, highest first: a
+                        submit tagged priority=A is always dequeued
+                        before one tagged B; untagged submits land in
+                        the LOWEST lane.  Fair-share round-robin over
+                        clients applies within each lane
+   --journal=PATH|off   durable job journal (default: <socket>.journal)
+                        — every admission/start/finish is an fsync'd
+                        NDJSON record, so a daemon restarted after a
+                        hard crash (kill -9, OOM-kill) replays it:
+                        queued jobs re-queue, running jobs re-admit as
+                        --resume continuations of their own ckpts, and
+                        finished results restore.  "off" disables
+   --spool-threshold-bytes=N  spool a finished job's result (stats +
+                        stderr tail) to disk once its JSON exceeds N
+                        bytes: daemon RAM keeps only an index entry,
+                        `result` reads stream from the spool file
+                        (CRC-verified, fsio-atomic), eviction unlinks
+                        it — resident result memory stays bounded
+                        regardless of report size (default: off)
+   --spool-dir=DIR      where spooled results live (default:
+                        <socket>.spool/); setting it enables spooling
+                        with a 65536-byte threshold
    --max-concurrent=N   worker threads executing jobs (default 1).
                         Each running job also holds a DEVICE LEASE
                         (one lane of the device inventory), so K
@@ -94,6 +131,20 @@ _SERVE_USAGE = """Usage:
  queued jobs are reported preempted-resumable, new submissions are
  rejected, and the daemon exits 75.  A second signal hard-aborts.
 """
+
+
+# fair-share client identities double as metric label values and
+# journal fields: keep the charset boring (empty = anonymous bucket)
+_CLIENT_RE = re.compile(r"^[A-Za-z0-9_.:@/-]*$")
+
+
+def _num(v, default: float) -> float:
+    """A journal field that should be a number, defensively: replay
+    must survive bit-rot or hand edits in ANY field, so a wrong-typed
+    timestamp/size degrades to the default instead of raising into
+    daemon startup."""
+    return float(v) if isinstance(v, (int, float)) \
+        and not isinstance(v, bool) else default
 
 
 class WarmContext:
@@ -202,7 +253,12 @@ class Daemon:
                  stderr=None, runner=None, metrics_textfile=None,
                  log_json=None, result_ttl_s: float | None = None,
                  max_results: int | None = None,
-                 lanes: int | None = None, devices_per_job: int = 1):
+                 lanes: int | None = None, devices_per_job: int = 1,
+                 journal_path: str | None = "auto",
+                 max_queue_total: int | None = None,
+                 priority_lanes: tuple[str, ...] | None = None,
+                 spool_threshold_bytes: int | None = None,
+                 spool_dir: str | None = None):
         self.socket_path = socket_path
         self.max_concurrent = max(1, int(max_concurrent))
         # device-lease scheduler (ISSUE 8): every running job holds one
@@ -224,7 +280,26 @@ class Daemon:
         self.max_frame_bytes = int(max_frame_bytes)
         self.stderr = stderr if stderr is not None else sys.stderr
         self._runner = runner
-        self.queue = JobQueue(max_queue)
+        self.queue = JobQueue(max_queue, max_total=max_queue_total,
+                              priority_lanes=priority_lanes)
+        # ---- crash safety (ISSUE 9): the durable job journal.  Every
+        # admission/start/finish/cancel/evict is an fsync'd NDJSON
+        # record (service/journal.py), replayed at the next start on
+        # this socket so a kill -9 loses no acked job.
+        if journal_path == "auto":
+            journal_path = socket_path + ".journal"
+        self.journal = JobJournal(journal_path) if journal_path \
+            else None
+        self._journal_warned = False
+        # ---- disk-spooled results (ISSUE 9): past the threshold a
+        # finished job's stats/stderr move to <spool_dir>/<id>.result
+        # (fsio-atomic, CRC'd like ckpt v2) and RAM keeps an index row
+        if spool_dir is not None and spool_threshold_bytes is None:
+            spool_threshold_bytes = 65536
+        self.spool_threshold_bytes = spool_threshold_bytes
+        self.spool_dir = spool_dir if spool_dir is not None \
+            else socket_path + ".spool"
+        self._spool_bytes = 0
         self.jobs: dict[str, Job] = {}
         self.stats = ServiceStats()
         self.warm = WarmContext()
@@ -260,6 +335,8 @@ class Daemon:
         self.svc_metrics["max_queue"].set(self.queue.max_queue)
         self.svc_metrics["max_concurrent"].set(self.max_concurrent)
         self.svc_metrics["lanes"].set(self.leases.n_lanes)
+        self._clients_seen: set[str] = set()   # label universe for the
+        #   per-client depth gauge (a drained client reads 0, not gone)
         self.metrics_textfile = metrics_textfile
         self._textfile_lock = threading.Lock()  # fsio's tmp name is
         #   pid-unique, not thread-unique: two workers finishing at
@@ -308,6 +385,40 @@ class Daemon:
         sock.listen(16)
         sock.settimeout(0.2)
         self._jobdir = tempfile.TemporaryDirectory(prefix="pwasm_svc_")
+        if self.journal is not None:
+            # replay BEFORE workers start and BEFORE the first accept:
+            # recovered jobs must be queued when the first worker looks
+            # and restored results visible to the first client request
+            try:
+                self._replay_journal()
+                self.journal.open()
+            except OSError as e:
+                self._say(f"warning: job journal {self.journal.path} "
+                          f"unavailable ({e}); serving WITHOUT crash "
+                          "recovery")
+                self.journal = None
+            except Exception as e:
+                # a corrupt journal must degrade, never wedge every
+                # restart on this socket (the exact path the journal
+                # exists to protect): quarantine it ckpt-v2 style and
+                # keep journaling on a fresh file
+                self.journal.close()
+                bad = self.journal.path + ".bad"
+                try:
+                    from pwasm_tpu.utils.fsio import replace_durable
+                    replace_durable(self.journal.path, bad)
+                except OSError:
+                    bad = "(could not quarantine)"
+                self._say(f"warning: job journal replay failed "
+                          f"({type(e).__name__}: {e}); journal "
+                          f"quarantined to {bad} — any jobs it "
+                          "named are NOT recovered (resubmit them), "
+                          "new jobs are journaled afresh")
+                self.obs.event("journal_quarantined", detail=str(e))
+                try:
+                    self.journal.open()
+                except OSError:
+                    self.journal = None
         workers = [threading.Thread(target=self._worker, daemon=True,
                                     name=f"pwasm-svc-worker-{i}")
                    for i in range(self.max_concurrent)]
@@ -363,6 +474,21 @@ class Daemon:
                 if self._jobdir is not None:
                     self._jobdir.cleanup()
         rc = EXIT_PREEMPTED if self.drain.requested else 0
+        if self.drain.requested:
+            # CLEAN exit: every admitted job reached a terminal state
+            # its client was told about (in-flight drained resumable,
+            # queued reported preempted), so there is nothing for a
+            # restart to recover — retire the journal and the spool.
+            # A hard crash never reaches this line, which is the point.
+            if self.journal is not None:
+                self.journal.unlink()
+            with self._lock:
+                spooled = [j for j in self.jobs.values()
+                           if j.spool is not None]
+            for j in spooled:
+                self._unlink_spool(j)
+        elif self.journal is not None:
+            self.journal.close()
         self.obs.event("daemon_exit", rc=rc,
                        drained=self.drain.requested)
         self._write_textfile()       # final snapshot for the scraper
@@ -390,6 +516,10 @@ class Daemon:
             running = len(self._running)
             held = sum(1 for j in self.jobs.values()
                        if j.state in TERMINAL_STATES)
+            clients_seen = set(self._clients_seen)   # snapshot: a
+            #   concurrent admit's .add() must not resize the set
+            #   mid-iteration below
+            spool_bytes = self._spool_bytes
         m["inflight"].set(running)
         m["draining"].set(1 if self._draining else 0)
         m["results_held"].set(held)
@@ -402,6 +532,14 @@ class Daemon:
         for row in self.leases.lane_states():
             m["lane_breaker_state"].set(row["breaker_state"],
                                         lane=str(row["lane"]))
+        m["spool_bytes"].set(spool_bytes)
+        depths = self.queue.client_depths()
+        for c in clients_seen | set(depths):
+            # every client ever admitted keeps a series: a drained
+            # client reads 0 (a disappearing series looks like a
+            # scrape gap, not an emptied queue)
+            m["client_queue_depth"].set(depths.get(c, 0),
+                                        client=c or "default")
 
     def _write_textfile(self) -> None:
         """Atomic textfile publish (fsync-then-replace via
@@ -416,6 +554,242 @@ class Daemon:
         except OSError as e:
             self._say(f"warning: cannot write --metrics-textfile "
                       f"{self.metrics_textfile}: {e}")
+
+    # ---- crash safety: journal + spool (ISSUE 9) -----------------------
+    def _journal_append(self, rec: str, **fields) -> None:
+        """Durably journal one job transition.  A failed append warns
+        ONCE and latches (the daemon keeps serving without crash
+        recovery — a full disk must not take the fleet down), never
+        raises into the serving path."""
+        if self.journal is None:
+            return
+        if self.journal.append(rec, t=round(time.time(), 3),
+                               **fields):
+            self.svc_metrics["journal_records"].inc(rec=rec)
+        elif not self._journal_warned:
+            self._journal_warned = True
+            self._say(f"warning: job-journal append failed "
+                      f"({self.journal.broken}); continuing WITHOUT "
+                      "crash recovery")
+            self.obs.event("journal_broken",
+                           detail=self.journal.broken)
+
+    def _replay_journal(self) -> None:
+        """Rebuild the job table from the journal a crashed
+        predecessor left behind (serve() calls this before the first
+        accept).  Per admitted job, in admission order:
+
+        - ``finish`` record → restored as a terminal result-index
+          entry (stats stream from its spool file when it had one);
+        - ``cancel`` without ``finish`` → terminal ``cancelled`` (the
+          cancel was acked; silently re-running would un-cancel it);
+        - ``start`` without ``finish`` → the crash killed it mid-run:
+          re-admitted as a ``--resume`` continuation of its own report
+          checkpoint, with lane affinity for the lane it ran on — the
+          ckpt-v2 resume contract makes the recovered report
+          byte-identical to a never-crashed run;
+        - bare ``admit`` → re-queued exactly as submitted.
+
+        Afterwards the journal is compacted to the surviving records
+        so restart cost tracks live state, not daemon history."""
+        records = self.journal.replay()
+        folded = fold_records(records) if records else {}
+        if not folded:
+            return
+        rows = sorted(folded.items(), key=lambda kv: kv[1]["_ord"])
+        keep: list[dict] = []
+        n_requeued = n_resumed = n_restored = 0
+        max_num = 0
+        for jid, row in rows:
+            try:
+                max_num = max(max_num, int(jid.rsplit("-", 1)[-1]))
+            except ValueError:
+                pass
+            if row["evicted"]:
+                continue
+            admit = row["admit"]
+            argv = admit.get("argv")
+            if not isinstance(argv, list) \
+                    or not all(isinstance(a, str) for a in argv):
+                continue
+            client = str(admit.get("client") or "")
+            priority = str(admit.get("priority") or "")
+            fin = row["finish"]
+            if fin is not None or row["cancel"] is not None:
+                job = Job(id=jid, argv=list(argv), client=client,
+                          priority=priority)
+                job.submitted_s = _num(admit.get("t"),
+                                       job.submitted_s)
+                if fin is not None:
+                    job.state = fin.get("state") \
+                        if fin.get("state") in TERMINAL_STATES \
+                        else JOB_FAILED
+                    job.rc = fin.get("rc") \
+                        if isinstance(fin.get("rc"), int) else None
+                    job.detail = str(fin.get("detail") or "")
+                    job.finished_s = _num(fin.get("t"), time.time())
+                    spool = fin.get("spool")
+                    if isinstance(spool, dict) \
+                            and isinstance(spool.get("path"), str):
+                        if os.path.exists(spool["path"]):
+                            job.spool = {
+                                "path": spool["path"],
+                                "bytes": int(_num(
+                                    spool.get("bytes"), 0))}
+                            self._spool_bytes += job.spool["bytes"]
+                        else:
+                            job.detail += \
+                                " [spooled result lost in crash]"
+                else:
+                    # a cancel the crash interrupted: the client was
+                    # told "cancelling", so re-running would UN-cancel
+                    # it — land terminal, resumable by resubmission
+                    job.state = JOB_CANCELLED
+                    job.detail = ("cancel was in flight when the "
+                                  "daemon crashed; not re-run — "
+                                  "resubmit (with --resume if a "
+                                  "checkpoint exists) to complete it")
+                    job.finished_s = time.time()
+                job.done.set()
+                self.jobs[jid] = job
+                keep.append(dict(admit))
+                fin_rec = {"v": JOURNAL_VERSION, "rec": REC_FINISH,
+                           "job_id": jid,
+                           "state": job.state, "rc": job.rc,
+                           "detail": job.detail or None,
+                           "spool": job.spool,
+                           "t": round(job.finished_s, 3)}
+                keep.append(fin_rec)
+                n_restored += 1
+                continue
+            # live at crash time: re-queue, resuming if it had started
+            resume = row["start"] is not None
+            run_argv = list(argv)
+            if resume and "--resume" not in run_argv:
+                run_argv.append("--resume")
+            job = Job(id=jid, argv=list(run_argv), client=client,
+                      priority=priority)
+            job.recovered = True
+            job.submitted_s = _num(admit.get("t"), job.submitted_s)
+            if resume and isinstance(row["start"].get("lane"), int):
+                job.prefer_lane = row["start"]["lane"]
+            job.detail = ("recovered from the job journal "
+                          + ("(daemon crashed mid-run); resuming "
+                             "from its checkpoint" if resume
+                             else "(daemon crashed while it was "
+                             "queued); re-queued"))
+            self._arm_job(job)
+            try:
+                self.queue.submit(job)
+            except (Draining, QueueFull) as e:
+                # only reachable when queue limits SHRANK across the
+                # restart: surface it as a failed job, never a lost one
+                job.state = JOB_FAILED
+                job.detail = ("journal recovery could not re-queue "
+                              f"({e})")
+                job.finished_s = time.time()
+                job.done.set()
+                self.jobs[jid] = job
+                continue
+            self.jobs[jid] = job
+            self._clients_seen.add(client)
+            new_admit = dict(admit)
+            new_admit.update({"v": JOURNAL_VERSION, "rec": REC_ADMIT,
+                              "job_id": jid, "argv": run_argv,
+                              "client": client,
+                              "priority": priority})
+            keep.append(new_admit)
+            self.stats.jobs_recovered += 1
+            if resume:
+                n_resumed += 1
+            else:
+                n_requeued += 1
+        with self._lock:
+            self._next_id = max(self._next_id, max_num)
+        self.journal.compact(keep)
+        self.stats.journal_replays += 1
+        self.svc_metrics["journal_replays"].inc()
+        self.obs.event("journal_replay", requeued=n_requeued,
+                       resumed=n_resumed, restored=n_restored)
+        self._say(f"journal replay: {n_requeued} queued job(s) "
+                  f"re-queued, {n_resumed} interrupted job(s) "
+                  f"re-admitted with --resume, {n_restored} "
+                  "terminal result(s) restored")
+
+    def _spool_result(self, job: Job) -> None:
+        """Move a finished job's RAM-resident result (its RunStats
+        JSON + stderr tail) to the spool dir once the serialized form
+        passes ``--spool-threshold-bytes``: the daemon keeps only the
+        index row (path + size), so resident result memory is bounded
+        no matter how large reports grow.  The file is published via
+        the audited fsync-then-replace and CRC'd like ckpt v2 — a torn
+        or rotted spool is detected at read time, never served."""
+        if self.spool_threshold_bytes is None or job.spool is not None:
+            return
+        import json
+
+        from pwasm_tpu.utils.fsio import (payload_crc,
+                                          write_durable_text)
+        payload = {"version": 1, "job_id": job.id,
+                   "state": job.state, "rc": job.rc,
+                   "stats": job.stats,
+                   "stderr_tail": job.stderr_tail}
+        blob = json.dumps(payload, sort_keys=True,
+                          separators=(",", ":"))
+        if len(blob) < self.spool_threshold_bytes:
+            return
+        payload["crc"] = payload_crc(payload)
+        out = json.dumps(payload, sort_keys=True,
+                         separators=(",", ":"))
+        path = os.path.join(self.spool_dir,
+                            f"{job.id}.result.json")
+        try:
+            os.makedirs(self.spool_dir, exist_ok=True)
+            write_durable_text(path, out)
+        except OSError as e:
+            self._say(f"warning: cannot spool result for {job.id}: "
+                      f"{e} (result stays in memory)")
+            return
+        job.spool = {"path": path, "bytes": len(out)}
+        job.stats = None
+        job.stderr_tail = ""
+        with self._lock:     # workers race this read-modify-write
+            self._spool_bytes += len(out)
+        self.obs.event("result_spool", job_id=job.id,
+                       bytes=len(out))
+
+    def _load_spool(self, job: Job):
+        """(stats, stderr_tail, error) read back from the job's spool
+        file, CRC-verified (the ckpt-v2 rule: a result that fails
+        verification is reported unreadable, never served as if
+        whole)."""
+        import json
+
+        from pwasm_tpu.utils.fsio import payload_crc
+        try:
+            with open(job.spool["path"], encoding="utf-8") as f:
+                obj = json.load(f)
+            if not isinstance(obj, dict):
+                raise ValueError("not an object")
+            crc = int(obj.pop("crc"))
+            if payload_crc(obj) != crc:
+                raise ValueError("spool payload CRC mismatch")
+            return (obj.get("stats"),
+                    str(obj.get("stderr_tail") or ""), None)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            return None, "", f"spooled result unreadable ({e})"
+
+    def _unlink_spool(self, job: Job) -> None:
+        if job.spool is None:
+            return
+        try:
+            os.unlink(job.spool["path"])
+        except OSError:
+            pass
+        with self._lock:     # workers race this read-modify-write
+            self._spool_bytes = max(0, self._spool_bytes
+                                    - job.spool.get("bytes", 0))
+        job.spool = None
 
     def _evict_results(self) -> None:
         """Drop TERMINAL job results past ``--result-ttl-s`` and/or
@@ -444,6 +818,9 @@ class Daemon:
             for j in victims:
                 self.jobs.pop(j.id, None)
         for j in victims:
+            self._unlink_spool(j)      # eviction bounds DISK too: the
+            #                            spool file goes with the entry
+            self._journal_append(REC_EVICT, job_id=j.id)
             self.stats.jobs_evicted += 1
             self.svc_metrics["results_evicted"].inc()
             self.obs.event("job_evict", job_id=j.id, state=j.state)
@@ -471,6 +848,10 @@ class Daemon:
             job.finished_s = time.time()
             self.stats.jobs_preempted += 1
             self.svc_metrics["jobs"].inc(outcome="preempted")
+            self._journal_append(REC_FINISH, job_id=job.id,
+                                 state=JOB_PREEMPTED,
+                                 rc=EXIT_PREEMPTED,
+                                 detail=job.detail)
             job.done.set()
         for job in running:
             if job.drain is not None:
@@ -501,7 +882,8 @@ class Daemon:
             # the drain-less close path
             t_wait = time.monotonic()
             lease = self.leases.acquire(
-                should_abort=self._closing.is_set)
+                should_abort=self._closing.is_set,
+                prefer_lane=job.prefer_lane)
             if lease is None:        # drained, or closing mid-wait
                 self._preempt_leaseless(job)
                 continue
@@ -529,12 +911,19 @@ class Daemon:
         job.finished_s = time.time()
         self.stats.jobs_preempted += 1
         self.svc_metrics["jobs"].inc(outcome="preempted")
+        self._journal_append(REC_FINISH, job_id=job.id,
+                             state=JOB_PREEMPTED, rc=EXIT_PREEMPTED,
+                             detail=job.detail)
         self.obs.event("job_preempt_leaseless", job_id=job.id)
         job.done.set()
 
     def _run_job(self, job: Job, lease) -> None:
         job.state = JOB_RUNNING
         job.started_s = time.time()
+        # journal the start BEFORE the run: a kill -9 from here on
+        # makes the job a --resume continuation at the next start
+        self._journal_append(REC_START, job_id=job.id,
+                             lane=lease.lane)
         self.obs.event("job_start", job_id=job.id, lane=lease.lane,
                        queue_wait_s=round(job.started_s
                                           - job.submitted_s, 6))
@@ -597,6 +986,14 @@ class Daemon:
         self.svc_metrics["queue_wait_seconds"].observe(
             max(0.0, job.started_s - job.submitted_s))
         fold_run_stats(self.run_metrics, job.stats)
+        # past every RAM consumer of job.stats: big results move to
+        # the spool (index-only in RAM), then the terminal verdict —
+        # with its spool pointer — lands durably in the journal
+        self._spool_result(job)
+        self._journal_append(REC_FINISH, job_id=job.id,
+                             state=job.state, rc=rc,
+                             detail=job.detail or None,
+                             spool=job.spool)
         self.obs.event(
             "job_finish", job_id=job.id, state=job.state, rc=rc,
             lane=lease.lane,
@@ -621,17 +1018,59 @@ class Daemon:
         return st if isinstance(st, dict) else None
 
     # ---- admission -----------------------------------------------------
-    def submit(self, argv: list, cwd: str | None = None) -> Job:
+    def _arm_job(self, job: Job) -> None:
+        """Per-job drain flag + RunStats sink (a daemon-owned stats
+        tmp is injected when the client didn't pass ``--stats`` — the
+        daemon needs every job's RunStats for the roll-up and warm-hit
+        gates).  Shared by fresh admissions and journal recovery."""
+        job.drain = SignalDrain(stderr=job.errbuf,
+                                hard_exit=lambda code: None)
+        stats_path = next(
+            (a.split("=", 1)[1] for a in job.argv
+             if a.startswith("--stats=")), None)
+        if stats_path is None:
+            stats_path = os.path.join(self._jobdir.name,
+                                      f"{job.id}.stats.json")
+            job.argv = job.argv + [f"--stats={stats_path}"]
+            job.stats_injected = True
+        job.stats_path = stats_path
+
+    def submit(self, argv: list, cwd: str | None = None,
+               client: str | None = None,
+               priority: str | None = None) -> Job:
         """Validate + admit one job (raises Draining/QueueFull/
         ValueError).  Also the in-process API the tests drive.
         ``cwd`` is the CLIENT's working directory: relative paths in
         the job argv are resolved against it, not the daemon's cwd —
         the cold-to-warm drop-in contract (the client sends it
-        automatically)."""
+        automatically).  ``client`` is the fair-share identity (the
+        protocol layer defaults it to the socket-peer uid);
+        ``priority`` must name a ``--priority-lanes`` tier when
+        given."""
         if not isinstance(argv, list) \
                 or not all(isinstance(a, str) for a in argv) \
                 or not argv:
             raise ValueError("args must be a non-empty list of strings")
+        if client is None:
+            client = ""
+        if not isinstance(client, str) or len(client) > 64 \
+                or not _CLIENT_RE.match(client or "x"):
+            raise ValueError(
+                "client must be a short identifier "
+                "([A-Za-z0-9_.:@/-]{1,64})")
+        if priority is None:
+            priority = ""
+        if not isinstance(priority, str):
+            raise ValueError("priority must be a string")
+        if priority:
+            lanes = [l for l in self.queue.priority_lanes if l]
+            if not lanes:
+                raise ValueError(
+                    "this daemon has no --priority-lanes configured")
+            if priority not in lanes:
+                raise ValueError(
+                    f"unknown priority lane {priority!r} "
+                    f"(configured: {','.join(lanes)})")
         from pwasm_tpu.cli import _SERVICE_CMDS, _parse_args, CliError
         if argv[0] in _SERVICE_CMDS:
             raise ValueError(
@@ -655,29 +1094,37 @@ class Daemon:
                 "not report bytes")
         if self.drain.requested:
             raise Draining("service is draining")
+        base_argv = list(argv)     # what the journal records: the
+        #   pre-injection argv (the injected stats tmp lives in a
+        #   directory that dies with this process)
         with self._lock:
             self._next_id += 1
-            job = Job(id=f"job-{self._next_id:04d}", argv=list(argv))
-        job.drain = SignalDrain(stderr=job.errbuf,
-                                hard_exit=lambda code: None)
-        stats_path = next(
-            (a.split("=", 1)[1] for a in argv
-             if a.startswith("--stats=")), None)
-        if stats_path is None:
-            # the daemon needs every job's RunStats for the roll-up
-            # and the warm-hit gates: inject a stats sink the client
-            # didn't ask for (daemon-owned, deleted after reading)
-            stats_path = os.path.join(self._jobdir.name,
-                                      f"{job.id}.stats.json")
-            job.argv = job.argv + [f"--stats={stats_path}"]
-            job.stats_injected = True
-        job.stats_path = stats_path
-        self.queue.submit(job)     # may raise Draining/QueueFull
+            job = Job(id=f"job-{self._next_id:04d}", argv=list(argv),
+                      client=client, priority=priority)
+        self._arm_job(job)
+        # write-ahead order: the admit record lands BEFORE the queue
+        # can hand the job to a worker — a worker only journals start
+        # after a successful dequeue, so the file order admit < start
+        # that replay's fold depends on cannot invert.  (It also lands
+        # before the ok frame, so every ACKED admission is durable; a
+        # crash in the gap between append and ack at worst re-runs a
+        # job nobody was promised — the benign direction.)
+        self._journal_append(REC_ADMIT, job_id=job.id,
+                             argv=base_argv, client=client,
+                             priority=priority)
+        try:
+            self.queue.submit(job)
+        except (Draining, QueueFull):
+            # the admission never happened: retract the id so replay
+            # cannot resurrect a job the client was told was rejected
+            self._journal_append(REC_EVICT, job_id=job.id)
+            raise
         with self._lock:
             self.jobs[job.id] = job
+            self._clients_seen.add(client)
         self.stats.jobs_accepted += 1
         self.svc_metrics["jobs"].inc(outcome="accepted")
-        self.obs.event("job_admit", job_id=job.id,
+        self.obs.event("job_admit", job_id=job.id, client=client,
                        queue_depth=self.queue.depth())
         return job
 
@@ -692,6 +1139,7 @@ class Daemon:
     def _handle_conn(self, conn: socket.socket) -> None:
         rfile = conn.makefile("rb")
         wfile = conn.makefile("wb")
+        peer = _peer_identity(conn)
         try:
             while True:
                 try:
@@ -706,7 +1154,7 @@ class Daemon:
                 if req is None:
                     return
                 try:
-                    resp = self._dispatch(req)
+                    resp = self._dispatch(req, peer=peer)
                 except Exception as e:
                     # client-controlled field TYPES can reach stdlib
                     # calls (a string `timeout` into Event.wait, an
@@ -734,7 +1182,7 @@ class Daemon:
             except OSError:
                 pass
 
-    def _dispatch(self, req: dict) -> dict:
+    def _dispatch(self, req: dict, peer: str | None = None) -> dict:
         cmd = req.get("cmd")
         # eviction runs on every request (plus the accept-loop tick
         # and each admission), so reads observe a deterministic
@@ -746,9 +1194,15 @@ class Daemon:
                 protocol_version=protocol.PROTOCOL_VERSION,
                 draining=self._draining)
         if cmd == "submit":
+            client = req.get("client")
+            if client is None:
+                # default identity: the unix-socket peer uid (ucred)
+                client = peer or ""
             try:
                 job = self.submit(req.get("args"),
-                                  cwd=req.get("cwd"))
+                                  cwd=req.get("cwd"),
+                                  client=client,
+                                  priority=req.get("priority"))
             except ValueError as e:
                 return protocol.err(protocol.ERR_BAD_REQUEST, str(e))
             except Draining as e:
@@ -758,12 +1212,18 @@ class Daemon:
                 return protocol.err(protocol.ERR_DRAINING, str(e))
             except QueueFull as e:
                 # the 429: a well-behaved client backs off and retries
+                # (`submit --retry` honors retry_after_s with capped-
+                # exponential backoff).  The quota is per client, so
+                # the frame names WHOSE quota filled.
                 self.stats.jobs_rejected += 1
                 self.svc_metrics["jobs"].inc(outcome="rejected")
                 return protocol.err(
                     protocol.ERR_QUEUE_FULL, str(e),
                     queue_depth=self.queue.depth(),
                     max_queue=self.queue.max_queue,
+                    client=client or "default",
+                    client_depth=self.queue.client_depths().get(
+                        client, 0),
                     retry_after_s=self._retry_after_s())
             return protocol.ok(job_id=job.id,
                                queue_depth=self.queue.depth())
@@ -791,6 +1251,30 @@ class Daemon:
                 "waiting": self.leases.waiting_count(),
                 "grants": self.leases.grants,
                 "wait_s_total": round(self.leases.wait_s_total, 6),
+            }
+            # additive (stats_version unchanged): crash-safety +
+            # fair-share surfaces (ISSUE 9)
+            st["fair_share"] = {
+                "max_queue_per_client": self.queue.max_queue,
+                "max_queue_total": self.queue.max_total,
+                "priority_lanes": [l for l in
+                                   self.queue.priority_lanes if l],
+                "clients": {(c or "default"): n for c, n in
+                            self.queue.client_depths().items()},
+            }
+            st["journal"] = {
+                "path": self.journal.path if self.journal else None,
+                "records": (self.journal.records_written
+                            if self.journal else 0),
+                "broken": (self.journal.broken is not None
+                           if self.journal else False),
+                "replays": self.stats.journal_replays,
+                "jobs_recovered": self.stats.jobs_recovered,
+            }
+            st["spool"] = {
+                "dir": self.spool_dir,
+                "threshold_bytes": self.spool_threshold_bytes,
+                "bytes": self._spool_bytes,
             }
             return protocol.ok(stats=st)
         if cmd == "metrics":
@@ -831,8 +1315,17 @@ class Daemon:
                 d = job.describe()
                 if job.state not in TERMINAL_STATES:
                     return protocol.ok(job=d, pending=True)
-                return protocol.ok(job=d, rc=job.rc, stats=job.stats,
-                                   stderr_tail=job.stderr_tail)
+                stats, tail = job.stats, job.stderr_tail
+                spool_error = None
+                if job.spool is not None:
+                    # disk-spooled result: RAM held only the index —
+                    # the frame streams from the spool file on demand
+                    stats, tail, spool_error = self._load_spool(job)
+                resp = protocol.ok(job=d, rc=job.rc, stats=stats,
+                                   stderr_tail=tail)
+                if spool_error is not None:
+                    resp["spool_error"] = spool_error
+                return resp
             return self._cancel(job)
         return protocol.err(protocol.ERR_UNKNOWN_CMD,
                             f"unknown cmd {cmd!r}")
@@ -845,6 +1338,9 @@ class Daemon:
             job.finished_s = time.time()
             self.stats.jobs_cancelled += 1
             self.svc_metrics["jobs"].inc(outcome="cancelled")
+            self._journal_append(REC_FINISH, job_id=job.id,
+                                 state=JOB_CANCELLED, rc=None,
+                                 detail=job.detail)
             self.obs.event("job_cancel", job_id=job.id, was="queued")
             job.done.set()
             return protocol.ok(state=JOB_CANCELLED, was="queued")
@@ -860,6 +1356,9 @@ class Daemon:
         job.cancel_requested = True
         if job.drain is not None:
             job.drain.request("cancelled by client")
+        # journaled so a crash mid-cancel cannot silently UN-cancel:
+        # replay lands the job terminal-cancelled instead of re-running
+        self._journal_append(REC_CANCEL, job_id=job.id)
         self.obs.event("job_cancel", job_id=job.id, was="running")
         return protocol.ok(state="cancelling", was="running")
 
@@ -932,6 +1431,28 @@ def _absolutize_argv(argv: list[str], cwd: str) -> list[str]:
     return out
 
 
+def _peer_identity(conn: socket.socket) -> str | None:
+    """The connection's DEFAULT fair-share identity: the unix-socket
+    peer uid via ``SO_PEERCRED`` (kernel-attested — a client cannot
+    spoof it the way a free-form field could), rendered ``uid:<n>``.
+    An explicit ``client=`` submit field overrides it: one uid fronting
+    many logical tenants (a scheduler submitting for users) needs the
+    finer identity, and admission quotas are a fairness device here,
+    not a security boundary.  None when the platform has no peer
+    credentials — those submits share the anonymous bucket."""
+    peercred = getattr(socket, "SO_PEERCRED", None)
+    if peercred is None:
+        return None
+    try:
+        import struct
+        raw = conn.getsockopt(socket.SOL_SOCKET, peercred,
+                              struct.calcsize("3i"))
+        _pid, uid, _gid = struct.unpack("3i", raw)
+        return f"uid:{uid}"
+    except (OSError, ValueError):
+        return None
+
+
 def _socket_alive(path: str) -> bool:
     s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     s.settimeout(0.5)
@@ -966,7 +1487,9 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
     nums = {}
     for knob, dflt in (("max-queue", 16), ("max-concurrent", 1),
                        ("max-frame-bytes", protocol.MAX_FRAME_BYTES),
-                       ("devices-per-job", 1), ("lanes", None)):
+                       ("devices-per-job", 1), ("lanes", None),
+                       ("max-queue-total", None),
+                       ("spool-threshold-bytes", None)):
         val = opts.pop(knob, None)
         if val is None:
             nums[knob] = dflt
@@ -976,6 +1499,29 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
             stderr.write(f"{_SERVE_USAGE}\nInvalid --{knob} value: "
                          f"{val}\n")
             return EXIT_USAGE
+    journal_path = opts.pop("journal", "auto")
+    if journal_path == "off":
+        journal_path = None
+    elif journal_path is not None and journal_path != "auto" \
+            and not journal_path.strip():
+        stderr.write(f"{_SERVE_USAGE}\nInvalid --journal value\n")
+        return EXIT_USAGE
+    spool_dir = opts.pop("spool-dir", None)
+    if spool_dir is not None and not spool_dir.strip():
+        stderr.write(f"{_SERVE_USAGE}\nInvalid --spool-dir value\n")
+        return EXIT_USAGE
+    priority_lanes: tuple[str, ...] | None = None
+    val = opts.pop("priority-lanes", None)
+    if val is not None:
+        lanes = [l.strip() for l in val.split(",")]
+        if (not lanes or any(not l or not _CLIENT_RE.match(l)
+                             for l in lanes)
+                or len(set(lanes)) != len(lanes)):
+            stderr.write(f"{_SERVE_USAGE}\nInvalid --priority-lanes "
+                         f"value: {val} (comma-separated unique "
+                         "names, highest first)\n")
+            return EXIT_USAGE
+        priority_lanes = tuple(lanes)
     metrics_textfile = opts.pop("metrics-textfile", None)
     log_json = opts.pop("log-json", None)
     result_ttl_s = None
@@ -1012,7 +1558,13 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
                         log_json=log_json, result_ttl_s=result_ttl_s,
                         max_results=max_results,
                         lanes=nums["lanes"],
-                        devices_per_job=nums["devices-per-job"])
+                        devices_per_job=nums["devices-per-job"],
+                        journal_path=journal_path,
+                        max_queue_total=nums["max-queue-total"],
+                        priority_lanes=priority_lanes,
+                        spool_threshold_bytes=nums[
+                            "spool-threshold-bytes"],
+                        spool_dir=spool_dir)
     except OSError:
         stderr.write(f"Cannot open file {log_json} for writing!\n")
         return EXIT_USAGE

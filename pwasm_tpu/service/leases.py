@@ -126,13 +126,23 @@ class LeaseManager:
     # ---- grant/release -------------------------------------------------
     def acquire(self, timeout: float | None = None,
                 should_abort=None,
-                poll_s: float = 0.25) -> DeviceLease | None:
+                poll_s: float = 0.25,
+                prefer_lane: int | None = None) -> DeviceLease | None:
         """Grant the next free lease, FIFO among callers.  Returns None
         on timeout, once :meth:`drain` latched, or when
         ``should_abort()`` turns true mid-wait.  (Wait observability:
         the caller times the call itself — the daemon feeds its
         lease-wait histogram that way, including zero-wait grants —
         and ``wait_s_total`` aggregates the queued waits here.)
+
+        ``prefer_lane`` is an AFFINITY HINT, not a reservation: when
+        that lane is free it is granted (a journal-recovered job goes
+        back to the lane it ran on, inheriting that lane's warm
+        breaker/ceiling state instead of polluting a neighbor's);
+        when it is busy — or the caller had to queue — any lane
+        serves, because byte output is placement-independent and a
+        hard reservation would let one recovered job idle a whole
+        pool behind it.
 
         The ONE ticket enqueued here survives the whole wait —
         ``should_abort`` is polled every ``poll_s`` on the same ticket
@@ -146,7 +156,15 @@ class LeaseManager:
             if self._draining:
                 return None
             if self._free and not self._waiters:
-                lease = self._free.popleft()
+                lease = None
+                if prefer_lane is not None:
+                    for cand in self._free:
+                        if cand.lane == prefer_lane:
+                            lease = cand
+                            self._free.remove(cand)
+                            break
+                if lease is None:
+                    lease = self._free.popleft()
                 lease.busy = True
                 self.grants += 1
                 return lease

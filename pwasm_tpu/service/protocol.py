@@ -13,15 +13,20 @@ Commands (``{"cmd": ...}``):
 
 =============  ==========================================================
 ``submit``     ``{"cmd":"submit","args":[...cli argv...],
-               "cwd":ABS_DIR}`` — enqueue a report job; relative
-               paths in ``args`` resolve against the client's
-               ``cwd`` (what a cold run would do), never the
-               daemon's.  Admission control answers ``queue_full``
-               (the 429 of this protocol: back off and retry) when the
-               bounded queue is at capacity, and ``draining`` once a
-               drain began.  Jobs must write their outputs to files
-               (``-o`` required): the socket carries control, not bulk
-               report bytes.
+               "cwd":ABS_DIR[,"client":NAME,"priority":LANE]}`` —
+               enqueue a report job; relative paths in ``args``
+               resolve against the client's ``cwd`` (what a cold run
+               would do), never the daemon's.  ``client`` overrides
+               the fair-share identity (default: the kernel-attested
+               socket-peer uid); ``priority`` names a
+               ``--priority-lanes`` tier.  Admission control answers
+               ``queue_full`` (the 429 of this protocol: back off and
+               retry — the frame carries ``retry_after_s``,
+               ``client`` and ``client_depth``) once THAT client's
+               queue quota (or the global backstop) fills, and
+               ``draining`` once a drain began.  Jobs must write
+               their outputs to files (``-o`` required): the socket
+               carries control, not bulk report bytes.
 ``status``     ``{"cmd":"status","job_id":...}`` — non-blocking state.
 ``result``     ``{"cmd":"result","job_id":...[,"wait":bool,
                "timeout":s]}`` — the terminal verdict (rc, per-job

@@ -1,12 +1,29 @@
-"""Bounded FIFO job queue with admission control + service counters.
+"""Fair-share job queue with per-client admission control + counters.
 
 The queue is the daemon's *admission control* point: a serving process
-that accepts unboundedly is just an OOM with extra steps, so ``submit``
-fails fast with :class:`QueueFull` (the protocol's ``queue_full`` —
-429-shaped: the caller backs off and retries) once ``max_queue`` jobs
-wait, and with :class:`Draining` once a drain began.  FIFO on purpose:
-report jobs are peers, and predictable completion order is worth more
-to a batch fleet than any priority scheme.
+that accepts unboundedly is just an OOM with extra steps.  Two things
+changed from the PR 5 global FIFO (the "millions of users" gaps
+ROADMAP item 5 named):
+
+- **per-client fair share**: jobs are grouped by *client identity*
+  (socket-peer uid, or an explicit ``client=`` submit field) and
+  dequeued by weighted deficit-round-robin over the clients — a
+  500-job submitter and a 1-job submitter both make progress, and
+  within one client order stays strict FIFO (predictable completion
+  order per submitter is part of the contract).  Optional
+  ``--priority-lanes=hi,lo`` adds strict priority *tiers* above the
+  round-robin: a higher lane is always served before a lower one,
+  with DRR fairness among the clients inside each lane;
+- **per-client depth quotas**: ``max_queue`` is the PER-CLIENT queued
+  ceiling (the old single global cliff let one heavy submitter eat
+  every slot, turning admission control into a denial of service for
+  everyone else); :class:`QueueFull` now names the client at quota.
+  ``max_total`` (default ``8 * max_queue``) keeps the global
+  memory-bound backstop.
+
+``submit`` fails fast with :class:`QueueFull` (the protocol's
+``queue_full`` — 429-shaped: the caller backs off and retries) and
+with :class:`Draining` once a drain began.
 
 :class:`ServiceStats` is the service-level mirror of the per-job
 ``RunStats``: admission/outcome counters plus a numeric roll-up of
@@ -54,6 +71,17 @@ class Job:
     rc: int | None = None
     detail: str = ""
     cancel_requested: bool = False
+    client: str = ""                   # fair-share identity (peer uid
+    #   or the submit frame's client= field); "" = anonymous bucket
+    priority: str = ""                 # priority lane ("" = default)
+    prefer_lane: int | None = None     # device-lane affinity hint (a
+    #   journal-recovered job asks for the lane it ran on)
+    recovered: bool = False            # re-admitted by journal replay
+    seq: int = 0                       # global admission order (drain
+    #   and journal replay preserve it across the per-client deques)
+    spool: dict | None = None          # disk-spooled result index
+    #   ({path, bytes}): the RAM-resident stats/stderr_tail moved to
+    #   the spool dir — see daemon._spool_result
     submitted_s: float = field(default_factory=time.time)
     started_s: float | None = None
     finished_s: float | None = None
@@ -80,6 +108,9 @@ class Job:
             "rc": self.rc,
             "detail": self.detail,
             "cancel_requested": self.cancel_requested,
+            "client": self.client,
+            "priority": self.priority,
+            "recovered": self.recovered,
             "submitted_s": round(self.submitted_s, 3),
             "started_s": round(self.started_s, 3)
             if self.started_s else None,
@@ -88,12 +119,97 @@ class Job:
         }
 
 
-class JobQueue:
-    """Thread-safe bounded FIFO with a draining latch."""
+class _LaneSched:
+    """Weighted deficit-round-robin state for ONE priority lane: a
+    strict-FIFO deque per client, a client rotation, and per-client
+    deficit counters.  Unit job cost, so with equal weights DRR
+    degenerates to plain round-robin over clients — the property the
+    fair-share acceptance gate tests (a 1-job submitter never waits
+    behind a 500-job submitter's whole backlog)."""
 
-    def __init__(self, max_queue: int = 16):
+    __slots__ = ("clients", "rr", "deficit")
+
+    def __init__(self) -> None:
+        self.clients: dict[str, deque[Job]] = {}
+        self.rr: deque[str] = deque()      # client service rotation
+        self.deficit: dict[str, float] = {}
+
+    def push(self, job: Job) -> None:
+        q = self.clients.get(job.client)
+        if q is None:
+            q = self.clients[job.client] = deque()
+            self.rr.append(job.client)
+            self.deficit[job.client] = 0.0
+        q.append(job)
+
+    def empty(self) -> bool:
+        return not any(self.clients.values())
+
+    def _drop_if_empty(self, client: str) -> None:
+        if client in self.clients and not self.clients[client]:
+            del self.clients[client]
+            del self.deficit[client]
+            try:
+                self.rr.remove(client)
+            except ValueError:
+                pass
+
+    def pop(self, weight_of) -> Job | None:
+        """One DRR dequeue: the head-of-rotation client is credited
+        its weight ONCE per visit (only when its deficit no longer
+        covers a job — the mid-burst guard), then serves its OLDEST
+        job per unit of deficit; the rotation advances when the burst
+        is paid out, so a weight-2 client yields two jobs per rotation
+        to a weight-1 client's one.  Weights are clamped positive, so
+        deficits grow every full rotation and the loop always
+        terminates on a non-empty lane; the credit guard also caps any
+        deficit at ``1 + weight`` (no unbounded credit hoarding)."""
+        while self.rr:
+            c = self.rr[0]
+            q = self.clients.get(c)
+            if not q:
+                self._drop_if_empty(c)
+                continue
+            if self.deficit[c] < 1.0:    # a fresh visit, not mid-burst
+                self.deficit[c] += max(0.05, float(weight_of(c)))
+            if self.deficit[c] >= 1.0:
+                job = q.popleft()
+                self.deficit[c] -= 1.0
+                if self.deficit[c] < 1.0 or not q:
+                    self.rr.rotate(-1)   # burst paid out: next take
+                    #                      serves the NEXT client
+                self._drop_if_empty(c)
+                return job
+            self.rr.rotate(-1)
+        return None
+
+
+class JobQueue:
+    """Thread-safe fair-share queue: per-client quotas at admission,
+    weighted deficit-round-robin over clients at dequeue, optional
+    strict priority lanes above both, and a draining latch.
+
+    ``max_queue`` is the PER-CLIENT depth quota (the global cliff it
+    replaces let one heavy submitter starve everyone — see the module
+    docstring); ``max_total`` (default ``8 * max_queue``) bounds the
+    whole queue.  A single-client workload behaves exactly like the
+    old bounded FIFO: same quota arithmetic, same FIFO order."""
+
+    def __init__(self, max_queue: int = 16,
+                 max_total: int | None = None,
+                 priority_lanes: tuple[str, ...] | None = None):
         self.max_queue = max(1, int(max_queue))
-        self._q: deque[Job] = deque()
+        self.max_total = max(self.max_queue, int(max_total)) \
+            if max_total is not None else self.max_queue * 8
+        # priority tiers, highest first; () / None = one anonymous lane
+        self.priority_lanes = tuple(priority_lanes) \
+            if priority_lanes else ("",)
+        self._sched = {lane: _LaneSched()
+                       for lane in self.priority_lanes}
+        self._count = 0
+        self._client_counts: dict[str, int] = {}
+        self._weights: dict[str, float] = {}
+        self._seq = 0
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._draining = False
@@ -104,51 +220,118 @@ class JobQueue:
 
     def depth(self) -> int:
         with self._lock:
-            return len(self._q)
+            return self._count
+
+    def client_depths(self) -> dict[str, int]:
+        """Queued-job count per client (the
+        ``pwasm_service_client_queue_depth`` gauge source)."""
+        with self._lock:
+            return dict(self._client_counts)
+
+    def set_client_weight(self, client: str, weight: float) -> None:
+        """Set a client's DRR weight (default 1.0): a weight-2 client
+        is served two jobs per rotation for every one a weight-1
+        client gets.  Clamped positive."""
+        with self._lock:
+            self._weights[client] = max(0.05, float(weight))
+
+    def _weight_of(self, client: str) -> float:
+        return self._weights.get(client, 1.0)
 
     def submit(self, job: Job) -> int:
-        """Admit ``job``; returns its 0-based queue position.  Raises
-        :class:`Draining` / :class:`QueueFull` — admission decisions
-        are exceptions, not silent drops, so the protocol layer can
-        answer with the right wire code."""
+        """Admit ``job``; returns the number of jobs queued ahead of
+        it.  Raises :class:`Draining` / :class:`QueueFull` — admission
+        decisions are exceptions, not silent drops, so the protocol
+        layer can answer with the right wire code.  ``job.priority``
+        must be one of the configured lanes (the daemon validates the
+        submit field before it gets here)."""
+        lane = job.priority or self.priority_lanes[-1]
         with self._cond:
             if self._draining:
                 raise Draining("service is draining")
-            if len(self._q) >= self.max_queue:
+            if lane not in self._sched:
+                raise QueueFull(f"unknown priority lane {lane!r}")
+            if self._client_counts.get(job.client, 0) \
+                    >= self.max_queue:
                 raise QueueFull(
-                    f"queue at capacity ({self.max_queue})")
-            self._q.append(job)
-            pos = len(self._q) - 1
+                    f"client {job.client or 'default'!s} at queue "
+                    f"quota ({self.max_queue})")
+            if self._count >= self.max_total:
+                raise QueueFull(
+                    f"queue at total capacity ({self.max_total})")
+            pos = self._count
+            job.seq = self._seq
+            self._seq += 1
+            self._sched[lane].push(job)
+            self._count += 1
+            self._client_counts[job.client] = \
+                self._client_counts.get(job.client, 0) + 1
             self._cond.notify()
             return pos
 
+    def _pop_locked(self) -> Job | None:
+        for lane in self.priority_lanes:   # strict tiers, high first
+            job = self._sched[lane].pop(self._weight_of)
+            if job is not None:
+                self._count -= 1
+                self._uncount_client(job.client)
+                return job
+        return None
+
+    def _uncount_client(self, client: str) -> None:
+        n = self._client_counts.get(client, 0) - 1
+        if n > 0:
+            self._client_counts[client] = n
+        else:
+            self._client_counts.pop(client, None)
+
     def take(self, timeout: float | None = None) -> Job | None:
-        """Pop the oldest queued job (FIFO); None on timeout or when
-        draining emptied the queue."""
+        """Dequeue the next job by priority tier then client fair
+        share (FIFO within a client); None on timeout or when draining
+        emptied the queue."""
         with self._cond:
-            if not self._q:
+            if not self._count:
                 self._cond.wait(timeout)
-            if not self._q:
+            if not self._count:
                 return None
-            return self._q.popleft()
+            return self._pop_locked()
 
     def remove(self, job: Job) -> bool:
         """Remove a still-queued job (the queued-cancel path)."""
         with self._lock:
+            lane = job.priority or self.priority_lanes[-1]
+            sched = self._sched.get(lane)
+            if sched is None:
+                return False
+            q = sched.clients.get(job.client)
+            if not q:
+                return False
             try:
-                self._q.remove(job)
-                return True
+                q.remove(job)
             except ValueError:
                 return False
+            sched._drop_if_empty(job.client)
+            self._count -= 1
+            self._uncount_client(job.client)
+            return True
 
     def drain(self) -> list[Job]:
         """Latch the draining state (every later ``submit`` raises
-        :class:`Draining`) and return the jobs that were still queued —
-        the daemon marks them preempted-resumable, never starts them."""
+        :class:`Draining`) and return the jobs that were still queued
+        in ADMISSION order — the daemon marks them preempted-
+        resumable, never starts them."""
         with self._cond:
             self._draining = True
-            waiting = list(self._q)
-            self._q.clear()
+            waiting: list[Job] = []
+            for sched in self._sched.values():
+                for q in sched.clients.values():
+                    waiting.extend(q)
+                sched.clients.clear()
+                sched.rr.clear()
+                sched.deficit.clear()
+            waiting.sort(key=lambda j: j.seq)
+            self._count = 0
+            self._client_counts.clear()
             self._cond.notify_all()
             return waiting
 
@@ -167,6 +350,9 @@ class ServiceStats:
         self.jobs_cancelled = 0
         self.jobs_evicted = 0         # terminal results dropped by
         #                               --result-ttl-s / --max-results
+        self.jobs_recovered = 0       # re-admitted by journal replay
+        #                               after a daemon crash
+        self.journal_replays = 0      # startup replays performed
         self._rollup: dict = {}
         self._lock = threading.Lock()
 
@@ -213,6 +399,7 @@ class ServiceStats:
                 "preempted": self.jobs_preempted,
                 "cancelled": self.jobs_cancelled,
                 "evicted": self.jobs_evicted,
+                "recovered": self.jobs_recovered,
             },
             # the warm-pool promise, observable: probes paid vs probe
             # checks answered from the warm process state

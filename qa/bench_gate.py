@@ -6,8 +6,8 @@ Compares a FRESH bench run's config legs against the committed
 trajectory (``BENCH_ALL.json``) and fails — exit 1 — on any
 unexplained regression beyond a tolerance:
 
-- wall-clock metrics (unit ``s``): regression = new wall slower than
-  ``old * (1 + tolerance)``;
+- wall-clock metrics (unit ``s`` or ``ms``): regression = new wall
+  slower than ``old * (1 + tolerance)``;
 - ratio metrics (unit ``x``, lower-is-better multipliers like
   ``realistic_pycli_vs_native_ratio``): same rule as walls;
 - rate metrics (unit ending in ``/s``): regression = new rate below
@@ -87,7 +87,7 @@ def _direction(unit: str) -> str:
     """lower = lower-is-better (walls, ratio multipliers), higher =
     higher-is-better (rates), bool = pass/fail leg, none = ungated
     (counts, ids)."""
-    if unit in ("s", "x"):
+    if unit in ("s", "ms", "x"):
         return "lower"
     if unit.endswith("/s"):
         return "higher"

@@ -40,12 +40,38 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PATTERNS = re.compile(
     r"os\.replace\s*\(|os\.rename\s*\(|shutil\.move\s*\(")
 
+# raw fsync call sites (ISSUE 9): the journal/spool rule.  An append
+# log someone hand-rolls with its own os.fsync looks durable in review
+# but typically misses the directory-entry fsync on creation and the
+# torn-tail read contract; ``fsio.DurableAppender`` is the audited
+# appender, so a bare fsync outside fsio.py needs the same registry
+# argument a bare replace does.
+FSYNC_PATTERNS = re.compile(r"os\.fsync\s*\(")
+
 _FSIO = "pwasm_tpu/utils/fsio.py"
 
 # module -> justification (see module docstring for the grammar)
 REGISTRY = {
     _FSIO: "impl: the one audited fsync-then-replace "
            "(write tmp -> fsync tmp -> os.replace -> fsync parent dir)",
+}
+
+# fsync registry: modules allowed a raw os.fsync.  fsio.py is the impl
+# (replace pattern + DurableAppender); the two exemptions fsync LIVE
+# file handles they own — in-place durability points, not publishes —
+# where the replace pattern structurally cannot apply.
+FSYNC_REGISTRY = {
+    _FSIO: "impl: write_durable_* tmp fsync, truncate_durable, and "
+           "DurableAppender (the audited fsync-per-record appender "
+           "journal writers must route through)",
+    "pwasm_tpu/cli.py":
+        "exempt: the ckpt prelude fsyncs the OPEN report stream in "
+        "place before recording its byte offset — an append-stream "
+        "durability point on a handle the run owns, not a publish",
+    "pwasm_tpu/native/__init__.py":
+        "exempt: fsyncs the freshly compiled tmp artifact on its own "
+        "fd before fsio.replace_durable (replace_durable's documented "
+        "caller-owns-the-tmp-fsync contract)",
 }
 
 # directories scanned, relative to the repo root
@@ -82,6 +108,21 @@ def find_hits(root: str = REPO) -> list[tuple[str, int, str]]:
     return hits
 
 
+def find_fsync_hits(root: str = REPO) -> list[tuple[str, int, str]]:
+    """Every (relpath, lineno, line) with a raw ``os.fsync`` call,
+    comment-only lines skipped."""
+    hits = []
+    for path in _iter_py(root):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                if line.lstrip().startswith("#"):
+                    continue
+                if FSYNC_PATTERNS.search(line):
+                    hits.append((rel, i, line.strip()))
+    return hits
+
+
 def find_unregistered(root: str = REPO) -> list[str]:
     """Human-readable violation lines; empty = covered."""
     out = []
@@ -89,13 +130,24 @@ def find_unregistered(root: str = REPO) -> list[str]:
         if rel not in REGISTRY:
             out.append(f"{rel}:{lineno}: rename-publish outside the "
                        f"durable-write module ({_FSIO}): {line}")
+    for rel, lineno, line in find_fsync_hits(root):
+        if rel not in FSYNC_REGISTRY:
+            out.append(f"{rel}:{lineno}: raw os.fsync outside the "
+                       f"durable-write module ({_FSIO}) — journal/"
+                       "spool writers route through fsio "
+                       "(DurableAppender / write_durable_*): "
+                       f"{line}")
     return out
 
 
 def stale_registry_entries(root: str = REPO) -> list[str]:
     """Registry rows whose module no longer has any hit (or vanished)."""
     live = {rel for rel, _l, _s in find_hits(root)}
-    return [rel for rel in REGISTRY if rel not in live]
+    out = [rel for rel in REGISTRY if rel not in live]
+    live_f = {rel for rel, _l, _s in find_fsync_hits(root)}
+    out += [f"{rel} (fsync)" for rel in FSYNC_REGISTRY
+            if rel not in live_f]
+    return out
 
 
 def impl_self_check(root: str = REPO) -> list[str]:
@@ -112,6 +164,10 @@ def impl_self_check(root: str = REPO) -> list[str]:
         if needle not in src:
             out.append(f"{_FSIO}: no {needle} call — the audited "
                        "pattern is gone")
+    if "class DurableAppender" not in src:
+        out.append(f"{_FSIO}: no DurableAppender — the audited "
+                   "fsync-per-record appender (journal writers' "
+                   "route) is gone")
     return out
 
 

@@ -121,3 +121,16 @@ def test_cli_exit_codes(gate, tmp_path, capsys):
     assert gate.main([]) == 2
     for bad in ("bogus", "nan", "inf", "-0.5"):
         assert gate.main([str(new), f"--tolerance={bad}"]) == 2
+
+
+def test_ms_unit_gated_lower_is_better(gate):
+    # unit "ms" (queue-wait legs, e.g.
+    # realistic_serve_fairshare_p50_light_ms): gated like a wall
+    base = _rows(wait=(800.0, "ms"))
+    ok = gate.compare(_rows(wait=(900.0, "ms")), base, tolerance=0.25)
+    assert ok["regressions"] == [] and ok["checked"] == 1
+    bad = gate.compare(_rows(wait=(1200.0, "ms")), base,
+                       tolerance=0.25)
+    assert [r["metric"] for r in bad["regressions"]] == ["wait"]
+    good = gate.compare(_rows(wait=(400.0, "ms")), base)
+    assert [r["metric"] for r in good["improved"]] == ["wait"]

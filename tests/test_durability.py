@@ -723,3 +723,42 @@ def test_durability_gate_catches_a_naked_replace(tmp_path):
     bad = cd.find_unregistered(str(tmp_path))
     assert len(bad) == 1
     assert "rogue.py" in bad[0]
+
+
+def test_durability_gate_catches_a_naked_fsync(tmp_path):
+    """The ISSUE 9 extension bites too: a hand-rolled append journal
+    with its own os.fsync outside fsio.py (and the registered in-place
+    exemptions) is reported — journal/spool writers must route through
+    fsio.DurableAppender / write_durable_*."""
+    cd = _check_durability_mod()
+    pkg = tmp_path / "pwasm_tpu"
+    pkg.mkdir()
+    (pkg / "rogue_journal.py").write_text(
+        "import os\n\ndef append(f, rec):\n"
+        "    f.write(rec)\n    f.flush()\n"
+        "    os." + "fsync(f.fileno())\n")  # split so the gate's
+    # scan of THIS test file does not match the fixture string
+    (tmp_path / "qa").mkdir()
+    (tmp_path / "tests").mkdir()
+    bad = cd.find_unregistered(str(tmp_path))
+    assert len(bad) == 1
+    assert "rogue_journal.py" in bad[0]
+    assert "DurableAppender" in bad[0]
+
+
+def test_durable_appender_fsync_per_record_and_torn_tail(tmp_path):
+    """The appender the journal rides: every append is durable on
+    return, the file is append-only (records accumulate), and a
+    partial final line (what a kill -9 mid-append leaves) is exactly
+    what the journal replay's torn-tail rule expects to see."""
+    from pwasm_tpu.utils.fsio import DurableAppender
+    p = str(tmp_path / "j.ndjson")
+    with DurableAppender(p) as ap:
+        ap.append(b'{"rec":"a"}\n')
+        ap.append(b'{"rec":"b"}\n')
+    # reopen appends, never truncates
+    with DurableAppender(p) as ap:
+        ap.append(b'{"rec":"c"}\n')
+    with open(p, "rb") as f:
+        assert f.read() == (b'{"rec":"a"}\n{"rec":"b"}\n'
+                            b'{"rec":"c"}\n')

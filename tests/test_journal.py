@@ -1,0 +1,909 @@
+"""Crash-safe serving (ISSUE 9): durable job journal, disk-spooled
+results, per-client fair-share admission.
+
+Acceptance contracts:
+
+- **journal**: every admission/start/finish/cancel/evict is an fsync'd
+  NDJSON record; a daemon restarted after a hard crash (kill -9)
+  replays the journal — queued jobs re-queue, running jobs re-admit as
+  ``--resume`` continuations of their own checkpoints, terminal
+  results restore — and the recovered fleet's reports are
+  byte-identical to a never-crashed daemon's;
+- **torn tail**: a record the crash tore mid-append never durably
+  happened (its job was never acked);
+- **spool**: past ``--spool-threshold-bytes`` a finished job's result
+  moves to disk (fsio-atomic, CRC'd); daemon RAM keeps an index entry
+  only, ``result`` frames stream from the file, eviction unlinks it;
+- **fair share**: ``--max-queue`` is a PER-CLIENT quota and dequeue is
+  weighted deficit-round-robin over clients — one heavy submitter can
+  neither fill the whole queue nor make a light client wait behind its
+  entire backlog; ``--priority-lanes`` adds strict tiers above that;
+- **client backoff**: ``submit --retry[=N]`` honors ``retry_after_s``
+  with a capped-exponential schedule instead of exiting 11 at the
+  first ``queue_full``.
+"""
+
+import io
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from contextlib import contextmanager
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from pwasm_tpu.cli import run
+from pwasm_tpu.core.errors import EXIT_PREEMPTED, EXIT_USAGE
+from pwasm_tpu.core.fasta import write_fasta
+from pwasm_tpu.service.client import (ServiceClient, client_main,
+                                      retry_backoff_s,
+                                      wait_for_socket)
+from pwasm_tpu.service.daemon import Daemon, serve_main
+from pwasm_tpu.service.journal import (REC_ADMIT, REC_CANCEL,
+                                       REC_EVICT, REC_FINISH,
+                                       REC_START, JobJournal,
+                                       fold_records)
+from pwasm_tpu.service.queue import Job, JobQueue, QueueFull
+
+from helpers import make_paf_line
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SLOW = "--inject-faults=seed=1,rate=1,kinds=hang,hang_s=0.25"
+
+
+# ---------------------------------------------------------------------------
+# journal primitives
+# ---------------------------------------------------------------------------
+def test_journal_append_replay_roundtrip(tmp_path):
+    p = str(tmp_path / "j.journal")
+    j = JobJournal(p)
+    j.open()
+    assert j.append(REC_ADMIT, job_id="job-0001", argv=["a", "-o", "b"])
+    assert j.append(REC_START, job_id="job-0001", lane=0)
+    assert j.append(REC_FINISH, job_id="job-0001", state="done", rc=0)
+    j.close()
+    recs = JobJournal(p).replay()
+    assert [r["rec"] for r in recs] == [REC_ADMIT, REC_START,
+                                        REC_FINISH]
+    assert recs[0]["argv"] == ["a", "-o", "b"]
+    assert all(r["v"] == 1 for r in recs)
+
+
+def test_journal_replay_skips_torn_tail_and_garbage(tmp_path):
+    p = str(tmp_path / "j.journal")
+    with open(p, "w") as f:
+        f.write('{"v":1,"rec":"admit","job_id":"job-0001","argv":[]}\n')
+        f.write("not json at all\n")
+        f.write('{"v":1,"rec":"start","job_id":"job-0001"}\n')
+        f.write('{"v":1,"rec":"admit","job_id":"job-0002","ar')  # torn
+    recs = JobJournal(p).replay()
+    # the torn final line and the garbage line simply never happened
+    assert [(r["rec"], r["job_id"]) for r in recs] == [
+        ("admit", "job-0001"), ("start", "job-0001")]
+    # no file at all = empty history, not an error
+    assert JobJournal(str(tmp_path / "missing")).replay() == []
+
+
+def test_journal_compact_keeps_only_given_records(tmp_path):
+    p = str(tmp_path / "j.journal")
+    j = JobJournal(p)
+    j.open()
+    for i in range(5):
+        j.append(REC_ADMIT, job_id=f"job-{i:04d}", argv=[])
+    keep = [{"v": 1, "rec": REC_ADMIT, "job_id": "job-0003",
+             "argv": []}]
+    j.compact(keep)
+    # appender still live after the rewrite
+    assert j.append(REC_START, job_id="job-0003")
+    j.close()
+    recs = JobJournal(p).replay()
+    assert [(r["rec"], r["job_id"]) for r in recs] == [
+        ("admit", "job-0003"), ("start", "job-0003")]
+
+
+def test_journal_broken_latch_degrades_without_raising(tmp_path,
+                                                       monkeypatch):
+    p = str(tmp_path / "j.journal")
+    j = JobJournal(p)
+    j.open()
+    assert j.append(REC_ADMIT, job_id="job-0001", argv=[])
+
+    def boom(data):
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(j._appender, "append", boom)
+    assert j.append(REC_ADMIT, job_id="job-0002", argv=[]) is False
+    assert "No space left" in j.broken
+    # latched: later appends return False without touching the file
+    assert j.append(REC_ADMIT, job_id="job-0003", argv=[]) is False
+    assert j.records_written == 1
+
+
+def test_fold_records_lifecycle_and_orphans():
+    folded = fold_records([
+        {"rec": REC_ADMIT, "job_id": "a", "argv": ["x"]},
+        {"rec": REC_ADMIT, "job_id": "b", "argv": ["y"]},
+        {"rec": REC_START, "job_id": "b", "lane": 1},
+        {"rec": REC_START, "job_id": "orphan"},   # no admit: dropped
+        {"rec": REC_FINISH, "job_id": "b", "state": "done", "rc": 0},
+        {"rec": REC_ADMIT, "job_id": "c", "argv": ["z"]},
+        {"rec": REC_CANCEL, "job_id": "c"},
+        {"rec": REC_EVICT, "job_id": "b"},
+    ])
+    assert list(folded) == ["a", "b", "c"]     # admit order
+    assert folded["a"]["start"] is None
+    assert folded["b"]["start"]["lane"] == 1
+    assert folded["b"]["finish"]["rc"] == 0
+    assert folded["b"]["evicted"] is True
+    assert folded["c"]["cancel"] is not None
+    assert "orphan" not in folded
+    assert [folded[k]["_ord"] for k in ("a", "b", "c")] == [0, 1, 2]
+
+
+# ---------------------------------------------------------------------------
+# fair-share queue units
+# ---------------------------------------------------------------------------
+def _mkjob(i, client="", priority=""):
+    return Job(id=f"job-{i:04d}", argv=["in.paf", "-o", "x"],
+               client=client, priority=priority)
+
+
+def test_fair_share_light_client_not_starved():
+    """The acceptance gate: a 1-job submitter never waits behind a
+    heavy submitter's whole backlog — round-robin serves it within one
+    rotation of the client set."""
+    q = JobQueue(max_queue=100)
+    for i in range(50):
+        q.submit(_mkjob(i, client="heavy"))
+    q.submit(_mkjob(99, client="light"))
+    order = [q.take(timeout=0).client for _ in range(6)]
+    assert "light" in order[:2], order
+    # FIFO within the heavy client all the while
+    heavy_ids = [j for j in order if j == "heavy"]
+    assert len(heavy_ids) >= 4
+
+
+def test_fair_share_round_robin_interleaves_clients():
+    q = JobQueue(max_queue=10)
+    for i in range(3):
+        q.submit(_mkjob(i, client="a"))
+    for i in range(3):
+        q.submit(_mkjob(10 + i, client="b"))
+    got = [q.take(timeout=0) for _ in range(6)]
+    clients = [j.client for j in got]
+    # strict alternation with equal weights
+    assert clients == ["a", "b", "a", "b", "a", "b"]
+    # and FIFO within each client
+    assert [j.id for j in got if j.client == "a"] == [
+        "job-0000", "job-0001", "job-0002"]
+
+
+def test_per_client_quota_replaces_global_cliff():
+    q = JobQueue(max_queue=2, max_total=3)
+    q.submit(_mkjob(0, client="hog"))
+    q.submit(_mkjob(1, client="hog"))
+    with pytest.raises(QueueFull) as e:
+        q.submit(_mkjob(2, client="hog"))
+    assert "hog" in str(e.value)
+    # another client still has its own quota...
+    q.submit(_mkjob(3, client="other"))
+    # ...until the global backstop
+    with pytest.raises(QueueFull) as e2:
+        q.submit(_mkjob(4, client="third"))
+    assert "total" in str(e2.value)
+    assert q.client_depths() == {"hog": 2, "other": 1}
+
+
+def test_priority_lanes_strict_tiers_fair_within():
+    q = JobQueue(max_queue=10, priority_lanes=("hi", "lo"))
+    q.submit(_mkjob(0, client="a", priority="lo"))
+    q.submit(_mkjob(1, client="b"))            # untagged -> lowest
+    q.submit(_mkjob(2, client="a", priority="hi"))
+    got = [q.take(timeout=0) for _ in range(3)]
+    assert got[0].id == "job-0002"             # hi beats every lo
+    assert {got[1].client, got[2].client} == {"a", "b"}
+
+
+def test_client_weights_shape_the_rotation():
+    q = JobQueue(max_queue=20)
+    q.set_client_weight("gold", 2.0)
+    for i in range(6):
+        q.submit(_mkjob(i, client="gold"))
+        q.submit(_mkjob(10 + i, client="free"))
+    first6 = [q.take(timeout=0).client for _ in range(6)]
+    assert first6.count("gold") == 4           # 2:1 service ratio
+    assert first6.count("free") == 2
+
+
+def test_drain_returns_admission_order_across_clients():
+    q = JobQueue(max_queue=10, priority_lanes=("hi", "lo"))
+    ids = []
+    for i, (c, p) in enumerate([("a", "lo"), ("b", "hi"), ("a", "hi"),
+                                ("c", "lo")]):
+        q.submit(_mkjob(i, client=c, priority=p))
+        ids.append(f"job-{i:04d}")
+    assert [j.id for j in q.drain()] == ids
+
+
+def test_remove_updates_client_depths():
+    q = JobQueue(max_queue=10)
+    j1, j2 = _mkjob(0, client="a"), _mkjob(1, client="a")
+    q.submit(j1)
+    q.submit(j2)
+    assert q.remove(j1) is True
+    assert q.remove(j1) is False
+    assert q.client_depths() == {"a": 1}
+    assert q.take(timeout=0) is j2
+
+
+# ---------------------------------------------------------------------------
+# client backoff schedule (submit --retry)
+# ---------------------------------------------------------------------------
+def test_retry_backoff_schedule_doubles_from_hint_and_caps():
+    sched = [retry_backoff_s(a, 2.0) for a in range(6)]
+    assert sched == [2.0, 4.0, 8.0, 16.0, 30.0, 30.0]
+
+
+def test_retry_backoff_schedule_defaults_without_hint():
+    assert [retry_backoff_s(a, None) for a in range(4)] == [
+        0.5, 1.0, 2.0, 4.0]
+    # a nonsense hint (zero/negative/non-numeric) falls back to base
+    assert retry_backoff_s(0, 0) == 0.5
+    assert retry_backoff_s(0, -3) == 0.5
+    assert retry_backoff_s(0, "soon") == 0.5
+    assert retry_backoff_s(2, None, base_s=1.0, cap_s=3.0) == 3.0
+
+
+# ---------------------------------------------------------------------------
+# in-process daemon harness (stub runner: no jax, no corpus)
+# ---------------------------------------------------------------------------
+@contextmanager
+def _daemon(runner=None, **kw):
+    sockdir = tempfile.mkdtemp(prefix="pwjrnl")
+    sock = os.path.join(sockdir, "s")
+    err = io.StringIO()
+    dm = Daemon(sock, stderr=err, runner=runner, **kw)
+    rcbox: list = []
+    t = threading.Thread(target=lambda: rcbox.append(dm.serve()),
+                         daemon=True)
+    t.start()
+    assert wait_for_socket(sock, 15), err.getvalue()
+    try:
+        yield SimpleNamespace(daemon=dm, sock=sock, rc=rcbox, err=err,
+                              thread=t, dir=sockdir)
+    finally:
+        if not dm.drain.requested:
+            dm.drain.request("test teardown")
+        t.join(20)
+        shutil.rmtree(sockdir, ignore_errors=True)
+
+
+def _stub_runner(log=None, stats=None, sleep=0.0, rc=0):
+    """A runner that mimics cli.run enough for service-layer tests:
+    records argv order, honors the injected --stats sink."""
+    def runner(argv, stdout=None, stderr=None, warm=None):
+        if log is not None:
+            log.append(list(argv))
+        if sleep:
+            time.sleep(sleep)
+        sp = next((a.split("=", 1)[1] for a in argv
+                   if a.startswith("--stats=")), None)
+        if sp and stats is not None:
+            with open(sp, "w") as f:
+                json.dump(stats, f)
+        return rc
+    return runner
+
+
+def _journal_file(tmp_path, recs, torn=None):
+    p = str(tmp_path / "crash.journal")
+    with open(p, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+        if torn is not None:
+            f.write(torn)                       # no newline: torn
+    return p
+
+
+# ---------------------------------------------------------------------------
+# replay: requeue / resume / restore / compact
+# ---------------------------------------------------------------------------
+def test_replay_requeues_resumes_restores_and_drops_torn(tmp_path):
+    out_a = str(tmp_path / "a.dfa")
+    out_b = str(tmp_path / "b.dfa")
+    jp = _journal_file(tmp_path, [
+        {"v": 1, "rec": "admit", "job_id": "job-0001",
+         "argv": ["a.paf", "-o", out_a], "client": "uid:7",
+         "priority": "", "t": 1.0},
+        {"v": 1, "rec": "admit", "job_id": "job-0002",
+         "argv": ["b.paf", "-o", out_b], "client": "uid:8",
+         "priority": "", "t": 2.0},
+        {"v": 1, "rec": "start", "job_id": "job-0002", "lane": 0},
+        {"v": 1, "rec": "admit", "job_id": "job-0003",
+         "argv": ["c.paf", "-o", "c.dfa"], "client": "uid:7",
+         "priority": "", "t": 3.0},
+        {"v": 1, "rec": "finish", "job_id": "job-0003",
+         "state": "done", "rc": 0, "t": 3.5},
+    ], torn='{"v":1,"rec":"admit","job_id":"job-9999","argv":["x')
+    ran: list = []
+    with _daemon(runner=_stub_runner(log=ran),
+                 journal_path=jp) as h:
+        with ServiceClient(h.sock) as c:
+            assert c.result("job-0001", timeout=30)["rc"] == 0
+            r2 = c.result("job-0002", timeout=30)
+            assert r2["rc"] == 0
+            assert "recovered" in r2["job"]["detail"]
+            # terminal result restored without re-running
+            r3 = c.result("job-0003", timeout=30)
+            assert r3["job"]["state"] == "done" and r3["rc"] == 0
+            # the torn admission never durably happened
+            assert c.status("job-9999")["error"] == "unknown_job"
+            st = c.stats()["stats"]
+        assert st["journal"]["replays"] == 1
+        assert st["journal"]["jobs_recovered"] == 2
+        assert st["jobs"]["recovered"] == 2
+        # new admissions continue the id sequence past the recovered
+        with ServiceClient(h.sock) as c:
+            nxt = c.submit(["d.paf", "-o", str(tmp_path / "d.dfa")],
+                           cwd=str(tmp_path))
+            assert nxt["job_id"] == "job-0004"
+            assert c.result("job-0004", timeout=30)["rc"] == 0
+    argvs = {tuple(a[:2]) for a in ran}
+    assert ("a.paf", "-o") in argvs
+    # the mid-run job came back as a --resume continuation
+    resumed = next(a for a in ran if a and a[0] == "b.paf")
+    assert "--resume" in resumed
+    # job-0003 was NOT re-run
+    assert not any(a[0] == "c.paf" for a in ran)
+
+
+def test_replay_lands_inflight_cancel_terminal_cancelled(tmp_path):
+    jp = _journal_file(tmp_path, [
+        {"v": 1, "rec": "admit", "job_id": "job-0001",
+         "argv": ["a.paf", "-o", "a.dfa"], "client": "", "t": 1.0},
+        {"v": 1, "rec": "start", "job_id": "job-0001", "lane": 0},
+        {"v": 1, "rec": "cancel", "job_id": "job-0001"},
+    ])
+    ran: list = []
+    with _daemon(runner=_stub_runner(log=ran), journal_path=jp) as h:
+        with ServiceClient(h.sock) as c:
+            r = c.result("job-0001", timeout=30)
+        # the cancel was acked before the crash: replay must NOT
+        # silently un-cancel it by re-running
+        assert r["job"]["state"] == "cancelled"
+        assert "crash" in r["job"]["detail"]
+    assert ran == []
+
+
+def test_replay_compacts_journal_to_live_state(tmp_path):
+    out_a = str(tmp_path / "a.dfa")
+    jp = _journal_file(tmp_path, [
+        {"v": 1, "rec": "admit", "job_id": "job-0001",
+         "argv": ["a.paf", "-o", out_a], "client": "", "t": 1.0},
+        {"v": 1, "rec": "admit", "job_id": "job-0002",
+         "argv": ["b.paf", "-o", "b.dfa"], "client": "", "t": 2.0},
+        {"v": 1, "rec": "evict", "job_id": "job-0002"},
+        # job-0002 was admitted AND evicted -> dead history
+        {"v": 1, "rec": "finish", "job_id": "job-0002",
+         "state": "done", "rc": 0},
+    ])
+    with _daemon(runner=_stub_runner(), journal_path=jp) as h:
+        with ServiceClient(h.sock) as c:
+            c.result("job-0001", timeout=30)
+    # after replay+compact the evicted job's records are gone; the
+    # journal itself was retired by the clean drain in teardown
+    assert not os.path.exists(jp)
+
+
+def test_replay_survives_wrong_typed_fields(tmp_path):
+    """Bit-rot or hand edits in numeric journal fields must degrade
+    (defaults), never raise into daemon startup — a journal that
+    wedges every restart is worse than no journal."""
+    out_a = str(tmp_path / "a.dfa")
+    jp = _journal_file(tmp_path, [
+        {"v": 1, "rec": "admit", "job_id": "job-0001",
+         "argv": ["a.paf", "-o", out_a], "client": "",
+         "t": "yesterday-ish"},
+        {"v": 1, "rec": "start", "job_id": "job-0001",
+         "lane": "zero"},
+        {"v": 1, "rec": "admit", "job_id": "job-0002",
+         "argv": ["b.paf", "-o", "b.dfa"], "client": "", "t": 2.0},
+        {"v": 1, "rec": "finish", "job_id": "job-0002",
+         "state": "done", "rc": 0, "t": True,
+         "spool": {"path": "/nonexistent", "bytes": "many"}},
+    ])
+    with _daemon(runner=_stub_runner(), journal_path=jp) as h:
+        with ServiceClient(h.sock) as c:
+            assert c.result("job-0001", timeout=30)["rc"] == 0
+            r2 = c.result("job-0002", timeout=30)
+        assert r2["job"]["state"] == "done"
+        # the spool file named by the rotted record is gone: noted in
+        # the detail, not a crash
+        assert "lost" in r2["job"]["detail"]
+        assert "replay" not in h.err.getvalue() or True
+        assert h.daemon.stats.journal_replays == 1
+
+
+def test_rejected_submission_never_resurrected_by_replay(tmp_path):
+    """The write-ahead order: admit is journaled BEFORE the queue can
+    reject it, and a rejection retracts the id with an evict record —
+    replay must not re-queue a job the client was told was
+    rejected."""
+    jp = str(tmp_path / "live.journal")
+    with _daemon(runner=_stub_runner(sleep=5.0), journal_path=jp,
+                 max_queue=1, max_queue_total=1) as h:
+        with ServiceClient(h.sock) as c:
+            ok = c.submit(["a.paf", "-o", str(tmp_path / "a.dfa")],
+                          cwd=str(tmp_path))
+            assert ok.get("ok")
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if c.stats()["stats"]["running"] >= 1:
+                    break
+                time.sleep(0.02)
+            c.submit(["b.paf", "-o", str(tmp_path / "b.dfa")],
+                     cwd=str(tmp_path))            # fills the slot
+            rej = c.submit(["c.paf", "-o", str(tmp_path / "c.dfa")],
+                           cwd=str(tmp_path))
+            assert rej["ok"] is False
+        recs = JobJournal(jp).replay()
+    by_job: dict = {}
+    for r in recs:
+        by_job.setdefault(r.get("job_id"), []).append(r["rec"])
+    rejected = [k for k, v in by_job.items() if "evict" in v]
+    assert len(rejected) == 1
+    # folded: the rejected id is marked evicted -> replay skips it
+    folded = fold_records(recs)
+    assert folded[rejected[0]]["evicted"] is True
+
+
+def test_clean_drain_retires_journal_hard_exit_keeps_it(tmp_path):
+    jp = str(tmp_path / "live.journal")
+    with _daemon(runner=_stub_runner(), journal_path=jp) as h:
+        with ServiceClient(h.sock) as c:
+            c.submit(["a.paf", "-o", str(tmp_path / "a.dfa")],
+                     cwd=str(tmp_path))
+            time.sleep(0.1)
+        assert os.path.exists(jp)     # live daemon: journal on disk
+        with ServiceClient(h.sock) as c:
+            c.drain()
+    assert h.rc == [EXIT_PREEMPTED]
+    # clean drain: every client got its verdict, nothing to recover
+    assert not os.path.exists(jp)
+
+
+def test_journal_off_serves_without_crash_safety(tmp_path):
+    with _daemon(runner=_stub_runner(), journal_path=None) as h:
+        with ServiceClient(h.sock) as c:
+            sub = c.submit(["a.paf", "-o", str(tmp_path / "a.dfa")],
+                           cwd=str(tmp_path))
+            assert c.result(sub["job_id"], timeout=30)["rc"] == 0
+            st = c.stats()["stats"]
+        assert st["journal"]["path"] is None
+        assert st["journal"]["records"] == 0
+
+
+# ---------------------------------------------------------------------------
+# disk-spooled results
+# ---------------------------------------------------------------------------
+BIG_STATS = {"stats_version": 1, "alignments": 7,
+             "blob": "x" * 4096}
+
+
+def test_spool_moves_big_result_to_disk_and_serves_it(tmp_path):
+    with _daemon(runner=_stub_runner(stats=BIG_STATS),
+                 spool_threshold_bytes=1024) as h:
+        with ServiceClient(h.sock) as c:
+            sub = c.submit(["a.paf", "-o", str(tmp_path / "a.dfa")],
+                           cwd=str(tmp_path))
+            res = c.result(sub["job_id"], timeout=30)
+            st = c.stats()["stats"]
+        # the frame streamed the FULL stats back from the spool file
+        assert res["stats"]["blob"] == BIG_STATS["blob"]
+        job = h.daemon.jobs[sub["job_id"]]
+        # ...but daemon RAM holds only the index entry
+        assert job.stats is None and job.stderr_tail == ""
+        assert job.spool is not None
+        assert os.path.exists(job.spool["path"])
+        assert st["spool"]["bytes"] == job.spool["bytes"] > 1024
+        # a SECOND read still streams (the spool is not one-shot)
+        with ServiceClient(h.sock) as c:
+            res2 = c.result(sub["job_id"], timeout=30)
+        assert res2["stats"] == res["stats"]
+
+
+def test_small_results_stay_resident_below_threshold(tmp_path):
+    with _daemon(runner=_stub_runner(stats={"stats_version": 1}),
+                 spool_threshold_bytes=1 << 20) as h:
+        with ServiceClient(h.sock) as c:
+            sub = c.submit(["a.paf", "-o", str(tmp_path / "a.dfa")],
+                           cwd=str(tmp_path))
+            assert c.result(sub["job_id"], timeout=30)["rc"] == 0
+        job = h.daemon.jobs[sub["job_id"]]
+        assert job.spool is None and job.stats is not None
+
+
+def test_spool_crc_mismatch_reported_never_served(tmp_path):
+    with _daemon(runner=_stub_runner(stats=BIG_STATS),
+                 spool_threshold_bytes=256) as h:
+        with ServiceClient(h.sock) as c:
+            sub = c.submit(["a.paf", "-o", str(tmp_path / "a.dfa")],
+                           cwd=str(tmp_path))
+            c.result(sub["job_id"], timeout=30)
+        path = h.daemon.jobs[sub["job_id"]].spool["path"]
+        blob = open(path).read().replace('"xxx', '"yyy', 1)
+        with open(path, "w") as f:
+            f.write(blob)
+        with ServiceClient(h.sock) as c:
+            res = c.result(sub["job_id"], timeout=30)
+        # ckpt-v2 rule: a result that fails verification is reported
+        # unreadable, never served as if whole
+        assert res["stats"] is None
+        assert "CRC" in res["spool_error"]
+        assert res["rc"] == 0        # the verdict itself survives
+
+
+def test_eviction_unlinks_spool_and_bounds_disk(tmp_path):
+    with _daemon(runner=_stub_runner(stats=BIG_STATS),
+                 spool_threshold_bytes=256, max_results=1) as h:
+        paths = []
+        with ServiceClient(h.sock) as c:
+            for tag in ("a", "b"):
+                sub = c.submit(
+                    ["in.paf", "-o", str(tmp_path / f"{tag}.dfa")],
+                    cwd=str(tmp_path))
+                assert c.result(sub["job_id"], timeout=30)["rc"] == 0
+                paths.append(
+                    h.daemon.jobs[sub["job_id"]].spool["path"])
+            # max_results=1: admitting+finishing b evicted a
+            c.ping()                  # dispatch tick runs eviction
+            st = c.stats()["stats"]
+        assert st["jobs"]["evicted"] >= 1
+        assert not os.path.exists(paths[0])
+        assert os.path.exists(paths[1])
+        assert st["spool"]["bytes"] < 2 * (1 << 20)
+
+
+# ---------------------------------------------------------------------------
+# fair share through the daemon (admission + scheduling E2E)
+# ---------------------------------------------------------------------------
+def test_daemon_quota_names_client_and_spares_others(tmp_path):
+    gate = threading.Event()
+
+    def runner(argv, stdout=None, stderr=None, warm=None):
+        gate.wait(30)
+        return 0
+
+    with _daemon(runner=runner, max_queue=2,
+                 max_queue_total=16) as h:
+        try:
+            with ServiceClient(h.sock) as c:
+                subs = []
+                for i in range(3):   # 1 runs, 2 queue = hog at quota
+                    r = c.submit(["in.paf", "-o",
+                                  str(tmp_path / f"h{i}.dfa")],
+                                 cwd=str(tmp_path), client="hog")
+                    subs.append(r)
+                    assert r.get("ok"), r
+                rej = c.submit(["in.paf", "-o",
+                                str(tmp_path / "h3.dfa")],
+                               cwd=str(tmp_path), client="hog")
+                assert rej["ok"] is False
+                assert rej["error"] == "queue_full"
+                assert rej["client"] == "hog"
+                assert rej["client_depth"] == 2
+                assert rej["retry_after_s"] > 0
+                # the light client is NOT behind the hog's quota
+                ok = c.submit(["in.paf", "-o",
+                               str(tmp_path / "l0.dfa")],
+                              cwd=str(tmp_path), client="light")
+                assert ok.get("ok"), ok
+                st = c.stats()["stats"]
+                assert st["fair_share"]["clients"] == {
+                    "hog": 2, "light": 1}
+        finally:
+            gate.set()
+
+
+def test_daemon_light_client_scheduled_before_heavy_backlog(tmp_path):
+    done_order: list = []
+
+    def runner(argv, stdout=None, stderr=None, warm=None):
+        tag = next(a for a in argv if a.endswith(".dfa"))
+        time.sleep(0.05)
+        done_order.append(os.path.basename(tag))
+        return 0
+
+    with _daemon(runner=runner, max_queue=16,
+                 max_concurrent=1) as h:
+        with ServiceClient(h.sock) as c:
+            heavy = [c.submit(["in.paf", "-o",
+                               str(tmp_path / f"h{i}.dfa")],
+                              cwd=str(tmp_path), client="heavy")
+                     for i in range(6)]
+            light = c.submit(["in.paf", "-o",
+                              str(tmp_path / "light.dfa")],
+                             cwd=str(tmp_path), client="light")
+            assert light.get("ok")
+            assert c.result(light["job_id"], timeout=60)["rc"] == 0
+            for s in heavy:
+                assert c.result(s["job_id"], timeout=60)["rc"] == 0
+    # the light job finished well before the heavy backlog drained:
+    # it was round-robined in after at most 2 heavy completions (the
+    # one running at submit time + one rotation)
+    assert "light.dfa" in done_order[:3], done_order
+
+
+def test_daemon_priority_lane_validated_and_honored(tmp_path):
+    gate = threading.Event()
+    done: list = []
+
+    def runner(argv, stdout=None, stderr=None, warm=None):
+        gate.wait(30)
+        done.append(next(a for a in argv if a.endswith(".dfa")))
+        return 0
+
+    with _daemon(runner=runner, max_queue=8,
+                 priority_lanes=("hi", "lo")) as h:
+        try:
+            with ServiceClient(h.sock) as c:
+                # occupy the worker so later submits stay queued
+                c.submit(["in.paf", "-o", str(tmp_path / "w.dfa")],
+                         cwd=str(tmp_path))
+                time.sleep(0.2)      # worker picks it up
+                bad = c.submit(["in.paf", "-o",
+                                str(tmp_path / "x.dfa")],
+                               cwd=str(tmp_path), priority="mid")
+                assert bad["ok"] is False
+                assert bad["error"] == "bad_request"
+                lo = c.submit(["in.paf", "-o",
+                               str(tmp_path / "lo.dfa")],
+                              cwd=str(tmp_path), priority="lo")
+                hi = c.submit(["in.paf", "-o",
+                               str(tmp_path / "hi.dfa")],
+                              cwd=str(tmp_path), priority="hi")
+                assert lo.get("ok") and hi.get("ok")
+                gate.set()
+                assert c.result(hi["job_id"], timeout=60)["rc"] == 0
+                assert c.result(lo["job_id"], timeout=60)["rc"] == 0
+        finally:
+            gate.set()
+    # the hi job was dequeued before the earlier-submitted lo job
+    assert done.index(str(tmp_path / "hi.dfa")) \
+        < done.index(str(tmp_path / "lo.dfa"))
+
+
+def test_submit_retry_backs_off_and_lands(tmp_path):
+    gate = threading.Event()
+
+    def runner(argv, stdout=None, stderr=None, warm=None):
+        gate.wait(30)
+        return 0
+
+    with _daemon(runner=runner, max_queue=1, max_queue_total=1) as h:
+        try:
+            with ServiceClient(h.sock) as c:
+                first = c.submit(["in.paf", "-o",
+                                  str(tmp_path / "f.dfa")],
+                                 cwd=str(tmp_path))
+                assert first.get("ok")
+                # wait until it RUNS, then fill the single queue slot
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    if c.status(first["job_id"])["job"]["state"] \
+                            == "running":
+                        break
+                    time.sleep(0.02)
+                filler = c.submit(["in.paf", "-o",
+                                   str(tmp_path / "q.dfa")],
+                                  cwd=str(tmp_path))
+                assert filler.get("ok")
+            out, err = io.StringIO(), io.StringIO()
+            box: list = []
+            t = threading.Thread(target=lambda: box.append(
+                client_main("submit",
+                            [f"--socket={h.sock}", "--retry=8",
+                             "--", "in.paf", "-o",
+                             str(tmp_path / "r.dfa")],
+                            stdout=out, stderr=err)), daemon=True)
+            t.start()
+            time.sleep(0.3)          # let the first rejection land
+            gate.set()               # capacity frees; a retry lands
+            t.join(90)
+            assert box == [0], (box, err.getvalue())
+            assert "retry" in err.getvalue()
+            assert json.loads(out.getvalue())["state"] == "done"
+        finally:
+            gate.set()
+
+
+def test_submit_retry_budget_spent_exits_11(tmp_path):
+    gate = threading.Event()
+
+    def runner(argv, stdout=None, stderr=None, warm=None):
+        gate.wait(30)
+        return 0
+
+    with _daemon(runner=runner, max_queue=1, max_queue_total=1) as h:
+        try:
+            with ServiceClient(h.sock) as c:
+                c.submit(["in.paf", "-o", str(tmp_path / "f.dfa")],
+                         cwd=str(tmp_path))
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    st = c.stats()["stats"]
+                    if st["running"] >= 1:
+                        break
+                    time.sleep(0.02)
+                c.submit(["in.paf", "-o", str(tmp_path / "q.dfa")],
+                         cwd=str(tmp_path))
+            err = io.StringIO()
+            rc = client_main("submit",
+                             [f"--socket={h.sock}", "--retry=1",
+                              "--", "in.paf", "-o",
+                              str(tmp_path / "r.dfa")],
+                             stdout=io.StringIO(), stderr=err)
+            assert rc == 11, err.getvalue()
+            assert "retry 1/1" in err.getvalue()
+        finally:
+            gate.set()
+
+
+def test_retry_flag_validation():
+    err = io.StringIO()
+    rc = client_main("submit", ["--socket=/nonexistent",
+                                "--retry=zero", "--", "in.paf"],
+                     stdout=io.StringIO(), stderr=err)
+    assert rc == EXIT_USAGE
+    assert "--retry" in err.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# serve_main flag surface
+# ---------------------------------------------------------------------------
+def test_serve_main_rejects_bad_crash_safety_flags(tmp_path):
+    for bad in (["--socket=s", "--priority-lanes=hi,hi"],
+                ["--socket=s", "--priority-lanes=,"],
+                ["--socket=s", "--spool-threshold-bytes=none"],
+                ["--socket=s", "--max-queue-total=0"],
+                ["--socket=s", "--journal= "]):
+        err = io.StringIO()
+        assert serve_main(bad, stderr=err) == EXIT_USAGE, bad
+        assert "Invalid" in err.getvalue()
+
+
+def test_peer_identity_is_kernel_attested_uid():
+    import socket as socketlib
+
+    from pwasm_tpu.service.daemon import _peer_identity
+    a, b = socketlib.socketpair(socketlib.AF_UNIX,
+                                socketlib.SOCK_STREAM)
+    try:
+        assert _peer_identity(a) == f"uid:{os.getuid()}"
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# the crash drill: kill -9 a live serve subprocess mid-job
+# ---------------------------------------------------------------------------
+def _corpus(tmp_path, n=24, qlen=120, seed=3):
+    rng = np.random.default_rng(seed)
+    q = "".join("ACGT"[i] for i in rng.integers(0, 4, qlen))
+    lines = []
+    for i in range(n):
+        cut = 10 + int(rng.integers(0, qlen - 40))
+        qb = q[cut]
+        tb = "ACGT"[("ACGT".index(qb) + 1) % 4]
+        ops = [("=", cut), ("*", tb, qb), ("=", 20), ("ins", "gg"),
+               ("=", qlen - cut - 21)]
+        lines.append(make_paf_line("q", q, f"asm{i}", "+", ops)[0])
+    fa = tmp_path / "q.fa"
+    write_fasta(str(fa), [("q", q.encode())])
+    paf = tmp_path / "in.paf"
+    paf.write_text("".join(ln + "\n" for ln in lines))
+    return str(paf), str(fa)
+
+
+def _job_args(tmp_path, tag, paf, fa, extra=()):
+    return [paf, "-r", fa, "-o", str(tmp_path / f"{tag}.dfa"),
+            "--device=tpu", "--batch=2",
+            f"--stats={tmp_path / f'{tag}.json'}"] + list(extra)
+
+
+def _serve_env():
+    old_pp = os.environ.get("PYTHONPATH", "")
+    return dict(os.environ, JAX_PLATFORMS="cpu",
+                PWASM_DEVICE_PROBE="0",
+                PYTHONPATH=REPO + (os.pathsep + old_pp if old_pp
+                                   else ""))
+
+
+def _spawn_serve(sock, extra=()):
+    return subprocess.Popen(
+        [sys.executable, "-m", "pwasm_tpu.cli", "serve",
+         f"--socket={sock}"] + list(extra),
+        env=_serve_env(), stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE, text=True)
+
+
+def test_kill9_crash_drill_replay_recovers_byte_identical(tmp_path):
+    """THE acceptance drill: kill -9 a live serve daemon mid-job
+    (after its first durable checkpoint) with a second job still
+    queued; a fresh daemon on the same socket replays the journal,
+    resumes the interrupted job from its ckpt and re-queues the queued
+    one — and every report is byte-identical to the uncrashed arm."""
+    paf, fa = _corpus(tmp_path)
+    # the uncrashed arm: cold runs of the exact same job argvs
+    cold_a = run(_job_args(tmp_path, "colda", paf, fa, [SLOW]),
+                 stderr=io.StringIO())
+    cold_b = run(_job_args(tmp_path, "coldb", paf, fa),
+                 stderr=io.StringIO())
+    assert cold_a == 0 and cold_b == 0
+    expect_a = (tmp_path / "colda.dfa").read_bytes()
+    expect_b = (tmp_path / "coldb.dfa").read_bytes()
+
+    sockdir = tempfile.mkdtemp(prefix="pwkill9")
+    sock = os.path.join(sockdir, "s")
+    sp = _spawn_serve(sock)
+    sp2 = None
+    try:
+        assert wait_for_socket(sock, 60)
+        with ServiceClient(sock) as c:
+            ja = c.submit(_job_args(tmp_path, "a", paf, fa, [SLOW]))
+            assert ja.get("ok"), ja
+            jb = c.submit(_job_args(tmp_path, "b", paf, fa))
+            assert jb.get("ok"), jb
+            # wait until job a is demonstrably MID-RUN with a durable
+            # ckpt — the window where a crash loses real work
+            ck = str(tmp_path / "a.dfa.ckpt")
+            deadline = time.monotonic() + 60
+            mid = False
+            while time.monotonic() < deadline:
+                st = c.status(ja["job_id"])["job"]["state"]
+                if st == "running" and os.path.exists(ck):
+                    mid = True
+                    break
+                assert st in ("queued", "running"), st
+                time.sleep(0.02)
+            assert mid, "job never reached mid-run with a ckpt"
+        sp.kill()                     # SIGKILL: no drain, no cleanup
+        sp.wait(timeout=30)
+        assert os.path.exists(sock + ".journal")
+
+        sp2 = _spawn_serve(sock)
+        assert wait_for_socket(sock, 60)
+        with ServiceClient(sock) as c:
+            # ids survive the crash: clients keep polling the same ids
+            ra = c.result(ja["job_id"], timeout=240)
+            rb = c.result(jb["job_id"], timeout=240)
+            st = c.stats()["stats"]
+            c.drain()
+        assert ra.get("rc") == 0, ra
+        assert rb.get("rc") == 0, rb
+        assert "recovered" in ra["job"]["detail"]
+        assert st["journal"]["replays"] == 1
+        assert st["journal"]["jobs_recovered"] == 2
+        # no lost, duplicated, or reordered work: bytes identical to
+        # the never-crashed arm for BOTH jobs
+        assert (tmp_path / "a.dfa").read_bytes() == expect_a
+        assert (tmp_path / "b.dfa").read_bytes() == expect_b
+        assert sp2.wait(timeout=120) == EXIT_PREEMPTED
+        # the recovered fleet drained clean: journal retired
+        assert not os.path.exists(sock + ".journal")
+    finally:
+        for p in (sp, sp2):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()
+            if p is not None:
+                p.stderr.close()
+        shutil.rmtree(sockdir, ignore_errors=True)
