@@ -1464,6 +1464,154 @@ def cfg8_realistic_scale() -> int:
         _emit("realistic_serve_fairshare_p50_light_ms", light_p50,
               "ms", 1.0 if fair_flag else 0.0, cpu_metric=True)
 
+        # --- streaming ingestion (ISSUE 10 tentpole): the SAME
+        # corpus record-at-a-time over the service socket.  Gates
+        # byte parity against the one-shot outputs and measures the
+        # record-appended -> report-bytes-emitted p50 under --batch=1
+        # (every record is its own flush; the host pipeline holds two
+        # batches in flight, so after a 3-record prime each appended
+        # record emits exactly one older batch's bytes — the steady-
+        # state per-record serving latency of the minimap2-pipe
+        # shape, docs/STREAMING.md).
+        svc5 = os.path.join(d, "svc5.sock")
+        sp5 = subprocess.Popen(
+            cmd + ["serve", f"--socket={svc5}", "--max-queue=8"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        lat_ms: list[float] = []
+        try:
+            if not wait_for_socket(svc5, 120):
+                return _fail("realistic_stream_up")
+            strm_out = outset("strm")
+            recs = [l + "\n" for l in lines]
+
+            def _rsize():
+                try:
+                    return os.path.getsize(strm_out[0])
+                except OSError:
+                    return 0
+
+            with ServiceClient(svc5) as c:
+                so = c.stream_open(
+                    ["-r", fa, "-o", strm_out[0], "-s", strm_out[1],
+                     "-w", strm_out[2], f"--cons={strm_out[3]}",
+                     "--batch=1"])
+                if not so.get("ok"):
+                    sys.stderr.write(str(so)[:1000])
+                    return _fail("realistic_stream_open")
+                jid = so["job_id"]
+                for r in recs[:3]:       # prime the 2-deep pipeline
+                    c.stream_data(jid, r)
+                deadline = time.monotonic() + 120
+                while _rsize() == 0:
+                    if time.monotonic() > deadline:
+                        return _fail("realistic_stream_first_byte")
+                    time.sleep(0.002)
+                for r in recs[3:43]:
+                    base = _rsize()
+                    t0 = time.perf_counter()
+                    rr = c.stream_data(jid, r)
+                    if not rr.get("ok"):
+                        sys.stderr.write(str(rr)[:1000])
+                        return _fail("realistic_stream_feed")
+                    deadline = time.monotonic() + 60
+                    while _rsize() <= base:
+                        if time.monotonic() > deadline:
+                            return _fail("realistic_stream_latency")
+                        time.sleep(0.001)
+                    lat_ms.append((time.perf_counter() - t0) * 1e3)
+                for r in recs[43:]:
+                    rr = c.stream_data(jid, r)
+                    if not rr.get("ok"):
+                        sys.stderr.write(str(rr)[:1000])
+                        return _fail("realistic_stream_feed")
+                c.stream_end(jid)
+                res = c.result(jid, timeout=600)
+                c.drain()
+            strm_rc = sp5.wait(timeout=120)
+            if not res.get("ok") or res.get("rc") != 0:
+                sys.stderr.write(str(res)[:1000])
+                return _fail("realistic_stream_job")
+            if readset("strm") != parity_body:
+                return _fail("realistic_stream_parity")
+            if strm_rc != 75:
+                return _fail("realistic_stream_drain")
+        except Exception as e:
+            sys.stderr.write(f"stream leg: {e}\n")
+            return _fail("realistic_stream")
+        finally:
+            if sp5.poll() is None:
+                sp5.kill()
+                sp5.wait()
+        _emit("realistic_stream_batch_latency_ms",
+              sorted(lat_ms)[len(lat_ms) // 2], "ms", 1.0,
+              cpu_metric=True)
+
+        # --- many-to-many (ISSUE 10 tentpole): BASELINE config 3's
+        # shape in miniature — Q CDS queries scored against T
+        # assembly targets through ONE --many2many session vs Q
+        # sequential single-CDS jobs (each paying its own interpreter
+        # + jax + session).  Per-CDS section/summary bytes are parity
+        # gated (concatenated singles == multi); the emitted ratio is
+        # the amortization multiplier (unit "x", lower is better,
+        # gated by qa/bench_gate.py like the other ratios).
+        import numpy as _np
+        m2m = os.path.join(d, "m2m")
+        os.makedirs(m2m, exist_ok=True)
+        rng = _np.random.default_rng(19)
+
+        def _seq(n):
+            return "".join("ACGT"[i]
+                           for i in rng.integers(0, 4, n))
+
+        m2m_qs = [(f"cds{k}", _seq(300 + 40 * (k % 3)))
+                  for k in range(4)]
+        m2m_ts = [(f"asm{k}", _seq(500 + 31 * k)) for k in range(24)]
+        qfa_all = os.path.join(m2m, "cds_multi.fa")
+        tfa = os.path.join(m2m, "targets.fa")
+        with open(qfa_all, "w") as f:
+            f.write("".join(f">{n}\n{s}\n" for n, s in m2m_qs))
+        with open(tfa, "w") as f:
+            f.write("".join(f">{n}\n{s}\n" for n, s in m2m_ts))
+        multi_out = os.path.join(m2m, "multi.tsv")
+        multi_sum = os.path.join(m2m, "multi.sum")
+        t0 = time.perf_counter()
+        r = subprocess.run(
+            cmd + ["--many2many", tfa, "-r", qfa_all,
+                   "-o", multi_out, "-s", multi_sum],
+            env=env, capture_output=True)
+        multi_wall = time.perf_counter() - t0
+        if r.returncode != 0:
+            sys.stderr.write(r.stderr.decode()[:1000])
+            return _fail("realistic_many2many")
+        seq_wall = 0.0
+        seq_body = b""
+        seq_sum = b""
+        for name, s in m2m_qs:
+            q1 = os.path.join(m2m, f"{name}.fa")
+            with open(q1, "w") as f:
+                f.write(f">{name}\n{s}\n")
+            o1 = os.path.join(m2m, f"{name}.tsv")
+            s1 = os.path.join(m2m, f"{name}.sum")
+            t0 = time.perf_counter()
+            r = subprocess.run(
+                cmd + ["--many2many", tfa, "-r", q1,
+                       "-o", o1, "-s", s1],
+                env=env, capture_output=True)
+            seq_wall += time.perf_counter() - t0
+            if r.returncode != 0:
+                sys.stderr.write(r.stderr.decode()[:1000])
+                return _fail("realistic_many2many_seq")
+            seq_body += open(o1, "rb").read()
+            seq_sum += open(s1, "rb").read()
+        if (seq_body != open(multi_out, "rb").read()
+                or seq_sum != open(multi_sum, "rb").read()):
+            return _fail("realistic_many2many_parity")
+        m2m_ratio = multi_wall / seq_wall
+        # vs_baseline flags the aspirational "one session costs at
+        # most half of N sessions" target, like the pycli ratio's 1.5x
+        _emit("realistic_many2many_vs_sequential_ratio", m2m_ratio,
+              "x", 1.0 if m2m_ratio <= 0.5 else 0.0, cpu_metric=True)
+
         # --- host engine A/B: 1k-alignment report+summary corpus ----
         qseq1k, lines1k = make_corpus(n_aln=1000)
         fa1k = os.path.join(d, "cds1k.fa")

@@ -104,12 +104,26 @@ USAGE = """Usage:
                of N chips (default: all visible): the analysis batch
                spreads over the mesh and consensus pileup counts are
                psum-reduced over the depth axis before the vote
+   --follow[=IDLE_S]  streaming ingestion (docs/STREAMING.md): tail
+               the input PAF as a GROWING file, emitting report bytes
+               as batches fill (rotation-safe tail -F semantics;
+               partial lines wait for their newline).  With =IDLE_S
+               the stream ends after IDLE_S seconds without growth
+               and the run completes normally; bare --follow tails
+               until SIGTERM (which drains to exit 75, resumable)
+   --many2many    multi-CDS scoring job (docs/STREAMING.md): score
+               EVERY query in the -r FASTA against every target in
+               the positional FASTA through ONE device session
+               (banded DP, parallel/many2many.py) — per-CDS report
+               sections byte-identical to N single-CDS runs
 
  Warm-pool service (docs/SERVICE.md): a resident daemon that keeps the
  process warm (one backend probe, one compile cache, one breaker +
  health monitor) and multiplexes report jobs over a unix socket:
    pwasm-tpu serve --socket=PATH [--max-queue=N] [--max-concurrent=N]
    pwasm-tpu submit --socket=PATH [--no-wait] [--] <cli args...>
+   pwasm-tpu stream --socket=PATH [--] <cli args...>   (PAF on stdin,
+               streamed record-at-a-time — the minimap2-pipe shape)
    pwasm-tpu svc-stats --socket=PATH [--drain]
    pwasm-tpu metrics --socket=PATH   (Prometheus text exposition)
 """
@@ -120,10 +134,10 @@ _BOOL_FLAGS = set("DGFCNvh")
 _VALUE_FLAGS = set("dprmowcs")
 
 # warm-pool service subcommands (pwasm_tpu/service/, docs/SERVICE.md):
-# `pwasm-tpu serve` starts the resident daemon, `submit`/`svc-stats`
-# are the client side — dispatched on the FIRST argv token so the
-# classic flag grammar stays untouched for plain runs
-_SERVICE_CMDS = ("serve", "submit", "svc-stats", "metrics")
+# `pwasm-tpu serve` starts the resident daemon, `submit`/`svc-stats`/
+# `stream` are the client side — dispatched on the FIRST argv token so
+# the classic flag grammar stays untouched for plain runs
+_SERVICE_CMDS = ("serve", "submit", "svc-stats", "metrics", "stream")
 
 
 class CliError(PwasmError):
@@ -361,12 +375,19 @@ def _unlink_checkpoint(report_path: str) -> None:
         pass
 
 
-def run(argv: list[str], stdout=None, stderr=None, warm=None) -> int:
+def run(argv: list[str], stdout=None, stderr=None, warm=None,
+        input_stream=None) -> int:
     """One CLI invocation.  ``warm`` is the warm-pool service hook
     (``service.daemon.WarmContext`` shape): a resident serve process
     passes one per job so consecutive jobs share the drain flag, the
     backend health monitor, and the supervisor's breaker/ceiling state
-    — a cold run (warm=None) behaves exactly as before."""
+    — a cold run (warm=None) behaves exactly as before.
+    ``input_stream`` is the socket-stream hook (docs/STREAMING.md): an
+    iterable of PAF lines (``stream.pafstream.StreamFeed`` shape) the
+    serve daemon substitutes for the input file when the job arrived
+    via ``stream`` protocol frames — the loop, batching, and
+    checkpoint machinery are identical either way, which is the
+    byte-parity contract."""
     stdout = stdout or sys.stdout
     stderr = stderr or sys.stderr
     if argv and argv[0] in _SERVICE_CMDS:
@@ -383,6 +404,18 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None) -> int:
     if opts.get("h"):
         stderr.write(USAGE + "\n")
         return EXIT_USAGE
+    if opts.get("many2many"):
+        # the multi-CDS job type (ISSUE 10b): one device session for
+        # every query in the -r FASTA — jax-free host driver in
+        # pwasm_tpu/stream/multicds.py, device work via the supervised
+        # many2many site
+        from pwasm_tpu.stream.multicds import many2many_main
+        try:
+            return many2many_main(opts, positional, stdout, stderr,
+                                  warm=warm)
+        except PwasmError as e:
+            stderr.write(str(e))
+            return e.exit_code
 
     cfg = Config()
     cfg.debug = bool(opts.get("D"))
@@ -433,17 +466,51 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None) -> int:
         return EXIT_USAGE
 
     infile = positional[0] if positional else None
+    if infile == "-":
+        # the conventional stdin marker (the pipe shape the service
+        # layer's _absolutize_argv already passes through untouched)
+        infile = None
     inf = sys.stdin
     obs = None          # the observability bundle (closed on unwind)
     opened: list = []   # output handles closed on ANY unwind: a killed
     # run must not leave a buffered handle whose late GC flush could
     # write stale bytes past a checkpoint-truncated report
-    try:
-        if infile:
+    # --follow[=IDLE_S]: streaming ingestion over a growing input file
+    # (docs/STREAMING.md).  bare --follow tails until a signal drains
+    # the run; =IDLE_S ends the stream after that long without growth.
+    follow = "follow" in opts
+    follow_idle: float | None = None
+    if follow:
+        val = opts["follow"]
+        if val is not True:
+            import math as _m
             try:
-                inf = open(infile)
-            except OSError:
-                raise PwasmError(f"Cannot open input file {infile}!\n")
+                follow_idle = float(str(val))
+                if follow_idle <= 0 or not _m.isfinite(follow_idle):
+                    raise ValueError
+            except (TypeError, ValueError):
+                raise CliError(f"{USAGE}\nInvalid --follow value: "
+                               f"{val}\n")
+        if infile is None and input_stream is None:
+            raise CliError(f"{USAGE}\n--follow requires an input PAF "
+                           "file to tail (stdin already streams)\n")
+    try:
+        if input_stream is not None:
+            if infile is not None:
+                raise PwasmError(
+                    "Error: a socket-streamed job reads records from "
+                    "the stream — drop the positional PAF path!\n")
+            inf = input_stream
+        elif infile:
+            if follow:
+                from pwasm_tpu.stream.pafstream import FollowReader
+                inf = FollowReader(infile, idle_timeout_s=follow_idle)
+            else:
+                try:
+                    inf = open(infile)
+                except OSError:
+                    raise PwasmError(
+                        f"Cannot open input file {infile}!\n")
         if "motifs" in opts:
             try:
                 cfg.motifs = load_motifs(str(opts["motifs"]))
@@ -922,6 +989,14 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
 
     obs = obs if obs is not None else NULL_OBS
     stats = RunStats()
+
+    # streaming inputs (FollowReader / StreamFeed) block between
+    # records: hand them the drain flag so a SIGTERM (or the daemon's
+    # per-job drain) wakes the wait and stops iteration at the current
+    # record boundary — the loop below then takes its standard
+    # preempted path (final ckpt, exit 75, resumable)
+    if drain is not None and hasattr(inf, "bind_drain"):
+        inf.bind_drain(drain)
 
     # one supervisor per run: every device round-trip (report batches,
     # --realign dispatches, the consensus/refine launches) goes through

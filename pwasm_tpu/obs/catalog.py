@@ -235,6 +235,28 @@ def build_service_metrics(reg: MetricsRegistry) -> dict:
     return m
 
 
+def build_stream_metrics(reg: MetricsRegistry) -> dict:
+    """Register the streaming-ingestion families (ISSUE 10), labeled
+    by the stream's fair-share client identity.  ``records``/``batches``
+    count what actually flowed; ``lag`` is the live fed-but-unconsumed
+    buffer depth per client — the "is a producer outrunning its
+    consumer" pressure signal the per-stream quota acts on."""
+    m = {}
+    m["records"] = reg.counter(
+        "pwasm_stream_records_total",
+        "PAF records accepted over stream-data frames, by client",
+        labels=("client",))
+    m["batches"] = reg.counter(
+        "pwasm_stream_batches_total",
+        "Arrival batches drained from stream buffers by executing "
+        "jobs, by client", labels=("client",))
+    m["lag"] = reg.gauge(
+        "pwasm_stream_lag_records",
+        "Records fed to a stream but not yet consumed by its job, "
+        "by client", labels=("client",))
+    return m
+
+
 def fold_run_stats(m: dict, st: dict | None) -> None:
     """Fold one run's ``--stats`` JSON (the versioned ``stats_version``
     schema) into the run-metric families.  The one-shot CLI calls it

@@ -38,6 +38,16 @@ _CLIENT_USAGE = """Usage:
      socket-peer uid); --priority=LANE targets a --priority-lanes
      tier on the daemon.
 
+ pwasm-tpu stream --socket=PATH [--timeout=S] [--client=NAME]
+                  [--priority=LANE] [--] <cli args...>
+     open a STREAM job (docs/STREAMING.md) and feed it the PAF read
+     from stdin, record-at-a-time — `minimap2 --cs ... | pwasm-tpu
+     stream --socket=S -- -r cds.fa -o out.dfa` is the pipe shape.
+     The job argv takes no positional PAF (records arrive over the
+     socket); -o is required like submit.  Backpressure (queue_full
+     mid-stream) is handled with capped-exponential backoff
+     automatically; exits with the job's exit code.
+
  pwasm-tpu svc-stats --socket=PATH [--drain]
      print the service-level stats JSON (versioned schema); with
      --drain, ask the daemon to drain gracefully first (running jobs
@@ -147,6 +157,115 @@ class ServiceClient:
     def cancel(self, job_id: str) -> dict:
         return self.request({"cmd": "cancel", "job_id": job_id})
 
+    # ---- streaming ingestion (docs/STREAMING.md) -----------------------
+    def stream_open(self, argv: list[str], cwd: str | None = None,
+                    client: str | None = None,
+                    priority: str | None = None) -> dict:
+        """Admit a stream job: ``argv`` is a submit-shaped job argv
+        WITHOUT a positional PAF (the records arrive over
+        ``stream_data``)."""
+        import os
+        req: dict = {"cmd": "stream", "args": list(argv),
+                     "cwd": cwd if cwd is not None else os.getcwd()}
+        if client is not None:
+            req["client"] = client
+        if priority is not None:
+            req["priority"] = priority
+        return self.request(req)
+
+    def stream_data(self, job_id: str, data: str) -> dict:
+        """Feed one chunk of PAF text (any byte split — the daemon
+        reassembles records across frames)."""
+        return self.request({"cmd": "stream-data", "job_id": job_id,
+                             "data": data})
+
+    def stream_end(self, job_id: str) -> dict:
+        return self.request({"cmd": "stream-end", "job_id": job_id})
+
+    def stream(self, argv: list[str], chunks,
+               cwd: str | None = None, client: str | None = None,
+               priority: str | None = None, max_retries: int = 8,
+               sleep=time.sleep,
+               keepalive_s: float | None = None) -> dict:
+        """Open a stream job, feed every chunk from ``chunks``, and
+        end the stream — with the backpressure dance built in: a
+        ``queue_full`` mid-stream (the stream's buffer quota or fair
+        share filled faster than the job drains it) waits
+        :func:`retry_backoff_s` seconds (capped-exponential, seeded by
+        the daemon's ``retry_after_s`` hint — the same schedule
+        ``submit --retry`` uses) and resends the SAME frame; the
+        attempt counter resets on every accepted frame.  Raises
+        :class:`ServiceError` once one frame stays rejected past
+        ``max_retries`` consecutive attempts, or on any non-429
+        rejection.  Returns the open response, augmented with
+        ``records`` (total the daemon assembled) and
+        ``backpressure_waits`` (how often the dance was danced) —
+        call :meth:`result` with the returned ``job_id`` to wait for
+        the report.
+
+        ``keepalive_s``: while this thread is blocked pulling the
+        NEXT chunk from a slow producer (a minimap2 index build can
+        go silent for minutes), a helper thread on its OWN
+        connection sends an empty ``stream-data`` frame every that
+        many seconds — empty frames carry no records but count as
+        stream activity, so the daemon's ``--stream-idle-s`` reaper
+        never mistakes a slow producer for a vanished client."""
+        resp = self.stream_open(argv, cwd=cwd, client=client,
+                                priority=priority)
+        if not resp.get("ok"):
+            return resp
+        job_id = resp["job_id"]
+        stop = beat = None
+        if keepalive_s:
+            import threading
+            stop = threading.Event()
+
+            def _beat():
+                # a SEPARATE connection: two threads interleaving
+                # frames on one socket would corrupt the one-request/
+                # one-response pairing
+                try:
+                    with ServiceClient(self.socket_path) as kc:
+                        while not stop.wait(keepalive_s):
+                            if not kc.stream_data(job_id,
+                                                  "").get("ok"):
+                                return
+                except ServiceError:
+                    pass      # best-effort: the feed itself decides
+
+            beat = threading.Thread(target=_beat, daemon=True)
+            beat.start()
+        waits = 0
+        try:
+            for chunk in chunks:
+                attempt = 0
+                while True:
+                    r = self.stream_data(job_id, chunk)
+                    if r.get("ok"):
+                        break
+                    if r.get("error") != protocol.ERR_QUEUE_FULL:
+                        raise ServiceError(
+                            f"stream-data rejected: {r}")
+                    if attempt >= max_retries:
+                        raise ServiceError(
+                            f"stream backpressure budget spent "
+                            f"({max_retries} consecutive retries): "
+                            f"{r}")
+                    sleep(retry_backoff_s(attempt,
+                                          r.get("retry_after_s")))
+                    waits += 1
+                    attempt += 1
+        finally:
+            if stop is not None:
+                stop.set()
+                beat.join(5)
+        end = self.stream_end(job_id)
+        if not end.get("ok"):
+            raise ServiceError(f"stream-end rejected: {end}")
+        resp["records"] = end.get("records")
+        resp["backpressure_waits"] = waits
+        return resp
+
     def stats(self) -> dict:
         return self.request({"cmd": "stats"})
 
@@ -223,10 +342,35 @@ def _parse_client_argv(argv: list[str]) -> tuple[dict, list[str]]:
     return opts, argv[i:]
 
 
+def _job_verdict(resp: dict, job_id: str, stdout, stderr) -> int:
+    """Render a ``result`` response the way ``submit`` always has (one
+    JSON verdict line, the stderr tail of a non-done job) and return
+    the shell exit code — shared by the ``submit`` and ``stream``
+    verbs so the two cannot drift."""
+    if not resp.get("ok"):
+        stderr.write(f"Error: result failed: {resp}\n")
+        return EXIT_FATAL
+    if resp.get("pending"):
+        stderr.write(f"Error: job {job_id} still "
+                     f"{resp['job']['state']} after the "
+                     "--timeout\n")
+        return EXIT_FATAL
+    job = resp["job"]
+    json.dump({"job_id": job_id, "state": job["state"],
+               "rc": resp.get("rc"), "detail": job.get("detail")},
+              stdout)
+    stdout.write("\n")
+    tail = resp.get("stderr_tail") or ""
+    if tail and job["state"] != "done":
+        stderr.write(tail)
+    rc = resp.get("rc")
+    return rc if isinstance(rc, int) else EXIT_FATAL
+
+
 def client_main(cmd: str, argv: list[str], stdout=None,
                 stderr=None) -> int:
-    """The ``pwasm-tpu submit`` / ``pwasm-tpu svc-stats`` entry
-    point."""
+    """The ``pwasm-tpu submit`` / ``pwasm-tpu stream`` /
+    ``pwasm-tpu svc-stats`` entry point."""
     stdout = stdout if stdout is not None else sys.stdout
     stderr = stderr if stderr is not None else sys.stderr
     opts, job_argv = _parse_client_argv(argv)
@@ -269,6 +413,40 @@ def client_main(cmd: str, argv: list[str], stdout=None,
             json.dump(resp["stats"], stdout)
             stdout.write("\n")
             return 0
+        if cmd == "stream":
+            # the minimap2-pipe verb: stdin is the record source, fed
+            # record-at-a-time with automatic backpressure handling
+            if not job_argv:
+                stderr.write(f"{_CLIENT_USAGE}\nError: stream needs "
+                             "the job's CLI arguments\n")
+                return EXIT_USAGE
+            # available-bytes chunking (read1): frames carry whatever
+            # the pipe has — low latency on a trickling producer, yet
+            # one frame per ~64 KiB on a firehose instead of one RPC
+            # per record (the daemon reassembles records across
+            # frames either way).  Streams without a .buffer (tests
+            # hand a StringIO) fall back to per-line frames.
+            buf = getattr(sys.stdin, "buffer", None)
+            if buf is not None:
+                src = (b.decode("utf-8", "replace") for b in
+                       iter(lambda: buf.read1(1 << 16), b""))
+            else:
+                src = iter(sys.stdin.readline, "")
+            with ServiceClient(sock) as c:
+                resp = c.stream(job_argv, src,
+                                client=opts.get("client"),
+                                priority=opts.get("priority"),
+                                keepalive_s=30.0)
+                if not resp.get("ok"):
+                    code = resp.get("error")
+                    stderr.write(f"Error: stream rejected ({code}): "
+                                 f"{resp.get('detail', '')}\n")
+                    return EXIT_QUEUE_FULL \
+                        if code == protocol.ERR_QUEUE_FULL \
+                        else EXIT_FATAL
+                job_id = resp["job_id"]
+                resp = c.result(job_id, wait=True, timeout=timeout)
+            return _job_verdict(resp, job_id, stdout, stderr)
         # submit
         if not job_argv:
             stderr.write(f"{_CLIENT_USAGE}\nError: submit needs the "
@@ -316,24 +494,7 @@ def client_main(cmd: str, argv: list[str], stdout=None,
                 stdout.write("\n")
                 return 0
             resp = c.result(job_id, wait=True, timeout=timeout)
-        if not resp.get("ok"):
-            stderr.write(f"Error: result failed: {resp}\n")
-            return EXIT_FATAL
-        if resp.get("pending"):
-            stderr.write(f"Error: job {job_id} still "
-                         f"{resp['job']['state']} after the "
-                         "--timeout\n")
-            return EXIT_FATAL
-        job = resp["job"]
-        json.dump({"job_id": job_id, "state": job["state"],
-                   "rc": resp.get("rc"), "detail": job.get("detail")},
-                  stdout)
-        stdout.write("\n")
-        tail = resp.get("stderr_tail") or ""
-        if tail and job["state"] != "done":
-            stderr.write(tail)
-        rc = resp.get("rc")
-        return rc if isinstance(rc, int) else EXIT_FATAL
+        return _job_verdict(resp, job_id, stdout, stderr)
     except ServiceError as e:
         stderr.write(f"Error: {e}\n")
         return EXIT_FATAL

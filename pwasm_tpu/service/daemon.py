@@ -52,14 +52,15 @@ from pwasm_tpu.service.queue import (JOB_CANCELLED, JOB_DONE, JOB_FAILED,
                                      JOB_PREEMPTED, JOB_QUEUED,
                                      JOB_RUNNING, TERMINAL_STATES,
                                      Draining, Job, JobQueue, QueueFull,
-                                     ServiceStats)
+                                     ServiceStats, StreamBook)
 
 _SERVE_USAGE = """Usage:
  pwasm-tpu serve --socket=PATH [--max-queue=N] [--max-queue-total=N]
                  [--max-concurrent=N] [--priority-lanes=hi,lo]
                  [--devices-per-job=N] [--lanes=N]
                  [--journal=PATH|off] [--spool-threshold-bytes=N]
-                 [--spool-dir=DIR]
+                 [--spool-dir=DIR] [--stream-buffer=N]
+                 [--stream-idle-s=S]
                  [--max-frame-bytes=N] [--metrics-textfile=PATH]
                  [--log-json=FILE] [--result-ttl-s=S] [--max-results=N]
 
@@ -110,6 +111,19 @@ _SERVE_USAGE = """Usage:
                         mesh; with lanes < max-concurrent a dequeued
                         job WAITS for a free lease (FIFO, measured by
                         the lease-wait histogram), not just a thread
+   --stream-buffer=N    per-stream buffered-record quota (default
+                        512): records fed over stream-data frames but
+                        not yet consumed by the executing job.  A
+                        stream past its quota (or over its fair share
+                        of the 4x global ceiling once streams together
+                        hit it) answers queue_full — the client backs
+                        off and resends (docs/STREAMING.md)
+   --stream-idle-s=S    drain a stream job after S seconds with no
+                        stream-data and no stream-end (default 300):
+                        the job exits 75 with a valid checkpoint —
+                        preempted-resumable, never silently complete
+                        with missing records — so a vanished client
+                        cannot wedge a worker forever
    --max-frame-bytes=N  protocol frame ceiling (default 8 MiB)
    --metrics-textfile=PATH  publish the daemon's Prometheus text
                         exposition here (atomic rewrite after every
@@ -258,7 +272,9 @@ class Daemon:
                  max_queue_total: int | None = None,
                  priority_lanes: tuple[str, ...] | None = None,
                  spool_threshold_bytes: int | None = None,
-                 spool_dir: str | None = None):
+                 spool_dir: str | None = None,
+                 stream_buffer: int = 512,
+                 stream_idle_s: float | None = 300.0):
         self.socket_path = socket_path
         self.max_concurrent = max(1, int(max_concurrent))
         # device-lease scheduler (ISSUE 8): every running job holds one
@@ -300,6 +316,14 @@ class Daemon:
         self.spool_dir = spool_dir if spool_dir is not None \
             else socket_path + ".spool"
         self._spool_bytes = 0
+        # ---- streaming ingestion (ISSUE 10): per-stream buffer
+        # quotas + fair-share arbitration; stream jobs are otherwise
+        # ordinary queue citizens (DRR over clients, leases, journal)
+        self.streams = StreamBook(stream_buffer)
+        self.stream_idle_s = stream_idle_s
+        self._client_lanes: dict[str, int] = {}   # lane a client's
+        #   last stream ran on — consecutive/re-opened streams prefer
+        #   it, so they inherit the lane's warm breaker/compile state
         self.jobs: dict[str, Job] = {}
         self.stats = ServiceStats()
         self.warm = WarmContext()
@@ -323,9 +347,11 @@ class Daemon:
         from pwasm_tpu.obs import (EventLog, MetricsRegistry,
                                    Observability)
         from pwasm_tpu.obs.catalog import (build_run_metrics,
-                                           build_service_metrics)
+                                           build_service_metrics,
+                                           build_stream_metrics)
         self.registry = MetricsRegistry()
         self.svc_metrics = build_service_metrics(self.registry)
+        self.stream_metrics = build_stream_metrics(self.registry)
         # foldable counters only: the live run instruments (attempt
         # histogram, run breaker gauge) belong to each run's own obs
         # bundle — the daemon's breaker view is the
@@ -533,6 +559,9 @@ class Daemon:
             m["lane_breaker_state"].set(row["breaker_state"],
                                         lane=str(row["lane"]))
         m["spool_bytes"].set(spool_bytes)
+        for c, lag in self.streams.client_lag().items():
+            self.stream_metrics["lag"].set(lag,
+                                           client=c or "default")
         depths = self.queue.client_depths()
         for c in clients_seen | set(depths):
             # every client ever admitted keeps a series: a drained
@@ -660,6 +689,44 @@ class Daemon:
                            "spool": job.spool,
                            "t": round(job.finished_s, 3)}
                 keep.append(fin_rec)
+                n_restored += 1
+                continue
+            if admit.get("stream"):
+                # a live-at-crash SOCKET stream: its records came over
+                # a connection the crash severed, so the daemon cannot
+                # re-run it alone — land it terminal
+                # preempted-RESUMABLE (records up to the last
+                # batch-boundary ckpt are durable; the client re-opens
+                # a stream with --resume and re-sends, byte-identical
+                # by the resume contract), and remember its lane so
+                # the re-opened stream inherits the warm state
+                job = Job(id=jid, argv=list(argv), client=client,
+                          priority=priority)
+                job.stream = True
+                job.submitted_s = _num(admit.get("t"),
+                                       job.submitted_s)
+                job.state = JOB_PREEMPTED
+                job.rc = EXIT_PREEMPTED
+                job.detail = (
+                    "stream interrupted by a daemon crash; records "
+                    "up to the last checkpoint are durable — re-open "
+                    "the stream with --resume and re-send the "
+                    "records to complete it")
+                job.finished_s = time.time()
+                job.done.set()
+                self.jobs[jid] = job
+                start = row["start"]
+                if start is not None \
+                        and isinstance(start.get("lane"), int):
+                    self._client_lanes.setdefault(client,
+                                                  start["lane"])
+                keep.append(dict(admit))
+                keep.append({"v": JOURNAL_VERSION, "rec": REC_FINISH,
+                             "job_id": jid, "state": JOB_PREEMPTED,
+                             "rc": EXIT_PREEMPTED,
+                             "detail": job.detail,
+                             "t": round(job.finished_s, 3)})
+                self.stats.jobs_preempted += 1
                 n_restored += 1
                 continue
             # live at crash time: re-queue, resuming if it had started
@@ -840,6 +907,7 @@ class Daemon:
         #                        jobs are preempted below by the worker
         waiting = self.queue.drain()
         for job in waiting:
+            self._retire_stream(job)
             job.state = JOB_PREEMPTED
             job.rc = EXIT_PREEMPTED
             job.detail = ("preempted before start (service drained); "
@@ -897,12 +965,25 @@ class Daemon:
                 self.leases.release(lease)
                 with self._lock:
                     self._running.pop(job.id, None)
+                self._retire_stream(job)
                 job.done.set()
+
+    def _retire_stream(self, job: Job) -> None:
+        """A stream job leaving the live set: drop it from the quota
+        book and latch its feed shut, so later ``stream-data`` frames
+        answer an error instead of buffering records nobody will ever
+        read."""
+        if not job.stream:
+            return
+        self.streams.unregister(job.id)
+        if job.feed is not None:
+            job.feed.end()
 
     def _preempt_leaseless(self, job: Job) -> None:
         """A dequeued job the drain caught BEFORE it got a lease: same
         contract as one still queued — preempted, resumable, never
         started."""
+        self._retire_stream(job)
         job.state = JOB_PREEMPTED
         job.rc = EXIT_PREEMPTED
         job.detail = ("preempted waiting for a device lease (service "
@@ -920,6 +1001,11 @@ class Daemon:
     def _run_job(self, job: Job, lease) -> None:
         job.state = JOB_RUNNING
         job.started_s = time.time()
+        if job.stream:
+            # lane affinity for the client's NEXT stream (and, via the
+            # journal's start record, for a crash-reopened one)
+            with self._lock:
+                self._client_lanes[job.client] = lease.lane
         # journal the start BEFORE the run: a kill -9 from here on
         # makes the job a --resume continuation at the next start
         self._journal_append(REC_START, job_id=job.id,
@@ -936,9 +1022,10 @@ class Daemon:
         warm = _JobWarm(self.warm, job.drain, lease,
                         expose_devices=self._expose_devices)
         rc: int | None = None
+        kw = {"input_stream": job.feed} if job.stream else {}
         try:
             rc = self._runner(job.argv, stdout=job.outbuf,
-                              stderr=job.errbuf, warm=warm)
+                              stderr=job.errbuf, warm=warm, **kw)
         except BaseException as e:   # InjectedKill, stray PwasmError —
             # a dying job must never take the daemon down with it
             job.detail = f"job raised {type(e).__name__}: {e}"
@@ -1037,7 +1124,8 @@ class Daemon:
 
     def submit(self, argv: list, cwd: str | None = None,
                client: str | None = None,
-               priority: str | None = None) -> Job:
+               priority: str | None = None,
+               stream: bool = False) -> Job:
         """Validate + admit one job (raises Draining/QueueFull/
         ValueError).  Also the in-process API the tests drive.
         ``cwd`` is the CLIENT's working directory: relative paths in
@@ -1046,7 +1134,11 @@ class Daemon:
         automatically).  ``client`` is the fair-share identity (the
         protocol layer defaults it to the socket-peer uid);
         ``priority`` must name a ``--priority-lanes`` tier when
-        given."""
+        given.  ``stream=True`` admits a SOCKET-STREAM job (the
+        ``stream`` protocol verb): its PAF records arrive later as
+        ``stream-data`` frames, so the argv must carry no positional
+        input, and the job gets a quota-gated StreamFeed plus lane
+        affinity to the client's previous stream."""
         if not isinstance(argv, list) \
                 or not all(isinstance(a, str) for a in argv) \
                 or not argv:
@@ -1092,6 +1184,16 @@ class Daemon:
                 "service jobs must write their report to a file "
                 "(-o <report>): the socket carries control frames, "
                 "not report bytes")
+        if stream:
+            if _pos:
+                raise ValueError(
+                    "stream jobs read records from stream-data "
+                    "frames: drop the positional PAF path "
+                    f"({_pos[0]!r})")
+            for bad in ("follow", "many2many"):
+                if bad in job_opts:
+                    raise ValueError(
+                        f"--{bad} does not apply to a socket stream")
         if self.drain.requested:
             raise Draining("service is draining")
         base_argv = list(argv)     # what the journal records: the
@@ -1102,6 +1204,21 @@ class Daemon:
             job = Job(id=f"job-{self._next_id:04d}", argv=list(argv),
                       client=client, priority=priority)
         self._arm_job(job)
+        if stream:
+            from pwasm_tpu.stream.pafstream import StreamFeed
+            job.stream = True
+            job.feed = StreamFeed(idle_timeout_s=self.stream_idle_s)
+            # the drain flag wakes a feed-blocked job; the batch hook
+            # feeds the per-client arrival-batch counter
+            job.feed.bind_drain(job.drain)
+            job.feed.on_batch = \
+                lambda n, c=(client or "default"): \
+                self.stream_metrics["batches"].inc(1, client=c)
+            # lane affinity: a client's consecutive (or crash-reopened)
+            # streams land on the lane whose warm state they built
+            with self._lock:
+                job.prefer_lane = self._client_lanes.get(client)
+            self.streams.register(job.id, client, job.feed)
         # write-ahead order: the admit record lands BEFORE the queue
         # can hand the job to a worker — a worker only journals start
         # after a successful dequeue, so the file order admit < start
@@ -1111,13 +1228,16 @@ class Daemon:
         # job nobody was promised — the benign direction.)
         self._journal_append(REC_ADMIT, job_id=job.id,
                              argv=base_argv, client=client,
-                             priority=priority)
+                             priority=priority,
+                             **({"stream": True} if stream else {}))
         try:
             self.queue.submit(job)
         except (Draining, QueueFull):
             # the admission never happened: retract the id so replay
             # cannot resurrect a job the client was told was rejected
             self._journal_append(REC_EVICT, job_id=job.id)
+            if stream:
+                self.streams.unregister(job.id)
             raise
         with self._lock:
             self.jobs[job.id] = job
@@ -1125,7 +1245,7 @@ class Daemon:
         self.stats.jobs_accepted += 1
         self.svc_metrics["jobs"].inc(outcome="accepted")
         self.obs.event("job_admit", job_id=job.id, client=client,
-                       queue_depth=self.queue.depth())
+                       stream=stream, queue_depth=self.queue.depth())
         return job
 
     def _retry_after_s(self) -> float:
@@ -1227,6 +1347,105 @@ class Daemon:
                     retry_after_s=self._retry_after_s())
             return protocol.ok(job_id=job.id,
                                queue_depth=self.queue.depth())
+        if cmd == "stream":
+            # streaming ingestion (ISSUE 10): admit a job whose PAF
+            # records will arrive as stream-data frames — the
+            # minimap2-pipe-over-the-socket shape.  Admission control
+            # is the same per-client fair-share gate as submit.
+            client = req.get("client")
+            if client is None:
+                client = peer or ""
+            try:
+                job = self.submit(req.get("args"),
+                                  cwd=req.get("cwd"),
+                                  client=client,
+                                  priority=req.get("priority"),
+                                  stream=True)
+            except ValueError as e:
+                return protocol.err(protocol.ERR_BAD_REQUEST, str(e))
+            except Draining as e:
+                self.stats.jobs_rejected_draining += 1
+                self.svc_metrics["jobs"].inc(
+                    outcome="rejected_draining")
+                return protocol.err(protocol.ERR_DRAINING, str(e))
+            except QueueFull as e:
+                self.stats.jobs_rejected += 1
+                self.svc_metrics["jobs"].inc(outcome="rejected")
+                return protocol.err(
+                    protocol.ERR_QUEUE_FULL, str(e),
+                    queue_depth=self.queue.depth(),
+                    max_queue=self.queue.max_queue,
+                    client=client or "default",
+                    retry_after_s=self._retry_after_s())
+            return protocol.ok(job_id=job.id,
+                               max_buffer=self.streams.max_buffer,
+                               queue_depth=self.queue.depth())
+        if cmd in ("stream-data", "stream-end"):
+            job = self.jobs.get(req.get("job_id"))
+            if job is None:
+                return protocol.err(
+                    protocol.ERR_UNKNOWN_JOB,
+                    f"unknown job_id {req.get('job_id')!r}")
+            if not job.stream:
+                return protocol.err(
+                    protocol.ERR_BAD_REQUEST,
+                    f"job {job.id} is not a stream job")
+            job.accessed_s = time.time()
+            feed = job.feed
+            closed = (feed is None or job.state in TERMINAL_STATES
+                      or (cmd == "stream-data" and feed.ended))
+            if closed and cmd == "stream-data":
+                return protocol.err(
+                    protocol.ERR_BAD_REQUEST,
+                    f"stream {job.id} is closed ({job.state})"
+                    + ("; re-open a stream with --resume to complete "
+                       "it" if job.state == JOB_PREEMPTED else ""))
+            if cmd == "stream-end":
+                if feed is not None:
+                    feed.end()
+                return protocol.ok(
+                    records=feed.records_in if feed else 0,
+                    buffered=feed.buffered if feed else 0)
+            data = req.get("data")
+            if not isinstance(data, str):
+                return protocol.err(
+                    protocol.ERR_BAD_REQUEST,
+                    "stream-data needs a string data field")
+            n = feed.completed(data)
+            if not n and data:
+                # the record quota counts complete lines, so
+                # newline-less frames must be bounded separately or
+                # one client grows the partial-record tail without
+                # limit (a protocol violation, not backpressure — no
+                # resend can help, so the error is NOT queue_full)
+                from pwasm_tpu.stream.pafstream import \
+                    MAX_RECORD_BYTES
+                if feed.tail_bytes + len(data) > MAX_RECORD_BYTES:
+                    return protocol.err(
+                        protocol.ERR_BAD_REQUEST,
+                        f"unterminated PAF record exceeds "
+                        f"{MAX_RECORD_BYTES} bytes — stream-data "
+                        "frames must eventually carry a newline")
+            if n:
+                try:
+                    # all-or-nothing per frame: a rejected frame left
+                    # no assembler state behind and resends verbatim
+                    self.streams.admit(job.id, n)
+                except QueueFull as e:
+                    # the streaming 429: back off (retry_backoff_s)
+                    # and resend — the executing job is draining the
+                    # buffer at device speed, so the hint is short
+                    return protocol.err(
+                        protocol.ERR_QUEUE_FULL, str(e),
+                        buffered=feed.buffered,
+                        max_buffer=self.streams.max_buffer,
+                        retry_after_s=0.1)
+            fed = feed.feed(data)
+            if fed:
+                self.stream_metrics["records"].inc(
+                    fed, client=job.client or "default")
+            return protocol.ok(buffered=feed.buffered,
+                               records=feed.records_in)
         if cmd == "stats":
             # queue depth / in-flight / breaker state read back from
             # the SAME registry gauges the `metrics` exposition serves
@@ -1275,6 +1494,18 @@ class Daemon:
                 "dir": self.spool_dir,
                 "threshold_bytes": self.spool_threshold_bytes,
                 "bytes": self._spool_bytes,
+            }
+            # additive (stats_version unchanged): streaming ingestion
+            # (ISSUE 10) — live streams, record/batch flow, buffer lag
+            tot = self.streams.totals()
+            st["streams"] = {
+                "active": tot["active"],
+                "records_in": tot["records_in"],
+                "records_out": tot["records_out"],
+                "batches": tot["batches"],
+                "lag_records": tot["buffered"],
+                "max_buffer": self.streams.max_buffer,
+                "max_buffer_total": self.streams.max_total,
             }
             return protocol.ok(stats=st)
         if cmd == "metrics":
@@ -1332,6 +1563,7 @@ class Daemon:
 
     def _cancel(self, job: Job) -> dict:
         if job.state == JOB_QUEUED and self.queue.remove(job):
+            self._retire_stream(job)
             job.state = JOB_CANCELLED
             job.rc = None
             job.detail = "cancelled while queued (never started)"
@@ -1489,7 +1721,8 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
                        ("max-frame-bytes", protocol.MAX_FRAME_BYTES),
                        ("devices-per-job", 1), ("lanes", None),
                        ("max-queue-total", None),
-                       ("spool-threshold-bytes", None)):
+                       ("spool-threshold-bytes", None),
+                       ("stream-buffer", 512)):
         val = opts.pop(knob, None)
         if val is None:
             nums[knob] = dflt
@@ -1522,6 +1755,18 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
                          "names, highest first)\n")
             return EXIT_USAGE
         priority_lanes = tuple(lanes)
+    stream_idle_s = 300.0
+    val = opts.pop("stream-idle-s", None)
+    if val is not None:
+        import math
+        try:
+            stream_idle_s = float(val)
+            if stream_idle_s <= 0 or not math.isfinite(stream_idle_s):
+                raise ValueError
+        except (TypeError, ValueError):
+            stderr.write(f"{_SERVE_USAGE}\nInvalid --stream-idle-s "
+                         f"value: {val}\n")
+            return EXIT_USAGE
     metrics_textfile = opts.pop("metrics-textfile", None)
     log_json = opts.pop("log-json", None)
     result_ttl_s = None
@@ -1564,7 +1809,9 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
                         priority_lanes=priority_lanes,
                         spool_threshold_bytes=nums[
                             "spool-threshold-bytes"],
-                        spool_dir=spool_dir)
+                        spool_dir=spool_dir,
+                        stream_buffer=nums["stream-buffer"],
+                        stream_idle_s=stream_idle_s)
     except OSError:
         stderr.write(f"Cannot open file {log_json} for writing!\n")
         return EXIT_USAGE

@@ -27,6 +27,24 @@ Commands (``{"cmd": ...}``):
                ``draining`` once a drain began.  Jobs must write
                their outputs to files (``-o`` required): the socket
                carries control, not bulk report bytes.
+``stream``     ``{"cmd":"stream","args":[...cli argv...],
+               "cwd":ABS_DIR[,"client":NAME,"priority":LANE]}`` —
+               admit a STREAMING-INGESTION job (docs/STREAMING.md):
+               same validation and fair-share admission as
+               ``submit``, but the argv must carry NO positional PAF
+               — the records arrive later as ``stream-data`` frames.
+``stream-data``  ``{"cmd":"stream-data","job_id":...,"data":TEXT}`` —
+               feed a chunk of PAF text to a stream job.  Chunks may
+               split records anywhere (the daemon reassembles lines
+               across frames).  Answers ``queue_full`` when the
+               stream's buffered-record quota (``--stream-buffer``)
+               or its fair share of the global ceiling is exceeded:
+               back off ``retry_after_s``-seeded capped-exponential
+               and RESEND THE SAME FRAME (admission is all-or-nothing
+               per frame, so a rejected frame left no partial state).
+``stream-end``   ``{"cmd":"stream-end","job_id":...}`` — no more
+               records; the job finishes its tail (MSA/summary) and
+               lands terminal.  Follow with ``result`` to wait.
 ``status``     ``{"cmd":"status","job_id":...}`` — non-blocking state.
 ``result``     ``{"cmd":"result","job_id":...[,"wait":bool,
                "timeout":s]}`` — the terminal verdict (rc, per-job

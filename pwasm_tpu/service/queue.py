@@ -75,7 +75,12 @@ class Job:
     #   or the submit frame's client= field); "" = anonymous bucket
     priority: str = ""                 # priority lane ("" = default)
     prefer_lane: int | None = None     # device-lane affinity hint (a
-    #   journal-recovered job asks for the lane it ran on)
+    #   journal-recovered job asks for the lane it ran on; a stream
+    #   job asks for the lane its client's last stream warmed)
+    stream: bool = False               # socket-streamed job: input
+    #   arrives as stream-data frames, not a file (docs/STREAMING.md)
+    feed: object = field(default=None, repr=False)  # the job's
+    #   StreamFeed (stream.pafstream) when stream is True
     recovered: bool = False            # re-admitted by journal replay
     seq: int = 0                       # global admission order (drain
     #   and journal replay preserve it across the per-client deques)
@@ -110,6 +115,7 @@ class Job:
             "cancel_requested": self.cancel_requested,
             "client": self.client,
             "priority": self.priority,
+            "stream": self.stream,
             "recovered": self.recovered,
             "submitted_s": round(self.submitted_s, 3),
             "started_s": round(self.started_s, 3)
@@ -334,6 +340,133 @@ class JobQueue:
             self._client_counts.clear()
             self._cond.notify_all()
             return waiting
+
+
+class StreamBook:
+    """Per-stream admission quotas + fair-share buffer arbitration
+    (ISSUE 10).
+
+    A stream job's records live in its :class:`~pwasm_tpu.stream.
+    pafstream.StreamFeed` buffer between the ``stream-data`` frame
+    that carried them and the worker that drains them.  Unbounded,
+    that buffer is the same OOM-with-extra-steps the job queue's
+    admission control exists to prevent — so every feed is gated here
+    BEFORE the chunk is committed:
+
+    - **per-stream quota** (``max_buffer`` records, the ``serve
+      --stream-buffer`` knob): one stream whose producer outruns its
+      consumer answers ``queue_full`` (the protocol's 429 — the client
+      backs off on ``retry_backoff_s`` and resends the same frame);
+    - **fair share under the global ceiling** (``max_total``, default
+      ``4 x max_buffer``): once the streams TOGETHER hit the ceiling,
+      a feed is admitted only while that stream sits at or under its
+      equal credit share (``max_total / active_streams`` — unit-cost
+      DRR degenerates to exactly this equal rotation, the same
+      property :class:`_LaneSched` documents).  A heavy stream at the
+      ceiling gets backpressure while a light one under its share
+      keeps feeding: heavy cannot starve light, the fair-share
+      acceptance leg.
+
+    Scheduling BETWEEN stream jobs (which one a worker picks up) rides
+    the existing weighted-DRR-over-clients dequeue above — streams are
+    ordinary jobs to the queue.  Checks are all-or-nothing per frame,
+    so a rejected frame is resendable verbatim."""
+
+    def __init__(self, max_buffer: int = 512,
+                 max_total: int | None = None):
+        self.max_buffer = max(1, int(max_buffer))
+        self.max_total = max(self.max_buffer, int(max_total)) \
+            if max_total is not None else self.max_buffer * 4
+        self._streams: dict[str, tuple[str, object]] = {}
+        self._clients_seen: set[str] = set()   # label universe for the
+        #   lag gauge: a finished stream's client reads 0, not gone
+        self._done = {"records_in": 0, "records_out": 0, "batches": 0}
+        #   retired streams' flow counters — svc-stats totals stay
+        #   cumulative after a stream finishes
+        self._lock = threading.Lock()
+
+    def register(self, job_id: str, client: str, feed) -> None:
+        with self._lock:
+            self._streams[job_id] = (client, feed)
+            self._clients_seen.add(client)
+
+    def unregister(self, job_id: str) -> None:
+        with self._lock:
+            row = self._streams.pop(job_id, None)
+            if row is not None:
+                feed = row[1]
+                self._done["records_in"] += feed.records_in
+                self._done["records_out"] += feed.records_out
+                self._done["batches"] += feed.batches
+
+    def active(self) -> int:
+        with self._lock:
+            return len(self._streams)
+
+    def admit(self, job_id: str, n: int) -> None:
+        """Gate ``n`` more records into ``job_id``'s buffer; raises
+        :class:`QueueFull` (quota or fair-share — the message names
+        which) instead of admitting.  Unknown streams admit freely:
+        the daemon validates the job before calling here.
+
+        A stream whose buffer is EMPTY always admits, even a frame
+        larger than the whole quota: the protocol's backoff contract
+        is "resend the same frame", so a frame that could never fit
+        would livelock the retry dance on an otherwise idle daemon.
+        Progress beats strictness — the overage is bounded by one
+        already-received frame per stream (the frame ceiling bounds
+        its size), and the very next frame backpressures until the
+        job drains the buffer back under quota."""
+        with self._lock:
+            row = self._streams.get(job_id)
+            if row is None:
+                return
+            _client, feed = row
+            buffered = feed.buffered
+            if not buffered:
+                return
+            if buffered + n > self.max_buffer:
+                raise QueueFull(
+                    f"stream {job_id} at its buffer quota "
+                    f"({self.max_buffer} records)")
+            total = sum(f.buffered
+                        for _c, f in self._streams.values())
+            if total + n > self.max_total:
+                share = max(1, self.max_total
+                            // max(1, len(self._streams)))
+                if buffered + n > share:
+                    raise QueueFull(
+                        f"streams at the global buffer ceiling "
+                        f"({self.max_total} records); stream "
+                        f"{job_id} is over its fair share ({share})")
+
+    def totals(self) -> dict:
+        """The roll-up the ``svc-stats`` ``streams`` block reports:
+        ``active``/``buffered`` are live, the flow counters are
+        cumulative over the daemon's whole life (live + retired)."""
+        with self._lock:
+            feeds = [f for _c, f in self._streams.values()]
+            return {
+                "active": len(feeds),
+                "buffered": sum(f.buffered for f in feeds),
+                "records_in": self._done["records_in"]
+                + sum(f.records_in for f in feeds),
+                "records_out": self._done["records_out"]
+                + sum(f.records_out for f in feeds),
+                "batches": self._done["batches"]
+                + sum(f.batches for f in feeds),
+            }
+
+    def client_lag(self) -> dict[str, int]:
+        """Buffered (fed-but-unconsumed) records per client — the
+        ``pwasm_stream_lag_records`` gauge source.  Every client that
+        ever streamed keeps a series at 0 (a vanished series reads as
+        a scrape gap, not an emptied buffer)."""
+        with self._lock:
+            out = {c: 0 for c in self._clients_seen}
+            for client, feed in self._streams.values():
+                out[client] = out.get(client, 0) + feed.buffered
+            return out
 
 
 class ServiceStats:

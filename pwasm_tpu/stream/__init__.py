@@ -1,0 +1,28 @@
+"""Streaming ingestion + multi-CDS jobs (ISSUE 10).
+
+Two workload shapes the one-CDS/one-file/one-shot CLI left closed
+(ROADMAP item 3):
+
+- **streaming** (``pafstream``): PAF records arrive incrementally —
+  from a growing file (``--follow``, the minimap2-pipe-into-a-file use
+  case) or over the service socket (``stream``/``stream-data``/
+  ``stream-end`` frames) — and accumulate into the EXISTING
+  flush-cadence batches, emitting report bytes as batches fill and
+  riding the batch-boundary checkpoint machinery, so a stream is
+  preemptible/resumable and journal-replayable like any run;
+- **many-to-many** (``multicds``): one multi-CDS submit scores every
+  query in the FASTA against every target through ONE device session
+  (``parallel.many2many_scores_ragged`` + the bucketing library)
+  instead of N sequential jobs.
+
+Like ``pwasm_tpu/service/`` and ``pwasm_tpu/obs/``, this package is
+host-side and jax-free (gated by
+``qa/check_supervision.py::find_stream_violations``): device work is
+reached only through the supervised sites in ``pwasm_tpu/parallel/``,
+imported lazily inside the dispatch path.
+"""
+
+from pwasm_tpu.stream.pafstream import (FollowReader, LineAssembler,
+                                        StreamFeed)
+
+__all__ = ["FollowReader", "LineAssembler", "StreamFeed"]

@@ -1,0 +1,271 @@
+"""Many-to-many jobs: one multi-CDS submit, one device session.
+
+BASELINE.md config 3's shape (ROADMAP item 3b): hundreds of bacterial
+CDS queries scored against many assembly targets.  Run naively that is
+N sequential jobs — N interpreter startups, N backend probes, N
+compile-cache warmups — for work that is one embarrassingly-parallel
+(Q x T) batch.  This driver is the job type that amortizes all of it:
+every query in the ``-r`` FASTA scores against every target in the
+positional FASTA through ONE ``many2many_scores_ragged`` session
+(queries bucketed by exact length, targets padded per query bucket —
+``parallel/bucketing.py``), under ONE backend probe and ONE
+``BatchSupervisor`` ``many2many`` site (retries, guardrails, TPU→CPU
+degradation all inherited).
+
+Output contract (the parity gate ``tests/test_stream.py`` enforces):
+the report is a sequence of per-CDS sections, each depending only on
+(that query, the targets) —
+
+.. code-block:: text
+
+    >cds1	1500	200          # query id, query length, target count
+    asm000	101442	1423         # target id, target length, score
+    ...
+
+— so a multi-CDS job's section bytes are IDENTICAL to N single-CDS
+runs of the same driver, and the ``-s`` summary (one roll-up line per
+CDS: id, targets, best target, best score, score sum) concatenates the
+same way.  What changes is the cost: one session instead of N
+(``backend.probes + backend.warm_hits == 1`` in ``--stats``), and the
+bench leg ``realistic_many2many_vs_sequential_ratio`` gates the
+multiplier.
+
+Scores are the banded affine-gap DP global scores (``NEG`` for pairs
+whose end diagonal no band placement covers — rendered as ``.`` so a
+"no alignment under this band" verdict is explicit, not a plausible
+number).
+
+jax-free at module level (the ``find_stream_violations`` gate): the
+device stack loads lazily inside :func:`many2many_main`, exactly like
+``cli._main_loop`` does.
+"""
+
+from __future__ import annotations
+
+from pwasm_tpu.core.errors import EXIT_USAGE, PwasmError
+
+M2M_USAGE = """Usage:
+ pafreport --many2many <targets.fa> -r <cds_multi.fa> [-o <scores.tsv>]
+    [-s <summary.txt>] [--device=cpu|tpu] [--band=N] [--stats=FILE]
+    [--max-retries=N] [--fallback=cpu|fail] [-v]
+
+   Score EVERY query in the -r FASTA against EVERY target in
+   <targets.fa> through one device session (banded affine-gap DP,
+   parallel/many2many.py).  The report is one section per CDS
+   (">id\\tlen\\tn_targets" then one "target\\tlen\\tscore" row per
+   target, in FASTA order); -s writes one roll-up line per CDS
+   (id, targets, best target, best score, score sum).  Sections are
+   byte-identical to running each CDS as its own job — the multi
+   submit only amortizes the session.
+"""
+
+
+class M2mUsageError(PwasmError):
+    exit_code = EXIT_USAGE
+
+
+def _usage_err(msg: str) -> M2mUsageError:
+    return M2mUsageError(f"{M2M_USAGE}\n{msg}\n")
+
+
+def format_sections(qnames, qlens, tnames, tlens, scores, neg) -> str:
+    """Render the per-CDS report sections (pure, unit-testable).  One
+    query's section reads only its own score row, so multi-vs-single
+    byte parity holds by construction."""
+    out = []
+    for qi, qn in enumerate(qnames):
+        out.append(f">{qn}\t{qlens[qi]}\t{len(tnames)}\n")
+        row = scores[qi]
+        for ti, tn in enumerate(tnames):
+            s = int(row[ti])
+            out.append(f"{tn}\t{tlens[ti]}\t"
+                       f"{'.' if s == neg else s}\n")
+    return "".join(out)
+
+
+def format_summary(qnames, tnames, scores, neg) -> str:
+    """One roll-up line per CDS: ``id  n_targets  best_target
+    best_score  score_sum`` (ties break to FASTA order; an all-NEG row
+    reports ``.`` — nothing aligned under the band)."""
+    out = []
+    for qi, qn in enumerate(qnames):
+        row = [int(v) for v in scores[qi]]
+        live = [(v, ti) for ti, v in enumerate(row) if v != neg]
+        if live:
+            best, bi = max(live, key=lambda p: (p[0], -p[1]))
+            total = sum(v for v, _t in live)
+            out.append(f"{qn}\t{len(tnames)}\t{tnames[bi]}\t{best}"
+                       f"\t{total}\n")
+        else:
+            out.append(f"{qn}\t{len(tnames)}\t.\t.\t0\n")
+    return "".join(out)
+
+
+def many2many_main(opts: dict, positional: list, stdout, stderr,
+                   warm=None) -> int:
+    """The ``--many2many`` job type (dispatched from ``cli.run``, so it
+    is submittable to the serve daemon like any other job and shares
+    the warm-context contract: one probe, inherited supervisor state,
+    per-lane placement under a device lease)."""
+    from pwasm_tpu.core.fasta import FastaFile
+    from pwasm_tpu.utils import RunStats
+
+    for bad, why in (("w", "builds an MSA"), ("ace", "builds an MSA"),
+                     ("info", "builds an MSA"), ("cons", "builds an "
+                      "MSA"), ("realign", "rewrites PAF gaps"),
+                     ("follow", "tails a PAF"), ("resume", "resumes a "
+                      "report"), ("shard", "is a report-path knob")):
+        if bad in opts:
+            raise _usage_err(f"Error: --many2many scores sequences; "
+                             f"-{'-' if len(bad) > 1 else ''}{bad} "
+                             f"{why} and does not apply")
+    if len(positional) != 1:
+        raise _usage_err("Error: --many2many takes exactly one "
+                         "<targets.fa> argument")
+    rpath = opts.get("r")
+    if not rpath or rpath is True:
+        raise _usage_err("Error: query FASTA file (-r) is required!")
+    device = str(opts.get("device", "cpu"))
+    if device not in ("cpu", "tpu"):
+        raise _usage_err(f"Error: invalid --device value: {device}")
+    band = 64
+    if "band" in opts:
+        val = opts["band"]
+        if val is True or not str(val).isascii() \
+                or not str(val).isdigit() or int(val) < 1:
+            raise _usage_err(f"Error: invalid --band value: {val}")
+        band = int(val)
+    max_retries = 2
+    if "max-retries" in opts:
+        val = opts["max-retries"]
+        if val is True or not str(val).isascii() \
+                or not str(val).isdigit():
+            raise _usage_err(
+                f"Error: invalid --max-retries value: {val}")
+        max_retries = int(val)
+    fallback = str(opts.get("fallback", "cpu"))
+    if fallback not in ("cpu", "fail"):
+        raise _usage_err(f"Error: invalid --fallback value: {fallback}")
+    verbose = bool(opts.get("v")) or bool(opts.get("D"))
+
+    def load_fasta(path, what):
+        try:
+            fa = FastaFile(str(path))
+        except (OSError, PwasmError):
+            raise PwasmError(
+                f"Error: invalid FASTA file {path} !\n")
+        if not len(fa):
+            raise PwasmError(
+                f"Error: invalid FASTA file {path} !\n")
+        seqs = []
+        for name in fa.names:
+            s = fa.fetch(name)
+            if not s:
+                raise PwasmError(
+                    f"Error: could not retrieve sequence for {name} "
+                    f"({what})!\n")
+            seqs.append(s.upper())
+        return fa.names, seqs
+
+    qnames, qs = load_fasta(rpath, "-r query")
+    tnames, ts = load_fasta(positional[0], "target")
+    stats = RunStats()
+    stats.lines = len(qs) * len(ts)
+
+    # the one session gate: identical to cli._main_loop's — a bounded
+    # probe before the first jax touch, demoting loudly to cpu, with
+    # per-run probe/warm-hit accounting (the "one warm device session"
+    # acceptance reads these)
+    use_device = device == "tpu"
+    if use_device:
+        from pwasm_tpu.utils import backend as _backend
+        from pwasm_tpu.utils.backend import device_backend_reachable
+        _p0 = _backend.probe_counters["probes"]
+        _w0 = _backend.probe_counters["warm_hits"]
+        ok, why = device_backend_reachable()
+        stats.backend_probes += \
+            _backend.probe_counters["probes"] - _p0
+        stats.backend_warm_hits += \
+            _backend.probe_counters["warm_hits"] - _w0
+        if not ok:
+            print(f"Warning: jax backend unreachable ({why.strip()}); "
+                  "running with --device=cpu", file=stderr)
+            use_device = False
+            stats.engine_fallbacks += 1
+    if not use_device:
+        # never let a pinned-but-unhealthy TPU tunnel hijack a cpu
+        # scoring job at backend init (same guard as flush_realign;
+        # via the compat shim so this module stays textually jax-free
+        # for the find_stream_violations gate)
+        from pwasm_tpu.utils.jaxcompat import pin_cpu_platform
+        pin_cpu_platform()
+    else:
+        from pwasm_tpu.ops import enable_compilation_cache
+        enable_compilation_cache()
+
+    from pwasm_tpu.resilience import BatchSupervisor, ResiliencePolicy
+    supervisor = BatchSupervisor(
+        ResiliencePolicy(max_retries=max_retries, fallback=fallback),
+        stats=stats, stderr=stderr)
+    if warm is not None and getattr(warm, "supervisor_state", None):
+        supervisor.restore_state(warm.supervisor_state)
+
+    from types import SimpleNamespace
+
+    from pwasm_tpu.cli import _lane_device_scope
+    from pwasm_tpu.ops.banded_dp import NEG
+    from pwasm_tpu.parallel.many2many import many2many_scores_ragged
+    if verbose:
+        print(f"many2many: {len(qs)} quer"
+              f"{'y' if len(qs) == 1 else 'ies'} x {len(ts)} "
+              f"target(s), band {band}, one "
+              f"{'device' if use_device else 'cpu'} session",
+              file=stderr)
+    # a served job holding a device lease places on ITS lane, exactly
+    # like cli._main_loop jobs (the ISSUE 8 lane-isolation contract);
+    # inert for cold runs and single-lane daemons.  (Spanning a
+    # MULTI-device lease with a 2-D mesh is the ROADMAP item-3
+    # remaining work — today the session stays single-device.)
+    with _lane_device_scope(
+            SimpleNamespace(device="tpu" if use_device else "cpu"),
+            warm, stderr):
+        scores = many2many_scores_ragged(qs, ts, band=band,
+                                         supervisor=supervisor)
+    stats.alignments = len(qs) * len(ts)
+    stats.aligned_bases = sum(len(t) for t in ts) * len(qs)
+    stats.device_batches = 0   # the ragged driver dispatches per
+    #   bucket; the supervisor's site counters carry the attempt story
+
+    body = format_sections(qnames, [len(q) for q in qs], tnames,
+                           [len(t) for t in ts], scores, NEG)
+    if "o" in opts:
+        try:
+            with open(str(opts["o"]), "w") as f:
+                f.write(body)
+        except OSError:
+            raise PwasmError(
+                f"Cannot open file {opts['o']} for writing!\n")
+    else:
+        stdout.write(body)
+    if "s" in opts:
+        try:
+            with open(str(opts["s"]), "w") as f:
+                f.write(format_summary(qnames, tnames, scores, NEG))
+        except OSError:
+            raise PwasmError(
+                f"Cannot open file {opts['s']} for writing!\n")
+    supervisor.finalize_stats()
+    if warm is not None:
+        warm.supervisor_state = {
+            k: v for k, v in supervisor.export_state().items()
+            if k != "fault_calls"}
+    if "stats" in opts:
+        try:
+            with open(str(opts["stats"]), "w") as f:
+                stats.write(f)
+        except OSError:
+            raise PwasmError(
+                f"Cannot open file {opts['stats']} for writing!\n")
+    if verbose:
+        print(stats.brief(), file=stderr)
+    return 0

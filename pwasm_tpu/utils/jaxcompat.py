@@ -71,3 +71,19 @@ def pcast(x, axis_name, to: str = "varying"):
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, axis_name, to=to)
     return x
+
+
+def pin_cpu_platform() -> None:
+    """Pin jax to the CPU backend before its first init — a
+    ``--device=cpu`` job must never touch a (possibly unhealthy) TPU
+    tunnel.  A no-op once a backend is already up (``update`` raises
+    then; callers deliberately keep whatever is live).  Lives here so
+    textually-jax-free layers (``pwasm_tpu/stream/``, gated by
+    ``find_stream_violations``) can request the pin without importing
+    jax themselves."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass

@@ -54,6 +54,11 @@ _FSIO = "pwasm_tpu/utils/fsio.py"
 REGISTRY = {
     _FSIO: "impl: the one audited fsync-then-replace "
            "(write tmp -> fsync tmp -> os.replace -> fsync parent dir)",
+    "tests/test_stream.py":
+        "exempt: simulates an EXTERNAL writer's log rotation "
+        "(logrotate-style replace of the tailed PAF) to exercise "
+        "FollowReader's inode tracking — deliberately not a durable "
+        "publish of repo state",
 }
 
 # fsync registry: modules allowed a raw os.fsync.  fsio.py is the impl

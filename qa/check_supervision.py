@@ -75,6 +75,12 @@ REGISTRY = {
 # init into all three.
 SERVICE_DIR = "pwasm_tpu/service"
 OBS_DIR = "pwasm_tpu/obs"
+# pwasm_tpu/stream/ (ISSUE 10) is held to the same jax-free rule: the
+# streaming ingestion readers run inside the daemon and around signal
+# handling, and the multi-CDS driver is a HOST driver — its device
+# work is reached only through the supervised many2many site in
+# pwasm_tpu/parallel/ (imported lazily, like cli._main_loop does).
+STREAM_DIR = "pwasm_tpu/stream"
 SERVICE_PATTERNS = re.compile(
     r"^\s*(?:import\s+jax\b|from\s+jax[.\s])|jax\.jit|jax\.device_put"
     r"|jax\.device_get|\.block_until_ready\s*\(")
@@ -181,6 +187,13 @@ def find_obs_violations(root: str = REPO) -> list[str]:
     return _find_jaxfree_violations(root, OBS_DIR, "obs")
 
 
+def find_stream_violations(root: str = REPO) -> list[str]:
+    """Streaming-layer jax use (ISSUE 10): pwasm_tpu/stream/ must stay
+    jax-free — device work belongs behind the supervised sites in
+    pwasm_tpu/parallel/, reached via lazy imports."""
+    return _find_jaxfree_violations(root, STREAM_DIR, "stream")
+
+
 def find_sharding_violations(root: str = REPO) -> list[str]:
     """Bare sharding/collective API use outside the jaxcompat shim
     (module docstring: the ISSUE 8 routing rule)."""
@@ -270,6 +283,7 @@ def main() -> int:
     stale = stale_registry_entries()
     svc = find_service_violations()
     obs = find_obs_violations()
+    stream = find_stream_violations()
     metric = find_metric_lint()
     sharding = find_sharding_violations()
     for line in bad:
@@ -277,7 +291,7 @@ def main() -> int:
     for rel in stale:
         print(f"{rel}: stale registry entry (no device entry points "
               "left — remove it)", file=sys.stderr)
-    for line in svc + obs + metric + sharding:
+    for line in svc + obs + stream + metric + sharding:
         print(line, file=sys.stderr)
     if bad:
         print(f"\n{len(bad)} device entry point(s) outside the "
@@ -285,11 +299,12 @@ def main() -> int:
               "through a supervised site (resilience/supervisor.py) or "
               "register the module in qa/check_supervision.py with a "
               "justification.", file=sys.stderr)
-    if svc or obs:
-        print(f"\n{len(svc) + len(obs)} direct jax use(s) in "
-              "pwasm_tpu/service/ or pwasm_tpu/obs/.  These layers "
-              "reach the device only through cli.run's supervised "
-              "sites — move the device work there.", file=sys.stderr)
+    if svc or obs or stream:
+        print(f"\n{len(svc) + len(obs) + len(stream)} direct jax "
+              "use(s) in pwasm_tpu/service/, pwasm_tpu/obs/ or "
+              "pwasm_tpu/stream/.  These layers reach the device "
+              "only through supervised sites — move the device work "
+              "there.", file=sys.stderr)
     if metric:
         print(f"\n{len(metric)} metric-name lint failure(s): all "
               "registrations live in pwasm_tpu/obs/catalog.py with "
@@ -300,7 +315,7 @@ def main() -> int:
               f"use(s): import shard_map/psum/ppermute/pcast from "
               f"{JAXCOMPAT} instead, so a jax pin change costs one "
               "edit there.", file=sys.stderr)
-    return 1 if (bad or stale or svc or obs or metric
+    return 1 if (bad or stale or svc or obs or stream or metric
                  or sharding) else 0
 
 

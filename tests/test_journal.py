@@ -614,10 +614,15 @@ def test_daemon_quota_names_client_and_spares_others(tmp_path):
 
 def test_daemon_light_client_scheduled_before_heavy_backlog(tmp_path):
     done_order: list = []
+    gate = threading.Event()   # holds the worker until every submit
+    #   has landed — otherwise a fast runner on a slow box can drain
+    #   several heavy jobs before the light submit even arrives, and
+    #   the DRR-order assertion below races the socket round-trips
 
     def runner(argv, stdout=None, stderr=None, warm=None):
+        gate.wait(30)
         tag = next(a for a in argv if a.endswith(".dfa"))
-        time.sleep(0.05)
+        time.sleep(0.01)
         done_order.append(os.path.basename(tag))
         return 0
 
@@ -632,6 +637,7 @@ def test_daemon_light_client_scheduled_before_heavy_backlog(tmp_path):
                               str(tmp_path / "light.dfa")],
                              cwd=str(tmp_path), client="light")
             assert light.get("ok")
+            gate.set()
             assert c.result(light["job_id"], timeout=60)["rc"] == 0
             for s in heavy:
                 assert c.result(s["job_id"], timeout=60)["rc"] == 0

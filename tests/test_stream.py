@@ -1,0 +1,919 @@
+"""Streaming ingestion + multi-CDS jobs (ISSUE 10).
+
+Acceptance contracts:
+
+- **incremental == whole-file**: a streamed run — follow-mode tail of
+  a growing file, or stream-data frames over the service socket, with
+  records arriving at fuzzed (non-record-aligned) chunk boundaries —
+  produces report/-s bytes identical to the one-shot CLI run over the
+  same records (incl. the realistic 200-alignment corpus);
+- **preemptible/resumable**: a mid-stream SIGTERM drains at a batch
+  boundary → exit 75 with a valid checkpoint → ``--resume`` over the
+  completed records finishes byte-identically; a daemon kill -9
+  mid-stream replays the journal, lands the stream terminal
+  preempted-RESUMABLE, and a re-opened ``--resume`` stream completes
+  byte-identically;
+- **fair share**: a heavy stream at its buffer quota gets queue_full
+  backpressure (the client helper backs off on ``retry_backoff_s``)
+  while a light concurrent stream feeds and finishes unimpeded;
+- **multi-CDS**: a ``--many2many`` job's per-CDS report sections and
+  summary roll-up are byte-identical to N single-CDS runs while
+  paying ONE backend reachability check (one warm device session).
+"""
+
+import io
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from pwasm_tpu.cli import run
+from pwasm_tpu.core.errors import EXIT_PREEMPTED, EXIT_USAGE
+from pwasm_tpu.core.fasta import write_fasta
+from pwasm_tpu.service import protocol
+from pwasm_tpu.service.client import ServiceClient, wait_for_socket
+from pwasm_tpu.service.daemon import Daemon
+from pwasm_tpu.service.queue import QueueFull, StreamBook
+from pwasm_tpu.stream.pafstream import (FollowReader, LineAssembler,
+                                        StreamFeed)
+
+from helpers import make_paf_line
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the deterministic SLOW job of test_service.py: every supervised
+# device call sleeps, stretching wall time without changing bytes
+SLOW = "--inject-faults=seed=1,rate=1,kinds=hang,hang_s=0.25"
+
+
+def _corpus(tmp_path, n=16, qlen=120, seed=3):
+    rng = np.random.default_rng(seed)
+    q = "".join("ACGT"[i] for i in rng.integers(0, 4, qlen))
+    lines = []
+    for i in range(n):
+        cut = 10 + int(rng.integers(0, qlen - 40))
+        qb = q[cut]
+        tb = "ACGT"[("ACGT".index(qb) + 1) % 4]
+        ops = [("=", cut), ("*", tb, qb), ("=", 20), ("ins", "gg"),
+               ("=", qlen - cut - 21)]
+        lines.append(make_paf_line("q", q, f"asm{i}", "+", ops)[0])
+    fa = tmp_path / "q.fa"
+    write_fasta(str(fa), [("q", q.encode())])
+    paf = tmp_path / "in.paf"
+    paf.write_text("".join(ln + "\n" for ln in lines))
+    return str(paf), str(fa), lines
+
+
+def _oneshot(tmp_path, tag, paf, fa, extra=()):
+    err = io.StringIO()
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / f"{tag}.dfa"),
+              "-s", str(tmp_path / f"{tag}.sum"), "--batch=4"]
+             + list(extra), stderr=err)
+    assert rc == 0, err.getvalue()[:2000]
+    return ((tmp_path / f"{tag}.dfa").read_bytes(),
+            (tmp_path / f"{tag}.sum").read_bytes())
+
+
+def _fuzz_chunks(text, n_cuts, seed):
+    rng = np.random.default_rng(seed)
+    cuts = sorted(set(rng.integers(1, len(text),
+                                   n_cuts).tolist())) + [len(text)]
+    chunks, prev = [], 0
+    for c in cuts:
+        if c > prev:
+            chunks.append(text[prev:c])
+            prev = c
+    return chunks
+
+
+# ---------------------------------------------------------------------------
+# units: assembler, follow reader, feed, quota book
+# ---------------------------------------------------------------------------
+def test_line_assembler_fuzzed_chunking_rebuilds_lines():
+    rng = np.random.default_rng(11)
+    for trial in range(20):
+        lines = [f"rec{k}\tpayload{'x' * int(rng.integers(0, 9))}\n"
+                 for k in range(int(rng.integers(1, 30)))]
+        text = "".join(lines)
+        if rng.random() < 0.5:
+            text = text[:-1]       # final record without its newline
+        asm = LineAssembler()
+        got = []
+        for ch in _fuzz_chunks(text, int(rng.integers(1, 40)),
+                               int(rng.integers(0, 1 << 30))):
+            assert asm.completed(ch) == ch.count("\n")
+            got.extend(asm.push(ch))
+        got.extend(asm.flush())
+        assert "".join(got) == text         # nothing lost or reordered
+        assert len(got) == len(lines)       # record boundaries exact
+        assert asm.pending == ""
+
+
+def test_follow_reader_tails_growth_and_survives_rotation(tmp_path):
+    path = str(tmp_path / "grow.paf")
+    open(path, "w").close()
+    rd = FollowReader(path, idle_timeout_s=0.4, poll_s=0.01)
+
+    def writer():
+        with open(path, "a") as f:
+            f.write("a1\na2\npar")     # partial line stays pending
+            f.flush()
+            time.sleep(0.05)
+            f.write("tial\n")
+            f.flush()
+        time.sleep(0.05)
+        # rotation: replace the file wholesale (new inode)
+        with open(path + ".new", "w") as f:
+            f.write("b1\nb2")           # final record, no newline
+        os.replace(path + ".new", path)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    got = list(rd)
+    t.join()
+    rd.close()
+    assert got == ["a1\n", "a2\n", "partial\n", "b1\n", "b2"]
+    assert rd.rotations == 1
+
+
+def test_stream_feed_batches_lag_and_final_partial():
+    feed = StreamFeed()
+    batches = []
+    feed.on_batch = batches.append
+    feed.feed("r1\nr2\nr3")
+    assert feed.buffered == 2 and feed.records_in == 2
+    assert next(feed) == "r1\n" and next(feed) == "r2\n"
+    assert feed.buffered == 0 and feed.records_out == 2
+    assert batches == [2]          # one arrival batch drained
+    feed.feed("-tail\nlast")
+    feed.end()                     # the newline-less tail arrives now
+    assert list(feed) == ["r3-tail\n", "last"]
+    assert feed.batches == 2 and feed.records_out == 4
+    with pytest.raises(ValueError):
+        feed.feed("too late\n")
+
+
+def test_stream_feed_drain_wakes_blocked_consumer():
+    feed = StreamFeed()
+    drain = SimpleNamespace(requested=False)
+    feed.bind_drain(drain)
+    got = []
+
+    def consume():
+        got.extend(feed)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.1)
+    assert t.is_alive()            # blocked waiting for records
+    drain.requested = True
+    t.join(5)
+    assert not t.is_alive() and got == []
+
+
+def test_stream_book_quota_and_fair_share():
+    def fake(buffered):
+        return SimpleNamespace(buffered=buffered, records_in=buffered,
+                               records_out=0, batches=0)
+
+    book = StreamBook(max_buffer=10)   # global ceiling 40
+    heavy, light = fake(0), fake(0)
+    book.register("h", "heavy", heavy)
+    book.register("l", "light", light)
+    book.admit("h", 10)                # exactly at quota: fine
+    book.admit("h", 999)  # EMPTY buffer always admits, even a frame
+    #   past the whole quota — "resend the same frame" must be able
+    #   to make progress, never livelock on an idle daemon
+    heavy.buffered = 10
+    with pytest.raises(QueueFull, match="buffer quota"):
+        book.admit("h", 1)             # per-stream quota
+    heavy.buffered = heavy.records_in = 9
+    # drive past the GLOBAL ceiling with more streams (43 > 40)
+    light.buffered = light.records_in = 1
+    for k in range(3):
+        book.register(f"o{k}", f"c{k}", fake(11))
+    # fair share = 40/5 = 8.  heavy (at 9, under its quota but over
+    # its share) is refused; light (at 1, under) still feeds.
+    with pytest.raises(QueueFull, match="fair share"):
+        book.admit("h", 1)
+    book.admit("l", 7)
+    with pytest.raises(QueueFull, match="fair share"):
+        book.admit("l", 8)
+    lag = book.client_lag()
+    assert lag["heavy"] == 9 and lag["light"] == 1
+    # retirement folds flow counters into the cumulative totals
+    book.unregister("h")
+    tot = book.totals()
+    assert tot["active"] == 4 and tot["records_in"] == 43
+    assert book.client_lag()["heavy"] == 0   # series stays, reads 0
+
+
+# ---------------------------------------------------------------------------
+# follow mode end to end
+# ---------------------------------------------------------------------------
+def test_follow_mode_byte_parity_with_oneshot(tmp_path):
+    paf, fa, lines = _corpus(tmp_path)
+    want = _oneshot(tmp_path, "one", paf, fa)
+    grow = str(tmp_path / "grow.paf")
+    open(grow, "w").close()
+    text = "".join(ln + "\n" for ln in lines)
+
+    def writer():
+        with open(grow, "a") as f:
+            for ch in _fuzz_chunks(text, 40, seed=9):
+                f.write(ch)
+                f.flush()
+                time.sleep(0.005)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    err = io.StringIO()
+    rc = run([grow, "--follow=1.0", "-r", fa,
+              "-o", str(tmp_path / "fol.dfa"),
+              "-s", str(tmp_path / "fol.sum"), "--batch=4"],
+             stderr=err)
+    t.join()
+    assert rc == 0, err.getvalue()[:2000]
+    assert ((tmp_path / "fol.dfa").read_bytes(),
+            (tmp_path / "fol.sum").read_bytes()) == want
+
+
+def test_follow_crlf_input_byte_parity_with_oneshot(tmp_path):
+    """The one-shot CLI opens its input in text mode (universal
+    newlines), so a CRLF PAF must stream to the same bytes — incl. a
+    \\r\\n split exactly across two appends."""
+    paf, fa, lines = _corpus(tmp_path)
+    crlf = str(tmp_path / "crlf.paf")
+    open(crlf, "w", newline="").write(
+        "".join(ln + "\r\n" for ln in lines))
+    want = _oneshot(tmp_path, "one", crlf, fa)
+    grow = str(tmp_path / "grow.paf")
+    open(grow, "w").close()
+
+    def writer():
+        with open(grow, "a", newline="") as f:
+            for ln in lines:
+                f.write(ln + "\r")    # the \r lands first...
+                f.flush()
+                time.sleep(0.005)
+                f.write("\n")         # ...its \n a poll later
+                f.flush()
+
+    t = threading.Thread(target=writer)
+    t.start()
+    err = io.StringIO()
+    rc = run([grow, "--follow=1.0", "-r", fa,
+              "-o", str(tmp_path / "fol.dfa"),
+              "-s", str(tmp_path / "fol.sum"), "--batch=4"],
+             stderr=err)
+    t.join()
+    assert rc == 0, err.getvalue()[:2000]
+    assert ((tmp_path / "fol.dfa").read_bytes(),
+            (tmp_path / "fol.sum").read_bytes()) == want
+
+
+def test_stdin_dash_marker_reads_stdin(tmp_path, monkeypatch):
+    """`pafreport - ...` is the documented pipe shape: '-' reads
+    stdin exactly like the no-positional form."""
+    paf, fa, lines = _corpus(tmp_path, n=4)
+    want = _oneshot(tmp_path, "one", paf, fa)[0]
+    monkeypatch.setattr(
+        "sys.stdin", io.StringIO("".join(ln + "\n" for ln in lines)))
+    err = io.StringIO()
+    rc = run(["-", "-r", fa, "-o", str(tmp_path / "d.dfa"),
+              "--batch=4"], stderr=err)
+    assert rc == 0, err.getvalue()[:2000]
+    assert (tmp_path / "d.dfa").read_bytes() == want
+
+
+def test_follow_usage_errors(tmp_path):
+    from pwasm_tpu.cli import CliError
+
+    paf, fa, _ = _corpus(tmp_path, n=2)
+    with pytest.raises(CliError, match="Invalid --follow"):
+        run([paf, "--follow=nope", "-r", fa], stderr=io.StringIO())
+    with pytest.raises(CliError, match="requires an input PAF"):
+        run(["--follow", "-r", fa], stderr=io.StringIO())
+
+
+def test_follow_sigterm_midstream_exit75_then_resume_parity(tmp_path):
+    """Mid-stream preemption: SIGTERM a live --follow run after its
+    first durable checkpoint → exit 75; --resume over the COMPLETED
+    file finishes the report byte-identically (the -s summary is
+    excluded by the documented resume contract)."""
+    paf, fa, lines = _corpus(tmp_path, n=24)
+    want = _oneshot(tmp_path, "one", paf, fa)[0]
+    grow = str(tmp_path / "grow.paf")
+    open(grow, "w").close()
+    rep = str(tmp_path / "st.dfa")
+    old_pp = os.environ.get("PYTHONPATH", "")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + (os.pathsep + old_pp if old_pp
+                                  else ""))
+    sp = subprocess.Popen(
+        [sys.executable, "-m", "pwasm_tpu.cli", grow, "--follow",
+         "-r", fa, "-o", rep, "--batch=4"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        # feed enough for several durable batches, then hold the rest
+        with open(grow, "a") as f:
+            f.write("".join(ln + "\n" for ln in lines[:16]))
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if os.path.exists(rep + ".ckpt"):
+                break
+            assert sp.poll() is None, sp.stderr.read()[:2000]
+            time.sleep(0.02)
+        assert os.path.exists(rep + ".ckpt"), "no ckpt before signal"
+        sp.send_signal(__import__("signal").SIGTERM)
+        rc = sp.wait(timeout=60)
+        assert rc == EXIT_PREEMPTED, sp.stderr.read()[:2000]
+    finally:
+        if sp.poll() is None:
+            sp.kill()
+            sp.wait()
+        sp.stderr.close()
+    # the writer "finishes" the file; --resume completes the report
+    with open(grow, "a") as f:
+        f.write("".join(ln + "\n" for ln in lines[16:]))
+    err = io.StringIO()
+    rc = run([grow, "--resume", "-r", fa, "-o", rep, "--batch=4"],
+             stderr=err)
+    assert rc == 0, err.getvalue()[:2000]
+    assert open(rep, "rb").read() == want
+
+
+# ---------------------------------------------------------------------------
+# socket-stream mode end to end
+# ---------------------------------------------------------------------------
+def _daemon(**kw):
+    sockdir = tempfile.mkdtemp(prefix="pwstream")
+    sock = os.path.join(sockdir, "s")
+    err = io.StringIO()
+    dm = Daemon(sock, stderr=err, **kw)
+    rcbox: list = []
+    t = threading.Thread(target=lambda: rcbox.append(dm.serve()),
+                         daemon=True)
+    t.start()
+    assert wait_for_socket(sock, 15), err.getvalue()
+    return SimpleNamespace(daemon=dm, sock=sock, dir=sockdir,
+                           err=err, thread=t, rc=rcbox)
+
+
+def _stop(h):
+    if not h.daemon.drain.requested:
+        h.daemon.drain.request("test teardown")
+    h.thread.join(30)
+    shutil.rmtree(h.dir, ignore_errors=True)
+
+
+def test_socket_stream_fuzzed_chunks_byte_parity(tmp_path):
+    paf, fa, lines = _corpus(tmp_path)
+    want = _oneshot(tmp_path, "one", paf, fa)
+    text = "".join(ln + "\n" for ln in lines)
+    h = _daemon()
+    try:
+        with ServiceClient(h.sock) as c:
+            resp = c.stream(
+                ["-r", fa, "-o", str(tmp_path / "st.dfa"),
+                 "-s", str(tmp_path / "st.sum"), "--batch=4"],
+                iter(_fuzz_chunks(text, 30, seed=5)))
+            assert resp.get("ok") and resp["records"] == len(lines)
+            res = c.result(resp["job_id"], timeout=120)
+            assert res.get("rc") == 0, res
+            st = c.stats()["stats"]["streams"]
+        assert st["records_in"] == len(lines)
+        assert st["batches"] >= 1 and st["active"] == 0
+        assert ((tmp_path / "st.dfa").read_bytes(),
+                (tmp_path / "st.sum").read_bytes()) == want
+    finally:
+        _stop(h)
+
+
+def test_stream_admission_and_frame_validation(tmp_path):
+    paf, fa, _ = _corpus(tmp_path, n=4)
+    h = _daemon()
+    try:
+        with ServiceClient(h.sock) as c:
+            # a positional PAF in a stream argv is a bad_request
+            r = c.stream_open([paf, "-r", fa,
+                               "-o", str(tmp_path / "x.dfa")])
+            assert not r.get("ok") \
+                and r["error"] == protocol.ERR_BAD_REQUEST
+            assert "positional" in r["detail"]
+            r = c.stream_open(["--follow", "-r", fa,
+                               "-o", str(tmp_path / "x.dfa")])
+            assert not r.get("ok") and "--follow" in r["detail"]
+            # stream frames against a NON-stream job are bad_request
+            sub = c.submit([paf, "-r", fa,
+                            "-o", str(tmp_path / "sub.dfa")])
+            assert sub.get("ok")
+            r = c.stream_data(sub["job_id"], "x\n")
+            assert not r.get("ok") \
+                and r["error"] == protocol.ERR_BAD_REQUEST
+            # unknown ids are unknown
+            r = c.stream_data("job-9999", "x\n")
+            assert not r.get("ok") \
+                and r["error"] == protocol.ERR_UNKNOWN_JOB
+            # after stream-end, more data is rejected
+            so = c.stream_open(["-r", fa,
+                                "-o", str(tmp_path / "st.dfa")])
+            assert so.get("ok"), so
+            assert c.stream_data(so["job_id"], "").get("ok")
+            assert c.stream_end(so["job_id"]).get("ok")
+            r = c.stream_data(so["job_id"], "x\n")
+            assert not r.get("ok") \
+                and r["error"] == protocol.ERR_BAD_REQUEST
+            res = c.result(so["job_id"], timeout=60)
+            assert res.get("rc") == 0    # an empty stream: empty report
+    finally:
+        _stop(h)
+
+
+def test_stream_backpressure_heavy_cannot_starve_light(tmp_path):
+    """THE fair-share leg: a heavy stream whose producer floods a tiny
+    buffer gets queue_full backpressure (handled by the client
+    helper's capped-exponential backoff) while a light stream on the
+    same daemon feeds, runs, and finishes — before the heavy job is
+    even done.  Both byte-identical to their one-shot arms."""
+    paf, fa, lines = _corpus(tmp_path, n=30)
+    (tmp_path / "l").mkdir(exist_ok=True)
+    lpaf, lfa, llines = _corpus(tmp_path / "l", n=4, seed=8)
+    heavy_want = _oneshot(tmp_path, "oneh", paf, fa,
+                          ["--device=tpu"])[0]
+    light_want = _oneshot(tmp_path, "onel", lpaf, lfa)[0]
+    h = _daemon(max_concurrent=2, stream_buffer=4)
+    heavy_box: dict = {}
+
+    def heavy_run():
+        try:
+            with ServiceClient(h.sock) as c:
+                resp = c.stream(
+                    ["-r", fa, "-o", str(tmp_path / "hv.dfa"),
+                     "--batch=2", "--device=tpu", SLOW],
+                    iter([ln + "\n" for ln in lines]),
+                    client="heavy", max_retries=40)
+                heavy_box["open"] = resp
+                heavy_box["res"] = c.result(resp["job_id"],
+                                            timeout=240)
+        except Exception as e:       # surfaced by the main thread
+            heavy_box["err"] = e
+
+    t = threading.Thread(target=heavy_run)
+    t.start()
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline \
+                and not h.daemon.streams.active():
+            time.sleep(0.01)
+        assert h.daemon.streams.active() >= 1
+        with ServiceClient(h.sock) as c:
+            resp = c.stream(
+                ["-r", lfa, "-o", str(tmp_path / "lt.dfa"),
+                 "--batch=2"],
+                iter([ln + "\n" for ln in llines]), client="light")
+            assert resp.get("ok"), resp
+            assert resp["backpressure_waits"] == 0
+            res = c.result(resp["job_id"], timeout=120)
+            assert res.get("rc") == 0, res
+        t.join(240)
+        assert not t.is_alive()
+        assert "err" not in heavy_box, heavy_box.get("err")
+        assert heavy_box["open"]["backpressure_waits"] > 0
+        assert heavy_box["res"].get("rc") == 0, heavy_box["res"]
+        assert (tmp_path / "hv.dfa").read_bytes() == heavy_want
+        assert (tmp_path / "lt.dfa").read_bytes() == light_want
+        assert (heavy_box["res"]["job"]["finished_s"]
+                > res["job"]["finished_s"])
+    finally:
+        t.join(240)
+        _stop(h)
+
+
+def test_stream_drain_midstream_is_preempted_resumable(tmp_path):
+    """A service drain while a stream job waits for records: the job
+    exits 75 with a durable ckpt, and a re-opened --resume stream
+    over the full record set completes byte-identically."""
+    paf, fa, lines = _corpus(tmp_path)
+    want = _oneshot(tmp_path, "one", paf, fa)[0]
+    rep = str(tmp_path / "st.dfa")
+    h = _daemon()
+    try:
+        with ServiceClient(h.sock) as c:
+            so = c.stream_open(["-r", fa, "-o", rep, "--batch=4"])
+            assert so.get("ok"), so
+            c.stream_data(so["job_id"],
+                          "".join(ln + "\n" for ln in lines[:12]))
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline \
+                    and not os.path.exists(rep + ".ckpt"):
+                time.sleep(0.01)
+            assert os.path.exists(rep + ".ckpt")
+            c.drain()
+            res = c.result(so["job_id"], timeout=120)
+        assert res.get("rc") == EXIT_PREEMPTED, res
+        assert res["job"]["state"] == "preempted"
+        h.thread.join(30)
+        assert h.rc == [EXIT_PREEMPTED]
+    finally:
+        _stop(h)
+    # round 2 on a fresh daemon: --resume + the full record set
+    h = _daemon()
+    try:
+        with ServiceClient(h.sock) as c:
+            resp = c.stream(
+                ["-r", fa, "-o", rep, "--batch=4", "--resume"],
+                iter([ln + "\n" for ln in lines]))
+            assert resp.get("ok"), resp
+            res = c.result(resp["job_id"], timeout=120)
+            assert res.get("rc") == 0, res
+        assert open(rep, "rb").read() == want
+    finally:
+        _stop(h)
+
+
+def _spawn_serve(sock, *extra):
+    old_pp = os.environ.get("PYTHONPATH", "")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PWASM_DEVICE_PROBE="0",
+               PYTHONPATH=REPO + (os.pathsep + old_pp if old_pp
+                                  else ""))
+    return subprocess.Popen(
+        [sys.executable, "-m", "pwasm_tpu.cli", "serve",
+         f"--socket={sock}", *extra],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        text=True)
+
+
+def test_kill9_midstream_journal_replay_reopen_resume(tmp_path):
+    """kill -9 the daemon mid-stream: the restarted daemon's journal
+    replay lands the stream terminal preempted-RESUMABLE (its
+    connection died with the crash — re-running alone is impossible),
+    and a re-opened --resume stream over the full record set
+    completes byte-identically to the one-shot arm."""
+    paf, fa, lines = _corpus(tmp_path, n=24)
+    want = _oneshot(tmp_path, "one", paf, fa)[0]
+    rep = str(tmp_path / "st.dfa")
+    sockdir = tempfile.mkdtemp(prefix="pwstream9")
+    sock = os.path.join(sockdir, "s")
+    sp = _spawn_serve(sock)
+    sp2 = None
+    try:
+        assert wait_for_socket(sock, 60)
+        with ServiceClient(sock) as c:
+            so = c.stream_open(["-r", fa, "-o", rep, "--batch=4"])
+            assert so.get("ok"), so
+            jid = so["job_id"]
+            c.stream_data(jid,
+                          "".join(ln + "\n" for ln in lines[:16]))
+            deadline = time.monotonic() + 90
+            mid = False
+            while time.monotonic() < deadline:
+                st = c.status(jid)["job"]["state"]
+                if st == "running" and os.path.exists(rep + ".ckpt"):
+                    mid = True
+                    break
+                assert st in ("queued", "running"), st
+                time.sleep(0.02)
+            assert mid, "stream never reached mid-run with a ckpt"
+        sp.kill()                    # SIGKILL: no drain, no cleanup
+        sp.wait(timeout=30)
+        assert os.path.exists(sock + ".journal")
+        sp2 = _spawn_serve(sock)
+        assert wait_for_socket(sock, 60)
+        with ServiceClient(sock) as c:
+            ra = c.result(jid, timeout=60)
+            assert ra.get("rc") == EXIT_PREEMPTED, ra
+            assert ra["job"]["state"] == "preempted"
+            assert "re-open the stream with --resume" \
+                in ra["job"]["detail"]
+            st = c.stats()["stats"]
+            assert st["journal"]["replays"] == 1
+            # the replayed verdict is DURABLE: feeding the dead id is
+            # an error, not a silent buffer
+            r = c.stream_data(jid, "x\n")
+            assert not r.get("ok")
+            # round 2: re-open with --resume, re-send everything
+            resp = c.stream(
+                ["-r", fa, "-o", rep, "--batch=4", "--resume"],
+                iter([ln + "\n" for ln in lines]))
+            assert resp.get("ok"), resp
+            res = c.result(resp["job_id"], timeout=240)
+            assert res.get("rc") == 0, res
+            c.drain()
+        assert sp2.wait(timeout=120) == EXIT_PREEMPTED
+        assert open(rep, "rb").read() == want
+        assert not os.path.exists(sock + ".journal")
+    finally:
+        for p in (sp, sp2):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()
+            if p is not None:
+                p.stderr.close()
+        shutil.rmtree(sockdir, ignore_errors=True)
+
+
+def test_stream_keepalive_outlives_idle_reaper(tmp_path):
+    """A slow producer (silent longer than --stream-idle-s) survives
+    when the client helper heartbeats empty frames (keepalive_s);
+    without the heartbeat, the reaper drains the job
+    preempted-resumable — never silently complete."""
+    paf, fa, lines = _corpus(tmp_path, n=6)
+    want = _oneshot(tmp_path, "one", paf, fa)[0]
+
+    def slow_chunks():
+        yield lines[0] + "\n"
+        time.sleep(1.2)               # > stream_idle_s
+        yield "".join(ln + "\n" for ln in lines[1:])
+
+    h = _daemon(stream_idle_s=0.4)
+    try:
+        with ServiceClient(h.sock) as c:
+            resp = c.stream(["-r", fa,
+                             "-o", str(tmp_path / "ka.dfa"),
+                             "--batch=4"], slow_chunks(),
+                            keepalive_s=0.1)
+            assert resp.get("ok"), resp
+            res = c.result(resp["job_id"], timeout=60)
+            assert res.get("rc") == 0, res
+            assert (tmp_path / "ka.dfa").read_bytes() == want
+            # the no-heartbeat arm: the reaper preempts, resumable
+            so = c.stream_open(["-r", fa,
+                                "-o", str(tmp_path / "idle.dfa")])
+            assert so.get("ok"), so
+            res = c.result(so["job_id"], timeout=60)
+            assert res.get("rc") == EXIT_PREEMPTED, res
+            assert res["job"]["state"] == "preempted"
+    finally:
+        _stop(h)
+
+
+def test_stream_oversized_frame_admits_and_tail_flood_rejected(
+        tmp_path):
+    """Two admission edges: (1) one frame carrying more records than
+    the whole --stream-buffer quota is admitted when the stream's
+    buffer is empty (the resend-the-same-frame contract must never
+    livelock) and the job completes byte-identically; (2) a client
+    flooding newline-LESS chunks cannot grow the partial-record tail
+    past MAX_RECORD_BYTES — the daemon answers bad_request (not
+    queue_full: no resend can help), bounding daemon memory."""
+    from pwasm_tpu.stream.pafstream import MAX_RECORD_BYTES
+
+    paf, fa, lines = _corpus(tmp_path)
+    want = _oneshot(tmp_path, "one", paf, fa)[0]
+    h = _daemon(stream_buffer=4)     # quota far under len(lines)
+    try:
+        with ServiceClient(h.sock) as c:
+            so = c.stream_open(["-r", fa,
+                                "-o", str(tmp_path / "big.dfa"),
+                                "--batch=4"])
+            assert so.get("ok"), so
+            # ONE frame with every record: > quota, buffer empty
+            r = c.stream_data(so["job_id"],
+                              "".join(ln + "\n" for ln in lines))
+            assert r.get("ok"), r
+            assert c.stream_end(so["job_id"]).get("ok")
+            res = c.result(so["job_id"], timeout=120)
+            assert res.get("rc") == 0, res
+            assert (tmp_path / "big.dfa").read_bytes() == want
+
+            # newline-less flood: bounded by the record-byte ceiling
+            so = c.stream_open(["-r", fa,
+                                "-o", str(tmp_path / "fl.dfa")])
+            assert so.get("ok"), so
+            chunk = "x" * (1 << 20)
+            rejected = None
+            for _ in range(8):       # 8 MiB attempted > 4 MiB cap
+                r = c.stream_data(so["job_id"], chunk)
+                if not r.get("ok"):
+                    rejected = r
+                    break
+            assert rejected is not None
+            assert rejected["error"] == protocol.ERR_BAD_REQUEST
+            assert "unterminated" in rejected["detail"]
+            assert h.daemon.jobs[so["job_id"]].feed.tail_bytes \
+                <= MAX_RECORD_BYTES
+            c.cancel(so["job_id"])
+    finally:
+        _stop(h)
+
+
+def test_stream_cli_verb_pipes_stdin(tmp_path, monkeypatch):
+    """`pwasm-tpu stream --socket=S -- <job args>`: the minimap2-pipe
+    shape — stdin is streamed record-at-a-time and the verb exits
+    with the job's exit code, byte-identical to the one-shot run."""
+    paf, fa, lines = _corpus(tmp_path)
+    want = _oneshot(tmp_path, "one", paf, fa)
+    h = _daemon()
+    try:
+        monkeypatch.setattr(
+            "sys.stdin", io.StringIO("".join(ln + "\n"
+                                             for ln in lines)))
+        out = io.StringIO()
+        err = io.StringIO()
+        rc = run(["stream", f"--socket={h.sock}", "--",
+                  "-r", fa, "-o", str(tmp_path / "sv.dfa"),
+                  "-s", str(tmp_path / "sv.sum"), "--batch=4"],
+                 stdout=out, stderr=err)
+        assert rc == 0, err.getvalue()[:2000]
+        verdict = json.loads(out.getvalue())
+        assert verdict["state"] == "done" and verdict["rc"] == 0
+        assert ((tmp_path / "sv.dfa").read_bytes(),
+                (tmp_path / "sv.sum").read_bytes()) == want
+    finally:
+        _stop(h)
+
+
+# ---------------------------------------------------------------------------
+# realistic-scale acceptance: streamed == one-shot, all three routes
+# ---------------------------------------------------------------------------
+def test_realistic_stream_follow_and_socket_byte_parity(tmp_path):
+    from test_realistic_scale import make_corpus
+
+    qseq, lines = make_corpus()
+    fa = tmp_path / "cds.fa"
+    fa.write_text(f">cds1\n{qseq}\n")
+    paf = tmp_path / "in.paf"
+    text = "".join(ln + "\n" for ln in lines)
+    paf.write_text(text)
+    want = _oneshot(tmp_path, "one", str(paf), str(fa))
+
+    # follow-mode arm: the corpus arrives in bursts
+    grow = str(tmp_path / "grow.paf")
+    open(grow, "w").close()
+    chunks = _fuzz_chunks(text, 12, seed=13)
+
+    def writer():
+        with open(grow, "a") as f:
+            for ch in chunks:
+                f.write(ch)
+                f.flush()
+                time.sleep(0.01)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    err = io.StringIO()
+    rc = run([grow, "--follow=1.5", "-r", str(fa),
+              "-o", str(tmp_path / "fol.dfa"),
+              "-s", str(tmp_path / "fol.sum"), "--batch=4"],
+             stderr=err)
+    t.join()
+    assert rc == 0, err.getvalue()[:2000]
+    assert ((tmp_path / "fol.dfa").read_bytes(),
+            (tmp_path / "fol.sum").read_bytes()) == want
+
+    # socket arm: fuzzed frames through a warm daemon
+    h = _daemon()
+    try:
+        with ServiceClient(h.sock) as c:
+            resp = c.stream(
+                ["-r", str(fa), "-o", str(tmp_path / "soc.dfa"),
+                 "-s", str(tmp_path / "soc.sum"), "--batch=4"],
+                iter(_fuzz_chunks(text, 60, seed=17)))
+            assert resp.get("ok") and resp["records"] == len(lines)
+            res = c.result(resp["job_id"], timeout=240)
+            assert res.get("rc") == 0, res
+        assert ((tmp_path / "soc.dfa").read_bytes(),
+                (tmp_path / "soc.sum").read_bytes()) == want
+    finally:
+        _stop(h)
+
+
+# ---------------------------------------------------------------------------
+# multi-CDS jobs (--many2many)
+# ---------------------------------------------------------------------------
+def _m2m_fixture(tmp_path, n_q=4, n_t=6, seed=5):
+    rng = np.random.default_rng(seed)
+
+    def seq(n):
+        return "".join("ACGT"[i]
+                       for i in rng.integers(0, 4, n)).encode()
+
+    qs = [(f"cds{i}", seq(120 + (i % 3) * 40)) for i in range(n_q)]
+    ts = [(f"asm{i}", seq(150 + 17 * i)) for i in range(n_t)]
+    qfa = str(tmp_path / "q.fa")
+    write_fasta(qfa, qs)
+    tfa = str(tmp_path / "t.fa")
+    write_fasta(tfa, ts)
+    return qs, ts, qfa, tfa
+
+
+def test_many2many_multi_vs_single_byte_parity(tmp_path):
+    """THE multi-CDS acceptance: one --many2many job's per-CDS report
+    sections and -s roll-up are byte-identical to N single-CDS runs,
+    while the multi job pays ONE backend reachability check (probes +
+    warm_hits == 1 in --stats) vs one per run sequentially."""
+    qs, _ts, qfa, tfa = _m2m_fixture(tmp_path)
+    err = io.StringIO()
+    rc = run(["--many2many", tfa, "-r", qfa,
+              "-o", str(tmp_path / "m.tsv"),
+              "-s", str(tmp_path / "m.sum"), "--device=tpu",
+              f"--stats={tmp_path / 'm.json'}"], stderr=err)
+    assert rc == 0, err.getvalue()[:2000]
+    multi = (tmp_path / "m.tsv").read_bytes()
+    msum = (tmp_path / "m.sum").read_bytes()
+    bk = json.loads((tmp_path / "m.json").read_text())["backend"]
+    assert bk["probes"] + bk["warm_hits"] == 1   # ONE session
+    body = b""
+    ssum = b""
+    checks = 0
+    for name, s in qs:
+        q1 = str(tmp_path / f"{name}.fa")
+        write_fasta(q1, [(name, s)])
+        err = io.StringIO()
+        rc = run(["--many2many", tfa, "-r", q1,
+                  "-o", str(tmp_path / f"{name}.tsv"),
+                  "-s", str(tmp_path / f"{name}.sum"),
+                  "--device=tpu",
+                  f"--stats={tmp_path / f'{name}.json'}"],
+                 stderr=err)
+        assert rc == 0, err.getvalue()[:2000]
+        body += (tmp_path / f"{name}.tsv").read_bytes()
+        ssum += (tmp_path / f"{name}.sum").read_bytes()
+        bk = json.loads(
+            (tmp_path / f"{name}.json").read_text())["backend"]
+        checks += bk["probes"] + bk["warm_hits"]
+    assert body == multi          # per-CDS sections: byte-identical
+    assert ssum == msum           # summary roll-up concatenates
+    assert checks == len(qs)      # sequential pays one PER RUN
+
+
+def test_many2many_cpu_tpu_parity_and_stdout(tmp_path):
+    _qs, _ts, qfa, tfa = _m2m_fixture(tmp_path, n_q=2, n_t=3)
+    out = io.StringIO()
+    rc = run(["--many2many", tfa, "-r", qfa], stdout=out,
+             stderr=io.StringIO())
+    assert rc == 0
+    cpu_body = out.getvalue()
+    assert cpu_body.startswith(">cds0\t")
+    err = io.StringIO()
+    rc = run(["--many2many", tfa, "-r", qfa, "--device=tpu",
+              "-o", str(tmp_path / "t.tsv")], stderr=err)
+    assert rc == 0, err.getvalue()[:2000]
+    assert (tmp_path / "t.tsv").read_text() == cpu_body
+
+
+def test_many2many_usage_errors(tmp_path):
+    _qs, _ts, qfa, tfa = _m2m_fixture(tmp_path, n_q=1, n_t=1)
+    cases = [
+        (["--many2many", tfa], "required"),             # no -r
+        (["--many2many", "-r", qfa], "exactly one"),    # no targets
+        (["--many2many", tfa, tfa, "-r", qfa], "exactly one"),
+        (["--many2many", tfa, "-r", qfa, "--band=x"], "--band"),
+        (["--many2many", tfa, "-r", qfa, "-w", "x.mfa"],
+         "does not apply"),
+        (["--many2many", tfa, "-r", qfa, "--follow"],
+         "does not apply"),
+        (["--many2many", tfa, "-r", qfa, "--device=gpu"],
+         "--device"),
+    ]
+    for argv, needle in cases:
+        err = io.StringIO()
+        assert run(argv, stderr=err) == EXIT_USAGE, argv
+        assert needle in err.getvalue(), (argv, err.getvalue()[:500])
+    err = io.StringIO()
+    assert run(["--many2many", str(tmp_path / "absent.fa"),
+                "-r", qfa, "-o", str(tmp_path / "x.tsv")],
+               stderr=err) != 0
+    assert "invalid FASTA" in err.getvalue()
+
+
+def test_many2many_as_service_job_warm_session(tmp_path):
+    """A --many2many submit is a first-class service citizen: the
+    daemon validates and runs it like any job, bytes match the cold
+    run, and the SECOND m2m job answers its reachability check from
+    the warm process (probes == 0, warm_hits == 1)."""
+    _qs, _ts, qfa, tfa = _m2m_fixture(tmp_path)
+    err = io.StringIO()
+    rc = run(["--many2many", tfa, "-r", qfa,
+              "-o", str(tmp_path / "cold.tsv"), "--device=tpu"],
+             stderr=err)
+    assert rc == 0, err.getvalue()[:2000]
+    want = (tmp_path / "cold.tsv").read_bytes()
+    h = _daemon()
+    try:
+        for j in (1, 2):
+            with ServiceClient(h.sock) as c:
+                sub = c.submit(
+                    ["--many2many", tfa, "-r", qfa,
+                     "-o", str(tmp_path / f"w{j}.tsv"),
+                     "--device=tpu",
+                     f"--stats={tmp_path / f'w{j}.json'}"])
+                assert sub.get("ok"), sub
+                res = c.result(sub["job_id"], timeout=120)
+            assert res.get("rc") == 0, res
+            assert (tmp_path / f"w{j}.tsv").read_bytes() == want
+        bk = json.loads((tmp_path / "w2.json").read_text())["backend"]
+        assert bk["probes"] == 0 and bk["warm_hits"] == 1
+    finally:
+        _stop(h)

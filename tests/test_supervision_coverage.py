@@ -74,6 +74,30 @@ def test_obs_rule_detects_direct_jax(checker, tmp_path):
     assert len(bad) == 2 and all("rogue.py" in b for b in bad)
 
 
+def test_stream_modules_stay_jax_free(checker):
+    """ISSUE 10 satellite: pwasm_tpu/stream/ must stay jax-free —
+    the streaming readers run inside the daemon and around signal
+    handling, and the multi-CDS driver reaches the device only
+    through the supervised many2many site in pwasm_tpu/parallel/."""
+    bad = checker.find_stream_violations()
+    assert bad == [], "\n".join(bad)
+
+
+def test_stream_rule_detects_direct_jax(checker, tmp_path):
+    stream = tmp_path / "pwasm_tpu" / "stream"
+    stream.mkdir(parents=True)
+    (stream / "rogue.py").write_text(
+        "import jax\n"
+        "from pwasm_tpu.parallel.many2many import "
+        "many2many_scores_ragged\n"          # lazy-import style: NOT
+        "# import jax in a comment is NOT a hit\n"
+        "y = jax.device_put(1)\n")
+    bad = checker.find_stream_violations(str(tmp_path))
+    assert len(bad) == 2 and all("rogue.py" in b for b in bad)
+    # a tree without a stream dir is trivially clean
+    assert checker.find_stream_violations(str(tmp_path / "no")) == []
+
+
 def test_metric_lint_clean_on_this_tree(checker):
     """ISSUE 6 satellite: every metric registration lives in
     obs/catalog.py, with snake_case pwasm_-prefixed unique names."""
