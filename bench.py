@@ -1652,6 +1652,58 @@ def cfg8_realistic_scale() -> int:
             return _fail("realistic_host_engine_parity")
         _emit("realistic_host_report_1k_s", min(col_walls), "s",
               min(sca_walls) / min(col_walls), cpu_metric=True)
+
+        # --- observability overhead (ISSUE 11): the same 1k-alignment
+        # report with the FULL observability surface on (trace + event
+        # log + stats + metrics textfile) vs all off.  Bytes must stay
+        # identical (the byte-neutrality contract at realistic scale)
+        # and the wall ratio is gated <= 1.10 — observability that
+        # costs more than 10% would get turned off exactly when it is
+        # needed.  Unit "x" = lower-is-better in qa/bench_gate.py.
+        def host_obs_once(tag, obs_on):
+            o = [os.path.join(d, f"{tag}.dfa"),
+                 os.path.join(d, f"{tag}.sum")]
+            extra = []
+            if obs_on:
+                extra = [
+                    f"--trace-json={os.path.join(d, tag + '.trace')}",
+                    f"--log-json={os.path.join(d, tag + '.ndjson')}",
+                    f"--stats={os.path.join(d, tag + '.json')}",
+                    "--metrics-textfile="
+                    + os.path.join(d, tag + ".prom")]
+            t0 = time.perf_counter()
+            r = subprocess.run(
+                cmd + [paf1k, "-r", fa1k, "-o", o[0], "-s", o[1]]
+                + extra, env=env, capture_output=True)
+            wall = time.perf_counter() - t0
+            if r.returncode != 0:
+                sys.stderr.write(r.stderr.decode()[:1000])
+                return None, None
+            return wall, b"".join(open(p, "rb").read() for p in o)
+        # interleaved arms, same rationale as the engine A/B above
+        obs_walls, plain_walls = [], []
+        obs_body = plain_body = None
+        for _ in range(4):
+            w, obs_body = host_obs_once("h1kobs", True)
+            if w is None:
+                return _fail("realistic_obs_overhead")
+            obs_walls.append(w)
+            w, plain_body = host_obs_once("h1kplain", False)
+            if w is None:
+                return _fail("realistic_obs_overhead")
+            plain_walls.append(w)
+        if obs_body != plain_body:
+            return _fail("realistic_obs_parity")
+        obs_ratio = min(obs_walls) / min(plain_walls)
+        obs_ok = obs_ratio <= 1.10
+        _emit("realistic_obs_overhead_ratio", obs_ratio, "x",
+              1.0 if obs_ok else 0.0, cpu_metric=True)
+        # the <= 1.10 ceiling as a BOOL leg: unit "x" only gates
+        # against the committed trajectory, so without this a first
+        # stamp at 1.4x would become the accepted baseline — the bool
+        # flips 1 -> 0 past the ceiling and bench_gate fails the flip
+        _emit("realistic_obs_overhead_ok", 1 if obs_ok else 0,
+              "bool", 1.0 if obs_ok else 0.0, cpu_metric=True)
         if on_tpu_backend():
             dev_env = dict(os.environ, PYTHONPATH=env["PYTHONPATH"])
             dev_times = []

@@ -74,6 +74,14 @@ USAGE = """Usage:
                checkpoint writes, drains) with wall+monotonic
                timestamps and a run id; "-" streams to stdout
                (requires -o so events never share the report stream)
+   --log-json-max-bytes=N  rotate the --log-json file once it passes
+               N bytes (current file moves to FILE.1, one generation
+               kept; a log_rotate event opens the fresh file) — a
+               long-lived daemon's event log stays bounded
+   --trace-max-events=N  cap the --trace-json recorder at N events
+               (default 200000); drops are counted live in
+               pwasm_trace_events_dropped_total and reported in the
+               trace's otherData
    --metrics-textfile=PATH  write the run's metrics as Prometheus
                text exposition at end of run (atomic publish) for a
                node-exporter textfile collector
@@ -126,6 +134,13 @@ USAGE = """Usage:
                streamed record-at-a-time — the minimap2-pipe shape)
    pwasm-tpu svc-stats --socket=PATH [--drain]
    pwasm-tpu metrics --socket=PATH   (Prometheus text exposition)
+   pwasm-tpu inspect --socket=PATH JOB_ID   (the job's flight record:
+               phase-accounted walls — queue/lease/exec, per-flush
+               device/host/format — plus its event ring)
+   pwasm-tpu top --socket=PATH [--interval=S] [--once]   (live fleet
+               view: lanes, per-client queues, streams, breakers)
+   pwasm-tpu trace-merge CLIENT.json DAEMON.json [-o OUT.json]
+               (one wall-anchored cross-process Perfetto timeline)
 """
 
 # reference optstring: "DGFCNvd:p:r:o:m:w:c:s:" — -d/-p/-m take a value but
@@ -134,10 +149,12 @@ _BOOL_FLAGS = set("DGFCNvh")
 _VALUE_FLAGS = set("dprmowcs")
 
 # warm-pool service subcommands (pwasm_tpu/service/, docs/SERVICE.md):
-# `pwasm-tpu serve` starts the resident daemon, `submit`/`svc-stats`/
-# `stream` are the client side — dispatched on the FIRST argv token so
-# the classic flag grammar stays untouched for plain runs
-_SERVICE_CMDS = ("serve", "submit", "svc-stats", "metrics", "stream")
+# `pwasm-tpu serve` starts the resident daemon, the rest are the
+# client side — dispatched on the FIRST argv token so the classic flag
+# grammar stays untouched for plain runs.  `trace-merge` is the
+# offline cross-process trace join (no socket, pwasm_tpu/obs/merge.py)
+_SERVICE_CMDS = ("serve", "submit", "svc-stats", "metrics", "stream",
+                 "inspect", "top", "trace-merge")
 
 
 class CliError(PwasmError):
@@ -395,6 +412,12 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None,
             if argv[0] == "serve":
                 from pwasm_tpu.service.daemon import serve_main
                 return serve_main(argv[1:], stdout, stderr)
+            if argv[0] == "trace-merge":
+                from pwasm_tpu.obs.merge import trace_merge_main
+                return trace_merge_main(argv[1:], stdout, stderr)
+            if argv[0] == "top":
+                from pwasm_tpu.service.top import top_main
+                return top_main(argv[1:], stdout, stderr)
             from pwasm_tpu.service.client import client_main
             return client_main(argv[0], argv[1:], stdout, stderr)
         except PwasmError as e:
@@ -600,6 +623,16 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None,
         cfg.trace_json = str(opts.get("trace-json", ""))
         cfg.log_json = str(opts.get("log-json", ""))
         cfg.metrics_textfile = str(opts.get("metrics-textfile", ""))
+        for knob, attr in (("trace-max-events", "trace_max_events"),
+                           ("log-json-max-bytes",
+                            "log_json_max_bytes")):
+            if knob in opts:
+                val = opts[knob]
+                if val is True or not str(val).isascii() \
+                        or not str(val).isdigit() or int(val) < 1:
+                    raise CliError(
+                        f"{USAGE}\nInvalid --{knob} value: {val}\n")
+                setattr(cfg, attr, int(val))
         if cfg.log_json == "-" and "o" not in opts:
             # without -o the report itself streams to stdout — event
             # lines interleaved with report rows would corrupt both
@@ -757,11 +790,21 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None,
         # observability bundle (pwasm_tpu.obs).  Strictly additive: it
         # writes only to its own sinks, never the report stream — the
         # byte-parity test (flags on vs off) holds by construction.
+        # a served job inherits the daemon-minted identity + flight
+        # recorder (warm._JobWarm): the trace_id stamps every event
+        # line as run_id, and the run's spans accumulate phase walls
+        # on the job's flight record (docs/OBSERVABILITY.md)
+        trace_id = getattr(warm, "trace_id", None) \
+            if warm is not None else None
+        flight = getattr(warm, "flight", None) \
+            if warm is not None else None
         try:
-            obs = make_observability(cfg.trace_json or None,
-                                     cfg.log_json or None,
-                                     cfg.metrics_textfile or None,
-                                     stdout=stdout)
+            obs = make_observability(
+                cfg.trace_json or None, cfg.log_json or None,
+                cfg.metrics_textfile or None, stdout=stdout,
+                trace_max_events=cfg.trace_max_events or None,
+                log_json_max_bytes=cfg.log_json_max_bytes or None,
+                run_id=trace_id, flight=flight)
         except OSError:
             raise PwasmError(
                 f"Cannot open file {cfg.log_json} for writing!\n")
@@ -1213,8 +1256,32 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         if freport not in (stdout, None) else None
     emitted = [resume_skip]
 
+    # per-flush host-stage folding (ISSUE 11 satellite): the --stats
+    # host block used to reach pwasm_host_stage_seconds_total only at
+    # end of run — a drifting canary (realistic_host_report_1k_s) had
+    # no live per-stage attribution.  Each completed batch now folds
+    # the stage DELTAS into the live counter and the flight record;
+    # the end-of-run fold applies only the residual, so totals match
+    # the --stats JSON exactly (no double count).
+    host_folded = {"parse": 0.0, "extract": 0.0, "analyze": 0.0,
+                   "format": 0.0}
+
+    def fold_host_stages() -> None:
+        cur = {"parse": stats.host_parse_s,
+               "extract": stats.host_extract_s,
+               "analyze": stats.host_analyze_s,
+               "format": stats.host_format_s}
+        for k, v in cur.items():
+            d = v - host_folded[k]
+            if d > 0:
+                obs.count("host_stage_seconds", d, stage=k)
+                if obs.flight is not None:
+                    obs.flight.note("host_" + k, d)
+            host_folded[k] = v
+
     def note_batch_done(nrecords: int) -> None:
         emitted[0] += nrecords
+        fold_host_stages()
         if report_path is not None:
             if _write_checkpoint(freport, report_path, emitted[0],
                                  supervisor.export_state()):
@@ -1710,7 +1777,14 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         # textfile atomically
         from pwasm_tpu.obs.catalog import (breaker_state_value,
                                            fold_run_stats)
-        fold_run_stats(obs.run_metrics, stats.as_dict())
+        d = stats.as_dict()
+        # the per-flush folds above already attributed most of the
+        # host block: fold only the residual so the counter total
+        # equals the --stats JSON exactly
+        d["host"] = {k + "_s": round(max(
+            0.0, d["host"][k + "_s"] - host_folded[k]), 6)
+            for k in host_folded}
+        fold_run_stats(obs.run_metrics, d)
         obs.set_gauge("breaker_state", breaker_state_value(
             supervisor.breaker_open,
             monitor.state if monitor is not None else None))

@@ -49,6 +49,10 @@ class Config:
     metrics_textfile: str = ""      # --metrics-textfile: Prometheus
     #                                 text exposition, written atomically
     #                                 at end of run (pwasm_tpu.obs)
+    trace_max_events: int = 0       # --trace-max-events: span-recorder
+    #                                 event cap (0 = the 200k default)
+    log_json_max_bytes: int = 0     # --log-json-max-bytes: size-capped
+    #                                 event-log rotation (0 = unbounded)
 
     # resilience knobs (pwasm_tpu.resilience; no ref equivalent —
     # the reference fails fast, SURVEY.md §2.5.12)

@@ -156,6 +156,39 @@ def build_run_metrics(reg: MetricsRegistry,
     m["bucket_repromotions"] = reg.counter(
         "pwasm_bucket_repromotions_total",
         "Probation-raises of a demoted batch ceiling")
+    # trace health (ISSUE 11 satellite): drops surfaced live, not only
+    # in otherData at write time (fed by TraceRecorder.on_drop)
+    m["trace_dropped"] = reg.counter(
+        "pwasm_trace_events_dropped_total",
+        "Trace events dropped past --trace-max-events (or a "
+        "contended recorder lock)")
+    # utilization accounting (ISSUE 11): pow2 padding waste and the
+    # compile-vs-steady device wall split, folded from the --stats
+    # device block; the ratio gauges are derived from the cumulative
+    # counters at fold time
+    m["pad_items"] = reg.counter(
+        "pwasm_device_pad_items_total",
+        "Live event rows launched in pow2-padded device batches")
+    m["pad_slots"] = reg.counter(
+        "pwasm_device_pad_slots_total",
+        "Total slots (live + pad) launched in pow2-padded device "
+        "batches")
+    m["pad_waste"] = reg.gauge(
+        "pwasm_device_pad_waste_ratio",
+        "Fraction of launched device-batch slots that were pow2 "
+        "bucket padding (cumulative; 0 = perfectly full buckets)")
+    m["compile_seconds"] = reg.counter(
+        "pwasm_device_compile_seconds_total",
+        "Wall seconds of each supervised site's FIRST attempt "
+        "(compile-inclusive)")
+    m["steady_seconds"] = reg.counter(
+        "pwasm_device_steady_seconds_total",
+        "Wall seconds of supervised attempts after a site's first "
+        "(steady-state, compile-cache warm)")
+    m["compile_fraction"] = reg.gauge(
+        "pwasm_device_compile_fraction",
+        "Compile-inclusive fraction of supervised device wall "
+        "(cumulative compile / (compile + steady))")
     return m
 
 
@@ -196,6 +229,10 @@ def build_service_metrics(reg: MetricsRegistry) -> dict:
     m["lane_jobs"] = reg.counter(
         "pwasm_service_lane_jobs_total",
         "Jobs completed per device-lease lane", labels=("lane",))
+    m["lane_busy_fraction"] = reg.gauge(
+        "pwasm_service_lane_busy_fraction",
+        "Fraction of the daemon's uptime each lane spent leased to a "
+        "job (per-lane device busy-fraction)", labels=("lane",))
     m["lease_wait_seconds"] = reg.histogram(
         "pwasm_service_lease_wait_seconds",
         "Per-job device-lease wait seconds (dequeue to grant)",
@@ -254,6 +291,11 @@ def build_stream_metrics(reg: MetricsRegistry) -> dict:
         "pwasm_stream_lag_records",
         "Records fed to a stream but not yet consumed by its job, "
         "by client", labels=("client",))
+    m["lag_age"] = reg.gauge(
+        "pwasm_stream_lag_age_seconds",
+        "Age of the oldest fed-but-unconsumed stream record, by "
+        "client (how STALE the lag is, where lag_records says how "
+        "deep)", labels=("client",))
     return m
 
 
@@ -286,6 +328,23 @@ def fold_run_stats(m: dict, st: dict | None) -> None:
     m["aligned_bases"].inc(n(st, "aligned_bases"))
     m["device_dispatches"].inc(n(device, "dispatches"))
     m["device_flushes"].inc(n(device, "flushes"))
+    # utilization accounting (ISSUE 11): fold the pad/compile counters
+    # and derive the ratio gauges from the CUMULATIVE totals, so the
+    # gauges describe the registry's whole history (a daemon's life),
+    # not just the last folded run
+    m["pad_items"].inc(n(device, "pad_items"))
+    m["pad_slots"].inc(n(device, "pad_slots"))
+    slots = m["pad_slots"].value()
+    if slots > 0:
+        m["pad_waste"].set(
+            round(1.0 - m["pad_items"].value() / slots, 6))
+    m["compile_seconds"].inc(n(device, "compile_s"))
+    m["steady_seconds"].inc(n(device, "steady_s"))
+    dev_wall = m["compile_seconds"].value() \
+        + m["steady_seconds"].value()
+    if dev_wall > 0:
+        m["compile_fraction"].set(
+            round(m["compile_seconds"].value() / dev_wall, 6))
     host = st.get("host")
     host = host if isinstance(host, dict) else {}
     for stage in ("parse", "extract", "analyze", "format"):

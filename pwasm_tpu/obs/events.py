@@ -37,14 +37,62 @@ class EventLog:
     """One NDJSON event sink.  ``stream`` is any text file object;
     ``owns_stream`` says whether :meth:`close` closes it (False for
     ``-`` = the run's stdout).  Thread-safe: daemon workers and the
-    accept loop emit concurrently, one whole line per event."""
+    accept loop emit concurrently, one whole line per event.
 
-    def __init__(self, stream, run_id: str | None = None,
-                 owns_stream: bool = True):
+    Size-capped rotation (``--log-json-max-bytes=N``): construct with
+    ``path=``/``max_bytes=`` instead of a stream and the log rotates
+    once the file passes ``max_bytes`` — the current file moves to
+    ``<path>.1`` (ONE rotation generation: a long-lived serve daemon
+    holds at most ~2x the cap on disk, instead of growing its NDJSON
+    log without bound) and a ``log_rotate`` event opens the fresh
+    file, so a tailing collector sees the seam.  Rotation failures
+    degrade to appending on (emit-never-raises holds throughout)."""
+
+    def __init__(self, stream=None, run_id: str | None = None,
+                 owns_stream: bool = True, path: str | None = None,
+                 max_bytes: int | None = None):
         self._lock = threading.Lock()
+        self.path = path
+        self.max_bytes = int(max_bytes) if max_bytes else None
+        self.rotations = 0
+        if stream is None and path is not None:
+            stream = open(path, "a")    # may raise: caller maps it to
+            #   the usual cannot-open diagnostic, like the stream form
+            owns_stream = True
         self._fh = stream
         self._owns = owns_stream
         self.run_id = run_id or new_run_id()
+
+    def _maybe_rotate(self) -> None:
+        """Rotate under the held lock once the file passed the cap.
+        Best-effort: any failure keeps the current handle appending."""
+        if self.max_bytes is None or self.path is None \
+                or self._fh is None:
+            return
+        try:
+            if self._fh.tell() < self.max_bytes:
+                return
+            import os
+            self._fh.close()
+            os.replace(self.path, self.path + ".1")
+            self._fh = open(self.path, "a")
+            self.rotations += 1
+            rec = {"event": "log_rotate", "run_id": self.run_id,
+                   "ts_wall": round(time.time(), 6),
+                   "ts_mono": round(time.perf_counter(), 6),
+                   "rotations": self.rotations,
+                   "previous": self.path + ".1"}
+            self._fh.write(json.dumps(rec, separators=(",", ":"))
+                           + "\n")
+            self._fh.flush()
+        except Exception:
+            # a failed rotation must not kill the log (or the run):
+            # reopen the path if the handle died, else keep appending
+            if self._fh is None or self._fh.closed:
+                try:
+                    self._fh = open(self.path, "a")
+                except Exception:
+                    self._fh = None
 
     def emit(self, event: str, **fields) -> None:
         """Append one event line.  Never raises — and is safe to call
@@ -54,8 +102,7 @@ class EventLog:
         deadlock the drain it is trying to log.  On timeout — self-
         reentrancy or a wedged sink — the line is dropped, never the
         run."""
-        fh = self._fh
-        if fh is None:
+        if self._fh is None:
             return
         rec = {"event": event, "run_id": self.run_id,
                "ts_wall": round(time.time(), 6),
@@ -66,8 +113,11 @@ class EventLog:
         if not self._lock.acquire(timeout=0.2):
             return
         try:
-            fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
-            fh.flush()
+            self._maybe_rotate()
+            fh = self._fh
+            if fh is not None:
+                fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
+                fh.flush()
         except Exception:
             pass
         finally:

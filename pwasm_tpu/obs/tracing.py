@@ -57,10 +57,18 @@ class TraceRecorder:
     def __init__(self, clock=None, max_events: int = 200_000):
         self._clock = clock or time.perf_counter
         self._t0 = self._clock()
+        # the wall-clock anchor of the monotonic origin: two processes'
+        # traces (client submit vs serve daemon) each stamp their own,
+        # and trace_merge shifts every timeline onto one wall axis —
+        # the cross-process correlation the per-process monotonic
+        # clocks cannot provide alone
+        self.anchor_wall_s = time.time()
         self._lock = threading.Lock()
         self._events: list[dict] = []
         self._max = max_events
         self.dropped = 0
+        self.on_drop = None   # hook: called (unlocked) per dropped
+        #   event — the live pwasm_trace_events_dropped_total feed
         self._pid = os.getpid()
 
     # ---- recording -----------------------------------------------------
@@ -107,23 +115,35 @@ class TraceRecorder:
         # non-reentrant lock mid-append would deadlock the drain it is
         # recording — on timeout the event is dropped, never the run
         if not self._lock.acquire(timeout=0.2):
-            self.dropped += 1
+            self._note_drop()
             return
         try:
             if len(self._events) >= self._max:
-                self.dropped += 1
+                self._note_drop()
                 return
             self._events.append(ev)
         finally:
             self._lock.release()
 
+    def _note_drop(self) -> None:
+        self.dropped += 1
+        hook = self.on_drop
+        if hook is not None:
+            try:
+                hook()     # a metrics hook must never kill the drop
+            except Exception:
+                pass
+
     # ---- output --------------------------------------------------------
     def to_dict(self) -> dict:
         with self._lock:
             events = list(self._events)
-        out = {"traceEvents": events, "displayTimeUnit": "ms"}
+        out = {"traceEvents": events, "displayTimeUnit": "ms",
+               "otherData": {"anchor_wall_s":
+                             round(self.anchor_wall_s, 6),
+                             "pid": self._pid}}
         if self.dropped:
-            out["otherData"] = {"dropped_events": self.dropped}
+            out["otherData"]["dropped_events"] = self.dropped
         return out
 
     def write(self, path: str) -> None:

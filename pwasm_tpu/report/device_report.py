@@ -34,8 +34,9 @@ from pwasm_tpu.core.config import DEFAULT_MOTIFS
 from pwasm_tpu.core.dna import encode
 from pwasm_tpu.core.errors import PwasmError
 from pwasm_tpu.ops.ctx_scan import (PAD as PAD_CODE, ctx_scan_packed,
-                                    pack_events, pack_motifs,
-                                    ref_bucket_len, unpack_ctx_scan)
+                                    next_pow2, pack_events,
+                                    pack_motifs, ref_bucket_len,
+                                    unpack_ctx_scan)
 from pwasm_tpu.report.columnar import assemble_results, emit_batch_rows
 from pwasm_tpu.report.diff_report import get_ref_context  # noqa: F401
 
@@ -130,7 +131,16 @@ def submit_events_device(refseq: bytes, events,
                                    max_len=max_len,
                                    skip_codan=skip_codan)
 
+        def note_pad(evs) -> None:
+            # pow2 pad-waste accounting (ISSUE 11): pack_events pads
+            # the event axis to next_pow2(E, 256) — record live rows
+            # vs launched slots so pwasm_device_pad_waste_ratio can
+            # say how much of the device batch was bucket padding
+            if stats is not None and hasattr(stats, "note_pad"):
+                stats.note_pad(len(evs), next_pow2(len(evs)))
+
         if supervisor is None:
+            note_pad(small)
             out = launch_for(small)
         else:
             # a prior OOM demoted the run's pow2 batch ceiling: pre-
@@ -144,6 +154,7 @@ def submit_events_device(refseq: bytes, events,
             else:
                 chunks = [small]
             for evs in chunks:
+                note_pad(evs)
                 try:
                     pre.append(launch_for(evs))  # async submit;
                 except Exception:    # failures retried at finish

@@ -293,8 +293,14 @@ class BatchSupervisor:
             t_att = time.perf_counter()
 
             def _attempt_wall(_t0=t_att) -> None:
-                self.obs.observe("batch_attempt_seconds",
-                                 time.perf_counter() - _t0, site=site)
+                wall = time.perf_counter() - _t0
+                self.obs.observe("batch_attempt_seconds", wall,
+                                 site=site)
+                if self.stats is not None \
+                        and hasattr(self.stats, "note_attempt_wall"):
+                    # compile-vs-steady accounting (ISSUE 11): a
+                    # site's first attempt is compile-inclusive
+                    self.stats.note_attempt_wall(site, wall)
 
             try:
                 if self.stats is not None \

@@ -59,6 +59,24 @@ _CLIENT_USAGE = """Usage:
      depth, in-flight jobs, breaker state, job wall/queue-wait
      histograms, cumulative per-run counters) — the socket twin of
      `serve --metrics-textfile=PATH` (docs/OBSERVABILITY.md).
+
+ pwasm-tpu inspect --socket=PATH JOB_ID
+     print the job's FLIGHT RECORD as JSON (docs/OBSERVABILITY.md):
+     trace_id, phase-accounted walls (queue wait, lease wait, exec —
+     with the run's per-flush device/host/format breakdown inside)
+     and the bounded event ring (retries, breaker transitions, OOM
+     bisections, ckpt writes).  Works on live, finished, and
+     disk-spooled jobs (spooled records are CRC-verified).
+
+ Every frame this client sends carries a trace_id (minted per
+ connection, or --trace-id=ID to join an existing trace): the daemon
+ stamps it into its journal, event log, flight record and trace spans
+ — one greppable identity for a job across both processes.
+ `submit --trace-json=FILE` / `stream --trace-json=FILE` additionally
+ record the CLIENT's side (submit RPC / stream feed, result wait) as
+ a wall-anchored Chrome trace — written on error paths too, because a
+ daemon that died mid-job is exactly the incident the trace is for;
+ `pwasm-tpu trace-merge client.json daemon.json` joins the two.
 """
 
 # distinct from every CLI exit code (1/3/5/75): "the service queue is
@@ -72,12 +90,21 @@ class ServiceError(Exception):
 
 class ServiceClient:
     """One connection to a serve daemon.  Context-manager; every
-    command is one request/response frame pair on this connection."""
+    command is one request/response frame pair on this connection.
+
+    ``trace_id`` (minted per connection unless passed in) rides EVERY
+    frame: the daemon stamps it onto the jobs this client submits —
+    into the journal (surviving kill -9 replay), the event log, the
+    flight record, and both sides' Chrome traces — so one grep (or one
+    ``trace-merge``) reconstructs a job's whole cross-process life."""
 
     def __init__(self, socket_path: str, timeout: float | None = None,
-                 max_frame_bytes: int = protocol.MAX_FRAME_BYTES):
+                 max_frame_bytes: int = protocol.MAX_FRAME_BYTES,
+                 trace_id: str | None = None):
+        from pwasm_tpu.obs.events import new_run_id
         self.socket_path = socket_path
         self.max_frame_bytes = max_frame_bytes
+        self.trace_id = trace_id or new_run_id()
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         if timeout is not None:
             self._sock.settimeout(timeout)
@@ -92,6 +119,13 @@ class ServiceClient:
         self._wfile = self._sock.makefile("wb")
 
     # ---- plumbing ------------------------------------------------------
+    def _req(self, obj: dict) -> dict:
+        """One command frame, trace_id stamped (the propagation rule:
+        EVERY frame carries it, so even a bare status poll is
+        correlatable in a packet capture)."""
+        obj.setdefault("trace_id", self.trace_id)
+        return self.request(obj)
+
     def request(self, obj: dict) -> dict:
         try:
             protocol.write_frame(self._wfile, obj)
@@ -124,7 +158,7 @@ class ServiceClient:
 
     # ---- commands ------------------------------------------------------
     def ping(self) -> dict:
-        return self.request({"cmd": "ping"})
+        return self._req({"cmd": "ping"})
 
     def submit(self, argv: list[str], cwd: str | None = None,
                client: str | None = None,
@@ -142,20 +176,26 @@ class ServiceClient:
             req["client"] = client
         if priority is not None:
             req["priority"] = priority
-        return self.request(req)
+        return self._req(req)
 
     def status(self, job_id: str) -> dict:
-        return self.request({"cmd": "status", "job_id": job_id})
+        return self._req({"cmd": "status", "job_id": job_id})
 
     def result(self, job_id: str, wait: bool = True,
                timeout: float | None = None) -> dict:
         req: dict = {"cmd": "result", "job_id": job_id, "wait": wait}
         if timeout is not None:
             req["timeout"] = timeout
-        return self.request(req)
+        return self._req(req)
 
     def cancel(self, job_id: str) -> dict:
-        return self.request({"cmd": "cancel", "job_id": job_id})
+        return self._req({"cmd": "cancel", "job_id": job_id})
+
+    def inspect(self, job_id: str) -> dict:
+        """The job's flight record (docs/OBSERVABILITY.md): phase
+        walls + event ring, read from daemon RAM or the CRC-verified
+        result spool."""
+        return self._req({"cmd": "inspect", "job_id": job_id})
 
     # ---- streaming ingestion (docs/STREAMING.md) -----------------------
     def stream_open(self, argv: list[str], cwd: str | None = None,
@@ -171,16 +211,16 @@ class ServiceClient:
             req["client"] = client
         if priority is not None:
             req["priority"] = priority
-        return self.request(req)
+        return self._req(req)
 
     def stream_data(self, job_id: str, data: str) -> dict:
         """Feed one chunk of PAF text (any byte split — the daemon
         reassembles records across frames)."""
-        return self.request({"cmd": "stream-data", "job_id": job_id,
-                             "data": data})
+        return self._req({"cmd": "stream-data", "job_id": job_id,
+                          "data": data})
 
     def stream_end(self, job_id: str) -> dict:
-        return self.request({"cmd": "stream-end", "job_id": job_id})
+        return self._req({"cmd": "stream-end", "job_id": job_id})
 
     def stream(self, argv: list[str], chunks,
                cwd: str | None = None, client: str | None = None,
@@ -225,7 +265,9 @@ class ServiceClient:
                 # frames on one socket would corrupt the one-request/
                 # one-response pairing
                 try:
-                    with ServiceClient(self.socket_path) as kc:
+                    with ServiceClient(self.socket_path,
+                                       trace_id=self.trace_id) \
+                            as kc:
                         while not stop.wait(keepalive_s):
                             if not kc.stream_data(job_id,
                                                   "").get("ok"):
@@ -267,13 +309,13 @@ class ServiceClient:
         return resp
 
     def stats(self) -> dict:
-        return self.request({"cmd": "stats"})
+        return self._req({"cmd": "stats"})
 
     def metrics(self) -> dict:
-        return self.request({"cmd": "metrics"})
+        return self._req({"cmd": "metrics"})
 
     def drain(self) -> dict:
-        return self.request({"cmd": "drain"})
+        return self._req({"cmd": "drain"})
 
 
 def retry_backoff_s(attempt: int, hint_s: float | None,
@@ -336,6 +378,10 @@ def _parse_client_argv(argv: list[str]) -> tuple[dict, list[str]]:
             opts["client"] = a.split("=", 1)[1]
         elif a.startswith("--priority="):
             opts["priority"] = a.split("=", 1)[1]
+        elif a.startswith("--trace-id="):
+            opts["trace_id"] = a.split("=", 1)[1]
+        elif a.startswith("--trace-json="):
+            opts["trace_json"] = a.split("=", 1)[1]
         else:
             break
         i += 1
@@ -357,7 +403,8 @@ def _job_verdict(resp: dict, job_id: str, stdout, stderr) -> int:
         return EXIT_FATAL
     job = resp["job"]
     json.dump({"job_id": job_id, "state": job["state"],
-               "rc": resp.get("rc"), "detail": job.get("detail")},
+               "rc": resp.get("rc"), "detail": job.get("detail"),
+               "trace_id": job.get("trace_id")},
               stdout)
     stdout.write("\n")
     tail = resp.get("stderr_tail") or ""
@@ -389,17 +436,64 @@ def client_main(cmd: str, argv: list[str], stdout=None,
             stderr.write(f"{_CLIENT_USAGE}\nInvalid --timeout value: "
                          f"{opts['timeout']}\n")
             return EXIT_USAGE
+    # --trace-json: record THIS process's side of the job (the RPC
+    # spans) as a wall-anchored Chrome trace — the `trace-merge`
+    # counterpart of the daemon's serve --trace-json.  Built up here
+    # so both the submit and stream verbs share it.
+    tracer = None
+    if "trace_json" in opts:
+        from pwasm_tpu.obs import TraceRecorder
+        tracer = TraceRecorder()
+
+    def _span(name: str, t0, c) -> None:
+        if tracer is not None:
+            tracer.complete(name, t0, trace_id=c.trace_id)
+
+    def _write_trace() -> None:
+        if tracer is not None:
+            try:
+                tracer.write(opts["trace_json"])
+                stderr.write(f"pwasm: client trace written to "
+                             f"{opts['trace_json']}\n")
+            except OSError as e:
+                stderr.write(f"Warning: cannot write "
+                             f"--trace-json {opts['trace_json']}:"
+                             f" {e}\n")
+
     try:
         if cmd == "metrics":
-            with ServiceClient(sock) as c:
+            with ServiceClient(sock,
+                               trace_id=opts.get("trace_id")) as c:
                 resp = c.metrics()
             if not resp.get("ok"):
                 stderr.write(f"Error: metrics failed: {resp}\n")
                 return EXIT_FATAL
             stdout.write(resp.get("metrics", ""))
             return 0
+        if cmd == "inspect":
+            if len(job_argv) != 1:
+                stderr.write(f"{_CLIENT_USAGE}\nError: inspect needs "
+                             "exactly one JOB_ID\n")
+                return EXIT_USAGE
+            with ServiceClient(sock,
+                               trace_id=opts.get("trace_id")) as c:
+                resp = c.inspect(job_argv[0])
+            if not resp.get("ok"):
+                stderr.write(f"Error: inspect failed "
+                             f"({resp.get('error')}): "
+                             f"{resp.get('detail', '')}\n")
+                return EXIT_FATAL
+            json.dump({"job": resp.get("job"),
+                       "trace_id": resp.get("trace_id"),
+                       "flight": resp.get("flight"),
+                       **({"spool_error": resp["spool_error"]}
+                          if "spool_error" in resp else {})},
+                      stdout, indent=2)
+            stdout.write("\n")
+            return 0
         if cmd == "svc-stats":
-            with ServiceClient(sock) as c:
+            with ServiceClient(sock,
+                               trace_id=opts.get("trace_id")) as c:
                 if opts.get("drain"):
                     resp = c.drain()
                     if not resp.get("ok"):
@@ -432,20 +526,27 @@ def client_main(cmd: str, argv: list[str], stdout=None,
                        iter(lambda: buf.read1(1 << 16), b""))
             else:
                 src = iter(sys.stdin.readline, "")
-            with ServiceClient(sock) as c:
+            with ServiceClient(sock,
+                               trace_id=opts.get("trace_id")) as c:
+                t0 = tracer.now() if tracer is not None else 0.0
                 resp = c.stream(job_argv, src,
                                 client=opts.get("client"),
                                 priority=opts.get("priority"),
                                 keepalive_s=30.0)
+                _span("stream_feed", t0, c)
                 if not resp.get("ok"):
                     code = resp.get("error")
                     stderr.write(f"Error: stream rejected ({code}): "
                                  f"{resp.get('detail', '')}\n")
+                    _write_trace()
                     return EXIT_QUEUE_FULL \
                         if code == protocol.ERR_QUEUE_FULL \
                         else EXIT_FATAL
                 job_id = resp["job_id"]
+                t0 = tracer.now() if tracer is not None else 0.0
                 resp = c.result(job_id, wait=True, timeout=timeout)
+                _span("result_wait", t0, c)
+            _write_trace()
             return _job_verdict(resp, job_id, stdout, stderr)
         # submit
         if not job_argv:
@@ -460,10 +561,13 @@ def client_main(cmd: str, argv: list[str], stdout=None,
                              f"value: {val}\n")
                 return EXIT_USAGE
             retries = int(val)
-        with ServiceClient(sock) as c:
+        with ServiceClient(sock,
+                           trace_id=opts.get("trace_id")) as c:
             for attempt in range(retries + 1):
+                t0 = tracer.now() if tracer is not None else 0.0
                 resp = c.submit(job_argv, client=opts.get("client"),
                                 priority=opts.get("priority"))
+                _span("submit_rpc", t0, c)
                 if resp.get("ok") \
                         or resp.get("error") != protocol.ERR_QUEUE_FULL \
                         or attempt >= retries:
@@ -481,6 +585,7 @@ def client_main(cmd: str, argv: list[str], stdout=None,
                 code = resp.get("error")
                 stderr.write(f"Error: submission rejected "
                              f"({code}): {resp.get('detail', '')}\n")
+                _write_trace()
                 if code == protocol.ERR_QUEUE_FULL:
                     hint = resp.get("retry_after_s")
                     if hint is not None:
@@ -489,12 +594,20 @@ def client_main(cmd: str, argv: list[str], stdout=None,
                 return EXIT_FATAL
             job_id = resp["job_id"]
             if opts.get("no_wait"):
-                json.dump({"job_id": job_id, "state": "queued"},
+                json.dump({"job_id": job_id, "state": "queued",
+                           "trace_id": resp.get("trace_id")},
                           stdout)
                 stdout.write("\n")
+                _write_trace()
                 return 0
+            t0 = tracer.now() if tracer is not None else 0.0
             resp = c.result(job_id, wait=True, timeout=timeout)
+            _span("result_wait", t0, c)
+        _write_trace()
         return _job_verdict(resp, job_id, stdout, stderr)
     except ServiceError as e:
         stderr.write(f"Error: {e}\n")
+        # the client-side trace is most valuable exactly when the
+        # daemon died mid-job: flush whatever spans landed
+        _write_trace()
         return EXIT_FATAL

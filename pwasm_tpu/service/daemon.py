@@ -62,7 +62,9 @@ _SERVE_USAGE = """Usage:
                  [--spool-dir=DIR] [--stream-buffer=N]
                  [--stream-idle-s=S]
                  [--max-frame-bytes=N] [--metrics-textfile=PATH]
-                 [--log-json=FILE] [--result-ttl-s=S] [--max-results=N]
+                 [--log-json=FILE] [--log-json-max-bytes=N]
+                 [--trace-json=FILE]
+                 [--result-ttl-s=S] [--max-results=N]
 
    --socket=PATH        unix socket to listen on (required)
    --max-queue=N        admission control: PER-CLIENT queued-job
@@ -133,7 +135,19 @@ _SERVE_USAGE = """Usage:
    --log-json=FILE      append structured NDJSON service events (job
                         admit/start/finish/evict, drains, breaker
                         transitions inside jobs go to each job's own
-                        --log-json)
+                        --log-json); every job event carries the
+                        job's trace_id
+   --log-json-max-bytes=N  rotate the service event log once it
+                        passes N bytes (FILE moves to FILE.1, one
+                        generation kept; a log_rotate event opens the
+                        fresh file) — a long-lived daemon's log stays
+                        bounded
+   --trace-json=FILE    record the daemon's job-lifecycle spans
+                        (queue wait, lease wait, exec — each stamped
+                        with the job's trace_id) as Chrome trace JSON,
+                        written at exit; `pwasm-tpu trace-merge` joins
+                        it with a client's trace onto one wall-
+                        anchored timeline (docs/OBSERVABILITY.md)
    --result-ttl-s=S     evict a finished job's result S seconds after
                         it finished (default: keep forever); evicted
                         job ids answer unknown_job
@@ -231,11 +245,17 @@ class _JobWarm:
     as before."""
 
     def __init__(self, shared: WarmContext, drain: SignalDrain,
-                 lease, expose_devices: bool = False):
+                 lease, expose_devices: bool = False,
+                 trace_id: str | None = None, flight=None):
         self._shared = shared
         self.drain = drain
         self.lease = lease
         self.lease_devices = lease.devices if expose_devices else None
+        # cross-process trace identity + the per-job flight record
+        # (ISSUE 11): cli.run stamps the trace_id on its event lines
+        # (run_id) and feeds its spans into the flight recorder
+        self.trace_id = trace_id
+        self.flight = flight
 
     @property
     def monitor(self):
@@ -274,8 +294,12 @@ class Daemon:
                  spool_threshold_bytes: int | None = None,
                  spool_dir: str | None = None,
                  stream_buffer: int = 512,
-                 stream_idle_s: float | None = 300.0):
+                 stream_idle_s: float | None = 300.0,
+                 log_json_max_bytes: int | None = None,
+                 trace_json: str | None = None):
         self.socket_path = socket_path
+        self._t0_mono = time.monotonic()   # uptime origin (the lane
+        #   busy-fraction gauges divide by it)
         self.max_concurrent = max(1, int(max_concurrent))
         # device-lease scheduler (ISSUE 8): every running job holds one
         # lane of the device inventory.  lanes defaults to the worker
@@ -345,7 +369,7 @@ class Daemon:
         # folded into (fold_run_stats), exposed over the `metrics`
         # protocol command and, optionally, a node-exporter textfile.
         from pwasm_tpu.obs import (EventLog, MetricsRegistry,
-                                   Observability)
+                                   Observability, TraceRecorder)
         from pwasm_tpu.obs.catalog import (build_run_metrics,
                                            build_service_metrics,
                                            build_stream_metrics)
@@ -370,10 +394,23 @@ class Daemon:
         events = None
         if log_json:
             # append (documented): a restarted daemon extends the
-            # incident timeline instead of wiping the previous one
-            events = EventLog(open(log_json, "a"))
+            # incident timeline instead of wiping the previous one;
+            # --log-json-max-bytes rotates it (FILE -> FILE.1) so a
+            # long-lived daemon's log stays bounded
+            events = EventLog(path=log_json,
+                              max_bytes=log_json_max_bytes)
+        # --trace-json (ISSUE 11): the daemon's OWN span recorder —
+        # per-job queue-wait/lease-wait/exec spans stamped with the
+        # job's trace_id, wall-anchored so `trace-merge` can join them
+        # with the submitting client's trace on one timeline
+        tracer = TraceRecorder() if trace_json else None
+        if tracer is not None:
+            dropped = self.run_metrics.get("trace_dropped")
+            if dropped is not None:
+                tracer.on_drop = lambda c=dropped: c.inc()
         self.obs = Observability(registry=self.registry,
-                                 events=events)
+                                 events=events, tracer=tracer,
+                                 trace_path=trace_json)
         self.drain.obs = self.obs   # SIGTERM/drain lands in the log
         # ---- result eviction (the PR 5 "results live forever" gap):
         # TTL and/or LRU ceiling over TERMINAL jobs only — running and
@@ -518,6 +555,14 @@ class Daemon:
         self.obs.event("daemon_exit", rc=rc,
                        drained=self.drain.requested)
         self._write_textfile()       # final snapshot for the scraper
+        if self.obs.tracer is not None and self.obs.trace_path:
+            try:
+                self.obs.tracer.write(self.obs.trace_path)
+                self._say("trace written to "
+                          f"{self.obs.trace_path}")
+            except OSError as e:
+                self._say(f"warning: cannot write --trace-json "
+                          f"{self.obs.trace_path}: {e}")
         if self.obs.events is not None:
             self.obs.events.close()
         if self.drain.requested:
@@ -555,13 +600,22 @@ class Daemon:
         m["breaker_state"].set(self.leases.breaker_rollup())
         m["lanes_busy"].set(self.leases.busy_count())
         m["lease_waiting"].set(self.leases.waiting_count())
+        uptime = max(1e-9, time.monotonic() - self._t0_mono)
         for row in self.leases.lane_states():
             m["lane_breaker_state"].set(row["breaker_state"],
                                         lane=str(row["lane"]))
+            # utilization accounting (ISSUE 11): fraction of the
+            # daemon's uptime this lane spent leased to a job
+            m["lane_busy_fraction"].set(
+                round(min(1.0, row["busy_s"] / uptime), 6),
+                lane=str(row["lane"]))
         m["spool_bytes"].set(spool_bytes)
         for c, lag in self.streams.client_lag().items():
             self.stream_metrics["lag"].set(lag,
                                            client=c or "default")
+        for c, age in self.streams.client_lag_age().items():
+            self.stream_metrics["lag_age"].set(round(age, 3),
+                                               client=c or "default")
         depths = self.queue.client_depths()
         for c in clients_seen | set(depths):
             # every client ever admitted keeps a series: a drained
@@ -643,10 +697,11 @@ class Daemon:
                 continue
             client = str(admit.get("client") or "")
             priority = str(admit.get("priority") or "")
+            trace_id = str(admit.get("trace_id") or "")
             fin = row["finish"]
             if fin is not None or row["cancel"] is not None:
                 job = Job(id=jid, argv=list(argv), client=client,
-                          priority=priority)
+                          priority=priority, trace_id=trace_id)
                 job.submitted_s = _num(admit.get("t"),
                                        job.submitted_s)
                 if fin is not None:
@@ -701,7 +756,7 @@ class Daemon:
                 # by the resume contract), and remember its lane so
                 # the re-opened stream inherits the warm state
                 job = Job(id=jid, argv=list(argv), client=client,
-                          priority=priority)
+                          priority=priority, trace_id=trace_id)
                 job.stream = True
                 job.submitted_s = _num(admit.get("t"),
                                        job.submitted_s)
@@ -735,7 +790,7 @@ class Daemon:
             if resume and "--resume" not in run_argv:
                 run_argv.append("--resume")
             job = Job(id=jid, argv=list(run_argv), client=client,
-                      priority=priority)
+                      priority=priority, trace_id=trace_id)
             job.recovered = True
             job.submitted_s = _num(admit.get("t"), job.submitted_s)
             if resume and isinstance(row["start"].get("lane"), int):
@@ -797,8 +852,17 @@ class Daemon:
 
         from pwasm_tpu.utils.fsio import (payload_crc,
                                           write_durable_text)
+        flight = None
+        if job.flight is not None:
+            # the flight record is finalized HERE (phase walls are all
+            # in by the terminal state) and rides the spool payload —
+            # `inspect` on a spooled job reads it back CRC-verified
+            wall = (job.finished_s or time.time()) - job.submitted_s
+            flight = job.flight.summary(wall_s=wall)
         payload = {"version": 1, "job_id": job.id,
                    "state": job.state, "rc": job.rc,
+                   "trace_id": job.trace_id or None,
+                   "flight": flight,
                    "stats": job.stats,
                    "stderr_tail": job.stderr_tail}
         blob = json.dumps(payload, sort_keys=True,
@@ -820,16 +884,19 @@ class Daemon:
         job.spool = {"path": path, "bytes": len(out)}
         job.stats = None
         job.stderr_tail = ""
+        job.flight = None     # the spool file holds it now — RAM
+        #                       keeps only the index row
         with self._lock:     # workers race this read-modify-write
             self._spool_bytes += len(out)
         self.obs.event("result_spool", job_id=job.id,
                        bytes=len(out))
 
     def _load_spool(self, job: Job):
-        """(stats, stderr_tail, error) read back from the job's spool
-        file, CRC-verified (the ckpt-v2 rule: a result that fails
+        """(payload, error) read back from the job's spool file,
+        CRC-verified (the ckpt-v2 rule: a result that fails
         verification is reported unreadable, never served as if
-        whole)."""
+        whole).  The payload dict carries stats, stderr_tail, and —
+        since ISSUE 11 — the job's trace_id and flight record."""
         import json
 
         from pwasm_tpu.utils.fsio import payload_crc
@@ -841,10 +908,9 @@ class Daemon:
             crc = int(obj.pop("crc"))
             if payload_crc(obj) != crc:
                 raise ValueError("spool payload CRC mismatch")
-            return (obj.get("stats"),
-                    str(obj.get("stderr_tail") or ""), None)
+            return obj, None
         except (OSError, ValueError, KeyError, TypeError) as e:
-            return None, "", f"spooled result unreadable ({e})"
+            return None, f"spooled result unreadable ({e})"
 
     def _unlink_spool(self, job: Job) -> None:
         if job.spool is None:
@@ -890,7 +956,8 @@ class Daemon:
             self._journal_append(REC_EVICT, job_id=j.id)
             self.stats.jobs_evicted += 1
             self.svc_metrics["results_evicted"].inc()
-            self.obs.event("job_evict", job_id=j.id, state=j.state)
+            self.obs.event("job_evict", job_id=j.id, state=j.state,
+                           trace_id=j.trace_id)
 
     def _drained(self) -> bool:
         with self._lock:
@@ -948,6 +1015,12 @@ class Daemon:
             # back each round, reordering two waiting jobs); drain
             # wakes the ticket empty-handed, and should_abort covers
             # the drain-less close path
+            # flight accounting (ISSUE 11): queue wait ends at this
+            # dequeue; the lease wait is its own phase — the two must
+            # not overlap or the accounted sum overshoots the wall
+            queue_wait = max(0.0, time.time() - job.submitted_s)
+            if job.flight is not None:
+                job.flight.note("queue_wait", queue_wait)
             t_wait = time.monotonic()
             lease = self.leases.acquire(
                 should_abort=self._closing.is_set,
@@ -955,8 +1028,27 @@ class Daemon:
             if lease is None:        # drained, or closing mid-wait
                 self._preempt_leaseless(job)
                 continue
-            self.svc_metrics["lease_wait_seconds"].observe(
-                time.monotonic() - t_wait)
+            waited = time.monotonic() - t_wait
+            self.svc_metrics["lease_wait_seconds"].observe(waited)
+            if job.flight is not None:
+                job.flight.note("lease_wait", waited,
+                                lane=lease.lane)
+            if self.obs.tracer is not None:
+                # the daemon's trace timeline: queue + lease waits as
+                # back-to-back complete spans (explicit end times —
+                # the queue wait ends EXACTLY where the lease wait
+                # starts, preserving the monotonic-nesting schema),
+                # stamped with the job's trace_id so trace-merge can
+                # follow one job across both processes
+                now = self.obs.tracer.now()
+                self.obs.tracer.complete(
+                    "job_queue_wait", now - waited - queue_wait,
+                    now - waited, job_id=job.id,
+                    trace_id=job.trace_id)
+                self.obs.tracer.complete(
+                    "job_lease_wait", now - waited, now,
+                    job_id=job.id, trace_id=job.trace_id,
+                    lane=lease.lane)
             with self._lock:
                 self._running[job.id] = job
             try:
@@ -1011,6 +1103,7 @@ class Daemon:
         self._journal_append(REC_START, job_id=job.id,
                              lane=lease.lane)
         self.obs.event("job_start", job_id=job.id, lane=lease.lane,
+                       trace_id=job.trace_id,
                        queue_wait_s=round(job.started_s
                                           - job.submitted_s, 6))
         # a drain latched between this job's dequeue and here must
@@ -1020,17 +1113,25 @@ class Daemon:
                 and not job.drain.requested:
             job.drain.request(self.drain.reason or "service draining")
         warm = _JobWarm(self.warm, job.drain, lease,
-                        expose_devices=self._expose_devices)
+                        expose_devices=self._expose_devices,
+                        trace_id=job.trace_id, flight=job.flight)
         rc: int | None = None
         kw = {"input_stream": job.feed} if job.stream else {}
         try:
-            rc = self._runner(job.argv, stdout=job.outbuf,
-                              stderr=job.errbuf, warm=warm, **kw)
+            with self.obs.span("job_exec", job_id=job.id,
+                               trace_id=job.trace_id,
+                               lane=lease.lane):
+                rc = self._runner(job.argv, stdout=job.outbuf,
+                                  stderr=job.errbuf, warm=warm, **kw)
         except BaseException as e:   # InjectedKill, stray PwasmError —
             # a dying job must never take the daemon down with it
             job.detail = f"job raised {type(e).__name__}: {e}"
         job.rc = rc
         job.finished_s = time.time()
+        if job.flight is not None:
+            job.flight.note("exec", max(
+                0.0, job.finished_s - job.started_s),
+                lane=lease.lane, rc=rc)
         self._job_walls.append(job.finished_s - job.started_s)
         job.stderr_tail = job.errbuf.getvalue()[-4000:]
         # a resident daemon must not retain every finished job's full
@@ -1083,7 +1184,7 @@ class Daemon:
                              spool=job.spool)
         self.obs.event(
             "job_finish", job_id=job.id, state=job.state, rc=rc,
-            lane=lease.lane,
+            lane=lease.lane, trace_id=job.trace_id,
             wall_s=round(job.finished_s - job.started_s, 6),
             detail=job.detail or None)
         self._write_textfile()
@@ -1109,7 +1210,12 @@ class Daemon:
         """Per-job drain flag + RunStats sink (a daemon-owned stats
         tmp is injected when the client didn't pass ``--stats`` — the
         daemon needs every job's RunStats for the roll-up and warm-hit
-        gates).  Shared by fresh admissions and journal recovery."""
+        gates) + the flight recorder (ISSUE 11).  Shared by fresh
+        admissions and journal recovery."""
+        from pwasm_tpu.obs.flight import FlightRecorder
+        job.flight = FlightRecorder(trace_id=job.trace_id or None)
+        if job.recovered:
+            job.flight.mark("journal_recovered")
         job.drain = SignalDrain(stderr=job.errbuf,
                                 hard_exit=lambda code: None)
         stats_path = next(
@@ -1125,7 +1231,8 @@ class Daemon:
     def submit(self, argv: list, cwd: str | None = None,
                client: str | None = None,
                priority: str | None = None,
-               stream: bool = False) -> Job:
+               stream: bool = False,
+               trace_id: str | None = None) -> Job:
         """Validate + admit one job (raises Draining/QueueFull/
         ValueError).  Also the in-process API the tests drive.
         ``cwd`` is the CLIENT's working directory: relative paths in
@@ -1154,6 +1261,18 @@ class Daemon:
             priority = ""
         if not isinstance(priority, str):
             raise ValueError("priority must be a string")
+        # cross-process trace identity (ISSUE 11): ServiceClient mints
+        # one and sends it on every frame; a frame without one (an
+        # older client, a hand-rolled nc pipe) gets a daemon-minted id
+        # so EVERY job is trace-correlatable
+        if trace_id is None or trace_id == "":
+            from pwasm_tpu.obs.events import new_run_id
+            trace_id = new_run_id()
+        if not isinstance(trace_id, str) or len(trace_id) > 64 \
+                or not _CLIENT_RE.match(trace_id):
+            raise ValueError(
+                "trace_id must be a short identifier "
+                "([A-Za-z0-9_.:@/-]{1,64})")
         if priority:
             lanes = [l for l in self.queue.priority_lanes if l]
             if not lanes:
@@ -1202,7 +1321,8 @@ class Daemon:
         with self._lock:
             self._next_id += 1
             job = Job(id=f"job-{self._next_id:04d}", argv=list(argv),
-                      client=client, priority=priority)
+                      client=client, priority=priority,
+                      trace_id=trace_id)
         self._arm_job(job)
         if stream:
             from pwasm_tpu.stream.pafstream import StreamFeed
@@ -1228,7 +1348,7 @@ class Daemon:
         # job nobody was promised — the benign direction.)
         self._journal_append(REC_ADMIT, job_id=job.id,
                              argv=base_argv, client=client,
-                             priority=priority,
+                             priority=priority, trace_id=trace_id,
                              **({"stream": True} if stream else {}))
         try:
             self.queue.submit(job)
@@ -1245,7 +1365,8 @@ class Daemon:
         self.stats.jobs_accepted += 1
         self.svc_metrics["jobs"].inc(outcome="accepted")
         self.obs.event("job_admit", job_id=job.id, client=client,
-                       stream=stream, queue_depth=self.queue.depth())
+                       trace_id=job.trace_id, stream=stream,
+                       queue_depth=self.queue.depth())
         return job
 
     def _retry_after_s(self) -> float:
@@ -1322,7 +1443,8 @@ class Daemon:
                 job = self.submit(req.get("args"),
                                   cwd=req.get("cwd"),
                                   client=client,
-                                  priority=req.get("priority"))
+                                  priority=req.get("priority"),
+                                  trace_id=req.get("trace_id"))
             except ValueError as e:
                 return protocol.err(protocol.ERR_BAD_REQUEST, str(e))
             except Draining as e:
@@ -1346,6 +1468,7 @@ class Daemon:
                         client, 0),
                     retry_after_s=self._retry_after_s())
             return protocol.ok(job_id=job.id,
+                               trace_id=job.trace_id,
                                queue_depth=self.queue.depth())
         if cmd == "stream":
             # streaming ingestion (ISSUE 10): admit a job whose PAF
@@ -1360,7 +1483,8 @@ class Daemon:
                                   cwd=req.get("cwd"),
                                   client=client,
                                   priority=req.get("priority"),
-                                  stream=True)
+                                  stream=True,
+                                  trace_id=req.get("trace_id"))
             except ValueError as e:
                 return protocol.err(protocol.ERR_BAD_REQUEST, str(e))
             except Draining as e:
@@ -1378,6 +1502,7 @@ class Daemon:
                     client=client or "default",
                     retry_after_s=self._retry_after_s())
             return protocol.ok(job_id=job.id,
+                               trace_id=job.trace_id,
                                max_buffer=self.streams.max_buffer,
                                queue_depth=self.queue.depth())
         if cmd in ("stream-data", "stream-end"):
@@ -1528,7 +1653,7 @@ class Daemon:
                     and j.started_s is None)
             return protocol.ok(draining=True, running=running,
                                preempted_queued=preempted)
-        if cmd in ("status", "result", "cancel"):
+        if cmd in ("status", "result", "cancel", "inspect"):
             job = self.jobs.get(req.get("job_id"))
             if job is None:
                 # unknown OR evicted (--result-ttl-s/--max-results):
@@ -1537,6 +1662,26 @@ class Daemon:
                     protocol.ERR_UNKNOWN_JOB,
                     f"unknown job_id {req.get('job_id')!r}")
             job.accessed_s = time.time()   # the LRU clock
+            if cmd == "inspect":
+                # the flight record (ISSUE 11): phase-accounted walls
+                # + the event ring — from RAM while the job holds it,
+                # from the CRC-verified spool once the result moved
+                # to disk
+                flight = None
+                spool_error = None
+                if job.spool is not None:
+                    obj, spool_error = self._load_spool(job)
+                    flight = obj.get("flight") if obj else None
+                elif job.flight is not None:
+                    wall = ((job.finished_s or time.time())
+                            - job.submitted_s)
+                    flight = job.flight.summary(wall_s=wall)
+                resp = protocol.ok(job=job.describe(),
+                                   trace_id=job.trace_id,
+                                   flight=flight)
+                if spool_error is not None:
+                    resp["spool_error"] = spool_error
+                return resp
             if cmd == "status":
                 return protocol.ok(job=job.describe(),
                                    queue_depth=self.queue.depth())
@@ -1551,7 +1696,10 @@ class Daemon:
                 if job.spool is not None:
                     # disk-spooled result: RAM held only the index —
                     # the frame streams from the spool file on demand
-                    stats, tail, spool_error = self._load_spool(job)
+                    obj, spool_error = self._load_spool(job)
+                    stats = obj.get("stats") if obj else None
+                    tail = str(obj.get("stderr_tail") or "") \
+                        if obj else ""
                 resp = protocol.ok(job=d, rc=job.rc, stats=stats,
                                    stderr_tail=tail)
                 if spool_error is not None:
@@ -1573,7 +1721,8 @@ class Daemon:
             self._journal_append(REC_FINISH, job_id=job.id,
                                  state=JOB_CANCELLED, rc=None,
                                  detail=job.detail)
-            self.obs.event("job_cancel", job_id=job.id, was="queued")
+            self.obs.event("job_cancel", job_id=job.id, was="queued",
+                           trace_id=job.trace_id)
             job.done.set()
             return protocol.ok(state=JOB_CANCELLED, was="queued")
         if job.state in TERMINAL_STATES:
@@ -1591,7 +1740,8 @@ class Daemon:
         # journaled so a crash mid-cancel cannot silently UN-cancel:
         # replay lands the job terminal-cancelled instead of re-running
         self._journal_append(REC_CANCEL, job_id=job.id)
-        self.obs.event("job_cancel", job_id=job.id, was="running")
+        self.obs.event("job_cancel", job_id=job.id, was="running",
+                       trace_id=job.trace_id)
         return protocol.ok(state="cancelling", was="running")
 
 
@@ -1722,7 +1872,8 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
                        ("devices-per-job", 1), ("lanes", None),
                        ("max-queue-total", None),
                        ("spool-threshold-bytes", None),
-                       ("stream-buffer", 512)):
+                       ("stream-buffer", 512),
+                       ("log-json-max-bytes", None)):
         val = opts.pop(knob, None)
         if val is None:
             nums[knob] = dflt
@@ -1769,6 +1920,10 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
             return EXIT_USAGE
     metrics_textfile = opts.pop("metrics-textfile", None)
     log_json = opts.pop("log-json", None)
+    trace_json = opts.pop("trace-json", None)
+    if trace_json is not None and not trace_json.strip():
+        stderr.write(f"{_SERVE_USAGE}\nInvalid --trace-json value\n")
+        return EXIT_USAGE
     result_ttl_s = None
     val = opts.pop("result-ttl-s", None)
     if val is not None:
@@ -1811,7 +1966,9 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
                             "spool-threshold-bytes"],
                         spool_dir=spool_dir,
                         stream_buffer=nums["stream-buffer"],
-                        stream_idle_s=stream_idle_s)
+                        stream_idle_s=stream_idle_s,
+                        log_json_max_bytes=nums["log-json-max-bytes"],
+                        trace_json=trace_json)
     except OSError:
         stderr.write(f"Cannot open file {log_json} for writing!\n")
         return EXIT_USAGE

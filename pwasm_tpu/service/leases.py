@@ -63,6 +63,9 @@ class DeviceLease:
         self.monitor = None
         self.jobs_run = 0
         self.busy = False
+        self.busy_s_total = 0.0    # cumulative leased wall (the
+        #   per-lane busy-fraction gauge source, ISSUE 11)
+        self.granted_at = 0.0      # monotonic grant time while busy
 
     @property
     def devices(self) -> tuple[int, int]:
@@ -166,6 +169,7 @@ class LeaseManager:
                 if lease is None:
                     lease = self._free.popleft()
                 lease.busy = True
+                lease.granted_at = time.monotonic()
                 self.grants += 1
                 return lease
             w = _Waiter()
@@ -211,11 +215,14 @@ class LeaseManager:
         oldest waiter if one queued (FIFO — the starvation guard)."""
         with self._lock:
             lease.busy = False
+            lease.busy_s_total += max(
+                0.0, time.monotonic() - lease.granted_at)
             lease.jobs_run += 1
             while self._waiters:
                 w = self._waiters.popleft()
                 if not w.event.is_set():
                     lease.busy = True
+                    lease.granted_at = time.monotonic()
                     w.box = lease
                     w.event.set()
                     return
@@ -248,15 +255,22 @@ class LeaseManager:
         """Per-lane stats rows (the svc-stats ``lanes`` block)."""
         from pwasm_tpu.obs.catalog import breaker_state_value
         out = []
+        now = time.monotonic()
         with self._lock:
             for lease in self._leases:
                 st = lease.supervisor_state
                 mon = lease.monitor
+                busy_s = lease.busy_s_total
+                if lease.busy:
+                    # include the CURRENT grant's elapsed time, so a
+                    # long-running job shows as busy wall, not zero
+                    busy_s += max(0.0, now - lease.granted_at)
                 out.append({
                     "lane": lease.lane,
                     "devices": [lease.device_lo, lease.device_hi],
                     "busy": lease.busy,
                     "jobs_run": lease.jobs_run,
+                    "busy_s": round(busy_s, 3),
                     "breaker_state": breaker_state_value(
                         bool(st.get("breaker_open")) if st else False,
                         mon.state if mon is not None else None),

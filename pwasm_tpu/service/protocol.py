@@ -46,6 +46,13 @@ Commands (``{"cmd": ...}``):
                records; the job finishes its tail (MSA/summary) and
                lands terminal.  Follow with ``result`` to wait.
 ``status``     ``{"cmd":"status","job_id":...}`` — non-blocking state.
+``inspect``    ``{"cmd":"inspect","job_id":...}`` — the job's FLIGHT
+               RECORD (docs/OBSERVABILITY.md): trace_id,
+               phase-accounted walls (queue wait, lease wait, exec —
+               per-flush device/host/format breakdown inside) and the
+               bounded event ring.  Served from daemon RAM for live
+               jobs and from the CRC-verified result spool once the
+               result moved to disk.
 ``result``     ``{"cmd":"result","job_id":...[,"wait":bool,
                "timeout":s]}`` — the terminal verdict (rc, per-job
                RunStats, stderr tail); by default blocks until the job
@@ -63,6 +70,14 @@ Commands (``{"cmd": ...}``):
 
 Error responses are ``{"ok": false, "error": <code>, "detail": ...}``
 with codes from the ``ERR_*`` constants below.
+
+Trace propagation (ISSUE 11): every request frame MAY carry a
+``trace_id`` field (short identifier, ``[A-Za-z0-9_.:@/-]{1,64}``);
+``ServiceClient`` mints one per connection and sends it on every
+frame.  The ``submit``/``stream`` handlers stamp it onto the admitted
+job — journal record, event-log lines, flight record, both sides'
+Chrome traces — and echo it in the ok frame; a frame without one gets
+a daemon-minted id, so every job is trace-correlatable either way.
 """
 
 from __future__ import annotations

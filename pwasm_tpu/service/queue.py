@@ -74,6 +74,14 @@ class Job:
     client: str = ""                   # fair-share identity (peer uid
     #   or the submit frame's client= field); "" = anonymous bucket
     priority: str = ""                 # priority lane ("" = default)
+    trace_id: str = ""                 # cross-process trace identity
+    #   (ISSUE 11): minted by the submitting ServiceClient (or the
+    #   daemon when the frame carried none), stamped into the journal,
+    #   event-log lines, both sides' Chrome traces, and the flight
+    #   record — one greppable id for a job's whole life
+    flight: object = field(default=None, repr=False)  # the job's
+    #   obs.flight.FlightRecorder (phase walls + event ring), served
+    #   by the `inspect` verb and spooled with the result
     prefer_lane: int | None = None     # device-lane affinity hint (a
     #   journal-recovered job asks for the lane it ran on; a stream
     #   job asks for the lane its client's last stream warmed)
@@ -115,6 +123,7 @@ class Job:
             "cancel_requested": self.cancel_requested,
             "client": self.client,
             "priority": self.priority,
+            "trace_id": self.trace_id,
             "stream": self.stream,
             "recovered": self.recovered,
             "submitted_s": round(self.submitted_s, 3),
@@ -467,6 +476,20 @@ class StreamBook:
             for client, feed in self._streams.values():
                 out[client] = out.get(client, 0) + feed.buffered
             return out
+
+    def client_lag_age(self) -> dict[str, float]:
+        """Age of the oldest unconsumed record per client (worst
+        stream wins) — the ``pwasm_stream_lag_age_seconds`` gauge
+        source; same every-client-keeps-a-series rule as
+        :meth:`client_lag`."""
+        with self._lock:
+            streams = list(self._streams.values())
+            out = {c: 0.0 for c in self._clients_seen}
+        for client, feed in streams:
+            age = feed.lag_age_s() if hasattr(feed, "lag_age_s") \
+                else 0.0
+            out[client] = max(out.get(client, 0.0), age)
+        return out
 
 
 class ServiceStats:

@@ -1,0 +1,171 @@
+"""``pwasm-tpu top`` — live fleet introspection over one socket.
+
+A refresh-loop terminal view rendered from the daemon's ``stats``
+response (the SAME registry-backed svc-stats surface ``pwasm-tpu
+svc-stats`` prints as JSON, so the two cannot disagree): device-lease
+lanes with busy fraction and breaker state, queued jobs per fair-share
+client, live streams with buffer lag, and the job-outcome counters.
+One screen answers the operator's first three incident questions —
+is anything degraded, who is queued, is a stream backing up — without
+leaving the terminal.
+
+``--once`` renders a single frame and exits (the scriptable/testable
+form; the refresh loop just repaints it).  Rendering is a pure
+function of the stats dict (:func:`render`), unit-tested directly.
+
+Like every ``pwasm_tpu/service/`` module this file is jax-free
+(``qa/check_supervision.py``).
+"""
+
+from __future__ import annotations
+
+import time
+
+from pwasm_tpu.core.errors import EXIT_FATAL, EXIT_USAGE
+
+_TOP_USAGE = """Usage:
+ pwasm-tpu top --socket=PATH [--interval=S] [--once]
+
+   --socket=PATH   the serve daemon's unix socket (required)
+   --interval=S    refresh period in seconds (default 2)
+   --once          render one frame and exit (no screen clearing)
+
+ Ctrl-C exits.  The view is rendered from the daemon's svc-stats
+ response (docs/OBSERVABILITY.md).
+"""
+
+_BREAKER_NAMES = {0: "closed", 1: "HALF-OPEN", 2: "OPEN"}
+
+
+def _fmt_breaker(v) -> str:
+    return _BREAKER_NAMES.get(v, str(v))
+
+
+def render(st: dict) -> str:
+    """One ``top`` frame from a svc-stats dict — pure and total:
+    missing blocks render as empty sections, never a crash (an older
+    daemon's stats must still display)."""
+    out: list[str] = []
+    jobs = st.get("jobs") or {}
+    out.append(
+        f"pwasm-tpu top — uptime {st.get('uptime_s', 0):.0f}s"
+        + ("  [DRAINING]" if st.get("draining") else "")
+        + f"  breaker {_fmt_breaker(st.get('breaker_state', 0))}")
+    out.append(
+        f" jobs: {st.get('running', 0)} running, "
+        f"{st.get('queue_depth', 0)} queued | "
+        f"done {jobs.get('completed', 0)}  "
+        f"failed {jobs.get('failed', 0)}  "
+        f"preempted {jobs.get('preempted', 0)}  "
+        f"cancelled {jobs.get('cancelled', 0)}  "
+        f"rejected {jobs.get('rejected', 0)}  "
+        f"recovered {jobs.get('recovered', 0)}")
+    lanes = st.get("lanes") or []
+    if lanes:
+        uptime = max(1e-9, float(st.get("uptime_s") or 0) or 1e-9)
+        out.append("")
+        out.append(" LANE  DEVICES   STATE  JOBS  BUSY%  BREAKER")
+        for row in lanes:
+            dev = row.get("devices") or [0, 0]
+            busy_pct = 100.0 * min(
+                1.0, float(row.get("busy_s") or 0.0) / uptime)
+            out.append(
+                f" {row.get('lane', '?'):>4}  "
+                f"[{dev[0]},{dev[1]}) ".ljust(10)
+                + f"{'busy' if row.get('busy') else 'idle':>5}  "
+                f"{row.get('jobs_run', 0):>4}  "
+                f"{busy_pct:>4.0f}%  "
+                f"{_fmt_breaker(row.get('breaker_state', 0))}")
+    fair = st.get("fair_share") or {}
+    clients = fair.get("clients") or {}
+    queued = {c: n for c, n in sorted(clients.items()) if n}
+    out.append("")
+    if queued:
+        out.append(f" QUEUE by client (quota "
+                   f"{fair.get('max_queue_per_client', '?')}/client, "
+                   f"{fair.get('max_queue_total', '?')} total):")
+        for c, n in queued.items():
+            out.append(f"   {c:<24} {n}")
+    else:
+        out.append(" QUEUE empty")
+    streams = st.get("streams") or {}
+    if streams.get("active"):
+        out.append(
+            f" STREAMS: {streams.get('active')} live, "
+            f"lag {streams.get('lag_records', 0)}/"
+            f"{streams.get('max_buffer_total', '?')} records "
+            f"(records in {streams.get('records_in', 0)}, "
+            f"batches {streams.get('batches', 0)})")
+    else:
+        out.append(" STREAMS: none")
+    warm = st.get("warm") or {}
+    journal = st.get("journal") or {}
+    out.append(
+        f" warm hits {warm.get('backend_warm_hits', 0)} / probes "
+        f"{warm.get('backend_probes', 0)} | journal "
+        f"{'BROKEN' if journal.get('broken') else 'ok'}, "
+        f"{journal.get('records', 0)} records, "
+        f"{journal.get('replays', 0)} replay(s)")
+    return "\n".join(out) + "\n"
+
+
+def top_main(argv: list[str], stdout=None, stderr=None) -> int:
+    """The ``pwasm-tpu top`` entry point."""
+    import sys
+    stdout = stdout if stdout is not None else sys.stdout
+    stderr = stderr if stderr is not None else sys.stderr
+    sock = None
+    interval = 2.0
+    once = False
+    for a in argv:
+        if a.startswith("--socket="):
+            sock = a.split("=", 1)[1]
+        elif a.startswith("--interval="):
+            import math
+            try:
+                interval = float(a.split("=", 1)[1])
+                if interval <= 0 or not math.isfinite(interval):
+                    raise ValueError
+            except (TypeError, ValueError):
+                stderr.write(f"{_TOP_USAGE}\nInvalid --interval "
+                             f"value: {a.split('=', 1)[1]}\n")
+                return EXIT_USAGE
+        elif a == "--once":
+            once = True
+        elif a in ("-h", "--help"):
+            stderr.write(_TOP_USAGE)
+            return EXIT_USAGE
+        else:
+            stderr.write(f"{_TOP_USAGE}\nInvalid argument: {a}\n")
+            return EXIT_USAGE
+    if not sock:
+        stderr.write(f"{_TOP_USAGE}\nError: --socket=PATH is "
+                     "required\n")
+        return EXIT_USAGE
+    from pwasm_tpu.service.client import ServiceClient, ServiceError
+    try:
+        while True:
+            try:
+                with ServiceClient(sock, timeout=10.0) as c:
+                    resp = c.stats()
+            except ServiceError as e:
+                stderr.write(f"Error: {e}\n")
+                return EXIT_FATAL
+            if not resp.get("ok"):
+                stderr.write(f"Error: stats failed: {resp}\n")
+                return EXIT_FATAL
+            frame = render(resp["stats"])
+            if not once:
+                stdout.write("\x1b[H\x1b[2J")   # home+clear: repaint
+            stdout.write(frame)
+            try:
+                stdout.flush()
+            except Exception:
+                pass
+            if once:
+                return 0
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        # "Ctrl-C exits" means exits CLEANLY — wherever it lands (the
+        # in-flight stats RPC included), never a traceback
+        return 0

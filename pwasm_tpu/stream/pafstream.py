@@ -272,6 +272,10 @@ class StreamFeed:
         self.on_batch = None         # daemon metric hook: fn(n_lines)
         self._drain = None
         self._last_activity = time.monotonic()
+        self._arrivals: deque = deque()  # (records_in after the feed,
+        #   monotonic t) per committing feed — the lag-AGE source
+        #   (pwasm_stream_lag_age_seconds): how long the oldest
+        #   unconsumed record has been waiting
 
     def bind_drain(self, drain) -> None:
         self._drain = drain
@@ -291,6 +295,19 @@ class StreamFeed:
     def completed(self, data: str) -> int:
         return self._asm.completed(data)
 
+    def lag_age_s(self, now: float | None = None) -> float:
+        """Seconds the OLDEST fed-but-unconsumed record has waited
+        (0.0 when the buffer is drained) — ``buffered`` says how deep
+        the lag is, this says how stale."""
+        now = time.monotonic() if now is None else now
+        with self._cond:
+            consumed = self.records_out
+            while self._arrivals and self._arrivals[0][0] <= consumed:
+                self._arrivals.popleft()
+            if not self._arrivals or self.records_in <= consumed:
+                return 0.0
+            return max(0.0, now - self._arrivals[0][1])
+
     def feed(self, data: str) -> int:
         """Commit one chunk; returns the number of complete lines it
         added.  Quota enforcement happens BEFORE this call (see
@@ -303,6 +320,16 @@ class StreamFeed:
             self._q.extend(lines)
             self.records_in += len(lines)
             self._last_activity = time.monotonic()
+            if lines:
+                # trim already-consumed arrival marks HERE, not only
+                # when the lag-age gauge is polled: a daemon nobody
+                # scrapes must not grow one tuple per frame forever
+                consumed = self.records_out
+                while self._arrivals \
+                        and self._arrivals[0][0] <= consumed:
+                    self._arrivals.popleft()
+                self._arrivals.append((self.records_in,
+                                       self._last_activity))
             self._cond.notify_all()
             return len(lines)
 

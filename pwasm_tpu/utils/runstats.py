@@ -129,6 +129,16 @@ class RunStats:
         self.device_dispatches = 0     # device program launches
         self.device_flushes = 0        # host-blocking result fetches
         self.dispatches_by_site = {}   # site -> launch count
+        # utilization accounting (ISSUE 11): pow2-bucket padding waste
+        # (live rows vs launched slots in each padded device batch)
+        # and the compile-vs-steady split of supervised attempt walls
+        # (a site's FIRST attempt pays the XLA compile; the split says
+        # how much of the device wall was compile, not work)
+        self.device_pad_items = 0      # live rows in padded launches
+        self.device_pad_slots = 0      # total slots (live + pad)
+        self.device_compile_s = 0.0    # first-attempt-per-site wall
+        self.device_steady_s = 0.0     # subsequent attempt wall
+        self._compiled_sites: set = set()
 
     def note_dispatch(self, site: str, n: int = 1) -> None:
         """Count ``n`` device program launches at ``site`` (ctx_scan,
@@ -141,6 +151,21 @@ class RunStats:
         """Count ``n`` host-blocking device round-trips (a fetch the
         host waits on)."""
         self.device_flushes += n
+
+    def note_pad(self, items: int, slots: int) -> None:
+        """Count one pow2-padded device launch: ``items`` live rows in
+        ``slots`` launched slots (the pad-waste-ratio source)."""
+        self.device_pad_items += items
+        self.device_pad_slots += slots
+
+    def note_attempt_wall(self, site: str, wall_s: float) -> None:
+        """Split one supervised attempt's wall into compile-inclusive
+        (the site's first attempt this run) vs steady."""
+        if site in self._compiled_sites:
+            self.device_steady_s += wall_s
+        else:
+            self._compiled_sites.add(site)
+            self.device_compile_s += wall_s
 
     @property
     def wall_s(self) -> float:
@@ -177,6 +202,12 @@ class RunStats:
                 "dispatches": self.device_dispatches,
                 "flushes": self.device_flushes,
                 "by_site": dict(self.dispatches_by_site),
+                # additive (stats_version unchanged): utilization
+                # accounting — pow2 pad waste + compile/steady split
+                "pad_items": self.device_pad_items,
+                "pad_slots": self.device_pad_slots,
+                "compile_s": round(self.device_compile_s, 6),
+                "steady_s": round(self.device_steady_s, 6),
             },
             "host": {
                 "parse_s": round(self.host_parse_s, 6),

@@ -59,6 +59,12 @@ REGISTRY = {
         "(logrotate-style replace of the tailed PAF) to exercise "
         "FollowReader's inode tracking — deliberately not a durable "
         "publish of repo state",
+    "pwasm_tpu/obs/events.py":
+        "exempt: --log-json-max-bytes rotation renames the CURRENT "
+        "event log aside (FILE -> FILE.1) inside the never-raises "
+        "emit path — best-effort observability whose loss costs log "
+        "lines, not correctness; an fsync here would put disk-flush "
+        "stalls on the signal-drain emit path",
 }
 
 # fsync registry: modules allowed a raw os.fsync.  fsio.py is the impl

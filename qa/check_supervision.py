@@ -116,6 +116,14 @@ METRIC_REGISTER_RE = re.compile(
 METRIC_NAME_RE = re.compile(r"^pwasm_[a-z0-9]+(_[a-z0-9]+)*$")
 METRIC_LITERAL_RE = re.compile(r"""["'](pwasm_[A-Za-z0-9_]*)["']""")
 
+# ---- metric doc-drift rule (ISSUE 11 satellite) -----------------------
+# docs/OBSERVABILITY.md is the operator's catalog of record: a metric
+# family registered in obs/catalog.py but absent from the doc is a
+# series an operator cannot know to alert on.  This rule fails any
+# catalog name literal the doc never mentions (substring match — the
+# doc tables and prose both count).
+METRIC_DOC = "docs/OBSERVABILITY.md"
+
 
 def find_hits(root: str = REPO) -> list[tuple[str, int, str]]:
     """Every (relpath, lineno, line) in pwasm_tpu/ matching PATTERNS,
@@ -271,6 +279,45 @@ def find_metric_lint(root: str = REPO) -> list[str]:
     return out
 
 
+def catalog_metric_names(root: str = REPO) -> dict[str, int]:
+    """Every valid-grammar metric name literal in the catalog, with
+    its first line number (the doc-drift rule's registration side)."""
+    catalog_path = os.path.join(root, *METRIC_CATALOG.split("/"))
+    names: dict[str, int] = {}
+    if not os.path.isfile(catalog_path):
+        return names
+    with open(catalog_path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            if line.lstrip().startswith("#"):
+                continue
+            for name in METRIC_LITERAL_RE.findall(line):
+                if METRIC_NAME_RE.match(name):
+                    names.setdefault(name, i)
+    return names
+
+
+def find_doc_drift(root: str = REPO) -> list[str]:
+    """Catalog families missing from docs/OBSERVABILITY.md (module
+    comment: the doc is the operator's catalog of record, so every
+    registered family must appear there)."""
+    doc_path = os.path.join(root, *METRIC_DOC.split("/"))
+    if not os.path.isfile(doc_path):
+        # no doc at all: every catalog name is undocumented
+        doc_text = ""
+    else:
+        with open(doc_path, encoding="utf-8") as f:
+            doc_text = f.read()
+    out = []
+    for name, lineno in sorted(catalog_metric_names(root).items(),
+                               key=lambda kv: kv[1]):
+        if name not in doc_text:
+            out.append(
+                f"{METRIC_CATALOG}:{lineno}: metric {name!r} is "
+                f"registered but undocumented — add it to "
+                f"{METRIC_DOC}")
+    return out
+
+
 def stale_registry_entries(root: str = REPO) -> list[str]:
     """Registry rows whose module no longer has any hit (or vanished) —
     kept accurate so the registry stays a map, not a fossil."""
@@ -285,13 +332,14 @@ def main() -> int:
     obs = find_obs_violations()
     stream = find_stream_violations()
     metric = find_metric_lint()
+    doc_drift = find_doc_drift()
     sharding = find_sharding_violations()
     for line in bad:
         print(line, file=sys.stderr)
     for rel in stale:
         print(f"{rel}: stale registry entry (no device entry points "
               "left — remove it)", file=sys.stderr)
-    for line in svc + obs + stream + metric + sharding:
+    for line in svc + obs + stream + metric + doc_drift + sharding:
         print(line, file=sys.stderr)
     if bad:
         print(f"\n{len(bad)} device entry point(s) outside the "
@@ -310,13 +358,18 @@ def main() -> int:
               "registrations live in pwasm_tpu/obs/catalog.py with "
               "snake_case pwasm_-prefixed unique names.",
               file=sys.stderr)
+    if doc_drift:
+        print(f"\n{len(doc_drift)} doc-drift failure(s): every "
+              f"family registered in {METRIC_CATALOG} must appear in "
+              f"{METRIC_DOC} (the operator's catalog of record).",
+              file=sys.stderr)
     if sharding:
         print(f"\n{len(sharding)} bare sharding/collective API "
               f"use(s): import shard_map/psum/ppermute/pcast from "
               f"{JAXCOMPAT} instead, so a jax pin change costs one "
               "edit there.", file=sys.stderr)
     return 1 if (bad or stale or svc or obs or stream or metric
-                 or sharding) else 0
+                 or doc_drift or sharding) else 0
 
 
 if __name__ == "__main__":

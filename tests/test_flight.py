@@ -1,0 +1,752 @@
+"""Job flight recorder (ISSUE 11): cross-process trace correlation,
+utilization accounting, and live fleet introspection.
+
+Acceptance contracts exercised here:
+
+- **identity plumbing**: a trace_id minted by the client rides every
+  protocol frame, is stamped into the journal (surviving replay onto
+  the recovered job's flight record), the daemon event log, and both
+  sides' Chrome traces;
+- **flight records**: every served job accumulates phase-accounted
+  walls (queue wait, lease wait, exec with the run's per-flush
+  breakdown inside) whose accounted sum covers >= 90% of the job
+  wall; ``inspect`` serves them from RAM and — CRC-verified — from
+  the result spool;
+- **trace-merge**: two wall-anchored trace documents join onto one
+  timeline that still satisfies the monotonic-nesting schema;
+- **bounded observability**: event-log rotation caps the NDJSON log,
+  the trace recorder's cap surfaces drops live, and the whole surface
+  stays byte-neutral (report bytes identical with everything on).
+"""
+
+import io
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+from contextlib import contextmanager
+from types import SimpleNamespace
+
+import pytest
+
+from pwasm_tpu.cli import run
+from pwasm_tpu.obs import EventLog, TraceRecorder, make_observability
+from pwasm_tpu.obs.flight import FlightRecorder
+from pwasm_tpu.obs.merge import merge_traces, trace_merge_main
+from pwasm_tpu.service.client import ServiceClient, wait_for_socket
+from pwasm_tpu.service.daemon import Daemon
+from pwasm_tpu.service.top import render, top_main
+
+from test_obs import _corpus as _obs_corpus
+from test_obs import assert_valid_exposition
+
+
+def _corpus(tmp_path, n=8, qlen=120):
+    return _obs_corpus(tmp_path, n=n, qlen=qlen)
+
+
+@contextmanager
+def _daemon(runner=None, **kw):
+    sockdir = tempfile.mkdtemp(prefix="pwflt")
+    sock = os.path.join(sockdir, "s")
+    err = io.StringIO()
+    dm = Daemon(sock, stderr=err, runner=runner, **kw)
+    rcbox: list = []
+    t = threading.Thread(target=lambda: rcbox.append(dm.serve()),
+                         daemon=True)
+    t.start()
+    assert wait_for_socket(sock, 15), err.getvalue()
+    try:
+        yield SimpleNamespace(daemon=dm, sock=sock, rc=rcbox, err=err,
+                              thread=t, dir=sockdir)
+    finally:
+        if not dm.drain.requested:
+            dm.drain.request("test teardown")
+        t.join(20)
+        shutil.rmtree(sockdir, ignore_errors=True)
+
+
+def _stub_runner(rc=0):
+    def runner(argv, stdout=None, stderr=None, warm=None):
+        sp = next((a.split("=", 1)[1] for a in argv
+                   if a.startswith("--stats=")), None)
+        if sp:
+            with open(sp, "w") as f:
+                json.dump({"stats_version": 1, "wall_s": 0.01}, f)
+        return rc
+    return runner
+
+
+# ---------------------------------------------------------------------------
+# flight recorder unit
+# ---------------------------------------------------------------------------
+def test_flight_recorder_phases_ring_and_coverage():
+    fl = FlightRecorder(trace_id="t1", max_entries=3, max_marks=4)
+    fl.note("queue_wait", 0.2)
+    fl.note("lease_wait", 0.1)
+    fl.note("exec", 0.6, lane=0)
+    fl.note("exec", 0.05)             # phases accumulate; ring caps
+    for i in range(6):
+        fl.mark("retry", attempt=i)   # mark ring bounded at 4
+    s = fl.summary(wall_s=1.0)
+    assert s["version"] == 1 and s["trace_id"] == "t1"
+    assert s["phases"]["exec"] == {"s": 0.65, "n": 2}
+    assert s["accounted_s"] == pytest.approx(0.95)
+    assert s["coverage"] == pytest.approx(0.95)
+    assert len(s["entries"]) == 3 and s["entries_dropped"] == 1
+    assert len(s["events"]) == 4 and s["events_dropped"] == 2
+    # routine span notes can NEVER evict diagnostic marks: the two
+    # rings are separate (the incident-review property)
+    assert all(e["ev"] == "retry" for e in s["events"])
+    assert fl.phase_s("queue_wait") == pytest.approx(0.2)
+    # per-batch-cadence marks route to the SPAN ring: a day of
+    # ckpt_write marks must never evict an hour-1 OOM from events
+    fl.mark("ckpt_write", records=10)
+    s2 = fl.summary()
+    assert all(e["ev"] == "retry" for e in s2["events"])
+    assert any(e.get("ev") == "ckpt_write" for e in s2["entries"])
+    # no wall -> no coverage key, and the summary is JSON-able
+    json.dumps(fl.summary())
+
+
+def test_flight_recorder_never_raises_on_weird_fields():
+    fl = FlightRecorder()
+    fl.mark("ev", skipme=None, keep=1)
+    fl.note("ph", 0.1, extra="x")
+    s = fl.summary()
+    assert "skipme" not in s["events"][0]
+    assert s["events"][0]["keep"] == 1
+    assert s["entries"][0]["extra"] == "x"
+
+
+# ---------------------------------------------------------------------------
+# identity plumbing: frames -> job -> journal -> events -> flight
+# ---------------------------------------------------------------------------
+def test_trace_id_rides_frames_journal_events_and_flight(tmp_path):
+    paf, fa = _corpus(tmp_path)
+    log = tmp_path / "svc.ndjson"
+    jp = str(tmp_path / "j.journal")
+    with _daemon(log_json=str(log), journal_path=jp) as h:
+        with ServiceClient(h.sock, trace_id="trace.abc-1") as c:
+            sub = c.submit([paf, "-r", fa,
+                            "-o", str(tmp_path / "a.dfa"),
+                            "--batch=2"])
+            assert sub.get("ok") and sub["trace_id"] == "trace.abc-1"
+            res = c.result(sub["job_id"], timeout=120)
+            assert res.get("ok") and res.get("rc") == 0, res
+            assert res["job"]["trace_id"] == "trace.abc-1"
+            insp = c.inspect(sub["job_id"])
+            assert insp.get("ok"), insp
+            assert insp["trace_id"] == "trace.abc-1"
+            assert insp["flight"]["trace_id"] == "trace.abc-1"
+            # the journal admit record carries it (read BEFORE the
+            # clean drain retires the journal)
+            recs = [json.loads(ln) for ln in
+                    open(jp).read().splitlines()]
+            admit = next(r for r in recs if r["rec"] == "admit")
+            assert admit["trace_id"] == "trace.abc-1"
+    evs = [json.loads(ln) for ln in log.read_text().splitlines()]
+    for kind in ("job_admit", "job_start", "job_finish"):
+        ev = next(e for e in evs if e["event"] == kind)
+        assert ev["trace_id"] == "trace.abc-1", kind
+
+
+def test_daemon_mints_trace_id_when_frame_has_none(tmp_path):
+    paf, fa = _corpus(tmp_path, n=4)
+    with _daemon() as h:
+        with ServiceClient(h.sock) as c:
+            # a hand-rolled frame without trace_id (an older client)
+            resp = c.request({"cmd": "submit",
+                              "args": [paf, "-r", fa, "-o",
+                                       str(tmp_path / "a.dfa")],
+                              "cwd": str(tmp_path)})
+            assert resp.get("ok"), resp
+            assert resp["trace_id"]      # daemon-minted, non-empty
+            assert c.result(resp["job_id"], timeout=120)["rc"] == 0
+
+
+def test_bad_trace_id_rejected(tmp_path):
+    paf, fa = _corpus(tmp_path, n=2)
+    with _daemon() as h:
+        with ServiceClient(h.sock) as c:
+            resp = c.request({"cmd": "submit",
+                              "args": [paf, "-r", fa, "-o", "o.dfa"],
+                              "cwd": str(tmp_path),
+                              "trace_id": "bad id with spaces"})
+            assert resp.get("error") == "bad_request"
+            assert "trace_id" in resp.get("detail", "")
+
+
+def test_trace_id_survives_journal_replay_onto_flight(tmp_path):
+    """The kill -9 drill for identity: a journal left by a crashed
+    daemon names the job's trace_id; the restarted daemon's recovered
+    job carries it — on the job record, the flight record, and its
+    finish events."""
+    out = str(tmp_path / "a.dfa")
+    jp = str(tmp_path / "crash.journal")
+    with open(jp, "w") as f:
+        f.write(json.dumps(
+            {"v": 1, "rec": "admit", "job_id": "job-0001",
+             "argv": ["a.paf", "-o", out], "client": "uid:7",
+             "priority": "", "trace_id": "crashed.trace.9",
+             "t": 1.0}) + "\n")
+        f.write(json.dumps(
+            {"v": 1, "rec": "start", "job_id": "job-0001",
+             "lane": 0}) + "\n")
+    log = tmp_path / "svc.ndjson"
+    with _daemon(runner=_stub_runner(), journal_path=jp,
+                 log_json=str(log)) as h:
+        with ServiceClient(h.sock) as c:
+            res = c.result("job-0001", timeout=30)
+            assert res.get("rc") == 0, res
+            assert res["job"]["trace_id"] == "crashed.trace.9"
+            assert res["job"]["recovered"] is True
+            insp = c.inspect("job-0001")
+            assert insp["trace_id"] == "crashed.trace.9"
+            fl = insp["flight"]
+            assert fl["trace_id"] == "crashed.trace.9"
+            assert any(e.get("ev") == "journal_recovered"
+                       for e in fl["events"])
+            # the recovered run came back as --resume AND kept its
+            # identity in the re-compacted journal
+            recs = [json.loads(ln) for ln in
+                    open(jp).read().splitlines()]
+            admit = next(r for r in recs if r["rec"] == "admit")
+            assert admit["trace_id"] == "crashed.trace.9"
+            assert "--resume" in admit["argv"]
+    evs = [json.loads(ln) for ln in log.read_text().splitlines()]
+    fin = next(e for e in evs if e["event"] == "job_finish")
+    assert fin["trace_id"] == "crashed.trace.9"
+
+
+def test_stream_verbs_carry_trace_id(tmp_path):
+    paf, fa = _corpus(tmp_path, n=4)
+    records = open(paf).read()
+    log = tmp_path / "svc.ndjson"
+    with _daemon(log_json=str(log)) as h:
+        with ServiceClient(h.sock, trace_id="stream.t1") as c:
+            opened = c.stream_open(["-r", fa,
+                                    "-o", str(tmp_path / "s.dfa")])
+            assert opened.get("ok"), opened
+            assert opened["trace_id"] == "stream.t1"
+            jid = opened["job_id"]
+            # split mid-record on purpose: reassembly is orthogonal
+            cut = len(records) // 2 + 3
+            assert c.stream_data(jid, records[:cut]).get("ok")
+            assert c.stream_data(jid, records[cut:]).get("ok")
+            assert c.stream_end(jid).get("ok")
+            res = c.result(jid, timeout=120)
+            assert res.get("rc") == 0, res
+            insp = c.inspect(jid)
+            assert insp["trace_id"] == "stream.t1"
+            assert insp["flight"]["coverage"] >= 0.9
+    evs = [json.loads(ln) for ln in log.read_text().splitlines()]
+    admit = next(e for e in evs if e["event"] == "job_admit")
+    assert admit["trace_id"] == "stream.t1" and admit["stream"]
+
+
+# ---------------------------------------------------------------------------
+# flight records over the spool
+# ---------------------------------------------------------------------------
+def test_inspect_reads_spooled_flight_with_crc(tmp_path):
+    paf, fa = _corpus(tmp_path)
+    with _daemon(spool_threshold_bytes=1) as h:
+        with ServiceClient(h.sock) as c:
+            sub = c.submit([paf, "-r", fa,
+                            "-o", str(tmp_path / "a.dfa"),
+                            "--batch=2"])
+            assert c.result(sub["job_id"], timeout=120)["rc"] == 0
+            job = h.daemon.jobs[sub["job_id"]]
+            assert job.spool is not None     # result went to disk
+            assert job.flight is None        # RAM keeps the index only
+            insp = c.inspect(sub["job_id"])
+            assert insp.get("ok"), insp
+            fl = insp["flight"]
+            assert fl["trace_id"] == c.trace_id
+            assert fl["coverage"] >= 0.9
+            for phase in ("queue_wait", "lease_wait", "exec"):
+                assert phase in fl["phases"], fl["phases"]
+            # rot the spooled record: inspect must REPORT it, never
+            # serve a half-verified flight record
+            raw = open(job.spool["path"]).read()
+            bad = raw.replace('"state":"done"', '"state":"dome"', 1)
+            assert bad != raw
+            with open(job.spool["path"], "w") as f:
+                f.write(bad)
+            insp2 = c.inspect(sub["job_id"])
+            assert insp2.get("ok")
+            assert "CRC" in insp2["spool_error"] \
+                or "unreadable" in insp2["spool_error"]
+            assert insp2["flight"] is None
+
+
+def test_inspect_unknown_job(tmp_path):
+    with _daemon() as h:
+        with ServiceClient(h.sock) as c:
+            assert c.inspect("job-9999")["error"] == "unknown_job"
+
+
+def test_inspect_live_job_before_terminal(tmp_path):
+    """A RUNNING job answers inspect too — the live half of "why is
+    job X slow RIGHT NOW"."""
+    paf, fa = _corpus(tmp_path, n=4)
+    slow = "--inject-faults=seed=1,rate=1,kinds=hang,hang_s=0.3"
+    with _daemon() as h:
+        with ServiceClient(h.sock) as c:
+            sub = c.submit([paf, "-r", fa, "--device=tpu",
+                            "-o", str(tmp_path / "a.dfa"),
+                            "--batch=2", slow])
+            deadline = time.monotonic() + 60
+            seen_running = None
+            while time.monotonic() < deadline:
+                insp = c.inspect(sub["job_id"])
+                if insp["job"]["state"] == "running":
+                    seen_running = insp
+                    break
+                if insp["job"]["state"] not in ("queued", "running"):
+                    break
+                time.sleep(0.02)
+            assert seen_running is not None
+            fl = seen_running["flight"]
+            assert "queue_wait" in fl["phases"]
+            assert c.result(sub["job_id"], timeout=120)["rc"] == 0
+
+
+# ---------------------------------------------------------------------------
+# trace merge
+# ---------------------------------------------------------------------------
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _assert_monotonic_nesting(events):
+    """The schema property: same-(pid,tid) complete spans nest — for
+    any two spans that overlap, one contains the other."""
+    by_track = {}
+    for e in events:
+        if e.get("ph") == "X":
+            by_track.setdefault((e["pid"], e["tid"]), []).append(
+                (e["ts"], e["ts"] + e["dur"]))
+    for spans in by_track.values():
+        for i, (a0, a1) in enumerate(spans):
+            for b0, b1 in spans[i + 1:]:
+                overlap = max(a0, b0) < min(a1, b1)
+                contained = (a0 <= b0 and b1 <= a1) \
+                    or (b0 <= a0 and a1 <= b1)
+                assert not overlap or contained, (spans,)
+
+
+def test_merge_traces_aligns_on_wall_anchor():
+    ca, cb = _Clock(), _Clock()
+    ra, rb = TraceRecorder(clock=ca), TraceRecorder(clock=cb)
+    ra.anchor_wall_s = 100.0      # client started 2s before daemon
+    rb.anchor_wall_s = 102.0
+    with ra.span("submit_rpc", trace_id="t"):
+        ca.t = 1.0
+    with rb.span("job_exec", trace_id="t"):
+        cb.t = 0.5
+    merged = merge_traces([("client.json", ra.to_dict()),
+                           ("daemon.json", rb.to_dict())])
+    evs = {e["name"]: e for e in merged["traceEvents"]
+           if e.get("ph") == "X"}
+    # client events keep their base; daemon events shift +2s
+    assert evs["submit_rpc"]["ts"] == 0
+    assert evs["job_exec"]["ts"] == 2_000_000
+    assert merged["otherData"]["anchor_wall_s"] == 100.0
+    assert merged["otherData"]["merged"] == 2
+    names = [e for e in merged["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"]
+    assert {n["args"]["name"] for n in names} \
+        == {"client.json", "daemon.json"}
+    _assert_monotonic_nesting(merged["traceEvents"])
+
+
+def test_merge_traces_remaps_colliding_pids():
+    ca, cb = _Clock(), _Clock()
+    ra, rb = TraceRecorder(clock=ca), TraceRecorder(clock=cb)
+    ra.anchor_wall_s = rb.anchor_wall_s = 0.0
+    ra.instant("a")
+    rb.instant("b")       # same process => same pid in both docs
+    merged = merge_traces([("x", ra.to_dict()), ("y", rb.to_dict())])
+    pids = {e["pid"] for e in merged["traceEvents"]
+            if e.get("ph") == "i"}
+    assert len(pids) == 2   # two tracks, despite one real pid
+
+
+def test_trace_merge_main_cli(tmp_path):
+    c = _Clock()
+    rec = TraceRecorder(clock=c)
+    rec.anchor_wall_s = 5.0
+    with rec.span("run"):
+        c.t = 1.0
+    a = tmp_path / "a.json"
+    a.write_text(json.dumps(rec.to_dict()))
+    out, err = io.StringIO(), io.StringIO()
+    dst = tmp_path / "merged.json"
+    assert run(["trace-merge", str(a), str(a), "-o", str(dst)],
+               stdout=out, stderr=err) == 0
+    doc = json.loads(dst.read_text())
+    assert len([e for e in doc["traceEvents"]
+                if e.get("ph") == "X"]) == 2
+    _assert_monotonic_nesting(doc["traceEvents"])
+    # usage errors
+    assert run(["trace-merge"], stdout=out, stderr=err) == 1
+    assert run(["trace-merge", str(tmp_path / "nope.json")],
+               stdout=out, stderr=err) == 1
+
+
+# ---------------------------------------------------------------------------
+# the one-command incident reconstruction (acceptance)
+# ---------------------------------------------------------------------------
+def test_incident_reconstruction_end_to_end(tmp_path):
+    """A 200-aln job submitted with tracing on: ONE trace_id greppable
+    across client trace, daemon events, and journal; inspect's
+    accounted phases cover >= 90% of the job wall; trace-merge emits
+    one valid Chrome trace holding both processes' spans."""
+    paf, fa = _corpus(tmp_path, n=200)
+    log = tmp_path / "svc.ndjson"
+    jp = str(tmp_path / "j.journal")
+    dtrace = tmp_path / "daemon.trace.json"
+    ctrace = tmp_path / "client.trace.json"
+    trace_ids = {}
+    with _daemon(log_json=str(log), journal_path=jp,
+                 trace_json=str(dtrace)) as h:
+        out, err = io.StringIO(), io.StringIO()
+        rc = run(["submit", f"--socket={h.sock}",
+                  f"--trace-json={ctrace}", "--trace-id=incident.7",
+                  "--", paf, "-r", fa,
+                  "-o", str(tmp_path / "a.dfa"), "--batch=64"],
+                 stdout=out, stderr=err)
+        assert rc == 0, err.getvalue()
+        verdict = json.loads(out.getvalue())
+        assert verdict["trace_id"] == "incident.7"
+        with ServiceClient(h.sock) as c:
+            insp = c.inspect(verdict["job_id"])
+        assert insp["trace_id"] == "incident.7"
+        assert insp["flight"]["coverage"] >= 0.9, insp["flight"]
+        journal_text = open(jp).read()
+    # one id, greppable on every surface
+    assert "incident.7" in ctrace.read_text()
+    assert "incident.7" in log.read_text()
+    assert "incident.7" in journal_text
+    # merge the two processes' traces: one valid doc, both sides in
+    out, err = io.StringIO(), io.StringIO()
+    merged_path = tmp_path / "one.json"
+    assert run(["trace-merge", str(ctrace), str(dtrace),
+                "-o", str(merged_path)],
+               stdout=out, stderr=err) == 0
+    doc = json.loads(merged_path.read_text())
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"submit_rpc", "result_wait"} <= names     # client side
+    assert {"job_exec", "job_queue_wait"} <= names    # daemon side
+    assert len({e["pid"] for e in doc["traceEvents"]}) >= 2
+    _assert_monotonic_nesting(doc["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# event-log rotation (--log-json-max-bytes)
+# ---------------------------------------------------------------------------
+def test_event_log_rotation_caps_and_seams(tmp_path):
+    path = str(tmp_path / "ev.ndjson")
+    log = EventLog(path=path, max_bytes=400)
+    for i in range(50):
+        log.emit("tick", i=i)
+    log.close()
+    assert log.rotations >= 1
+    assert os.path.exists(path + ".1")
+    # bounded on disk: current + exactly one previous generation,
+    # each about the cap (one overshoot line at most)
+    assert os.path.getsize(path) <= 400 + 200
+    assert os.path.getsize(path + ".1") <= 400 + 200
+    # the fresh file opens with the rotation seam event
+    first = json.loads(open(path).readline())
+    assert first["event"] == "log_rotate"
+    assert first["previous"] == path + ".1"
+    # nothing was lost across the seam: the tick sequence is
+    # contiguous over (previous, current)
+    ticks = []
+    for p in (path + ".1", path):
+        for ln in open(p).read().splitlines():
+            rec = json.loads(ln)
+            if rec["event"] == "tick":
+                ticks.append(rec["i"])
+    assert ticks == sorted(ticks) and ticks[-1] == 49
+
+
+def test_event_log_rotation_never_raises(tmp_path, monkeypatch):
+    path = str(tmp_path / "ev.ndjson")
+    log = EventLog(path=path, max_bytes=100)
+    import os as _os
+    real_replace = _os.replace
+
+    def boom(*a, **k):
+        raise OSError("no rename for you")
+    monkeypatch.setattr("os.replace", boom)
+    for i in range(20):
+        log.emit("tick", i=i)     # rotation fails; appending goes on
+    monkeypatch.setattr("os.replace", real_replace)
+    log.close()
+    recs = [json.loads(ln) for ln in open(path).read().splitlines()]
+    assert [r["i"] for r in recs if r["event"] == "tick"] \
+        == list(range(20))
+
+
+def test_cli_log_json_max_bytes_rotates(tmp_path):
+    paf, fa = _corpus(tmp_path, n=8)
+    log = tmp_path / "run.ndjson"
+    err = io.StringIO()
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / "a.dfa"),
+              "--batch=2", f"--log-json={log}",
+              "--log-json-max-bytes=256"], stderr=err)
+    assert rc == 0, err.getvalue()
+    assert (tmp_path / "run.ndjson.1").exists()
+    # bad values are usage errors
+    for bad in ("0", "x", "-5"):
+        err = io.StringIO()
+        assert run([paf, "-r", fa, "-o", str(tmp_path / "b.dfa"),
+                    f"--log-json={log}",
+                    f"--log-json-max-bytes={bad}"],
+                   stderr=err) == 1
+        assert "Invalid --log-json-max-bytes" in err.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# trace cap + live dropped counter (--trace-max-events)
+# ---------------------------------------------------------------------------
+def test_trace_max_events_surfaces_drops_live(tmp_path):
+    paf, fa = _corpus(tmp_path, n=12)
+    trace = tmp_path / "t.json"
+    prom = tmp_path / "m.prom"
+    err = io.StringIO()
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / "a.dfa"),
+              "--batch=2", f"--trace-json={trace}",
+              "--trace-max-events=4",
+              f"--metrics-textfile={prom}"], stderr=err)
+    assert rc == 0, err.getvalue()
+    doc = json.loads(trace.read_text())
+    assert len(doc["traceEvents"]) == 4
+    dropped = doc["otherData"]["dropped_events"]
+    assert dropped > 0
+    text = prom.read_text()
+    assert_valid_exposition(text)
+    assert f"pwasm_trace_events_dropped_total {dropped}" \
+        in text.splitlines()
+    # bad values are usage errors
+    err = io.StringIO()
+    assert run([paf, "-r", fa, "-o", str(tmp_path / "b.dfa"),
+                f"--trace-json={trace}", "--trace-max-events=no"],
+               stderr=err) == 1
+
+
+def test_trace_recorder_on_drop_hook_never_raises():
+    rec = TraceRecorder(max_events=1)
+
+    def boom():
+        raise RuntimeError("hook bug")
+    rec.on_drop = boom
+    rec.instant("a")
+    rec.instant("b")       # dropped; hook raises; drop still counted
+    assert rec.dropped == 1
+
+
+# ---------------------------------------------------------------------------
+# utilization accounting
+# ---------------------------------------------------------------------------
+def test_pad_and_compile_accounting(tmp_path, monkeypatch):
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path, n=12)
+    stats = tmp_path / "s.json"
+    prom = tmp_path / "m.prom"
+    err = io.StringIO()
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / "a.dfa"),
+              "--device=tpu", "--batch=4", f"--stats={stats}",
+              f"--metrics-textfile={prom}"], stderr=err)
+    assert rc == 0, err.getvalue()
+    dev = json.loads(stats.read_text())["device"]
+    # pow2 bucketing: 12 alignments' events launched in >= 1 padded
+    # batch of 256-slot buckets
+    assert dev["pad_items"] > 0
+    assert dev["pad_slots"] >= max(dev["pad_items"], 256)
+    # the first attempt at each site is the compile-inclusive one
+    assert dev["compile_s"] > 0
+    assert dev["steady_s"] >= 0
+    text = prom.read_text()
+    assert_valid_exposition(text)
+    sample = {ln.rsplit(" ", 1)[0]: float(ln.rsplit(" ", 1)[1])
+              for ln in text.splitlines() if not ln.startswith("#")}
+    waste = sample["pwasm_device_pad_waste_ratio"]
+    assert waste == pytest.approx(
+        1.0 - dev["pad_items"] / dev["pad_slots"], abs=1e-4)
+    assert 0 < waste < 1
+    assert sample["pwasm_device_compile_fraction"] > 0
+
+
+def test_lane_busy_fraction_gauge(tmp_path):
+    paf, fa = _corpus(tmp_path, n=4)
+    with _daemon() as h:
+        with ServiceClient(h.sock) as c:
+            sub = c.submit([paf, "-r", fa,
+                            "-o", str(tmp_path / "a.dfa")])
+            assert c.result(sub["job_id"], timeout=120)["rc"] == 0
+            text = c.metrics()["metrics"]
+            st = c.stats()["stats"]
+    lines = text.splitlines()
+    row = next(ln for ln in lines if ln.startswith(
+        'pwasm_service_lane_busy_fraction{lane="0"}'))
+    frac = float(row.rsplit(" ", 1)[1])
+    assert 0 < frac <= 1
+    # svc-stats lanes rows carry the busy wall the gauge derives from
+    assert st["lanes"][0]["busy_s"] > 0
+
+
+def test_stream_feed_lag_age():
+    from pwasm_tpu.stream.pafstream import StreamFeed
+    feed = StreamFeed()
+    feed.feed("a\tb\n")
+    now = time.monotonic()
+    assert feed.lag_age_s(now=now + 5.0) >= 5.0
+    feed.end()
+    for _ in feed:
+        pass                        # drain everything
+    assert feed.lag_age_s() == 0.0
+
+
+def test_host_stages_fold_per_flush_without_double_count(tmp_path):
+    """Satellite (c): pwasm_host_stage_seconds_total is fed per FLUSH
+    (live attribution for the drifting host canary) and the end-of-run
+    residual fold keeps the counter total EXACTLY equal to the --stats
+    host block — folding per-flush must not double-count."""
+    paf, fa = _corpus(tmp_path, n=12)
+    stats = tmp_path / "s.json"
+    prom = tmp_path / "m.prom"
+    err = io.StringIO()
+    rc = run([paf, "-r", fa, "-o", str(tmp_path / "a.dfa"),
+              "--batch=2", f"--stats={stats}",
+              f"--metrics-textfile={prom}"], stderr=err)
+    assert rc == 0, err.getvalue()
+    host = json.loads(stats.read_text())["host"]
+    sample = {}
+    for ln in prom.read_text().splitlines():
+        if ln.startswith("pwasm_host_stage_seconds_total"):
+            k, v = ln.rsplit(" ", 1)
+            sample[k] = float(v)
+    for stage in ("parse", "extract", "analyze", "format"):
+        key = ('pwasm_host_stage_seconds_total{stage="%s"}' % stage)
+        assert sample.get(key, 0.0) == pytest.approx(
+            host[stage + "_s"], abs=2e-5), (stage, sample)
+    # the per-flush proof lands on the flight side of the same hook:
+    # a served job's flight record carries one host_* note per flush
+    with _daemon() as h:
+        with ServiceClient(h.sock) as c:
+            sub = c.submit([paf, "-r", fa,
+                            "-o", str(tmp_path / "b.dfa"),
+                            "--batch=2"])
+            assert c.result(sub["job_id"], timeout=120)["rc"] == 0
+            fl = c.inspect(sub["job_id"])["flight"]
+    assert fl["phases"]["host_analyze"]["n"] >= 2   # per-flush, not
+    #                                                 one end-of-run sum
+
+
+# ---------------------------------------------------------------------------
+# pwasm-tpu top
+# ---------------------------------------------------------------------------
+def test_top_render_pure():
+    st = {"uptime_s": 12.5, "draining": False, "breaker_state": 2,
+          "running": 1, "queue_depth": 3,
+          "jobs": {"completed": 5, "failed": 1, "preempted": 0,
+                   "cancelled": 0, "rejected": 2, "recovered": 1},
+          "lanes": [{"lane": 0, "devices": [0, 1], "busy": True,
+                     "jobs_run": 5, "busy_s": 6.0,
+                     "breaker_state": 0},
+                    {"lane": 1, "devices": [1, 2], "busy": False,
+                     "jobs_run": 1, "busy_s": 1.0,
+                     "breaker_state": 2}],
+          "fair_share": {"max_queue_per_client": 16,
+                         "max_queue_total": 128,
+                         "clients": {"uid:7": 3, "uid:9": 0}},
+          "streams": {"active": 2, "lag_records": 40,
+                      "max_buffer_total": 2048, "records_in": 900,
+                      "batches": 12},
+          "warm": {"backend_warm_hits": 4, "backend_probes": 1},
+          "journal": {"broken": False, "records": 9, "replays": 1}}
+    frame = render(st)
+    assert "breaker OPEN" in frame
+    assert "1 running, 3 queued" in frame
+    assert "uid:7" in frame and "uid:9" not in frame  # 0-depth hidden
+    assert "STREAMS: 2 live" in frame
+    assert "LANE" in frame and "48%" in frame         # 6.0 / 12.5
+    assert "replay(s)" in frame
+    # pure and total on an empty dict too
+    assert "QUEUE empty" in render({})
+
+
+def test_top_once_against_live_daemon(tmp_path):
+    paf, fa = _corpus(tmp_path, n=4)
+    with _daemon() as h:
+        with ServiceClient(h.sock) as c:
+            sub = c.submit([paf, "-r", fa,
+                            "-o", str(tmp_path / "a.dfa")])
+            assert c.result(sub["job_id"], timeout=120)["rc"] == 0
+        out, err = io.StringIO(), io.StringIO()
+        rc = run(["top", f"--socket={h.sock}", "--once"],
+                 stdout=out, stderr=err)
+        assert rc == 0, err.getvalue()
+        frame = out.getvalue()
+        assert "pwasm-tpu top" in frame
+        assert "\x1b[" not in frame     # --once never clears
+    # usage errors
+    out, err = io.StringIO(), io.StringIO()
+    assert run(["top"], stdout=out, stderr=err) == 1
+    assert run(["top", "--socket=s", "--interval=nope"],
+               stdout=out, stderr=err) == 1
+
+
+# ---------------------------------------------------------------------------
+# byte parity: the whole new surface on vs off
+# ---------------------------------------------------------------------------
+def test_byte_parity_with_flight_tracing_and_gauges(tmp_path,
+                                                    monkeypatch):
+    """Report/-s bytes identical with the flight recorder, trace
+    propagation, utilization gauges, rotation and trace-cap knobs all
+    ON vs all off — cold run and served job alike."""
+    monkeypatch.setenv("PWASM_DEVICE_PROBE", "0")
+    paf, fa = _corpus(tmp_path, n=12)
+
+    def outs(tag):
+        return [str(tmp_path / f"{tag}.dfa"),
+                str(tmp_path / f"{tag}.sum")]
+
+    def body(tag):
+        return b"".join(open(p, "rb").read() for p in outs(tag))
+
+    o = outs("ref")
+    err = io.StringIO()
+    assert run([paf, "-r", fa, "-o", o[0], "-s", o[1],
+                "--device=tpu", "--batch=4"], stderr=err) == 0, \
+        err.getvalue()
+    o = outs("obs")
+    err = io.StringIO()
+    assert run([paf, "-r", fa, "-o", o[0], "-s", o[1],
+                "--device=tpu", "--batch=4",
+                f"--trace-json={tmp_path / 'o.trace'}",
+                "--trace-max-events=100000",
+                f"--log-json={tmp_path / 'o.ndjson'}",
+                "--log-json-max-bytes=100000",
+                f"--stats={tmp_path / 'o.json'}",
+                f"--metrics-textfile={tmp_path / 'o.prom'}"],
+               stderr=err) == 0, err.getvalue()
+    assert body("obs") == body("ref")
+    # served (flight recorder + trace_id always on) vs cold
+    with _daemon(spool_threshold_bytes=1) as h:
+        o = outs("svc")
+        with ServiceClient(h.sock) as c:
+            sub = c.submit([paf, "-r", fa, "-o", o[0], "-s", o[1],
+                            "--device=tpu", "--batch=4"])
+            assert c.result(sub["job_id"], timeout=120)["rc"] == 0
+    assert body("svc") == body("ref")

@@ -128,6 +128,33 @@ def test_metric_lint_detects_violations(checker, tmp_path):
     assert any("duplicate metric name" in b for b in bad)
 
 
+def test_metric_doc_drift_clean_on_this_tree(checker):
+    """ISSUE 11 satellite: every family registered in obs/catalog.py
+    appears in docs/OBSERVABILITY.md — the doc is the operator's
+    catalog of record, so an undocumented series is lint-fatal."""
+    bad = checker.find_doc_drift()
+    assert bad == [], "\n".join(bad)
+
+
+def test_metric_doc_drift_detects_undocumented(checker, tmp_path):
+    (tmp_path / "pwasm_tpu" / "obs").mkdir(parents=True)
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "pwasm_tpu" / "obs" / "catalog.py").write_text(
+        'a = reg.gauge("pwasm_documented_depth", "h")\n'
+        'b = reg.counter(\n'
+        '    "pwasm_missing_total", "multi-line style")\n'
+        '# "pwasm_commented_total" is NOT a registration\n')
+    (tmp_path / "docs" / "OBSERVABILITY.md").write_text(
+        "| `pwasm_documented_depth` | fine |\n")
+    bad = checker.find_doc_drift(str(tmp_path))
+    assert len(bad) == 1, bad
+    assert "pwasm_missing_total" in bad[0]
+    assert "OBSERVABILITY.md" in bad[0]
+    # a doc-less tree flags every name
+    (tmp_path / "docs" / "OBSERVABILITY.md").unlink()
+    assert len(checker.find_doc_drift(str(tmp_path))) == 2
+
+
 def test_checker_detects_patterns(checker, tmp_path):
     # the check must actually SEE a violation, or a pattern regression
     # (e.g. jax API rename) would silently pass forever
