@@ -1464,6 +1464,154 @@ def cfg8_realistic_scale() -> int:
         _emit("realistic_serve_fairshare_p50_light_ms", light_p50,
               "ms", 1.0 if fair_flag else 0.0, cpu_metric=True)
 
+        # --- fleet federation (ISSUE 13 tentpole): THREE serve
+        # daemons behind one `route` router.  One fleet serves three
+        # legs in order: (1) an UNCRASHED arm (byte parity of routed
+        # jobs vs the direct run), (2) fleet-wide fairness — a light
+        # client's p50 queue wait under a heavy 8-job co-submitter
+        # routed across all three members (ms, lower-is-better), and
+        # (3) THE kill-one-of-three drill: SIGKILL the member running
+        # a mid-job job (after its first durable ckpt) → the router
+        # reads its journal, resumes the job on a sibling as a
+        # --resume continuation, and every report lands byte-identical
+        # to the uncrashed arm with the client's trace_id intact
+        # (gated bool leg).
+        fsocks = [os.path.join(d, f"flt{k}.sock") for k in range(3)]
+        fprocs = [subprocess.Popen(
+            cmd + ["serve", f"--socket={s}", "--max-queue=16"],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE) for s in fsocks]
+        frouter = None
+        rsock = os.path.join(d, "fleet.sock")
+        flt_fair: list[float] = []
+        flt_heavy_walls: list[float] = []
+        flt_ok = False
+        try:
+            for s in fsocks:
+                if not wait_for_socket(s, 120):
+                    return _fail("realistic_fleet_up")
+            frouter = subprocess.Popen(
+                cmd + ["route", "--backends=" + ",".join(fsocks),
+                       f"--socket={rsock}", "--poll-interval=0.2"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE)
+            if not wait_for_socket(rsock, 120):
+                return _fail("realistic_fleet_router_up")
+            slow = ("--inject-faults=seed=1,rate=1,kinds=hang,"
+                    "hang_s=0.25")
+            with ServiceClient(rsock,
+                               trace_id="bench-fleet") as c:
+                # (1) uncrashed arm through the router
+                for tag in ("fa0", "fb0"):
+                    s0 = c.submit(args(tag, ["--batch=16"]))
+                    if not s0.get("ok"):
+                        return _fail("realistic_fleet_submit")
+                    r0 = c.result(s0["job_id"], timeout=600)
+                    if r0.get("rc") != 0:
+                        return _fail("realistic_fleet_job")
+                if readset("fa0") != parity_body \
+                        or readset("fb0") != parity_body:
+                    return _fail("realistic_fleet_parity")
+                # (2) fleet-wide fair share across 3 members: a deep
+                # heavy backlog saturating EVERY member's worker, then
+                # the light client's jobs submitted while it stands —
+                # DRR on each member must rotate light in after at
+                # most ~one running job, so the light p50 is ~one
+                # heavy wall, not the backlog's drain time (the
+                # whole-fleet twin of the single-daemon leg above)
+                heavy = []
+                for k in range(12):
+                    s0 = c.submit(args(f"ffh{k}", []),
+                                  client="fleet-heavy")
+                    if not s0.get("ok"):
+                        return _fail("realistic_fleet_fair_submit")
+                    heavy.append(s0["job_id"])
+                light = []
+                for k in range(3):
+                    s0 = c.submit(args(f"ffl{k}", []),
+                                  client="fleet-light")
+                    if not s0.get("ok"):
+                        return _fail("realistic_fleet_fair_light")
+                    light.append(s0["job_id"])
+                for jid in light:
+                    r0 = c.result(jid, timeout=600)
+                    if r0.get("rc") != 0:
+                        return _fail("realistic_fleet_fair_job")
+                    job = r0["job"]
+                    flt_fair.append(
+                        (job["started_s"] - job["submitted_s"]) * 1e3)
+                for jid in heavy:
+                    r0 = c.result(jid, timeout=600)
+                    if r0.get("rc") != 0:
+                        return _fail("realistic_fleet_fair_heavy")
+                    job = r0["job"]
+                    flt_heavy_walls.append(job["finished_s"]
+                                           - job["started_s"])
+                # (3) the kill drill: slow job mid-run + a queued one
+                ja = c.submit(args("fa1", ["--batch=16", slow]))
+                jb = c.submit(args("fb1", []))
+                if not (ja.get("ok") and jb.get("ok")):
+                    return _fail("realistic_fleet_crash_submit")
+                ck = os.path.join(d, "fa1.dfa.ckpt")
+                deadline = time.monotonic() + 120
+                mid = False
+                while time.monotonic() < deadline:
+                    st = c.status(ja["job_id"])["job"]["state"]
+                    if st == "running" and os.path.exists(ck):
+                        mid = True
+                        break
+                    if st not in ("queued", "running"):
+                        break
+                    time.sleep(0.02)
+                if not mid:
+                    return _fail("realistic_fleet_crash_window")
+                victim = ja["member"]
+                vi = [i for i, s in enumerate(fsocks)
+                      if os.path.basename(s) == victim][0]
+                fprocs[vi].kill()       # SIGKILL: no drain
+                fprocs[vi].wait(timeout=60)
+                ra = c.result(ja["job_id"], timeout=600)
+                rb = c.result(jb["job_id"], timeout=600)
+                flt_st = c.stats()["stats"]
+                c.drain()
+            frc = frouter.wait(timeout=120)
+            # the dead member's journal was consumed and set aside
+            # (a restart of it must not double-run recovered work)
+            flt_ok = (
+                ra.get("rc") == 0 and rb.get("rc") == 0
+                and ra["job"]["trace_id"] == "bench-fleet"
+                and ra["job"].get("member") not in (None, victim)
+                and ra["job"].get("failovers") == 1
+                and flt_st["fleet"]["failovers"] == 1
+                and flt_st["fleet"]["jobs_recovered"]["resumed"] == 1
+                and read_nosum("fa1") == read_nosum("fa0")
+                and readset("fb1") == readset("fb0")
+                and os.path.exists(fsocks[vi]
+                                   + ".journal.recovered")
+                and frc == 0)
+            for i, s in enumerate(fsocks):
+                if i == vi:
+                    continue
+                with ServiceClient(s) as c:
+                    c.drain()
+                if fprocs[i].wait(timeout=120) != 75:
+                    return _fail("realistic_fleet_member_drain")
+        except Exception as e:
+            sys.stderr.write(f"fleet leg: {e}\n")
+            return _fail("realistic_fleet_failover")
+        finally:
+            for p in fprocs + ([frouter] if frouter else []):
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait()
+        _emit("realistic_fleet_failover_parity",
+              1 if flt_ok else 0, "bool",
+              1.0 if flt_ok else 0.0, cpu_metric=True)
+        flt_p50 = sorted(flt_fair)[len(flt_fair) // 2]
+        flt_fair_flag = flt_p50 <= 2.5 * max(flt_heavy_walls) * 1e3
+        _emit("realistic_fleet_fairshare_p50_light_ms", flt_p50,
+              "ms", 1.0 if flt_fair_flag else 0.0, cpu_metric=True)
+
         # --- streaming ingestion (ISSUE 10 tentpole): the SAME
         # corpus record-at-a-time over the service socket.  Gates
         # byte parity against the one-shot outputs and measures the

@@ -119,6 +119,11 @@ USAGE = """Usage:
                the stream ends after IDLE_S seconds without growth
                and the run completes normally; bare --follow tails
                until SIGTERM (which drains to exit 75, resumable)
+   --compile-cache-dir=DIR  persistent XLA compilation-cache location
+               for the device path (via the jaxcompat shim; default
+               PWASM_JAX_CACHE_DIR or ~/.cache/pwasm_tpu/jax) — a
+               fleet member restarted on the same DIR skips its
+               compile wall (docs/FLEET.md)
    --many2many    multi-CDS scoring job (docs/STREAMING.md): score
                EVERY query in the -r FASTA against every target in
                the positional FASTA through ONE device session
@@ -141,6 +146,11 @@ USAGE = """Usage:
                view: lanes, per-client queues, streams, breakers)
    pwasm-tpu trace-merge CLIENT.json DAEMON.json [-o OUT.json]
                (one wall-anchored cross-process Perfetto timeline)
+   pwasm-tpu route --backends=a.sock,hostB:9211 --socket=PATH
+               (fleet router, docs/FLEET.md: N daemons — unix and/or
+               TCP `serve --listen` members — behind one submit
+               surface, least-loaded placement, fleet-wide fair
+               share, journal-aware failover)
 """
 
 # reference optstring: "DGFCNvd:p:r:o:m:w:c:s:" — -d/-p/-m take a value but
@@ -154,7 +164,7 @@ _VALUE_FLAGS = set("dprmowcs")
 # grammar stays untouched for plain runs.  `trace-merge` is the
 # offline cross-process trace join (no socket, pwasm_tpu/obs/merge.py)
 _SERVICE_CMDS = ("serve", "submit", "svc-stats", "metrics", "stream",
-                 "inspect", "top", "trace-merge")
+                 "inspect", "top", "trace-merge", "route")
 
 
 class CliError(PwasmError):
@@ -392,6 +402,38 @@ def _unlink_checkpoint(report_path: str) -> None:
         pass
 
 
+def warmup_files(dirpath: str) -> tuple[str, str]:
+    """Write the deterministic warmup corpus (``serve --warmup``):
+    a tiny query FASTA + PAF whose alignments exercise the ctx-scan
+    device program on the smallest pow2 event/ref buckets — enough to
+    pay the jax import, backend init and first compiles (and populate
+    ``--compile-cache-dir``) before a daemon's first real job.  Pure
+    host-side text generation: no jax, no randomness."""
+    import os
+
+    os.makedirs(dirpath, exist_ok=True)
+    q = "ACGT" * 30                       # 120-base query
+    fa = os.path.join(dirpath, "warm.fa")
+    with open(fa, "w") as f:
+        f.write(f">warmq\n{q}\n")
+    lines = []
+    for i in range(16):
+        p = 10 + 6 * i                    # substitution position
+        qb = q[p]
+        tb = "ACGT"[("ACGT".index(qb) + 1) % 4]
+        cs = f":{p}*{tb.lower()}{qb.lower()}:{len(q) - p - 1}"
+        tseq_len = len(q)
+        lines.append("\t".join([
+            "warmq", str(len(q)), "0", str(len(q)), "+",
+            f"warmt{i}", str(tseq_len), "0", str(tseq_len),
+            str(len(q)), str(len(q)), "60", "NM:i:1", "AS:i:0",
+            f"cg:Z:{len(q)}M", f"cs:Z:{cs}"]))
+    paf = os.path.join(dirpath, "warm.paf")
+    with open(paf, "w") as f:
+        f.write("".join(ln + "\n" for ln in lines))
+    return paf, fa
+
+
 def run(argv: list[str], stdout=None, stderr=None, warm=None,
         input_stream=None) -> int:
     """One CLI invocation.  ``warm`` is the warm-pool service hook
@@ -412,6 +454,9 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None,
             if argv[0] == "serve":
                 from pwasm_tpu.service.daemon import serve_main
                 return serve_main(argv[1:], stdout, stderr)
+            if argv[0] == "route":
+                from pwasm_tpu.fleet.router import route_main
+                return route_main(argv[1:], stdout, stderr)
             if argv[0] == "trace-merge":
                 from pwasm_tpu.obs.merge import trace_merge_main
                 return trace_merge_main(argv[1:], stdout, stderr)
@@ -612,10 +657,11 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None,
                 raise CliError(f"{USAGE}\nInvalid --inject-faults: "
                                f"{e}\n")
         for kind in ("profile", "stats", "trace-json", "log-json",
-                     "metrics-textfile"):
+                     "metrics-textfile", "compile-cache-dir"):
             if opts.get(kind) is True:
                 raise CliError(
                     f"{USAGE}\n--{kind} requires a file argument\n")
+        cfg.compile_cache_dir = str(opts.get("compile-cache-dir", ""))
         if "profile" in opts:
             cfg.profile_dir = str(opts["profile"])
         if "stats" in opts:
@@ -1141,9 +1187,15 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
         else:
             # repeated pafreport invocations are the reference's
             # workflow: persist compiled programs across runs so only
-            # the first invocation pays the device compiles
+            # the first invocation pays the device compiles.  An
+            # explicit --compile-cache-dir (or the serve daemon's
+            # warm-context dir — the fleet-member restart lever,
+            # ROADMAP item 2b) overrides the env/default location.
             from pwasm_tpu.ops import enable_compilation_cache
-            enable_compilation_cache()
+            enable_compilation_cache(
+                cfg.compile_cache_dir
+                or (getattr(warm, "compile_cache_dir", None)
+                    if warm is not None else None))
     pending: list[tuple] = []
     cons_outs = cons_outs or {}
     build_msa_out = fmsa is not None or bool(cons_outs)

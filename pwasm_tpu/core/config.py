@@ -53,6 +53,10 @@ class Config:
     #                                 event cap (0 = the 200k default)
     log_json_max_bytes: int = 0     # --log-json-max-bytes: size-capped
     #                                 event-log rotation (0 = unbounded)
+    compile_cache_dir: str = ""     # --compile-cache-dir: persistent
+    #                                 XLA compilation cache location
+    #                                 (via the jaxcompat shim; "" =
+    #                                 the PWASM_JAX_CACHE_DIR/default)
 
     # resilience knobs (pwasm_tpu.resilience; no ref equivalent —
     # the reference fails fast, SURVEY.md §2.5.12)

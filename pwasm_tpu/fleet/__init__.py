@@ -1,0 +1,23 @@
+"""Fleet federation: many serve daemons, one submit surface.
+
+``pwasm_tpu/fleet/`` turns N independent serve daemons (PR 5-11) into
+one crash-tolerant fleet behind a single endpoint:
+
+- ``transport``  — the TCP transport joining the unix socket: target
+  parsing/connecting shared by :class:`~pwasm_tpu.service.client.
+  ServiceClient`, ``serve --listen`` and the router;
+- ``ledger``     — the global fair-share ledger: per-client fleet-wide
+  admission quotas and placement accounting extending each daemon's
+  DRR client identities across processes;
+- ``router``     — the ``pwasm-tpu route`` daemon: full-protocol
+  fan-out over N member daemons with least-queue-depth placement and
+  journal-aware failover (a member killed mid-job has its journal read
+  and its started-unfinished jobs re-admitted to a sibling as
+  ``--resume`` continuations — the PR 9 kill -9 drill, across
+  processes).
+
+Like ``service/``, ``obs/`` and ``stream/``, every module here is
+jax-free (gated by ``qa/check_supervision.py``
+``find_fleet_violations``): the fleet layer moves frames and files,
+never tensors.
+"""

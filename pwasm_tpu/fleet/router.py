@@ -1,0 +1,1261 @@
+"""The fleet router daemon (``pwasm-tpu route``).
+
+One router process in front of N serve daemons (same host over unix
+sockets, across hosts over TCP) exposes the FULL serve protocol —
+submit/stream/result/cancel/status/inspect/stats/metrics/drain/ping —
+on one endpoint, so "millions of users" stop dying at one socket on
+one host:
+
+- **placement**: every submit lands on the member with the least
+  (queue depth + running + router-placed-but-not-yet-visible) load,
+  refreshed from each member's registry-backed svc-stats by the health
+  loop; a member answering ``queue_full`` is skipped for the next-best
+  sibling before the client ever sees a 429;
+- **global fair share**: client identities (explicit ``client=``,
+  ``tok:`` tokens on TCP, peer uid on unix) get ONE fleet-wide
+  admission quota in the :class:`~pwasm_tpu.fleet.ledger.FleetLedger`
+  and ride every forwarded frame, so each member's DRR keeps being
+  fair per member while no client can dodge its quota by spraying
+  members;
+- **journal-aware failover**: a member that dies mid-job (SIGKILL,
+  OOM-kill, host loss) is detected by the health loop; the router
+  reads the member's job journal (shared ``--journal-dir`` or the
+  same-host ``<socket>.journal`` default — docs/FLEET.md placement
+  policy) and re-admits every started-unfinished job to a sibling as
+  a ``--resume`` continuation of its own report checkpoint — the PR 9
+  kill -9 drill, across processes.  Jobs the journal shows FINISHED
+  are served from their CRC-verified spool files; acked cancels stay
+  cancelled; live streams land terminal preempted-RESUMABLE exactly
+  as a restarting member would land them.  The consumed journal is
+  then set aside (``<journal>.recovered``) so a later restart of that
+  member cannot re-run work a sibling already owns.
+
+The router holds no device, no queue of its own (members queue), and
+no jax (``qa/check_supervision.py::find_fleet_violations``): it moves
+frames, reads journals, and keeps the ledger.  Job identity: the
+router mints fleet-wide ids (``fleet-NNNN``) and rewrites member ids
+at the edge; the client-supplied ``trace_id`` is forwarded verbatim on
+every frame — including failover re-admissions — so one
+``trace-merge`` of client + router + member traces reconstructs a
+job's whole cross-process, cross-crash life.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import threading
+import time
+
+from pwasm_tpu.core.errors import EXIT_USAGE, PwasmError
+from pwasm_tpu.fleet.ledger import FleetLedger
+from pwasm_tpu.fleet.transport import (connect, is_tcp_target,
+                                       make_tcp_listener,
+                                       member_journal_path,
+                                       target_name)
+from pwasm_tpu.resilience.lifecycle import SignalDrain
+from pwasm_tpu.service import protocol
+from pwasm_tpu.service.client import ServiceClient, ServiceError
+from pwasm_tpu.service.journal import JobJournal, fold_records
+from pwasm_tpu.service.queue import (JOB_CANCELLED, JOB_FAILED,
+                                     JOB_PREEMPTED, QueueFull,
+                                     TERMINAL_STATES, _sum_numeric)
+
+_ROUTE_USAGE = """Usage:
+ pwasm-tpu route --backends=TARGET[,TARGET...]
+                 (--socket=PATH | --listen=HOST:PORT) [both allowed]
+                 [--journal-dir=DIR] [--max-queue=N]
+                 [--max-queue-total=N] [--poll-interval=S]
+                 [--metrics-textfile=PATH] [--log-json=FILE]
+                 [--trace-json=FILE]
+
+   --backends=...       member serve daemons, comma-separated targets
+                        (unix socket paths and/or HOST:PORT — required)
+   --socket=PATH        unix socket to serve the fleet protocol on
+   --listen=HOST:PORT   TCP endpoint to serve it on (port 0 = any)
+   --journal-dir=DIR    where members journal (shared durable storage:
+                        start each member with the same --journal-dir
+                        so the router can read a dead member's journal
+                        and fail its jobs over; without it only
+                        same-host unix members — default
+                        <socket>.journal — are recoverable)
+   --max-queue=N        FLEET-WIDE per-client live-job quota
+                        (default 64); past it a client's submit
+                        answers queue_full on the router, no matter
+                        which member it would have landed on
+   --max-queue-total=N  fleet-wide live-job backstop (default 8x)
+   --max-results=N      retired routed-job entries kept for id lookup
+                        (default 4096, LRU by last access; results
+                        themselves live on the members — an evicted
+                        fleet id answers unknown_job)
+   --poll-interval=S    member health/stats refresh period
+                        (default 0.5; a live member is declared dead
+                        only after 2 consecutive failed polls, or
+                        instantly on a mid-request connection
+                        failure)
+   --metrics-textfile=PATH  node-exporter textfile of the fleet
+                        families (pwasm_fleet_*, docs/OBSERVABILITY.md)
+   --log-json=FILE      append NDJSON fleet events (member_down,
+                        failover verdicts, placements)
+   --trace-json=FILE    Chrome trace of the router's per-job spans
+                        (route_submit / route_result_wait, stamped
+                        with each job's trace_id) — `pwasm-tpu
+                        trace-merge` joins it with the client's and
+                        members' traces on one timeline
+
+ SIGTERM (or the `drain` command) latches admission shut; in-flight
+ member jobs keep running and their results stay fetchable until the
+ last routed job lands terminal, then the router exits 0.
+"""
+
+
+# consecutive health-poll failures before a live member is declared
+# dead (the poll path is a timeout-prone 3s stats RPC; mid-request
+# connection failures on the forwarding paths still count as instant
+# evidence).  2 keeps real-death detection within ~2 poll ticks while
+# absorbing a single slow poll.
+_POLL_STRIKES = 2
+
+
+class _Member:
+    """One backend serve daemon as the router sees it."""
+
+    def __init__(self, target: str, journal_dir: str | None):
+        self.target = target
+        self.name = target_name(target)
+        self.journal_path = member_journal_path(target, journal_dir)
+        self.alive = False          # until the first healthy poll
+        self.ever_alive = False
+        self.queue_depth = 0
+        self.running = 0
+        self.stats: dict | None = None
+        self.jobs_routed = 0
+        self.fail_streak = 0
+        self.dispatched_since_poll = 0   # router placements the
+        #   member's last stats reply cannot have observed yet — the
+        #   placement pressure term (reset on every successful poll,
+        #   so a long-running routed job is never double-counted
+        #   against the depth the member itself reports)
+
+
+class _FleetJob:
+    """One routed job: fleet id, current placement, and — after a
+    failover recovered its verdict from journal+spool — the cached
+    terminal result the router serves itself."""
+
+    __slots__ = ("fid", "client", "priority", "trace_id", "frame",
+                 "member", "mjid", "gen", "stream", "sconn", "slock",
+                 "terminal", "retired", "failovers", "submitted_s",
+                 "accessed_s", "recovering")
+
+    def __init__(self, fid: str, client: str, priority: str,
+                 trace_id: str, frame: dict, member: str, mjid: str,
+                 stream: bool = False):
+        self.fid = fid
+        self.client = client
+        self.priority = priority
+        self.trace_id = trace_id
+        self.frame = frame          # the ORIGINAL submit fields (args/
+        #   cwd/...) — what a failover re-admission replays
+        self.member = member
+        self.mjid = mjid
+        self.gen = 0                # placement generation (bumped per
+        #   failover so result-waiters re-aim their member connection)
+        self.stream = stream
+        self.sconn = None           # persistent member conn for
+        #   stream-data frames (one per stream job)
+        self.slock = threading.Lock()
+        self.terminal: dict | None = None   # router-served verdict
+        self.retired = False        # ledger slot released
+        self.failovers = 0
+        self.submitted_s = time.time()
+        self.accessed_s = time.time()   # LRU clock for table eviction
+        self.recovering = False     # orphan-recovery once-latch
+
+
+class Router:
+    """The fleet router.  ``serve()`` runs the accept + health loops;
+    everything else is the per-connection protocol dispatch."""
+
+    def __init__(self, backends: list[str],
+                 socket_path: str | None = None,
+                 listen: str | None = None,
+                 journal_dir: str | None = None,
+                 max_queue: int = 64,
+                 max_queue_total: int | None = None,
+                 poll_interval: float = 0.5,
+                 max_results: int = 4096,
+                 stderr=None, metrics_textfile: str | None = None,
+                 log_json: str | None = None,
+                 trace_json: str | None = None):
+        if not backends:
+            raise ValueError("route needs at least one backend")
+        if not socket_path and not listen:
+            raise ValueError("route needs --socket and/or --listen")
+        self.socket_path = socket_path
+        self.listen = listen
+        self.tcp_port: int | None = None    # actual port after bind
+        self.stderr = stderr if stderr is not None else sys.stderr
+        self.poll_interval = max(0.05, float(poll_interval))
+        self.members: dict[str, _Member] = {}
+        for t in backends:
+            m = _Member(t, journal_dir)
+            if m.name in self.members:
+                raise ValueError(
+                    f"two backends map to member name {m.name!r} "
+                    f"({self.members[m.name].target!r} and {t!r}) — "
+                    "give them distinct basenames/ports")
+            self.members[m.name] = m
+        self.ledger = FleetLedger(max_queue, max_queue_total)
+        self.max_results = max(1, int(max_results))
+        self.jobs: dict[str, _FleetJob] = {}
+        self._clients_seen: set[str] = set()   # label universe for
+        #   the per-client gauge (a retired client reads 0, not gone)
+        self.drain = SignalDrain(stderr=self.stderr)
+        self._lock = threading.Lock()
+        self._draining = False
+        self._closing = threading.Event()
+        self._next_id = 0
+        self._rr = 0                 # placement tie-breaker
+        self._t0 = time.time()
+        self.failovers = 0           # member-death events handled
+        self.recovered = {"resumed": 0, "requeued": 0, "restored": 0,
+                          "cancelled": 0, "stream_preempted": 0,
+                          "failed": 0}
+        from pwasm_tpu.obs import (EventLog, MetricsRegistry,
+                                   Observability, TraceRecorder)
+        from pwasm_tpu.obs.catalog import build_fleet_metrics
+        self.registry = MetricsRegistry()
+        self.metrics = build_fleet_metrics(self.registry)
+        self.metrics["members"].set(len(self.members))
+        self.metrics_textfile = metrics_textfile
+        events = EventLog(path=log_json) if log_json else None
+        tracer = TraceRecorder() if trace_json else None
+        self.obs = Observability(registry=self.registry,
+                                 events=events, tracer=tracer,
+                                 trace_path=trace_json)
+        self.drain.obs = self.obs
+
+    # ---- lifecycle -----------------------------------------------------
+    def serve(self) -> int:
+        import selectors
+        listeners: list[socket.socket] = []
+        try:
+            if self.socket_path:
+                from pwasm_tpu.service.daemon import _socket_alive
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                if os.path.exists(self.socket_path):
+                    if _socket_alive(self.socket_path):
+                        s.close()
+                        raise PwasmError(
+                            f"Error: something is already serving on "
+                            f"{self.socket_path}\n")
+                    os.unlink(self.socket_path)
+                s.bind(self.socket_path)
+                s.listen(16)
+                listeners.append(s)
+            if self.listen:
+                t = make_tcp_listener(self.listen)
+                self.tcp_port = t.getsockname()[1]
+                listeners.append(t)
+        except OSError as e:
+            for s in listeners:
+                s.close()
+            raise PwasmError(
+                f"Error: cannot bind router endpoint: {e}\n")
+        sel = selectors.DefaultSelector()
+        for s in listeners:
+            s.setblocking(False)
+            sel.register(s, selectors.EVENT_READ)
+        self._poll_members()         # first placement view up front
+        health = threading.Thread(target=self._health_loop,
+                                  daemon=True,
+                                  name="pwasm-route-health")
+        with self.drain:
+            health.start()
+            where = " + ".join(
+                ([self.socket_path] if self.socket_path else [])
+                + ([f"{self.listen.rsplit(':', 1)[0]}:"
+                    f"{self.tcp_port}"] if self.listen else []))
+            self._say(f"routing {len(self.members)} member(s) on "
+                      f"{where}")
+            self.obs.event("router_start", members=len(self.members),
+                           backends=[m.target for m in
+                                     self.members.values()])
+            self._write_textfile()
+            drained_at = None
+            try:
+                while True:
+                    if self.drain.requested:
+                        self._begin_drain(self.drain.reason
+                                          or "drain requested")
+                        if self._drained():
+                            if drained_at is None:
+                                drained_at = time.monotonic()
+                            elif time.monotonic() - drained_at > 0.5:
+                                break
+                    try:
+                        events = sel.select(0.2)
+                    except OSError:
+                        break
+                    for key, _ in events:
+                        try:
+                            conn, _addr = key.fileobj.accept()
+                        except OSError:
+                            continue
+                        conn.setblocking(True)
+                        threading.Thread(target=self._handle_conn,
+                                         args=(conn,),
+                                         daemon=True).start()
+            finally:
+                self._closing.set()
+                sel.close()
+                for s in listeners:
+                    s.close()
+                with self._lock:
+                    sconns = [j.sconn for j in self.jobs.values()
+                              if j.sconn is not None]
+                for sc in sconns:
+                    sc.close()
+                if self.socket_path:
+                    try:
+                        os.unlink(self.socket_path)
+                    except OSError:
+                        pass
+        self.obs.event("router_exit", drained=self.drain.requested)
+        self._write_textfile()
+        if self.obs.tracer is not None and self.obs.trace_path:
+            try:
+                self.obs.tracer.write(self.obs.trace_path)
+                self._say(f"trace written to {self.obs.trace_path}")
+            except OSError as e:
+                self._say(f"warning: cannot write --trace-json "
+                          f"{self.obs.trace_path}: {e}")
+        if self.obs.events is not None:
+            self.obs.events.close()
+        if self.drain.requested:
+            self._say("drained — every routed job landed terminal; "
+                      "members keep serving")
+        return 0
+
+    def _say(self, msg: str) -> None:
+        print(f"pwasm-route: {msg}", file=self.stderr)
+
+    def _drained(self) -> bool:
+        with self._lock:
+            return self._draining and all(
+                j.retired or j.terminal is not None
+                for j in self.jobs.values())
+
+    def _begin_drain(self, reason: str) -> None:
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+            live = sum(1 for j in self.jobs.values()
+                       if not j.retired and j.terminal is None)
+        self.obs.event("router_drain", reason=reason, live=live)
+        self._say(f"draining ({reason}): {live} routed job(s) still "
+                  "live on members; results stay fetchable, new "
+                  "submissions rejected")
+
+    # ---- member health + placement -------------------------------------
+    def _health_loop(self) -> None:
+        while not self._closing.wait(self.poll_interval):
+            self._poll_members(count_failures=True)
+            self._reap_finished()
+            self._evict_jobs()
+            self._write_textfile()
+
+    def _poll_members(self, count_failures: bool = False) -> None:
+        """Refresh every member's liveness + load.  Only the health
+        loop passes ``count_failures=True``: it is single-threaded, so
+        ``fail_streak`` really counts CONSECUTIVE health ticks — a
+        stats request's synchronous refresh racing the loop must not
+        double-count one member stall into two strikes and fail over
+        a live member (the double-run corruption failover exists to
+        prevent)."""
+        for m in list(self.members.values()):
+            try:
+                with ServiceClient(m.target, timeout=3.0) as c:
+                    st = c.stats()
+                if not st.get("ok"):
+                    raise ServiceError(f"stats failed: {st}")
+                stats = st["stats"]
+                with self._lock:
+                    revived = not m.alive and m.ever_alive
+                    m.alive = True
+                    m.ever_alive = True
+                    m.fail_streak = 0
+                    m.stats = stats
+                    m.queue_depth = int(stats.get("queue_depth") or 0)
+                    m.running = int(stats.get("running") or 0)
+                    # this reply has observed everything we placed
+                    # before the RPC — stop counting it as pressure
+                    m.dispatched_since_poll = 0
+                if revived:
+                    self.obs.event("member_up", member=m.name)
+                    self._say(f"member {m.name} is back")
+            except (ServiceError, OSError, ValueError, TypeError,
+                    KeyError):
+                if not count_failures:
+                    continue
+                down = False
+                with self._lock:
+                    m.fail_streak += 1
+                    # a never-seen member just hasn't started yet.  A
+                    # known-alive member is declared dead only after
+                    # _POLL_STRIKES CONSECUTIVE poll failures: one
+                    # missed 3s stats RPC can be a load spike or a
+                    # long compile.  (A genuinely dead daemon refuses
+                    # the connect instantly, so real death still
+                    # resolves within ~2 poll ticks.)
+                    if m.alive and m.fail_streak >= _POLL_STRIKES:
+                        down = True
+                if down:
+                    self._member_down(m.name)
+        self._refresh_gauges()
+
+    def _refresh_gauges(self) -> None:
+        with self._lock:
+            rows = [(m.name, m.alive, m.queue_depth + m.running)
+                    for m in self.members.values()]
+            live = sum(1 for j in self.jobs.values()
+                       if not j.retired and j.terminal is None)
+        for name, alive, depth in rows:
+            self.metrics["member_up"].set(1 if alive else 0,
+                                          member=name)
+            self.metrics["member_queue_depth"].set(depth, member=name)
+        self.metrics["live_jobs"].set(live)
+        depths = self.ledger.client_depths()
+        with self._lock:
+            self._clients_seen |= set(depths)
+            if len(self._clients_seen) > 1024:
+                # identities are client-minted on TCP (tok:...): cap
+                # the label universe or a token-cycling client grows
+                # router memory and the textfile forever.  Retired
+                # (zero-depth) series are dropped oldest-set-first;
+                # live clients always keep theirs.
+                for c in list(self._clients_seen):
+                    if c not in depths:
+                        self._clients_seen.discard(c)
+                    if len(self._clients_seen) <= 1024:
+                        break
+            clients = set(self._clients_seen)
+        for c in clients:
+            # every client ever routed keeps a series (bounded
+            # above): a fully retired client must read 0, not freeze
+            # at its last nonzero sample (the daemon's gauge rule)
+            self.metrics["client_jobs"].set(depths.get(c, 0),
+                                            client=c or "default")
+
+    def _write_textfile(self) -> None:
+        if not self.metrics_textfile:
+            return
+        try:
+            self.registry.write_textfile(self.metrics_textfile)
+        except OSError as e:
+            self._say(f"warning: cannot write --metrics-textfile "
+                      f"{self.metrics_textfile}: {e}")
+
+    def _reap_finished(self) -> None:
+        """Release ledger slots of jobs that finished on their member
+        even if no client ever fetched the result — a quota must track
+        LIVE work, not politeness."""
+        with self._lock:
+            pending = [j for j in self.jobs.values() if not j.retired]
+        by_member: dict[str, list[_FleetJob]] = {}
+        for j in pending:
+            if j.terminal is not None:
+                self._note_retired(j)   # router-cached verdict
+            else:
+                by_member.setdefault(j.member, []).append(j)
+        for name, jobs in by_member.items():
+            with self._lock:
+                m = self.members.get(name)
+                if m is None or not m.alive:
+                    continue
+            try:
+                with ServiceClient(m.target, timeout=3.0) as c:
+                    for j in jobs:
+                        st = c.status(j.mjid)
+                        if st.get("ok") and st["job"]["state"] \
+                                in TERMINAL_STATES:
+                            self._note_retired(j)
+            except (ServiceError, OSError, KeyError, TypeError):
+                continue
+
+    def _note_retired(self, job: _FleetJob) -> None:
+        with self._lock:
+            if job.retired:
+                return
+            job.retired = True
+            sconn, job.sconn = job.sconn, None
+        if sconn is not None:
+            # a terminal stream job's persistent member connection
+            # would otherwise leak one fd here and one blocked handler
+            # thread on the member for the router's whole life
+            sconn.close()
+        self.ledger.retire(job.client, job.member)
+
+    def _evict_jobs(self) -> None:
+        """Bound the routed-job table: RETIRED jobs past
+        ``max_results`` are dropped least-recently-accessed first
+        (their results live on the members; an evicted fleet id
+        answers unknown_job, same contract as daemon eviction).  Live
+        jobs are never candidates — the ledger and failover need
+        them."""
+        with self._lock:
+            retired = [j for j in self.jobs.values() if j.retired]
+            excess = len(retired) - self.max_results
+            if excess <= 0:
+                return
+            retired.sort(key=lambda j: j.accessed_s)
+            for j in retired[:excess]:
+                self.jobs.pop(j.fid, None)
+
+    def _members_by_depth(self) -> list[_Member]:
+        """Alive members, least-loaded first: reported depth+running
+        plus only the placements the member's LAST stats reply cannot
+        have observed yet (``dispatched_since_poll`` — counting every
+        live routed job here would double-count work the member
+        already reports), round-robin on ties."""
+        with self._lock:
+            alive = [m for m in self.members.values() if m.alive]
+            self._rr += 1
+            rr = self._rr
+            order = sorted(
+                enumerate(alive),
+                key=lambda im: (im[1].queue_depth + im[1].running
+                                + im[1].dispatched_since_poll,
+                                (im[0] + rr) % max(1, len(alive))))
+        return [m for _i, m in order]
+
+    # ---- failover ------------------------------------------------------
+    def _member_down(self, name: str) -> None:
+        with self._lock:
+            m = self.members.get(name)
+            if m is None or not m.alive:
+                return
+            m.alive = False
+            affected = [j for j in self.jobs.values()
+                        if j.member == name and not j.retired
+                        and j.terminal is None]
+        self.failovers += 1
+        self.metrics["failovers"].inc()
+        self.metrics["member_up"].set(0, member=name)
+        self.obs.event("member_down", member=name,
+                       affected=len(affected))
+        self._say(f"member {name} is DOWN ({len(affected)} routed "
+                  "job(s) affected)")
+        folded: dict = {}
+        if m.journal_path:
+            try:
+                records = JobJournal(m.journal_path).replay()
+                folded = fold_records(records) if records else {}
+            except Exception as e:
+                self._say(f"warning: cannot read member journal "
+                          f"{m.journal_path}: {e} — failing over "
+                          "without it")
+        for job in affected:
+            self._recover_job(job, folded.get(job.mjid))
+        if folded and affected and m.journal_path:
+            # set the consumed journal aside: a later restart of this
+            # member must not replay jobs a sibling now owns (two
+            # processes resuming the same report file is corruption,
+            # not redundancy)
+            try:
+                from pwasm_tpu.utils.fsio import replace_durable
+                replace_durable(m.journal_path,
+                                m.journal_path + ".recovered")
+                self.obs.event("journal_set_aside", member=name,
+                               path=m.journal_path + ".recovered")
+            except OSError as e:
+                self._say(f"warning: cannot set aside {name}'s "
+                          f"journal after failover ({e}); do NOT "
+                          "restart the member on it")
+
+    def _recover_job(self, job: _FleetJob,
+                     row: dict | None = None) -> None:
+        """One job's failover verdict (module docstring).  ``row`` is
+        the folded journal state for this job; None means "resolve it
+        yourself" — the method re-reads the dead member's journal so
+        a caller WITHOUT the fold (a result-waiter rescuing an orphan
+        the death snapshot missed) still gets the journal verdict: a
+        bare resume-anyway would re-run a job whose finish (or acked
+        cancel) is durably recorded.  Idempotent and race-safe: a
+        per-job latch plus a live-member check make concurrent calls
+        (health loop vs a result-waiter) no-ops — a job must never be
+        re-admitted twice."""
+        with self._lock:
+            if job.terminal is not None or job.retired \
+                    or job.recovering:
+                return
+            m = self.members.get(job.member)
+            if m is not None and m.alive:
+                return        # already re-placed on a live member
+            job.recovering = True
+            jp = m.journal_path if m is not None else None
+        try:
+            if row is None and jp:
+                try:
+                    records = JobJournal(jp).replay()
+                    row = fold_records(records).get(job.mjid) \
+                        if records else None
+                except Exception:
+                    row = None    # unreadable/set-aside journal:
+                    #               the resume-anyway path is the
+                    #               documented safe fallback
+            self._recover_job_inner(job, row)
+        finally:
+            with self._lock:
+                job.recovering = False
+
+    def _recover_job_inner(self, job: _FleetJob,
+                           row: dict | None) -> None:
+        dead = job.member
+        # journal verdicts FIRST — a stream job whose finish (or
+        # acked cancel) is durably recorded must be served, not told
+        # to re-send records (the member's own restart replay orders
+        # its checks the same way)
+        fin = row.get("finish") if row else None
+        if fin is not None:
+            state = fin.get("state") \
+                if fin.get("state") in TERMINAL_STATES else JOB_FAILED
+            rc = fin.get("rc") if isinstance(fin.get("rc"), int) \
+                else None
+            extra: dict = {}
+            spool = fin.get("spool")
+            if isinstance(spool, dict) \
+                    and isinstance(spool.get("path"), str):
+                from pwasm_tpu.service.daemon import \
+                    load_spool_payload
+                payload, err = load_spool_payload(spool["path"])
+                if payload is not None:
+                    extra = {"stats": payload.get("stats"),
+                             "stderr_tail":
+                             str(payload.get("stderr_tail") or "")}
+                else:
+                    extra = {"spool_error": err}
+            self._cache_terminal(job, state, rc,
+                                 str(fin.get("detail") or "")
+                                 + " [served from the dead member's "
+                                 "journal+spool]", **extra)
+            self.recovered["restored"] += 1
+            self.metrics["recovered"].inc(how="restored")
+            return
+        if row is not None and row.get("cancel") is not None:
+            self._cache_terminal(job, JOB_CANCELLED, None, (
+                "cancel was acked before the member died; not re-run"))
+            self.recovered["cancelled"] += 1
+            self.metrics["recovered"].inc(how="cancelled")
+            return
+        if job.stream:
+            # a LIVE-at-crash socket stream: its records came over a
+            # connection the crash severed, so no sibling can re-run
+            # it alone — terminal preempted-resumable, the same
+            # verdict the member's own restart replay reaches
+            self._cache_terminal(job, JOB_PREEMPTED, 75, (
+                "stream interrupted: fleet member died; records up "
+                "to the last checkpoint are durable — re-open a "
+                "stream with --resume and re-send the records"))
+            self.recovered["stream_preempted"] += 1
+            self.metrics["recovered"].inc(how="stream_preempted")
+            return
+        # live at crash time: re-admit on a sibling.  With a journal
+        # row, `start` tells us whether a --resume continuation is
+        # needed; without one (per-daemon journal on an unreachable
+        # host) --resume is still the safe choice — it resumes a valid
+        # checkpoint when one exists and restarts cleanly when none
+        # does.
+        resume = row["start"] is not None if row is not None \
+            else True
+        argv = list(job.frame.get("args") or [])
+        if resume and "--resume" not in argv:
+            argv = argv + ["--resume"]
+        fwd = dict(job.frame, args=argv)
+        placed = False
+        for m in self._members_by_depth():
+            if m.name == dead:
+                continue
+            try:
+                c = ServiceClient(m.target, timeout=30.0)
+            except ServiceError:
+                continue       # connect refused: safe to try the next
+            try:
+                with c:
+                    resp = c.request({
+                        "cmd": "submit", **fwd,
+                        "trace_id": job.trace_id,
+                        "client": job.client,
+                        **({"priority": job.priority}
+                           if job.priority else {})})
+            except ServiceError:
+                # the frame may have been WRITTEN before the
+                # connection died — the sibling could have admitted
+                # the job without us seeing the ack.  At-most-once
+                # (the same rule as _route_submit): land the job
+                # terminal failed instead of re-admitting a possibly
+                # duplicate copy on yet another sibling.
+                self._cache_terminal(job, JOB_FAILED, None, (
+                    f"failover re-admission to member {m.name} "
+                    "failed mid-request; the job may or may not "
+                    "have been admitted there, so it was not "
+                    "retried elsewhere (at-most-once). Check that "
+                    "member's results before resubmitting."))
+                self.recovered["failed"] += 1
+                self.metrics["recovered"].inc(how="failed")
+                return
+            if resp.get("ok"):
+                with self._lock:
+                    job.member = m.name
+                    job.mjid = resp["job_id"]
+                    job.gen += 1
+                    job.failovers += 1
+                    m.jobs_routed += 1
+                    m.dispatched_since_poll += 1
+                self.ledger.move(job.client, dead, m.name)
+                how = "resumed" if resume else "requeued"
+                self.recovered[how] += 1
+                self.metrics["recovered"].inc(how=how)
+                self.obs.event("failover_readmit", job_id=job.fid,
+                               trace_id=job.trace_id, member=m.name,
+                               resumed=resume, was=dead)
+                self._say(f"job {job.fid}: "
+                          + ("resumed on" if resume
+                             else "re-queued to")
+                          + f" member {m.name}")
+                placed = True
+                break
+        if not placed:
+            self._cache_terminal(job, JOB_FAILED, None, (
+                "fleet member died and no sibling could take the "
+                "job over; resubmit (with --resume if a checkpoint "
+                "exists)"))
+            self.recovered["failed"] += 1
+            self.metrics["recovered"].inc(how="failed")
+
+    def _cache_terminal(self, job: _FleetJob, state: str,
+                        rc: int | None, detail: str,
+                        **extra) -> None:
+        resp = protocol.ok(
+            job={"id": job.fid, "state": state, "rc": rc,
+                 "detail": detail, "client": job.client,
+                 "priority": job.priority, "trace_id": job.trace_id,
+                 "stream": job.stream, "recovered": True,
+                 "member": job.member,
+                 "submitted_s": round(job.submitted_s, 3),
+                 "started_s": None, "finished_s":
+                 round(time.time(), 3)},
+            rc=rc, stats=extra.pop("stats", None),
+            stderr_tail=extra.pop("stderr_tail", ""), **extra)
+        with self._lock:
+            job.terminal = resp
+        self.obs.event("failover_verdict", job_id=job.fid,
+                       trace_id=job.trace_id, state=state, rc=rc)
+        self._note_retired(job)
+
+    # ---- protocol ------------------------------------------------------
+    def _handle_conn(self, conn: socket.socket) -> None:
+        from pwasm_tpu.service.daemon import _peer_identity
+        protocol.serve_connection(conn, self._dispatch,
+                                  peer=_peer_identity(conn))
+
+    def _resolve_client(self, req: dict, peer: str | None) -> str:
+        """protocol.resolve_client_identity — shared with the serve
+        daemon so router quota buckets and member DRR buckets cannot
+        drift."""
+        return protocol.resolve_client_identity(req, peer)
+
+    def _dispatch(self, req: dict, peer: str | None = None) -> dict:
+        cmd = req.get("cmd")
+        if cmd == "ping":
+            with self._lock:
+                alive = sum(1 for m in self.members.values()
+                            if m.alive)
+            return protocol.ok(
+                protocol_version=protocol.PROTOCOL_VERSION,
+                draining=self._draining, router=True,
+                members=len(self.members), members_alive=alive)
+        if cmd in ("submit", "stream"):
+            return self._route_submit(req, peer,
+                                      stream=(cmd == "stream"))
+        if cmd in ("stream-data", "stream-end"):
+            return self._route_stream_frame(req)
+        if cmd == "stats":
+            # refresh synchronously: svc-stats (and the fleet-aware
+            # top built on it) must describe NOW, not the last poll
+            self._poll_members()
+            return protocol.ok(stats=self._fleet_stats())
+        if cmd == "metrics":
+            self._refresh_gauges()
+            return protocol.ok(
+                metrics=self.registry.expose(),
+                content_type="text/plain; version=0.0.4")
+        if cmd == "drain":
+            self.drain.request("drain requested by client")
+            self._begin_drain(self.drain.reason)
+            with self._lock:
+                live = sorted(j.fid for j in self.jobs.values()
+                              if not j.retired and j.terminal is None)
+            return protocol.ok(draining=True, running=live,
+                               preempted_queued=[])
+        if cmd in ("status", "result", "cancel", "inspect"):
+            job = self.jobs.get(req.get("job_id"))
+            if job is None:
+                # unknown OR evicted past max_results: same answer
+                return protocol.err(
+                    protocol.ERR_UNKNOWN_JOB,
+                    f"unknown job_id {req.get('job_id')!r}")
+            job.accessed_s = time.time()   # the LRU clock
+            if cmd == "result":
+                return self._route_result(job, req)
+            return self._route_simple(job, cmd)
+        return protocol.err(protocol.ERR_UNKNOWN_CMD,
+                            f"unknown cmd {cmd!r}")
+
+    def _route_submit(self, req: dict, peer: str | None,
+                      stream: bool) -> dict:
+        if self._draining:
+            return protocol.err(protocol.ERR_DRAINING,
+                                "fleet router is draining")
+        client = self._resolve_client(req, peer)
+        if not isinstance(client, str) or len(client) > 64:
+            return protocol.err(protocol.ERR_BAD_REQUEST,
+                                "client must be a short identifier")
+        trace_id = req.get("trace_id")
+        frame = {"args": req.get("args"), "cwd": req.get("cwd")}
+        if req.get("priority") is not None:
+            frame["priority"] = req.get("priority")
+        order = self._members_by_depth()
+        if not order:
+            return protocol.err(
+                protocol.ERR_QUEUE_FULL,
+                "no live fleet members (retry after they rejoin)",
+                retry_after_s=2.0)
+        last_reject: dict | None = None
+        for m in order:
+            try:
+                self.ledger.admit(client, m.name)
+            except QueueFull as e:
+                self.metrics["jobs"].inc(outcome="rejected")
+                self.obs.event("route_reject", client=client,
+                               detail=str(e))
+                return protocol.err(
+                    protocol.ERR_QUEUE_FULL, str(e),
+                    client=client or "default",
+                    client_depth=self.ledger.client_depths().get(
+                        client, 0),
+                    retry_after_s=2.0)
+            t0 = self.obs.tracer.now() \
+                if self.obs.tracer is not None else 0.0
+            try:
+                c = ServiceClient(m.target, timeout=60.0)
+            except ServiceError:
+                self.ledger.retire(client, m.name)
+                self._member_down(m.name)
+                continue
+            try:
+                resp = c.request({
+                    "cmd": "stream" if stream else "submit",
+                    **frame, "client": client,
+                    **({"trace_id": trace_id}
+                       if isinstance(trace_id, str) and trace_id
+                       else {})})
+            except ServiceError:
+                # the frame may have been WRITTEN before the
+                # connection died: the member could have admitted
+                # (and journaled) the job even though we never saw
+                # the ack.  Re-placing it on a sibling here would be
+                # a possible double admission — two processes running
+                # the same -o argv, the corruption this router's own
+                # failover logic refuses elsewhere.  At-most-once
+                # wins: fail the submission loudly instead.  (A
+                # CONNECT-phase failure above carries no such risk
+                # and does try the next sibling.)
+                c.close()
+                self.ledger.retire(client, m.name)
+                self._member_down(m.name)
+                self.metrics["jobs"].inc(outcome="rejected")
+                return protocol.err(
+                    protocol.ERR_BAD_REQUEST,
+                    f"fleet member {m.name} failed mid-submission; "
+                    "the job may or may not have been admitted "
+                    "there, so it was NOT retried on a sibling "
+                    "(at-most-once). Check the member's "
+                    "journal/results before resubmitting.")
+            if self.obs.tracer is not None:
+                self.obs.tracer.complete(
+                    "route_submit", t0, trace_id=trace_id,
+                    member=m.name)
+            if resp.get("ok"):
+                with self._lock:
+                    self._next_id += 1
+                    fid = f"fleet-{self._next_id:04d}"
+                    job = _FleetJob(fid, client,
+                                    str(req.get("priority") or ""),
+                                    str(resp.get("trace_id")
+                                        or trace_id or ""),
+                                    frame, m.name, resp["job_id"],
+                                    stream=stream)
+                    if stream:
+                        job.sconn = c
+                    self.jobs[fid] = job
+                    m.jobs_routed += 1
+                    m.dispatched_since_poll += 1
+                if not stream:
+                    c.close()
+                self.metrics["jobs"].inc(outcome="accepted")
+                self.metrics["routed"].inc(member=m.name)
+                self.obs.event("route_admit", job_id=fid,
+                               member=m.name, client=client,
+                               stream=stream,
+                               trace_id=job.trace_id)
+                out = dict(resp)
+                out["job_id"] = fid
+                out["member"] = m.name
+                return out
+            c.close()
+            self.ledger.retire(client, m.name)
+            if resp.get("error") == protocol.ERR_QUEUE_FULL:
+                last_reject = resp      # try the next-best sibling
+                continue
+            # bad_request / draining etc: the member's diagnostic is
+            # the authoritative one — relay it
+            self.metrics["jobs"].inc(outcome="rejected")
+            return resp
+        self.metrics["jobs"].inc(outcome="rejected")
+        return last_reject if last_reject is not None else \
+            protocol.err(protocol.ERR_QUEUE_FULL,
+                         "every fleet member is at capacity",
+                         retry_after_s=2.0)
+
+    def _route_stream_frame(self, req: dict) -> dict:
+        job = self.jobs.get(req.get("job_id"))
+        if job is None:
+            return protocol.err(
+                protocol.ERR_UNKNOWN_JOB,
+                f"unknown job_id {req.get('job_id')!r}")
+        if not job.stream:
+            return protocol.err(
+                protocol.ERR_BAD_REQUEST,
+                f"job {job.fid} is not a stream job")
+        with self._lock:
+            # snapshot under the lock: _note_retired pops job.sconn
+            # concurrently (a stream that landed terminal server-side
+            # while the client was still pumping frames)
+            sconn = job.sconn
+            closed = job.terminal is not None or job.retired \
+                or sconn is None
+        if closed:
+            return protocol.err(
+                protocol.ERR_BAD_REQUEST,
+                f"stream {job.fid} is closed; re-open a stream with "
+                "--resume to complete it")
+        fwd = dict(req)
+        fwd["job_id"] = job.mjid
+        try:
+            with job.slock:
+                return sconn.request(fwd)
+        except ServiceError:
+            # decide WHOSE failure this was before declaring a member
+            # dead: a router-side close (the job retired mid-request)
+            # is a closed stream on a healthy member, and failing the
+            # member over for it would re-run jobs it still owns
+            with self._lock:
+                retired_now = job.retired or job.terminal is not None
+            if retired_now:
+                return protocol.err(
+                    protocol.ERR_BAD_REQUEST,
+                    f"stream {job.fid} is closed; re-open a stream "
+                    "with --resume to complete it")
+            self._member_down(job.member)
+            return protocol.err(
+                protocol.ERR_BAD_REQUEST,
+                f"stream {job.fid} lost its member mid-stream; "
+                "re-open a stream with --resume and re-send the "
+                "records")
+
+    def _route_simple(self, job: _FleetJob, cmd: str) -> dict:
+        """status / cancel / inspect: one forwarded frame, ids
+        rewritten at the edge; a dead member answers from the cached
+        failover verdict once one exists."""
+        for _attempt in (0, 1):
+            with self._lock:
+                term = job.terminal
+                m = self.members.get(job.member)
+                mjid = job.mjid
+            if term is not None:
+                if cmd == "cancel":
+                    return protocol.ok(
+                        state=term["job"]["state"], was="terminal")
+                if cmd == "inspect":
+                    return protocol.ok(job=dict(term["job"]),
+                                       trace_id=job.trace_id,
+                                       flight=None)
+                return protocol.ok(job=dict(term["job"]))
+            if m is None or not m.alive:
+                # same orphan rescue as _route_result: a job the
+                # death snapshot missed must still reach a verdict
+                # through a status/inspect/cancel poll (idempotent —
+                # the per-job latch makes a racing health pass win)
+                self._member_down(job.member)
+                self._recover_job(job)
+                continue
+            try:
+                with ServiceClient(m.target, timeout=30.0) as c:
+                    resp = c.request({"cmd": cmd, "job_id": mjid})
+            except ServiceError:
+                self._member_down(job.member)
+                self._recover_job(job)
+                continue
+            return self._rewrite(resp, job)
+        # recovery is still in flight (or re-placement raced us):
+        # reads answer a soft in-progress state — the client's next
+        # poll sees the verdict; a cancel must not pretend it acted
+        if cmd == "cancel":
+            return protocol.err(
+                protocol.ERR_BAD_REQUEST,
+                f"job {job.fid} is failing over after a member "
+                "loss; retry the cancel in a moment")
+        return protocol.ok(job={
+            "id": job.fid, "state": "running",
+            "detail": "member lost; failover in progress",
+            "trace_id": job.trace_id, "member": job.member})
+
+    def _route_result(self, job: _FleetJob, req: dict) -> dict:
+        wait = req.get("wait", True)
+        timeout = req.get("timeout")
+        deadline = time.monotonic() + float(timeout) \
+            if isinstance(timeout, (int, float)) else None
+        t0 = self.obs.tracer.now() \
+            if self.obs.tracer is not None else 0.0
+        while True:
+            with self._lock:
+                term = job.terminal
+                m = self.members.get(job.member)
+                mjid, gen = job.mjid, job.gen
+            if term is not None:
+                self._note_retired(job)
+                if self.obs.tracer is not None:
+                    self.obs.tracer.complete(
+                        "route_result_wait", t0,
+                        trace_id=job.trace_id, job_id=job.fid)
+                return dict(term)
+            expired = deadline is not None \
+                and time.monotonic() >= deadline
+            slice_s = 2.0
+            if deadline is not None:
+                slice_s = min(slice_s, max(
+                    0.05, deadline - time.monotonic()))
+            if m is None or not m.alive:
+                # the member is dead and this job has no verdict yet.
+                # Honor the CLIENT's contract first: a no-wait poll or
+                # an expired timeout answers pending instead of
+                # blocking on the recovery.  Then recover: normally
+                # _member_down's failover already owns the job, but
+                # one admitted in the gap between the death snapshot
+                # and its table insertion would be orphaned forever —
+                # _recover_job is idempotent (per-job latch), so
+                # calling it here is safe either way.
+                if not wait or expired:
+                    return protocol.ok(
+                        job={"id": job.fid, "state": "running",
+                             "detail": "member lost; failover in "
+                             "progress", "trace_id": job.trace_id,
+                             "member": job.member},
+                        pending=True)
+                self._recover_job(job, None)
+                time.sleep(0.05)
+                continue
+            try:
+                with ServiceClient(m.target, timeout=60.0) as c:
+                    resp = c.result(mjid,
+                                    wait=wait and not expired,
+                                    timeout=slice_s)
+            except ServiceError:
+                self._member_down(job.member)
+                continue
+            if not resp.get("ok"):
+                return resp
+            if resp.get("pending"):
+                with self._lock:
+                    moved = job.gen != gen
+                if moved or (wait and not expired):
+                    continue
+                return self._rewrite(resp, job)
+            self._note_retired(job)
+            if self.obs.tracer is not None:
+                self.obs.tracer.complete(
+                    "route_result_wait", t0, trace_id=job.trace_id,
+                    job_id=job.fid, member=job.member)
+            return self._rewrite(resp, job)
+
+    def _rewrite(self, resp: dict, job: _FleetJob) -> dict:
+        out = dict(resp)
+        j = out.get("job")
+        if isinstance(j, dict):
+            j = dict(j)
+            j["id"] = job.fid
+            j["member"] = job.member
+            if job.failovers:
+                j["failovers"] = job.failovers
+            out["job"] = j
+        return out
+
+    def _fleet_stats(self) -> dict:
+        """The fleet-aggregated svc-stats surface: member counters
+        summed, lanes labeled by member, plus the ``fleet`` block the
+        fleet-aware ``top`` renders."""
+        from pwasm_tpu.service.queue import SERVICE_STATS_VERSION
+        jobs_sum: dict = {}
+        warm_sum: dict = {}
+        streams_sum: dict = {}
+        lanes: list[dict] = []
+        depth = running = maxc = 0
+        breaker = 0
+        member_rows = []
+        with self._lock:
+            members = list(self.members.values())
+            live = sum(1 for j in self.jobs.values()
+                       if not j.retired and j.terminal is None)
+        for m in members:
+            st = m.stats or {}
+            if m.alive:
+                depth += int(st.get("queue_depth") or 0)
+                running += int(st.get("running") or 0)
+                maxc += int(st.get("max_concurrent") or 0)
+                breaker = max(breaker,
+                              int(st.get("breaker_state") or 0))
+                if isinstance(st.get("jobs"), dict):
+                    _sum_numeric(jobs_sum, st["jobs"])
+                if isinstance(st.get("warm"), dict):
+                    _sum_numeric(warm_sum, st["warm"])
+                if isinstance(st.get("streams"), dict):
+                    _sum_numeric(streams_sum, st["streams"])
+                for row in (st.get("lanes") or []):
+                    if isinstance(row, dict):
+                        r = dict(row)
+                        r["member"] = m.name
+                        lanes.append(r)
+            member_rows.append({
+                "name": m.name, "target": m.target,
+                "alive": m.alive,
+                "queue_depth": m.queue_depth if m.alive else None,
+                "running": m.running if m.alive else None,
+                "jobs_routed": m.jobs_routed,
+                "journal": m.journal_path,
+            })
+        return {
+            "stats_version": SERVICE_STATS_VERSION,
+            "protocol_version": protocol.PROTOCOL_VERSION,
+            "router": True,
+            "uptime_s": round(time.time() - self._t0, 3),
+            "draining": self._draining,
+            "queue_depth": depth,
+            "running": running,
+            "breaker_state": breaker,
+            "max_queue": self.ledger.max_queue,
+            "max_concurrent": maxc,
+            "jobs": jobs_sum,
+            "warm": warm_sum,
+            "streams": streams_sum,
+            "lanes": lanes,
+            "fair_share": {
+                "max_queue_per_client": self.ledger.max_queue,
+                "max_queue_total": self.ledger.max_total,
+                "clients": {(c or "default"): n for c, n in
+                            self.ledger.client_depths().items()},
+            },
+            "fleet": {
+                "members": member_rows,
+                "alive": sum(1 for m in members if m.alive),
+                "failovers": self.failovers,
+                "jobs_routed": self.ledger.admitted,
+                "jobs_recovered": dict(self.recovered),
+                "live_jobs": live,
+            },
+        }
+
+
+def route_main(argv: list[str], stdout=None, stderr=None) -> int:
+    """The ``pwasm-tpu route`` entry point."""
+    stderr = stderr if stderr is not None else sys.stderr
+    opts: dict[str, str] = {}
+    for a in argv:
+        if a.startswith("--") and "=" in a:
+            k, v = a[2:].split("=", 1)
+            opts[k] = v
+        elif a in ("-h", "--help"):
+            stderr.write(_ROUTE_USAGE)
+            return EXIT_USAGE
+        else:
+            stderr.write(f"{_ROUTE_USAGE}\nInvalid argument: {a}\n")
+            return EXIT_USAGE
+    backends = [b for b in
+                (opts.pop("backends", "") or "").split(",") if b]
+    if not backends:
+        stderr.write(f"{_ROUTE_USAGE}\nError: --backends=TARGET"
+                     "[,TARGET...] is required\n")
+        return EXIT_USAGE
+    sock = opts.pop("socket", None)
+    listen = opts.pop("listen", None)
+    if not sock and not listen:
+        stderr.write(f"{_ROUTE_USAGE}\nError: --socket=PATH and/or "
+                     "--listen=HOST:PORT is required\n")
+        return EXIT_USAGE
+    if listen is not None:
+        if not is_tcp_target(listen):
+            stderr.write(f"{_ROUTE_USAGE}\nInvalid --listen value: "
+                         f"{listen} (HOST:PORT)\n")
+            return EXIT_USAGE
+    nums: dict[str, int | None] = {}
+    for knob, dflt in (("max-queue", 64), ("max-queue-total", None),
+                       ("max-results", 4096)):
+        val = opts.pop(knob, None)
+        if val is None:
+            nums[knob] = dflt
+        elif val.isascii() and val.isdigit() and int(val) >= 1:
+            nums[knob] = int(val)
+        else:
+            stderr.write(f"{_ROUTE_USAGE}\nInvalid --{knob} value: "
+                         f"{val}\n")
+            return EXIT_USAGE
+    poll = 0.5
+    val = opts.pop("poll-interval", None)
+    if val is not None:
+        import math
+        try:
+            poll = float(val)
+            if poll <= 0 or not math.isfinite(poll):
+                raise ValueError
+        except (TypeError, ValueError):
+            stderr.write(f"{_ROUTE_USAGE}\nInvalid --poll-interval "
+                         f"value: {val}\n")
+            return EXIT_USAGE
+    journal_dir = opts.pop("journal-dir", None)
+    metrics_textfile = opts.pop("metrics-textfile", None)
+    log_json = opts.pop("log-json", None)
+    trace_json = opts.pop("trace-json", None)
+    if opts:
+        stderr.write(f"{_ROUTE_USAGE}\nInvalid argument: "
+                     f"--{next(iter(opts))}\n")
+        return EXIT_USAGE
+    try:
+        router = Router(backends, socket_path=sock, listen=listen,
+                        journal_dir=journal_dir,
+                        max_queue=nums["max-queue"],
+                        max_queue_total=nums["max-queue-total"],
+                        max_results=nums["max-results"],
+                        poll_interval=poll, stderr=stderr,
+                        metrics_textfile=metrics_textfile,
+                        log_json=log_json, trace_json=trace_json)
+    except ValueError as e:
+        stderr.write(f"{_ROUTE_USAGE}\nError: {e}\n")
+        return EXIT_USAGE
+    try:
+        return router.serve()
+    except PwasmError as e:
+        stderr.write(str(e))
+        return e.exit_code

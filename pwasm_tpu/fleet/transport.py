@@ -1,0 +1,123 @@
+"""The service transport layer: unix sockets joined by TCP.
+
+Every service surface (``serve``, ``submit``, ``route``, ``top``,
+``svc-stats``) names its peer with one *target* string:
+
+- a filesystem path (any string containing ``/``, or anything that is
+  not ``host:port`` shaped) is a unix socket — the single-host default,
+  with kernel-attested ``SO_PEERCRED`` client identity;
+- ``HOST:PORT`` (e.g. ``10.0.0.7:9211``, ``localhost:9211``) is TCP —
+  the cross-host transport fleet federation runs on.  TCP has no peer
+  credentials, so the client identity there is the explicit
+  ``--client-token`` riding every frame (``tok:<name>`` buckets in the
+  DRR fair share) and an untokened connection shares the anonymous
+  bucket.  The NDJSON protocol itself is byte-identical on both.
+
+This module is the one place the target grammar lives: parsing,
+connecting, listening, and the sanitized *member name* used for
+journal/metric identities — so the client, the daemon and the router
+cannot disagree about what a target string means.
+
+Jax-free like the rest of ``pwasm_tpu/fleet/`` (gated by
+``qa/check_supervision.py::find_fleet_violations``).
+"""
+
+from __future__ import annotations
+
+import re
+import socket
+
+# HOST:PORT — host is anything path-free and colon-free (DNS name or
+# IPv4); a string with "/" can only be a unix path.  IPv6 literals are
+# deliberately out of the grammar (brackets would collide with shells);
+# use a DNS name.
+_TCP_RE = re.compile(r"^(?P<host>[^/:\s]+):(?P<port>\d{1,5})$")
+
+# member names double as journal filenames and metric label values:
+# keep the charset boring
+_NAME_BAD = re.compile(r"[^A-Za-z0-9_.-]")
+
+
+def is_tcp_target(target: str) -> bool:
+    """True when ``target`` is ``HOST:PORT`` shaped (a path — anything
+    with a ``/`` or no ``:<digits>`` tail — is a unix socket)."""
+    return bool(_TCP_RE.match(target or ""))
+
+
+def split_hostport(target: str) -> tuple[str, int]:
+    m = _TCP_RE.match(target or "")
+    if not m or not 0 <= int(m.group("port")) <= 65535:
+        raise ValueError(
+            f"not a HOST:PORT target: {target!r} (port 0-65535)")
+    return m.group("host"), int(m.group("port"))
+
+
+def connect(target: str, timeout: float | None = None) -> socket.socket:
+    """One connected stream socket to ``target`` (AF_INET for
+    ``HOST:PORT``, AF_UNIX otherwise).  Raises OSError like the bare
+    socket calls would — the caller owns the error rendering."""
+    if is_tcp_target(target):
+        host, port = split_hostport(target)
+        return socket.create_connection((host, port), timeout=timeout)
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    if timeout is not None:
+        s.settimeout(timeout)
+    try:
+        s.connect(target)
+    except OSError:
+        s.close()
+        raise
+    return s
+
+
+def make_tcp_listener(spec: str, backlog: int = 16) -> socket.socket:
+    """A bound+listening TCP socket for a ``HOST:PORT`` listen spec
+    (port 0 = kernel-assigned; read it back via ``getsockname``).
+    ``SO_REUSEADDR`` is set so a restarted daemon rebinds without
+    waiting out TIME_WAIT — the crash-recovery path must not stall two
+    minutes on its own ghost."""
+    host, port = split_hostport(spec)
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    try:
+        s.bind((host, port))
+        s.listen(backlog)
+    except OSError:
+        s.close()
+        raise
+    return s
+
+
+def target_name(target: str) -> str:
+    """The sanitized member identity a target maps to — journal
+    filenames under a shared ``--journal-dir`` and the ``member=``
+    metric label both use it.  Unix sockets name by basename (two
+    members sharing a journal dir must use distinct socket basenames —
+    docs/FLEET.md), TCP targets by ``host_port``."""
+    if is_tcp_target(target):
+        host, port = split_hostport(target)
+        return _NAME_BAD.sub("_", f"{host}_{port}")
+    base = target.rstrip("/").rsplit("/", 1)[-1] or "socket"
+    return _NAME_BAD.sub("_", base)
+
+
+def member_journal_path(target: str,
+                        journal_dir: str | None) -> str | None:
+    """Where a member serving on ``target`` keeps its job journal —
+    the placement-policy contract between ``serve --journal-dir`` and
+    ``route --journal-dir`` (both compute it HERE, so the router finds
+    exactly the file the member wrote):
+
+    - with a shared ``journal_dir`` (durable network storage):
+      ``<dir>/<member-name>.journal`` for any transport;
+    - without one (fast local disk): the serve default
+      ``<socket>.journal`` — readable by a same-host router for unix
+      targets, unreachable for TCP targets (returns None: failover
+      degrades to resubmit-with---resume, docs/FLEET.md)."""
+    import os
+    if journal_dir:
+        return os.path.join(journal_dir,
+                            target_name(target) + ".journal")
+    if is_tcp_target(target):
+        return None
+    return target + ".journal"

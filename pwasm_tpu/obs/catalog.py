@@ -299,6 +299,53 @@ def build_stream_metrics(reg: MetricsRegistry) -> dict:
     return m
 
 
+def build_fleet_metrics(reg: MetricsRegistry) -> dict:
+    """Register the fleet-router families (the ``pwasm-tpu route``
+    daemon, docs/FLEET.md): member liveness and load as the router
+    sees it, placement and failover counters, and the global
+    fair-share ledger's per-client live-job gauge.  Labeled by the
+    sanitized member name (``fleet/transport.py::target_name``) —
+    the same identity the shared-journal placement policy uses."""
+    m = {}
+    m["members"] = reg.gauge(
+        "pwasm_fleet_members",
+        "Member serve daemons this router fronts")
+    m["member_up"] = reg.gauge(
+        "pwasm_fleet_member_up",
+        "Member liveness as the router's health loop sees it "
+        "(1 up, 0 down)", labels=("member",))
+    m["member_queue_depth"] = reg.gauge(
+        "pwasm_fleet_member_queue_depth",
+        "Queued + running jobs per member at the last stats poll",
+        labels=("member",))
+    m["live_jobs"] = reg.gauge(
+        "pwasm_fleet_jobs_live",
+        "Routed jobs not yet terminal anywhere in the fleet")
+    m["client_jobs"] = reg.gauge(
+        "pwasm_fleet_client_jobs",
+        "Live fleet-wide jobs per fair-share client identity (the "
+        "global ledger the fleet quota is enforced against)",
+        labels=("client",))
+    m["routed"] = reg.counter(
+        "pwasm_fleet_jobs_routed_total",
+        "Jobs placed per member (least-loaded placement)",
+        labels=("member",))
+    m["jobs"] = reg.counter(
+        "pwasm_fleet_jobs_total",
+        "Router admissions by outcome (accepted/rejected)",
+        labels=("outcome",))
+    m["failovers"] = reg.counter(
+        "pwasm_fleet_failovers_total",
+        "Member-death events the router handled (each one is a "
+        "journal-aware failover pass)")
+    m["recovered"] = reg.counter(
+        "pwasm_fleet_jobs_recovered_total",
+        "Jobs recovered from dead members, by verdict (resumed/"
+        "requeued/restored/cancelled/stream_preempted/failed)",
+        labels=("how",))
+    return m
+
+
 def fold_run_stats(m: dict, st: dict | None) -> None:
     """Fold one run's ``--stats`` JSON (the versioned ``stats_version``
     schema) into the run-metric families.  The one-shot CLI calls it

@@ -36,38 +36,34 @@ def __getattr__(name: str):
 _cache_armed = False
 
 
-def enable_compilation_cache() -> None:
-    """Arm JAX's persistent compilation cache (idempotent).
+def enable_compilation_cache(cache_dir: str | None = None) -> None:
+    """Arm JAX's persistent compilation cache (idempotent — the first
+    caller's directory wins for the process).
 
     The CLI's device path compiles a handful of programs per run
     (ctx-scan per ref-length bucket, consensus, refine phases); a cold
     TPU compile costs tens of seconds, and the reference's workflow is
     MANY pafreport invocations over assembly batches — without a disk
     cache every invocation pays the compiles again.  Cache dir:
-    ``PWASM_JAX_CACHE_DIR`` > ``~/.cache/pwasm_tpu/jax``; opt out with
-    ``PWASM_JAX_CACHE=0``.  Failures are non-fatal (the cache is an
-    optimization, never a correctness dependency)."""
+    explicit ``cache_dir`` (the ``--compile-cache-dir`` /
+    ``serve --compile-cache-dir`` knob) > ``PWASM_JAX_CACHE_DIR`` >
+    ``~/.cache/pwasm_tpu/jax``; opt out with ``PWASM_JAX_CACHE=0``.
+    The ``jax.config`` surface itself is touched only through the
+    jaxcompat shim (the config keys moved across jax pins before).
+    Failures are non-fatal (the cache is an optimization, never a
+    correctness dependency)."""
     global _cache_armed
     import os
 
     if _cache_armed or os.environ.get("PWASM_JAX_CACHE", "1") == "0":
         return
     _cache_armed = True
-    try:
-        import jax
-
-        d = os.environ.get("PWASM_JAX_CACHE_DIR") or os.path.join(
-            os.path.expanduser("~"), ".cache", "pwasm_tpu", "jax")
-        os.makedirs(d, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", d)
-        # cache every program, not just the >1s compiles: the CLI's
-        # repeated-invocation pattern amortizes even small ones
-        jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                          0.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
-                          0)
-    except Exception:
-        pass
+    d = cache_dir or os.environ.get("PWASM_JAX_CACHE_DIR") \
+        or os.path.join(os.path.expanduser("~"), ".cache",
+                        "pwasm_tpu", "jax")
+    from pwasm_tpu.utils.jaxcompat import \
+        enable_compilation_cache as _shim
+    _shim(d)
 
 
 def on_tpu_backend() -> bool:

@@ -22,9 +22,15 @@ from pwasm_tpu.core.errors import EXIT_FATAL, EXIT_USAGE
 from pwasm_tpu.service import protocol
 
 _CLIENT_USAGE = """Usage:
- pwasm-tpu submit --socket=PATH [--no-wait] [--timeout=S]
+ pwasm-tpu submit --socket=TARGET [--no-wait] [--timeout=S]
                   [--retry[=N]] [--client=NAME] [--priority=LANE]
-                  [--] <cli args...>
+                  [--client-token=TOK] [--] <cli args...>
+
+ TARGET is a unix socket path or a HOST:PORT TCP endpoint (a `serve
+ --listen` daemon or a `route` fleet router — docs/FLEET.md).  On TCP
+ there is no kernel peer credential, so pass --client-token=TOK to
+ claim a fair-share identity (jobs bucket under tok:TOK); untokened
+ TCP submits share the anonymous bucket.
      submit one report job (the argv a cold CLI run would take; -o is
      required — the socket carries control, not report bytes).  By
      default waits for the job and exits with the JOB's exit code
@@ -89,31 +95,39 @@ class ServiceError(Exception):
 
 
 class ServiceClient:
-    """One connection to a serve daemon.  Context-manager; every
-    command is one request/response frame pair on this connection.
+    """One connection to a serve daemon — over a unix socket path or,
+    since the fleet federation PR, a ``HOST:PORT`` TCP target (the
+    grammar lives in ``pwasm_tpu/fleet/transport.py``; docs/FLEET.md).
+    Context-manager; every command is one request/response frame pair
+    on this connection.
 
     ``trace_id`` (minted per connection unless passed in) rides EVERY
     frame: the daemon stamps it onto the jobs this client submits —
     into the journal (surviving kill -9 replay), the event log, the
     flight record, and both sides' Chrome traces — so one grep (or one
-    ``trace-merge``) reconstructs a job's whole cross-process life."""
+    ``trace-merge``) reconstructs a job's whole cross-process life.
+
+    ``client_token`` (the ``--client-token`` flag) also rides every
+    frame: on TCP — where no kernel-attested ``SO_PEERCRED`` identity
+    exists — the daemon buckets this connection's jobs under
+    ``tok:<token>`` for DRR fair share, so identities stay
+    attested-or-explicit on both transports."""
 
     def __init__(self, socket_path: str, timeout: float | None = None,
                  max_frame_bytes: int = protocol.MAX_FRAME_BYTES,
-                 trace_id: str | None = None):
+                 trace_id: str | None = None,
+                 client_token: str | None = None):
+        from pwasm_tpu.fleet.transport import connect
         from pwasm_tpu.obs.events import new_run_id
         self.socket_path = socket_path
         self.max_frame_bytes = max_frame_bytes
         self.trace_id = trace_id or new_run_id()
-        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        if timeout is not None:
-            self._sock.settimeout(timeout)
+        self.client_token = client_token
         try:
-            self._sock.connect(socket_path)
-        except OSError as e:
-            self._sock.close()
+            self._sock = connect(socket_path, timeout=timeout)
+        except (OSError, ValueError) as e:
             raise ServiceError(
-                f"cannot connect to service socket {socket_path}: "
+                f"cannot connect to service target {socket_path}: "
                 f"{e}") from e
         self._rfile = self._sock.makefile("rb")
         self._wfile = self._sock.makefile("wb")
@@ -122,8 +136,11 @@ class ServiceClient:
     def _req(self, obj: dict) -> dict:
         """One command frame, trace_id stamped (the propagation rule:
         EVERY frame carries it, so even a bare status poll is
-        correlatable in a packet capture)."""
+        correlatable in a packet capture) — and the client token when
+        this connection has one (the TCP identity)."""
         obj.setdefault("trace_id", self.trace_id)
+        if self.client_token:
+            obj.setdefault("client_token", self.client_token)
         return self.request(obj)
 
     def request(self, obj: dict) -> dict:
@@ -266,7 +283,8 @@ class ServiceClient:
                 # one-response pairing
                 try:
                     with ServiceClient(self.socket_path,
-                                       trace_id=self.trace_id) \
+                                       trace_id=self.trace_id,
+                                       client_token=self.client_token) \
                             as kc:
                         while not stop.wait(keepalive_s):
                             if not kc.stream_data(job_id,
@@ -376,6 +394,8 @@ def _parse_client_argv(argv: list[str]) -> tuple[dict, list[str]]:
             opts["retry"] = a.split("=", 1)[1]
         elif a.startswith("--client="):
             opts["client"] = a.split("=", 1)[1]
+        elif a.startswith("--client-token="):
+            opts["client_token"] = a.split("=", 1)[1]
         elif a.startswith("--priority="):
             opts["priority"] = a.split("=", 1)[1]
         elif a.startswith("--trace-id="):
@@ -462,8 +482,9 @@ def client_main(cmd: str, argv: list[str], stdout=None,
 
     try:
         if cmd == "metrics":
-            with ServiceClient(sock,
-                               trace_id=opts.get("trace_id")) as c:
+            with ServiceClient(
+                    sock, trace_id=opts.get("trace_id"),
+                    client_token=opts.get("client_token")) as c:
                 resp = c.metrics()
             if not resp.get("ok"):
                 stderr.write(f"Error: metrics failed: {resp}\n")
@@ -475,8 +496,9 @@ def client_main(cmd: str, argv: list[str], stdout=None,
                 stderr.write(f"{_CLIENT_USAGE}\nError: inspect needs "
                              "exactly one JOB_ID\n")
                 return EXIT_USAGE
-            with ServiceClient(sock,
-                               trace_id=opts.get("trace_id")) as c:
+            with ServiceClient(
+                    sock, trace_id=opts.get("trace_id"),
+                    client_token=opts.get("client_token")) as c:
                 resp = c.inspect(job_argv[0])
             if not resp.get("ok"):
                 stderr.write(f"Error: inspect failed "
@@ -492,8 +514,9 @@ def client_main(cmd: str, argv: list[str], stdout=None,
             stdout.write("\n")
             return 0
         if cmd == "svc-stats":
-            with ServiceClient(sock,
-                               trace_id=opts.get("trace_id")) as c:
+            with ServiceClient(
+                    sock, trace_id=opts.get("trace_id"),
+                    client_token=opts.get("client_token")) as c:
                 if opts.get("drain"):
                     resp = c.drain()
                     if not resp.get("ok"):
@@ -526,8 +549,9 @@ def client_main(cmd: str, argv: list[str], stdout=None,
                        iter(lambda: buf.read1(1 << 16), b""))
             else:
                 src = iter(sys.stdin.readline, "")
-            with ServiceClient(sock,
-                               trace_id=opts.get("trace_id")) as c:
+            with ServiceClient(
+                    sock, trace_id=opts.get("trace_id"),
+                    client_token=opts.get("client_token")) as c:
                 t0 = tracer.now() if tracer is not None else 0.0
                 resp = c.stream(job_argv, src,
                                 client=opts.get("client"),
@@ -561,8 +585,9 @@ def client_main(cmd: str, argv: list[str], stdout=None,
                              f"value: {val}\n")
                 return EXIT_USAGE
             retries = int(val)
-        with ServiceClient(sock,
-                           trace_id=opts.get("trace_id")) as c:
+        with ServiceClient(
+                sock, trace_id=opts.get("trace_id"),
+                client_token=opts.get("client_token")) as c:
             for attempt in range(retries + 1):
                 t0 = tracer.now() if tracer is not None else 0.0
                 resp = c.submit(job_argv, client=opts.get("client"),

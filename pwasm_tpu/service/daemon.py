@@ -55,18 +55,50 @@ from pwasm_tpu.service.queue import (JOB_CANCELLED, JOB_DONE, JOB_FAILED,
                                      ServiceStats, StreamBook)
 
 _SERVE_USAGE = """Usage:
- pwasm-tpu serve --socket=PATH [--max-queue=N] [--max-queue-total=N]
+ pwasm-tpu serve --socket=PATH [--listen=HOST:PORT]
+                 [--max-queue=N] [--max-queue-total=N]
                  [--max-concurrent=N] [--priority-lanes=hi,lo]
                  [--devices-per-job=N] [--lanes=N]
-                 [--journal=PATH|off] [--spool-threshold-bytes=N]
+                 [--journal=PATH|off] [--journal-dir=DIR]
+                 [--spool-threshold-bytes=N]
                  [--spool-dir=DIR] [--stream-buffer=N]
                  [--stream-idle-s=S]
+                 [--compile-cache-dir=DIR] [--warmup[=tpu|cpu]]
                  [--max-frame-bytes=N] [--metrics-textfile=PATH]
                  [--log-json=FILE] [--log-json-max-bytes=N]
                  [--trace-json=FILE]
                  [--result-ttl-s=S] [--max-results=N]
 
    --socket=PATH        unix socket to listen on (required)
+   --listen=HOST:PORT   ALSO serve the same protocol over TCP (the
+                        fleet transport, docs/FLEET.md; port 0 = any
+                        free port).  TCP peers have no SO_PEERCRED,
+                        so their fair-share identity is the explicit
+                        client_token frame field (`submit
+                        --client-token=TOK` buckets as tok:TOK);
+                        untokened TCP clients share the anonymous
+                        bucket
+   --journal-dir=DIR    place the job journal (and, unless --spool-dir
+                        says otherwise, the result spool) under DIR as
+                        <member-name>.journal instead of next to the
+                        socket — point it at shared durable storage
+                        and a fleet router (`pwasm-tpu route
+                        --journal-dir=DIR`) can read a dead member's
+                        journal to fail its jobs over; leave it unset
+                        for fast local disk (same-host routers still
+                        find <socket>.journal).  docs/FLEET.md
+   --compile-cache-dir=DIR  persistent XLA compilation cache (via the
+                        jaxcompat shim) for every job this daemon
+                        runs: a restarted or newly joined fleet
+                        member loads compiled programs from DIR
+                        instead of paying lane 1's compile wall again
+   --warmup[=tpu|cpu]   ahead-of-time warmup at daemon start (default
+                        tpu): a tiny synthetic job runs through the
+                        normal supervised path on a free lane,
+                        paying the backend probe, the jax import and
+                        the pow2-bucket program compiles BEFORE the
+                        first real job arrives (and populating
+                        --compile-cache-dir when set)
    --max-queue=N        admission control: PER-CLIENT queued-job
                         quota (client = socket-peer uid, or the
                         submit frame's client= field), beyond which
@@ -202,6 +234,9 @@ class WarmContext:
         self.monitor = None
         self.supervisor_state: dict | None = None
         self.host_pool = None
+        self.compile_cache_dir: str | None = None  # persistent XLA
+        #   compilation cache dir every job arms before its first
+        #   device compile (serve --compile-cache-dir)
         self.lock = threading.Lock()
 
     def host_executor(self):
@@ -258,6 +293,10 @@ class _JobWarm:
         self.flight = flight
 
     @property
+    def compile_cache_dir(self):
+        return self._shared.compile_cache_dir
+
+    @property
     def monitor(self):
         return self.lease.monitor
 
@@ -296,8 +335,18 @@ class Daemon:
                  stream_buffer: int = 512,
                  stream_idle_s: float | None = 300.0,
                  log_json_max_bytes: int | None = None,
-                 trace_json: str | None = None):
+                 trace_json: str | None = None,
+                 listen: str | None = None,
+                 journal_dir: str | None = None,
+                 compile_cache_dir: str | None = None,
+                 warmup: str | None = None):
         self.socket_path = socket_path
+        # fleet transport (docs/FLEET.md): an optional TCP listener
+        # joining the unix socket — same protocol, token-based client
+        # identity (no SO_PEERCRED on AF_INET)
+        self.listen = listen
+        self.tcp_port: int | None = None   # actual port after bind
+        self.warmup = warmup
         self._t0_mono = time.monotonic()   # uptime origin (the lane
         #   busy-fraction gauges divide by it)
         self.max_concurrent = max(1, int(max_concurrent))
@@ -326,19 +375,37 @@ class Daemon:
         # admission/start/finish/cancel/evict is an fsync'd NDJSON
         # record (service/journal.py), replayed at the next start on
         # this socket so a kill -9 loses no acked job.
+        # --journal-dir is the fleet placement-policy knob: shared
+        # durable storage a router can read after this process dies
+        # vs the fast-local-disk default next to the socket.  The path
+        # arithmetic lives in fleet/transport.py so `serve` and
+        # `route` cannot disagree about where a member journals.
         if journal_path == "auto":
-            journal_path = socket_path + ".journal"
+            from pwasm_tpu.fleet.transport import member_journal_path
+            journal_path = member_journal_path(socket_path,
+                                               journal_dir)
         self.journal = JobJournal(journal_path) if journal_path \
             else None
         self._journal_warned = False
         # ---- disk-spooled results (ISSUE 9): past the threshold a
         # finished job's stats/stderr move to <spool_dir>/<id>.result
         # (fsio-atomic, CRC'd like ckpt v2) and RAM keeps an index row
+        if spool_dir is None and journal_dir is not None:
+            # one placement knob moves BOTH durable surfaces: spool
+            # files ride journal finish records, so a router serving a
+            # dead member's results needs them on the same storage
+            from pwasm_tpu.fleet.transport import target_name
+            spool_dir = os.path.join(
+                journal_dir, target_name(socket_path) + ".spool")
         if spool_dir is not None and spool_threshold_bytes is None:
             spool_threshold_bytes = 65536
         self.spool_threshold_bytes = spool_threshold_bytes
         self.spool_dir = spool_dir if spool_dir is not None \
             else socket_path + ".spool"
+        # persistent XLA compilation cache (ROADMAP item 2b): carried
+        # on the warm context so every job's device path arms it (via
+        # the jaxcompat shim) before its first compile
+        self.compile_cache_dir = compile_cache_dir
         self._spool_bytes = 0
         # ---- streaming ingestion (ISSUE 10): per-stream buffer
         # quotas + fair-share arbitration; stream jobs are otherwise
@@ -351,6 +418,7 @@ class Daemon:
         self.jobs: dict[str, Job] = {}
         self.stats = ServiceStats()
         self.warm = WarmContext()
+        self.warm.compile_cache_dir = compile_cache_dir
         self.drain = SignalDrain(stderr=self.stderr)
         self._lock = threading.Lock()
         self._running: dict[str, Job] = {}
@@ -446,7 +514,29 @@ class Daemon:
                 f"Error: cannot bind service socket "
                 f"{self.socket_path}: {e}\n")
         sock.listen(16)
-        sock.settimeout(0.2)
+        listeners: list[socket.socket] = [sock]
+        if self.listen:
+            # the TCP transport (fleet federation): same protocol,
+            # same dispatch — only the peer-identity source differs
+            from pwasm_tpu.fleet.transport import make_tcp_listener
+            try:
+                tsock = make_tcp_listener(self.listen)
+            except (OSError, ValueError) as e:
+                sock.close()
+                try:
+                    os.unlink(self.socket_path)
+                except OSError:
+                    pass
+                raise PwasmError(
+                    f"Error: cannot bind --listen={self.listen}: "
+                    f"{e}\n")
+            self.tcp_port = tsock.getsockname()[1]
+            listeners.append(tsock)
+        import selectors
+        sel = selectors.DefaultSelector()
+        for l in listeners:
+            l.setblocking(False)
+            sel.register(l, selectors.EVENT_READ)
         self._jobdir = tempfile.TemporaryDirectory(prefix="pwasm_svc_")
         if self.journal is not None:
             # replay BEFORE workers start and BEFORE the first accept:
@@ -489,8 +579,10 @@ class Daemon:
         with self.drain:     # signal handlers (main thread only)
             for w in workers:
                 w.start()
-            self._say(f"serving on {self.socket_path} "
-                      f"(max-queue {self.queue.max_queue}, "
+            self._say(f"serving on {self.socket_path}"
+                      + (f" + tcp {self.listen.rsplit(':', 1)[0]}:"
+                         f"{self.tcp_port}" if self.listen else "")
+                      + f" (max-queue {self.queue.max_queue}, "
                       f"max-concurrent {self.max_concurrent}, "
                       f"lanes {self.leases.n_lanes}"
                       + (f" x {self.devices_per_job} device(s)"
@@ -501,6 +593,14 @@ class Daemon:
                            lanes=self.leases.n_lanes,
                            devices_per_job=self.devices_per_job)
             self._write_textfile()   # scrapers see a file immediately
+            if self.warmup:
+                # ahead-of-time shape warmup (ROADMAP item 2b): a tiny
+                # synthetic job through the NORMAL supervised path on a
+                # free lane, so the backend probe + jax import + the
+                # pow2-bucket compiles are paid before the first real
+                # job — in the background, admission is already open
+                threading.Thread(target=self._run_warmup, daemon=True,
+                                 name="pwasm-svc-warmup").start()
             try:
                 while True:
                     self._evict_results()
@@ -516,20 +616,29 @@ class Daemon:
                             elif time.monotonic() - drained_at > 0.5:
                                 break
                     try:
-                        conn, _ = sock.accept()
-                    except socket.timeout:
-                        continue
+                        events = sel.select(0.2)
                     except OSError:
                         break
-                    t = threading.Thread(target=self._handle_conn,
-                                         args=(conn,), daemon=True)
-                    t.start()
+                    if not events:
+                        continue
+                    for key, _mask in events:
+                        try:
+                            conn, _ = key.fileobj.accept()
+                        except OSError:
+                            continue
+                        conn.setblocking(True)
+                        t = threading.Thread(
+                            target=self._handle_conn,
+                            args=(conn,), daemon=True)
+                        t.start()
             finally:
                 self._closing.set()
                 for w in workers:
                     w.join(timeout=5.0)
                 self.warm.close()
-                sock.close()
+                sel.close()
+                for l in listeners:
+                    l.close()
                 try:
                     os.unlink(self.socket_path)
                 except OSError:
@@ -897,20 +1006,7 @@ class Daemon:
         verification is reported unreadable, never served as if
         whole).  The payload dict carries stats, stderr_tail, and —
         since ISSUE 11 — the job's trace_id and flight record."""
-        import json
-
-        from pwasm_tpu.utils.fsio import payload_crc
-        try:
-            with open(job.spool["path"], encoding="utf-8") as f:
-                obj = json.load(f)
-            if not isinstance(obj, dict):
-                raise ValueError("not an object")
-            crc = int(obj.pop("crc"))
-            if payload_crc(obj) != crc:
-                raise ValueError("spool payload CRC mismatch")
-            return obj, None
-        except (OSError, ValueError, KeyError, TypeError) as e:
-            return None, f"spooled result unreadable ({e})"
+        return load_spool_payload(job.spool["path"])
 
     def _unlink_spool(self, job: Job) -> None:
         if job.spool is None:
@@ -1059,6 +1155,48 @@ class Daemon:
                     self._running.pop(job.id, None)
                 self._retire_stream(job)
                 job.done.set()
+
+    def _run_warmup(self) -> None:
+        """``--warmup``: one tiny deterministic job through the normal
+        supervised path (``cli.warmup_files`` corpus) on a free lane —
+        the jax import, the backend probe and the smallest pow2-bucket
+        program compiles are paid NOW, in the background, instead of
+        under the first real job; with ``--compile-cache-dir`` the
+        compiles also persist for the next restart.  Best-effort: a
+        failed warmup costs a warning, never the daemon."""
+        import io
+        t0 = time.monotonic()
+        lease = self.leases.acquire(
+            should_abort=lambda: (self._closing.is_set()
+                                  or self.drain.requested))
+        if lease is None:
+            return
+        try:
+            from pwasm_tpu.cli import warmup_files
+            wdir = os.path.join(self._jobdir.name, "warmup")
+            paf, fa = warmup_files(wdir)
+            out = os.path.join(wdir, "warm.dfa")
+            device = self.warmup if self.warmup in ("cpu", "tpu") \
+                else "tpu"
+            argv = [paf, "-r", fa, "-o", out, f"--device={device}",
+                    "--batch=8"]
+            drain = SignalDrain(stderr=self.stderr,
+                                hard_exit=lambda code: None)
+            warm = _JobWarm(self.warm, drain, lease,
+                            expose_devices=self._expose_devices)
+            self.obs.event("warmup_start", device=device,
+                           lane=lease.lane)
+            rc = self._runner(argv, stdout=io.StringIO(),
+                              stderr=io.StringIO(), warm=warm)
+            wall = round(time.monotonic() - t0, 3)
+            self.obs.event("warmup_done", rc=rc, wall_s=wall,
+                           lane=lease.lane)
+            self._say(f"warmup ({device}) done in {wall}s (rc {rc})")
+        except BaseException as e:   # never take the daemon down
+            self._say(f"warning: warmup failed "
+                      f"({type(e).__name__}: {e})")
+        finally:
+            self.leases.release(lease)
 
     def _retire_stream(self, job: Job) -> None:
         """A stream job leaving the live set: drop it from the quota
@@ -1378,50 +1516,14 @@ class Daemon:
 
     # ---- protocol ------------------------------------------------------
     def _handle_conn(self, conn: socket.socket) -> None:
-        rfile = conn.makefile("rb")
-        wfile = conn.makefile("wb")
-        peer = _peer_identity(conn)
-        try:
-            while True:
-                try:
-                    req = protocol.read_frame(rfile,
-                                              self.max_frame_bytes)
-                except protocol.FrameError as e:
-                    protocol.write_frame(
-                        wfile, protocol.err(e.code, str(e)))
-                    if e.fatal:
-                        return
-                    continue
-                if req is None:
-                    return
-                try:
-                    resp = self._dispatch(req, peer=peer)
-                except Exception as e:
-                    # client-controlled field TYPES can reach stdlib
-                    # calls (a string `timeout` into Event.wait, an
-                    # unhashable job_id into a dict lookup): a bad
-                    # request must cost the CLIENT an error frame,
-                    # never the daemon a dead connection thread
-                    resp = protocol.err(
-                        protocol.ERR_BAD_REQUEST,
-                        f"{type(e).__name__}: {e}")
-                protocol.write_frame(wfile, resp)
-        except (BrokenPipeError, ConnectionResetError, OSError,
-                ValueError):
-            # the peer went away (possibly mid-result): their problem,
-            # never the daemon's — the job keeps running and the next
-            # connection can fetch the result
-            pass
-        finally:
-            for f in (rfile, wfile):
-                try:
-                    f.close()
-                except OSError:
-                    pass
-            try:
-                conn.close()
-            except OSError:
-                pass
+        protocol.serve_connection(conn, self._dispatch,
+                                  peer=_peer_identity(conn),
+                                  max_frame_bytes=self.max_frame_bytes)
+
+    def _resolve_client(self, req: dict, peer: str | None) -> str:
+        """protocol.resolve_client_identity — shared with the fleet
+        router so the two bucketings cannot drift."""
+        return protocol.resolve_client_identity(req, peer)
 
     def _dispatch(self, req: dict, peer: str | None = None) -> dict:
         cmd = req.get("cmd")
@@ -1435,10 +1537,7 @@ class Daemon:
                 protocol_version=protocol.PROTOCOL_VERSION,
                 draining=self._draining)
         if cmd == "submit":
-            client = req.get("client")
-            if client is None:
-                # default identity: the unix-socket peer uid (ucred)
-                client = peer or ""
+            client = self._resolve_client(req, peer)
             try:
                 job = self.submit(req.get("args"),
                                   cwd=req.get("cwd"),
@@ -1475,9 +1574,7 @@ class Daemon:
             # records will arrive as stream-data frames — the
             # minimap2-pipe-over-the-socket shape.  Admission control
             # is the same per-client fair-share gate as submit.
-            client = req.get("client")
-            if client is None:
-                client = peer or ""
+            client = self._resolve_client(req, peer)
             try:
                 job = self.submit(req.get("args"),
                                   cwd=req.get("cwd"),
@@ -1745,6 +1842,28 @@ class Daemon:
         return protocol.ok(state="cancelling", was="running")
 
 
+def load_spool_payload(path: str):
+    """(payload, error) from a spooled-result file, CRC-verified (the
+    ckpt-v2 rule: a torn or rotted spool is reported unreadable, never
+    served as if whole).  Module-level because the fleet router reads
+    a DEAD member's spool files during journal-aware failover — same
+    verification, different process."""
+    import json
+
+    from pwasm_tpu.utils.fsio import payload_crc
+    try:
+        with open(path, encoding="utf-8") as f:
+            obj = json.load(f)
+        if not isinstance(obj, dict):
+            raise ValueError("not an object")
+        crc = int(obj.pop("crc"))
+        if payload_crc(obj) != crc:
+            raise ValueError("spool payload CRC mismatch")
+        return obj, None
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        return None, f"spooled result unreadable ({e})"
+
+
 # the argv slots that hold PATHS, resolved against the client's cwd:
 # short value flags (from cli._VALUE_FLAGS; -c is clipmax, -d/-p/-m are
 # the reference's parsed-but-unread quirks), --long=FILE options, and
@@ -1825,6 +1944,11 @@ def _peer_identity(conn: socket.socket) -> str | None:
     peercred = getattr(socket, "SO_PEERCRED", None)
     if peercred is None:
         return None
+    if conn.family != socket.AF_UNIX:
+        # a TCP peer has no kernel credential (Linux answers uid -1
+        # rather than failing): identity there is the explicit
+        # client_token, never a fake attestation
+        return None
     try:
         import struct
         raw = conn.getsockopt(socket.SOL_SOCKET, peercred,
@@ -1855,6 +1979,8 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
         if a.startswith("--") and "=" in a:
             k, v = a[2:].split("=", 1)
             opts[k] = v
+        elif a == "--warmup":
+            opts["warmup"] = "tpu"   # bare form: warm the device path
         elif a in ("-h", "--help"):
             stderr.write(_SERVE_USAGE)
             return EXIT_USAGE
@@ -1890,6 +2016,40 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
             and not journal_path.strip():
         stderr.write(f"{_SERVE_USAGE}\nInvalid --journal value\n")
         return EXIT_USAGE
+    listen = opts.pop("listen", None)
+    if listen is not None:
+        from pwasm_tpu.fleet.transport import is_tcp_target
+        if not is_tcp_target(listen):
+            stderr.write(f"{_SERVE_USAGE}\nInvalid --listen value: "
+                         f"{listen} (HOST:PORT)\n")
+            return EXIT_USAGE
+    journal_dir = opts.pop("journal-dir", None)
+    if journal_dir is not None and not journal_dir.strip():
+        stderr.write(f"{_SERVE_USAGE}\nInvalid --journal-dir value\n")
+        return EXIT_USAGE
+    if journal_dir is not None and journal_path != "auto":
+        # an explicit --journal=PATH would silently defeat the shared
+        # placement a router's --journal-dir computes (it would look
+        # for DIR/<member-name>.journal the member never writes, and
+        # failover would lose every journal verdict) — refuse the
+        # half-applied combination
+        stderr.write(f"{_SERVE_USAGE}\nError: --journal-dir and an "
+                     "explicit --journal are mutually exclusive "
+                     "(the dir DERIVES the journal path so the "
+                     "fleet router can find it)\n")
+        return EXIT_USAGE
+    compile_cache_dir = opts.pop("compile-cache-dir", None)
+    if compile_cache_dir is not None and not compile_cache_dir.strip():
+        stderr.write(f"{_SERVE_USAGE}\nInvalid --compile-cache-dir "
+                     "value\n")
+        return EXIT_USAGE
+    warmup = None
+    if "warmup" in opts:
+        warmup = opts.pop("warmup")
+        if warmup not in ("tpu", "cpu"):
+            stderr.write(f"{_SERVE_USAGE}\nInvalid --warmup value: "
+                         f"{warmup} (tpu or cpu)\n")
+            return EXIT_USAGE
     spool_dir = opts.pop("spool-dir", None)
     if spool_dir is not None and not spool_dir.strip():
         stderr.write(f"{_SERVE_USAGE}\nInvalid --spool-dir value\n")
@@ -1968,7 +2128,10 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
                         stream_buffer=nums["stream-buffer"],
                         stream_idle_s=stream_idle_s,
                         log_json_max_bytes=nums["log-json-max-bytes"],
-                        trace_json=trace_json)
+                        trace_json=trace_json,
+                        listen=listen, journal_dir=journal_dir,
+                        compile_cache_dir=compile_cache_dir,
+                        warmup=warmup)
     except OSError:
         stderr.write(f"Cannot open file {log_json} for writing!\n")
         return EXIT_USAGE

@@ -78,6 +78,15 @@ frame.  The ``submit``/``stream`` handlers stamp it onto the admitted
 job — journal record, event-log lines, flight record, both sides'
 Chrome traces — and echo it in the ok frame; a frame without one gets
 a daemon-minted id, so every job is trace-correlatable either way.
+
+Transports and identity (ISSUE 13, docs/FLEET.md): the same frames
+run over the unix socket and over TCP (``serve --listen=HOST:PORT``,
+``route``).  A frame MAY carry a ``client_token`` field: on TCP —
+where no kernel-attested ``SO_PEERCRED`` identity exists — the
+daemon buckets the submit under ``tok:<token>`` for fair share, so
+identities stay attested-or-explicit on both transports (an explicit
+``client`` field still wins; an untokened TCP frame shares the
+anonymous bucket).
 """
 
 from __future__ import annotations
@@ -113,6 +122,23 @@ class FrameError(Exception):
         self.fatal = fatal
 
 
+def resolve_client_identity(req: dict, peer: str | None) -> str:
+    """The fair-share identity resolution order, attested-or-explicit
+    on BOTH transports (one function shared by the serve daemon and
+    the fleet router, so their quota/DRR bucketing can never drift):
+    an explicit ``client`` field wins; else a ``client_token`` frame
+    field buckets as ``tok:<token>`` (the TCP identity — AF_INET has
+    no SO_PEERCRED); else the kernel-attested unix peer uid; else the
+    anonymous bucket."""
+    client = req.get("client")
+    if client is not None:
+        return client
+    tok = req.get("client_token")
+    if isinstance(tok, str) and tok:
+        return "tok:" + tok
+    return peer or ""
+
+
 def ok(**fields) -> dict:
     out = {"ok": True}
     out.update(fields)
@@ -123,6 +149,51 @@ def err(code: str, detail: str = "", **fields) -> dict:
     out = {"ok": False, "error": code, "detail": detail}
     out.update(fields)
     return out
+
+
+def serve_connection(conn, dispatch, peer: str | None = None,
+                     max_frame_bytes: int = MAX_FRAME_BYTES) -> None:
+    """The per-connection frame-serving loop shared by the serve
+    daemon and the fleet router (one implementation, so a protocol-
+    loop fix cannot land in only one of them): read frames until EOF,
+    answer recoverable frame errors in-band, close on fatal ones, and
+    turn any ``dispatch(req, peer)`` exception into a ``bad_request``
+    frame — client-controlled field types must cost the CLIENT an
+    error frame, never the server a dead connection thread.  Peer
+    disconnects (possibly mid-result) are swallowed: their problem,
+    never the server's."""
+    rfile = conn.makefile("rb")
+    wfile = conn.makefile("wb")
+    try:
+        while True:
+            try:
+                req = read_frame(rfile, max_frame_bytes)
+            except FrameError as e:
+                write_frame(wfile, err(e.code, str(e)))
+                if e.fatal:
+                    return
+                continue
+            if req is None:
+                return
+            try:
+                resp = dispatch(req, peer=peer)
+            except Exception as e:
+                resp = err(ERR_BAD_REQUEST,
+                           f"{type(e).__name__}: {e}")
+            write_frame(wfile, resp)
+    except (BrokenPipeError, ConnectionResetError, OSError,
+            ValueError):
+        pass
+    finally:
+        for f in (rfile, wfile):
+            try:
+                f.close()
+            except OSError:
+                pass
+        try:
+            conn.close()
+        except OSError:
+            pass
 
 
 def write_frame(wfile, obj: dict) -> None:

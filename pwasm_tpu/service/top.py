@@ -24,9 +24,13 @@ import time
 from pwasm_tpu.core.errors import EXIT_FATAL, EXIT_USAGE
 
 _TOP_USAGE = """Usage:
- pwasm-tpu top --socket=PATH [--interval=S] [--once]
+ pwasm-tpu top --socket=TARGET [--interval=S] [--once]
 
-   --socket=PATH   the serve daemon's unix socket (required)
+   --socket=TARGET the serve daemon's unix socket, a HOST:PORT TCP
+                   endpoint, or a fleet router (`pwasm-tpu route`) —
+                   against a router the view is fleet-aware: member
+                   liveness/load rows ride above the aggregated
+                   queue/stream/job sections (docs/FLEET.md)
    --interval=S    refresh period in seconds (default 2)
    --once          render one frame and exit (no screen clearing)
 
@@ -47,10 +51,37 @@ def render(st: dict) -> str:
     daemon's stats must still display)."""
     out: list[str] = []
     jobs = st.get("jobs") or {}
+    fleet = st.get("fleet") or {}
     out.append(
-        f"pwasm-tpu top — uptime {st.get('uptime_s', 0):.0f}s"
+        ("pwasm-tpu top (FLEET)" if fleet else "pwasm-tpu top")
+        + f" — uptime {st.get('uptime_s', 0):.0f}s"
         + ("  [DRAINING]" if st.get("draining") else "")
         + f"  breaker {_fmt_breaker(st.get('breaker_state', 0))}")
+    if fleet:
+        # fleet-aware view (the `route` daemon's aggregated stats):
+        # one row per member daemon, liveness first — "is anything
+        # down" is the fleet operator's question zero
+        members = fleet.get("members") or []
+        out.append(
+            f" fleet: {fleet.get('alive', 0)}/{len(members)} members "
+            f"up | routed {fleet.get('jobs_routed', 0)}  live "
+            f"{fleet.get('live_jobs', 0)}  failovers "
+            f"{fleet.get('failovers', 0)}")
+        out.append(" MEMBER                 STATE  DEPTH  RUN  ROUTED")
+        for row in members:
+            alive = row.get("alive")
+            out.append(
+                f"   {str(row.get('name', '?')):<20} "
+                + f"{'up' if alive else 'DOWN':>5}  "
+                + (f"{row.get('queue_depth', 0) or 0:>5}  "
+                   f"{row.get('running', 0) or 0:>3}  "
+                   if alive else "    -    -  ")
+                + f"{row.get('jobs_routed', 0):>6}")
+        rec = fleet.get("jobs_recovered") or {}
+        recovered = {k: v for k, v in sorted(rec.items()) if v}
+        if recovered:
+            out.append(" recovered: " + "  ".join(
+                f"{k} {v}" for k, v in recovered.items()))
     out.append(
         f" jobs: {st.get('running', 0)} running, "
         f"{st.get('queue_depth', 0)} queued | "

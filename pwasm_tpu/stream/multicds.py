@@ -201,7 +201,13 @@ def many2many_main(opts: dict, positional: list, stdout, stderr,
         pin_cpu_platform()
     else:
         from pwasm_tpu.ops import enable_compilation_cache
-        enable_compilation_cache()
+        # flag first (a cold --many2many run), warm-context second
+        # (a served job under `serve --compile-cache-dir`)
+        cache_dir = opts.get("compile-cache-dir")
+        if not isinstance(cache_dir, str) or not cache_dir:
+            cache_dir = getattr(warm, "compile_cache_dir", None) \
+                if warm is not None else None
+        enable_compilation_cache(cache_dir)
 
     from pwasm_tpu.resilience import BatchSupervisor, ResiliencePolicy
     supervisor = BatchSupervisor(

@@ -73,6 +73,37 @@ def pcast(x, axis_name, to: str = "varying"):
     return x
 
 
+def enable_compilation_cache(path: str) -> bool:
+    """Point JAX's persistent compilation cache at ``path`` and widen
+    it to cache EVERY program (min-compile-time/min-entry-size floors
+    zeroed — the repeated-invocation CLI pattern amortizes even small
+    programs).  The three config keys have moved/appeared across jax
+    pins, so each update is tolerated independently; returns whether
+    the directory knob itself took (the others are refinements).
+    Lives here so the rest of the repo never touches the
+    ``jax.config`` persistent-cache surface directly — the next key
+    rename costs one edit in this shim."""
+    import os
+
+    import jax
+
+    ok = False
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError:
+        return False
+    for key, val in (
+            ("jax_compilation_cache_dir", path),
+            ("jax_persistent_cache_min_compile_time_secs", 0.0),
+            ("jax_persistent_cache_min_entry_size_bytes", 0)):
+        try:
+            jax.config.update(key, val)
+            ok = ok or key == "jax_compilation_cache_dir"
+        except Exception:
+            pass
+    return ok
+
+
 def pin_cpu_platform() -> None:
     """Pin jax to the CPU backend before its first init — a
     ``--device=cpu`` job must never touch a (possibly unhealthy) TPU

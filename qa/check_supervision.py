@@ -81,6 +81,12 @@ OBS_DIR = "pwasm_tpu/obs"
 # work is reached only through the supervised many2many site in
 # pwasm_tpu/parallel/ (imported lazily, like cli._main_loop does).
 STREAM_DIR = "pwasm_tpu/stream"
+# pwasm_tpu/fleet/ (ISSUE 13) too: the router and the TCP transport
+# move protocol frames and read journals/spools — a fleet module
+# importing jax would smuggle backend init into a process that must
+# stay device-free by design (the router fronts N daemons that each
+# own their devices).
+FLEET_DIR = "pwasm_tpu/fleet"
 SERVICE_PATTERNS = re.compile(
     r"^\s*(?:import\s+jax\b|from\s+jax[.\s])|jax\.jit|jax\.device_put"
     r"|jax\.device_get|\.block_until_ready\s*\(")
@@ -200,6 +206,14 @@ def find_stream_violations(root: str = REPO) -> list[str]:
     jax-free — device work belongs behind the supervised sites in
     pwasm_tpu/parallel/, reached via lazy imports."""
     return _find_jaxfree_violations(root, STREAM_DIR, "stream")
+
+
+def find_fleet_violations(root: str = REPO) -> list[str]:
+    """Fleet-layer jax use (ISSUE 13): pwasm_tpu/fleet/ must stay
+    jax-free — the router/transport/ledger move frames and files;
+    every device touch in the fleet happens inside a member daemon's
+    cli.run, behind the supervised sites."""
+    return _find_jaxfree_violations(root, FLEET_DIR, "fleet")
 
 
 def find_sharding_violations(root: str = REPO) -> list[str]:
@@ -331,6 +345,7 @@ def main() -> int:
     svc = find_service_violations()
     obs = find_obs_violations()
     stream = find_stream_violations()
+    fleet = find_fleet_violations()
     metric = find_metric_lint()
     doc_drift = find_doc_drift()
     sharding = find_sharding_violations()
@@ -339,7 +354,8 @@ def main() -> int:
     for rel in stale:
         print(f"{rel}: stale registry entry (no device entry points "
               "left — remove it)", file=sys.stderr)
-    for line in svc + obs + stream + metric + doc_drift + sharding:
+    for line in svc + obs + stream + fleet + metric + doc_drift \
+            + sharding:
         print(line, file=sys.stderr)
     if bad:
         print(f"\n{len(bad)} device entry point(s) outside the "
@@ -347,12 +363,13 @@ def main() -> int:
               "through a supervised site (resilience/supervisor.py) or "
               "register the module in qa/check_supervision.py with a "
               "justification.", file=sys.stderr)
-    if svc or obs or stream:
-        print(f"\n{len(svc) + len(obs) + len(stream)} direct jax "
-              "use(s) in pwasm_tpu/service/, pwasm_tpu/obs/ or "
-              "pwasm_tpu/stream/.  These layers reach the device "
-              "only through supervised sites — move the device work "
-              "there.", file=sys.stderr)
+    if svc or obs or stream or fleet:
+        print(f"\n{len(svc) + len(obs) + len(stream) + len(fleet)} "
+              "direct jax use(s) in pwasm_tpu/service/, "
+              "pwasm_tpu/obs/, pwasm_tpu/stream/ or pwasm_tpu/fleet/."
+              "  These layers reach the device only through "
+              "supervised sites — move the device work there.",
+              file=sys.stderr)
     if metric:
         print(f"\n{len(metric)} metric-name lint failure(s): all "
               "registrations live in pwasm_tpu/obs/catalog.py with "
@@ -368,8 +385,8 @@ def main() -> int:
               f"use(s): import shard_map/psum/ppermute/pcast from "
               f"{JAXCOMPAT} instead, so a jax pin change costs one "
               "edit there.", file=sys.stderr)
-    return 1 if (bad or stale or svc or obs or stream or metric
-                 or doc_drift or sharding) else 0
+    return 1 if (bad or stale or svc or obs or stream or fleet
+                 or metric or doc_drift or sharding) else 0
 
 
 if __name__ == "__main__":

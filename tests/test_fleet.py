@@ -1,0 +1,1004 @@
+"""Fleet federation (ISSUE 13): TCP transport, token identity, the
+``route`` daemon, and journal-aware failover.
+
+Acceptance contracts:
+
+- **one protocol, two transports**: ``serve --listen=HOST:PORT``
+  answers the same NDJSON protocol as the unix socket, with client
+  identity attested-or-explicit on both — ``SO_PEERCRED`` uid on unix,
+  ``tok:<client-token>`` on TCP, anonymous otherwise;
+- **one submit surface**: the router exposes submit/stream/result/
+  cancel/status/inspect/stats/metrics/drain over N member daemons,
+  with least-loaded placement, member-queue_full spillover to
+  siblings, and a fleet-wide per-client quota no member-spraying can
+  dodge;
+- **the kill-one-of-three drill**: SIGKILL a member mid-job behind
+  the router → its jobs resume on a sibling and every report is
+  byte-identical to an uncrashed fleet, with the job's trace_id
+  intact end-to-end (trace-merge of client+router+surviving members
+  is one valid timeline);
+- **warm fleet members**: ``serve --warmup --compile-cache-dir=DIR``
+  pays the backend probe and the pow2-bucket compiles at daemon
+  start, so the first real job runs probe-free and a restarted member
+  finds its programs on disk.
+"""
+
+import io
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from contextlib import contextmanager
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from pwasm_tpu.core.fasta import write_fasta
+from pwasm_tpu.fleet import transport
+from pwasm_tpu.fleet.ledger import FleetLedger
+from pwasm_tpu.fleet.router import Router, route_main
+from pwasm_tpu.service.client import (ServiceClient, ServiceError,
+                                      wait_for_socket)
+from pwasm_tpu.service.daemon import Daemon, serve_main
+from pwasm_tpu.service.queue import QueueFull
+from pwasm_tpu.service.top import render
+
+from helpers import make_paf_line
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SLOW = "--inject-faults=seed=1,rate=1,kinds=hang,hang_s=0.25"
+
+
+# ---------------------------------------------------------------------------
+# transport units
+# ---------------------------------------------------------------------------
+def test_target_grammar():
+    assert transport.is_tcp_target("localhost:9211")
+    assert transport.is_tcp_target("10.0.0.7:1")
+    assert not transport.is_tcp_target("/tmp/a.sock")
+    assert not transport.is_tcp_target("a.sock")       # no port
+    assert not transport.is_tcp_target("host:port")    # non-numeric
+    assert not transport.is_tcp_target("a/b:9211")     # path-ish
+    assert not transport.is_tcp_target("")
+    assert transport.split_hostport("h:80") == ("h", 80)
+    with pytest.raises(ValueError):
+        transport.split_hostport("h:99999")            # port > 65535
+    with pytest.raises(ValueError):
+        transport.split_hostport("/tmp/a.sock")
+
+
+def test_target_names_and_journal_placement(tmp_path):
+    assert transport.target_name("/var/run/m0.sock") == "m0.sock"
+    assert transport.target_name("node7:9211") == "node7_9211"
+    # per-daemon (fast local disk): next to the socket; TCP targets
+    # are unreachable without shared storage
+    assert transport.member_journal_path("/tmp/a.sock", None) \
+        == "/tmp/a.sock.journal"
+    assert transport.member_journal_path("h:9211", None) is None
+    # shared --journal-dir: the SAME arithmetic serves both sides
+    shared = str(tmp_path / "shared")
+    assert transport.member_journal_path("/tmp/a.sock", shared) \
+        == os.path.join(shared, "a.sock.journal")
+    assert transport.member_journal_path("h:9211", shared) \
+        == os.path.join(shared, "h_9211.journal")
+
+
+# ---------------------------------------------------------------------------
+# ledger units
+# ---------------------------------------------------------------------------
+def test_ledger_quota_move_retire():
+    led = FleetLedger(max_queue=2, max_total=3)
+    led.admit("a", "m0")
+    led.admit("a", "m1")
+    with pytest.raises(QueueFull) as ei:
+        led.admit("a", "m0")            # per-client fleet quota
+    assert "FLEET" in str(ei.value)
+    led.admit("b", "m0")
+    with pytest.raises(QueueFull):
+        led.admit("c", "m0")            # fleet total backstop
+    assert led.client_depths() == {"a": 2, "b": 1}
+    assert led.member_pressure("m0") == 2
+    led.move("a", "m1", "m0")           # failover re-placement
+    assert led.member_pressure("m0") == 3
+    assert led.client_depths()["a"] == 2   # quota unchanged by a move
+    led.retire("a", "m0")
+    led.retire("a", "m0")
+    led.retire("b", "m0")
+    assert led.client_depths() == {}
+    assert led.member_pressure("m0") == 0
+    led.admit("c", "m0")                # slots freed
+
+
+# ---------------------------------------------------------------------------
+# in-process harness (stub runner: no jax, no corpus)
+# ---------------------------------------------------------------------------
+def _stub_runner(log=None, sleep=0.0, rc=0):
+    def runner(argv, stdout=None, stderr=None, warm=None, **kw):
+        if log is not None:
+            log.append(list(argv))
+        if sleep:
+            time.sleep(sleep)
+        sp = next((a.split("=", 1)[1] for a in argv
+                   if a.startswith("--stats=")), None)
+        if sp:
+            with open(sp, "w") as f:
+                json.dump({"wall_s": sleep}, f)
+        return rc
+    return runner
+
+
+@contextmanager
+def _daemon(runner=None, **kw):
+    sockdir = tempfile.mkdtemp(prefix="pwflt")
+    # unique basename: member names (fleet/transport.target_name) key
+    # on it, and a fleet of members all called "s" would collide
+    sock = os.path.join(sockdir, os.path.basename(sockdir) + ".sock")
+    err = io.StringIO()
+    dm = Daemon(sock, stderr=err, runner=runner, **kw)
+    rcbox: list = []
+    t = threading.Thread(target=lambda: rcbox.append(dm.serve()),
+                         daemon=True)
+    t.start()
+    assert wait_for_socket(sock, 15), err.getvalue()
+    try:
+        yield SimpleNamespace(daemon=dm, sock=sock, rc=rcbox, err=err,
+                              thread=t, dir=sockdir)
+    finally:
+        if not dm.drain.requested:
+            dm.drain.request("test teardown")
+        t.join(20)
+        shutil.rmtree(sockdir, ignore_errors=True)
+
+
+@contextmanager
+def _fleet(n=2, runner=None, router_kw=None, daemon_kw=None):
+    """N in-process member daemons + one in-process router."""
+    with _nested(n, runner, daemon_kw or {}) as members:
+        rdir = tempfile.mkdtemp(prefix="pwrt")
+        rsock = os.path.join(rdir, "router.sock")
+        err = io.StringIO()
+        r = Router([m.sock for m in members], socket_path=rsock,
+                   stderr=err, poll_interval=0.1,
+                   **(router_kw or {}))
+        rcbox: list = []
+        t = threading.Thread(target=lambda: rcbox.append(r.serve()),
+                             daemon=True)
+        t.start()
+        assert wait_for_socket(rsock, 15), err.getvalue()
+        try:
+            yield SimpleNamespace(router=r, sock=rsock,
+                                  members=members, err=err, rc=rcbox)
+        finally:
+            if not r.drain.requested:
+                r.drain.request("test teardown")
+            t.join(20)
+            shutil.rmtree(rdir, ignore_errors=True)
+
+
+@contextmanager
+def _nested(n, runner, daemon_kw):
+    stack = []
+    try:
+        out = []
+        for _ in range(n):
+            cm = _daemon(runner=runner, **daemon_kw)
+            stack.append(cm)
+            out.append(cm.__enter__())
+        yield out
+    finally:
+        for cm in reversed(stack):
+            cm.__exit__(None, None, None)
+
+
+# ---------------------------------------------------------------------------
+# TCP transport + token identity
+# ---------------------------------------------------------------------------
+def test_tcp_listener_and_token_identity(tmp_path):
+    """serve --listen: the same protocol over TCP, with token-based
+    fair-share identity — tok:<token> buckets, anonymous without,
+    SO_PEERCRED untouched on the unix side."""
+    with _daemon(runner=_stub_runner(),
+                 listen="127.0.0.1:0") as h:
+        tcp = f"127.0.0.1:{h.daemon.tcp_port}"
+        out = str(tmp_path / "o.dfa")
+        with ServiceClient(tcp, client_token="alice") as c:
+            assert c.ping()["ok"]
+            r = c.result(c.submit(["in.paf", "-o", out],
+                                  cwd=str(tmp_path))["job_id"],
+                         timeout=30)
+            assert r["rc"] == 0
+            assert r["job"]["client"] == "tok:alice"
+        with ServiceClient(tcp) as c:        # untokened: anonymous
+            r = c.result(c.submit(["in.paf", "-o", out],
+                                  cwd=str(tmp_path))["job_id"],
+                         timeout=30)
+            assert r["job"]["client"] == ""
+        with ServiceClient(h.sock) as c:     # unix: kernel-attested
+            r = c.result(c.submit(["in.paf", "-o", out],
+                                  cwd=str(tmp_path))["job_id"],
+                         timeout=30)
+            assert r["job"]["client"] == f"uid:{os.getuid()}"
+            # an explicit client= still overrides the token default
+        with ServiceClient(tcp, client_token="alice") as c:
+            r = c.result(c.submit(["in.paf", "-o", out],
+                                  cwd=str(tmp_path),
+                                  client="tenant9")["job_id"],
+                         timeout=30)
+            assert r["job"]["client"] == "tenant9"
+
+
+def test_tcp_token_quota_is_per_token(tmp_path):
+    """The DRR quota follows the token: one token at quota answers
+    queue_full naming tok:<name>; another token keeps its own slots."""
+    with _daemon(runner=_stub_runner(sleep=0.5), max_queue=1,
+                 listen="127.0.0.1:0") as h:
+        tcp = f"127.0.0.1:{h.daemon.tcp_port}"
+        out = str(tmp_path / "o.dfa")
+        with ServiceClient(tcp, client_token="heavy") as c:
+            first = c.submit(["in.paf", "-o", out],
+                             cwd=str(tmp_path))
+            assert first["ok"]
+            # keep submitting until the running job has dequeued or
+            # not — at quota 1 the SECOND queued submit must 429
+            rejected = None
+            for _ in range(3):
+                r = c.submit(["in.paf", "-o", out],
+                             cwd=str(tmp_path))
+                if not r.get("ok"):
+                    rejected = r
+                    break
+            assert rejected is not None
+            assert rejected["error"] == "queue_full"
+            assert rejected["client"] == "tok:heavy"
+        with ServiceClient(tcp, client_token="light") as c:
+            assert c.submit(["in.paf", "-o", out],
+                            cwd=str(tmp_path))["ok"]
+
+
+def test_serve_main_validates_fleet_flags(tmp_path):
+    err = io.StringIO()
+    assert serve_main(["--socket=" + str(tmp_path / "s"),
+                       "--listen=nope"], stderr=err) == 1
+    assert "--listen" in err.getvalue()
+    err = io.StringIO()
+    assert serve_main(["--socket=" + str(tmp_path / "s"),
+                       "--warmup=gpu"], stderr=err) == 1
+    assert "--warmup" in err.getvalue()
+    err = io.StringIO()
+    assert serve_main(["--socket=" + str(tmp_path / "s"),
+                       "--journal-dir= "], stderr=err) == 1
+    assert "--journal-dir" in err.getvalue()
+    # an explicit --journal would defeat the shared placement a
+    # router's --journal-dir computes: refuse the combination
+    err = io.StringIO()
+    assert serve_main(["--socket=" + str(tmp_path / "s"),
+                       "--journal-dir=" + str(tmp_path),
+                       "--journal=" + str(tmp_path / "j")],
+                      stderr=err) == 1
+    assert "mutually exclusive" in err.getvalue()
+
+
+def test_route_main_validates_flags(tmp_path):
+    err = io.StringIO()
+    assert route_main([], stderr=err) == 1
+    assert "--backends" in err.getvalue()
+    err = io.StringIO()
+    assert route_main(["--backends=a.sock"], stderr=err) == 1
+    assert "--socket" in err.getvalue()
+    err = io.StringIO()
+    assert route_main(["--backends=a.sock", "--listen=zzz"],
+                      stderr=err) == 1
+    assert "--listen" in err.getvalue()
+    err = io.StringIO()
+    assert route_main(["--backends=/x/m.sock,/y/m.sock",
+                       "--socket=" + str(tmp_path / "r")],
+                      stderr=err) == 1
+    assert "distinct" in err.getvalue()
+    err = io.StringIO()
+    assert route_main(["--backends=a.sock",
+                       "--socket=" + str(tmp_path / "r"),
+                       "--bogus=1"], stderr=err) == 1
+    assert "--bogus" in err.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# router: routing, placement, fair share, aggregation
+# ---------------------------------------------------------------------------
+def test_router_submit_result_status_inspect_cancel(tmp_path):
+    ran: list = []
+    with _fleet(n=2, runner=_stub_runner(log=ran)) as f:
+        out = str(tmp_path / "o.dfa")
+        with ServiceClient(f.sock, trace_id="rt-1") as c:
+            p = c.ping()
+            assert p["router"] and p["members"] == 2
+            sub = c.submit(["in.paf", "-o", out], cwd=str(tmp_path))
+            assert sub["ok"] and sub["job_id"].startswith("fleet-")
+            assert sub["member"] in f.router.members
+            st = c.status(sub["job_id"])
+            assert st["ok"] and st["job"]["id"] == sub["job_id"]
+            r = c.result(sub["job_id"], timeout=30)
+            assert r["rc"] == 0
+            # ids rewritten at the edge: the member's job-NNNN never
+            # leaks, the fleet id and placement do
+            assert r["job"]["id"] == sub["job_id"]
+            assert r["job"]["member"] == sub["member"]
+            assert r["job"]["trace_id"] == "rt-1"
+            ins = c.inspect(sub["job_id"])
+            assert ins["ok"] and ins["job"]["id"] == sub["job_id"]
+            # unknown ids answer unknown_job, not a crash
+            assert c.status("fleet-9999")["error"] == "unknown_job"
+            assert c.cancel(sub["job_id"])["ok"]   # terminal: a no-op
+
+
+def test_router_spreads_by_least_depth(tmp_path):
+    with _fleet(n=3, runner=_stub_runner(sleep=0.3)) as f:
+        out = lambda k: str(tmp_path / f"o{k}.dfa")
+        with ServiceClient(f.sock) as c:
+            jids = [c.submit(["in.paf", "-o", out(k)],
+                             cwd=str(tmp_path))["job_id"]
+                    for k in range(6)]
+            for j in jids:
+                assert c.result(j, timeout=60)["rc"] == 0
+            st = c.stats()["stats"]
+        routed = {m["name"]: m["jobs_routed"]
+                  for m in st["fleet"]["members"]}
+        # 6 jobs over 3 members, least-loaded: every member worked
+        assert sum(routed.values()) == 6
+        assert all(n >= 1 for n in routed.values()), routed
+
+
+def test_router_fleet_quota_and_member_spillover(tmp_path):
+    """The global ledger: a client at the FLEET quota answers
+    queue_full at the router; below it, a member's own queue_full
+    spills the job to a sibling instead of bouncing the client."""
+    with _fleet(n=2, runner=_stub_runner(sleep=0.4),
+                router_kw={"max_queue": 3},
+                daemon_kw={"max_queue": 1}) as f:
+        out = lambda k: str(tmp_path / f"q{k}.dfa")
+        with ServiceClient(f.sock, client_token="t") as c:
+            subs = [c.submit(["in.paf", "-o", out(k)],
+                             cwd=str(tmp_path)) for k in range(3)]
+            assert all(s["ok"] for s in subs), subs
+            # member quota is 1/client, but 2 members absorb 3 live
+            # jobs (2 running + 1 queued); the FOURTH hits the fleet
+            # ledger (quota 3) — rejected at the router, by name
+            r = c.submit(["in.paf", "-o", out(9)], cwd=str(tmp_path))
+            assert not r.get("ok") and r["error"] == "queue_full"
+            assert "FLEET" in r["detail"]
+            assert r["client"] == "tok:t"
+            for s in subs:
+                assert c.result(s["job_id"], timeout=60)["rc"] == 0
+        # the three accepted jobs spread over both members
+        names = {s["member"] for s in subs}
+        assert len(names) == 2
+
+
+def test_router_aggregated_stats_metrics_and_top(tmp_path):
+    with _fleet(n=2, runner=_stub_runner()) as f:
+        out = str(tmp_path / "o.dfa")
+        with ServiceClient(f.sock, client_token="agg") as c:
+            for _ in range(2):
+                r = c.result(c.submit(["in.paf", "-o", out],
+                                      cwd=str(tmp_path))["job_id"],
+                             timeout=30)
+                assert r["rc"] == 0
+            st = c.stats()["stats"]
+            met = c.metrics()["metrics"]
+        assert st["router"] is True
+        assert st["fleet"]["alive"] == 2
+        assert st["fleet"]["jobs_routed"] == 2
+        # member jobs counters aggregate (both completions visible)
+        assert st["jobs"]["completed"] == 2
+        assert st["fair_share"]["clients"] == {}   # all retired
+        for fam in ("pwasm_fleet_member_up",
+                    "pwasm_fleet_jobs_routed_total",
+                    "pwasm_fleet_members 2"):
+            assert fam in met, fam
+        # the fleet-aware top renders the member table from the same
+        # stats dict (pure function)
+        frame = render(st)
+        assert "FLEET" in frame and "MEMBER" in frame
+        assert "up" in frame
+
+
+def test_router_stream_verbs_forward(tmp_path):
+    feeds: list = []
+
+    def stream_runner(argv, stdout=None, stderr=None, warm=None,
+                      input_stream=None, **kw):
+        if input_stream is not None:
+            feeds.append(list(input_stream))
+        return 0
+
+    with _fleet(n=2, runner=stream_runner) as f:
+        out = str(tmp_path / "s.dfa")
+        with ServiceClient(f.sock) as c:
+            so = c.stream_open(["-r", "q.fa", "-o", out],
+                               cwd=str(tmp_path))
+            assert so["ok"], so
+            jid = so["job_id"]
+            assert jid.startswith("fleet-")
+            assert c.stream_data(jid, "rec1\tx\nrec2\t")["ok"]
+            assert c.stream_data(jid, "y\n")["ok"]
+            end = c.stream_end(jid)
+            assert end["ok"] and end["records"] == 2
+            r = c.result(jid, timeout=30)
+            assert r["rc"] == 0
+    assert feeds and [l.rstrip("\n") for l in feeds[0]] \
+        == ["rec1\tx", "rec2\ty"]
+
+
+def test_router_drain_rejects_new_keeps_results(tmp_path):
+    with _fleet(n=1, runner=_stub_runner(sleep=0.3)) as f:
+        out = str(tmp_path / "d.dfa")
+        with ServiceClient(f.sock) as c:
+            sub = c.submit(["in.paf", "-o", out], cwd=str(tmp_path))
+            assert sub["ok"]
+            d = c.drain()
+            assert d["ok"] and d["draining"]
+            r = c.submit(["in.paf", "-o", out], cwd=str(tmp_path))
+            assert r["error"] == "draining"
+            # the in-flight job's result stays fetchable through the
+            # draining router
+            assert c.result(sub["job_id"], timeout=60)["rc"] == 0
+        assert f.rc == [] or f.rc[0] == 0
+
+
+# ---------------------------------------------------------------------------
+# failover: unit-level verdicts from a crafted journal
+# ---------------------------------------------------------------------------
+def _craft_router_with_dead_member(tmp_path, sibling, journal_recs,
+                                   stream=False):
+    """A router whose member 'ghost' is alive-then-dead with a
+    hand-written journal, plus one real sibling to take jobs over."""
+    ghost_target = str(tmp_path / "ghost.sock")
+    r = Router([sibling.sock, ghost_target], socket_path=None,
+               listen="127.0.0.1:0", stderr=io.StringIO(),
+               poll_interval=999)
+    ghost = r.members["ghost.sock"]
+    ghost.alive = True
+    ghost.ever_alive = True
+    sib = r.members[transport.target_name(sibling.sock)]
+    sib.alive = True
+    sib.ever_alive = True
+    with open(ghost.journal_path, "w") as f:
+        for rec in journal_recs:
+            f.write(json.dumps(rec) + "\n")
+    from pwasm_tpu.fleet.router import _FleetJob
+    job = _FleetJob("fleet-0001", "cl1", "", "tr-9",
+                    {"args": ["a.paf", "-o",
+                              str(tmp_path / "a.dfa")],
+                     "cwd": str(tmp_path)},
+                    "ghost.sock", "job-0001", stream=stream)
+    r.jobs[job.fid] = job
+    r.ledger.admit("cl1", "ghost.sock")
+    return r, job
+
+
+def test_failover_started_job_resumes_on_sibling(tmp_path):
+    ran: list = []
+    with _daemon(runner=_stub_runner(log=ran)) as sib:
+        r, job = _craft_router_with_dead_member(tmp_path, sib, [
+            {"v": 1, "rec": "admit", "job_id": "job-0001",
+             "argv": ["a.paf", "-o", str(tmp_path / "a.dfa")],
+             "client": "cl1", "t": 1.0},
+            {"v": 1, "rec": "start", "job_id": "job-0001",
+             "lane": 0},
+        ])
+        r._member_down("ghost.sock")
+        assert job.member == transport.target_name(sib.sock)
+        assert job.gen == 1 and job.failovers == 1
+        # the re-admission is a --resume continuation with the SAME
+        # trace identity, and the consumed journal is set aside
+        deadline = time.monotonic() + 15
+        while not ran and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert ran and "--resume" in ran[0]
+        assert os.path.exists(
+            r.members["ghost.sock"].journal_path + ".recovered")
+        assert not os.path.exists(
+            r.members["ghost.sock"].journal_path)
+        with ServiceClient(sib.sock) as c:
+            got = c.result(job.mjid, timeout=30)
+        assert got["rc"] == 0 and got["job"]["trace_id"] == "tr-9"
+        assert r.recovered["resumed"] == 1
+
+
+def test_failover_unstarted_job_requeues_plain(tmp_path):
+    ran: list = []
+    with _daemon(runner=_stub_runner(log=ran)) as sib:
+        r, job = _craft_router_with_dead_member(tmp_path, sib, [
+            {"v": 1, "rec": "admit", "job_id": "job-0001",
+             "argv": ["a.paf", "-o", str(tmp_path / "a.dfa")],
+             "client": "cl1", "t": 1.0},
+        ])
+        r._member_down("ghost.sock")
+        deadline = time.monotonic() + 15
+        while not ran and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert ran and "--resume" not in ran[0]
+        assert r.recovered["requeued"] == 1
+
+
+def test_failover_finished_job_served_from_journal_and_spool(
+        tmp_path):
+    from pwasm_tpu.utils.fsio import payload_crc, write_durable_text
+    spool = str(tmp_path / "job-0001.result.json")
+    payload = {"version": 1, "job_id": "job-0001", "state": "done",
+               "rc": 0, "trace_id": "tr-9", "flight": None,
+               "stats": {"alignments": 7}, "stderr_tail": "tail!"}
+    payload["crc"] = payload_crc(
+        {k: v for k, v in payload.items() if k != "crc"})
+    write_durable_text(spool, json.dumps(payload, sort_keys=True,
+                                         separators=(",", ":")))
+    with _daemon(runner=_stub_runner()) as sib:
+        r, job = _craft_router_with_dead_member(tmp_path, sib, [
+            {"v": 1, "rec": "admit", "job_id": "job-0001",
+             "argv": ["a.paf", "-o", "a.dfa"], "client": "cl1",
+             "t": 1.0},
+            {"v": 1, "rec": "start", "job_id": "job-0001",
+             "lane": 0},
+            {"v": 1, "rec": "finish", "job_id": "job-0001",
+             "state": "done", "rc": 0,
+             "spool": {"path": spool, "bytes": 1}, "t": 2.0},
+        ])
+        r._member_down("ghost.sock")
+        # no re-run: served straight from journal + CRC'd spool
+        term = job.terminal
+        assert term is not None and term["rc"] == 0
+        assert term["stats"] == {"alignments": 7}
+        assert term["stderr_tail"] == "tail!"
+        assert r.recovered["restored"] == 1
+        # a corrupt spool would be reported, never served: covered by
+        # the daemon-side CRC tests (same loader)
+
+
+def test_failover_cancelled_and_stream_verdicts(tmp_path):
+    with _daemon(runner=_stub_runner()) as sib:
+        r, job = _craft_router_with_dead_member(tmp_path, sib, [
+            {"v": 1, "rec": "admit", "job_id": "job-0001",
+             "argv": ["a.paf", "-o", "a.dfa"], "client": "cl1",
+             "t": 1.0},
+            {"v": 1, "rec": "cancel", "job_id": "job-0001"},
+        ])
+        r._member_down("ghost.sock")
+        assert job.terminal["job"]["state"] == "cancelled"
+        assert r.recovered["cancelled"] == 1
+    with _daemon(runner=_stub_runner()) as sib:
+        r, job = _craft_router_with_dead_member(
+            tmp_path, sib, [
+                {"v": 1, "rec": "admit", "job_id": "job-0001",
+                 "argv": ["-r", "q.fa", "-o", "a.dfa"],
+                 "client": "cl1", "stream": True, "t": 1.0},
+            ], stream=True)
+        r._member_down("ghost.sock")
+        assert job.terminal["job"]["state"] == "preempted"
+        assert job.terminal["rc"] == 75
+        assert "--resume" in job.terminal["job"]["detail"]
+        assert r.recovered["stream_preempted"] == 1
+
+
+def test_failover_without_journal_still_resumes(tmp_path):
+    """Per-daemon journal on an unreachable host (TCP member, no
+    --journal-dir): the router still re-admits with --resume — the
+    resume contract restarts cleanly when no ckpt exists."""
+    ran: list = []
+    with _daemon(runner=_stub_runner(log=ran)) as sib:
+        ghost_target = "ghosthost:19999"
+        r = Router([sib.sock, ghost_target], socket_path=None,
+                   listen="127.0.0.1:0", stderr=io.StringIO(),
+                   poll_interval=999)
+        for m in r.members.values():
+            m.alive = m.ever_alive = True
+        assert r.members["ghosthost_19999"].journal_path is None
+        from pwasm_tpu.fleet.router import _FleetJob
+        job = _FleetJob("fleet-0001", "cl1", "", "tr-9",
+                        {"args": ["a.paf", "-o",
+                                  str(tmp_path / "a.dfa")],
+                         "cwd": str(tmp_path)},
+                        "ghosthost_19999", "job-0001")
+        r.jobs[job.fid] = job
+        r.ledger.admit("cl1", "ghosthost_19999")
+        r._member_down("ghosthost_19999")
+        deadline = time.monotonic() + 15
+        while not ran and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert ran and "--resume" in ran[0]
+        assert r.recovered["resumed"] == 1
+
+
+def test_failover_no_sibling_lands_failed(tmp_path):
+    r = Router(["/nonexistent/a.sock", "/nonexistent/b.sock"],
+               socket_path=None, listen="127.0.0.1:0",
+               stderr=io.StringIO(), poll_interval=999)
+    for m in r.members.values():
+        m.alive = m.ever_alive = True
+    from pwasm_tpu.fleet.router import _FleetJob
+    job = _FleetJob("fleet-0001", "cl1", "", "tr",
+                    {"args": ["a.paf", "-o", "a.dfa"],
+                     "cwd": str(tmp_path)}, "a.sock", "job-0001")
+    r.jobs[job.fid] = job
+    r.ledger.admit("cl1", "a.sock")
+    r._member_down("a.sock")
+    assert job.terminal["job"]["state"] == "failed"
+    assert "resubmit" in job.terminal["job"]["detail"]
+    assert r.recovered["failed"] == 1
+    # the ledger slot was released: the client is not quota-wedged
+    assert r.ledger.client_depths() == {}
+
+
+# ---------------------------------------------------------------------------
+# THE drill: kill one of three daemons behind the router
+# ---------------------------------------------------------------------------
+def _corpus(tmp_path, n=24, qlen=120, seed=3):
+    rng = np.random.default_rng(seed)
+    q = "".join("ACGT"[i] for i in rng.integers(0, 4, qlen))
+    lines = []
+    for i in range(n):
+        cut = 10 + int(rng.integers(0, qlen - 40))
+        qb = q[cut]
+        tb = "ACGT"[("ACGT".index(qb) + 1) % 4]
+        ops = [("=", cut), ("*", tb, qb), ("=", 20), ("ins", "gg"),
+               ("=", qlen - cut - 21)]
+        lines.append(make_paf_line("q", q, f"asm{i}", "+", ops)[0])
+    fa = tmp_path / "q.fa"
+    write_fasta(str(fa), [("q", q.encode())])
+    paf = tmp_path / "in.paf"
+    paf.write_text("".join(ln + "\n" for ln in lines))
+    return str(paf), str(fa)
+
+
+def _job_args(tmp_path, tag, paf, fa, extra=()):
+    return [paf, "-r", fa, "-o", str(tmp_path / f"{tag}.dfa"),
+            "--device=tpu", "--batch=2",
+            f"--stats={tmp_path / f'{tag}.json'}"] + list(extra)
+
+
+def _serve_env():
+    old_pp = os.environ.get("PYTHONPATH", "")
+    return dict(os.environ, JAX_PLATFORMS="cpu",
+                PWASM_DEVICE_PROBE="0",
+                PYTHONPATH=REPO + (os.pathsep + old_pp if old_pp
+                                   else ""))
+
+
+def test_kill_one_of_three_members_failover_byte_identical(tmp_path):
+    """THE ISSUE 13 acceptance drill: three serve daemons behind one
+    router; SIGKILL the member running a mid-run job (after its first
+    durable ckpt) → the router reads the dead member's journal,
+    resumes the job on a sibling, and every report lands
+    byte-identical to the uncrashed arm — with the client-minted
+    trace_id intact end-to-end and trace-merge of client + router +
+    surviving members yielding one valid timeline."""
+    from pwasm_tpu.obs import TraceRecorder
+    from pwasm_tpu.obs.merge import merge_traces
+
+    paf, fa = _corpus(tmp_path)
+    # the uncrashed arm: cold runs of the same argvs
+    from pwasm_tpu.cli import run as cli_run
+    assert cli_run(_job_args(tmp_path, "colda", paf, fa, [SLOW]),
+                   stderr=io.StringIO()) == 0
+    assert cli_run(_job_args(tmp_path, "coldb", paf, fa),
+                   stderr=io.StringIO()) == 0
+    expect_a = (tmp_path / "colda.dfa").read_bytes()
+    expect_b = (tmp_path / "coldb.dfa").read_bytes()
+
+    d = tempfile.mkdtemp(prefix="pwdrill")
+    socks, procs = [], []
+    member_traces = []
+    try:
+        for i in range(3):
+            s = os.path.join(d, f"m{i}.sock")
+            tr = os.path.join(d, f"m{i}.trace")
+            p = subprocess.Popen(
+                [sys.executable, "-m", "pwasm_tpu.cli", "serve",
+                 f"--socket={s}", f"--trace-json={tr}"],
+                env=_serve_env(), stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE, text=True)
+            socks.append(s)
+            procs.append(p)
+            member_traces.append(tr)
+        for s in socks:
+            assert wait_for_socket(s, 60)
+        rsock = os.path.join(d, "router.sock")
+        rtrace = os.path.join(d, "router.trace")
+        router = Router(socks, socket_path=rsock,
+                        stderr=io.StringIO(), poll_interval=0.2,
+                        trace_json=rtrace)
+        rt = threading.Thread(target=router.serve, daemon=True)
+        rt.start()
+        assert wait_for_socket(rsock, 15)
+
+        ctrace = TraceRecorder()     # the CLIENT side of the story
+        with ServiceClient(rsock, trace_id="drill-trace") as c:
+            t0 = ctrace.now()
+            ja = c.submit(_job_args(tmp_path, "a", paf, fa, [SLOW]),
+                          cwd=str(tmp_path))
+            ctrace.complete("submit_rpc", t0, trace_id=c.trace_id)
+            jb = c.submit(_job_args(tmp_path, "b", paf, fa),
+                          cwd=str(tmp_path))
+            assert ja["ok"] and jb["ok"], (ja, jb)
+            # wait until job a is demonstrably MID-RUN with a ckpt
+            ck = str(tmp_path / "a.dfa.ckpt")
+            deadline = time.monotonic() + 60
+            mid = False
+            while time.monotonic() < deadline:
+                st = c.status(ja["job_id"])["job"]["state"]
+                if st == "running" and os.path.exists(ck):
+                    mid = True
+                    break
+                assert st in ("queued", "running"), st
+                time.sleep(0.02)
+            assert mid, "job never reached mid-run with a ckpt"
+            victim = ja["member"]
+            vi = socks.index(router.members[victim].target)
+            procs[vi].kill()          # SIGKILL: no drain, no cleanup
+            procs[vi].wait(timeout=30)
+            t0 = ctrace.now()
+            ra = c.result(ja["job_id"], timeout=300)
+            ctrace.complete("result_wait", t0, trace_id=c.trace_id)
+            rb = c.result(jb["job_id"], timeout=300)
+            assert ra.get("rc") == 0, ra
+            assert rb.get("rc") == 0, rb
+            # identity intact end-to-end, placement visible
+            assert ra["job"]["trace_id"] == "drill-trace"
+            assert rb["job"]["trace_id"] == "drill-trace"
+            assert ra["job"]["member"] != victim
+            assert ra["job"]["failovers"] == 1
+            st = c.stats()["stats"]
+            assert st["fleet"]["failovers"] == 1
+            assert st["fleet"]["jobs_recovered"]["resumed"] == 1
+            c.drain()
+        rt.join(20)
+        # byte parity vs the uncrashed arm for BOTH jobs
+        assert (tmp_path / "a.dfa").read_bytes() == expect_a
+        assert (tmp_path / "b.dfa").read_bytes() == expect_b
+        # the victim's journal was set aside, not left to double-run
+        assert os.path.exists(socks[vi] + ".journal.recovered")
+        # surviving members drain clean and write their traces
+        for i, p in enumerate(procs):
+            if i == vi:
+                continue
+            with ServiceClient(socks[i]) as c:
+                c.drain()
+            assert p.wait(timeout=120) == 75
+        ctrace_path = os.path.join(d, "client.trace")
+        ctrace.write(ctrace_path)
+        docs = [("client", json.load(open(ctrace_path))),
+                ("router", json.load(open(rtrace)))]
+        for i, tr in enumerate(member_traces):
+            if i != vi and os.path.exists(tr):
+                docs.append((f"member{i}", json.load(open(tr))))
+        assert len(docs) == 4     # client + router + both survivors
+        merged = merge_traces(docs)
+        events = merged["traceEvents"]
+        assert events, "empty merged timeline"
+        # one valid timeline: the drill trace_id appears in spans
+        # from at least three of the four processes
+        pids_with_id = {e["pid"] for e in events
+                        if isinstance(e.get("args"), dict)
+                        and e["args"].get("trace_id")
+                        == "drill-trace"}
+        assert len(pids_with_id) >= 3, pids_with_id
+        assert merged["otherData"]["merged"] == 4
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+            p.stderr.close()
+        shutil.rmtree(d, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# warmup + persistent compile cache (ROADMAP item 2b satellite)
+# ---------------------------------------------------------------------------
+def test_warmup_pays_probe_and_populates_compile_cache(tmp_path):
+    """serve --warmup --compile-cache-dir: the daemon's warmup job
+    pays the backend probe and the device compiles at START, so the
+    first real job answers its probe warm — and the compile cache dir
+    holds persisted programs for the next restart.  A subprocess
+    daemon: conftest deliberately disarms the process-global cache
+    inside the pytest interpreter (PWASM_JAX_CACHE=0), so the cache
+    behavior can only be observed in a child process."""
+    cache = str(tmp_path / "xla-cache")
+    d = tempfile.mkdtemp(prefix="pwwarm")
+    sock = os.path.join(d, "w.sock")
+    env = _serve_env()
+    env["PWASM_JAX_CACHE"] = "1"     # re-arm: the child OWNS its cache
+    env.pop("PWASM_DEVICE_PROBE", None)   # probes must really happen
+    p = subprocess.Popen(
+        [sys.executable, "-m", "pwasm_tpu.cli", "serve",
+         f"--socket={sock}", "--warmup=tpu",
+         f"--compile-cache-dir={cache}"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        assert wait_for_socket(sock, 60)
+        paf, fa = _corpus(tmp_path, n=8)
+        # wait for the warmup to land (cache dir fills), then submit
+        deadline = time.monotonic() + 120
+        while not (os.path.isdir(cache) and os.listdir(cache)):
+            assert time.monotonic() < deadline
+            assert p.poll() is None
+            time.sleep(0.2)
+        with ServiceClient(sock) as c:
+            sub = c.submit(_job_args(tmp_path, "w1", paf, fa),
+                           cwd=str(tmp_path))
+            assert sub["ok"], sub
+            r = c.result(sub["job_id"], timeout=120)
+            c.drain()
+        assert r["rc"] == 0, r
+        # the warmup paid the probe: the FIRST real job is probe-free
+        assert r["stats"]["backend"]["probes"] == 0
+        assert r["stats"]["backend"]["warm_hits"] >= 1
+        assert p.wait(timeout=120) == 75
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+        p.stderr.close()
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def test_compile_cache_dir_flag_cold_run(tmp_path):
+    """--compile-cache-dir on a cold run: the dir is created and
+    populated, and a second run with the same dir stays
+    byte-identical (the cache is an optimization, never bytes)."""
+    paf, fa = _corpus(tmp_path, n=8)
+    cache = str(tmp_path / "cc")
+    env = _serve_env()
+    env["PWASM_JAX_CACHE"] = "1"     # conftest disarms it by default
+    outs = []
+    for tag in ("c1", "c2"):
+        args = _job_args(tmp_path, tag, paf, fa,
+                         [f"--compile-cache-dir={cache}"])
+        r = subprocess.run(
+            [sys.executable, "-m", "pwasm_tpu.cli"] + args,
+            env=env, capture_output=True)
+        assert r.returncode == 0, r.stderr.decode()[:2000]
+        outs.append((tmp_path / f"{tag}.dfa").read_bytes())
+    assert outs[0] == outs[1]
+    assert os.path.isdir(cache) and os.listdir(cache)
+
+
+def test_warmup_files_deterministic(tmp_path):
+    from pwasm_tpu.cli import warmup_files
+    p1 = warmup_files(str(tmp_path / "w1"))
+    p2 = warmup_files(str(tmp_path / "w2"))
+    assert open(p1[0]).read() == open(p2[0]).read()
+    assert open(p1[1]).read() == open(p2[1]).read()
+    # the corpus parses: a cold host run completes on it
+    from pwasm_tpu.cli import run as cli_run
+    err = io.StringIO()
+    rc = cli_run([p1[0], "-r", p1[1],
+                  "-o", str(tmp_path / "w.dfa")], stderr=err)
+    assert rc == 0, err.getvalue()
+    assert (tmp_path / "w.dfa").read_bytes()
+
+
+def test_router_job_table_bounded_lru(tmp_path):
+    """Review hardening: retired routed jobs are evicted past
+    --max-results (LRU by access) so a long-lived router's job table
+    (and its health-loop scans) stay bounded; evicted fleet ids answer
+    unknown_job like the daemon's own eviction."""
+    with _fleet(n=1, runner=_stub_runner(),
+                router_kw={"max_results": 2}) as f:
+        out = str(tmp_path / "e.dfa")
+        with ServiceClient(f.sock) as c:
+            jids = []
+            for _ in range(5):
+                s = c.submit(["in.paf", "-o", out], cwd=str(tmp_path))
+                assert s["ok"]
+                assert c.result(s["job_id"], timeout=30)["rc"] == 0
+                jids.append(s["job_id"])
+            deadline = time.monotonic() + 15
+            while len(f.router.jobs) > 2:
+                assert time.monotonic() < deadline, \
+                    sorted(f.router.jobs)
+                time.sleep(0.05)
+            r = c.status(jids[0])
+            assert r["error"] == "unknown_job"
+            # the most recent job survives the LRU
+            assert c.status(jids[-1])["ok"]
+
+
+def test_router_stream_conn_closed_on_terminal(tmp_path):
+    """Review hardening: a stream job's persistent member connection
+    is released once the job lands terminal — no fd/thread leak per
+    stream."""
+    def stream_runner(argv, stdout=None, stderr=None, warm=None,
+                      input_stream=None, **kw):
+        if input_stream is not None:
+            list(input_stream)
+        return 0
+
+    with _fleet(n=1, runner=stream_runner) as f:
+        out = str(tmp_path / "sc.dfa")
+        with ServiceClient(f.sock) as c:
+            so = c.stream_open(["-r", "q.fa", "-o", out],
+                               cwd=str(tmp_path))
+            assert so["ok"], so
+            job = f.router.jobs[so["job_id"]]
+            assert job.sconn is not None
+            c.stream_data(so["job_id"], "r1\tx\n")
+            c.stream_end(so["job_id"])
+            assert c.result(so["job_id"], timeout=30)["rc"] == 0
+            deadline = time.monotonic() + 15
+            while job.sconn is not None:
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            assert job.retired
+
+
+def test_poll_death_needs_consecutive_strikes():
+    """Review hardening: one failed health poll (a 3s stats RPC can
+    time out under member load) must NOT declare a live member dead —
+    a spurious failover re-runs jobs a live member still owns.  Two
+    consecutive failures do."""
+    r = Router(["/nonexistent/ghost.sock"], socket_path=None,
+               listen="127.0.0.1:0", stderr=io.StringIO(),
+               poll_interval=999)
+    m = r.members["ghost.sock"]
+    m.alive = m.ever_alive = True
+    m.fail_streak = 0
+    # a stats-request refresh (count_failures=False) NEVER strikes:
+    # only the single-threaded health loop may count, else two
+    # concurrent polls double-count one stall into a failover
+    r._poll_members()
+    assert m.alive and m.fail_streak == 0
+    r._poll_members(count_failures=True)
+    assert m.alive and m.fail_streak == 1     # one strike: still up
+    r._poll_members(count_failures=True)
+    assert not m.alive                        # two strikes: down
+
+
+def test_failover_finished_stream_served_not_resent(tmp_path):
+    """Review hardening: a stream job whose FINISH is durably
+    journaled before the member died gets its restored verdict, not
+    a preempted 're-send the records' — journal rows outrank the
+    stream flag, mirroring the member's own restart replay order."""
+    with _daemon(runner=_stub_runner()) as sib:
+        r, job = _craft_router_with_dead_member(
+            tmp_path, sib, [
+                {"v": 1, "rec": "admit", "job_id": "job-0001",
+                 "argv": ["-r", "q.fa", "-o", "a.dfa"],
+                 "client": "cl1", "stream": True, "t": 1.0},
+                {"v": 1, "rec": "start", "job_id": "job-0001",
+                 "lane": 0},
+                {"v": 1, "rec": "finish", "job_id": "job-0001",
+                 "state": "done", "rc": 0, "t": 2.0},
+            ], stream=True)
+        r._member_down("ghost.sock")
+        assert job.terminal["job"]["state"] == "done"
+        assert job.terminal["rc"] == 0
+        assert r.recovered["restored"] == 1
+        assert r.recovered["stream_preempted"] == 0
+
+
+def test_orphan_rescue_resolves_journal_itself(tmp_path):
+    """Review hardening: a result-waiter rescuing a job the death
+    snapshot missed calls _recover_job with no pre-folded row — the
+    method must read the dead member's journal itself, so a durably
+    finished (or cancelled) job is served, never blindly re-run with
+    --resume."""
+    with _daemon(runner=_stub_runner()) as sib:
+        r, job = _craft_router_with_dead_member(tmp_path, sib, [
+            {"v": 1, "rec": "admit", "job_id": "job-0001",
+             "argv": ["a.paf", "-o", "a.dfa"], "client": "cl1",
+             "t": 1.0},
+            {"v": 1, "rec": "start", "job_id": "job-0001",
+             "lane": 0},
+            {"v": 1, "rec": "finish", "job_id": "job-0001",
+             "state": "done", "rc": 0, "t": 2.0},
+        ])
+        r.members["ghost.sock"].alive = False   # death already noted
+        r._recover_job(job)                     # row resolved inside
+        assert job.terminal["job"]["state"] == "done"
+        assert r.recovered["restored"] == 1
+        assert r.recovered["resumed"] == 0

@@ -913,3 +913,90 @@ def test_kill9_crash_drill_replay_recovers_byte_identical(tmp_path):
             if p is not None:
                 p.stderr.close()
         shutil.rmtree(sockdir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# journal portability: the failover primitive (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+def test_journal_portable_across_cwd_and_socket(tmp_path, monkeypatch):
+    """A journal written by daemon A must replay IDENTICALLY in a
+    process with a different cwd and a different socket path — the
+    primitive fleet failover stands on (the router hands a dead
+    member's journal to a sibling daemon; nothing about the journal
+    may depend on where the writer ran).  Two fresh daemons on two
+    different cwds/sockets replay byte-identical copies and must
+    recover the same job table and replay the same argvs."""
+    gate = threading.Event()
+    started = threading.Event()
+
+    def blocking_runner(argv, stdout=None, stderr=None, warm=None,
+                        **kw):
+        started.set()
+        gate.wait(30)
+        return 0
+
+    # daemon A: one job mid-run, one queued — the live-at-crash state
+    srcdir = tmp_path / "a_cwd"
+    srcdir.mkdir()
+    snap = str(tmp_path / "crash-snapshot.journal")
+    with _daemon(runner=blocking_runner) as h:
+        with ServiceClient(h.sock, trace_id="port-trace") as c:
+            # RELATIVE paths + client cwd: the daemon absolutizes at
+            # admission, so the journal must carry cwd-free argvs
+            ja = c.submit(["a.paf", "-o", "a.dfa"],
+                          cwd=str(srcdir), client="tenant1")
+            assert ja.get("ok"), ja
+            assert started.wait(15)
+            jb = c.submit(["b.paf", "-o", "b.dfa"],
+                          cwd=str(srcdir), client="tenant2")
+            assert jb.get("ok"), jb
+            # snapshot the journal while both jobs are live (exactly
+            # what a kill -9 would leave behind)
+            shutil.copy(h.daemon.journal.path, snap)
+        gate.set()
+
+    def replay_in(cwd: str, tag: str):
+        """One fresh daemon process-alike: own cwd, own socket path,
+        replaying its own copy of the snapshot."""
+        monkeypatch.chdir(cwd)
+        jp = os.path.join(cwd, f"{tag}.journal")
+        shutil.copy(snap, jp)
+        ran: list = []
+        with _daemon(runner=_stub_runner(log=ran),
+                     journal_path=jp) as h:
+            with ServiceClient(h.sock) as c:
+                ra = c.result(ja["job_id"], timeout=30)
+                rb = c.result(jb["job_id"], timeout=30)
+                st = c.stats()["stats"]
+        assert ra["rc"] == 0 and rb["rc"] == 0
+        assert st["journal"]["jobs_recovered"] == 2
+        rows = []
+        for r in (ra, rb):
+            j = r["job"]
+            rows.append((j["id"], j["state"], j["client"],
+                         j["trace_id"], j["recovered"]))
+        # the injected --stats sink lives in each daemon's private
+        # tmpdir by design — it is the one daemon-local argv token
+        return rows, sorted(
+            tuple(t for t in a if not t.startswith("--stats="))
+            for a in ran)
+
+    cwd_b = tmp_path / "b_cwd"
+    cwd_c = tmp_path / "c_cwd" / "nested"
+    cwd_b.mkdir()
+    cwd_c.mkdir(parents=True)
+    rows_b, ran_b = replay_in(str(cwd_b), "b")
+    rows_c, ran_c = replay_in(str(cwd_c), "c")
+    # identical recovery in both foreign processes
+    assert rows_b == rows_c
+    assert ran_b == ran_c
+    # the mid-run job came back as --resume, the queued one plain,
+    # and every recovered path is absolute (cwd-independent)
+    resumed = next(a for a in ran_b if "--resume" in a)
+    assert os.path.join(str(srcdir), "a.paf") in resumed
+    for argv in ran_b:
+        for tok in argv:
+            if tok.endswith((".paf", ".dfa")):
+                assert os.path.isabs(tok), argv
+    # identity survives the foreign replay too
+    assert all(r[3] == "port-trace" for r in rows_b)

@@ -98,6 +98,27 @@ def test_stream_rule_detects_direct_jax(checker, tmp_path):
     assert checker.find_stream_violations(str(tmp_path / "no")) == []
 
 
+def test_fleet_modules_stay_jax_free(checker):
+    """ISSUE 13 satellite: pwasm_tpu/fleet/ must stay jax-free — the
+    router/transport/ledger move protocol frames and read journals;
+    every device touch happens inside a member daemon's cli.run,
+    behind the supervised sites."""
+    bad = checker.find_fleet_violations()
+    assert bad == [], "\n".join(bad)
+
+
+def test_fleet_rule_detects_direct_jax(checker, tmp_path):
+    fleet = tmp_path / "pwasm_tpu" / "fleet"
+    fleet.mkdir(parents=True)
+    (fleet / "rogue.py").write_text(
+        "from jax import numpy as jnp\n"
+        "# import jax in a comment is NOT a hit\n"
+        "y = jnp.zeros(3).block_until_ready()\n")
+    bad = checker.find_fleet_violations(str(tmp_path))
+    assert len(bad) == 2 and all("rogue.py" in b for b in bad)
+    assert checker.find_fleet_violations(str(tmp_path / "no")) == []
+
+
 def test_metric_lint_clean_on_this_tree(checker):
     """ISSUE 6 satellite: every metric registration lives in
     obs/catalog.py, with snake_case pwasm_-prefixed unique names."""
