@@ -1852,6 +1852,162 @@ def cfg8_realistic_scale() -> int:
         # flips 1 -> 0 past the ceiling and bench_gate fails the flip
         _emit("realistic_obs_overhead_ok", 1 if obs_ok else 0,
               "bool", 1.0 if obs_ok else 0.0, cpu_metric=True)
+
+        # --- self-monitoring overhead (ISSUE 14): the SAME 3-job
+        # serve flow through a daemon with the canary + SLO engine ON
+        # (--canary-interval + default rules) vs OFF (--slo-rules=off,
+        # no canary).  Bytes must stay identical (self-monitoring is
+        # observability, byte-invisible to real traffic) and the
+        # submit->result wall ratio is gated <= 1.10 like the PR 11
+        # obs-overhead leg — interleaved arms + min-of-mins for the
+        # same noise-robustness reason.  --lanes=2 on BOTH arms so
+        # the canary probes the idle lane instead of queueing behind
+        # the jobs — the designed free-lane behavior; --warmup=cpu
+        # keeps the probe corpus on the deterministic host path in
+        # this backend-agnostic leg.
+        def selfmon_arm(tag, selfmon_on):
+            sockp = os.path.join(d, f"{tag}.sock")
+            flags = ["serve", f"--socket={sockp}", "--max-queue=8",
+                     "--lanes=2", "--warmup=cpu"]
+            flags += (["--canary-interval=1.0"] if selfmon_on
+                      else ["--slo-rules=off"])
+            proc = subprocess.Popen(
+                cmd + flags, env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE)
+            walls, body = [], b""
+            try:
+                if not wait_for_socket(sockp, 120):
+                    return None, None
+                for j in (1, 2, 3):
+                    t0 = time.perf_counter()
+                    with ServiceClient(sockp) as c:
+                        sub = c.submit(args(f"{tag}{j}", []))
+                        if not sub.get("ok"):
+                            return None, None
+                        res = c.result(sub["job_id"], timeout=600)
+                    walls.append(time.perf_counter() - t0)
+                    if not res.get("ok") or res.get("rc") != 0:
+                        sys.stderr.write(str(res)[:1000])
+                        return None, None
+                    body += readset(f"{tag}{j}")
+                if selfmon_on:
+                    # the engine + canary must actually be LIVE in
+                    # the measured arm, or the ratio gates nothing
+                    # (bounded wait: a fast box can finish the jobs
+                    # before the first 0.5s canary tick)
+                    h = {}
+                    live_by = time.monotonic() + 30
+                    while time.monotonic() < live_by:
+                        with ServiceClient(sockp) as c:
+                            h = c.health().get("health") or {}
+                        if h.get("rules", 0) >= 1 \
+                                and (h.get("canary") or {}).get(
+                                    "runs", 0) >= 1:
+                            break
+                        time.sleep(0.1)
+                    else:
+                        sys.stderr.write(
+                            f"selfmon arm not live: {h}\n")
+                        return None, None
+                with ServiceClient(sockp) as c:
+                    c.drain()
+                if proc.wait(timeout=120) != 75:
+                    return None, None
+            except Exception as e:
+                sys.stderr.write(f"selfmon arm {tag}: {e}\n")
+                return None, None
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+            return min(walls), body
+        mon_walls, off_walls = [], []
+        mon_body = off_body = None
+        for _round in range(2):
+            mon_wall, mon_body = selfmon_arm("svcmon", True)
+            if mon_wall is None:
+                return _fail("realistic_selfmon_overhead")
+            mon_walls.append(mon_wall)
+            off_wall, off_body = selfmon_arm("svcoff", False)
+            if off_wall is None:
+                return _fail("realistic_selfmon_overhead")
+            off_walls.append(off_wall)
+        if mon_body != off_body:
+            return _fail("realistic_selfmon_parity")
+        selfmon_ratio = min(mon_walls) / min(off_walls)
+        selfmon_ok = selfmon_ratio <= 1.10
+        _emit("realistic_selfmon_overhead_ratio", selfmon_ratio, "x",
+              1.0 if selfmon_ok else 0.0, cpu_metric=True)
+        _emit("realistic_selfmon_overhead_ok",
+              1 if selfmon_ok else 0, "bool",
+              1.0 if selfmon_ok else 0.0, cpu_metric=True)
+
+        # --- canary detection latency (ISSUE 14): a scripted outage
+        # on the canary's own serving path (PWASM_CANARY_FAULTS:
+        # probe runs 2-3 carry --inject-faults=preempt=1, so they
+        # exit 75 = a failed probe) must surface as a FIRING rule in
+        # `health` within two canary intervals of the last healthy
+        # probe, and resolve once the window passes.  This measures
+        # the member-level detection wall; the 3-member routed drill
+        # is gated as a test (tests/test_slo.py).
+        det_interval = 1.0
+        det_sock = os.path.join(d, "svcdet.sock")
+        det_env = dict(env, PWASM_CANARY_FAULTS="2-3:preempt=1")
+        # --warmup=tpu: the canary probes the SUPERVISED device path
+        # (where the scripted preempt=1 clock ticks — a host-path
+        # probe would never see the injected outage) with the pow2
+        # compiles prepaid, so probe walls stay far under the interval
+        det_proc = subprocess.Popen(
+            cmd + ["serve", f"--socket={det_sock}", "--warmup=tpu",
+                   f"--canary-interval={det_interval}"],
+            env=det_env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE)
+        detect_s = resolved = None
+        try:
+            if not wait_for_socket(det_sock, 120):
+                return _fail("realistic_canary_up")
+
+            def det_health():
+                with ServiceClient(det_sock) as c:
+                    return c.health().get("health") or {}
+
+            deadline = time.monotonic() + 120
+            t_ok = None
+            while time.monotonic() < deadline:
+                h = det_health()
+                can = h.get("canary") or {}
+                if t_ok is None and can.get("runs", 0) >= 1 \
+                        and can.get("last_ok"):
+                    t_ok = time.monotonic()   # outage window opens
+                    #   with the NEXT probe — the detection clock
+                if t_ok is not None and h.get("verdict") != "ok" \
+                        and "canary_failing" in [
+                            f.get("rule") for f in
+                            (h.get("firing") or [])]:
+                    detect_s = time.monotonic() - t_ok
+                    break
+                time.sleep(0.05)
+            if detect_s is None:
+                return _fail("realistic_canary_detect")
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if det_health().get("verdict") == "ok":
+                    resolved = True
+                    break
+                time.sleep(0.05)
+            with ServiceClient(det_sock) as c:
+                c.drain()
+            det_proc.wait(timeout=120)
+        except Exception as e:
+            sys.stderr.write(f"canary detect leg: {e}\n")
+            return _fail("realistic_canary_detect")
+        finally:
+            if det_proc.poll() is None:
+                det_proc.kill()
+                det_proc.wait()
+        det_ok = bool(resolved) and detect_s <= 2 * det_interval
+        _emit("realistic_canary_detect_s", detect_s, "s",
+              1.0 if det_ok else 0.0, cpu_metric=True)
         if on_tpu_backend():
             dev_env = dict(os.environ, PYTHONPATH=env["PYTHONPATH"])
             dev_times = []

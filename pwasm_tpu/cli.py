@@ -164,7 +164,8 @@ _VALUE_FLAGS = set("dprmowcs")
 # grammar stays untouched for plain runs.  `trace-merge` is the
 # offline cross-process trace join (no socket, pwasm_tpu/obs/merge.py)
 _SERVICE_CMDS = ("serve", "submit", "svc-stats", "metrics", "stream",
-                 "inspect", "top", "trace-merge", "route")
+                 "inspect", "top", "trace-merge", "route", "health",
+                 "logs")
 
 
 class CliError(PwasmError):

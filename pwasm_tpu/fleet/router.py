@@ -68,7 +68,7 @@ _ROUTE_USAGE = """Usage:
                  [--journal-dir=DIR] [--max-queue=N]
                  [--max-queue-total=N] [--poll-interval=S]
                  [--metrics-textfile=PATH] [--log-json=FILE]
-                 [--trace-json=FILE]
+                 [--trace-json=FILE] [--slo-rules=FILE|off]
 
    --backends=...       member serve daemons, comma-separated targets
                         (unix socket paths and/or HOST:PORT — required)
@@ -103,6 +103,13 @@ _ROUTE_USAGE = """Usage:
                         with each job's trace_id) — `pwasm-tpu
                         trace-merge` joins it with the client's and
                         members' traces on one timeline
+   --slo-rules=FILE|off JSON rules merged over the fleet default set
+                        (member_down / failover_burst /
+                        ledger_saturation — obs/catalog.py); the
+                        router's `health` verb folds every member's
+                        own verdict into ONE fleet verdict on top
+                        ("off" disables the router's engine).
+                        docs/OBSERVABILITY.md
 
  SIGTERM (or the `drain` command) latches admission shut; in-flight
  member jobs keep running and their results stay fetchable until the
@@ -188,7 +195,8 @@ class Router:
                  max_results: int = 4096,
                  stderr=None, metrics_textfile: str | None = None,
                  log_json: str | None = None,
-                 trace_json: str | None = None):
+                 trace_json: str | None = None,
+                 slo_rules=None):
         if not backends:
             raise ValueError("route needs at least one backend")
         if not socket_path and not listen:
@@ -236,6 +244,26 @@ class Router:
                                  events=events, tracer=tracer,
                                  trace_path=trace_json)
         self.drain.obs = self.obs
+        self.log_json_path = log_json   # the `logs` verb reads it
+        # ---- fleet self-monitoring (ISSUE 14): the router's own SLO
+        # engine over the pwasm_fleet_* families (member_down,
+        # failover_burst, ledger_saturation by default; user rules
+        # merge by name), plus the member-verdict aggregation the
+        # `health` verb performs on demand
+        from pwasm_tpu.obs.catalog import (build_slo_metrics,
+                                           default_fleet_slo_rules)
+        from pwasm_tpu.obs.slo import SloEngine, merge_rules
+        self.metrics["max_jobs"].set(self.ledger.max_total)
+        self.slo_metrics = build_slo_metrics(self.registry)
+        if slo_rules == "off":
+            rules = []
+        else:
+            rules = merge_rules(default_fleet_slo_rules(), slo_rules)
+        self.slo = SloEngine(self.registry, rules,
+                             metrics=self.slo_metrics,
+                             on_event=self.obs.event,
+                             eval_interval_s=min(
+                                 1.0, self.poll_interval))
 
     # ---- lifecycle -----------------------------------------------------
     def serve(self) -> int:
@@ -366,6 +394,8 @@ class Router:
             self._poll_members(count_failures=True)
             self._reap_finished()
             self._evict_jobs()
+            if self.slo.due():
+                self.slo.evaluate()   # gauges fresh from the poll
             self._write_textfile()
 
     def _poll_members(self, count_failures: bool = False) -> None:
@@ -791,8 +821,13 @@ class Router:
         if cmd == "metrics":
             self._refresh_gauges()
             return protocol.ok(
-                metrics=self.registry.expose(),
+                metrics=self.registry.expose(
+                    exemplars=bool(req.get("exemplars"))),
                 content_type="text/plain; version=0.0.4")
+        if cmd == "health":
+            return protocol.ok(health=self._fleet_health())
+        if cmd == "logs":
+            return protocol.handle_logs(req, self.log_json_path)
         if cmd == "drain":
             self.drain.request("drain requested by client")
             self._begin_drain(self.drain.reason)
@@ -1104,6 +1139,71 @@ class Router:
             out["job"] = j
         return out
 
+    @staticmethod
+    def _member_health_entry(mh) -> dict:
+        """One member's health dict (from a fresh RPC or its cached
+        stats block) folded into the verdict-row shape; anything
+        unparseable ranks ``unknown`` (aggregated as degraded —
+        unknown must never read as healthy)."""
+        if not isinstance(mh, dict):
+            return {"verdict": "unknown", "firing": []}
+        entry = {
+            "verdict": str(mh.get("verdict") or "unknown"),
+            "firing": [f.get("rule") for f in
+                       (mh.get("firing") or [])
+                       if isinstance(f, dict)],
+        }
+        if mh.get("canary") is not None:
+            entry["canary"] = mh["canary"]
+        return entry
+
+    def _fleet_health(self, fresh: bool = True) -> dict:
+        """The fleet verdict (ISSUE 14): a fresh evaluation of the
+        router's own rules (member_up gauges, failover counters,
+        ledger saturation) FOLDED with every live member's own
+        ``health`` verdict — worst wins, so one failing member makes
+        the fleet verdict failing even when the router itself is
+        clean.  ``fresh=True`` (the `health` verb — a probe must see
+        NOW) asks each live member over a new connection;
+        ``fresh=False`` (the `stats` verb, called right after a
+        synchronous member poll) folds the health block each member's
+        stats reply already carries — zero extra RPCs, so a slow
+        member cannot stall every `top` refresh by its timeout.  A
+        DEAD member needs no verdict penalty — the router's own
+        member_down rule is already firing for it."""
+        from pwasm_tpu.obs.slo import worst_verdict
+        self._refresh_gauges()
+        h = self.slo.evaluate()
+        h["router"] = True
+        members: dict[str, dict] = {}
+        with self._lock:
+            rows = [(m.name, m.target, m.alive,
+                     (m.stats or {}).get("health"))
+                    for m in self.members.values()]
+        verdicts = [h["verdict"]]
+        for name, target, alive, cached in rows:
+            if not alive:
+                members[name] = {"verdict": "unreachable",
+                                 "firing": []}
+                continue
+            mh = cached
+            if fresh:
+                mh = None
+                try:
+                    with ServiceClient(target, timeout=3.0) as c:
+                        resp = c.request({"cmd": "health"})
+                    if resp.get("ok"):
+                        mh = resp.get("health")
+                except (ServiceError, OSError, ValueError,
+                        TypeError, KeyError):
+                    pass     # unknown ranks degraded below
+            entry = self._member_health_entry(mh)
+            verdicts.append(entry["verdict"])
+            members[name] = entry
+        h["members"] = members
+        h["verdict"] = worst_verdict(*verdicts)
+        return h
+
     def _fleet_stats(self) -> dict:
         """The fleet-aggregated svc-stats surface: member counters
         summed, lanes labeled by member, plus the ``fleet`` block the
@@ -1176,6 +1276,12 @@ class Router:
                 "jobs_recovered": dict(self.recovered),
                 "live_jobs": live,
             },
+            # additive: the aggregated fleet verdict (ISSUE 14) —
+            # the fleet-aware `top`'s alerts pane reads it here.
+            # fresh=False: the member poll the stats verb just ran
+            # already carries each member's health block — no second
+            # RPC round
+            "health": self._fleet_health(fresh=False),
         }
 
 
@@ -1238,6 +1344,18 @@ def route_main(argv: list[str], stdout=None, stderr=None) -> int:
     metrics_textfile = opts.pop("metrics-textfile", None)
     log_json = opts.pop("log-json", None)
     trace_json = opts.pop("trace-json", None)
+    slo_rules = None
+    val = opts.pop("slo-rules", None)
+    if val is not None:
+        if val == "off":
+            slo_rules = "off"
+        else:
+            from pwasm_tpu.obs.slo import load_rules_file
+            try:
+                slo_rules = load_rules_file(val)
+            except ValueError as e:
+                stderr.write(f"{_ROUTE_USAGE}\nError: {e}\n")
+                return EXIT_USAGE
     if opts:
         stderr.write(f"{_ROUTE_USAGE}\nInvalid argument: "
                      f"--{next(iter(opts))}\n")
@@ -1250,7 +1368,8 @@ def route_main(argv: list[str], stdout=None, stderr=None) -> int:
                         max_results=nums["max-results"],
                         poll_interval=poll, stderr=stderr,
                         metrics_textfile=metrics_textfile,
-                        log_json=log_json, trace_json=trace_json)
+                        log_json=log_json, trace_json=trace_json,
+                        slo_rules=slo_rules)
     except ValueError as e:
         stderr.write(f"{_ROUTE_USAGE}\nError: {e}\n")
         return EXIT_USAGE

@@ -343,7 +343,149 @@ def build_fleet_metrics(reg: MetricsRegistry) -> dict:
         "Jobs recovered from dead members, by verdict (resumed/"
         "requeued/restored/cancelled/stream_preempted/failed)",
         labels=("how",))
+    m["max_jobs"] = reg.gauge(
+        "pwasm_fleet_max_jobs",
+        "Fleet-wide live-job backstop (--max-queue-total) — the "
+        "ledger_saturation SLO rule's denominator")
     return m
+
+
+def build_slo_metrics(reg: MetricsRegistry) -> dict:
+    """Register the SLO-engine families (ISSUE 14): one firing gauge
+    per rule (0/1 — every configured rule keeps a series from start,
+    so an absent series is a scrape gap, never 'healthy') and the
+    firing/resolved transition counter the incident timeline keys on.
+    Registered by BOTH the serve daemon and the fleet router (each
+    over its own registry and rule set)."""
+    m = {}
+    m["firing"] = reg.gauge(
+        "pwasm_alerts_firing",
+        "1 while the named SLO rule is firing, else 0 (obs/slo.py; "
+        "rule catalog in docs/OBSERVABILITY.md)", labels=("rule",))
+    m["transitions"] = reg.counter(
+        "pwasm_alert_transitions_total",
+        "SLO rule state transitions, by rule and state "
+        "(firing/resolved)", labels=("rule", "state"))
+    return m
+
+
+def build_canary_metrics(reg: MetricsRegistry) -> dict:
+    """Register the synthetic-canary families (service/canary.py,
+    ``serve --canary-interval``): the last probe's verdict, the probe
+    wall histogram (exemplar-linked to each probe's trace_id), and
+    the run counter by outcome."""
+    m = {}
+    m["ok"] = reg.gauge(
+        "pwasm_canary_ok",
+        "1 if the last synthetic canary probe passed (rc 0 + golden "
+        "report digest), 0 if it failed — unset until the first probe")
+    m["wall_seconds"] = reg.histogram(
+        "pwasm_canary_wall_seconds",
+        "Wall seconds per synthetic canary probe (the full "
+        "probe->lease->device->report path)")
+    m["runs"] = reg.counter(
+        "pwasm_canary_runs_total",
+        "Synthetic canary probes, by outcome (ok/fail/skipped — "
+        "skipped means no free lane within the grab timeout)",
+        labels=("outcome",))
+    return m
+
+
+# metric-name-lint: end-of-registrations (everything below REFERENCES
+# registered families — SLO rule expressions — and is excluded from
+# the registration-uniqueness scan in qa/check_supervision.py)
+# ---------------------------------------------------------------------------
+# Default SLO rule sets (ISSUE 14): the alert sketches that lived as
+# prose in docs/OBSERVABILITY.md, codified as declarative rules the
+# engine (obs/slo.py) evaluates continuously.  Every rule name below
+# must appear in docs/OBSERVABILITY.md — enforced by the doc-drift
+# lint (qa/check_supervision.py::find_doc_drift), same contract as
+# the metric families.  User rules (serve/route --slo-rules=FILE)
+# merge over these by name.
+# ---------------------------------------------------------------------------
+
+# the serve daemon's default rules, evaluated over its own registry
+DEFAULT_SLO_RULES = (
+    {"name": "breaker_open", "severity": "page", "kind": "threshold",
+     "metric": "pwasm_service_breaker_state", "op": ">=", "value": 2,
+     "for_s": 0.0,
+     "runbook": "a lane's device backend is probe-confirmed dead and "
+                "jobs are degrading to the host path; check the lane "
+                "table in `pwasm-tpu top` and the chip"},
+    {"name": "queue_pressure", "severity": "warn",
+     "kind": "threshold", "metric": "pwasm_service_queue_depth",
+     "divide_by": "pwasm_service_max_queue", "op": ">", "value": 0.8,
+     "for_s": 5.0,
+     "runbook": "admission queue is over 80% of one client quota; "
+                "add members or raise --max-queue"},
+    {"name": "journal_replay", "severity": "warn", "kind": "rate",
+     "metric": "pwasm_service_journal_replays_total", "op": ">",
+     "value": 0, "window_s": 300.0, "baseline": "zero",
+     "runbook": "this daemon replayed its job journal within the "
+                "window — it recovered from a hard crash; find out "
+                "what killed it"},
+    {"name": "trace_drops", "severity": "warn", "kind": "rate",
+     "metric": "pwasm_trace_events_dropped_total", "op": ">",
+     "value": 0, "window_s": 300.0,
+     "runbook": "trace events are being dropped past "
+                "--trace-max-events; raise the cap or trace less"},
+    {"name": "canary_failing", "severity": "page",
+     "kind": "threshold", "metric": "pwasm_canary_ok", "op": "==",
+     "value": 0, "for_s": 0.0,
+     "runbook": "the synthetic canary probe failed (bad rc or report "
+                "digest drift): the submit->lease->device->report "
+                "path is broken end to end — check canary_fail "
+                "events via `pwasm-tpu logs`"},
+    {"name": "job_wall_p99_burn", "severity": "warn",
+     "kind": "burn_rate", "metric": "pwasm_service_job_wall_seconds",
+     "objective_s": 120.0, "budget": 0.01, "short_s": 60.0,
+     "long_s": 300.0, "burn": 1.0,
+     "runbook": "more than 1% of jobs exceeded the 120s wall "
+                "objective in both burn windows; inspect a slow "
+                "job's flight record (`pwasm-tpu inspect JOB_ID`)"},
+    {"name": "queue_wait_burn", "severity": "warn",
+     "kind": "burn_rate",
+     "metric": "pwasm_service_job_queue_wait_seconds",
+     "objective_s": 60.0, "budget": 0.05, "short_s": 60.0,
+     "long_s": 300.0, "burn": 1.0,
+     "runbook": "over 5% of jobs waited more than 60s for admission "
+                "in both burn windows — sustained overload; scale "
+                "members out"},
+)
+
+# the fleet router's default rules, over the pwasm_fleet_* families
+DEFAULT_FLEET_SLO_RULES = (
+    {"name": "member_down", "severity": "page", "kind": "threshold",
+     "metric": "pwasm_fleet_member_up", "op": "==", "value": 0,
+     "for_s": 0.0,
+     "runbook": "a member serve daemon is unreachable (failover ran "
+                "or is running); check the member host and restart "
+                "it WITHOUT its set-aside .recovered journal"},
+    {"name": "failover_burst", "severity": "warn", "kind": "rate",
+     "metric": "pwasm_fleet_failovers_total", "op": ">", "value": 0,
+     "window_s": 300.0,
+     "runbook": "the router handled member-death failover(s) within "
+                "the window; if members are flapping, fix the hosts "
+                "before the fleet runs out of siblings"},
+    {"name": "ledger_saturation", "severity": "warn",
+     "kind": "threshold", "metric": "pwasm_fleet_jobs_live",
+     "divide_by": "pwasm_fleet_max_jobs", "op": ">", "value": 0.8,
+     "for_s": 5.0,
+     "runbook": "fleet-wide live jobs are over 80% of the admission "
+                "backstop; clients will start seeing queue_full — "
+                "add members or raise route --max-queue-total"},
+)
+
+
+def default_slo_rules() -> list[dict]:
+    """The serve daemon's default rule set (fresh copies — the engine
+    normalizes in place)."""
+    return [dict(r) for r in DEFAULT_SLO_RULES]
+
+
+def default_fleet_slo_rules() -> list[dict]:
+    """The fleet router's default rule set."""
+    return [dict(r) for r in DEFAULT_FLEET_SLO_RULES]
 
 
 def fold_run_stats(m: dict, st: dict | None) -> None:

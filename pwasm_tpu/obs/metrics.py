@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 
 # the linted grammar: pwasm_ prefix, lower-snake-case throughout
 NAME_RE = re.compile(r"^pwasm_[a-z0-9]+(_[a-z0-9]+)*$")
@@ -97,7 +98,7 @@ class _Metric:
                 f"{sorted(self.labels)}")
         return tuple(str(labels[n]) for n in self.labels)
 
-    def expose(self) -> list[str]:
+    def expose(self, exemplars: bool = False) -> list[str]:
         out = [f"# HELP {self.name} {_escape_help(self.help_text)}",
                f"# TYPE {self.name} {self.kind}"]
         with self._lock:
@@ -107,13 +108,27 @@ class _Metric:
             cells = [(values, self._snapshot(cell))
                      for values, cell in sorted(self._cells.items())]
         for values, cell in cells:
-            out.extend(self._expose_cell(values, cell))
+            out.extend(self._expose_cell(values, cell, exemplars))
         return out
 
     def _snapshot(self, cell):
         return cell   # numbers are immutable; Histogram overrides
 
-    def _expose_cell(self, values: tuple, cell) -> list[str]:
+    def snapshot_cells(self) -> list[tuple[dict, object]]:
+        """Every live cell as ``({label: value}, snapshot)`` rows —
+        the read API the SLO engine (obs/slo.py) evaluates rules over.
+        Counter/gauge snapshots are plain numbers; Histogram rows are
+        the raw ``(bucket_counts, sum, exemplars)`` triple (counts
+        cumulated by the consumer, exactly like exposition).  Taken
+        under the family lock, so one evaluation never sees a torn
+        cell."""
+        with self._lock:
+            return [(dict(zip(self.labels, values)),
+                     self._snapshot(cell))
+                    for values, cell in sorted(self._cells.items())]
+
+    def _expose_cell(self, values: tuple, cell,
+                     exemplars: bool = False) -> list[str]:
         raise NotImplementedError
 
 
@@ -134,7 +149,8 @@ class Counter(_Metric):
         with self._lock:
             return self._cells.get(self._values(labels), 0)
 
-    def _expose_cell(self, values, cell) -> list[str]:
+    def _expose_cell(self, values, cell,
+                     exemplars: bool = False) -> list[str]:
         return [f"{self.name}{_label_str(self.labels, values)} "
                 f"{_fmt_num(cell)}"]
 
@@ -165,7 +181,8 @@ class Gauge(_Metric):
         with self._lock:
             return self._cells.get(self._values(labels), 0)
 
-    def _expose_cell(self, values, cell) -> list[str]:
+    def _expose_cell(self, values, cell,
+                     exemplars: bool = False) -> list[str]:
         return [f"{self.name}{_label_str(self.labels, values)} "
                 f"{_fmt_num(cell)}"]
 
@@ -175,7 +192,17 @@ class Histogram(_Metric):
     (sorted, finite upper bounds); exposition renders the Prometheus
     cumulative form — each ``_bucket{le="x"}`` counts observations
     ``<= x``, the mandatory ``+Inf`` bucket equals ``_count``, and
-    ``_sum`` carries the total."""
+    ``_sum`` carries the total.
+
+    Exemplars (ISSUE 14 satellite): ``observe(v, trace_id=...)``
+    attaches the observation's cross-process trace identity to the
+    bucket it landed in (latest wins per bucket), rendered in the
+    OpenMetrics exemplar syntax — ``..._bucket{le="1"} 7
+    # {trace_id="8f3ab129cd01"} 0.93 <ts>`` — so a p99 bucket links
+    straight to ``pwasm-tpu inspect``'s flight record for a job that
+    actually landed there.  Rendering is OPT-IN per exposition
+    (``expose(exemplars=True)``): the default output stays pure
+    Prometheus 0.0.4, which classic scrapers require."""
 
     kind = "histogram"
 
@@ -189,22 +216,27 @@ class Histogram(_Metric):
                 f"{name}: buckets must be a sorted unique tuple")
         self.buckets = bs
 
-    def observe(self, v: float, **labels) -> None:
+    def observe(self, v: float, trace_id: str | None = None,
+                **labels) -> None:
         key = self._values(labels)
         with self._lock:
             cell = self._cells.get(key)
             if cell is None:
-                # per-bucket RAW counts (cumulated at exposition) + sum
-                cell = [[0] * (len(self.buckets) + 1), 0.0]
+                # per-bucket RAW counts (cumulated at exposition),
+                # sum, and the per-bucket latest exemplar
+                cell = [[0] * (len(self.buckets) + 1), 0.0, {}]
                 self._cells[key] = cell
-            counts, _ = cell
+            counts = cell[0]
+            idx = len(self.buckets)      # the +Inf overflow bucket
             for i, b in enumerate(self.buckets):
                 if v <= b:
-                    counts[i] += 1
+                    idx = i
                     break
-            else:
-                counts[-1] += 1          # the +Inf overflow bucket
+            counts[idx] += 1
             cell[1] += v
+            if trace_id:
+                cell[2][idx] = (str(trace_id), float(v),
+                                round(time.time(), 3))
 
     def count(self, **labels) -> int:
         with self._lock:
@@ -212,21 +244,33 @@ class Histogram(_Metric):
             return sum(cell[0]) if cell else 0
 
     def _snapshot(self, cell):
-        counts, total = cell
-        return (list(counts), total)
+        counts, total, ex = cell
+        return (list(counts), total, dict(ex))
 
-    def _expose_cell(self, values, cell) -> list[str]:
-        counts, total = cell
+    def _expose_cell(self, values, cell,
+                     exemplars: bool = False) -> list[str]:
+        counts, total, ex = cell
+
+        def exemplar(idx: int) -> str:
+            e = ex.get(idx) if exemplars else None
+            if e is None:
+                return ""
+            tid, v, ts = e
+            return (f' # {{trace_id="{_escape_label(tid)}"}} '
+                    f"{_fmt_num(v)} {_fmt_num(ts)}")
+
         out = []
         cum = 0
-        for b, c in zip(self.buckets, counts):
+        for i, (b, c) in enumerate(zip(self.buckets, counts)):
             cum += c
             lbl = _label_str(self.labels + ("le",),
                              values + (_fmt_num(b),))
-            out.append(f"{self.name}_bucket{lbl} {cum}")
+            out.append(f"{self.name}_bucket{lbl} {cum}"
+                       + exemplar(i))
         cum += counts[-1]
         lbl = _label_str(self.labels + ("le",), values + ("+Inf",))
-        out.append(f"{self.name}_bucket{lbl} {cum}")
+        out.append(f"{self.name}_bucket{lbl} {cum}"
+                   + exemplar(len(self.buckets)))
         base = _label_str(self.labels, values)
         out.append(f"{self.name}_sum{base} {_fmt_num(total)}")
         out.append(f"{self.name}_count{base} {cum}")
@@ -269,15 +313,21 @@ class MetricsRegistry:
         with self._lock:
             return self._metrics.get(name)
 
-    def expose(self) -> str:
+    def expose(self, exemplars: bool = False) -> str:
         """The full registry in Prometheus text exposition format
         (families in registration order — stable output diffs are part
-        of the test contract)."""
+        of the test contract).  ``exemplars=True`` additionally
+        renders the OpenMetrics exemplar suffix on histogram bucket
+        lines — OPT-IN, because classic Prometheus 0.0.4 parsers (and
+        the node-exporter textfile collector) reject the trailing
+        ``#``: the default exposition and the textfile stay pure
+        0.0.4, and exemplar-aware consumers (``pwasm-tpu metrics
+        --exemplars``, OpenMetrics scrapers) ask explicitly."""
         with self._lock:
             fams = list(self._metrics.values())
         lines: list[str] = []
         for m in fams:
-            lines.extend(m.expose())
+            lines.extend(m.expose(exemplars))
         return "\n".join(lines) + "\n" if lines else ""
 
     def write_textfile(self, path: str) -> None:

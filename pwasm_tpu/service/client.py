@@ -60,11 +60,14 @@ _CLIENT_USAGE = """Usage:
      finish at batch boundaries, queued jobs report resumable, daemon
      exits 75).
 
- pwasm-tpu metrics --socket=PATH
+ pwasm-tpu metrics --socket=PATH [--exemplars]
      print the daemon's metrics as Prometheus text exposition (queue
      depth, in-flight jobs, breaker state, job wall/queue-wait
      histograms, cumulative per-run counters) — the socket twin of
-     `serve --metrics-textfile=PATH` (docs/OBSERVABILITY.md).
+     `serve --metrics-textfile=PATH` (docs/OBSERVABILITY.md).  With
+     --exemplars, histogram buckets carry the OpenMetrics exemplar
+     suffix linking each bucket to a trace_id (strict 0.0.4 parsers
+     reject it, so the default stays pure).
 
  pwasm-tpu inspect --socket=PATH JOB_ID
      print the job's FLIGHT RECORD as JSON (docs/OBSERVABILITY.md):
@@ -73,6 +76,23 @@ _CLIENT_USAGE = """Usage:
      and the bounded event ring (retries, breaker transitions, OOM
      bisections, ckpt writes).  Works on live, finished, and
      disk-spooled jobs (spooled records are CRC-verified).
+
+ pwasm-tpu health --socket=TARGET [--exit-code]
+     print the daemon's (or, against a router, the FLEET's) health
+     verdict as JSON: ok/degraded/failing, the firing SLO rules
+     (docs/OBSERVABILITY.md rule catalog), canary state, and — on a
+     router — every member's folded verdict.  With --exit-code the
+     shell exit encodes the verdict (0 ok, 1 degraded, 2 failing) —
+     the orchestrator-probe form (k8s liveness, cron pagers).
+
+ pwasm-tpu logs (--socket=TARGET | FILE) [--trace-id=ID] [--job=ID]
+                [--event=TYPE] [--limit=N]
+     query the NDJSON event log — a live daemon/router's --log-json
+     over the socket, or a log FILE on disk directly — filtered by
+     trace_id (matches run_id too), job id, and/or event type,
+     rotated .1 generation included, newest --limit (default 1000)
+     matches in order.  Incident reconstruction without hand-grepping
+     two files.
 
  Every frame this client sends carries a trace_id (minted per
  connection, or --trace-id=ID to join an existing trace): the daemon
@@ -329,8 +349,35 @@ class ServiceClient:
     def stats(self) -> dict:
         return self._req({"cmd": "stats"})
 
-    def metrics(self) -> dict:
-        return self._req({"cmd": "metrics"})
+    def metrics(self, exemplars: bool = False) -> dict:
+        """Prometheus text exposition; ``exemplars=True`` opts into
+        the OpenMetrics exemplar suffix on histogram buckets (strict
+        0.0.4 parsers reject it, so the default stays pure)."""
+        req: dict = {"cmd": "metrics"}
+        if exemplars:
+            req["exemplars"] = True
+        return self._req(req)
+
+    def health(self) -> dict:
+        """The machine-readable health verdict (docs/OBSERVABILITY.md):
+        ok/degraded/failing + firing SLO rules (+ member verdicts
+        when the target is a fleet router)."""
+        return self._req({"cmd": "health"})
+
+    def logs(self, trace_id: str | None = None,
+             job_id: str | None = None, event: str | None = None,
+             limit: int = 1000) -> dict:
+        """Query the daemon's --log-json event log (rotation-aware).
+        The filter rides as ``filter_trace_id`` because every frame
+        already carries this CONNECTION's trace_id."""
+        req: dict = {"cmd": "logs", "limit": limit}
+        if trace_id is not None:
+            req["filter_trace_id"] = trace_id
+        if job_id is not None:
+            req["job_id"] = job_id
+        if event is not None:
+            req["event"] = event
+        return self._req(req)
 
     def drain(self) -> dict:
         return self._req({"cmd": "drain"})
@@ -368,11 +415,17 @@ def wait_for_socket(path: str, budget_s: float = 30.0) -> bool:
         time.sleep(0.05)
 
 
-def _parse_client_argv(argv: list[str]) -> tuple[dict, list[str]]:
+def _parse_client_argv(argv: list[str],
+                       cmd: str | None = None) -> tuple[dict,
+                                                        list[str]]:
     """Split client flags from the job argv: client flags are read
     until the first ``--`` or the first token that is not a recognized
     client flag (so both ``submit --socket=S -- in.paf ...`` and
-    ``submit --socket=S in.paf ...`` work)."""
+    ``submit --socket=S in.paf ...`` work).  The verb-specific flags
+    (``--exit-code`` on health, ``--job``/``--event``/``--limit`` on
+    logs, ``--exemplars`` on metrics) are recognized ONLY for their
+    verb — on any other verb they fall through to the job argv and
+    fail its validation loudly instead of being silently swallowed."""
     opts: dict = {}
     i = 0
     while i < len(argv):
@@ -402,6 +455,16 @@ def _parse_client_argv(argv: list[str]) -> tuple[dict, list[str]]:
             opts["trace_id"] = a.split("=", 1)[1]
         elif a.startswith("--trace-json="):
             opts["trace_json"] = a.split("=", 1)[1]
+        elif a == "--exit-code" and cmd == "health":
+            opts["exit_code"] = True
+        elif a == "--exemplars" and cmd == "metrics":
+            opts["exemplars"] = True
+        elif a.startswith("--job=") and cmd == "logs":
+            opts["job"] = a.split("=", 1)[1]
+        elif a.startswith("--event=") and cmd == "logs":
+            opts["event"] = a.split("=", 1)[1]
+        elif a.startswith("--limit=") and cmd == "logs":
+            opts["limit"] = a.split("=", 1)[1]
         else:
             break
         i += 1
@@ -434,14 +497,90 @@ def _job_verdict(resp: dict, job_id: str, stdout, stderr) -> int:
     return rc if isinstance(rc, int) else EXIT_FATAL
 
 
+def _logs_main(opts: dict, positional: list[str],
+               sock: str | None, stdout, stderr) -> int:
+    """The ``pwasm-tpu logs`` verb: socket mode asks the daemon to
+    filter its own ``--log-json``; FILE mode runs the SAME filter
+    (``obs/logquery.py``) over a log on disk — the two cannot
+    disagree.  Output is NDJSON, oldest-first, newest --limit kept."""
+    # flags may follow the FILE positional (`logs ev.ndjson
+    # --event=x` reads as naturally as the flag-first order the
+    # generic client parser stops at) — sweep the remainder here
+    rest: list[str] = []
+    for a in positional:
+        if a.startswith("--trace-id="):
+            opts["trace_id"] = a.split("=", 1)[1]
+        elif a.startswith("--job="):
+            opts["job"] = a.split("=", 1)[1]
+        elif a.startswith("--event="):
+            opts["event"] = a.split("=", 1)[1]
+        elif a.startswith("--limit="):
+            opts["limit"] = a.split("=", 1)[1]
+        else:
+            rest.append(a)
+    positional = rest
+    limit = 1000
+    if "limit" in opts:
+        val = opts["limit"]
+        if not (val.isascii() and val.isdigit()
+                and 1 <= int(val) <= 10000):
+            stderr.write(f"{_CLIENT_USAGE}\nInvalid --limit value: "
+                         f"{val}\n")
+            return EXIT_USAGE
+        limit = int(val)
+    trace_id = opts.get("trace_id")
+    job_id = opts.get("job")
+    event = opts.get("event")
+    if sock:
+        if positional:
+            stderr.write(f"{_CLIENT_USAGE}\nError: logs takes "
+                         "--socket OR a log FILE, not both\n")
+            return EXIT_USAGE
+        try:
+            with ServiceClient(sock) as c:
+                resp = c.logs(trace_id=trace_id, job_id=job_id,
+                              event=event, limit=limit)
+        except ServiceError as e:
+            stderr.write(f"Error: {e}\n")
+            return EXIT_FATAL
+        if not resp.get("ok"):
+            stderr.write(f"Error: logs failed "
+                         f"({resp.get('error')}): "
+                         f"{resp.get('detail', '')}\n")
+            return EXIT_FATAL
+        lines = resp.get("lines") or []
+    else:
+        if len(positional) != 1:
+            stderr.write(f"{_CLIENT_USAGE}\nError: logs needs "
+                         "--socket=TARGET or exactly one log FILE\n")
+            return EXIT_USAGE
+        import os
+        path = positional[0]
+        if not os.path.exists(path) \
+                and not os.path.exists(path + ".1"):
+            stderr.write(f"Error: no event log at {path}\n")
+            return EXIT_FATAL
+        from pwasm_tpu.obs.logquery import query_log
+        lines = query_log(path, trace_id=trace_id, job_id=job_id,
+                          event=event, limit=limit)
+    for rec in lines:
+        json.dump(rec, stdout, separators=(",", ":"))
+        stdout.write("\n")
+    return 0
+
+
 def client_main(cmd: str, argv: list[str], stdout=None,
                 stderr=None) -> int:
     """The ``pwasm-tpu submit`` / ``pwasm-tpu stream`` /
     ``pwasm-tpu svc-stats`` entry point."""
     stdout = stdout if stdout is not None else sys.stdout
     stderr = stderr if stderr is not None else sys.stderr
-    opts, job_argv = _parse_client_argv(argv)
+    opts, job_argv = _parse_client_argv(argv, cmd)
     sock = opts.get("socket")
+    if cmd == "logs":
+        # the one socket-optional verb: `logs FILE` queries a log on
+        # disk directly (same filter engine the daemon runs)
+        return _logs_main(opts, job_argv, sock, stdout, stderr)
     if not sock:
         stderr.write(f"{_CLIENT_USAGE}\nError: --socket=PATH is "
                      "required\n")
@@ -485,11 +624,32 @@ def client_main(cmd: str, argv: list[str], stdout=None,
             with ServiceClient(
                     sock, trace_id=opts.get("trace_id"),
                     client_token=opts.get("client_token")) as c:
-                resp = c.metrics()
+                resp = c.metrics(
+                    exemplars=bool(opts.get("exemplars")))
             if not resp.get("ok"):
                 stderr.write(f"Error: metrics failed: {resp}\n")
                 return EXIT_FATAL
             stdout.write(resp.get("metrics", ""))
+            return 0
+        if cmd == "health":
+            with ServiceClient(
+                    sock, trace_id=opts.get("trace_id"),
+                    client_token=opts.get("client_token")) as c:
+                resp = c.health()
+            if not resp.get("ok"):
+                stderr.write(f"Error: health failed "
+                             f"({resp.get('error')}): "
+                             f"{resp.get('detail', '')}\n")
+                return EXIT_FATAL
+            health = resp.get("health") or {}
+            json.dump(health, stdout, indent=2)
+            stdout.write("\n")
+            if opts.get("exit_code"):
+                # the orchestrator-probe form: 0 ok / 1 degraded /
+                # 2 failing (unknown ranks degraded — a probe must
+                # never read a parse problem as health)
+                from pwasm_tpu.obs.slo import verdict_exit_code
+                return verdict_exit_code(health.get("verdict"))
             return 0
         if cmd == "inspect":
             if len(job_argv) != 1:

@@ -68,6 +68,7 @@ _SERVE_USAGE = """Usage:
                  [--log-json=FILE] [--log-json-max-bytes=N]
                  [--trace-json=FILE]
                  [--result-ttl-s=S] [--max-results=N]
+                 [--canary-interval=S] [--slo-rules=FILE|off]
 
    --socket=PATH        unix socket to listen on (required)
    --listen=HOST:PORT   ALSO serve the same protocol over TCP (the
@@ -185,6 +186,21 @@ _SERVE_USAGE = """Usage:
                         job ids answer unknown_job
    --max-results=N      keep at most N finished-job results (least-
                         recently-accessed evicted first)
+   --canary-interval=S  run a synthetic canary probe every S seconds
+                        (service/canary.py): the deterministic warmup
+                        corpus through a free lane's normal serving
+                        path, byte-verified against a golden digest —
+                        pwasm_canary_* metrics feed the canary_failing
+                        SLO rule, so a silently-wedged lane fires an
+                        alert instead of waiting for a user job
+   --slo-rules=FILE|off JSON list of SLO rule objects merged over the
+                        default set (obs/catalog.py; a rule with a
+                        default's name replaces it) — evaluated
+                        continuously by the in-process engine
+                        (obs/slo.py) feeding pwasm_alerts_firing and
+                        the `health` verb; "off" disables the engine
+                        (the self-monitoring A/B knob).  Rule catalog:
+                        docs/OBSERVABILITY.md
 
  SIGTERM/SIGINT (or the `drain` protocol command) drains gracefully:
  in-flight jobs finish at their next batch boundary and checkpoint,
@@ -339,7 +355,9 @@ class Daemon:
                  listen: str | None = None,
                  journal_dir: str | None = None,
                  compile_cache_dir: str | None = None,
-                 warmup: str | None = None):
+                 warmup: str | None = None,
+                 canary_interval_s: float | None = None,
+                 slo_rules=None):
         self.socket_path = socket_path
         # fleet transport (docs/FLEET.md): an optional TCP listener
         # joining the unix socket — same protocol, token-based client
@@ -480,6 +498,36 @@ class Daemon:
                                  events=events, tracer=tracer,
                                  trace_path=trace_json)
         self.drain.obs = self.obs   # SIGTERM/drain lands in the log
+        self.log_json_path = log_json   # the `logs` verb reads it
+        # ---- self-monitoring (ISSUE 14): the SLO engine over THIS
+        # registry (default rules + user --slo-rules merged by name;
+        # slo_rules="off" runs an empty engine — the A/B knob the
+        # selfmon-overhead bench leg flips) and the synthetic canary.
+        from pwasm_tpu.obs.catalog import (build_canary_metrics,
+                                           build_slo_metrics,
+                                           default_slo_rules)
+        from pwasm_tpu.obs.slo import SloEngine, merge_rules
+        self.slo_metrics = build_slo_metrics(self.registry)
+        self.canary_metrics = build_canary_metrics(self.registry)
+        if slo_rules == "off":
+            rules = []
+        else:
+            rules = merge_rules(default_slo_rules(), slo_rules)
+        # evaluate fast enough that a canary failure fires within the
+        # detection contract (two canary intervals), slow enough to
+        # stay invisible next to the 0.2s accept tick
+        eval_s = 1.0
+        if canary_interval_s is not None:
+            eval_s = min(eval_s, max(0.05, canary_interval_s / 2))
+        self.slo = SloEngine(self.registry, rules,
+                             metrics=self.slo_metrics,
+                             on_event=self.obs.event,
+                             eval_interval_s=eval_s)
+        self.canary = None
+        if canary_interval_s is not None:
+            from pwasm_tpu.service.canary import CanaryRunner
+            self.canary = CanaryRunner(self, canary_interval_s,
+                                       self.canary_metrics)
         # ---- result eviction (the PR 5 "results live forever" gap):
         # TTL and/or LRU ceiling over TERMINAL jobs only — running and
         # queued jobs are never touched; an evicted id answers
@@ -601,9 +649,14 @@ class Daemon:
                 # job — in the background, admission is already open
                 threading.Thread(target=self._run_warmup, daemon=True,
                                  name="pwasm-svc-warmup").start()
+            if self.canary is not None:
+                # the synthetic canary loop (ISSUE 14): started after
+                # _jobdir exists — the probe corpus lives under it
+                self.canary.start()
             try:
                 while True:
                     self._evict_results()
+                    self._selfmon_tick()
                     if self.drain.requested:
                         self._begin_drain(self.drain.reason
                                           or "drain requested")
@@ -732,6 +785,25 @@ class Daemon:
             # scrape gap, not an emptied queue)
             m["client_queue_depth"].set(depths.get(c, 0),
                                         client=c or "default")
+
+    def _selfmon_tick(self) -> None:
+        """One accept-loop tick of the SLO engine (ISSUE 14): refresh
+        the gauges the rules read, then evaluate — time-gated inside
+        the engine so the 0.2s accept cadence costs nothing between
+        evaluation intervals."""
+        if self.slo.due():
+            self._refresh_gauges()
+            self.slo.evaluate()
+
+    def _health(self) -> dict:
+        """The `health` verb body: a FRESH evaluation (a probe must
+        see now, not the last timer tick), the verdict + firing rules,
+        and the canary roll-up."""
+        self._refresh_gauges()
+        h = self.slo.evaluate()
+        h["canary"] = self.canary.summary() \
+            if self.canary is not None else None
+        return h
 
     def _write_textfile(self) -> None:
         """Atomic textfile publish (fsync-then-replace via
@@ -1307,10 +1379,14 @@ class Daemon:
         from pwasm_tpu.obs.catalog import fold_run_stats
         self.svc_metrics["jobs"].inc(outcome=job.state)
         self.svc_metrics["lane_jobs"].inc(lane=str(lease.lane))
+        # exemplar-linked (ISSUE 14 satellite): the bucket this job
+        # landed in carries its trace_id, so a p99 bucket in the
+        # exposition links straight to `pwasm-tpu inspect <job>`
         self.svc_metrics["job_wall_seconds"].observe(
-            job.finished_s - job.started_s)
+            job.finished_s - job.started_s, trace_id=job.trace_id)
         self.svc_metrics["queue_wait_seconds"].observe(
-            max(0.0, job.started_s - job.submitted_s))
+            max(0.0, job.started_s - job.submitted_s),
+            trace_id=job.trace_id)
         fold_run_stats(self.run_metrics, job.stats)
         # past every RAM consumer of job.stats: big results move to
         # the spool (index-only in RAM), then the terminal verdict —
@@ -1729,12 +1805,32 @@ class Daemon:
                 "max_buffer": self.streams.max_buffer,
                 "max_buffer_total": self.streams.max_total,
             }
+            # additive (stats_version unchanged): the self-monitoring
+            # verdict (ISSUE 14) — `top`'s alerts pane reads it from
+            # the same surface as the JSON verbs
+            st["health"] = self._health()
             return protocol.ok(stats=st)
         if cmd == "metrics":
             self._refresh_gauges()
+            # exemplars are OPT-IN (frame field / `metrics
+            # --exemplars`): the default body stays parseable by
+            # strict 0.0.4 scrapers
             return protocol.ok(
-                metrics=self.registry.expose(),
+                metrics=self.registry.expose(
+                    exemplars=bool(req.get("exemplars"))),
                 content_type="text/plain; version=0.0.4")
+        if cmd == "health":
+            # the machine-readable health verdict (ISSUE 14):
+            # ok/degraded/failing + the firing rules + canary state —
+            # what `pwasm-tpu health --exit-code` and any external
+            # orchestrator probe consume
+            return protocol.ok(health=self._health())
+        if cmd == "logs":
+            # the incident-query verb (ISSUE 14 satellite): filter
+            # THIS daemon's --log-json (rotated .1 generation
+            # included) by trace_id/job/event — the same query
+            # `pwasm-tpu logs FILE` runs locally
+            return protocol.handle_logs(req, self.log_json_path)
         if cmd == "drain":
             self.drain.request("drain requested by client")
             self._begin_drain(self.drain.reason)
@@ -2078,6 +2174,31 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
             stderr.write(f"{_SERVE_USAGE}\nInvalid --stream-idle-s "
                          f"value: {val}\n")
             return EXIT_USAGE
+    canary_interval_s = None
+    val = opts.pop("canary-interval", None)
+    if val is not None:
+        import math
+        try:
+            canary_interval_s = float(val)
+            if canary_interval_s <= 0 \
+                    or not math.isfinite(canary_interval_s):
+                raise ValueError
+        except (TypeError, ValueError):
+            stderr.write(f"{_SERVE_USAGE}\nInvalid --canary-interval "
+                         f"value: {val}\n")
+            return EXIT_USAGE
+    slo_rules = None
+    val = opts.pop("slo-rules", None)
+    if val is not None:
+        if val == "off":
+            slo_rules = "off"
+        else:
+            from pwasm_tpu.obs.slo import load_rules_file
+            try:
+                slo_rules = load_rules_file(val)
+            except ValueError as e:
+                stderr.write(f"{_SERVE_USAGE}\nError: {e}\n")
+                return EXIT_USAGE
     metrics_textfile = opts.pop("metrics-textfile", None)
     log_json = opts.pop("log-json", None)
     trace_json = opts.pop("trace-json", None)
@@ -2131,7 +2252,9 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
                         trace_json=trace_json,
                         listen=listen, journal_dir=journal_dir,
                         compile_cache_dir=compile_cache_dir,
-                        warmup=warmup)
+                        warmup=warmup,
+                        canary_interval_s=canary_interval_s,
+                        slo_rules=slo_rules)
     except OSError:
         stderr.write(f"Cannot open file {log_json} for writing!\n")
         return EXIT_USAGE

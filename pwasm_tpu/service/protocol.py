@@ -61,6 +61,18 @@ Commands (``{"cmd": ...}``):
                drain is requested — the job stops at its next batch
                boundary, leaving a valid resumable checkpoint.
 ``stats``      the service-level counters (versioned schema).
+``health``     the self-monitoring verdict (ISSUE 14): ok/degraded/
+               failing, the firing SLO rules (docs/OBSERVABILITY.md
+               rule catalog) and canary state; a fleet router folds
+               every member's verdict into one fleet verdict.
+               Surfaced by ``pwasm-tpu health [--exit-code]``.
+``logs``       filter the server's ``--log-json`` NDJSON event log
+               (rotated ``.1`` generation included) by
+               ``filter_trace_id`` / ``job_id`` / ``event``, newest
+               ``limit`` (default 1000, max 10000) matches returned
+               oldest-first.  (The filter field is ``filter_trace_id``
+               because every frame already carries the CONNECTION's
+               own ``trace_id``.)
 ``drain``      begin the same graceful drain a SIGTERM triggers: reject
                new submissions, finish in-flight jobs at batch
                boundaries, mark queued jobs preempted-resumable, exit
@@ -137,6 +149,30 @@ def resolve_client_identity(req: dict, peer: str | None) -> str:
     if isinstance(tok, str) and tok:
         return "tok:" + tok
     return peer or ""
+
+
+def handle_logs(req: dict, log_path: str | None) -> dict:
+    """The ``logs`` verb body, shared by the serve daemon and the
+    fleet router (one implementation, so a limit-bound or filter-field
+    change cannot land in only one of them): validate the limit,
+    filter the server's own ``--log-json`` via ``obs/logquery.py``
+    (rotated ``.1`` generation included), answer the newest matches
+    oldest-first."""
+    if not log_path:
+        return err(ERR_BAD_REQUEST,
+                   "this server runs without --log-json; there is "
+                   "no event log to query")
+    limit = req.get("limit", 1000)
+    if not isinstance(limit, int) or isinstance(limit, bool) \
+            or not 1 <= limit <= 10000:
+        return err(ERR_BAD_REQUEST,
+                   "limit must be an integer in [1, 10000]")
+    from pwasm_tpu.obs.logquery import query_log
+    lines = query_log(log_path,
+                      trace_id=req.get("filter_trace_id"),
+                      job_id=req.get("job_id"),
+                      event=req.get("event"), limit=limit)
+    return ok(lines=lines, path=log_path)
 
 
 def ok(**fields) -> dict:

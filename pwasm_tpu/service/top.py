@@ -82,6 +82,43 @@ def render(st: dict) -> str:
         if recovered:
             out.append(" recovered: " + "  ".join(
                 f"{k} {v}" for k, v in recovered.items()))
+    # the alerts pane (ISSUE 14): the SLO engine's verdict + firing
+    # rules, from the same health block the `health` verb serves —
+    # "is anything wrong" before any counter reading.  On a fleet
+    # view the verdict can be degraded/failing through a MEMBER's own
+    # rules while the router's are all quiet — those members render
+    # here too, or the pane would say "none" under a failing verdict.
+    health = st.get("health") or {}
+    firing = health.get("firing") or []
+    bad_members = {n: m for n, m in
+                   (health.get("members") or {}).items()
+                   if isinstance(m, dict)
+                   and m.get("verdict") not in ("ok", None)}
+    if health:
+        parts = [
+            f"{f.get('rule', '?')}[{f.get('severity', '?')}"
+            + (f" {f.get('since_s', 0):.0f}s" if f.get("since_s")
+               else "") + "]"
+            for f in firing if isinstance(f, dict)]
+        parts += [
+            f"{n}={m.get('verdict')}"
+            + (f"({','.join(str(r) for r in m.get('firing'))})"
+               if m.get("firing") else "")
+            for n, m in sorted(bad_members.items())]
+        if parts or health.get("verdict", "ok") != "ok":
+            out.append(
+                f" ALERTS ({health.get('verdict', '?')}): "
+                + ("  ".join(parts) if parts else "(see members)"))
+        else:
+            out.append(" ALERTS: none")
+    canary = health.get("canary") or {}
+    if canary.get("runs"):
+        ok = canary.get("last_ok")
+        out.append(
+            f" canary: {'ok' if ok else 'FAILING'} "
+            f"({canary.get('runs', 0)} runs, "
+            f"{canary.get('fails', 0)} fails, last "
+            f"{canary.get('last_wall_s') or 0:.3f}s)")
     out.append(
         f" jobs: {st.get('running', 0)} running, "
         f"{st.get('queue_depth', 0)} queued | "

@@ -122,6 +122,12 @@ METRIC_REGISTER_RE = re.compile(
 METRIC_NAME_RE = re.compile(r"^pwasm_[a-z0-9]+(_[a-z0-9]+)*$")
 METRIC_LITERAL_RE = re.compile(r"""["'](pwasm_[A-Za-z0-9_]*)["']""")
 
+# the registration region of the catalog ends at this sentinel line:
+# everything below it REFERENCES registered families (the default SLO
+# rule expressions, ISSUE 14), so the uniqueness scan must not read a
+# rule's metric reference as a second registration
+CATALOG_END_SENTINEL = "metric-name-lint: end-of-registrations"
+
 # ---- metric doc-drift rule (ISSUE 11 satellite) -----------------------
 # docs/OBSERVABILITY.md is the operator's catalog of record: a metric
 # family registered in obs/catalog.py but absent from the doc is a
@@ -129,6 +135,20 @@ METRIC_LITERAL_RE = re.compile(r"""["'](pwasm_[A-Za-z0-9_]*)["']""")
 # catalog name literal the doc never mentions (substring match — the
 # doc tables and prose both count).
 METRIC_DOC = "docs/OBSERVABILITY.md"
+
+# ---- self-monitoring gates (ISSUE 14 satellite) -----------------------
+# The SLO engine and the canary run INSIDE the daemon's accept loop
+# and worker threads: they are held to the same jax-free rule as the
+# rest of service/obs (the directory walks already cover them), and
+# additionally they must EXIST — a refactor that drops either silently
+# removes the self-monitoring surface the fleet verdict depends on.
+SLO_FILES = ("pwasm_tpu/obs/slo.py", "pwasm_tpu/service/canary.py")
+
+# default SLO rule names are declared in the catalog's rules region
+# (below the sentinel) as {"name": "..."} literals; each must appear
+# in docs/OBSERVABILITY.md — an undocumented rule is an alert an
+# operator cannot know to act on
+RULE_NAME_RE = re.compile(r"""["']name["']\s*:\s*["']([a-z0-9_]+)["']""")
 
 
 def find_hits(root: str = REPO) -> list[tuple[str, int, str]]:
@@ -276,6 +296,9 @@ def find_metric_lint(root: str = REPO) -> list[str]:
     seen: dict[str, int] = {}
     with open(catalog_path, encoding="utf-8") as f:
         for i, line in enumerate(f, 1):
+            if CATALOG_END_SENTINEL in line:
+                break   # below: rule metric REFERENCES, not
+                #         registrations (see the sentinel comment)
             if line.lstrip().startswith("#"):
                 continue
             for name in METRIC_LITERAL_RE.findall(line):
@@ -302,12 +325,61 @@ def catalog_metric_names(root: str = REPO) -> dict[str, int]:
         return names
     with open(catalog_path, encoding="utf-8") as f:
         for i, line in enumerate(f, 1):
+            if CATALOG_END_SENTINEL in line:
+                break
             if line.lstrip().startswith("#"):
                 continue
             for name in METRIC_LITERAL_RE.findall(line):
                 if METRIC_NAME_RE.match(name):
                     names.setdefault(name, i)
     return names
+
+
+def catalog_rule_names(root: str = REPO) -> dict[str, int]:
+    """Every default SLO rule name declared in the catalog's rules
+    region (after the sentinel), with its line number — the
+    registration side of the rule doc-drift check (ISSUE 14)."""
+    catalog_path = os.path.join(root, *METRIC_CATALOG.split("/"))
+    names: dict[str, int] = {}
+    if not os.path.isfile(catalog_path):
+        return names
+    in_rules = False
+    with open(catalog_path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            if CATALOG_END_SENTINEL in line:
+                in_rules = True
+                continue
+            if not in_rules or line.lstrip().startswith("#"):
+                continue
+            for name in RULE_NAME_RE.findall(line):
+                names.setdefault(name, i)
+    return names
+
+
+def find_slo_violations(root: str = REPO) -> list[str]:
+    """Self-monitoring gate (ISSUE 14 satellite): obs/slo.py and
+    service/canary.py must exist AND stay jax-free — the engine and
+    the canary run inside the daemon's accept loop and worker
+    threads, tier-1 like the rest of service/obs/stream/fleet."""
+    out: list[str] = []
+    for rel in SLO_FILES:
+        path = os.path.join(root, *rel.split("/"))
+        if not os.path.isfile(path):
+            out.append(f"{rel}: self-monitoring module missing — the "
+                       "SLO engine / canary surface the fleet health "
+                       "verdict depends on")
+            continue
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                if line.lstrip().startswith("#"):
+                    continue
+                if SERVICE_PATTERNS.search(line):
+                    out.append(
+                        f"{rel}:{i}: self-monitoring module touches "
+                        f"jax directly: {line.strip()} — the engine "
+                        "and canary must stay jax-free (device work "
+                        "goes through the injected runner)")
+    return out
 
 
 def find_doc_drift(root: str = REPO) -> list[str]:
@@ -329,6 +401,16 @@ def find_doc_drift(root: str = REPO) -> list[str]:
                 f"{METRIC_CATALOG}:{lineno}: metric {name!r} is "
                 f"registered but undocumented — add it to "
                 f"{METRIC_DOC}")
+    # the rule-name half (ISSUE 14 satellite): every default SLO rule
+    # must appear in the doc's rule catalog — `health` says a rule
+    # name to an operator, the doc owes them its meaning + runbook
+    for name, lineno in sorted(catalog_rule_names(root).items(),
+                               key=lambda kv: kv[1]):
+        if name not in doc_text:
+            out.append(
+                f"{METRIC_CATALOG}:{lineno}: SLO rule {name!r} is "
+                f"shipped as a default but undocumented — add it to "
+                f"the rule table in {METRIC_DOC}")
     return out
 
 
@@ -349,13 +431,14 @@ def main() -> int:
     metric = find_metric_lint()
     doc_drift = find_doc_drift()
     sharding = find_sharding_violations()
+    slo = find_slo_violations()
     for line in bad:
         print(line, file=sys.stderr)
     for rel in stale:
         print(f"{rel}: stale registry entry (no device entry points "
               "left — remove it)", file=sys.stderr)
     for line in svc + obs + stream + fleet + metric + doc_drift \
-            + sharding:
+            + sharding + slo:
         print(line, file=sys.stderr)
     if bad:
         print(f"\n{len(bad)} device entry point(s) outside the "
@@ -385,8 +468,12 @@ def main() -> int:
               f"use(s): import shard_map/psum/ppermute/pcast from "
               f"{JAXCOMPAT} instead, so a jax pin change costs one "
               "edit there.", file=sys.stderr)
+    if slo:
+        print(f"\n{len(slo)} self-monitoring gate failure(s): "
+              "obs/slo.py and service/canary.py must exist and stay "
+              "jax-free (ISSUE 14).", file=sys.stderr)
     return 1 if (bad or stale or svc or obs or stream or fleet
-                 or metric or doc_drift or sharding) else 0
+                 or metric or doc_drift or sharding or slo) else 0
 
 
 if __name__ == "__main__":

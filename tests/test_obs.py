@@ -123,7 +123,11 @@ def test_exposition_escaping():
 
 _SAMPLE_RE = re.compile(
     r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z0-9_]+="(\\.|[^"\\])*"'
-    r'(,[a-zA-Z0-9_]+="(\\.|[^"\\])*")*\})? -?[0-9.e+Inf-]+$')
+    r'(,[a-zA-Z0-9_]+="(\\.|[^"\\])*")*\})? -?[0-9.e+Inf-]+'
+    # the optional OpenMetrics exemplar suffix (ISSUE 14): histogram
+    # bucket samples may carry `# {trace_id="..."} value ts`
+    r'( # \{[a-zA-Z0-9_]+="(\\.|[^"\\])*"\}'
+    r' -?[0-9.e+-]+( -?[0-9.e+-]+)?)?$')
 
 
 def assert_valid_exposition(text: str) -> None:

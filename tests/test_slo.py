@@ -1,0 +1,858 @@
+"""Self-monitoring serving (ISSUE 14): SLO engine, synthetic canary
+probes, fleet health verdicts, log queries, exemplars.
+
+Acceptance contracts:
+
+- **the detection drill**: on a 3-member routed fleet with
+  ``--canary-interval`` and the default rules, an injected outage on
+  ONE member's serving path surfaces as a firing rule in the
+  ROUTER's ``health`` verdict within two canary intervals, resolves
+  after the member heals, and the firing→resolved transitions appear
+  in the member's event log in order;
+- **byte neutrality**: job outputs through a self-monitored fleet are
+  byte-identical to a fleet running with the engine and canary off;
+- **orchestrator probes**: ``pwasm-tpu health --exit-code`` answers
+  0/1/2 for ok/degraded/failing;
+- **the engine is declarative**: threshold (+ratio, +for_s), rate
+  (windowed counter increase) and multi-window burn-rate rules over
+  the live registry, with user rules merged by name from
+  ``--slo-rules=FILE``.
+"""
+
+import io
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+from contextlib import contextmanager
+from types import SimpleNamespace
+
+import pytest
+
+from pwasm_tpu.fleet.router import Router, route_main
+from pwasm_tpu.obs.catalog import (build_canary_metrics,
+                                   build_fleet_metrics,
+                                   build_service_metrics,
+                                   build_slo_metrics,
+                                   default_fleet_slo_rules,
+                                   default_slo_rules)
+from pwasm_tpu.obs.logquery import query_log, record_matches
+from pwasm_tpu.obs.metrics import MetricsRegistry
+from pwasm_tpu.obs.slo import (SloEngine, load_rules_file,
+                               merge_rules, parse_rules,
+                               validate_rule, verdict_exit_code,
+                               worst_verdict)
+from pwasm_tpu.service.client import (ServiceClient, client_main,
+                                      wait_for_socket)
+from pwasm_tpu.service.daemon import Daemon, serve_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# engine units
+# ---------------------------------------------------------------------------
+def _engine(rules, reg=None):
+    reg = reg or MetricsRegistry()
+    return reg, SloEngine(reg, rules, metrics=build_slo_metrics(reg),
+                          eval_interval_s=0.01)
+
+
+def test_threshold_fire_resolve_and_transitions():
+    reg = MetricsRegistry()
+    g = reg.gauge("pwasm_test_depth", "h")
+    events = []
+    eng = SloEngine(
+        reg,
+        [{"name": "deep", "kind": "threshold",
+          "metric": "pwasm_test_depth", "op": ">", "value": 5,
+          "severity": "page"}],
+        metrics=build_slo_metrics(reg),
+        on_event=lambda ev, **f: events.append((ev, f)))
+    # the firing gauge exists (0) before anything fires
+    assert reg.get("pwasm_alerts_firing").value(rule="deep") == 0
+    assert eng.evaluate()["verdict"] == "ok"
+    g.set(9)
+    v = eng.evaluate()
+    assert v["verdict"] == "failing"
+    (f,) = v["firing"]
+    assert f["rule"] == "deep" and f["severity"] == "page"
+    assert f["value"] == 9 and "pwasm_test_depth" in f["detail"]
+    assert reg.get("pwasm_alerts_firing").value(rule="deep") == 1
+    g.set(2)
+    assert eng.evaluate()["verdict"] == "ok"
+    t = reg.get("pwasm_alert_transitions_total")
+    assert t.value(rule="deep", state="firing") == 1
+    assert t.value(rule="deep", state="resolved") == 1
+    assert [e for e, _ in events] == ["alert_firing",
+                                      "alert_resolved"]
+
+
+def test_threshold_ratio_and_labeled_any_cell():
+    reg = MetricsRegistry()
+    depth = reg.gauge("pwasm_test_client_depth", "h",
+                      labels=("client",))
+    quota = reg.gauge("pwasm_test_quota", "h")
+    _, eng = _engine(
+        [{"name": "pressure", "kind": "threshold",
+          "metric": "pwasm_test_client_depth",
+          "divide_by": "pwasm_test_quota", "op": ">", "value": 0.8}],
+        reg)
+    quota.set(10)
+    depth.set(3, client="a")
+    depth.set(4, client="b")
+    assert eng.evaluate()["verdict"] == "ok"
+    depth.set(9, client="b")      # one cell over: any-cell fires
+    v = eng.evaluate()
+    assert v["verdict"] == "degraded"
+    assert "client=b" in v["firing"][0]["detail"]
+
+
+def test_threshold_for_s_holds_before_firing():
+    reg = MetricsRegistry()
+    g = reg.gauge("pwasm_test_level", "h")
+    _, eng = _engine(
+        [{"name": "held", "kind": "threshold",
+          "metric": "pwasm_test_level", "op": ">=", "value": 1,
+          "for_s": 10.0}], reg)
+    g.set(1)
+    t0 = 1000.0
+    assert eng.evaluate(now=t0)["verdict"] == "ok"       # pending
+    assert eng.evaluate(now=t0 + 5)["verdict"] == "ok"   # still held
+    assert eng.evaluate(now=t0 + 11)["verdict"] == "degraded"
+    # a dip resets the hold clock
+    g.set(0)
+    assert eng.evaluate(now=t0 + 12)["verdict"] == "ok"
+    g.set(1)
+    assert eng.evaluate(now=t0 + 13)["verdict"] == "ok"
+    assert eng.evaluate(now=t0 + 24)["verdict"] == "degraded"
+
+
+def test_rate_rule_window_and_zero_baseline():
+    reg = MetricsRegistry()
+    c = reg.counter("pwasm_test_replays_total", "h")
+    _, eng = _engine(
+        [{"name": "replayed", "kind": "rate",
+          "metric": "pwasm_test_replays_total", "op": ">",
+          "value": 0, "window_s": 60.0, "baseline": "zero"}], reg)
+    c.inc(1)            # a "startup replay" before the first sample
+    t0 = 2000.0
+    # baseline=zero: pre-engine history counts as an increase
+    assert eng.evaluate(now=t0)["verdict"] == "degraded"
+    # ...and resolves once the window slides past it
+    assert eng.evaluate(now=t0 + 30)["verdict"] == "degraded"
+    assert eng.evaluate(now=t0 + 61)["verdict"] == "ok"
+    c.inc(1)            # a fresh increase re-fires
+    assert eng.evaluate(now=t0 + 62)["verdict"] == "degraded"
+    assert eng.evaluate(now=t0 + 130)["verdict"] == "ok"
+
+
+def test_rate_rule_first_baseline_ignores_history():
+    reg = MetricsRegistry()
+    c = reg.counter("pwasm_test_drops_total", "h")
+    c.inc(40)
+    _, eng = _engine(
+        [{"name": "drops", "kind": "rate",
+          "metric": "pwasm_test_drops_total", "op": ">", "value": 0,
+          "window_s": 60.0}], reg)
+    t0 = 3000.0
+    assert eng.evaluate(now=t0)["verdict"] == "ok"   # history invisible
+    c.inc(1)
+    assert eng.evaluate(now=t0 + 1)["verdict"] == "degraded"
+
+
+def test_burn_rate_two_windows():
+    reg = MetricsRegistry()
+    h = reg.histogram("pwasm_test_wall_seconds", "h",
+                      buckets=(0.1, 1.0, 10.0))
+    _, eng = _engine(
+        [{"name": "burn", "kind": "burn_rate",
+          "metric": "pwasm_test_wall_seconds", "objective_s": 1.0,
+          "budget": 0.10, "short_s": 60.0, "long_s": 300.0}], reg)
+    t0 = 5000.0
+    for _ in range(20):
+        h.observe(0.05)
+    assert eng.evaluate(now=t0)["verdict"] == "ok"
+    # 50% of fresh observations above the 1s objective: both windows
+    # over the 10% budget -> fires
+    for _ in range(10):
+        h.observe(5.0)
+        h.observe(0.05)
+    v = eng.evaluate(now=t0 + 10)
+    assert v["verdict"] == "degraded"
+    assert v["firing"][0]["rule"] == "burn"
+    # the bleeding stops; the short window clears first and the rule
+    # resolves even while the long window still remembers
+    for _ in range(100):
+        h.observe(0.05)
+    assert eng.evaluate(now=t0 + 80)["verdict"] == "ok"
+
+
+def test_no_data_rules_do_not_fire():
+    _, eng = _engine(
+        [{"name": "ghost", "kind": "threshold",
+          "metric": "pwasm_not_registered", "op": ">", "value": 0},
+         {"name": "ghost_rate", "kind": "rate",
+          "metric": "pwasm_not_registered_total", "op": ">",
+          "value": 0, "window_s": 10.0},
+         {"name": "ghost_burn", "kind": "burn_rate",
+          "metric": "pwasm_not_registered_seconds",
+          "objective_s": 1.0, "budget": 0.1, "short_s": 5.0,
+          "long_s": 10.0}])
+    assert eng.evaluate()["verdict"] == "ok"
+
+
+def test_rule_validation_errors():
+    for bad, msg in (
+            ({"name": "BadName", "metric": "m"}, "snake_case"),
+            ({"name": "x", "metric": "m", "severity": "meh"},
+             "severity"),
+            ({"name": "x", "metric": "m", "kind": "wat"}, "kind"),
+            ({"name": "x", "metric": "m", "op": "~="}, "op"),
+            ({"name": "x", "metric": "m", "value": "9"}, "number"),
+            ({"name": "x", "metric": "m", "value": 1,
+              "surprise": 1}, "unknown field"),
+            ({"name": "x", "kind": "burn_rate", "metric": "m",
+              "objective_s": 1, "budget": 0.1, "short_s": 60,
+              "long_s": 60}, "short_s"),
+            ({"name": "x", "kind": "rate", "metric": "m",
+              "value": 0, "baseline": "maybe"}, "baseline"),
+    ):
+        with pytest.raises(ValueError, match=msg):
+            validate_rule(bad)
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_rules([{"name": "a", "metric": "m", "value": 1},
+                     {"name": "a", "metric": "m", "value": 2}])
+    with pytest.raises(ValueError, match="JSON list"):
+        parse_rules({"name": "a"})
+
+
+def test_default_rule_sets_validate():
+    # the shipped defaults must themselves pass the user-rule grammar
+    assert len(parse_rules(default_slo_rules())) == 7
+    assert len(parse_rules(default_fleet_slo_rules())) == 3
+
+
+def test_merge_rules_overrides_by_name():
+    merged = merge_rules(
+        default_slo_rules(),
+        parse_rules([{"name": "breaker_open", "kind": "threshold",
+                      "metric": "pwasm_service_breaker_state",
+                      "op": ">=", "value": 1, "severity": "warn"}]))
+    assert len(merged) == len(default_slo_rules())
+    override = [r for r in merged if r["name"] == "breaker_open"]
+    assert override[0]["value"] == 1.0
+    assert override[0]["severity"] == "warn"
+
+
+def test_verdict_helpers():
+    assert worst_verdict("ok", "ok") == "ok"
+    assert worst_verdict("ok", "degraded") == "degraded"
+    assert worst_verdict("degraded", "failing") == "failing"
+    assert worst_verdict("ok", "garbled") == "degraded"
+    assert worst_verdict() == "ok"
+    assert [verdict_exit_code(v) for v in
+            ("ok", "degraded", "failing", "???")] == [0, 1, 2, 1]
+
+
+def test_load_rules_file(tmp_path):
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps([
+        {"name": "my_rule", "kind": "threshold",
+         "metric": "pwasm_service_queue_depth", "op": ">",
+         "value": 3, "severity": "warn"}]))
+    rules = load_rules_file(str(p))
+    assert rules[0]["name"] == "my_rule"
+    p.write_text("{not json")
+    with pytest.raises(ValueError, match="not valid JSON"):
+        load_rules_file(str(p))
+    p.write_text(json.dumps([{"name": "x"}]))
+    with pytest.raises(ValueError, match="metric"):
+        load_rules_file(str(p))
+    with pytest.raises(ValueError, match="cannot read"):
+        load_rules_file(str(tmp_path / "absent.json"))
+
+
+# ---------------------------------------------------------------------------
+# exemplars (ISSUE 14 satellite)
+# ---------------------------------------------------------------------------
+def test_histogram_exemplars_in_exposition():
+    reg = MetricsRegistry()
+    h = reg.histogram("pwasm_test_x_seconds", "h",
+                      buckets=(1.0, 10.0))
+    h.observe(0.5)                       # no trace: plain line
+    h.observe(0.7, trace_id="job-abc")   # latest traced obs wins
+    h.observe(20.0, trace_id="job-inf")  # +Inf bucket exemplar
+    # exemplars are OPT-IN: the default exposition stays pure
+    # Prometheus 0.0.4 (a strict scraper/textfile collector would
+    # reject the trailing '#')
+    assert "# {" not in reg.expose()
+    text = reg.expose(exemplars=True)
+    lines = {l.split(" ", 1)[0].split("{")[0] + l[
+        l.find("{"):l.find("}") + 1]: l
+        for l in text.splitlines() if "_bucket" in l}
+    b1 = lines['pwasm_test_x_seconds_bucket{le="1"}']
+    assert b1.startswith('pwasm_test_x_seconds_bucket{le="1"} 2')
+    assert '# {trace_id="job-abc"} 0.7' in b1
+    binf = lines['pwasm_test_x_seconds_bucket{le="+Inf"}']
+    assert '# {trace_id="job-inf"} 20' in binf
+    # untraced families render exactly as before even when asked
+    reg2 = MetricsRegistry()
+    h2 = reg2.histogram("pwasm_test_y_seconds", "h", buckets=(1.0,))
+    h2.observe(0.5)
+    assert "# {" not in reg2.expose(exemplars=True)
+
+
+# ---------------------------------------------------------------------------
+# log queries (ISSUE 14 satellite)
+# ---------------------------------------------------------------------------
+def test_logquery_rotation_filters_and_limit(tmp_path):
+    log = tmp_path / "ev.ndjson"
+    old = [{"event": "job_admit", "run_id": "r1", "job_id": "j1",
+            "trace_id": "t1"},
+           {"event": "job_finish", "run_id": "r1", "job_id": "j1",
+            "trace_id": "t1"}]
+    new = [{"event": "job_admit", "run_id": "r2", "job_id": "j2",
+            "trace_id": "t2"},
+           {"event": "canary_fail", "run_id": "r2"},
+           "NOT JSON AT ALL",
+           {"event": "job_finish", "run_id": "r2", "job_id": "j2",
+            "trace_id": "t2"}]
+    (tmp_path / "ev.ndjson.1").write_text(
+        "".join(json.dumps(r) + "\n" for r in old))
+    log.write_text("".join(
+        (r if isinstance(r, str) else json.dumps(r)) + "\n"
+        for r in new))
+    # rotation order: .1 generation first, torn lines skipped
+    all_recs = query_log(str(log))
+    assert [r["event"] for r in all_recs] == [
+        "job_admit", "job_finish", "job_admit", "canary_fail",
+        "job_finish"]
+    assert [r["job_id"] for r in
+            query_log(str(log), job_id="j1")] == ["j1", "j1"]
+    assert [r["event"] for r in
+            query_log(str(log), event="canary_fail")] \
+        == ["canary_fail"]
+    # trace filter matches run_id too (a run's own lines)
+    assert len(query_log(str(log), trace_id="r2")) == 3
+    assert len(query_log(str(log), trace_id="t2")) == 2
+    # limit keeps the NEWEST matches
+    assert [r["event"] for r in query_log(str(log), limit=2)] == [
+        "canary_fail", "job_finish"]
+    # a missing log is empty, not an error
+    assert query_log(str(tmp_path / "nope.ndjson")) == []
+    assert record_matches({"event": "e"},
+                          trace_id=None, job_id=None, event=None)
+
+
+# ---------------------------------------------------------------------------
+# in-process daemon/fleet harness (stub runner — no jax, no corpus)
+# ---------------------------------------------------------------------------
+def _box_runner(box):
+    """A controllable stub runner: writes ``box['body']`` to the -o
+    path and answers ``box['rc']`` — flipping the box injects an
+    outage on THIS daemon's serving path (bad bytes = canary digest
+    drift; bad rc = canary failure), restoring it heals."""
+    def runner(argv, stdout=None, stderr=None, warm=None, **kw):
+        out = None
+        for i, a in enumerate(argv):
+            if a == "-o" and i + 1 < len(argv):
+                out = argv[i + 1]
+            elif a.startswith("-o") and len(a) > 2:
+                out = a[2:]
+        if out:
+            try:
+                with open(out, "wb") as f:
+                    f.write(box.get("body", b"OK"))
+            except OSError:
+                pass
+        sp = next((a.split("=", 1)[1] for a in argv
+                   if a.startswith("--stats=")), None)
+        if sp:
+            with open(sp, "w") as f:
+                json.dump({"wall_s": 0.001}, f)
+        return box.get("rc", 0)
+    return runner
+
+
+@contextmanager
+def _daemon(box=None, **kw):
+    box = box if box is not None else {}
+    d = tempfile.mkdtemp(prefix="pwslo")
+    sock = os.path.join(d, os.path.basename(d) + ".sock")
+    err = io.StringIO()
+    dm = Daemon(sock, stderr=err, runner=_box_runner(box), **kw)
+    rcbox: list = []
+    t = threading.Thread(target=lambda: rcbox.append(dm.serve()),
+                         daemon=True)
+    t.start()
+    assert wait_for_socket(sock, 15), err.getvalue()
+    try:
+        yield SimpleNamespace(daemon=dm, sock=sock, box=box, err=err,
+                              dir=d, rc=rcbox)
+    finally:
+        if not dm.drain.requested:
+            dm.drain.request("test teardown")
+        t.join(20)
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def _wait_canary_runs(sock, n=1, budget_s=15.0):
+    """Block until the canary has completed >= n probes — the golden
+    digest must be captured from a HEALTHY run before a test injects
+    its outage."""
+    deadline = time.monotonic() + budget_s
+    runs = 0
+    while time.monotonic() < deadline:
+        with ServiceClient(sock) as c:
+            runs = (c.health()["health"]["canary"]
+                    or {}).get("runs", 0)
+        if runs >= n:
+            return True
+        time.sleep(0.03)
+    return False
+
+
+def _wait_health(sock, want, budget_s=10.0):
+    """Poll the health verb until the verdict is ``want``; returns
+    (seconds waited, last health dict)."""
+    t0 = time.monotonic()
+    h = None
+    while time.monotonic() - t0 < budget_s:
+        with ServiceClient(sock) as c:
+            h = c.health()["health"]
+        if h["verdict"] == want:
+            return time.monotonic() - t0, h
+        time.sleep(0.03)
+    return time.monotonic() - t0, h
+
+
+# ---------------------------------------------------------------------------
+# canary + health on one daemon
+# ---------------------------------------------------------------------------
+def test_canary_probes_and_health_verb():
+    with _daemon(canary_interval_s=0.05) as h:
+        deadline = time.monotonic() + 10
+        health = None
+        while time.monotonic() < deadline:
+            with ServiceClient(h.sock) as c:
+                health = c.health()["health"]
+            if (health["canary"] or {}).get("runs", 0) >= 2:
+                break
+            time.sleep(0.03)
+        assert health["verdict"] == "ok"
+        can = health["canary"]
+        assert can["runs"] >= 2 and can["fails"] == 0
+        assert can["last_ok"] is True
+        assert health["rules"] == 7          # the default set
+        # canary runs never enter the job table or the journal
+        assert h.daemon.jobs == {}
+        # canary families are live
+        with ServiceClient(h.sock) as c:
+            plain = c.metrics()["metrics"]
+            m = c.metrics(exemplars=True)["metrics"]
+        assert "pwasm_canary_ok 1" in plain
+        assert 'pwasm_canary_runs_total{outcome="ok"}' in plain
+        # exemplars only on request (default stays strict 0.0.4);
+        # the canary wall histogram carries probe exemplars
+        assert "# {" not in plain
+        assert '# {trace_id="canary-' in m
+
+
+def test_canary_failure_fires_and_recloses():
+    with _daemon(canary_interval_s=0.05) as h:
+        assert _wait_canary_runs(h.sock)
+        h.box["body"] = b"CORRUPTED"       # the injected outage
+        waited, health = _wait_health(h.sock, "failing")
+        assert health["verdict"] == "failing", health
+        rules = [f["rule"] for f in health["firing"]]
+        assert "canary_failing" in rules
+        assert "digest drift" in health["canary"]["last_detail"]
+        h.box["body"] = b"OK"              # heal
+        _, health = _wait_health(h.sock, "ok")
+        assert health["verdict"] == "ok", health
+        t = h.daemon.registry.get("pwasm_alert_transitions_total")
+        assert t.value(rule="canary_failing", state="firing") >= 1
+        assert t.value(rule="canary_failing", state="resolved") >= 1
+
+
+def test_canary_bad_rc_fires_too():
+    with _daemon(canary_interval_s=0.05) as h:
+        assert _wait_canary_runs(h.sock)
+        h.box["rc"] = 3
+        _, health = _wait_health(h.sock, "failing")
+        assert "canary_failing" in [f["rule"] for f in
+                                    health["firing"]]
+        assert "exit 3" in health["canary"]["last_detail"]
+
+
+def test_health_exit_code_matrix(tmp_path):
+    # ok = 0
+    with _daemon(canary_interval_s=0.05) as h:
+        assert _wait_canary_runs(h.sock)
+        out = io.StringIO()
+        rc = client_main("health", [f"--socket={h.sock}",
+                                    "--exit-code"], out,
+                         io.StringIO())
+        assert rc == 0
+        doc = json.loads(out.getvalue())
+        assert doc["verdict"] == "ok" and doc["canary"]["runs"] >= 1
+        # without --exit-code the shell rc stays 0 regardless
+        h.box["rc"] = 9
+        _wait_health(h.sock, "failing")
+        assert client_main("health", [f"--socket={h.sock}"],
+                           io.StringIO(), io.StringIO()) == 0
+        # failing = 2
+        rc = client_main("health", [f"--socket={h.sock}",
+                                    "--exit-code"], io.StringIO(),
+                         io.StringIO())
+        assert rc == 2
+    # degraded = 1: a user warn rule that always fires
+    rules = tmp_path / "r.json"
+    rules.write_text(json.dumps([
+        {"name": "always_warn", "kind": "threshold",
+         "metric": "pwasm_service_max_queue", "op": ">=", "value": 1,
+         "severity": "warn"}]))
+    from pwasm_tpu.obs.slo import load_rules_file
+    with _daemon(slo_rules=load_rules_file(str(rules))) as h:
+        _wait_health(h.sock, "degraded")
+        rc = client_main("health", [f"--socket={h.sock}",
+                                    "--exit-code"], io.StringIO(),
+                         io.StringIO())
+        assert rc == 1
+
+
+def test_slo_rules_off_disables_engine():
+    with _daemon(slo_rules="off") as h:
+        with ServiceClient(h.sock) as c:
+            health = c.health()["health"]
+        assert health["verdict"] == "ok" and health["rules"] == 0
+
+
+def test_stats_and_top_carry_the_alerts_pane():
+    from pwasm_tpu.service.top import render
+    with _daemon(canary_interval_s=0.05) as h:
+        assert _wait_canary_runs(h.sock)
+        h.box["body"] = b"DRIFT"
+        _wait_health(h.sock, "failing")
+        with ServiceClient(h.sock) as c:
+            st = c.stats()["stats"]
+        assert st["health"]["verdict"] == "failing"
+        frame = render(st)
+        assert "ALERTS (failing)" in frame
+        assert "canary_failing[page" in frame
+        assert "canary: FAILING" in frame
+    # and a healthy daemon renders the quiet pane
+    frame = render({"health": {"verdict": "ok", "firing": []}})
+    assert "ALERTS: none" in frame
+
+
+def test_logs_verb_socket_and_validation(tmp_path):
+    log = str(tmp_path / "svc.ndjson")
+    with _daemon(log_json=log) as h:
+        out = str(tmp_path / "o.dfa")
+        with ServiceClient(h.sock) as c:
+            jid = c.submit(["in.paf", "-o", out],
+                           cwd=str(tmp_path))["job_id"]
+            r = c.result(jid, timeout=30)
+            assert r["rc"] == 0
+            trace = r["job"]["trace_id"]
+            resp = c.logs(trace_id=trace)
+            assert resp["ok"]
+            evs = [l["event"] for l in resp["lines"]]
+            assert evs == ["job_admit", "job_start", "job_finish"]
+            assert all(l["trace_id"] == trace for l in resp["lines"])
+            # job filter + event filter
+            assert [l["event"] for l in
+                    c.logs(job_id=jid, event="job_finish")["lines"]] \
+                == ["job_finish"]
+            # bad limit is a bad_request, not a dead daemon
+            bad = c.request({"cmd": "logs", "limit": 0})
+            assert bad["error"] == "bad_request"
+    # a daemon without --log-json says so
+    with _daemon() as h:
+        with ServiceClient(h.sock) as c:
+            resp = c.logs()
+        assert not resp["ok"] and "--log-json" in resp["detail"]
+
+
+def test_logs_cli_file_mode(tmp_path):
+    log = tmp_path / "ev.ndjson"
+    log.write_text(json.dumps({"event": "canary_fail",
+                               "run_id": "x"}) + "\n"
+                   + json.dumps({"event": "canary_ok",
+                                 "run_id": "x"}) + "\n")
+    out = io.StringIO()
+    rc = client_main("logs", [str(log), "--event=canary_fail"],
+                     out, io.StringIO())
+    assert rc == 0
+    assert json.loads(out.getvalue())["event"] == "canary_fail"
+    # no socket, no file -> usage
+    err = io.StringIO()
+    assert client_main("logs", ["--event=x"], io.StringIO(), err) != 0
+    # missing file -> pointed error
+    err = io.StringIO()
+    assert client_main("logs", [str(tmp_path / "no.ndjson")],
+                       io.StringIO(), err) != 0
+    assert "no event log" in err.getvalue()
+
+
+def test_serve_main_validates_selfmon_flags(tmp_path):
+    err = io.StringIO()
+    rc = serve_main([f"--socket={tmp_path}/s.sock",
+                     "--canary-interval=0"], stderr=err)
+    assert rc != 0 and "--canary-interval" in err.getvalue()
+    err = io.StringIO()
+    rc = serve_main([f"--socket={tmp_path}/s.sock",
+                     "--canary-interval=nope"], stderr=err)
+    assert rc != 0 and "--canary-interval" in err.getvalue()
+    bad = tmp_path / "bad.json"
+    bad.write_text("{")
+    err = io.StringIO()
+    rc = serve_main([f"--socket={tmp_path}/s.sock",
+                     f"--slo-rules={bad}"], stderr=err)
+    assert rc != 0 and "not valid JSON" in err.getvalue()
+
+
+def test_route_main_validates_slo_rules(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps([{"name": "x"}]))
+    err = io.StringIO()
+    rc = route_main([f"--backends={tmp_path}/m.sock",
+                     f"--socket={tmp_path}/r.sock",
+                     f"--slo-rules={bad}"], stderr=err)
+    assert rc != 0 and "metric" in err.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# the detection drill (acceptance): 3-member fleet, one injected
+# outage -> firing at member AND router within two canary intervals,
+# resolved after heal, transitions in event-log order, bytes neutral
+# ---------------------------------------------------------------------------
+CANARY_S = 0.75
+
+
+@contextmanager
+def _fleet(n=3, canary=True, slo="defaults", member_logs=False,
+           tmp=None):
+    stack, members = [], []
+    try:
+        for i in range(n):
+            kw = {}
+            if canary:
+                kw["canary_interval_s"] = CANARY_S
+            if slo == "off":
+                kw["slo_rules"] = "off"
+            if member_logs:
+                kw["log_json"] = os.path.join(tmp, f"m{i}.ndjson")
+            cm = _daemon(**kw)
+            stack.append(cm)
+            members.append(cm.__enter__())
+        rd = tempfile.mkdtemp(prefix="pwslort")
+        rsock = os.path.join(rd, "router.sock")
+        err = io.StringIO()
+        r = Router([m.sock for m in members], socket_path=rsock,
+                   stderr=err, poll_interval=0.1,
+                   slo_rules="off" if slo == "off" else None)
+        rcbox: list = []
+        t = threading.Thread(target=lambda: rcbox.append(r.serve()),
+                             daemon=True)
+        t.start()
+        assert wait_for_socket(rsock, 15), err.getvalue()
+        try:
+            yield SimpleNamespace(router=r, sock=rsock,
+                                  members=members, err=err)
+        finally:
+            if not r.drain.requested:
+                r.drain.request("test teardown")
+            t.join(20)
+            shutil.rmtree(rd, ignore_errors=True)
+    finally:
+        for cm in reversed(stack):
+            cm.__exit__(None, None, None)
+
+
+def test_fleet_outage_detection_drill(tmp_path):
+    with _fleet(n=3, member_logs=True, tmp=str(tmp_path)) as f:
+        victim = f.members[0]
+        victim_name = os.path.basename(victim.sock)
+        # (0) every member probes healthy; fleet verdict ok
+        for m in f.members:
+            waited, h = _wait_health(m.sock, "ok", budget_s=15)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                with ServiceClient(m.sock) as c:
+                    if (c.health()["health"]["canary"]
+                            or {}).get("runs", 0) >= 1:
+                        break
+                time.sleep(0.05)
+        _, h = _wait_health(f.sock, "ok", budget_s=15)
+        assert h["verdict"] == "ok", h
+        # (1) inject the outage on ONE member's serving path
+        t_inject = time.monotonic()
+        victim.box["body"] = b"WEDGED-LANE-GARBAGE"
+        # (2) the MEMBER's own verdict fails...
+        _, mh = _wait_health(victim.sock, "failing",
+                             budget_s=2 * CANARY_S + 5)
+        assert "canary_failing" in [x["rule"] for x in mh["firing"]]
+        # (3) ...and the ROUTER surfaces it within two canary
+        # intervals of the injection (the acceptance bound; the
+        # budget below only caps the polling loop itself)
+        deadline = time.monotonic() + 2 * CANARY_S + 10
+        detected_at = None
+        fh = None
+        while time.monotonic() < deadline:
+            with ServiceClient(f.sock) as c:
+                fh = c.health()["health"]
+            if fh["verdict"] == "failing":
+                detected_at = time.monotonic()
+                break
+            time.sleep(0.05)
+        assert detected_at is not None, fh
+        detect_wall = detected_at - t_inject
+        assert detect_wall <= 2 * CANARY_S, (
+            f"detection took {detect_wall:.2f}s > two canary "
+            f"intervals ({2 * CANARY_S:.2f}s)")
+        assert fh["members"][victim_name]["verdict"] == "failing"
+        assert "canary_failing" in fh["members"][victim_name][
+            "firing"]
+        # the siblings stay clean
+        for m in f.members[1:]:
+            name = os.path.basename(m.sock)
+            assert fh["members"][name]["verdict"] == "ok", fh
+        # (4) heal ("reclose"): the rule resolves at member and router
+        victim.box["body"] = b"OK"
+        _, mh = _wait_health(victim.sock, "ok",
+                             budget_s=2 * CANARY_S + 10)
+        assert mh["verdict"] == "ok", mh
+        _, fh = _wait_health(f.sock, "ok", budget_s=2 * CANARY_S + 10)
+        assert fh["verdict"] == "ok", fh
+        # (5) transitions land in the member's event log IN ORDER:
+        # canary_fail before alert_firing before canary_ok (healed)
+        # before alert_resolved
+        log = str(tmp_path / "m0.ndjson")
+        evs = [r["event"] for r in query_log(log)]
+        i_fail = evs.index("canary_fail")
+        i_fire = evs.index("alert_firing")
+        i_resolved = evs.index("alert_resolved")
+        i_heal = next(i for i, e in enumerate(evs)
+                      if e == "canary_ok" and i > i_fire)
+        assert i_fail < i_fire < i_heal < i_resolved, evs
+        firing_recs = query_log(log, event="alert_firing")
+        assert firing_recs[0]["rule"] == "canary_failing"
+        assert firing_recs[0]["severity"] == "page"
+
+
+def test_selfmon_byte_parity_on_vs_off(tmp_path):
+    """Job outputs through a self-monitored fleet (canary + engine
+    on) are byte-identical to a fleet with self-monitoring off."""
+    outs = {}
+    for tag, canary, slo in (("on", True, "defaults"),
+                             ("off", False, "off")):
+        with _fleet(n=3, canary=canary, slo=slo) as f:
+            body = b""
+            for k in range(3):
+                out = str(tmp_path / f"{tag}{k}.dfa")
+                with ServiceClient(f.sock) as c:
+                    r = c.result(c.submit(
+                        ["in.paf", "-o", out],
+                        cwd=str(tmp_path))["job_id"], timeout=60)
+                assert r["rc"] == 0, r
+                body += open(out, "rb").read()
+            outs[tag] = body
+    assert outs["on"] == outs["off"] and outs["on"]
+
+
+def test_router_member_down_rule_and_fleet_health():
+    with _fleet(n=2, canary=False) as f:
+        _wait_health(f.sock, "ok", budget_s=15)
+        # drain member 1 away: the router's own member_down rule
+        # fires (page) and the fleet verdict fails without any
+        # member's cooperation
+        f.members[1].daemon.drain.request("die")
+        deadline = time.monotonic() + 15
+        fh = None
+        while time.monotonic() < deadline:
+            with ServiceClient(f.sock) as c:
+                fh = c.health()["health"]
+            if "member_down" in [x["rule"] for x in fh["firing"]]:
+                break
+            time.sleep(0.05)
+        assert fh["verdict"] == "failing", fh
+        name = os.path.basename(f.members[1].sock)
+        assert fh["members"][name]["verdict"] == "unreachable"
+        # failover_burst rides along once the failover pass ran
+        t = f.router.registry.get("pwasm_alert_transitions_total")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if t.value(rule="failover_burst", state="firing") >= 1:
+                break
+            time.sleep(0.05)
+        assert t.value(rule="failover_burst", state="firing") >= 1
+        # fleet stats carry the health block; fleet-aware top shows it
+        from pwasm_tpu.service.top import render
+        with ServiceClient(f.sock) as c:
+            st = c.stats()["stats"]
+        assert st["health"]["verdict"] == "failing"
+        assert "member_down[page" in render(st)
+
+
+# ---------------------------------------------------------------------------
+# qa gates (ISSUE 14 satellite)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def checker():
+    for p in (REPO, os.path.join(REPO, "qa")):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    import check_supervision
+    return check_supervision
+
+
+def test_slo_gate_clean_on_this_tree(checker):
+    assert checker.find_slo_violations() == []
+
+
+def test_slo_gate_detects_jax_and_absence(checker, tmp_path):
+    (tmp_path / "pwasm_tpu" / "obs").mkdir(parents=True)
+    (tmp_path / "pwasm_tpu" / "obs" / "slo.py").write_text(
+        "import jax\n"
+        "# import jax in a comment is NOT a hit\n"
+        "y = jax.device_get(1)\n")
+    bad = checker.find_slo_violations(str(tmp_path))
+    assert sum("slo.py" in b and "jax" in b for b in bad) == 2
+    assert any("canary.py" in b and "missing" in b for b in bad)
+
+
+def test_rule_doc_drift_clean_and_detects(checker, tmp_path):
+    # every shipped default rule name appears in the doc
+    names = checker.catalog_rule_names()
+    assert set(names) == {
+        r["name"] for r in (default_slo_rules()
+                            + default_fleet_slo_rules())}
+    assert checker.find_doc_drift() == []
+    # and the detector actually detects: a rules region naming a rule
+    # the doc does not mention fails
+    (tmp_path / "pwasm_tpu" / "obs").mkdir(parents=True)
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "pwasm_tpu" / "obs" / "catalog.py").write_text(
+        'a = reg.gauge("pwasm_fine_depth", "h")\n'
+        f"# {checker.CATALOG_END_SENTINEL}\n"
+        'RULES = ({"name": "documented_rule", "op": ">"},\n'
+        '         {"name": "ghost_rule", "op": ">"})\n')
+    (tmp_path / "docs" / "OBSERVABILITY.md").write_text(
+        "| `pwasm_fine_depth` | fine |\n"
+        "| `documented_rule` | fine |\n")
+    bad = checker.find_doc_drift(str(tmp_path))
+    assert len(bad) == 1 and "ghost_rule" in bad[0]
+    # rule-region metric references are NOT registrations: a name
+    # repeated below the sentinel must not trip the uniqueness lint
+    (tmp_path / "pwasm_tpu" / "obs" / "catalog.py").write_text(
+        'a = reg.gauge("pwasm_fine_depth", "h")\n'
+        f"# {checker.CATALOG_END_SENTINEL}\n"
+        'RULES = ({"name": "documented_rule", '
+        '"metric": "pwasm_fine_depth"},)\n')
+    assert checker.find_metric_lint(str(tmp_path)) == []
