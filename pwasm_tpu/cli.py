@@ -124,6 +124,19 @@ USAGE = """Usage:
                PWASM_JAX_CACHE_DIR or ~/.cache/pwasm_tpu/jax) — a
                fleet member restarted on the same DIR skips its
                compile wall (docs/FLEET.md)
+   --result-cache=DIR|off  content-addressed RESULT cache
+               (docs/SERVICE.md): a completed run's output files are
+               stored under sha256(ref-FASTA digest, input digest,
+               result-affecting flags, output kinds), and an
+               identical later run — cosmetic argv reorders and
+               output paths excluded — is served the stored bytes in
+               microseconds instead of re-running.  CRC-verified on
+               every serve (rot = miss, never a corrupt serve);
+               --resume/--follow/--inject-faults and unknown flags
+               bypass.  The serve daemon consults the same cache at
+               admission (serve --result-cache)
+   --result-cache-max-bytes=N  evict least-recently-used cache
+               entries past N total bytes
    --many2many    multi-CDS scoring job (docs/STREAMING.md): score
                EVERY query in the -r FASTA against every target in
                the positional FASTA through ONE device session
@@ -563,7 +576,59 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None,
         if infile is None and input_stream is None:
             raise CliError(f"{USAGE}\n--follow requires an input PAF "
                            "file to tail (stdin already streams)\n")
+    # content-addressed result cache (ISSUE 15 / ROADMAP item 2): an
+    # identical job — same inputs by digest, same result-affecting
+    # flags by canonical form — serves its stored output bytes instead
+    # of re-running the pipeline.  service/cache.py owns the key
+    # derivation (the SAME derivation the serve daemon applies at
+    # admission, so cold runs populate what warm serving hits).
+    cache_store = None
+    cache_key_hex = None
+    cache_cls = None
+    rc_dir = opts.get("result-cache")
+    if rc_dir is True:
+        raise CliError(f"{USAGE}\n--result-cache requires a "
+                       "directory (or off)\n")
+    rc_max = None
+    if "result-cache-max-bytes" in opts:
+        val = opts["result-cache-max-bytes"]
+        if val is True or not str(val).isascii() \
+                or not str(val).isdigit() or int(val) < 1:
+            raise CliError(
+                f"{USAGE}\nInvalid --result-cache-max-bytes value: "
+                f"{val}\n")
+        rc_max = int(val)
     try:
+        if isinstance(rc_dir, str) and rc_dir and rc_dir != "off" \
+                and input_stream is None:
+            from pwasm_tpu.service.cache import (CacheStore, classify,
+                                                 derive_key,
+                                                 serve_outputs)
+            cache_cls = classify(opts, positional)
+            if cache_cls is not None:
+                cache_key_hex = derive_key(cache_cls)
+            if cache_key_hex is not None:
+                try:
+                    cache_store = CacheStore(rc_dir, max_bytes=rc_max)
+                except OSError as e:
+                    print(f"Warning: --result-cache dir {rc_dir} "
+                          f"unusable ({e}); caching disabled",
+                          file=stderr)
+            if cache_store is not None:
+                got = cache_store.get(cache_key_hex)
+                served = False
+                if got is not None:
+                    try:
+                        served = serve_outputs(got[1],
+                                               cache_cls.output_paths)
+                    except OSError:
+                        served = False   # unwritable output: fall
+                        #   through to the real run, which reports
+                        #   the canonical "Cannot open file ..."
+                if served:
+                    return _serve_cache_hit(got[0], opts, stderr,
+                                            verbose=bool(
+                                                opts.get("v")))
         if input_stream is not None:
             if infile is not None:
                 raise PwasmError(
@@ -575,8 +640,30 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None,
                 from pwasm_tpu.stream.pafstream import FollowReader
                 inf = FollowReader(infile, idle_timeout_s=follow_idle)
             else:
+                # block-scan ingest (ROADMAP item 5): the host
+                # path walks the input in 1 MiB blocks through the
+                # stream layer's LineAssembler instead of per-record
+                # readline calls — byte-identical to the text-mode
+                # read by the assembler's universal-newline contract
+                # (PWASM_MMAP_INGEST=0 is the A/B hatch; the reader
+                # deliberately avoids mmap — SIGBUS on a concurrently
+                # truncated input would kill a serve daemon whole).
+                # With the result cache armed, the pass also feeds the
+                # content hasher, so the insert-side key costs no
+                # second read of the input.
+                import hashlib as _hashlib
+                import os as _os
                 try:
-                    inf = open(infile)
+                    if _os.environ.get("PWASM_MMAP_INGEST",
+                                       "1") != "0":
+                        from pwasm_tpu.stream.pafstream import \
+                            BlockLineReader
+                        inf = BlockLineReader(
+                            infile,
+                            hasher=_hashlib.sha256()
+                            if cache_store is not None else None)
+                    else:
+                        inf = open(infile)
                 except OSError:
                     raise PwasmError(
                         f"Cannot open input file {infile}!\n")
@@ -873,11 +960,22 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None,
         with device_trace(cfg.profile_dir, stderr), drain_cm as drain:
             with obs.span("run", device=cfg.device), \
                     _lane_device_scope(cfg, warm, stderr):
-                return _main_loop(cfg, inf, freport, fmsa, fsummary,
-                                  summary, qfasta, stdout, stderr,
-                                  cons_outs, resume_skip=resume_skip,
-                                  resume_state=resume_state,
-                                  drain=drain, warm=warm, obs=obs)
+                rc = _main_loop(cfg, inf, freport, fmsa, fsummary,
+                                summary, qfasta, stdout, stderr,
+                                cons_outs, resume_skip=resume_skip,
+                                resume_state=resume_state,
+                                drain=drain, warm=warm, obs=obs)
+        if rc == 0 and cache_store is not None:
+            # populate on the way out: the COMPLETED run's output
+            # files become the entry an identical later job serves.
+            # The ingest reader's ride-along digest re-derives the key
+            # (no second input read) AND proves the input did not
+            # change between keying and running — a drifted key means
+            # someone rewrote the input mid-run, and inserting under
+            # the old key would poison every future hit.
+            _cache_populate(cache_store, cache_key_hex, cache_cls,
+                            inf, cfg.stats_path, stderr)
+        return rc
     except PwasmError as e:
         stderr.write(str(e))
         if obs is not None and obs.enabled:
@@ -904,6 +1002,51 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None,
                 fo.close()   # no-op when the normal path closed it
             except Exception:
                 pass
+
+
+def _serve_cache_hit(manifest: dict, opts: dict, stderr,
+                     verbose: bool = False) -> int:
+    """Finish a cold-run cache hit: the output files are already
+    written from the verified blobs — emit the hit-shaped ``--stats``
+    (original run's numbers, ``cache_hit: true``, backend zeroed:
+    THIS serve paid no probe) and return 0."""
+    from pwasm_tpu.service.cache import write_hit_stats
+    if "stats" in opts and opts["stats"] is not True:
+        try:
+            write_hit_stats(manifest, str(opts["stats"]), strict=True)
+        except OSError:
+            raise PwasmError(
+                f"Cannot open file {opts['stats']} for writing!\n")
+    if verbose:
+        print("pwasm: result served from cache (byte-identical to a "
+              "full run of these inputs+flags)", file=stderr)
+    return 0
+
+
+def _cache_populate(store, key_hex: str | None, cls, inf,
+                    stats_path: str | None, stderr) -> None:
+    """Insert a completed run's outputs into the result cache (best
+    effort — a failed insert costs the cache, never the job).  The
+    shared ``insert_from_paths`` re-derives the key with the ingest
+    reader's ride-along digest when one exists (else a fresh digest
+    pass) and skips on drift — one populate implementation with the
+    daemon tier."""
+    if key_hex is None or cls is None:
+        return
+    from pwasm_tpu.service.cache import insert_from_paths
+    input_digest = None
+    if getattr(inf, "consumed", False):
+        input_digest = inf.hexdigest()
+    stats = None
+    if stats_path:
+        import json as _json
+        try:
+            with open(stats_path) as f:
+                stats = _json.load(f)
+        except (OSError, ValueError):
+            stats = None
+    insert_from_paths(store, key_hex, cls,
+                      input_digest=input_digest, stats=stats)
 
 
 def _lane_devices(warm):

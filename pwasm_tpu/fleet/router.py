@@ -69,6 +69,8 @@ _ROUTE_USAGE = """Usage:
                  [--max-queue-total=N] [--poll-interval=S]
                  [--metrics-textfile=PATH] [--log-json=FILE]
                  [--trace-json=FILE] [--slo-rules=FILE|off]
+                 [--result-cache=DIR|off]
+                 [--result-cache-max-bytes=N]
 
    --backends=...       member serve daemons, comma-separated targets
                         (unix socket paths and/or HOST:PORT — required)
@@ -94,6 +96,17 @@ _ROUTE_USAGE = """Usage:
                         only after 2 consecutive failed polls, or
                         instantly on a mid-request connection
                         failure)
+   --result-cache=DIR   the members' SHARED result-cache dir
+                        (docs/SERVICE.md; point members'
+                        serve --result-cache at the same shared
+                        storage, like --journal-dir): a submit whose
+                        content key hits there is answered AT THE
+                        ROUTER — no member, no queue, no device,
+                        anywhere in the fleet.  On a miss the key
+                        drives cache-AFFINITY placement: a member
+                        whose `cache-probe` answers hit gets the job
+   --result-cache-max-bytes=N  LRU-evict the router's cache dir past
+                        N total bytes
    --metrics-textfile=PATH  node-exporter textfile of the fleet
                         families (pwasm_fleet_*, docs/OBSERVABILITY.md)
    --log-json=FILE      append NDJSON fleet events (member_down,
@@ -139,6 +152,9 @@ class _Member:
         self.stats: dict | None = None
         self.jobs_routed = 0
         self.fail_streak = 0
+        self.cache_enabled: bool | None = None   # last cache-probe's
+        #   enabled verdict: False skips this member in future
+        #   affinity probes (one RPC saved per submit per member)
         self.dispatched_since_poll = 0   # router placements the
         #   member's last stats reply cannot have observed yet — the
         #   placement pressure term (reset on every successful poll,
@@ -196,7 +212,9 @@ class Router:
                  stderr=None, metrics_textfile: str | None = None,
                  log_json: str | None = None,
                  trace_json: str | None = None,
-                 slo_rules=None):
+                 slo_rules=None,
+                 result_cache: str | None = None,
+                 result_cache_max_bytes: int | None = None):
         if not backends:
             raise ValueError("route needs at least one backend")
         if not socket_path and not listen:
@@ -264,6 +282,28 @@ class Router:
                              on_event=self.obs.event,
                              eval_interval_s=min(
                                  1.0, self.poll_interval))
+        # ---- fleet result cache (ISSUE 15): `route --result-cache`
+        # points at the MEMBERS' shared cache dir (the --journal-dir
+        # placement idea — shared durable storage).  A submit whose
+        # key hits there is answered AT THE ROUTER: no member, no
+        # queue, no device, anywhere.  On a miss the computed key is
+        # also used for cache-AFFINITY placement: a member answering
+        # the `cache-probe` verb hit=true gets the job (its own
+        # admission then serves it from its private cache), so a job
+        # already answered by ANY member never re-runs.
+        from pwasm_tpu.obs.catalog import build_cache_metrics
+        self.cache_metrics = build_cache_metrics(self.registry)
+        self.cache = None
+        if result_cache and result_cache != "off":
+            from pwasm_tpu.service.cache import CacheStore
+            try:
+                self.cache = CacheStore(
+                    result_cache, max_bytes=result_cache_max_bytes,
+                    metrics=self.cache_metrics)
+            except OSError as e:
+                self._say(f"warning: --result-cache dir "
+                          f"{result_cache} unusable ({e}); fleet "
+                          "result caching disabled")
 
     # ---- lifecycle -----------------------------------------------------
     def serve(self) -> int:
@@ -569,6 +609,8 @@ class Router:
             if m is None or not m.alive:
                 return
             m.alive = False
+            m.cache_enabled = None   # a member that rejoins may have
+            #   been restarted WITH caching on — re-learn its verdict
             affected = [j for j in self.jobs.values()
                         if j.member == name and not j.retired
                         and j.terminal is None]
@@ -863,12 +905,26 @@ class Router:
         frame = {"args": req.get("args"), "cwd": req.get("cwd")}
         if req.get("priority") is not None:
             frame["priority"] = req.get("priority")
+        # fleet result cache (ISSUE 15): consult the shared cache dir
+        # at the router's edge — a hit never reaches a member
+        cache_key_hex = None
+        if self.cache is not None and not stream:
+            cache_key_hex, served = self._cache_lookup(
+                frame, client, req.get("priority"), trace_id)
+            if served is not None:
+                return served
         order = self._members_by_depth()
         if not order:
             return protocol.err(
                 protocol.ERR_QUEUE_FULL,
                 "no live fleet members (retry after they rejoin)",
                 retry_after_s=2.0)
+        if cache_key_hex is not None and len(order) > 1:
+            # miss at the router: cache-AFFINITY placement — a member
+            # whose private cache holds the key gets the job (its own
+            # admission serves it), so the fleet never re-runs a job
+            # ANY member has already answered
+            order = self._cache_affinity(order, cache_key_hex)
         last_reject: dict | None = None
         for m in order:
             try:
@@ -965,6 +1021,98 @@ class Router:
             protocol.err(protocol.ERR_QUEUE_FULL,
                          "every fleet member is at capacity",
                          retry_after_s=2.0)
+
+    def _cache_lookup(self, frame: dict, client: str, priority,
+                      trace_id) -> tuple[str | None, dict | None]:
+        """``(key, terminal-submit-response | None)``: derive the
+        content-addressed key from the cwd-absolutized argv and
+        consult the router's shared cache dir.  A hit writes the
+        verified output bytes to the job's own output paths and
+        answers a terminal fleet job on the spot — zero members, zero
+        queues, zero devices.  Any defect falls through to a normal
+        placement (the key, when derivable, still feeds affinity)."""
+        from pwasm_tpu.service.cache import (argv_stats_path,
+                                             classify_argv,
+                                             derive_key,
+                                             serve_outputs,
+                                             write_hit_stats)
+        from pwasm_tpu.service.daemon import _absolutize_argv
+        args = frame.get("args")
+        if not isinstance(args, list) \
+                or not all(isinstance(a, str) for a in args):
+            return None, None
+        argv = list(args)
+        cwd = frame.get("cwd")
+        if isinstance(cwd, str) and os.path.isabs(cwd):
+            argv = _absolutize_argv(argv, cwd)
+        cls = classify_argv(argv)
+        key = derive_key(cls) if cls is not None else None
+        if key is None:
+            return None, None
+        got = self.cache.get(key)
+        if got is None:
+            return key, None
+        manifest, blobs = got
+        try:
+            if not serve_outputs(blobs, cls.output_paths):
+                return key, None
+        except OSError:
+            return key, None    # unwritable outputs: let a member
+            #                     produce the real diagnostic
+        stats = write_hit_stats(manifest, argv_stats_path(argv))
+        with self._lock:
+            self._next_id += 1
+            fid = f"fleet-{self._next_id:04d}"
+            job = _FleetJob(fid, client, str(priority or ""),
+                            str(trace_id or ""), dict(frame),
+                            "cache", "", stream=False)
+            job.retired = True      # never entered the ledger
+            self.jobs[fid] = job
+        resp = protocol.ok(
+            job={"id": fid, "state": "done", "rc": 0,
+                 "detail": "served from the fleet result cache "
+                           "(byte-identical to a full run)",
+                 "client": client, "priority": job.priority,
+                 "trace_id": job.trace_id, "stream": False,
+                 "recovered": False, "member": "cache",
+                 "submitted_s": round(job.submitted_s, 3),
+                 "started_s": None,
+                 "finished_s": round(time.time(), 3)},
+            rc=0, stats=stats, stderr_tail="")
+        with self._lock:
+            job.terminal = resp
+        self.metrics["jobs"].inc(outcome="accepted")
+        self.obs.event("cache_hit", job_id=fid,
+                       trace_id=job.trace_id)
+        return key, protocol.ok(job_id=fid, trace_id=job.trace_id,
+                                member="cache", cache_hit=True,
+                                queue_depth=0)
+
+    def _cache_affinity(self, order: list, key: str) -> list:
+        """Reorder placement so the first member whose ``cache-probe``
+        answers hit=true goes first.  The probe is a placement HINT,
+        never worth stalling admission for: per-probe timeout is
+        short, the WHOLE pass is budgeted (~1s), a member that
+        answered enabled=false is skipped until it next rejoins
+        (``_member_down`` resets the verdict), and probe failures are
+        never death evidence."""
+        deadline = time.monotonic() + 1.0
+        for m in order:
+            if m.cache_enabled is False:
+                continue
+            if time.monotonic() >= deadline:
+                break            # a hint must not gate the submit
+            try:
+                with ServiceClient(m.target, timeout=0.5) as c:
+                    r = c.request({"cmd": "cache-probe", "key": key})
+            except ServiceError:
+                continue
+            if not r.get("ok"):
+                continue
+            m.cache_enabled = bool(r.get("enabled"))
+            if r.get("hit"):
+                return [m] + [x for x in order if x is not m]
+        return order
 
     def _route_stream_frame(self, req: dict) -> dict:
         job = self.jobs.get(req.get("job_id"))
@@ -1261,6 +1409,8 @@ class Router:
             "jobs": jobs_sum,
             "warm": warm_sum,
             "streams": streams_sum,
+            "cache": self.cache.stats_dict()
+            if self.cache is not None else {"enabled": False},
             "lanes": lanes,
             "fair_share": {
                 "max_queue_per_client": self.ledger.max_queue,
@@ -1341,6 +1491,19 @@ def route_main(argv: list[str], stdout=None, stderr=None) -> int:
                          f"value: {val}\n")
             return EXIT_USAGE
     journal_dir = opts.pop("journal-dir", None)
+    result_cache = opts.pop("result-cache", None)
+    if result_cache == "off" or (result_cache is not None
+                                 and not result_cache.strip()):
+        result_cache = None
+    result_cache_max_bytes = None
+    val = opts.pop("result-cache-max-bytes", None)
+    if val is not None:
+        if val.isascii() and val.isdigit() and int(val) >= 1:
+            result_cache_max_bytes = int(val)
+        else:
+            stderr.write(f"{_ROUTE_USAGE}\nInvalid "
+                         f"--result-cache-max-bytes value: {val}\n")
+            return EXIT_USAGE
     metrics_textfile = opts.pop("metrics-textfile", None)
     log_json = opts.pop("log-json", None)
     trace_json = opts.pop("trace-json", None)
@@ -1369,7 +1532,9 @@ def route_main(argv: list[str], stdout=None, stderr=None) -> int:
                         poll_interval=poll, stderr=stderr,
                         metrics_textfile=metrics_textfile,
                         log_json=log_json, trace_json=trace_json,
-                        slo_rules=slo_rules)
+                        slo_rules=slo_rules,
+                        result_cache=result_cache,
+                        result_cache_max_bytes=result_cache_max_bytes)
     except ValueError as e:
         stderr.write(f"{_ROUTE_USAGE}\nError: {e}\n")
         return EXIT_USAGE

@@ -299,6 +299,40 @@ def build_stream_metrics(reg: MetricsRegistry) -> dict:
     return m
 
 
+def build_cache_metrics(reg: MetricsRegistry) -> dict:
+    """Register the content-addressed result-cache families (ISSUE
+    15, ``service/cache.py``): flow counters (hits/misses/insertions/
+    evictions), the live on-disk byte gauge (fed from the unified
+    :class:`~pwasm_tpu.service.cache.ByteLedger`, so it cannot drift
+    from the spool gauge's accounting), and the cumulative hit-ratio
+    gauge the capacity-planning dashboards read.  Registered by the
+    one-shot CLI (``--result-cache`` + ``--metrics-textfile``), the
+    serve daemon, and the fleet router — each over its own registry."""
+    m = {}
+    m["hits"] = reg.counter(
+        "pwasm_cache_hits_total",
+        "Result-cache hits (jobs served from stored bytes with zero "
+        "device/lease/queue involvement)")
+    m["misses"] = reg.counter(
+        "pwasm_cache_misses_total",
+        "Result-cache lookups that found no whole, unexpired, "
+        "CRC-clean entry")
+    m["insertions"] = reg.counter(
+        "pwasm_cache_insertions_total",
+        "Completed jobs whose outputs were stored in the result cache")
+    m["evictions"] = reg.counter(
+        "pwasm_cache_evictions_total",
+        "Result-cache entries dropped (LRU past "
+        "--result-cache-max-bytes, TTL expiry, or CRC rot)")
+    m["bytes"] = reg.gauge(
+        "pwasm_cache_bytes",
+        "Bytes of result-cache entries currently on disk")
+    m["hit_ratio"] = reg.gauge(
+        "pwasm_cache_hit_ratio",
+        "Cumulative result-cache hit ratio (hits / lookups)")
+    return m
+
+
 def build_fleet_metrics(reg: MetricsRegistry) -> dict:
     """Register the fleet-router families (the ``pwasm-tpu route``
     daemon, docs/FLEET.md): member liveness and load as the router
@@ -451,6 +485,15 @@ DEFAULT_SLO_RULES = (
      "runbook": "over 5% of jobs waited more than 60s for admission "
                 "in both burn windows — sustained overload; scale "
                 "members out"},
+    {"name": "cache_thrash", "severity": "warn", "kind": "threshold",
+     "metric": "pwasm_cache_evictions_total",
+     "divide_by": "pwasm_cache_insertions_total", "op": ">",
+     "value": 0.9, "for_s": 10.0,
+     "runbook": "the result cache is evicting nearly as fast as it "
+                "inserts (sustained evictions/insertions > 0.9): a "
+                "mis-sized --result-cache-max-bytes silently costs "
+                "every repeat job its 100x hit — raise the budget or "
+                "shrink the retained output set"},
 )
 
 # the fleet router's default rules, over the pwasm_fleet_* families

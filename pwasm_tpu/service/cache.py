@@ -1,0 +1,768 @@
+"""Content-addressed result cache: repeat traffic in microseconds.
+
+At production scale the traffic this service exists for is heavily
+repetitive — the same bacterial CDS re-scored against overlapping
+assembly sets every time basecalling re-runs (ROADMAP item 2).  Until
+now an identical job paid the full queue→lease→device→format pipeline
+even though the spool already held its exact output bytes.  This
+module closes that: a finished job's output files are stored under a
+**content-addressed key** and an identical later job — same inputs by
+DIGEST, same result-affecting flags by CANONICAL FORM — is served the
+stored bytes with zero device, lease, or queue involvement.
+
+The key
+-------
+
+``sha256`` over a canonical JSON document of:
+
+- the **canonicalized ref-FASTA digest** (:func:`fasta_digest`:
+  per-record ``>name`` + uppercased whitespace-stripped sequence, so
+  cosmetic line wrapping or case cannot split the cache);
+- the **input digest** (:func:`digest_file` over the PAF bytes, or
+  :func:`fasta_digest` for a ``--many2many`` target FASTA — computed
+  in ONE ``mmap``/block pass, and on the ingest side the same pass
+  that feeds the run, see ``stream.pafstream.BlockLineReader``);
+- the **result-affecting flag set** in canonical (sorted) form — see
+  :data:`KEYED_BOOL` / :data:`KEYED_VALUE` / :data:`KEYED_FILE`: a
+  cosmetic argv reorder still hits, while anything that changes
+  output BYTES (mode flags, ``-c``, ``--band``, motif content) keys a
+  distinct entry.  Flags that provably do not change bytes
+  (``--device``/``--batch``/resilience knobs — the repo's byte-parity
+  contracts) and per-invocation plumbing (output PATHS, obs sinks,
+  ``--socket``-side fields) are EXCLUDED, so the same logical job
+  hits regardless of where its report lands;
+- the requested **output kinds** (``o``/``s``/``w``/``ace``/``info``/
+  ``cons`` — kinds are keyed, paths are not), so an entry always
+  holds exactly the output set its hits need.
+
+A job carrying a flag outside the table — or one whose semantics are
+inherently uncacheable (``--resume``, ``--follow``, a socket stream,
+``--inject-faults``) — **bypasses** the cache entirely
+(:func:`classify` returns ``None``): unknown means "cannot vouch for
+byte identity", and the safe direction is always a real run.
+
+Storage
+-------
+
+The PR 9 spool discipline: per entry one CRC'd manifest
+(``<key>.json``, written via the audited ``fsio`` fsync-then-replace
+— the COMMIT POINT) plus one blob file per output kind
+(``<key>.<kind>``), each blob's size+CRC32 recorded in the manifest.
+A ``kill -9`` mid-insert leaves blobs without a manifest — orphans a
+startup :meth:`CacheStore.sweep` removes; a manifest whose blob rotted
+(CRC mismatch) is a MISS and the entry is dropped, never a corrupt
+serve.  Eviction is LRU (manifest mtime = last access) under
+``--result-cache-max-bytes`` plus optional TTL; all byte accounting
+runs through one lock-guarded :class:`ByteLedger` shared with the
+daemon's result spool, so ``pwasm_cache_bytes`` and
+``pwasm_service_spool_bytes`` cannot drift from disk under concurrent
+evictions.
+
+Like every ``pwasm_tpu/service/`` module this file is jax-free
+(``qa/check_supervision.py::find_cache_violations`` additionally
+requires it to EXIST — the serving tiers all lean on it).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+import zlib
+
+CACHE_KEY_VERSION = 1
+
+# ---------------------------------------------------------------------------
+# flag canonicalization table (docs/SERVICE.md "Result cache" section;
+# the matrix is unit-tested in tests/test_cache.py)
+# ---------------------------------------------------------------------------
+
+# result-affecting booleans: present/absent changes output bytes
+KEYED_BOOL = frozenset((
+    "G", "F", "C", "N",            # analysis mode selection
+    "realign",                     # rewrites gap structures
+    "remove-cons-gaps",            # consensus refinement policy
+    "no-refine-clip",              # clip refinement policy
+    "skip-bad-lines",              # changes which records emit rows
+    "many2many",                   # a different job type entirely
+))
+
+# result-affecting valued flags: the VALUE is keyed verbatim
+KEYED_VALUE = frozenset((
+    "c",                           # clipmax
+    "band",                        # DP band (realign / many2many)
+))
+
+# result-affecting FILE flags: keyed by the file's content digest,
+# not its path (the same motif set under a new name still hits)
+KEYED_FILE = frozenset(("motifs",))
+
+# output selectors: the KIND is keyed (an entry holds exactly the
+# kinds its jobs request), the PATH is not
+OUTPUT_KINDS = ("o", "s", "w", "ace", "info", "cons")
+
+# provably byte-neutral (the repo's parity contracts) or pure
+# per-invocation plumbing: never part of the key
+EXCLUDED = frozenset((
+    "v", "D",                      # verbosity (stderr only)
+    "d", "p", "m",                 # parsed-but-unread reference quirks
+    "device", "batch", "shard",    # placement: bytes are parity-gated
+    "max-retries", "device-deadline", "fallback", "recover",
+    "reprobe-interval", "reprobe-max",
+    "profile", "stats", "trace-json", "log-json",
+    "log-json-max-bytes", "trace-max-events", "metrics-textfile",
+    "compile-cache-dir",
+    "result-cache", "result-cache-max-bytes",
+))
+
+# inherently uncacheable semantics: their presence BYPASSES the cache
+BYPASS = frozenset(("resume", "follow", "inject-faults"))
+
+
+class Classified:
+    """The canonical view of one job argv the key derives from."""
+
+    __slots__ = ("flag_items", "output_kinds", "output_paths",
+                 "ref_path", "input_path", "motif_path", "many2many")
+
+    def __init__(self, flag_items, output_kinds, output_paths,
+                 ref_path, input_path, motif_path, many2many):
+        self.flag_items = flag_items        # sorted (flag, value) rows
+        self.output_kinds = output_kinds    # sorted kind names
+        self.output_paths = output_paths    # kind -> path (this job's)
+        self.ref_path = ref_path
+        self.input_path = input_path
+        self.motif_path = motif_path
+        self.many2many = many2many
+
+
+def classify(opts: dict, positional: list) -> Classified | None:
+    """Canonicalize a parsed argv (``cli._parse_args`` output) into
+    the key's flag view, or ``None`` when the job must bypass the
+    cache (bypass flag, unknown flag, stdin input, stdout report).
+    Pure — no file reads happen here."""
+    if any(k in opts for k in BYPASS):
+        return None
+    flag_items: list[tuple[str, str]] = []
+    output_paths: dict[str, str] = {}
+    motif_path = None
+    for k, v in opts.items():
+        if k in EXCLUDED:
+            continue
+        if k in KEYED_BOOL:
+            if v is True or v:          # --flag or --flag=anything
+                flag_items.append((k, ""))
+            continue
+        if k in KEYED_VALUE:
+            if v is True:
+                return None             # malformed: let the run reject
+            flag_items.append((k, str(v)))
+            continue
+        if k in KEYED_FILE:
+            if v is True:
+                return None
+            motif_path = str(v)
+            continue
+        if k in OUTPUT_KINDS:
+            if v is True:
+                return None
+            output_paths[k] = str(v)
+            continue
+        if k == "r":
+            continue                    # keyed as the ref digest
+        return None                     # unknown flag: cannot vouch
+    if "o" not in output_paths:
+        return None     # a stdout report has no file to serve back
+    rpath = opts.get("r")
+    if not isinstance(rpath, str) or not rpath:
+        return None
+    if len(positional) != 1 or positional[0] in ("", "-"):
+        return None     # stdin (or no) input: nothing to digest
+    flag_items.sort()
+    return Classified(
+        flag_items=tuple(flag_items),
+        output_kinds=tuple(sorted(output_paths)),
+        output_paths=output_paths,
+        ref_path=rpath,
+        input_path=positional[0],
+        motif_path=motif_path,
+        many2many="many2many" in opts)
+
+
+def classify_argv(argv: list) -> Classified | None:
+    """:func:`classify` over a raw argv (the daemon's admission path
+    — the argv is already cwd-absolutized there)."""
+    from pwasm_tpu.cli import CliError, _parse_args
+    try:
+        opts, positional = _parse_args(list(argv))
+    except CliError:
+        return None
+    return classify(opts, positional)
+
+
+# ---------------------------------------------------------------------------
+# digests
+# ---------------------------------------------------------------------------
+
+def digest_file(path: str) -> str:
+    """sha256 over a file's raw bytes in one bounded block pass.
+    Deliberately NOT mmap-backed: this runs at admission inside the
+    serve daemon/router on CLIENT-owned files — touching a mapped
+    page past the EOF of a file truncated under us raises SIGBUS and
+    kills the whole process, where a ``read`` merely sees a short
+    file (the key-drift re-check at insert time catches the change
+    either way).  Hashing dominates the pass, not the read."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def fasta_digest(path: str) -> str:
+    """Canonicalized FASTA digest: per record, the stripped header and
+    the UPPERCASED, whitespace-stripped sequence — cosmetic line
+    wrapping, case, or trailing blank lines cannot split the cache,
+    while any real sequence or naming change keys a distinct entry."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith(b">"):
+                h.update(line)
+                h.update(b"\n")
+            else:
+                h.update(line.upper())
+    return h.hexdigest()
+
+
+def record_digest(name: str, seq) -> str:
+    """Canonical digest of ONE FASTA record (the ``--many2many``
+    per-CDS section key's query half) — same canonical form as
+    :func:`fasta_digest` applied to a single record."""
+    h = hashlib.sha256()
+    h.update(b">" + str(name).encode("utf-8") + b"\n")
+    s = seq if isinstance(seq, (bytes, bytearray)) else \
+        str(seq).encode("utf-8")
+    h.update(bytes(s).upper())
+    return h.hexdigest()
+
+
+def cache_key(ref_digest: str, input_digest: str, flag_items,
+              output_kinds) -> str:
+    """The content-addressed key: sha256 over the canonical JSON of
+    every result-affecting fact."""
+    doc = {"v": CACHE_KEY_VERSION, "ref": ref_digest,
+           "input": input_digest,
+           "flags": [list(fi) for fi in flag_items],
+           "outputs": list(output_kinds)}
+    return hashlib.sha256(json.dumps(
+        doc, sort_keys=True, separators=(",", ":")).encode()).hexdigest()
+
+
+def section_key(query_digest: str, targets_digest: str,
+                band: int) -> str:
+    """The ``--many2many`` per-CDS SECTION key: one query record vs
+    the whole target set under one band — the granularity that lets a
+    job re-scoring 9 cached CDS + 1 new one dispatch only the new
+    one."""
+    doc = {"v": CACHE_KEY_VERSION, "m2m_section": 1,
+           "q": query_digest, "targets": targets_digest,
+           "band": int(band)}
+    return hashlib.sha256(json.dumps(
+        doc, sort_keys=True, separators=(",", ":")).encode()).hexdigest()
+
+
+def derive_key(cls: Classified,
+               input_digest: str | None = None) -> str | None:
+    """Digest the classified job's inputs and derive its cache key;
+    ``None`` when any input is unreadable (the run will produce the
+    real diagnostic — a cache must never pre-empt it).
+    ``input_digest`` skips the input re-read when the caller already
+    holds it — the ingest reader's digest rides its single pass
+    (``stream.pafstream.BlockLineReader``), and the insert side uses
+    it both to avoid a second read and to PROVE the input did not
+    change between keying and running (key mismatch = no insert)."""
+    try:
+        ref_d = fasta_digest(cls.ref_path)
+        input_d = input_digest if input_digest is not None else (
+            fasta_digest(cls.input_path) if cls.many2many
+            else digest_file(cls.input_path))
+        flag_items = list(cls.flag_items)
+        if cls.motif_path is not None:
+            flag_items.append(("motifs#sha256",
+                               digest_file(cls.motif_path)))
+            flag_items.sort()
+    except OSError:
+        return None
+    return cache_key(ref_d, input_d, flag_items, cls.output_kinds)
+
+
+# ---------------------------------------------------------------------------
+# the unified byte ledger (spool + cache accounting)
+# ---------------------------------------------------------------------------
+
+class ByteLedger:
+    """One lock-guarded byte ledger with named accounts.  The daemon
+    charges its result spool and its result cache against the SAME
+    ledger, so the two byte gauges are read from one synchronized
+    source and cannot drift from disk under concurrent evictions (the
+    latent window the old bare ``_spool_bytes`` int left open around
+    replay-time increments)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._accounts: dict[str, int] = {}
+
+    def add(self, account: str, n: int) -> None:
+        with self._lock:
+            self._accounts[account] = \
+                self._accounts.get(account, 0) + int(n)
+
+    def sub(self, account: str, n: int) -> None:
+        with self._lock:
+            self._accounts[account] = max(
+                0, self._accounts.get(account, 0) - int(n))
+
+    def set(self, account: str, n: int) -> None:
+        with self._lock:
+            self._accounts[account] = max(0, int(n))
+
+    def value(self, account: str) -> int:
+        with self._lock:
+            return self._accounts.get(account, 0)
+
+
+# ---------------------------------------------------------------------------
+# the store
+# ---------------------------------------------------------------------------
+
+MANIFEST_VERSION = 1
+_ACCOUNT = "cache"
+
+# files younger than this are never sweep candidates: on a shared dir
+# a sibling's in-flight insert is indistinguishable from a crash
+# remnant until its manifest commits
+SWEEP_GRACE_S = 60.0
+
+
+class CacheStore:
+    """Content-addressed result store (module docstring for layout and
+    crash/rot semantics).  Thread-safe: admission (connection threads),
+    workers (insert at finish) and eviction share one lock.
+
+    ``metrics`` is the ``build_cache_metrics`` dict (obs/catalog.py);
+    ``ledger`` the shared :class:`ByteLedger` (one is created when the
+    caller has none)."""
+
+    def __init__(self, root: str, max_bytes: int | None = None,
+                 ttl_s: float | None = None, metrics: dict | None = None,
+                 ledger: ByteLedger | None = None):
+        self.root = root
+        self.max_bytes = max_bytes
+        self.ttl_s = ttl_s
+        self.metrics = metrics or {}
+        self.ledger = ledger if ledger is not None else ByteLedger()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.insertions = 0
+        self.evictions = 0
+        self._recounted_at = 0.0     # monotonic, last disk recount
+        os.makedirs(root, exist_ok=True)
+        self.sweep()
+
+    # ---- internals -----------------------------------------------------
+    def _manifest_path(self, key: str) -> str:
+        return os.path.join(self.root, key + ".json")
+
+    def _blob_path(self, key: str, kind: str) -> str:
+        return os.path.join(self.root, f"{key}.{kind}")
+
+    def _read_manifest(self, key: str) -> dict | None:
+        """Parse + CRC-verify one manifest; None on any defect (the
+        ckpt-v2 rule: torn or rotted state is absent state)."""
+        from pwasm_tpu.utils.fsio import payload_crc
+        try:
+            with open(self._manifest_path(key),
+                      encoding="utf-8") as f:
+                obj = json.load(f)
+            if not isinstance(obj, dict):
+                raise ValueError("not an object")
+            crc = int(obj.pop("crc"))
+            if payload_crc(obj) != crc:
+                raise ValueError("manifest CRC mismatch")
+            if obj.get("version") != MANIFEST_VERSION \
+                    or obj.get("key") != key \
+                    or not isinstance(obj.get("outputs"), dict):
+                raise ValueError("manifest schema mismatch")
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+        return obj
+
+    def _entry_bytes(self, manifest: dict) -> int:
+        return int(manifest.get("bytes", 0))
+
+    def _drop_locked(self, key: str, manifest: dict | None) -> None:
+        """Unlink one entry (manifest first — later lookups miss even
+        if a blob unlink fails).  The caller owes ONE
+        ``_recount_locked`` after its whole drop batch — per-drop
+        recounts would make eviction O(drops x dir_size) under the
+        lock admission lookups need."""
+        try:
+            os.unlink(self._manifest_path(key))
+        except OSError:
+            pass
+        kinds = (manifest or {}).get("outputs") or {}
+        for kind in list(kinds) or list(OUTPUT_KINDS):
+            try:
+                os.unlink(self._blob_path(key, kind))
+            except OSError:
+                pass
+
+    def _recount_locked(self) -> None:
+        """Refresh the ledger's cache account from what is ACTUALLY on
+        disk (sum of file sizes in the cache dir).  Counting from disk
+        rather than incrementally is what keeps the gauge truthful on
+        a SHARED cache dir, where sibling fleet members insert and
+        evict under us."""
+        total = 0
+        try:
+            for n in os.listdir(self.root):
+                try:
+                    total += os.path.getsize(
+                        os.path.join(self.root, n))
+                except OSError:
+                    pass
+        except OSError:
+            return
+        self._recounted_at = time.monotonic()
+        self.ledger.set(_ACCOUNT, total)
+        self._publish()
+
+    def _publish(self) -> None:
+        """Refresh the gauges from the ledger + counters."""
+        m = self.metrics
+        if not m:
+            return
+        g = m.get("bytes")
+        if g is not None:
+            g.set(self.ledger.value(_ACCOUNT))
+        ratio = m.get("hit_ratio")
+        if ratio is not None:
+            total = self.hits + self.misses
+            ratio.set(round(self.hits / total, 6) if total else 0.0)
+
+    def _count(self, what: str) -> None:
+        setattr(self, what, getattr(self, what) + 1)
+        c = self.metrics.get({"hits": "hits", "misses": "misses",
+                              "insertions": "insertions",
+                              "evictions": "evictions"}[what])
+        if c is not None:
+            c.inc()
+        self._publish()
+
+    # ---- public API ----------------------------------------------------
+    def sweep(self) -> None:
+        """Startup consistency pass: remove orphan blobs (a kill -9
+        landed between blob writes and the manifest commit — the
+        insert never durably happened) and rebuild the ledger's byte
+        account from what is actually on disk.  Only files OLDER than
+        :data:`SWEEP_GRACE_S` are candidates: on a SHARED fleet dir a
+        sibling process's in-flight insert looks exactly like a crash
+        remnant (blobs and ``.tmp`` files, no manifest yet) and must
+        never be reaped mid-write — a real crash's leavings age past
+        the window and the next sweep gets them.  Manifests whose
+        blobs rotted or vanished are handled LAZILY by :meth:`get`
+        (drop + evict + miss), so a sweep never pays a CRC read of
+        every entry."""
+        with self._lock:
+            try:
+                names = os.listdir(self.root)
+            except OSError:
+                return
+            now = time.time()
+            manifests = {n[:-5] for n in names if n.endswith(".json")}
+            for n in sorted(names):
+                if n.endswith(".json"):
+                    continue
+                key = n.rsplit(".", 1)[0]
+                if key in manifests:
+                    continue
+                path = os.path.join(self.root, n)
+                try:
+                    if now - os.path.getmtime(path) < SWEEP_GRACE_S:
+                        continue     # possibly a sibling's in-flight
+                        #              insert — never reap mid-write
+                    os.unlink(path)
+                except OSError:
+                    pass
+            self._recount_locked()
+
+    def contains(self, key: str) -> bool:
+        """Cheap probe (the ``cache-probe`` verb): a CRC-valid,
+        unexpired manifest exists.  Blobs are verified at serve time."""
+        with self._lock:
+            manifest = self._read_manifest(key)
+            if manifest is None:
+                return False
+            if self._expired(manifest):
+                return False
+            return True
+
+    def _expired(self, manifest: dict) -> bool:
+        if self.ttl_s is None:
+            return False
+        created = manifest.get("created")
+        if not isinstance(created, (int, float)):
+            return True
+        return time.time() - created > self.ttl_s
+
+    def get(self, key: str) -> tuple[dict, dict] | None:
+        """Serve one entry: ``(manifest, {kind: bytes})`` with every
+        blob CRC-verified, or ``None`` (counted as a miss).  Any
+        defect — rot, truncation, expiry — DROPS the entry: a corrupt
+        entry is served exactly never."""
+        with self._lock:
+            manifest = self._read_manifest(key)
+            if manifest is None:
+                self._count("misses")
+                return None
+            if self._expired(manifest):
+                self._drop_locked(key, manifest)
+                self._recount_locked()
+                self._count("evictions")
+                self._count("misses")
+                return None
+            blobs: dict[str, bytes] = {}
+            for kind, meta in manifest["outputs"].items():
+                try:
+                    with open(self._blob_path(key, kind), "rb") as f:
+                        data = f.read()
+                    if len(data) != int(meta["bytes"]) \
+                            or zlib.crc32(data) != int(meta["crc"]):
+                        raise ValueError("blob CRC mismatch")
+                except (OSError, ValueError, KeyError, TypeError):
+                    # rot destroys the entry: counted as an EVICTION
+                    # too (the metric's documented causes include CRC
+                    # rot — churn must be visible to cache_thrash)
+                    self._drop_locked(key, manifest)
+                    self._recount_locked()
+                    self._count("evictions")
+                    self._count("misses")
+                    return None
+                blobs[kind] = data
+            try:
+                # LRU clock: manifest mtime = last access
+                os.utime(self._manifest_path(key))
+            except OSError:
+                pass
+            self._count("hits")
+            return manifest, blobs
+
+    def insert(self, key: str, outputs: dict[str, bytes],
+               stats: dict | None = None) -> bool:
+        """Store one entry: blobs first, CRC'd manifest LAST (the
+        commit point — a crash at any instant leaves either a whole
+        entry or orphan blobs the next sweep removes), then enforce
+        the byte budget.  Returns False on any write failure (a full
+        disk costs the cache, never the job)."""
+        from pwasm_tpu.utils.fsio import (payload_crc,
+                                          write_durable_bytes,
+                                          write_durable_text)
+        meta: dict[str, dict] = {}
+        total = 0
+        with self._lock:
+            try:
+                for kind, data in outputs.items():
+                    write_durable_bytes(self._blob_path(key, kind),
+                                        data)
+                    meta[kind] = {"bytes": len(data),
+                                  "crc": zlib.crc32(data)}
+                    total += len(data)
+                manifest = {"version": MANIFEST_VERSION, "key": key,
+                            "created": round(time.time(), 3),
+                            "outputs": meta, "stats": stats,
+                            "bytes": total}
+                manifest["crc"] = payload_crc(
+                    {k: v for k, v in manifest.items() if k != "crc"})
+                write_durable_text(self._manifest_path(key),
+                                   json.dumps(manifest, sort_keys=True,
+                                              separators=(",", ":")))
+            except OSError:
+                for kind in meta:
+                    try:
+                        os.unlink(self._blob_path(key, kind))
+                    except OSError:
+                        pass
+                return False
+            # re-inserts (two members racing one job on a shared dir)
+            # net out here: bytes are always recounted from disk,
+            # never accumulated
+            self._recount_locked()
+            self._count("insertions")
+            self._evict_locked()
+        return True
+
+    def _evict_locked(self) -> None:
+        """LRU eviction to the ``max_bytes`` budget (manifest mtime =
+        last access) + TTL expiry.  One ledger recount for the whole
+        pass, however many entries dropped."""
+        if self.max_bytes is None and self.ttl_s is None:
+            return
+        rows = []
+        dropped = 0
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return
+        for n in sorted(names):
+            if not n.endswith(".json"):
+                continue
+            key = n[:-5]
+            manifest = self._read_manifest(key)
+            if manifest is None:
+                self._drop_locked(key, None)
+                dropped += 1
+                continue
+            if self._expired(manifest):
+                self._drop_locked(key, manifest)
+                dropped += 1
+                self._count("evictions")
+                continue
+            try:
+                mtime = os.path.getmtime(self._manifest_path(key))
+            except OSError:
+                mtime = 0.0
+            rows.append((mtime, key, manifest))
+        if self.max_bytes is not None:
+            total = sum(self._entry_bytes(m) for _t, _k, m in rows)
+            rows.sort()                  # oldest access first
+            for _t, key, manifest in rows:
+                if total <= self.max_bytes:
+                    break
+                total -= self._entry_bytes(manifest)
+                self._drop_locked(key, manifest)
+                dropped += 1
+                self._count("evictions")
+        if dropped:
+            self._recount_locked()
+
+    def evict_now(self) -> None:
+        """Run one eviction pass (TTL + budget) outside an insert —
+        the daemon's periodic tick calls this so an idle cache still
+        expires."""
+        with self._lock:
+            self._evict_locked()
+
+    def stats_dict(self) -> dict:
+        """The svc-stats ``cache`` block.  Bytes are recounted from
+        disk (a shared dir's siblings mutate it under us) but
+        TIME-GATED: a `top` refresh loop hammering the stats verb on
+        a huge cache dir must not serialize every admission lookup
+        behind a directory scan — between recounts the last-known
+        ledger value (maintained by this process's own mutations)
+        serves."""
+        with self._lock:
+            if time.monotonic() - self._recounted_at > 2.0:
+                self._recount_locked()
+            total = self.hits + self.misses
+            return {
+                "enabled": True,
+                "dir": self.root,
+                "max_bytes": self.max_bytes,
+                "ttl_s": self.ttl_s,
+                "hits": self.hits,
+                "misses": self.misses,
+                "insertions": self.insertions,
+                "evictions": self.evictions,
+                "bytes": self.ledger.value(_ACCOUNT),
+                "hit_ratio": round(self.hits / total, 6)
+                if total else 0.0,
+            }
+
+
+# ---------------------------------------------------------------------------
+# serving helpers (shared by the CLI, the daemon and the router)
+# ---------------------------------------------------------------------------
+
+def insert_from_paths(store: CacheStore, key: str, cls: Classified,
+                      input_digest: str | None = None,
+                      stats: dict | None = None) -> bool:
+    """Insert a completed run's output FILES under ``key`` — the ONE
+    populate implementation every tier shares (cold CLI after
+    ``_main_loop``, daemon at job finish).  The key is RE-derived
+    first (``input_digest`` reuses the ingest reader's ride-along
+    digest so no input re-read happens): an input rewritten while the
+    run was in flight drifts the key, and inserting the new outputs
+    under the OLD key would poison every future hit — skipping is
+    always safe.  Best-effort: False on drift or any read failure."""
+    try:
+        if derive_key(cls, input_digest=input_digest) != key:
+            return False
+        blobs = {}
+        for kind, path in cls.output_paths.items():
+            with open(path, "rb") as f:
+                blobs[kind] = f.read()
+    except OSError:
+        return False
+    return store.insert(
+        key, blobs, stats=stats if isinstance(stats, dict) else None)
+
+
+def serve_outputs(blobs: dict[str, bytes],
+                  paths: dict[str, str]) -> bool:
+    """Write the cached output bytes to this invocation's output
+    paths.  All-or-nothing precheck: every requested kind must exist
+    in the entry (guaranteed when the key includes the kind set, but
+    verified anyway) — a partial serve would be worse than a miss."""
+    if any(kind not in blobs for kind in paths):
+        return False
+    for kind, path in paths.items():
+        with open(path, "wb") as f:
+            f.write(blobs[kind])
+    return True
+
+
+def hit_stats(manifest: dict) -> dict:
+    """The ``--stats`` JSON a cache hit serves: the original run's
+    stats with ``cache_hit`` set and the backend block ZEROED — this
+    serve paid no probe and touched no device, and the acceptance
+    gates read exactly that."""
+    st = manifest.get("stats")
+    st = dict(st) if isinstance(st, dict) else {}
+    st["cache_hit"] = True
+    st["backend"] = {"probes": 0, "warm_hits": 0}
+    return st
+
+
+def argv_stats_path(argv) -> str | None:
+    """The ``--stats=FILE`` path in a job argv, if any — what a hit
+    still owes the caller as a file artifact."""
+    return next((a.split("=", 1)[1] for a in argv
+                 if isinstance(a, str) and a.startswith("--stats=")),
+                None)
+
+
+def write_hit_stats(manifest: dict, stats_path: str | None,
+                    strict: bool = False) -> dict:
+    """Serve the hit-shaped stats: returns :func:`hit_stats` and, when
+    the job asked for a ``--stats`` file, writes it there too — ONE
+    implementation for all three serving tiers (CLI / daemon /
+    router), so the artifact cannot drift between them.  A failed
+    write is swallowed unless ``strict`` (the cold CLI raises its
+    canonical diagnostic; the daemons keep serving)."""
+    st = hit_stats(manifest)
+    if stats_path:
+        try:
+            with open(stats_path, "w") as f:
+                json.dump(st, f, indent=1)
+                f.write("\n")
+        except OSError:
+            if strict:
+                raise
+    return st

@@ -349,6 +349,12 @@ class ServiceClient:
     def stats(self) -> dict:
         return self._req({"cmd": "stats"})
 
+    def cache_probe(self, key: str) -> dict:
+        """Would this daemon's result cache answer ``key``?  A cheap
+        manifest check (``{"hit":bool,"enabled":bool}``) — the fleet
+        router's cache-affinity placement probe (docs/SERVICE.md)."""
+        return self._req({"cmd": "cache-probe", "key": key})
+
     def metrics(self, exemplars: bool = False) -> dict:
         """Prometheus text exposition; ``exemplars=True`` opts into
         the OpenMetrics exemplar suffix on histogram buckets (strict

@@ -43,10 +43,12 @@ import time
 from pwasm_tpu.core.errors import EXIT_PREEMPTED, EXIT_USAGE, PwasmError
 from pwasm_tpu.resilience.lifecycle import SignalDrain
 from pwasm_tpu.service import protocol
+from pwasm_tpu.service.cache import ByteLedger
 from pwasm_tpu.service.journal import (JOURNAL_VERSION, REC_ADMIT,
-                                       REC_CANCEL, REC_EVICT,
-                                       REC_FINISH, REC_START,
-                                       JobJournal, fold_records)
+                                       REC_CACHE_HIT, REC_CANCEL,
+                                       REC_EVICT, REC_FINISH,
+                                       REC_START, JobJournal,
+                                       fold_records)
 from pwasm_tpu.service.leases import LeaseManager
 from pwasm_tpu.service.queue import (JOB_CANCELLED, JOB_DONE, JOB_FAILED,
                                      JOB_PREEMPTED, JOB_QUEUED,
@@ -68,6 +70,8 @@ _SERVE_USAGE = """Usage:
                  [--log-json=FILE] [--log-json-max-bytes=N]
                  [--trace-json=FILE]
                  [--result-ttl-s=S] [--max-results=N]
+                 [--result-cache=DIR|off]
+                 [--result-cache-max-bytes=N]
                  [--canary-interval=S] [--slo-rules=FILE|off]
 
    --socket=PATH        unix socket to listen on (required)
@@ -186,6 +190,25 @@ _SERVE_USAGE = """Usage:
                         job ids answer unknown_job
    --max-results=N      keep at most N finished-job results (least-
                         recently-accessed evicted first)
+   --result-cache=DIR   content-addressed result cache
+                        (docs/SERVICE.md): a submit whose key —
+                        sha256 over (canonicalized ref-FASTA digest,
+                        input digest, result-affecting flags, output
+                        kinds) — matches a stored entry is answered
+                        AT ADMISSION from the cached bytes: zero
+                        queue, lease, or device involvement
+                        (backend.probes == 0 in its stats), a
+                        `cache_hit` journal record for replay truth.
+                        Completed jobs insert their outputs; every
+                        serve is CRC-verified (rot = miss, never a
+                        corrupt byte).  Point a FLEET's members at
+                        one shared DIR (the --journal-dir placement
+                        idea) and a job answered by ANY member never
+                        re-runs anywhere.  Default: off
+   --result-cache-max-bytes=N  evict least-recently-used cache
+                        entries past N total bytes (the cache_thrash
+                        SLO rule pages when a mis-sized budget makes
+                        eviction keep pace with insertion)
    --canary-interval=S  run a synthetic canary probe every S seconds
                         (service/canary.py): the deterministic warmup
                         corpus through a free lane's normal serving
@@ -253,6 +276,10 @@ class WarmContext:
         self.compile_cache_dir: str | None = None  # persistent XLA
         #   compilation cache dir every job arms before its first
         #   device compile (serve --compile-cache-dir)
+        self.result_cache_dir: str | None = None   # content-addressed
+        #   result cache dir (serve --result-cache): a served
+        #   --many2many job reads it for per-CDS SECTION caching —
+        #   the daemon's own whole-job lookup happens at admission
         self.lock = threading.Lock()
 
     def host_executor(self):
@@ -313,6 +340,10 @@ class _JobWarm:
         return self._shared.compile_cache_dir
 
     @property
+    def result_cache_dir(self):
+        return self._shared.result_cache_dir
+
+    @property
     def monitor(self):
         return self.lease.monitor
 
@@ -357,7 +388,10 @@ class Daemon:
                  compile_cache_dir: str | None = None,
                  warmup: str | None = None,
                  canary_interval_s: float | None = None,
-                 slo_rules=None):
+                 slo_rules=None,
+                 result_cache: str | None = None,
+                 result_cache_max_bytes: int | None = None,
+                 result_cache_ttl_s: float | None = None):
         self.socket_path = socket_path
         # fleet transport (docs/FLEET.md): an optional TCP listener
         # joining the unix socket — same protocol, token-based client
@@ -424,7 +458,11 @@ class Daemon:
         # on the warm context so every job's device path arms it (via
         # the jaxcompat shim) before its first compile
         self.compile_cache_dir = compile_cache_dir
-        self._spool_bytes = 0
+        # ---- unified byte ledger (ISSUE 15 satellite): spool AND
+        # result-cache byte accounting share ONE lock-guarded ledger,
+        # so the two gauges are read from one synchronized source and
+        # cannot drift from disk under concurrent evictions
+        self.ledger = ByteLedger()
         # ---- streaming ingestion (ISSUE 10): per-stream buffer
         # quotas + fair-share arbitration; stream jobs are otherwise
         # ordinary queue citizens (DRR over clients, leases, journal)
@@ -456,12 +494,33 @@ class Daemon:
         # protocol command and, optionally, a node-exporter textfile.
         from pwasm_tpu.obs import (EventLog, MetricsRegistry,
                                    Observability, TraceRecorder)
-        from pwasm_tpu.obs.catalog import (build_run_metrics,
+        from pwasm_tpu.obs.catalog import (build_cache_metrics,
+                                           build_run_metrics,
                                            build_service_metrics,
                                            build_stream_metrics)
         self.registry = MetricsRegistry()
         self.svc_metrics = build_service_metrics(self.registry)
         self.stream_metrics = build_stream_metrics(self.registry)
+        self.cache_metrics = build_cache_metrics(self.registry)
+        # ---- content-addressed result cache (ISSUE 15): lookup at
+        # admission, insert at job finish — the repeat-traffic fast
+        # path.  An unusable dir degrades to caching OFF with a
+        # warning, never a dead daemon.
+        self.cache = None
+        if result_cache and result_cache != "off":
+            from pwasm_tpu.service.cache import CacheStore
+            try:
+                self.cache = CacheStore(
+                    result_cache, max_bytes=result_cache_max_bytes,
+                    ttl_s=result_cache_ttl_s,
+                    metrics=self.cache_metrics, ledger=self.ledger)
+            except OSError as e:
+                self._say(f"warning: --result-cache dir "
+                          f"{result_cache} unusable ({e}); result "
+                          "caching disabled")
+        self.warm.result_cache_dir = result_cache \
+            if self.cache is not None else None
+        self._cache_evict_at = 0.0    # next TTL/budget sweep (mono)
         # foldable counters only: the live run instruments (attempt
         # histogram, run breaker gauge) belong to each run's own obs
         # bundle — the daemon's breaker view is the
@@ -657,6 +716,13 @@ class Daemon:
                 while True:
                     self._evict_results()
                     self._selfmon_tick()
+                    if self.cache is not None and \
+                            time.monotonic() >= self._cache_evict_at:
+                        # periodic TTL/budget sweep (cheap no-op when
+                        # neither is configured) — an idle cache must
+                        # still expire, not only on inserts
+                        self._cache_evict_at = time.monotonic() + 5.0
+                        self.cache.evict_now()
                     if self.drain.requested:
                         self._begin_drain(self.drain.reason
                                           or "drain requested")
@@ -752,7 +818,6 @@ class Daemon:
             clients_seen = set(self._clients_seen)   # snapshot: a
             #   concurrent admit's .add() must not resize the set
             #   mid-iteration below
-            spool_bytes = self._spool_bytes
         m["inflight"].set(running)
         m["draining"].set(1 if self._draining else 0)
         m["results_held"].set(held)
@@ -771,7 +836,10 @@ class Daemon:
             m["lane_busy_fraction"].set(
                 round(min(1.0, row["busy_s"] / uptime), 6),
                 lane=str(row["lane"]))
-        m["spool_bytes"].set(spool_bytes)
+        # both byte gauges read the ONE ledger (never a bare int a
+        # concurrent eviction could tear)
+        m["spool_bytes"].set(self.ledger.value("spool"))
+        self.cache_metrics["bytes"].set(self.ledger.value("cache"))
         for c, lag in self.streams.client_lag().items():
             self.stream_metrics["lag"].set(lag,
                                            client=c or "default")
@@ -901,7 +969,8 @@ class Daemon:
                                 "path": spool["path"],
                                 "bytes": int(_num(
                                     spool.get("bytes"), 0))}
-                            self._spool_bytes += job.spool["bytes"]
+                            self.ledger.add("spool",
+                                            job.spool["bytes"])
                         else:
                             job.detail += \
                                 " [spooled result lost in crash]"
@@ -1067,8 +1136,7 @@ class Daemon:
         job.stderr_tail = ""
         job.flight = None     # the spool file holds it now — RAM
         #                       keeps only the index row
-        with self._lock:     # workers race this read-modify-write
-            self._spool_bytes += len(out)
+        self.ledger.add("spool", len(out))
         self.obs.event("result_spool", job_id=job.id,
                        bytes=len(out))
 
@@ -1087,9 +1155,7 @@ class Daemon:
             os.unlink(job.spool["path"])
         except OSError:
             pass
-        with self._lock:     # workers race this read-modify-write
-            self._spool_bytes = max(0, self._spool_bytes
-                                    - job.spool.get("bytes", 0))
+        self.ledger.sub("spool", job.spool.get("bytes", 0))
         job.spool = None
 
     def _evict_results(self) -> None:
@@ -1388,6 +1454,12 @@ class Daemon:
             max(0.0, job.started_s - job.submitted_s),
             trace_id=job.trace_id)
         fold_run_stats(self.run_metrics, job.stats)
+        if job.state == JOB_DONE and job.cache is not None \
+                and self.cache is not None:
+            # insert at job finish (ISSUE 15): the outputs this run
+            # just wrote become the entry an identical later submit
+            # is answered from at admission
+            self._cache_insert(job)
         # past every RAM consumer of job.stats: big results move to
         # the spool (index-only in RAM), then the terminal verdict —
         # with its spool pointer — lands durably in the journal
@@ -1529,6 +1601,34 @@ class Daemon:
                         f"--{bad} does not apply to a socket stream")
         if self.drain.requested:
             raise Draining("service is draining")
+        # ---- content-addressed result cache (ISSUE 15): the lookup
+        # happens HERE, at admission, before queue.submit — a hit
+        # never touches the queue, a lease, or a device (the ≥100x
+        # path).  Streams bypass (their input is not a file); a miss
+        # remembers the key so the finished job inserts its outputs.
+        cache_row = None
+        if self.cache is not None and not stream:
+            from pwasm_tpu.service.cache import classify_argv, \
+                derive_key
+            cls = classify_argv(argv)
+            key = derive_key(cls) if cls is not None else None
+            if key is not None:
+                got = self.cache.get(key)
+                if got is not None:
+                    from pwasm_tpu.service.cache import serve_outputs
+                    manifest, blobs = got
+                    served = False
+                    try:
+                        served = serve_outputs(blobs,
+                                               cls.output_paths)
+                    except OSError:
+                        served = False   # unwritable output: the real
+                        #   run below reports the real diagnostic
+                    if served:
+                        return self._admit_cache_hit(
+                            argv, client, priority, trace_id,
+                            manifest)
+                cache_row = (key, cls)
         base_argv = list(argv)     # what the journal records: the
         #   pre-injection argv (the injected stats tmp lives in a
         #   directory that dies with this process)
@@ -1537,6 +1637,8 @@ class Daemon:
             job = Job(id=f"job-{self._next_id:04d}", argv=list(argv),
                       client=client, priority=priority,
                       trace_id=trace_id)
+        job.cache = cache_row      # (key, classified) on a cacheable
+        #   miss: _run_job inserts the finished outputs under it
         self._arm_job(job)
         if stream:
             from pwasm_tpu.stream.pafstream import StreamFeed
@@ -1582,6 +1684,97 @@ class Daemon:
                        trace_id=job.trace_id, stream=stream,
                        queue_depth=self.queue.depth())
         return job
+
+    def _admit_cache_hit(self, argv: list, client: str, priority: str,
+                         trace_id: str, manifest: dict) -> Job:
+        """Admit-and-finish a job answered from the result cache: the
+        output files are already written from the CRC-verified blobs,
+        so the job lands terminal DONE without ever entering the
+        queue.  Journaled as admit + cache_hit + finish, so a replay
+        (or a failover router reading this journal) restores a
+        truthful terminal verdict — a finish with no start record,
+        explained by the cache_hit line."""
+        from pwasm_tpu.service.cache import (argv_stats_path,
+                                             write_hit_stats)
+        with self._lock:
+            self._next_id += 1
+            job = Job(id=f"job-{self._next_id:04d}", argv=list(argv),
+                      client=client, priority=priority,
+                      trace_id=trace_id)
+        job.state = JOB_DONE
+        job.rc = 0
+        job.detail = ("served from the result cache "
+                      "(byte-identical to a full run)")
+        # a --stats-asking client gets the same file artifact a
+        # cold-run hit writes (one shared implementation across tiers)
+        job.stats = write_hit_stats(manifest, argv_stats_path(argv))
+        job.started_s = job.submitted_s
+        job.finished_s = time.time()
+        job.errbuf = job.outbuf = None
+        # one durable append (one fsync) for the whole triple: a hit
+        # pays one disk barrier, and the torn-tail rule still holds —
+        # a crash mid-append drops a whole suffix, never a half-line
+        if self.journal is not None:
+            t = round(time.time(), 3)
+            if self.journal.append_many([
+                    (REC_ADMIT, {"job_id": job.id, "t": t,
+                                 "argv": list(argv),
+                                 "client": client,
+                                 "priority": priority,
+                                 "trace_id": trace_id}),
+                    (REC_CACHE_HIT, {"job_id": job.id, "t": t}),
+                    (REC_FINISH, {"job_id": job.id, "t": t,
+                                  "state": JOB_DONE, "rc": 0,
+                                  "detail": job.detail})]):
+                for rec in (REC_ADMIT, REC_CACHE_HIT, REC_FINISH):
+                    self.svc_metrics["journal_records"].inc(rec=rec)
+            elif not self._journal_warned:
+                self._journal_warned = True
+                self._say(f"warning: job-journal append failed "
+                          f"({self.journal.broken}); continuing "
+                          "WITHOUT crash recovery")
+                self.obs.event("journal_broken",
+                               detail=self.journal.broken)
+        job.done.set()
+        with self._lock:
+            self.jobs[job.id] = job
+            self._clients_seen.add(client)
+        self.stats.jobs_accepted += 1
+        self.stats.jobs_completed += 1
+        self.svc_metrics["jobs"].inc(outcome="accepted")
+        self.svc_metrics["jobs"].inc(outcome=JOB_DONE)
+        wall = max(0.0, job.finished_s - job.submitted_s)
+        # the wall/wait histograms see the SERVED latency — the whole
+        # point of the cache is that these observations collapse
+        self.svc_metrics["job_wall_seconds"].observe(
+            wall, trace_id=job.trace_id)
+        self.svc_metrics["queue_wait_seconds"].observe(
+            0.0, trace_id=job.trace_id)
+        self.obs.event("job_admit", job_id=job.id, client=client,
+                       trace_id=job.trace_id, stream=False,
+                       queue_depth=self.queue.depth())
+        self.obs.event("cache_hit", job_id=job.id,
+                       trace_id=job.trace_id)
+        self.obs.event("job_finish", job_id=job.id, state=JOB_DONE,
+                       rc=0, trace_id=job.trace_id,
+                       wall_s=round(wall, 6), detail=job.detail)
+        self._write_textfile()   # a hit is a finished job too: the
+        #                          scraper's view must not go stale on
+        #                          a daemon serving pure repeat traffic
+        return job
+
+    def _cache_insert(self, job: Job) -> None:
+        """Store a cleanly finished job's output files under its
+        admission-time key via the shared ``insert_from_paths`` (one
+        populate implementation with the cold CLI): the key re-derive
+        inside it skips the insert when the input was rewritten
+        between admission and finish — a drifted key must never be
+        poisoned."""
+        key, cls = job.cache
+        from pwasm_tpu.service.cache import insert_from_paths
+        if insert_from_paths(self.cache, key, cls, stats=job.stats):
+            self.obs.event("cache_insert", job_id=job.id,
+                           trace_id=job.trace_id)
 
     def _retry_after_s(self) -> float:
         """The queue_full backoff hint: roughly one recent job's wall
@@ -1791,8 +1984,12 @@ class Daemon:
             st["spool"] = {
                 "dir": self.spool_dir,
                 "threshold_bytes": self.spool_threshold_bytes,
-                "bytes": self._spool_bytes,
+                "bytes": self.ledger.value("spool"),
             }
+            # additive (stats_version unchanged): the result cache
+            # (ISSUE 15) — hit/miss flow, on-disk bytes, hit ratio
+            st["cache"] = self.cache.stats_dict() \
+                if self.cache is not None else {"enabled": False}
             # additive (stats_version unchanged): streaming ingestion
             # (ISSUE 10) — live streams, record/batch flow, buffer lag
             tot = self.streams.totals()
@@ -1825,6 +2022,18 @@ class Daemon:
             # what `pwasm-tpu health --exit-code` and any external
             # orchestrator probe consume
             return protocol.ok(health=self._health())
+        if cmd == "cache-probe":
+            # fleet cache affinity (ISSUE 15): the router asks whether
+            # this member could answer a key from its result cache —
+            # a cheap manifest check, no blob reads, no admission
+            key = req.get("key")
+            if not isinstance(key, str) or not key:
+                return protocol.err(protocol.ERR_BAD_REQUEST,
+                                    "cache-probe needs a key field")
+            return protocol.ok(
+                enabled=self.cache is not None,
+                hit=self.cache is not None
+                and self.cache.contains(key))
         if cmd == "logs":
             # the incident-query verb (ISSUE 14 satellite): filter
             # THIS daemon's --log-json (rotated .1 generation
@@ -2095,7 +2304,8 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
                        ("max-queue-total", None),
                        ("spool-threshold-bytes", None),
                        ("stream-buffer", 512),
-                       ("log-json-max-bytes", None)):
+                       ("log-json-max-bytes", None),
+                       ("result-cache-max-bytes", None)):
         val = opts.pop(knob, None)
         if val is None:
             nums[knob] = dflt
@@ -2150,6 +2360,13 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
     if spool_dir is not None and not spool_dir.strip():
         stderr.write(f"{_SERVE_USAGE}\nInvalid --spool-dir value\n")
         return EXIT_USAGE
+    result_cache = opts.pop("result-cache", None)
+    if result_cache is not None and not result_cache.strip():
+        stderr.write(f"{_SERVE_USAGE}\nInvalid --result-cache "
+                     "value\n")
+        return EXIT_USAGE
+    if result_cache == "off":
+        result_cache = None
     priority_lanes: tuple[str, ...] | None = None
     val = opts.pop("priority-lanes", None)
     if val is not None:
@@ -2254,7 +2471,10 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
                         compile_cache_dir=compile_cache_dir,
                         warmup=warmup,
                         canary_interval_s=canary_interval_s,
-                        slo_rules=slo_rules)
+                        slo_rules=slo_rules,
+                        result_cache=result_cache,
+                        result_cache_max_bytes=nums[
+                            "result-cache-max-bytes"])
     except OSError:
         stderr.write(f"Cannot open file {log_json} for writing!\n")
         return EXIT_USAGE

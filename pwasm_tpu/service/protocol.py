@@ -61,6 +61,13 @@ Commands (``{"cmd": ...}``):
                drain is requested — the job stops at its next batch
                boundary, leaving a valid resumable checkpoint.
 ``stats``      the service-level counters (versioned schema).
+``cache-probe``  ``{"cmd":"cache-probe","key":SHA256}`` — would this
+               daemon's result cache (``serve --result-cache``,
+               docs/SERVICE.md) answer the content-addressed key?
+               ``{"hit":bool,"enabled":bool}`` from a cheap manifest
+               check (no blob reads, no admission).  The fleet router
+               uses it for cache-affinity placement: a member that
+               already answered a job gets its repeat.
 ``health``     the self-monitoring verdict (ISSUE 14): ok/degraded/
                failing, the firing SLO rules (docs/OBSERVABILITY.md
                rule catalog) and canary state; a fleet router folds

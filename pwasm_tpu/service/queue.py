@@ -95,6 +95,9 @@ class Job:
     spool: dict | None = None          # disk-spooled result index
     #   ({path, bytes}): the RAM-resident stats/stderr_tail moved to
     #   the spool dir — see daemon._spool_result
+    cache: object = field(default=None, repr=False)  # (key,
+    #   classified) for a cacheable job that MISSED at admission —
+    #   the finished outputs insert under it (service/cache.py)
     submitted_s: float = field(default_factory=time.time)
     started_s: float | None = None
     finished_s: float | None = None

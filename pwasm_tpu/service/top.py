@@ -166,6 +166,19 @@ def render(st: dict) -> str:
             f"batches {streams.get('batches', 0)})")
     else:
         out.append(" STREAMS: none")
+    cache = st.get("cache") or {}
+    if cache.get("enabled"):
+        # the result cache (ISSUE 15): hit flow + on-disk footprint —
+        # "is repeat traffic actually landing on the fast path" (and
+        # eviction keeping pace with insertion is the cache_thrash
+        # page's precursor, visible here first)
+        out.append(
+            f" CACHE: {cache.get('hits', 0)} hits / "
+            f"{cache.get('misses', 0)} misses "
+            f"(ratio {100.0 * float(cache.get('hit_ratio') or 0):.0f}"
+            f"%) | {cache.get('insertions', 0)} inserted, "
+            f"{cache.get('evictions', 0)} evicted, "
+            f"{cache.get('bytes', 0)} bytes")
     warm = st.get("warm") or {}
     journal = st.get("journal") or {}
     out.append(

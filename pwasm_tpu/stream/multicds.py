@@ -47,7 +47,8 @@ from pwasm_tpu.core.errors import EXIT_USAGE, PwasmError
 M2M_USAGE = """Usage:
  pafreport --many2many <targets.fa> -r <cds_multi.fa> [-o <scores.tsv>]
     [-s <summary.txt>] [--device=cpu|tpu] [--band=N] [--stats=FILE]
-    [--max-retries=N] [--fallback=cpu|fail] [-v]
+    [--max-retries=N] [--fallback=cpu|fail] [--result-cache=DIR|off]
+    [-v]
 
    Score EVERY query in the -r FASTA against EVERY target in
    <targets.fa> through one device session (banded affine-gap DP,
@@ -57,6 +58,13 @@ M2M_USAGE = """Usage:
    (id, targets, best target, best score, score sum).  Sections are
    byte-identical to running each CDS as its own job — the multi
    submit only amortizes the session.
+
+   --result-cache=DIR caches at PER-CDS SECTION granularity
+   (service/cache.py): each section keys on (its query record digest,
+   the whole target-set digest, --band), so a job re-scoring 9 cached
+   CDS + 1 new one dispatches ONLY the new one to the device and
+   splices the byte-identical stored sections around it.  A served
+   job under `serve --result-cache` inherits the daemon's dir.
 """
 
 
@@ -169,45 +177,61 @@ def many2many_main(opts: dict, positional: list, stdout, stderr,
 
     qnames, qs = load_fasta(rpath, "-r query")
     tnames, ts = load_fasta(positional[0], "target")
+    tlens = [len(t) for t in ts]
     stats = RunStats()
-    stats.lines = len(qs) * len(ts)
 
-    # the one session gate: identical to cli._main_loop's — a bounded
-    # probe before the first jax touch, demoting loudly to cpu, with
-    # per-run probe/warm-hit accounting (the "one warm device session"
-    # acceptance reads these)
-    use_device = device == "tpu"
-    if use_device:
-        from pwasm_tpu.utils import backend as _backend
-        from pwasm_tpu.utils.backend import device_backend_reachable
-        _p0 = _backend.probe_counters["probes"]
-        _w0 = _backend.probe_counters["warm_hits"]
-        ok, why = device_backend_reachable()
-        stats.backend_probes += \
-            _backend.probe_counters["probes"] - _p0
-        stats.backend_warm_hits += \
-            _backend.probe_counters["warm_hits"] - _w0
-        if not ok:
-            print(f"Warning: jax backend unreachable ({why.strip()}); "
-                  "running with --device=cpu", file=stderr)
-            use_device = False
-            stats.engine_fallbacks += 1
-    if not use_device:
-        # never let a pinned-but-unhealthy TPU tunnel hijack a cpu
-        # scoring job at backend init (same guard as flush_realign;
-        # via the compat shim so this module stays textually jax-free
-        # for the find_stream_violations gate)
-        from pwasm_tpu.utils.jaxcompat import pin_cpu_platform
-        pin_cpu_platform()
-    else:
-        from pwasm_tpu.ops import enable_compilation_cache
-        # flag first (a cold --many2many run), warm-context second
-        # (a served job under `serve --compile-cache-dir`)
-        cache_dir = opts.get("compile-cache-dir")
-        if not isinstance(cache_dir, str) or not cache_dir:
-            cache_dir = getattr(warm, "compile_cache_dir", None) \
-                if warm is not None else None
-        enable_compilation_cache(cache_dir)
+    # ---- per-CDS SECTION cache (ISSUE 15): each query's report
+    # section depends only on (that query record, the target set, the
+    # band) — exactly the per-section parity contract — so sections
+    # cache INDEPENDENTLY: a job re-scoring 9 cached CDS + 1 new one
+    # dispatches only the new one and splices byte-identical stored
+    # sections around it.  Flag first (a cold --many2many run),
+    # warm-context second (a served job under `serve --result-cache`).
+    store = None
+    skeys: list = [None] * len(qs)
+    sections: list = [None] * len(qs)
+    sums: list = [None] * len(qs)
+    rc_dir = opts.get("result-cache")
+    if rc_dir is True:
+        raise _usage_err("Error: --result-cache requires a directory "
+                         "(or off)")
+    rc_max = None
+    if "result-cache-max-bytes" in opts:
+        val = opts["result-cache-max-bytes"]
+        if val is True or not str(val).isascii() \
+                or not str(val).isdigit() or int(val) < 1:
+            raise _usage_err("Error: invalid "
+                             f"--result-cache-max-bytes value: {val}")
+        rc_max = int(val)
+    if not isinstance(rc_dir, str) or not rc_dir or rc_dir == "off":
+        rc_dir = getattr(warm, "result_cache_dir", None) \
+            if warm is not None else None
+    if rc_dir:
+        import hashlib
+
+        from pwasm_tpu.service.cache import (CacheStore,
+                                             record_digest,
+                                             section_key)
+        try:
+            store = CacheStore(rc_dir, max_bytes=rc_max)
+        except OSError as e:
+            print(f"Warning: --result-cache dir {rc_dir} unusable "
+                  f"({e}); caching disabled", file=stderr)
+        if store is not None:
+            th = hashlib.sha256()
+            for tn, t in zip(tnames, ts):
+                th.update(record_digest(tn, t).encode())
+            tdig = th.hexdigest()
+            for qi, (qn, q) in enumerate(zip(qnames, qs)):
+                skeys[qi] = section_key(record_digest(qn, q), tdig,
+                                        band)
+                got = store.get(skeys[qi])
+                if got is not None and "o" in got[1] \
+                        and "s" in got[1]:
+                    sections[qi] = got[1]["o"]
+                    sums[qi] = got[1]["s"]
+    miss = [qi for qi in range(len(qs)) if sections[qi] is None]
+    stats.lines = len(miss) * len(ts)
 
     from pwasm_tpu.resilience import BatchSupervisor, ResiliencePolicy
     supervisor = BatchSupervisor(
@@ -216,47 +240,106 @@ def many2many_main(opts: dict, positional: list, stdout, stderr,
     if warm is not None and getattr(warm, "supervisor_state", None):
         supervisor.restore_state(warm.supervisor_state)
 
-    from types import SimpleNamespace
-
-    from pwasm_tpu.cli import _lane_device_scope
     from pwasm_tpu.ops.banded_dp import NEG
-    from pwasm_tpu.parallel.many2many import many2many_scores_ragged
-    if verbose:
-        print(f"many2many: {len(qs)} quer"
-              f"{'y' if len(qs) == 1 else 'ies'} x {len(ts)} "
-              f"target(s), band {band}, one "
-              f"{'device' if use_device else 'cpu'} session",
-              file=stderr)
-    # a served job holding a device lease places on ITS lane, exactly
-    # like cli._main_loop jobs (the ISSUE 8 lane-isolation contract);
-    # inert for cold runs and single-lane daemons.  (Spanning a
-    # MULTI-device lease with a 2-D mesh is the ROADMAP item-3
-    # remaining work — today the session stays single-device.)
-    with _lane_device_scope(
-            SimpleNamespace(device="tpu" if use_device else "cpu"),
-            warm, stderr):
-        scores = many2many_scores_ragged(qs, ts, band=band,
-                                         supervisor=supervisor)
-    stats.alignments = len(qs) * len(ts)
-    stats.aligned_bases = sum(len(t) for t in ts) * len(qs)
+    use_device = device == "tpu" and bool(miss)
+    if miss:
+        # the one session gate: identical to cli._main_loop's — a
+        # bounded probe before the first jax touch, demoting loudly to
+        # cpu, with per-run probe/warm-hit accounting (the "one warm
+        # device session" acceptance reads these).  An ALL-HIT job
+        # never reaches this block: zero probes, zero device touches.
+        if use_device:
+            from pwasm_tpu.utils import backend as _backend
+            from pwasm_tpu.utils.backend import \
+                device_backend_reachable
+            _p0 = _backend.probe_counters["probes"]
+            _w0 = _backend.probe_counters["warm_hits"]
+            ok, why = device_backend_reachable()
+            stats.backend_probes += \
+                _backend.probe_counters["probes"] - _p0
+            stats.backend_warm_hits += \
+                _backend.probe_counters["warm_hits"] - _w0
+            if not ok:
+                print(f"Warning: jax backend unreachable "
+                      f"({why.strip()}); running with --device=cpu",
+                      file=stderr)
+                use_device = False
+                stats.engine_fallbacks += 1
+        if not use_device:
+            # never let a pinned-but-unhealthy TPU tunnel hijack a cpu
+            # scoring job at backend init (same guard as
+            # flush_realign; via the compat shim so this module stays
+            # textually jax-free for the find_stream_violations gate)
+            from pwasm_tpu.utils.jaxcompat import pin_cpu_platform
+            pin_cpu_platform()
+        else:
+            from pwasm_tpu.ops import enable_compilation_cache
+            # flag first (a cold --many2many run), warm-context second
+            # (a served job under `serve --compile-cache-dir`)
+            cache_dir = opts.get("compile-cache-dir")
+            if not isinstance(cache_dir, str) or not cache_dir:
+                cache_dir = getattr(warm, "compile_cache_dir", None) \
+                    if warm is not None else None
+            enable_compilation_cache(cache_dir)
+
+        from types import SimpleNamespace
+
+        from pwasm_tpu.cli import _lane_device_scope
+        from pwasm_tpu.parallel.many2many import \
+            many2many_scores_ragged
+        if verbose:
+            print(f"many2many: {len(miss)} of {len(qs)} quer"
+                  f"{'y' if len(qs) == 1 else 'ies'} x {len(ts)} "
+                  f"target(s), band {band}, one "
+                  f"{'device' if use_device else 'cpu'} session"
+                  + (f" ({len(qs) - len(miss)} section(s) from "
+                     "cache)" if len(miss) < len(qs) else ""),
+                  file=stderr)
+        # a served job holding a device lease places on ITS lane,
+        # exactly like cli._main_loop jobs (the ISSUE 8
+        # lane-isolation contract); inert for cold runs and
+        # single-lane daemons.  (Spanning a MULTI-device lease with a
+        # 2-D mesh is the ROADMAP item-3 remaining work — today the
+        # session stays single-device.)
+        with _lane_device_scope(
+                SimpleNamespace(device="tpu" if use_device
+                                else "cpu"), warm, stderr):
+            scores = many2many_scores_ragged(
+                [qs[qi] for qi in miss], ts, band=band,
+                supervisor=supervisor)
+        for k, qi in enumerate(miss):
+            sec = format_sections(
+                [qnames[qi]], [len(qs[qi])], tnames, tlens,
+                [scores[k]], NEG).encode("utf-8")
+            sm = format_summary([qnames[qi]], tnames, [scores[k]],
+                                NEG).encode("utf-8")
+            sections[qi], sums[qi] = sec, sm
+            if store is not None and skeys[qi] is not None:
+                store.insert(skeys[qi], {"o": sec, "s": sm})
+    elif verbose:
+        print(f"many2many: all {len(qs)} section(s) served from the "
+              "result cache — no device session", file=stderr)
+    # honest accounting: the counters describe work this run actually
+    # DID; cached sections ride in as bytes, not as alignments
+    stats.alignments = len(miss) * len(ts)
+    stats.aligned_bases = sum(tlens) * len(miss)
     stats.device_batches = 0   # the ragged driver dispatches per
     #   bucket; the supervisor's site counters carry the attempt story
 
-    body = format_sections(qnames, [len(q) for q in qs], tnames,
-                           [len(t) for t in ts], scores, NEG)
+    body = b"".join(sections)
     if "o" in opts:
         try:
-            with open(str(opts["o"]), "w") as f:
+            with open(str(opts["o"]), "wb") as f:
                 f.write(body)
         except OSError:
             raise PwasmError(
                 f"Cannot open file {opts['o']} for writing!\n")
     else:
-        stdout.write(body)
+        stdout.write(body.decode("utf-8"))
     if "s" in opts:
         try:
-            with open(str(opts["s"]), "w") as f:
-                f.write(format_summary(qnames, tnames, scores, NEG))
+            with open(str(opts["s"]), "wb") as f:
+                f.write(b"".join(sums))
         except OSError:
             raise PwasmError(
                 f"Cannot open file {opts['s']} for writing!\n")

@@ -109,6 +109,89 @@ class LineAssembler:
         return [tail] if tail else []
 
 
+class BlockLineReader:
+    """Block-scan line reader for the jax-free host path (ROADMAP
+    item 5): the one-shot CLI used to consume its input PAF through
+    Python's line-at-a-time text iterator — one readline call, one
+    newline scan, one str build per record.  This reader instead
+    walks the file in 1 MiB blocks, pushing each through the same
+    :class:`LineAssembler` the streaming readers use, so per-record
+    overhead collapses to the assembler's single ``split`` per block
+    while byte semantics stay IDENTICAL to the text-mode read
+    (universal newlines via the assembler, an INCREMENTAL utf-8
+    decoder so a multi-byte character straddling a block boundary
+    reassembles, strict errors so undecodable input fails as loudly
+    as the text reader did, final newline-less record yielded at
+    EOF).
+
+    Deliberately NOT ``mmap``-backed: this reader runs inside the
+    serve daemon's workers (every served job's ingest), and touching
+    a mapped page past the EOF of a file a client truncated mid-job
+    raises SIGBUS — killing the whole multi-client process, where a
+    bounded ``read`` merely observes a short file.  Sequential block
+    reads hit the page cache at the same speed; the win over readline
+    is the batching, not the mapping.
+
+    ``hasher`` (e.g. ``hashlib.sha256()``) is updated with every RAW
+    block as it is consumed, so the content digest the result cache
+    keys on (``service/cache.py``) rides the same single pass as the
+    ingest — keying an input adds no second read.  ``hexdigest()`` is
+    meaningful once the reader is exhausted.
+    """
+
+    def __init__(self, path: str, block_bytes: int = 1 << 20,
+                 hasher=None):
+        self.path = path
+        self.block_bytes = max(1, int(block_bytes))
+        self.hasher = hasher
+        self._f = open(path, "rb")
+        self._asm = LineAssembler()
+        import codecs
+        self._dec = codecs.getincrementaldecoder("utf-8")("strict")
+        self._lines: deque[str] = deque()
+        self._done = False
+        self.consumed = False          # reached EOF (digest is whole)
+
+    def _next_block(self) -> bytes:
+        return self._f.read(self.block_bytes)
+
+    def hexdigest(self) -> str | None:
+        """The content digest of everything read so far (the whole
+        file once ``consumed``); None without a hasher."""
+        return self.hasher.hexdigest() if self.hasher is not None \
+            else None
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+    def __iter__(self) -> "BlockLineReader":
+        return self
+
+    def __next__(self) -> str:
+        while True:
+            if self._lines:
+                return self._lines.popleft()
+            if self._done:
+                raise StopIteration
+            chunk = self._next_block()
+            if not chunk:
+                self._done = True
+                self.consumed = True
+                tail = self._dec.decode(b"", final=True)
+                if tail:
+                    self._lines.extend(self._asm.push(tail))
+                self._lines.extend(self._asm.flush())
+                continue
+            if self.hasher is not None:
+                self.hasher.update(chunk)
+            text = self._dec.decode(chunk)
+            if text:
+                self._lines.extend(self._asm.push(text))
+
+
 class FollowReader:
     """Iterate the lines of a growing file, ``tail -F``-style.
 

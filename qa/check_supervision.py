@@ -144,6 +144,15 @@ METRIC_DOC = "docs/OBSERVABILITY.md"
 # removes the self-monitoring surface the fleet verdict depends on.
 SLO_FILES = ("pwasm_tpu/obs/slo.py", "pwasm_tpu/service/canary.py")
 
+# ---- result-cache gate (ISSUE 15 satellite) ---------------------------
+# The content-addressed result cache sits on EVERY serving tier's hot
+# path (CLI populate, daemon admission, router edge + affinity) and
+# runs inside connection threads: it must EXIST (a refactor dropping
+# it silently removes the ≥100x repeat-traffic path every tier leans
+# on) and stay jax-free like the rest of service/ — its only jobs are
+# hashing, fsio writes, and file serves.
+CACHE_FILES = ("pwasm_tpu/service/cache.py",)
+
 # default SLO rule names are declared in the catalog's rules region
 # (below the sentinel) as {"name": "..."} literals; each must appear
 # in docs/OBSERVABILITY.md — an undocumented rule is an alert an
@@ -382,6 +391,32 @@ def find_slo_violations(root: str = REPO) -> list[str]:
     return out
 
 
+def find_cache_violations(root: str = REPO) -> list[str]:
+    """Result-cache gate (ISSUE 15 satellite): service/cache.py must
+    exist AND stay jax-free — the cache runs in admission/connection
+    threads on every serving tier, and a jax import there would
+    smuggle backend init into all of them."""
+    out: list[str] = []
+    for rel in CACHE_FILES:
+        path = os.path.join(root, *rel.split("/"))
+        if not os.path.isfile(path):
+            out.append(f"{rel}: result-cache module missing — the "
+                       "content-addressed serving path every tier "
+                       "(CLI/daemon/router) depends on")
+            continue
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                if line.lstrip().startswith("#"):
+                    continue
+                if SERVICE_PATTERNS.search(line):
+                    out.append(
+                        f"{rel}:{i}: result-cache module touches "
+                        f"jax directly: {line.strip()} — the cache "
+                        "hashes and serves bytes; device work stays "
+                        "behind cli.run's supervised sites")
+    return out
+
+
 def find_doc_drift(root: str = REPO) -> list[str]:
     """Catalog families missing from docs/OBSERVABILITY.md (module
     comment: the doc is the operator's catalog of record, so every
@@ -432,13 +467,14 @@ def main() -> int:
     doc_drift = find_doc_drift()
     sharding = find_sharding_violations()
     slo = find_slo_violations()
+    cachev = find_cache_violations()
     for line in bad:
         print(line, file=sys.stderr)
     for rel in stale:
         print(f"{rel}: stale registry entry (no device entry points "
               "left — remove it)", file=sys.stderr)
     for line in svc + obs + stream + fleet + metric + doc_drift \
-            + sharding + slo:
+            + sharding + slo + cachev:
         print(line, file=sys.stderr)
     if bad:
         print(f"\n{len(bad)} device entry point(s) outside the "
@@ -472,8 +508,13 @@ def main() -> int:
         print(f"\n{len(slo)} self-monitoring gate failure(s): "
               "obs/slo.py and service/canary.py must exist and stay "
               "jax-free (ISSUE 14).", file=sys.stderr)
+    if cachev:
+        print(f"\n{len(cachev)} result-cache gate failure(s): "
+              "service/cache.py must exist and stay jax-free "
+              "(ISSUE 15).", file=sys.stderr)
     return 1 if (bad or stale or svc or obs or stream or fleet
-                 or metric or doc_drift or sharding or slo) else 0
+                 or metric or doc_drift or sharding or slo
+                 or cachev) else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,771 @@
+"""Content-addressed result cache (ISSUE 15).
+
+Acceptance contracts:
+
+- **byte parity**: a cache hit's served output files are
+  byte-identical to a cache-off run of the same inputs+flags, on
+  every tier (cold CLI, serve daemon, fleet router);
+- **canonicalization**: a cosmetic argv reorder, a different output
+  path, or a byte-neutral knob (``--device``/``--batch``) still HITS;
+  anything result-affecting (``--band``, ``-c``, mode flags, input or
+  ref content) keys a distinct entry; anything the table cannot vouch
+  for (unknown flags, ``--resume``/``--follow``/``--inject-faults``)
+  BYPASSES;
+- **integrity**: CRC rot is a miss (and drops the entry) — a corrupt
+  byte is served exactly never; a kill -9 mid-insert leaves orphan
+  blobs the startup sweep removes, never a servable half-entry;
+- **zero pipeline involvement on a daemon hit**: the job lands
+  terminal at admission — no queue, no lease, no probe
+  (``backend.probes == 0``) — and the journal carries a ``cache_hit``
+  record so replay accounting stays truthful;
+- **m2m section granularity**: a ``--many2many`` job re-scoring
+  cached CDS + new ones dispatches only the new ones and its report
+  is byte-identical to the all-miss run;
+- **eviction**: LRU under ``--result-cache-max-bytes``, TTL expiry,
+  and the unified byte ledger tracking disk truth.
+"""
+
+import hashlib
+import io
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+from contextlib import contextmanager
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from pwasm_tpu.cli import _parse_args, run
+from pwasm_tpu.core.fasta import write_fasta
+from pwasm_tpu.fleet.router import Router
+from pwasm_tpu.service.cache import (ByteLedger, CacheStore, classify,
+                                     classify_argv, derive_key,
+                                     digest_file, fasta_digest,
+                                     record_digest, section_key,
+                                     serve_outputs)
+from pwasm_tpu.service.client import ServiceClient, wait_for_socket
+from pwasm_tpu.service.daemon import Daemon
+
+from helpers import make_paf_line
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _corpus(tmp_path, n=24, qlen=120, seed=3):
+    rng = np.random.default_rng(seed)
+    q = "".join("ACGT"[i] for i in rng.integers(0, 4, qlen))
+    lines = []
+    for i in range(n):
+        cut = 10 + int(rng.integers(0, qlen - 40))
+        qb = q[cut]
+        tb = "ACGT"[("ACGT".index(qb) + 1) % 4]
+        ops = [("=", cut), ("*", tb, qb), ("=", 20), ("ins", "gg"),
+               ("=", qlen - cut - 21)]
+        lines.append(make_paf_line("q", q, f"asm{i}", "+", ops)[0])
+    fa = tmp_path / "q.fa"
+    write_fasta(str(fa), [("q", q.encode())])
+    paf = tmp_path / "in.paf"
+    paf.write_text("".join(ln + "\n" for ln in lines))
+    return str(paf), str(fa)
+
+
+def _key_of(argv):
+    cls = classify_argv(argv)
+    assert cls is not None, argv
+    key = derive_key(cls)
+    assert key is not None, argv
+    return key
+
+
+@contextmanager
+def _daemon(**kw):
+    sockdir = tempfile.mkdtemp(prefix="pwcache")
+    sock = os.path.join(sockdir,
+                        os.path.basename(sockdir) + ".sock")
+    err = io.StringIO()
+    dm = Daemon(sock, stderr=err, **kw)
+    rcbox: list = []
+    t = threading.Thread(target=lambda: rcbox.append(dm.serve()),
+                         daemon=True)
+    t.start()
+    assert wait_for_socket(sock, 15), err.getvalue()
+    try:
+        yield SimpleNamespace(daemon=dm, sock=sock, rc=rcbox,
+                              err=err, thread=t, dir=sockdir)
+    finally:
+        if not dm.drain.requested:
+            dm.drain.request("test teardown")
+        t.join(20)
+        shutil.rmtree(sockdir, ignore_errors=True)
+
+
+def _submit_wait(sock, argv, timeout=120):
+    with ServiceClient(sock) as c:
+        sub = c.submit(argv)
+        assert sub.get("ok"), sub
+        res = c.result(sub["job_id"], timeout=timeout)
+    assert res.get("ok"), res
+    return res
+
+
+# ---------------------------------------------------------------------------
+# key derivation / canonicalization matrix
+# ---------------------------------------------------------------------------
+def test_flag_canonicalization_matrix(tmp_path):
+    """The documented table, exercised as a matrix: cosmetic
+    differences hit, result-affecting differences miss, uncacheable
+    semantics bypass."""
+    paf, fa = _corpus(tmp_path)
+    base = [paf, "-r", fa, "-o", str(tmp_path / "a.dfa")]
+    k0 = _key_of(base)
+    # cosmetic: argv reorder, a different -o path, joined-value forms
+    assert _key_of(["-r", fa, "-o", str(tmp_path / "b.dfa"),
+                    paf]) == k0
+    assert _key_of([f"-o{tmp_path / 'c.dfa'}", paf,
+                    f"-r{fa}"]) == k0
+    # byte-neutral knobs (parity-gated across the repo): still hit
+    assert _key_of(base + ["--device=tpu", "--batch=16"]) == k0
+    assert _key_of(base + ["--max-retries=5", "--fallback=fail",
+                           "--recover=off", "-v", "-D",
+                           f"--stats={tmp_path / 's.json'}"]) == k0
+    # result-affecting: each keys a DISTINCT entry
+    distinct = {k0}
+    for extra in (["-G"], ["-F"], ["-C"], ["-N"], ["-c", "30"],
+                  ["--band=32"], ["--skip-bad-lines"],
+                  ["--realign", "-w", str(tmp_path / "m.mfa")]):
+        k = _key_of(base + extra)
+        assert k not in distinct, extra
+        distinct.add(k)
+    # the output KIND set is keyed (paths are not)
+    ks = _key_of(base + ["-s", str(tmp_path / "x.sum")])
+    assert ks != k0
+    assert _key_of(["-s", str(tmp_path / "y.sum")] + base) == ks
+
+
+def test_bypass_semantics(tmp_path):
+    """--resume/--follow/--inject-faults, unknown flags, stdin input,
+    and a stdout report all refuse to key (classify → None): unknown
+    means 'cannot vouch for byte identity'."""
+    paf, fa = _corpus(tmp_path)
+    out = str(tmp_path / "a.dfa")
+    base = [paf, "-r", fa, "-o", out]
+    for argv in (base + ["--resume"], base + ["--follow"],
+                 base + ["--inject-faults=seed=1,rate=1,kinds=hang"],
+                 base + ["--totally-unknown-flag=1"],
+                 ["-", "-r", fa, "-o", out],
+                 [paf, "-r", fa]):
+        opts, pos = _parse_args(list(argv))
+        assert classify(opts, pos) is None, argv
+
+
+def test_ref_fasta_digest_is_canonical(tmp_path):
+    """Line wrapping and sequence case are cosmetic; sequence content
+    and record names are not."""
+    a = tmp_path / "a.fa"
+    b = tmp_path / "b.fa"
+    a.write_text(">q descr\nACGTACGTACGT\n")
+    b.write_text(">q descr\nacgt\nACGTA\nCGT\n\n")
+    assert fasta_digest(str(a)) == fasta_digest(str(b))
+    b.write_text(">q descr\nACGTACGTACGA\n")
+    assert fasta_digest(str(a)) != fasta_digest(str(b))
+    b.write_text(">q2 descr\nACGTACGTACGT\n")
+    assert fasta_digest(str(a)) != fasta_digest(str(b))
+
+
+def test_input_change_misses(tmp_path):
+    paf, fa = _corpus(tmp_path)
+    argv = [paf, "-r", fa, "-o", str(tmp_path / "a.dfa")]
+    k0 = _key_of(argv)
+    with open(paf, "a") as f:
+        f.write("# a comment line changes the input digest\n")
+    assert _key_of(argv) != k0
+
+
+# ---------------------------------------------------------------------------
+# the store: CRC, orphans, eviction, ledger
+# ---------------------------------------------------------------------------
+def test_store_roundtrip_and_crc_rot(tmp_path):
+    store = CacheStore(str(tmp_path / "cd"))
+    key = "k" * 64
+    assert store.insert(key, {"o": b"report bytes", "s": b"sum"})
+    manifest, blobs = store.get(key)
+    assert blobs == {"o": b"report bytes", "s": b"sum"}
+    assert store.contains(key)
+    # rot one blob: the next get is a MISS (never a corrupt serve)
+    # and the entry is dropped whole
+    with open(tmp_path / "cd" / (key + ".o"), "r+b") as f:
+        f.write(b"X")
+    assert store.get(key) is None
+    assert not store.contains(key)
+    assert not os.path.exists(tmp_path / "cd" / (key + ".json"))
+    st = store.stats_dict()
+    assert st["hits"] == 1 and st["misses"] == 1
+
+
+def test_store_manifest_rot_is_a_miss(tmp_path):
+    store = CacheStore(str(tmp_path / "cd"))
+    key = "m" * 64
+    store.insert(key, {"o": b"x" * 100})
+    mpath = tmp_path / "cd" / (key + ".json")
+    obj = json.loads(mpath.read_text())
+    obj["bytes"] = 999999        # payload no longer matches its CRC
+    mpath.write_text(json.dumps(obj))
+    assert store.get(key) is None
+
+
+def test_kill9_mid_insert_leaves_consistent_cache(tmp_path):
+    """The manifest is the COMMIT POINT: blobs without one (the
+    kill -9 window) are orphans the next store's sweep removes ONCE
+    they age past the grace window (a YOUNG orphan may be a shared-dir
+    sibling's in-flight insert and must survive); a manifest whose
+    blob vanished is dropped lazily at get time."""
+    from pwasm_tpu.service.cache import SWEEP_GRACE_S
+    root = tmp_path / "cd"
+    store = CacheStore(str(root))
+    store.insert("a" * 64, {"o": b"whole entry"})
+    # simulate the crash window: blobs landed, manifest did not
+    (root / ("b" * 64 + ".o")).write_bytes(b"orphan blob")
+    # and the inverse defect: manifest whose blob is gone
+    store.insert("c" * 64, {"o": b"doomed"})
+    os.unlink(root / ("c" * 64 + ".o"))
+    # a FRESH orphan survives the sweep (in-flight-insert protection)
+    young = CacheStore(str(root))
+    assert os.path.exists(root / ("b" * 64 + ".o"))
+    assert young.get("a" * 64) is not None
+    # aged past the grace window, the next sweep reaps it
+    old = time.time() - SWEEP_GRACE_S - 60
+    os.utime(root / ("b" * 64 + ".o"), (old, old))
+    store2 = CacheStore(str(root))     # restart = sweep
+    assert store2.get("a" * 64) is not None
+    assert not os.path.exists(root / ("b" * 64 + ".o"))
+    assert store2.get("c" * 64) is None    # lazy drop at get
+    assert not os.path.exists(root / ("c" * 64 + ".json"))
+    # ledger truth: bytes == what is actually on disk
+    disk = sum(os.path.getsize(root / n) for n in os.listdir(root))
+    assert store2.stats_dict()["bytes"] == disk
+
+
+def test_lru_eviction_under_max_bytes(tmp_path):
+    store = CacheStore(str(tmp_path / "cd"), max_bytes=250)
+    store.insert("a" * 64, {"o": b"x" * 100})
+    time.sleep(0.02)
+    store.insert("b" * 64, {"o": b"y" * 100})
+    time.sleep(0.02)
+    assert store.get("a" * 64) is not None   # refresh a's LRU clock
+    time.sleep(0.02)
+    store.insert("c" * 64, {"o": b"z" * 100})   # budget forces one out
+    assert store.get("b" * 64) is None       # b was least-recent
+    assert store.get("a" * 64) is not None
+    assert store.get("c" * 64) is not None
+    assert store.stats_dict()["evictions"] >= 1
+
+
+def test_ttl_expiry(tmp_path):
+    store = CacheStore(str(tmp_path / "cd"), ttl_s=0.05)
+    store.insert("t" * 64, {"o": b"short-lived"})
+    assert store.get("t" * 64) is not None
+    time.sleep(0.08)
+    assert store.get("t" * 64) is None
+    assert store.stats_dict()["evictions"] >= 1
+
+
+def test_byte_ledger_accounts():
+    led = ByteLedger()
+    led.add("spool", 100)
+    led.add("cache", 40)
+    led.sub("spool", 30)
+    assert led.value("spool") == 70 and led.value("cache") == 40
+    led.sub("cache", 1000)          # floors at 0, never negative
+    assert led.value("cache") == 0
+
+
+# ---------------------------------------------------------------------------
+# mmap/block-scan ingest (ROADMAP item 5 satellite)
+# ---------------------------------------------------------------------------
+def test_block_line_reader_matches_text_read(tmp_path):
+    from pwasm_tpu.stream.pafstream import BlockLineReader
+    cases = ["a\tb\nc\td\n", "one\ntwo", "crlf\r\nlone\rend\r\n",
+             "", "x" * 3000 + "\n" + "y" * 10, "\n\n\n",
+             # multi-byte UTF-8 characters placed to STRADDLE the
+             # 7-byte block boundary: the incremental decoder must
+             # reassemble them, byte-identical to the text-mode read
+             "abcdé\tñ\nrecord\tcafé\n", "é" * 40 + "\n"]
+    for i, text in enumerate(cases):
+        p = tmp_path / f"c{i}.txt"
+        p.write_bytes(text.encode())
+        with open(p) as f:
+            expect = list(f)
+        h = hashlib.sha256()
+        r = BlockLineReader(str(p), block_bytes=7, hasher=h)
+        got = list(r)
+        assert got == expect, (text, got, expect)
+        assert r.consumed
+        assert r.hexdigest() == hashlib.sha256(
+            text.encode()).hexdigest()
+        r.close()
+
+
+def test_mmap_ingest_byte_parity(tmp_path, monkeypatch):
+    """The block-scan ingest path produces byte-identical outputs to
+    the text-mode readline path (the A/B hatch)."""
+    paf, fa = _corpus(tmp_path, n=40)
+    outs = {}
+    for hatch in ("1", "0"):
+        monkeypatch.setenv("PWASM_MMAP_INGEST", hatch)
+        out = str(tmp_path / f"h{hatch}.dfa")
+        sm = str(tmp_path / f"h{hatch}.sum")
+        err = io.StringIO()
+        rc = run([paf, "-r", fa, "-o", out, "-s", sm], stderr=err)
+        assert rc == 0, err.getvalue()
+        outs[hatch] = (open(out, "rb").read(), open(sm, "rb").read())
+    assert outs["1"] == outs["0"]
+
+
+# ---------------------------------------------------------------------------
+# cold CLI tier
+# ---------------------------------------------------------------------------
+def test_cli_hit_parity_and_stats(tmp_path):
+    paf, fa = _corpus(tmp_path)
+    cd = str(tmp_path / "cd")
+
+    def args(tag, shuffle=False):
+        o = [str(tmp_path / f"{tag}.dfa"), str(tmp_path / f"{tag}.sum"),
+             str(tmp_path / f"{tag}.json")]
+        if shuffle:
+            return ["-r", fa, "-s", o[1], paf, "-o", o[0],
+                    f"--result-cache={cd}", f"--stats={o[2]}"], o
+        return [paf, "-r", fa, "-o", o[0], "-s", o[1],
+                f"--result-cache={cd}", f"--stats={o[2]}"], o
+
+    argv, o1 = args("cold")
+    err = io.StringIO()
+    assert run(argv, stderr=err) == 0, err.getvalue()
+    st1 = json.load(open(o1[2]))
+    assert "cache_hit" not in st1
+    argv, o2 = args("hit", shuffle=True)
+    err = io.StringIO()
+    assert run(argv, stderr=err) == 0, err.getvalue()
+    assert open(o1[0], "rb").read() == open(o2[0], "rb").read()
+    assert open(o1[1], "rb").read() == open(o2[1], "rb").read()
+    st2 = json.load(open(o2[2]))
+    assert st2["cache_hit"] is True
+    assert st2["backend"] == {"probes": 0, "warm_hits": 0}
+    # cache-off arm: the ground truth the hit must match
+    off = str(tmp_path / "off.dfa")
+    offsum = str(tmp_path / "off.sum")
+    assert run([paf, "-r", fa, "-o", off, "-s", offsum],
+               stderr=io.StringIO()) == 0
+    assert open(off, "rb").read() == open(o2[0], "rb").read()
+    assert open(offsum, "rb").read() == open(o2[1], "rb").read()
+
+
+def test_cli_rot_falls_back_to_real_run(tmp_path):
+    """A rotted entry is never served: the run happens for real and
+    REPLACES the entry."""
+    paf, fa = _corpus(tmp_path)
+    cd = tmp_path / "cd"
+    argv = [paf, "-r", fa, "-o", str(tmp_path / "a.dfa"),
+            f"--result-cache={cd}"]
+    assert run(list(argv), stderr=io.StringIO()) == 0
+    good = open(tmp_path / "a.dfa", "rb").read()
+    blob = next(p for p in os.listdir(cd) if p.endswith(".o"))
+    with open(cd / blob, "r+b") as f:
+        f.write(b"\x00\x00")
+    argv2 = [paf, "-r", fa, "-o", str(tmp_path / "b.dfa"),
+             f"--result-cache={cd}"]
+    assert run(argv2, stderr=io.StringIO()) == 0
+    assert open(tmp_path / "b.dfa", "rb").read() == good
+    # the real run re-populated a CLEAN entry
+    store = CacheStore(str(cd))
+    assert store.get(_key_of(argv)) is not None
+
+
+# ---------------------------------------------------------------------------
+# serve-daemon tier
+# ---------------------------------------------------------------------------
+def test_serve_admission_hit_zero_pipeline(tmp_path):
+    """The daemon tier: job 1 misses and inserts; job 2 (reordered
+    argv, different outputs) is answered AT ADMISSION — done state,
+    zero probes, no second lease grant, a cache_hit journal record."""
+    paf, fa = _corpus(tmp_path)
+    cd = str(tmp_path / "cd")
+    with _daemon(result_cache=cd) as h:
+        a1 = [paf, "-r", fa, "-o", str(tmp_path / "j1.dfa"),
+              f"--stats={tmp_path / 'j1.json'}"]
+        r1 = _submit_wait(h.sock, a1)
+        assert r1.get("rc") == 0, r1
+        grants_after_miss = h.daemon.leases.grants
+        a2 = ["-r", fa, str(paf), f"--stats={tmp_path / 'j2.json'}",
+              "-o", str(tmp_path / "j2.dfa")]
+        t0 = time.perf_counter()
+        r2 = _submit_wait(h.sock, a2)
+        hit_wall = time.perf_counter() - t0
+        assert r2.get("rc") == 0, r2
+        assert "result cache" in r2["job"]["detail"]
+        assert open(tmp_path / "j1.dfa", "rb").read() \
+            == open(tmp_path / "j2.dfa", "rb").read()
+        st2 = json.load(open(tmp_path / "j2.json"))
+        assert st2["cache_hit"] is True
+        assert st2["backend"]["probes"] == 0
+        # zero device/lease/queue involvement: no new lease grant
+        assert h.daemon.leases.grants == grants_after_miss
+        assert hit_wall < 1.0       # sanity, not the gated timing
+        with ServiceClient(h.sock) as c:
+            st = c.stats()["stats"]
+        assert st["cache"]["hits"] == 1
+        assert st["cache"]["misses"] == 1
+        assert st["cache"]["insertions"] == 1
+        # the journal carries the truth: admit + cache_hit + finish,
+        # and NO start record for the hit job
+        jtext = open(h.sock + ".journal").read()
+        rows = [json.loads(l) for l in jtext.splitlines()]
+        hit_recs = [r for r in rows if r.get("job_id") == "job-0002"]
+        kinds = [r["rec"] for r in hit_recs]
+        assert kinds == ["admit", "cache_hit", "finish"], kinds
+        h.daemon.drain.request("done")
+    assert h.rc == [75]
+
+
+def test_serve_hit_survives_restart(tmp_path):
+    """The cache outlives the daemon: a fresh daemon on the same dir
+    serves a hit for a job a DEAD predecessor answered."""
+    paf, fa = _corpus(tmp_path)
+    cd = str(tmp_path / "cd")
+    argv = [paf, "-r", fa, "-o", str(tmp_path / "p.dfa")]
+    with _daemon(result_cache=cd) as h:
+        assert _submit_wait(h.sock, argv).get("rc") == 0
+        h.daemon.drain.request("cycle")
+    with _daemon(result_cache=cd) as h2:
+        a2 = [paf, "-r", fa, "-o", str(tmp_path / "q.dfa"),
+              f"--stats={tmp_path / 'q.json'}"]
+        r = _submit_wait(h2.sock, a2)
+        assert r.get("rc") == 0
+        assert json.load(open(tmp_path / "q.json"))["cache_hit"] \
+            is True
+    assert open(tmp_path / "p.dfa", "rb").read() \
+        == open(tmp_path / "q.dfa", "rb").read()
+
+
+def test_serve_eviction_under_budget(tmp_path):
+    """--result-cache-max-bytes: distinct jobs (same input, a
+    result-affecting flag apart) overflow a 1-byte budget and LRU
+    eviction runs; svc-stats counts it."""
+    paf, fa = _corpus(tmp_path)
+    with _daemon(result_cache=str(tmp_path / "cd"),
+                 result_cache_max_bytes=1) as h:
+        for i, extra in enumerate(([], ["-c", "30"])):
+            r = _submit_wait(h.sock, [
+                paf, "-r", fa,
+                "-o", str(tmp_path / f"e{i}.dfa")] + extra)
+            assert r.get("rc") == 0
+        with ServiceClient(h.sock) as c:
+            st = c.stats()["stats"]["cache"]
+        assert st["insertions"] == 2
+        assert st["evictions"] >= 1
+
+
+def test_cache_probe_verb(tmp_path):
+    paf, fa = _corpus(tmp_path)
+    cd = str(tmp_path / "cd")
+    argv = [paf, "-r", fa, "-o", str(tmp_path / "a.dfa")]
+    with _daemon(result_cache=cd) as h:
+        assert _submit_wait(h.sock, argv).get("rc") == 0
+        with ServiceClient(h.sock) as c:
+            hitp = c.cache_probe(_key_of(argv))
+            missp = c.cache_probe("0" * 64)
+            badp = c.cache_probe("")
+        assert hitp.get("hit") is True and hitp.get("enabled")
+        assert missp.get("hit") is False
+        assert badp.get("error") == "bad_request"
+    with _daemon() as h2:      # caching off: enabled=False, never hit
+        with ServiceClient(h2.sock) as c:
+            p = c.cache_probe("0" * 64)
+        assert p.get("enabled") is False and p.get("hit") is False
+
+
+# ---------------------------------------------------------------------------
+# many2many: per-CDS section granularity
+# ---------------------------------------------------------------------------
+def _m2m_files(tmp_path, nq=4, nt=6, seed=7):
+    rng = np.random.default_rng(seed)
+
+    def seq(n):
+        return "".join("ACGT"[i] for i in rng.integers(0, 4, n))
+
+    qs = [(f"cds{k}", seq(120 + 10 * k)) for k in range(nq)]
+    ts = [(f"asm{k}", seq(200 + 13 * k)) for k in range(nt)]
+    tfa = tmp_path / "targets.fa"
+    tfa.write_text("".join(f">{n}\n{s}\n" for n, s in ts))
+    return qs, str(tfa)
+
+
+def _write_qfa(tmp_path, name, qs):
+    p = tmp_path / name
+    p.write_text("".join(f">{n}\n{s}\n" for n, s in qs))
+    return str(p)
+
+
+def test_m2m_partial_hit_splices_byte_identical(tmp_path):
+    """9-cached-plus-1-new in miniature: 3 cached CDS + 1 new one —
+    only the new one is scored (stats count exactly its alignments)
+    and the report/summary are byte-identical to the all-miss run."""
+    qs, tfa = _m2m_files(tmp_path)
+    q3 = _write_qfa(tmp_path, "q3.fa", qs[:3])
+    q4 = _write_qfa(tmp_path, "q4.fa", qs)
+    cd = str(tmp_path / "m2mcd")
+    # the all-miss ground truth, cache off
+    ref_o, ref_s = str(tmp_path / "ref.tsv"), str(tmp_path / "ref.sum")
+    assert run(["--many2many", tfa, "-r", q4, "-o", ref_o,
+                "-s", ref_s], stderr=io.StringIO()) == 0
+    # populate 3 sections
+    assert run(["--many2many", tfa, "-r", q3,
+                "-o", str(tmp_path / "c3.tsv"),
+                f"--result-cache={cd}"], stderr=io.StringIO()) == 0
+    # the partial-hit run: 1 of 4 dispatched
+    st4 = str(tmp_path / "c4.json")
+    assert run(["--many2many", tfa, "-r", q4,
+                "-o", str(tmp_path / "c4.tsv"),
+                "-s", str(tmp_path / "c4.sum"),
+                f"--result-cache={cd}", f"--stats={st4}"],
+               stderr=io.StringIO()) == 0
+    assert open(tmp_path / "c4.tsv", "rb").read() \
+        == open(ref_o, "rb").read()
+    assert open(tmp_path / "c4.sum", "rb").read() \
+        == open(ref_s, "rb").read()
+    st = json.load(open(st4))
+    assert st["alignments"] == 6     # exactly ONE query x 6 targets
+    # an all-hit rerun scores nothing and pays no probe
+    st5 = str(tmp_path / "c5.json")
+    assert run(["--many2many", tfa, "-r", q4,
+                "-o", str(tmp_path / "c5.tsv"),
+                f"--result-cache={cd}", f"--stats={st5}",
+                "--device=tpu"], stderr=io.StringIO()) == 0
+    assert open(tmp_path / "c5.tsv", "rb").read() \
+        == open(ref_o, "rb").read()
+    st = json.load(open(st5))
+    assert st["alignments"] == 0
+    assert st["backend"]["probes"] == 0
+
+
+def test_m2m_band_keys_distinct_sections(tmp_path):
+    """--band is result-affecting: sections cached under one band are
+    never served to a job under another."""
+    qs, tfa = _m2m_files(tmp_path, nq=2)
+    q2 = _write_qfa(tmp_path, "q2.fa", qs)
+    cd = str(tmp_path / "cd")
+    k64 = section_key(record_digest(*qs[0]), "t" * 64, 64)
+    k32 = section_key(record_digest(*qs[0]), "t" * 64, 32)
+    assert k64 != k32
+    # end to end: band=48 run after a band-default populate re-scores
+    assert run(["--many2many", tfa, "-r", q2,
+                "-o", str(tmp_path / "a.tsv"),
+                f"--result-cache={cd}"], stderr=io.StringIO()) == 0
+    stj = str(tmp_path / "b.json")
+    assert run(["--many2many", tfa, "-r", q2, "--band=48",
+                "-o", str(tmp_path / "b.tsv"),
+                f"--result-cache={cd}", f"--stats={stj}"],
+               stderr=io.StringIO()) == 0
+    assert json.load(open(stj))["alignments"] > 0   # re-scored
+
+
+def test_m2m_served_job_uses_daemon_cache_dir(tmp_path):
+    """A served --many2many job inherits `serve --result-cache` via
+    the warm context: its sections land in the daemon's dir and a
+    later served job partial-hits."""
+    qs, tfa = _m2m_files(tmp_path)
+    q3 = _write_qfa(tmp_path, "q3.fa", qs[:3])
+    q4 = _write_qfa(tmp_path, "q4.fa", qs)
+    cd = str(tmp_path / "cd")
+    with _daemon(result_cache=cd) as h:
+        r = _submit_wait(h.sock, ["--many2many", tfa, "-r", q3,
+                                  "-o", str(tmp_path / "s3.tsv")])
+        assert r.get("rc") == 0, r
+        stj = str(tmp_path / "s4.json")
+        r = _submit_wait(h.sock, ["--many2many", tfa, "-r", q4,
+                                  "-o", str(tmp_path / "s4.tsv"),
+                                  f"--stats={stj}"])
+        assert r.get("rc") == 0, r
+        assert json.load(open(stj))["alignments"] == 6
+    # ground truth parity
+    ref = str(tmp_path / "ref.tsv")
+    assert run(["--many2many", tfa, "-r", q4, "-o", ref],
+               stderr=io.StringIO()) == 0
+    assert open(tmp_path / "s4.tsv", "rb").read() \
+        == open(ref, "rb").read()
+
+
+# ---------------------------------------------------------------------------
+# fleet tier
+# ---------------------------------------------------------------------------
+@contextmanager
+def _fleet(tmp_path, n=2, daemon_kw=None, router_kw=None):
+    stack, members = [], []
+    try:
+        for _k in range(n):
+            cm = _daemon(**(daemon_kw or {}))
+            stack.append(cm)
+            members.append(cm.__enter__())
+        rdir = tempfile.mkdtemp(prefix="pwrt")
+        rsock = os.path.join(rdir, "router.sock")
+        err = io.StringIO()
+        r = Router([m.sock for m in members], socket_path=rsock,
+                   stderr=err, poll_interval=0.1,
+                   **(router_kw or {}))
+        rcbox: list = []
+        t = threading.Thread(target=lambda: rcbox.append(r.serve()),
+                             daemon=True)
+        t.start()
+        assert wait_for_socket(rsock, 15), err.getvalue()
+        try:
+            yield SimpleNamespace(router=r, sock=rsock,
+                                  members=members, err=err, rc=rcbox)
+        finally:
+            if not r.drain.requested:
+                r.drain.request("test teardown")
+            t.join(20)
+            shutil.rmtree(rdir, ignore_errors=True)
+    finally:
+        for cm in reversed(stack):
+            cm.__exit__(None, None, None)
+
+
+def test_router_shared_dir_hit_never_reaches_a_member(tmp_path):
+    """The fleet contract: members + router share one cache dir; a
+    repeat submit is answered AT THE ROUTER — no member sees it."""
+    paf, fa = _corpus(tmp_path)
+    shared = str(tmp_path / "shared")
+    with _fleet(tmp_path, n=2,
+                daemon_kw={"result_cache": shared},
+                router_kw={"result_cache": shared}) as f:
+        a1 = [paf, "-r", fa, "-o", str(tmp_path / "f1.dfa")]
+        with ServiceClient(f.sock) as c:
+            s1 = c.submit(a1)
+            assert s1.get("ok"), s1
+            r1 = c.result(s1["job_id"], timeout=120)
+        assert r1.get("rc") == 0, r1
+        a2 = ["-r", fa, paf, "-o", str(tmp_path / "f2.dfa"),
+              f"--stats={tmp_path / 'f2.json'}"]
+        with ServiceClient(f.sock) as c:
+            s2 = c.submit(a2)
+            assert s2.get("ok"), s2
+            r2 = c.result(s2["job_id"], timeout=120)
+        assert r2.get("rc") == 0, r2
+        assert s2.get("member") == "cache"
+        assert s2.get("cache_hit") is True
+        assert r2["job"]["state"] == "done"
+        assert json.load(open(
+            tmp_path / "f2.json"))["cache_hit"] is True
+        assert open(tmp_path / "f1.dfa", "rb").read() \
+            == open(tmp_path / "f2.dfa", "rb").read()
+        # exactly ONE member ever ran a job
+        ran = sum(m.daemon.stats.jobs_accepted for m in f.members)
+        assert ran == 1
+        with ServiceClient(f.sock) as c:
+            fs = c.stats()["stats"]
+        assert fs["cache"]["hits"] == 1
+
+
+def test_router_cache_affinity_places_on_hitting_member(tmp_path):
+    """Members with PRIVATE caches: the router (own empty dir) misses
+    but probes members with the key — the member that already
+    answered the job gets its repeat, whose admission serves it."""
+    paf, fa = _corpus(tmp_path)
+    # per-member PRIVATE dirs need distinct kwargs — build manually
+    stack, members = [], []
+    try:
+        for k in range(2):
+            cm = _daemon(result_cache=str(tmp_path / f"m{k}cd"))
+            stack.append(cm)
+            members.append(cm.__enter__())
+        rdir = tempfile.mkdtemp(prefix="pwrt")
+        rsock = os.path.join(rdir, "router.sock")
+        err = io.StringIO()
+        r = Router([m.sock for m in members], socket_path=rsock,
+                   stderr=err, poll_interval=0.1,
+                   result_cache=str(tmp_path / "router-cd2"))
+        rcbox: list = []
+        t = threading.Thread(target=lambda: rcbox.append(r.serve()),
+                             daemon=True)
+        t.start()
+        assert wait_for_socket(rsock, 15), err.getvalue()
+        try:
+            a1 = [paf, "-r", fa, "-o", str(tmp_path / "g1.dfa")]
+            with ServiceClient(rsock) as c:
+                s1 = c.submit(a1)
+                assert s1.get("ok"), s1
+                r1 = c.result(s1["job_id"], timeout=120)
+            assert r1.get("rc") == 0, r1
+            first_member = s1["member"]
+            a2 = [paf, "-r", fa, "-o", str(tmp_path / "g2.dfa"),
+                  f"--stats={tmp_path / 'g2.json'}"]
+            with ServiceClient(rsock) as c:
+                s2 = c.submit(a2)
+                assert s2.get("ok"), s2
+                r2 = c.result(s2["job_id"], timeout=120)
+            assert r2.get("rc") == 0, r2
+            # affinity: the repeat landed on the SAME member, and that
+            # member answered it from its private cache
+            assert s2["member"] == first_member, (s1, s2)
+            assert json.load(open(
+                tmp_path / "g2.json"))["cache_hit"] is True
+            assert open(tmp_path / "g1.dfa", "rb").read() \
+                == open(tmp_path / "g2.dfa", "rb").read()
+        finally:
+            if not r.drain.requested:
+                r.drain.request("test teardown")
+            t.join(20)
+            shutil.rmtree(rdir, ignore_errors=True)
+    finally:
+        for cm in reversed(stack):
+            cm.__exit__(None, None, None)
+
+
+# ---------------------------------------------------------------------------
+# obs: cache_thrash rule + top pane
+# ---------------------------------------------------------------------------
+def test_cache_thrash_rule_fires_on_sustained_thrash():
+    from pwasm_tpu.obs.catalog import (build_cache_metrics,
+                                       build_slo_metrics,
+                                       default_slo_rules)
+    from pwasm_tpu.obs.metrics import MetricsRegistry
+    from pwasm_tpu.obs.slo import SloEngine
+    rules = [r for r in default_slo_rules()
+             if r["name"] == "cache_thrash"]
+    assert rules, "cache_thrash must ship in the default set"
+    reg = MetricsRegistry()
+    cm = build_cache_metrics(reg)
+    sm = build_slo_metrics(reg)
+    eng = SloEngine(reg, rules, metrics=sm)
+    t0 = 1000.0
+    # healthy: lots of insertions, few evictions
+    cm["insertions"].inc(100)
+    cm["evictions"].inc(10)
+    eng.evaluate(now=t0)
+    eng.evaluate(now=t0 + 20)
+    assert eng.verdict()["verdict"] == "ok"
+    # thrash: eviction keeps pace with insertion, held past for_s
+    cm["evictions"].inc(85)
+    eng.evaluate(now=t0 + 30)          # pending (for_s hold)
+    assert eng.verdict()["verdict"] == "ok"
+    eng.evaluate(now=t0 + 45)          # held > 10s: fires degraded
+    v = eng.verdict()
+    assert v["verdict"] == "degraded"
+    assert v["firing"][0]["rule"] == "cache_thrash"
+
+
+def test_top_renders_cache_row():
+    from pwasm_tpu.service.top import render
+    st = {"uptime_s": 5.0, "jobs": {},
+          "cache": {"enabled": True, "hits": 7, "misses": 3,
+                    "hit_ratio": 0.7, "insertions": 3,
+                    "evictions": 1, "bytes": 12345}}
+    out = render(st)
+    assert "CACHE: 7 hits / 3 misses (ratio 70%)" in out
+    assert "12345 bytes" in out
+    # cache off: no row, still a total render
+    out = render({"uptime_s": 1.0, "cache": {"enabled": False}})
+    assert "CACHE:" not in out

@@ -119,6 +119,30 @@ def test_fleet_rule_detects_direct_jax(checker, tmp_path):
     assert checker.find_fleet_violations(str(tmp_path / "no")) == []
 
 
+def test_cache_gate_clean_on_this_tree(checker):
+    """ISSUE 15 satellite: service/cache.py exists and is jax-free —
+    the content-addressed result cache is on every serving tier's
+    admission hot path."""
+    bad = checker.find_cache_violations()
+    assert bad == [], "\n".join(bad)
+
+
+def test_cache_gate_detects_missing_and_jax(checker, tmp_path):
+    # a tree without the module at all: the existence half fires
+    bad = checker.find_cache_violations(str(tmp_path))
+    assert len(bad) == 1 and "missing" in bad[0], bad
+    # a tree where the cache module imports jax: the jax-free half
+    svc = tmp_path / "pwasm_tpu" / "service"
+    svc.mkdir(parents=True)
+    (svc / "cache.py").write_text(
+        "import jax\n"
+        "# import jax in a comment is NOT a hit\n"
+        "def get(key):\n    return jax.device_get(key)\n")
+    bad = checker.find_cache_violations(str(tmp_path))
+    assert len(bad) == 2, bad
+    assert all("cache.py" in b for b in bad)
+
+
 def test_metric_lint_clean_on_this_tree(checker):
     """ISSUE 6 satellite: every metric registration lives in
     obs/catalog.py, with snake_case pwasm_-prefixed unique names."""
