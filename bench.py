@@ -2121,6 +2121,215 @@ def cfg8_realistic_scale() -> int:
         det_ok = bool(resolved) and detect_s <= 2 * det_interval
         _emit("realistic_canary_detect_s", detect_s, "s",
               1.0 if det_ok else 0.0, cpu_metric=True)
+
+        # --- router HA failover gap (ISSUE 16 tentpole): SIGKILL the
+        # PRIMARY router while a job is mid-run on a member, with a
+        # warm standby (`route --standby-of`) tailing its write-ahead
+        # journal.  The standby must take over the SAME socket, replay
+        # the routed-job table, and serve the pre-crash client's
+        # `result` — rc 0, trace_id intact, byte-identical outputs.
+        # The metric is the submit-surface outage: primary SIGKILL ->
+        # first successful ping on the same socket (ms, lower-better).
+        dslow = ("--inject-faults=seed=1,rate=1,kinds=hang,"
+                 "hang_s=0.5")    # device-path hangs: ~12-16 s walls
+        hsocks = [os.path.join(d, f"ha{k}.sock") for k in range(2)]
+        hprocs = [subprocess.Popen(
+            cmd + ["serve", f"--socket={s}", "--max-queue=16"],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE) for s in hsocks]
+        hrsock = os.path.join(d, "ha.sock")
+        hprimary = hstandby = None
+        gap_ms = None
+        ha_ok = False
+        try:
+            for s in hsocks:
+                if not wait_for_socket(s, 120):
+                    return _fail("realistic_ha_member_up")
+            hprimary = subprocess.Popen(
+                cmd + ["route", "--backends=" + ",".join(hsocks),
+                       f"--socket={hrsock}", "--poll-interval=0.2"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE)
+            if not wait_for_socket(hrsock, 120):
+                return _fail("realistic_ha_router_up")
+            hstandby = subprocess.Popen(
+                cmd + ["route", f"--standby-of={hrsock}",
+                       "--poll-interval=0.2"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE)
+            with ServiceClient(hrsock, trace_id="bench-ha") as c:
+                ja = c.submit(args("haj", ["--device=tpu",
+                                           "--batch=8", dslow]))
+                if not ja.get("ok"):
+                    return _fail("realistic_ha_submit")
+                ck = os.path.join(d, "haj.dfa.ckpt")
+                deadline = time.monotonic() + 120
+                mid = False
+                while time.monotonic() < deadline:
+                    st = c.status(ja["job_id"])["job"]["state"]
+                    if st == "running" and os.path.exists(ck):
+                        mid = True
+                        break
+                    if st not in ("queued", "running"):
+                        break
+                    time.sleep(0.02)
+            if not mid:
+                return _fail("realistic_ha_crash_window")
+            t_kill = time.monotonic()
+            hprimary.kill()     # SIGKILL: the WAL is all that's left
+            hprimary.wait(timeout=60)
+            deadline = t_kill + 120
+            up = False
+            while time.monotonic() < deadline:
+                try:
+                    with ServiceClient(hrsock) as c:
+                        if c.ping().get("ok"):
+                            up = True
+                            break
+                except Exception:
+                    pass
+                time.sleep(0.02)
+            if not up:
+                return _fail("realistic_ha_takeover")
+            gap_ms = (time.monotonic() - t_kill) * 1e3
+            with ServiceClient(hrsock, trace_id="bench-ha") as c:
+                ra = c.result(ja["job_id"], timeout=600)
+                ha_st = c.stats()["stats"]
+                c.drain()
+            hrc = hstandby.wait(timeout=120)
+            ha_ok = (ra.get("rc") == 0
+                     and ra["job"]["trace_id"] == "bench-ha"
+                     and readset("haj") == parity_body
+                     and ha_st["ha"]["takeover"] is True
+                     and ha_st["ha"]["epoch"] >= 2
+                     and hrc == 0)
+            for k, s in enumerate(hsocks):
+                with ServiceClient(s) as c:
+                    c.drain()
+                if hprocs[k].wait(timeout=120) != 75:
+                    return _fail("realistic_ha_member_drain")
+        except Exception as e:
+            sys.stderr.write(f"router HA leg: {e}\n")
+            return _fail("realistic_router_failover")
+        finally:
+            for p in hprocs + [hprimary, hstandby]:
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait()
+        _emit("realistic_router_failover_gap_ms", gap_ms, "ms",
+              1.0 if ha_ok else 0.0, cpu_metric=True)
+
+        # --- SLO-driven member auto-scaling (ISSUE 16): a REAL
+        # queue_pressure breach (two clients x 4 slow jobs against a
+        # lone --max-queue=4 member: depth/quota up to 7/4, sustained
+        # past the rule's for_s=5) must make the router's scaler spawn
+        # a second `serve` member with --warmup=tpu +
+        # --compile-cache-dir, and the FIRST job placed on that scaled
+        # member must be served warm: probes == 0, warm_hits >= 1 in
+        # its --stats backend block (bool, gated, + byte parity).
+        scdir = os.path.join(d, "scale")
+        os.makedirs(scdir, exist_ok=True)
+        sccache = os.path.join(scdir, "ccache")
+        scpolicy = os.path.join(scdir, "policy.json")
+        with open(scpolicy, "w") as f:
+            json.dump({"min_members": 1, "max_members": 2,
+                       # cooldown/scale-down windows >> the leg: ONE
+                       # deterministic spawn, retire only at drain
+                       "cooldown_s": 600.0, "hysteresis": 2,
+                       "scale_down_after_s": 600.0,
+                       "rules": ["queue_pressure"],
+                       "spawn": {
+                           "socket_dir": scdir,
+                           "args": ["--warmup=tpu",
+                                    f"--compile-cache-dir={sccache}"],
+                       }}, f)
+        scm_sock = os.path.join(d, "scm0.sock")
+        scm = subprocess.Popen(
+            cmd + ["serve", f"--socket={scm_sock}", "--max-queue=4"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        scrouter = None
+        scrsock = os.path.join(d, "scale.sock")
+        warm_first = False
+        try:
+            if not wait_for_socket(scm_sock, 120):
+                return _fail("realistic_scale_member_up")
+            scrouter = subprocess.Popen(
+                cmd + ["route", f"--backends={scm_sock}",
+                       f"--socket={scrsock}",
+                       f"--scale-policy={scpolicy}",
+                       "--poll-interval=0.2"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE)
+            if not wait_for_socket(scrsock, 120):
+                return _fail("realistic_scale_router_up")
+            backlog = []
+            with ServiceClient(scrsock, trace_id="bench-scale") as c:
+                for k in range(8):
+                    s0 = c.submit(args(f"scb{k}",
+                                       ["--device=tpu", "--batch=16",
+                                        dslow]),
+                                  client=f"hv{k % 2}")
+                    if not s0.get("ok"):
+                        return _fail("realistic_scale_submit")
+                    backlog.append(s0["job_id"])
+                deadline = time.monotonic() + 180
+                owned = 0
+                while time.monotonic() < deadline:
+                    sc = (c.stats()["stats"]["ha"].get("scaler")
+                          or {})
+                    owned = sc.get("owned", 0)
+                    if owned >= 1:
+                        break
+                    time.sleep(0.1)
+                if owned < 1:
+                    return _fail("realistic_scale_spawn")
+                # warm signal: the scaled member's --warmup=tpu pass
+                # lands its pow2 compiles in the shared compile cache
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline:
+                    if os.path.isdir(sccache) and os.listdir(sccache):
+                        break
+                    time.sleep(0.1)
+                scstats = os.path.join(d, "scw.stats")
+                sub = c.submit(args("scw", ["--device=tpu",
+                                            f"--stats={scstats}"]))
+                if not sub.get("ok"):
+                    return _fail("realistic_scale_probe_submit")
+                # the backlog still stands on member 0, so least-depth
+                # placement must pick the fresh scaled member
+                if not str(sub.get("member", "")
+                           ).startswith("scaled-"):
+                    return _fail("realistic_scale_placement")
+                res = c.result(sub["job_id"], timeout=600)
+                if res.get("rc") != 0:
+                    return _fail("realistic_scale_probe_job")
+                for jid in backlog:
+                    if c.result(jid, timeout=600).get("rc") != 0:
+                        return _fail("realistic_scale_backlog_job")
+                c.drain()   # scaler.shutdown retires its member
+            if scrouter.wait(timeout=120) != 0:
+                return _fail("realistic_scale_router_drain")
+            with open(scstats) as f:
+                scbk = json.load(f).get("backend", {})
+            warm_first = (scbk.get("probes", 1) == 0
+                          and scbk.get("warm_hits", 0) >= 1
+                          and readset("scw") == parity_body)
+            with ServiceClient(scm_sock) as c:
+                c.drain()
+            if scm.wait(timeout=120) != 75:
+                return _fail("realistic_scale_member_drain")
+        except Exception as e:
+            sys.stderr.write(f"scale-up leg: {e}\n")
+            return _fail("realistic_fleet_scaleup")
+        finally:
+            for p in [scm, scrouter]:
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait()
+        _emit("realistic_fleet_scaleup_warm_first_job",
+              1 if warm_first else 0, "bool",
+              1.0 if warm_first else 0.0, cpu_metric=True)
+
         if on_tpu_backend():
             dev_env = dict(os.environ, PYTHONPATH=env["PYTHONPATH"])
             dev_times = []

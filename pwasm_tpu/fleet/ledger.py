@@ -76,6 +76,22 @@ class FleetLedger:
                 self._member_live.get(member, 0) + 1
             self.admitted += 1
 
+    def restore(self, client: str, member: str) -> None:
+        """Journal-replay re-admission (router restart/takeover,
+        ISSUE 16): count a job that was already admitted — and acked —
+        before the crash WITHOUT re-running the quota gate.  The
+        admission promise was made by the previous incarnation; a
+        replay that answered queue_full for it would turn crash
+        recovery into a broken ack, which is exactly what the WAL
+        exists to prevent.  (``admitted`` is not re-counted: the
+        lifetime counter survives in spirit, not across processes.)"""
+        with self._lock:
+            self._live[client] = self._live.get(client, 0) + 1
+            key = (client, member)
+            self._placed[key] = self._placed.get(key, 0) + 1
+            self._member_live[member] = \
+                self._member_live.get(member, 0) + 1
+
     def move(self, client: str, src: str, dst: str) -> None:
         """Re-place one live job (failover: ``src`` died, the job now
         runs on ``dst``) — quota unchanged, placement counts move."""

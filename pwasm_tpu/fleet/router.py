@@ -49,15 +49,23 @@ import threading
 import time
 
 from pwasm_tpu.core.errors import EXIT_USAGE, PwasmError
+from pwasm_tpu.fleet.fencing import (DEFAULT_LEASE_TTL_S,
+                                     readmit_epoch_guard)
 from pwasm_tpu.fleet.ledger import FleetLedger
 from pwasm_tpu.fleet.transport import (connect, is_tcp_target,
                                        make_tcp_listener,
                                        member_journal_path,
+                                       router_journal_path,
                                        target_name)
 from pwasm_tpu.resilience.lifecycle import SignalDrain
 from pwasm_tpu.service import protocol
 from pwasm_tpu.service.client import ServiceClient, ServiceError
-from pwasm_tpu.service.journal import JobJournal, fold_records
+from pwasm_tpu.service.journal import (JOURNAL_VERSION, JobJournal,
+                                       REC_EPOCH, REC_MEMBERS,
+                                       REC_ROUTE_ADMIT,
+                                       REC_ROUTE_PLACE,
+                                       REC_ROUTE_RETIRE, REC_SCALE,
+                                       fold_records)
 from pwasm_tpu.service.queue import (JOB_CANCELLED, JOB_FAILED,
                                      JOB_PREEMPTED, QueueFull,
                                      TERMINAL_STATES, _sum_numeric)
@@ -67,10 +75,15 @@ _ROUTE_USAGE = """Usage:
                  (--socket=PATH | --listen=HOST:PORT) [both allowed]
                  [--journal-dir=DIR] [--max-queue=N]
                  [--max-queue-total=N] [--poll-interval=S]
+                 [--lease-ttl=S] [--scale-policy=FILE]
+                 [--stream-replay-bytes=N]
                  [--metrics-textfile=PATH] [--log-json=FILE]
                  [--trace-json=FILE] [--slo-rules=FILE|off]
                  [--result-cache=DIR|off]
                  [--result-cache-max-bytes=N]
+ pwasm-tpu route --standby-of=TARGET [--journal-dir=DIR]
+                 [--poll-interval=S] [...primary flags inherited
+                 on takeover, EXCEPT --backends/--socket/--listen]
 
    --backends=...       member serve daemons, comma-separated targets
                         (unix socket paths and/or HOST:PORT — required)
@@ -96,6 +109,31 @@ _ROUTE_USAGE = """Usage:
                         only after 2 consecutive failed polls, or
                         instantly on a mid-request connection
                         failure)
+   --standby-of=TARGET  run as the WARM STANDBY of the router serving
+                        on unix socket TARGET: tail its write-ahead
+                        journal, and when the primary stops answering
+                        ping, take over its socket with the routed-job
+                        table replayed (docs/FLEET.md).  Mutually
+                        exclusive with --backends/--socket/--listen —
+                        the standby inherits all three from the
+                        primary's journal, never from flags
+   --lease-ttl=S        epoch-lease TTL granted to members (default
+                        15; heartbeated on every stats poll — keep it
+                        well above 2x --poll-interval).  A member that
+                        misses heartbeats for S seconds self-fences:
+                        drains in-flight work to checkpoints and
+                        refuses new frames until a fresh lease
+   --scale-policy=FILE  SLO-driven member auto-scaling policy (JSON:
+                        min/max members, spawn argv, cooldown,
+                        hysteresis — docs/FLEET.md).  Queue-pressure/
+                        burn-rate verdicts spawn `serve` members;
+                        sustained calm drains the scaler's own
+                        members back down
+   --stream-replay-bytes=N  per-stream replay window (default 4194304
+                        = 4 MiB, 0 = off): un-acked stream records
+                        buffered at the router so a member death
+                        MID-STREAM re-drives them to a sibling
+                        invisibly instead of answering re-open errors
    --result-cache=DIR   the members' SHARED result-cache dir
                         (docs/SERVICE.md; point members'
                         serve --result-cache at the same shared
@@ -160,6 +198,11 @@ class _Member:
         #   placement pressure term (reset on every successful poll,
         #   so a long-running routed job is never double-counted
         #   against the depth the member itself reports)
+        self.fenced = False         # member reports itself fenced
+        #   (lost epoch lease): reachable, but refusing new work
+        self.scaled = False         # spawned by the SLO scaler (the
+        #   only members the scaler may also retire)
+        self.proc = None            # the scaler's child handle
 
 
 class _FleetJob:
@@ -170,7 +213,8 @@ class _FleetJob:
     __slots__ = ("fid", "client", "priority", "trace_id", "frame",
                  "member", "mjid", "gen", "stream", "sconn", "slock",
                  "terminal", "retired", "failovers", "submitted_s",
-                 "accessed_s", "recovering")
+                 "accessed_s", "recovering", "epoch", "rbuf",
+                 "rbytes", "ended")
 
     def __init__(self, fid: str, client: str, priority: str,
                  trace_id: str, frame: dict, member: str, mjid: str,
@@ -195,6 +239,74 @@ class _FleetJob:
         self.submitted_s = time.time()
         self.accessed_s = time.time()   # LRU clock for table eviction
         self.recovering = False     # orphan-recovery once-latch
+        self.epoch = 0              # fleet epoch the CURRENT placement
+        #   was made under (fencing: a re-placement must carry an
+        #   epoch >= every prior placement's — readmit_epoch_guard)
+        self.rbuf: list | None = [] if stream else None   # the
+        #   bounded mid-stream replay window: acked stream-data/end
+        #   frames a failover re-drives to a sibling (None = overflow
+        #   or --stream-replay-bytes=0 — replay degrades to the
+        #   terminal preempted-resumable verdict)
+        self.rbytes = 0
+        self.ended = False          # stream-end already acked
+
+
+def fold_route_records(records: list[dict]) -> dict:
+    """Fold a replayed router-WAL stream (``REC_ROUTE_*`` / epoch /
+    members / scale records — service/journal.py vocabulary) into the
+    state a restarted router or a promoting standby rebuilds:
+
+    - ``jobs``: one ``{"admit", "place", "retire", "_ord"}`` row per
+      fleet job id, last-write-wins per kind, admit order preserved
+      (rows with no admit are dropped — a torn admit line means the
+      client was never acked);
+    - ``epoch``: the highest journaled fleet epoch;
+    - ``members``: the LAST members snapshot's backend target list
+      (None if no snapshot survived — the standby then has no
+      backends to adopt and must refuse the takeover);
+    - ``scaled``: scaler-owned members still alive at the crash
+      (spawn records minus retire records), by target."""
+    jobs: dict[str, dict] = {}
+    epoch = 0
+    members: list | None = None
+    scaled: dict[str, dict] = {}
+    for rec in records:
+        kind = rec.get("rec")
+        if kind == REC_EPOCH:
+            e = rec.get("epoch")
+            if isinstance(e, int) and e > epoch:
+                epoch = e
+            continue
+        if kind == REC_MEMBERS:
+            b = rec.get("backends")
+            if isinstance(b, list) \
+                    and all(isinstance(t, str) for t in b):
+                members = b
+            continue
+        if kind == REC_SCALE:
+            t = rec.get("target")
+            if isinstance(t, str):
+                if rec.get("action") == "spawn":
+                    scaled[t] = rec
+                else:
+                    scaled.pop(t, None)
+            continue
+        fid = rec.get("job_id")
+        if not isinstance(fid, str):
+            continue
+        if kind == REC_ROUTE_ADMIT:
+            jobs.setdefault(fid, {"admit": rec, "place": None,
+                                  "retire": None, "_ord": len(jobs)})
+            continue
+        row = jobs.get(fid)
+        if row is None:
+            continue
+        if kind == REC_ROUTE_PLACE:
+            row["place"] = rec
+        elif kind == REC_ROUTE_RETIRE:
+            row["retire"] = rec
+    return {"jobs": jobs, "epoch": epoch, "members": members,
+            "scaled": scaled}
 
 
 class Router:
@@ -214,13 +326,24 @@ class Router:
                  trace_json: str | None = None,
                  slo_rules=None,
                  result_cache: str | None = None,
-                 result_cache_max_bytes: int | None = None):
+                 result_cache_max_bytes: int | None = None,
+                 lease_ttl_s: float = DEFAULT_LEASE_TTL_S,
+                 scale_policy: dict | None = None,
+                 stream_replay_bytes: int = 4 << 20,
+                 takeover: bool = False):
         if not backends:
             raise ValueError("route needs at least one backend")
         if not socket_path and not listen:
             raise ValueError("route needs --socket and/or --listen")
         self.socket_path = socket_path
         self.listen = listen
+        self.journal_dir = journal_dir
+        self.lease_ttl_s = max(0.1, float(lease_ttl_s))
+        self.stream_replay_bytes = max(0, int(stream_replay_bytes))
+        self.takeover = bool(takeover)
+        self.epoch = 0               # fleet epoch (fencing token);
+        #   _open_journal replays the highest journaled epoch and
+        #   bumps it — every router incarnation is a new era
         self.tcp_port: int | None = None    # actual port after bind
         self.stderr = stderr if stderr is not None else sys.stderr
         self.poll_interval = max(0.05, float(poll_interval))
@@ -248,7 +371,15 @@ class Router:
         self.failovers = 0           # member-death events handled
         self.recovered = {"resumed": 0, "requeued": 0, "restored": 0,
                           "cancelled": 0, "stream_preempted": 0,
-                          "failed": 0}
+                          "stream_replayed": 0, "failed": 0}
+        # ---- router write-ahead journal (ISSUE 16): every routed
+        # admission/placement/retirement + epoch bumps + member-set
+        # snapshots, fsync'd per batch through the same JobJournal the
+        # members use.  None = journal-less (TCP-only endpoint with
+        # no --journal-dir): today's RAM-only behaviour, said loudly.
+        jpath = router_journal_path(socket_path, listen, journal_dir)
+        self.rjournal = JobJournal(jpath) if jpath else None
+        self._rjournal_warned = False
         from pwasm_tpu.obs import (EventLog, MetricsRegistry,
                                    Observability, TraceRecorder)
         from pwasm_tpu.obs.catalog import build_fleet_metrics
@@ -304,6 +435,14 @@ class Router:
                 self._say(f"warning: --result-cache dir "
                           f"{result_cache} unusable ({e}); fleet "
                           "result caching disabled")
+        # ---- SLO-driven member auto-scaling (ISSUE 16): the scaler
+        # turns the engine's queue-pressure/burn-rate verdicts into
+        # spawn/retire actions inside [min,max] bounds, journaled so
+        # a restarted router re-adopts the members it owns
+        self.scaler = None
+        if scale_policy:
+            from pwasm_tpu.fleet.scaler import FleetScaler
+            self.scaler = FleetScaler(self, scale_policy)
 
     # ---- lifecycle -----------------------------------------------------
     def serve(self) -> int:
@@ -336,6 +475,12 @@ class Router:
         for s in listeners:
             s.setblocking(False)
             sel.register(s, selectors.EVENT_READ)
+        self._open_journal()         # replay + epoch bump BEFORE the
+        #   first poll — the first heartbeat must carry the new era
+        if self.takeover:
+            self.metrics["takeovers"].inc()
+            self.obs.event("standby_takeover", epoch=self.epoch,
+                           socket=self.socket_path)
         self._poll_members()         # first placement view up front
         health = threading.Thread(target=self._health_loop,
                                   daemon=True,
@@ -391,6 +536,15 @@ class Router:
                         os.unlink(self.socket_path)
                     except OSError:
                         pass
+        if self.scaler is not None:
+            self.scaler.shutdown()
+        if self.rjournal is not None:
+            if self.drain.requested and self._drained():
+                # clean drain: every routed job landed terminal and
+                # every client could read it — nothing to recover
+                self.rjournal.unlink()
+            else:
+                self.rjournal.close()
         self.obs.event("router_exit", drained=self.drain.requested)
         self._write_textfile()
         if self.obs.tracer is not None and self.obs.trace_path:
@@ -428,6 +582,229 @@ class Router:
                   "live on members; results stay fetchable, new "
                   "submissions rejected")
 
+    # ---- write-ahead journal (ISSUE 16) --------------------------------
+    def _journal(self, rows: list) -> None:
+        """Durably append ``[(rec, fields), ...]`` in one fsync;
+        degrades loudly (warn once, keep routing) like the member
+        journal — a full disk costs the HA guarantee, not the fleet."""
+        if self.rjournal is None:
+            return
+        now = round(time.time(), 3)
+        stamped = [(rec, dict(fields, t=now)) for rec, fields in rows]
+        if self.rjournal.append_many(stamped):
+            for rec, _f in rows:
+                self.metrics["journal_records"].inc(rec=rec)
+        elif self.rjournal.broken and not self._rjournal_warned:
+            self._rjournal_warned = True
+            self._say("warning: router journal append failed "
+                      f"({self.rjournal.broken}); continuing WITHOUT "
+                      "crash-safe routing — a router crash now loses "
+                      "the routed-job table")
+
+    def _open_journal(self) -> None:
+        """Open (and replay) the router WAL.  Replay rebuilds the
+        routed-job table — live placements re-enter the ledger without
+        re-running the quota gate (their admissions were acked),
+        journaled terminal verdicts are served from the router again —
+        then the epoch is bumped: every incarnation is a new era, so
+        members leased to the dead incarnation re-lease or fence."""
+        if self.rjournal is None:
+            self._say("warning: no durable journal path for this "
+                      "endpoint (TCP-only, no --journal-dir): routing "
+                      "is NOT crash-safe and no standby can follow")
+            return
+        records = self.rjournal.replay()
+        self.rjournal.open()
+        folded = fold_route_records(records) if records else None
+        replayed = 0
+        if folded is not None:
+            replayed = self._replay_state(folded)
+            self.epoch = max(self.epoch, folded["epoch"])
+        self.epoch += 1
+        self._compact_journal()
+        self.metrics["epoch"].set(self.epoch)
+        if replayed:
+            self.metrics["journal_replayed"].inc(replayed)
+            self.obs.event("router_journal_replay", jobs=replayed,
+                           epoch=self.epoch)
+            self._say(f"replayed {replayed} routed job(s) from "
+                      f"{self.rjournal.path} (fleet epoch now "
+                      f"{self.epoch})")
+
+    def _replay_state(self, folded: dict) -> int:
+        """Rebuild the routed-job table from a fold; returns how many
+        jobs were restored (live + terminal)."""
+        backends = folded.get("members")
+        if backends:
+            for t in backends:
+                self._add_member(t)
+        for t in folded.get("scaled", {}):
+            self._add_member(t, scaled=True)
+        restored = 0
+        rows = sorted(folded["jobs"].items(),
+                      key=lambda kv: kv[1]["_ord"])
+        for fid, row in rows:
+            try:
+                n = int(fid.rsplit("-", 1)[-1])
+            except ValueError:
+                n = 0
+            self._next_id = max(self._next_id, n)
+            admit = row["admit"]
+            place = row["place"]
+            retire = row["retire"]
+            frame = admit.get("frame")
+            if not isinstance(frame, dict):
+                continue
+            stream = bool(admit.get("stream"))
+            job = _FleetJob(fid, str(admit.get("client") or ""),
+                            str(admit.get("priority") or ""),
+                            str(admit.get("trace_id") or ""),
+                            frame,
+                            str((place or {}).get("member")
+                                or "cache"),
+                            str((place or {}).get("mjid") or ""),
+                            stream=stream)
+            if place is not None:
+                job.gen = int(place.get("gen") or 0)
+                job.epoch = int(place.get("epoch") or 0)
+            if stream:
+                # the replay window died with the old process and the
+                # stream socket died with the client's connection —
+                # a live stream cannot survive a ROUTER death, only a
+                # member death.  Land it the way a member restart
+                # would: terminal preempted-resumable.
+                job.rbuf = None
+            sub = admit.get("t")
+            if isinstance(sub, (int, float)):
+                job.submitted_s = float(sub)
+            self.jobs[fid] = job
+            restored += 1
+            if retire is not None:
+                job.retired = True
+                state = retire.get("state")
+                if state in TERMINAL_STATES:
+                    rc = retire.get("rc") \
+                        if isinstance(retire.get("rc"), int) else None
+                    job.terminal = protocol.ok(
+                        job={"id": fid, "state": state, "rc": rc,
+                             "detail": str(retire.get("detail")
+                                           or "")
+                             + " [replayed from the router journal]",
+                             "client": job.client,
+                             "priority": job.priority,
+                             "trace_id": job.trace_id,
+                             "stream": stream, "recovered": True,
+                             "member": job.member,
+                             "submitted_s": round(job.submitted_s, 3),
+                             "started_s": None,
+                             "finished_s": retire.get("t")},
+                        rc=rc, stats=None, stderr_tail="")
+                continue
+            if stream:
+                job.recovering = True   # hold the health loop off
+                self._cache_terminal(job, JOB_PREEMPTED, 75, (
+                    "stream interrupted: the fleet router restarted "
+                    "and the stream connection died with it; records "
+                    "up to the last checkpoint are durable — re-open "
+                    "a stream with --resume and re-send the records"))
+                job.recovering = False
+                self.recovered["stream_preempted"] += 1
+                self.metrics["recovered"].inc(how="stream_preempted")
+                continue
+            if place is None or job.member == "cache":
+                # admitted but never placed (crash in the gap): the
+                # admission was never acked either — the ack and the
+                # place record commit together — so drop it
+                job.retired = True
+                continue
+            # live placement: re-enter the ledger WITHOUT the quota
+            # gate (the admission promise predates this incarnation)
+            self.ledger.restore(job.client, job.member)
+        return restored
+
+    def _compact_journal(self) -> None:
+        """Atomically rewrite the WAL to current state: one members
+        snapshot, the current epoch, the scaler's live spawns, then
+        admit(+place)(+retire) per surviving job — restart cost stays
+        bounded by the table, not router-lifetime traffic."""
+        if self.rjournal is None:
+            return
+        now = round(time.time(), 3)
+
+        def raw(rec: str, **fields) -> dict:
+            obj = {"v": JOURNAL_VERSION, "rec": rec, "t": now}
+            obj.update(fields)
+            return obj
+
+        with self._lock:
+            backends = [m.target for m in self.members.values()
+                        if not m.scaled]
+            scaled = [(m.target, getattr(m.proc, "pid", None))
+                      for m in self.members.values() if m.scaled]
+            jobs = sorted(self.jobs.values(),
+                          key=lambda j: j.submitted_s)
+            rows = [raw(REC_MEMBERS, backends=backends),
+                    raw(REC_EPOCH, epoch=self.epoch)]
+            for target, pid in scaled:
+                rows.append(raw(REC_SCALE, action="spawn",
+                                target=target, pid=pid))
+            for j in jobs:
+                rows.append(raw(
+                    REC_ROUTE_ADMIT, job_id=j.fid, client=j.client,
+                    priority=j.priority, trace_id=j.trace_id,
+                    stream=j.stream, frame=j.frame,
+                    t=round(j.submitted_s, 3)))
+                if j.member != "cache":
+                    rows.append(raw(
+                        REC_ROUTE_PLACE, job_id=j.fid,
+                        member=j.member, mjid=j.mjid, gen=j.gen,
+                        epoch=j.epoch))
+                if j.retired or j.terminal is not None:
+                    f = {}
+                    if isinstance(j.terminal, dict) \
+                            and isinstance(j.terminal.get("job"),
+                                           dict):
+                        tj = j.terminal["job"]
+                        f = {"state": tj.get("state"),
+                             "rc": tj.get("rc"),
+                             "detail": tj.get("detail")}
+                    rows.append(raw(REC_ROUTE_RETIRE, job_id=j.fid,
+                                    **f))
+        try:
+            self.rjournal.compact(rows)
+        except OSError as e:
+            if not self._rjournal_warned:
+                self._rjournal_warned = True
+                self._say(f"warning: router journal compaction "
+                          f"failed ({e}); continuing on the old file")
+
+    # ---- member-set mutation (takeover adoption + scaler) --------------
+    def _add_member(self, target: str, scaled: bool = False):
+        """Idempotently add a backend (journal-replay adoption or a
+        scaler spawn).  Returns the member."""
+        with self._lock:
+            name = target_name(target)
+            m = self.members.get(name)
+            if m is None:
+                m = _Member(target, self.journal_dir)
+                m.scaled = scaled
+                self.members[name] = m
+                n = len(self.members)
+            else:
+                n = None
+        if n is not None:
+            self.metrics["members"].set(n)
+        return m
+
+    def _remove_member(self, name: str) -> None:
+        """Forget a member (scaler retire): MUST run before the drain
+        RPC so its planned exit never reads as a death to fail over."""
+        with self._lock:
+            self.members.pop(name, None)
+            n = len(self.members)
+        self.metrics["members"].set(n)
+        self.metrics["member_up"].set(0, member=name)
+
     # ---- member health + placement -------------------------------------
     def _health_loop(self) -> None:
         while not self._closing.wait(self.poll_interval):
@@ -436,6 +813,13 @@ class Router:
             self._evict_jobs()
             if self.slo.due():
                 self.slo.evaluate()   # gauges fresh from the poll
+            if self.scaler is not None:
+                self.scaler.tick()
+            if self.rjournal is not None \
+                    and self.rjournal.records_written > max(
+                        1024, 8 * (len(self.jobs) + 1)):
+                # the WAL grew well past live state: fold it back down
+                self._compact_journal()
             self._write_textfile()
 
     def _poll_members(self, count_failures: bool = False) -> None:
@@ -449,10 +833,19 @@ class Router:
         for m in list(self.members.values()):
             try:
                 with ServiceClient(m.target, timeout=3.0) as c:
-                    st = c.stats()
+                    # the epoch lease rides the stats poll: every
+                    # healthy tick IS the heartbeat, so fencing needs
+                    # no extra RPC round and no extra timer
+                    st = c.request({
+                        "cmd": "stats",
+                        **({"lease": {"epoch": self.epoch,
+                                      "ttl_s": self.lease_ttl_s}}
+                           if self.epoch >= 1 else {})})
                 if not st.get("ok"):
                     raise ServiceError(f"stats failed: {st}")
                 stats = st["stats"]
+                lease = stats.get("lease")
+                lease = lease if isinstance(lease, dict) else {}
                 with self._lock:
                     revived = not m.alive and m.ever_alive
                     m.alive = True
@@ -464,6 +857,16 @@ class Router:
                     # this reply has observed everything we placed
                     # before the RPC — stop counting it as pressure
                     m.dispatched_since_poll = 0
+                    m.fenced = bool(lease.get("fenced"))
+                if lease.get("accepted") is False:
+                    # the member holds a NEWER epoch than ours: WE are
+                    # the stale incarnation (a zombie primary racing
+                    # its own standby's takeover) — say so loudly
+                    self.obs.event(
+                        "lease_refused", member=m.name,
+                        member_epoch=lease.get("epoch"),
+                        epoch=self.epoch,
+                        detail=str(lease.get("refused_detail") or ""))
                 if revived:
                     self.obs.event("member_up", member=m.name)
                     self._say(f"member {m.name} is back")
@@ -493,11 +896,18 @@ class Router:
                     for m in self.members.values()]
             live = sum(1 for j in self.jobs.values()
                        if not j.retired and j.terminal is None)
+            fenced = sum(1 for m in self.members.values()
+                         if m.alive and m.fenced)
+            scaled = sum(1 for m in self.members.values()
+                         if m.alive and m.scaled)
         for name, alive, depth in rows:
             self.metrics["member_up"].set(1 if alive else 0,
                                           member=name)
             self.metrics["member_queue_depth"].set(depth, member=name)
         self.metrics["live_jobs"].set(live)
+        self.metrics["epoch"].set(self.epoch)
+        self.metrics["fenced_members"].set(fenced)
+        self.metrics["scaler_members"].set(scaled)
         depths = self.ledger.client_depths()
         with self._lock:
             self._clients_seen |= set(depths)
@@ -562,12 +972,20 @@ class Router:
                 return
             job.retired = True
             sconn, job.sconn = job.sconn, None
+            term = job.terminal
         if sconn is not None:
             # a terminal stream job's persistent member connection
             # would otherwise leak one fd here and one blocked handler
             # thread on the member for the router's whole life
             sconn.close()
         self.ledger.retire(job.client, job.member)
+        fields: dict = {"job_id": job.fid}
+        if isinstance(term, dict) and isinstance(term.get("job"),
+                                                 dict):
+            tj = term["job"]
+            fields.update(state=tj.get("state"), rc=tj.get("rc"),
+                          detail=tj.get("detail"))
+        self._journal([(REC_ROUTE_RETIRE, fields)])
 
     def _evict_jobs(self) -> None:
         """Bound the routed-job table: RETIRED jobs past
@@ -592,7 +1010,11 @@ class Router:
         live routed job here would double-count work the member
         already reports), round-robin on ties."""
         with self._lock:
-            alive = [m for m in self.members.values() if m.alive]
+            # fenced members are reachable but refusing work — they
+            # get no placements until the next healthy poll re-grants
+            # their lease (a fence is a pause, not a death)
+            alive = [m for m in self.members.values()
+                     if m.alive and not m.fenced]
             self._rr += 1
             rr = self._rr
             order = sorted(
@@ -621,6 +1043,27 @@ class Router:
                        affected=len(affected))
         self._say(f"member {name} is DOWN ({len(affected)} routed "
                   "job(s) affected)")
+        # fencing (ISSUE 16): bump the fleet epoch BEFORE any re-
+        # placement so every re-admission below carries the new era —
+        # if the "dead" member is actually a zombie (network blip,
+        # stalled host), its lease expires without a heartbeat at the
+        # new epoch and it self-fences before it can double-write
+        with self._lock:
+            self.epoch += 1
+            epoch = self.epoch
+        self.metrics["epoch"].set(epoch)
+        self._journal([(REC_EPOCH,
+                        {"epoch": epoch, "why": f"member_down:{name}"})])
+        try:
+            # best-effort synchronous fence: if the member is a
+            # reachable zombie this lands instantly; a truly dead one
+            # just refuses the connect
+            with ServiceClient(m.target, timeout=1.0) as c:
+                c.request({"cmd": "fence",
+                           "reason": f"fleet failover epoch {epoch}: "
+                           "the router declared this member dead"})
+        except (ServiceError, OSError):
+            pass
         folded: dict = {}
         if m.journal_path:
             try:
@@ -724,10 +1167,16 @@ class Router:
             self.metrics["recovered"].inc(how="cancelled")
             return
         if job.stream:
-            # a LIVE-at-crash socket stream: its records came over a
-            # connection the crash severed, so no sibling can re-run
-            # it alone — terminal preempted-resumable, the same
-            # verdict the member's own restart replay reaches
+            # a LIVE-at-crash socket stream: first try the bounded
+            # replay window (--stream-replay-bytes) — every acked
+            # record is still buffered at the router, so a sibling
+            # can be fed the whole prefix and the client never even
+            # sees the death.  Past the window (or with it off) no
+            # sibling can re-run the stream alone — terminal
+            # preempted-resumable, the same verdict the member's own
+            # restart replay reaches.
+            if self._redrive_stream(job, dead):
+                return
             self._cache_terminal(job, JOB_PREEMPTED, 75, (
                 "stream interrupted: fleet member died; records up "
                 "to the last checkpoint are durable — re-open a "
@@ -744,6 +1193,11 @@ class Router:
         resume = row["start"] is not None if row is not None \
             else True
         argv = list(job.frame.get("args") or [])
+        # fencing invariant (qa/check_supervision.py): every --resume
+        # re-admission passes the epoch guard — a resume placed under
+        # an older epoch than the job's current one would race the
+        # newer owner on the same report file
+        epoch = readmit_epoch_guard(job.epoch, self.epoch)
         if resume and "--resume" not in argv:
             argv = argv + ["--resume"]
         fwd = dict(job.frame, args=argv)
@@ -784,10 +1238,15 @@ class Router:
                     job.member = m.name
                     job.mjid = resp["job_id"]
                     job.gen += 1
+                    job.epoch = epoch
                     job.failovers += 1
                     m.jobs_routed += 1
                     m.dispatched_since_poll += 1
                 self.ledger.move(job.client, dead, m.name)
+                self._journal([(REC_ROUTE_PLACE,
+                                {"job_id": job.fid, "member": m.name,
+                                 "mjid": job.mjid, "gen": job.gen,
+                                 "epoch": epoch})])
                 how = "resumed" if resume else "requeued"
                 self.recovered[how] += 1
                 self.metrics["recovered"].inc(how=how)
@@ -807,6 +1266,99 @@ class Router:
                 "exists)"))
             self.recovered["failed"] += 1
             self.metrics["recovered"].inc(how="failed")
+
+    def _redrive_stream(self, job: _FleetJob, dead: str) -> bool:
+        """Invisible mid-stream failover (ISSUE 16): re-open the
+        stream on a sibling and re-drive every buffered (acked)
+        record from the bounded replay window.  True = the job now
+        lives on the sibling and the client's next frame forwards
+        there as if nothing happened; False = no window (overflowed /
+        disabled) or no sibling could take it — the caller lands the
+        documented preempted-resumable verdict."""
+        with self._lock:
+            frames = list(job.rbuf) if job.rbuf is not None else None
+            ended = job.ended
+        if frames is None:
+            return False
+        epoch = readmit_epoch_guard(job.epoch, self.epoch)
+        for m in self._members_by_depth():
+            if m.name == dead:
+                continue
+            try:
+                c = ServiceClient(m.target, timeout=60.0)
+            except ServiceError:
+                continue
+            try:
+                resp = c.request({
+                    "cmd": "stream", **job.frame,
+                    "client": job.client,
+                    **({"trace_id": job.trace_id}
+                       if job.trace_id else {}),
+                    **({"priority": job.priority}
+                       if job.priority else {})})
+                if not resp.get("ok"):
+                    c.close()
+                    continue
+                mjid = resp["job_id"]
+                for f in frames:
+                    fwd = dict(f)
+                    fwd["job_id"] = mjid
+                    for _retry in range(50):
+                        r = c.request(fwd)
+                        if r.get("error") == protocol.ERR_QUEUE_FULL:
+                            time.sleep(min(0.2, float(
+                                r.get("retry_after_s") or 0.1)))
+                            continue
+                        break
+                    if not r.get("ok"):
+                        raise ServiceError(
+                            f"redrive rejected: {r.get('detail')}")
+                if ended:
+                    r = c.request({"cmd": "stream-end",
+                                   "job_id": mjid})
+                    if not r.get("ok"):
+                        raise ServiceError(
+                            f"redrive end rejected: {r.get('detail')}")
+            except (ServiceError, OSError, KeyError, TypeError) as e:
+                # at-most-once: the sibling may hold a half-fed
+                # stream — cancel it best-effort, then fall back to
+                # the preempted verdict rather than trying a THIRD
+                # member with unknown state on the second
+                try:
+                    c.request({"cmd": "cancel",
+                               "job_id": locals().get("mjid", "")})
+                except (ServiceError, OSError):
+                    pass
+                c.close()
+                self._say(f"stream {job.fid}: replay to {m.name} "
+                          f"failed ({e}); landing preempted")
+                return False
+            with self._lock:
+                old, job.sconn = job.sconn, c
+                job.member = m.name
+                job.mjid = mjid
+                job.gen += 1
+                job.epoch = epoch
+                job.failovers += 1
+                m.jobs_routed += 1
+                m.dispatched_since_poll += 1
+            if old is not None:
+                old.close()
+            self.ledger.move(job.client, dead, m.name)
+            self._journal([(REC_ROUTE_PLACE,
+                            {"job_id": job.fid, "member": m.name,
+                             "mjid": mjid, "gen": job.gen,
+                             "epoch": epoch})])
+            self.recovered["stream_replayed"] += 1
+            self.metrics["recovered"].inc(how="stream_replayed")
+            self.obs.event("stream_redriven", job_id=job.fid,
+                           trace_id=job.trace_id, member=m.name,
+                           frames=len(frames), was=dead)
+            self._say(f"stream {job.fid}: re-drove {len(frames)} "
+                      f"buffered frame(s) to member {m.name} — "
+                      "failover invisible to the client")
+            return True
+        return False
 
     def _cache_terminal(self, job: _FleetJob, state: str,
                         rc: int | None, detail: str,
@@ -990,13 +1542,29 @@ class Router:
                                         or trace_id or ""),
                                     frame, m.name, resp["job_id"],
                                     stream=stream)
+                    job.epoch = self.epoch
                     if stream:
                         job.sconn = c
+                        if self.stream_replay_bytes <= 0:
+                            job.rbuf = None   # replay window off
                     self.jobs[fid] = job
                     m.jobs_routed += 1
                     m.dispatched_since_poll += 1
                 if not stream:
                     c.close()
+                # WAL: the client's ack and this pair commit together
+                # (one fsync) — an admission the journal missed was
+                # never acked, so replay can safely drop it
+                self._journal([
+                    (REC_ROUTE_ADMIT,
+                     {"job_id": fid, "client": client,
+                      "priority": job.priority,
+                      "trace_id": job.trace_id, "stream": stream,
+                      "frame": frame}),
+                    (REC_ROUTE_PLACE,
+                     {"job_id": fid, "member": m.name,
+                      "mjid": job.mjid, "gen": 0,
+                      "epoch": job.epoch})])
                 self.metrics["jobs"].inc(outcome="accepted")
                 self.metrics["routed"].inc(member=m.name)
                 self.obs.event("route_admit", job_id=fid,
@@ -1009,8 +1577,12 @@ class Router:
                 return out
             c.close()
             self.ledger.retire(client, m.name)
-            if resp.get("error") == protocol.ERR_QUEUE_FULL:
-                last_reject = resp      # try the next-best sibling
+            if resp.get("error") in (protocol.ERR_QUEUE_FULL,
+                                     protocol.ERR_FENCED):
+                # queue_full: try the next-best sibling.  fenced: the
+                # member lost its lease between our poll and this
+                # frame — same treatment (the poll will mark it)
+                last_reject = resp
                 continue
             # bad_request / draining etc: the member's diagnostic is
             # the authoritative one — relay it
@@ -1081,6 +1653,16 @@ class Router:
             rc=0, stats=stats, stderr_tail="")
         with self._lock:
             job.terminal = resp
+        self._journal([
+            (REC_ROUTE_ADMIT,
+             {"job_id": fid, "client": client,
+              "priority": job.priority, "trace_id": job.trace_id,
+              "stream": False, "frame": dict(frame),
+              "cache_hit": True}),
+            (REC_ROUTE_RETIRE,
+             {"job_id": fid, "state": "done", "rc": 0,
+              "detail": "served from the fleet result cache "
+                        "(byte-identical to a full run)"})])
         self.metrics["jobs"].inc(outcome="accepted")
         self.obs.event("cache_hit", job_id=fid,
                        trace_id=job.trace_id)
@@ -1140,7 +1722,10 @@ class Router:
         fwd["job_id"] = job.mjid
         try:
             with job.slock:
-                return sconn.request(fwd)
+                resp = sconn.request(fwd)
+            if resp.get("ok"):
+                self._buffer_stream_frame(job, req)
+            return resp
         except ServiceError:
             # decide WHOSE failure this was before declaring a member
             # dead: a router-side close (the job retired mid-request)
@@ -1148,17 +1733,61 @@ class Router:
             # member over for it would re-run jobs it still owns
             with self._lock:
                 retired_now = job.retired or job.terminal is not None
+                gen = job.gen
             if retired_now:
                 return protocol.err(
                     protocol.ERR_BAD_REQUEST,
                     f"stream {job.fid} is closed; re-open a stream "
                     "with --resume to complete it")
             self._member_down(job.member)
+            # _member_down runs failover synchronously: if the replay
+            # window re-drove this stream to a sibling, forward THIS
+            # frame there too — the client never learns anything died
+            with self._lock:
+                moved = job.gen != gen and job.terminal is None \
+                    and not job.retired
+                sconn2, mjid2 = job.sconn, job.mjid
+            if moved and sconn2 is not None:
+                fwd2 = dict(req)
+                fwd2["job_id"] = mjid2
+                try:
+                    with job.slock:
+                        resp = sconn2.request(fwd2)
+                    if resp.get("ok"):
+                        self._buffer_stream_frame(job, req)
+                    return resp
+                except ServiceError:
+                    pass     # the sibling died too: fall through
             return protocol.err(
                 protocol.ERR_BAD_REQUEST,
                 f"stream {job.fid} lost its member mid-stream; "
                 "re-open a stream with --resume and re-send the "
                 "records")
+
+    def _buffer_stream_frame(self, job: _FleetJob, req: dict) -> None:
+        """Append one ACKED stream frame to the job's bounded replay
+        window.  Past --stream-replay-bytes the window is dropped
+        (not truncated — a partial prefix replays a corrupt stream)
+        and mid-stream failover degrades to the documented
+        preempted-resumable verdict."""
+        with self._lock:
+            if req.get("cmd") == "stream-end":
+                job.ended = True
+                return
+            if job.rbuf is None:
+                return
+            data = req.get("data")
+            size = len(data) if isinstance(data, str) else 256
+            if job.rbytes + size > self.stream_replay_bytes:
+                job.rbuf = None
+                job.rbytes = 0
+                fid = job.fid
+            else:
+                job.rbuf.append(dict(req))
+                job.rbytes += size
+                return
+        self.obs.event("stream_window_overflow", job_id=fid,
+                       limit=self.stream_replay_bytes)
 
     def _route_simple(self, job: _FleetJob, cmd: str) -> dict:
         """status / cancel / inspect: one forwarded frame, ids
@@ -1168,7 +1797,7 @@ class Router:
             with self._lock:
                 term = job.terminal
                 m = self.members.get(job.member)
-                mjid = job.mjid
+                mjid, gen = job.mjid, job.gen
             if term is not None:
                 if cmd == "cancel":
                     return protocol.ok(
@@ -1193,6 +1822,18 @@ class Router:
                 self._member_down(job.member)
                 self._recover_job(job)
                 continue
+            j = resp.get("job")
+            if isinstance(j, dict) and j.get("state") \
+                    in TERMINAL_STATES:
+                with self._lock:
+                    moved = job.gen != gen
+                if moved:
+                    # same stale-completion fence as _route_result
+                    self.metrics["stale_rejected"].inc()
+                    self.obs.event("stale_completion_rejected",
+                                   job_id=job.fid, gen=gen,
+                                   trace_id=job.trace_id)
+                    continue
             return self._rewrite(resp, job)
         # recovery is still in flight (or re-placement raced us):
         # reads answer a soft in-progress state — the client's next
@@ -1268,6 +1909,19 @@ class Router:
                 if moved or (wait and not expired):
                     continue
                 return self._rewrite(resp, job)
+            with self._lock:
+                moved = job.gen != gen
+            if moved:
+                # fencing at the router edge: this terminal reply was
+                # fetched from the placement generation we snapshotted
+                # BEFORE a failover re-placed the job — i.e. a stale
+                # (possibly zombie) member's completion.  The newer
+                # owner's verdict is the only one that counts.
+                self.metrics["stale_rejected"].inc()
+                self.obs.event("stale_completion_rejected",
+                               job_id=job.fid, gen=gen,
+                               trace_id=job.trace_id)
+                continue
             self._note_retired(job)
             if self.obs.tracer is not None:
                 self.obs.tracer.complete(
@@ -1394,6 +2048,8 @@ class Router:
                 "running": m.running if m.alive else None,
                 "jobs_routed": m.jobs_routed,
                 "journal": m.journal_path,
+                "fenced": m.fenced,
+                "scaled": m.scaled,
             })
         return {
             "stats_version": SERVICE_STATS_VERSION,
@@ -1426,6 +2082,26 @@ class Router:
                 "jobs_recovered": dict(self.recovered),
                 "live_jobs": live,
             },
+            # additive: router HA (ISSUE 16) — WAL, epoch fencing,
+            # takeover provenance, and the scaler's own accounting
+            "ha": {
+                "epoch": self.epoch,
+                "takeover": self.takeover,
+                "lease_ttl_s": self.lease_ttl_s,
+                "stream_replay_bytes": self.stream_replay_bytes,
+                "members_fenced": sum(
+                    1 for m in members if m.alive and m.fenced),
+                "journal": {
+                    "path": self.rjournal.path
+                    if self.rjournal is not None else None,
+                    "records": self.rjournal.records_written
+                    if self.rjournal is not None else 0,
+                    "broken": self.rjournal.broken
+                    if self.rjournal is not None else None,
+                },
+                "scaler": self.scaler.stats_dict()
+                if self.scaler is not None else {"enabled": False},
+            },
             # additive: the aggregated fleet verdict (ISSUE 14) —
             # the fleet-aware `top`'s alerts pane reads it here.
             # fresh=False: the member poll the stats verb just ran
@@ -1449,15 +2125,34 @@ def route_main(argv: list[str], stdout=None, stderr=None) -> int:
         else:
             stderr.write(f"{_ROUTE_USAGE}\nInvalid argument: {a}\n")
             return EXIT_USAGE
+    standby_of = opts.pop("standby-of", None)
     backends = [b for b in
                 (opts.pop("backends", "") or "").split(",") if b]
-    if not backends:
+    sock = opts.pop("socket", None)
+    listen = opts.pop("listen", None)
+    if standby_of is not None:
+        # a standby's whole identity comes from the primary's journal
+        # — a flag-supplied member set or endpoint would let the two
+        # disagree about the fleet, which is exactly the split-brain
+        # the journal exists to prevent.  Refuse LOUDLY.
+        if backends:
+            stderr.write(f"{_ROUTE_USAGE}\nError: --standby-of and "
+                         "--backends are mutually exclusive — the "
+                         "standby inherits the member set from the "
+                         "primary's journal (its last `members` "
+                         "record), never from flags\n")
+            return EXIT_USAGE
+        if sock or listen:
+            stderr.write(f"{_ROUTE_USAGE}\nError: --standby-of and "
+                         "--socket/--listen are mutually exclusive — "
+                         "on takeover the standby binds the "
+                         "PRIMARY's socket (that is the point)\n")
+            return EXIT_USAGE
+    elif not backends:
         stderr.write(f"{_ROUTE_USAGE}\nError: --backends=TARGET"
                      "[,TARGET...] is required\n")
         return EXIT_USAGE
-    sock = opts.pop("socket", None)
-    listen = opts.pop("listen", None)
-    if not sock and not listen:
+    elif not sock and not listen:
         stderr.write(f"{_ROUTE_USAGE}\nError: --socket=PATH and/or "
                      "--listen=HOST:PORT is required\n")
         return EXIT_USAGE
@@ -1489,6 +2184,36 @@ def route_main(argv: list[str], stdout=None, stderr=None) -> int:
         except (TypeError, ValueError):
             stderr.write(f"{_ROUTE_USAGE}\nInvalid --poll-interval "
                          f"value: {val}\n")
+            return EXIT_USAGE
+    lease_ttl = DEFAULT_LEASE_TTL_S
+    val = opts.pop("lease-ttl", None)
+    if val is not None:
+        import math
+        try:
+            lease_ttl = float(val)
+            if lease_ttl <= 0 or not math.isfinite(lease_ttl):
+                raise ValueError
+        except (TypeError, ValueError):
+            stderr.write(f"{_ROUTE_USAGE}\nInvalid --lease-ttl "
+                         f"value: {val}\n")
+            return EXIT_USAGE
+    stream_replay_bytes = 4 << 20
+    val = opts.pop("stream-replay-bytes", None)
+    if val is not None:
+        if val.isascii() and val.isdigit():
+            stream_replay_bytes = int(val)
+        else:
+            stderr.write(f"{_ROUTE_USAGE}\nInvalid "
+                         f"--stream-replay-bytes value: {val}\n")
+            return EXIT_USAGE
+    scale_policy = None
+    val = opts.pop("scale-policy", None)
+    if val is not None:
+        from pwasm_tpu.fleet.scaler import load_scale_policy
+        try:
+            scale_policy = load_scale_policy(val)
+        except ValueError as e:
+            stderr.write(f"{_ROUTE_USAGE}\nError: {e}\n")
             return EXIT_USAGE
     journal_dir = opts.pop("journal-dir", None)
     result_cache = opts.pop("result-cache", None)
@@ -1523,18 +2248,26 @@ def route_main(argv: list[str], stdout=None, stderr=None) -> int:
         stderr.write(f"{_ROUTE_USAGE}\nInvalid argument: "
                      f"--{next(iter(opts))}\n")
         return EXIT_USAGE
+    router_kwargs = dict(
+        journal_dir=journal_dir,
+        max_queue=nums["max-queue"],
+        max_queue_total=nums["max-queue-total"],
+        max_results=nums["max-results"],
+        poll_interval=poll, stderr=stderr,
+        metrics_textfile=metrics_textfile,
+        log_json=log_json, trace_json=trace_json,
+        slo_rules=slo_rules,
+        result_cache=result_cache,
+        result_cache_max_bytes=result_cache_max_bytes,
+        lease_ttl_s=lease_ttl, scale_policy=scale_policy,
+        stream_replay_bytes=stream_replay_bytes)
+    if standby_of is not None:
+        from pwasm_tpu.fleet.standby import run_standby
+        return run_standby(standby_of, stderr=stderr,
+                           router_kwargs=router_kwargs)
     try:
         router = Router(backends, socket_path=sock, listen=listen,
-                        journal_dir=journal_dir,
-                        max_queue=nums["max-queue"],
-                        max_queue_total=nums["max-queue-total"],
-                        max_results=nums["max-results"],
-                        poll_interval=poll, stderr=stderr,
-                        metrics_textfile=metrics_textfile,
-                        log_json=log_json, trace_json=trace_json,
-                        slo_rules=slo_rules,
-                        result_cache=result_cache,
-                        result_cache_max_bytes=result_cache_max_bytes)
+                        **router_kwargs)
     except ValueError as e:
         stderr.write(f"{_ROUTE_USAGE}\nError: {e}\n")
         return EXIT_USAGE

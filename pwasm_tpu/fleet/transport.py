@@ -121,3 +121,30 @@ def member_journal_path(target: str,
     if is_tcp_target(target):
         return None
     return target + ".journal"
+
+
+def router_journal_path(socket_path: str | None, listen: str | None,
+                        journal_dir: str | None) -> str | None:
+    """Where a router serving on ``socket_path``/``listen`` keeps its
+    write-ahead journal (ISSUE 16) — the contract between the primary
+    (``route --socket``) and its warm standby (``route --standby-of``),
+    both of which compute it HERE so the standby tails exactly the
+    file the primary writes.  Same placement policy as member
+    journals:
+
+    - with a shared ``journal_dir``: ``<dir>/router-<name>.journal``
+      (the ``router-`` prefix keeps it out of the member-journal
+      namespace the failover scan reads);
+    - without one: ``<socket>.router.journal`` next to the unix
+      socket — readable by a same-host standby;
+    - TCP-only routers without a journal dir get None (no durable
+      path both sides can agree on): the router runs journal-less,
+      today's RAM-only behaviour, and says so at startup."""
+    import os
+    name_src = socket_path or listen
+    if journal_dir and name_src:
+        return os.path.join(
+            journal_dir, "router-" + target_name(name_src) + ".journal")
+    if socket_path:
+        return socket_path + ".router.journal"
+    return None

@@ -269,6 +269,22 @@ def build_service_metrics(reg: MetricsRegistry) -> dict:
         "pwasm_service_job_queue_wait_seconds",
         "Per-job queue wait seconds (submit to start)",
         buckets=_WAIT_BUCKETS)
+    # epoch-lease fencing (ISSUE 16, fleet/fencing.py): a router-
+    # governed member's split-brain guards
+    m["fenced"] = reg.gauge(
+        "pwasm_service_fenced",
+        "1 while this member is fenced (lost/expired epoch lease: "
+        "new work refused, in-flight drained to checkpoints), else 0")
+    m["member_epoch"] = reg.gauge(
+        "pwasm_service_member_epoch",
+        "Highest fleet epoch this member has accepted a lease under "
+        "(monotonic; compare with pwasm_fleet_epoch to spot a member "
+        "heartbeating a stale router)")
+    m["fences"] = reg.counter(
+        "pwasm_service_fences_total",
+        "Times this member self-fenced (lease TTL expiry or an "
+        "explicit fence command) — each one is a suspected "
+        "router-side failover where this member was the zombie")
     return m
 
 
@@ -381,6 +397,42 @@ def build_fleet_metrics(reg: MetricsRegistry) -> dict:
         "pwasm_fleet_max_jobs",
         "Fleet-wide live-job backstop (--max-queue-total) — the "
         "ledger_saturation SLO rule's denominator")
+    # router HA (ISSUE 16): WAL, standby takeover, fencing, scaler
+    m["epoch"] = reg.gauge(
+        "pwasm_fleet_epoch",
+        "Current fleet epoch (monotonic fencing token: bumped on "
+        "every router restart/takeover and every member-death "
+        "failover; members accept work only under a lease at it)")
+    m["fenced_members"] = reg.gauge(
+        "pwasm_fleet_members_fenced",
+        "Reachable members currently reporting themselves fenced "
+        "(self-fenced zombies waiting for a fresh lease)")
+    m["takeovers"] = reg.counter(
+        "pwasm_fleet_takeovers_total",
+        "Warm-standby takeovers this router performed (route "
+        "--standby-of promoted itself onto the primary's socket)")
+    m["journal_records"] = reg.counter(
+        "pwasm_fleet_journal_records_total",
+        "Router write-ahead journal records appended, by record type "
+        "(route_admit/route_place/route_retire/epoch/members/scale)",
+        labels=("rec",))
+    m["journal_replayed"] = reg.counter(
+        "pwasm_fleet_journal_replayed_total",
+        "Routed jobs rebuilt from the router WAL at start (each "
+        "replay is a router crash or a standby takeover recovered)")
+    m["scaler_members"] = reg.gauge(
+        "pwasm_fleet_scaler_members",
+        "Members currently alive that the SLO-driven scaler spawned "
+        "(route --scale-policy)")
+    m["scaler_actions"] = reg.counter(
+        "pwasm_fleet_scaler_actions_total",
+        "Auto-scaler actions taken, by action (spawn/retire)",
+        labels=("action",))
+    m["stale_rejected"] = reg.counter(
+        "pwasm_fleet_stale_completions_total",
+        "Terminal replies rejected at the router edge because the "
+        "job had moved to a newer generation (a fenced zombie's "
+        "completion arriving after failover re-placed the job)")
     return m
 
 
@@ -517,6 +569,14 @@ DEFAULT_FLEET_SLO_RULES = (
      "runbook": "fleet-wide live jobs are over 80% of the admission "
                 "backstop; clients will start seeing queue_full — "
                 "add members or raise route --max-queue-total"},
+    {"name": "member_fenced", "severity": "warn", "kind": "threshold",
+     "metric": "pwasm_fleet_members_fenced", "op": ">", "value": 0,
+     "for_s": 0.0,
+     "runbook": "a reachable member is self-fenced (it lost its epoch "
+                "lease and is refusing work); the next healthy stats "
+                "poll re-grants the lease — if it stays fenced, the "
+                "member is heartbeating a stale router: check for a "
+                "zombie primary still holding the journal"},
 )
 
 

@@ -41,6 +41,7 @@ import threading
 import time
 
 from pwasm_tpu.core.errors import EXIT_PREEMPTED, EXIT_USAGE, PwasmError
+from pwasm_tpu.fleet.fencing import EpochLease
 from pwasm_tpu.resilience.lifecycle import SignalDrain
 from pwasm_tpu.service import protocol
 from pwasm_tpu.service.cache import ByteLedger
@@ -476,6 +477,13 @@ class Daemon:
         self.warm = WarmContext()
         self.warm.compile_cache_dir = compile_cache_dir
         self.drain = SignalDrain(stderr=self.stderr)
+        # ---- epoch-lease fencing (ISSUE 16, fleet/fencing.py): when
+        # a fleet router governs this member, its stats polls carry a
+        # lease {epoch, ttl_s}; missing heartbeats past the TTL means
+        # the fleet may have failed our jobs over — self-fence (drain
+        # in-flight to checkpoints, refuse new frames) rather than
+        # keep writing as a zombie.  Ungoverned daemons never fence.
+        self.epoch_lease = EpochLease()
         self._lock = threading.Lock()
         self._running: dict[str, Job] = {}
         self._draining = False
@@ -716,6 +724,9 @@ class Daemon:
                 while True:
                     self._evict_results()
                     self._selfmon_tick()
+                    if self.epoch_lease.expired():
+                        self._fence("lease TTL expired: heartbeats "
+                                    "from the fleet router stopped")
                     if self.cache is not None and \
                             time.monotonic() >= self._cache_evict_at:
                         # periodic TTL/budget sweep (cheap no-op when
@@ -839,6 +850,8 @@ class Daemon:
         # both byte gauges read the ONE ledger (never a bare int a
         # concurrent eviction could tear)
         m["spool_bytes"].set(self.ledger.value("spool"))
+        m["fenced"].set(1 if self.epoch_lease.fenced else 0)
+        m["member_epoch"].set(self.epoch_lease.epoch)
         self.cache_metrics["bytes"].set(self.ledger.value("cache"))
         for c, lag in self.streams.client_lag().items():
             self.stream_metrics["lag"].set(lag,
@@ -1231,6 +1244,67 @@ class Daemon:
                   f"job(s) finishing at their batch boundaries, "
                   f"{len(waiting)} queued job(s) preempted, new "
                   "submissions rejected")
+
+    def _fence(self, reason: str) -> None:
+        """Self-fence (ISSUE 16): the epoch lease is gone, so the
+        router may ALREADY have re-admitted our jobs to siblings —
+        from this instant every write we could make races the new
+        owner.  Drain in-flight work to its durable checkpoints and
+        preempt the queue, but — unlike a drain — do NOT latch
+        admission or kill the workers: a fence is a pause (the next
+        accepted lease lifts it), a drain is an exit."""
+        if not self.epoch_lease.fence(reason):
+            return                   # already fenced
+        with self._lock:
+            running = list(self._running.values())
+        waiting = self.queue.preempt_all()
+        for job in waiting:
+            self._retire_stream(job)
+            job.state = JOB_PREEMPTED
+            job.rc = EXIT_PREEMPTED
+            job.detail = ("preempted by fencing (member lost its "
+                          "epoch lease); resubmit to the fleet — "
+                          "with --resume if a previous attempt "
+                          "checkpointed")
+            job.finished_s = time.time()
+            self.stats.jobs_preempted += 1
+            self.svc_metrics["jobs"].inc(outcome="preempted")
+            self._journal_append(REC_FINISH, job_id=job.id,
+                                 state=JOB_PREEMPTED,
+                                 rc=EXIT_PREEMPTED,
+                                 detail=job.detail)
+            job.done.set()
+        for job in running:
+            if job.drain is not None:
+                job.drain.request(f"fenced: {reason}")
+        self.svc_metrics["fences"].inc()
+        self.obs.event("fenced", reason=reason,
+                       epoch=self.epoch_lease.epoch,
+                       running=len(running), preempted=len(waiting))
+        self._say(f"FENCED ({reason}): {len(running)} in-flight "
+                  f"job(s) draining to checkpoints, {len(waiting)} "
+                  "queued job(s) preempted; refusing new work until "
+                  "a fresh lease arrives")
+
+    def _lease_grant(self, obj) -> tuple[bool, str]:
+        """Apply one router lease heartbeat; returns (accepted,
+        detail).  An accepted grant on a fenced member UN-fences it —
+        the router has re-asserted ownership at a current epoch."""
+        if not isinstance(obj, dict):
+            return False, "lease must be an object {epoch, ttl_s}"
+        was_fenced = self.epoch_lease.fenced
+        ok, detail = self.epoch_lease.grant(obj.get("epoch"),
+                                            obj.get("ttl_s"))
+        if ok:
+            self.svc_metrics["member_epoch"].set(
+                self.epoch_lease.epoch)
+            if was_fenced:
+                self.obs.event("unfenced",
+                               epoch=self.epoch_lease.epoch)
+                self._say(f"lease re-granted at epoch "
+                          f"{self.epoch_lease.epoch} — fence lifted, "
+                          "accepting work again")
+        return ok, detail
 
     # ---- workers -------------------------------------------------------
     def _worker(self) -> None:
@@ -1805,6 +1879,31 @@ class Daemon:
             return protocol.ok(
                 protocol_version=protocol.PROTOCOL_VERSION,
                 draining=self._draining)
+        if cmd in ("submit", "stream", "stream-data") \
+                and self.epoch_lease.fenced:
+            # the fence: no NEW work while the lease is lost — the
+            # fleet may already have handed our jobs to siblings.
+            # Reads (status/result), stream-end, cancel, stats (the
+            # lease heartbeat rides it) and drain all still serve.
+            return protocol.err(
+                protocol.ERR_FENCED,
+                "member is fenced (lost its fleet epoch lease): "
+                "new work refused until the router re-grants a "
+                "lease — submit to the fleet router instead",
+                epoch=self.epoch_lease.epoch)
+        if cmd == "lease-grant":
+            ok, detail = self._lease_grant(
+                {"epoch": req.get("epoch"),
+                 "ttl_s": req.get("ttl_s")})
+            if not ok:
+                return protocol.err(
+                    protocol.ERR_FENCED, detail,
+                    lease=self.epoch_lease.as_dict())
+            return protocol.ok(lease=self.epoch_lease.as_dict())
+        if cmd == "fence":
+            self._fence(str(req.get("reason")
+                            or "fence requested by client"))
+            return protocol.ok(lease=self.epoch_lease.as_dict())
         if cmd == "submit":
             client = self._resolve_client(req, peer)
             try:
@@ -2006,6 +2105,20 @@ class Daemon:
             # verdict (ISSUE 14) — `top`'s alerts pane reads it from
             # the same surface as the JSON verbs
             st["health"] = self._health()
+            # additive: epoch-lease fencing (ISSUE 16).  The router's
+            # lease heartbeat RIDES the stats poll (req["lease"]), so
+            # governance costs zero extra RPCs; the reply always
+            # carries the member's lease view (+ the grant verdict
+            # when one was attempted)
+            lease_req = req.get("lease")
+            lb = self.epoch_lease.as_dict()
+            if lease_req is not None:
+                ok_g, detail = self._lease_grant(lease_req)
+                lb = self.epoch_lease.as_dict()
+                lb["accepted"] = ok_g
+                if not ok_g:
+                    lb["refused_detail"] = detail
+            st["lease"] = lb
             return protocol.ok(stats=st)
         if cmd == "metrics":
             self._refresh_gauges()

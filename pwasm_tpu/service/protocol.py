@@ -84,6 +84,25 @@ Commands (``{"cmd": ...}``):
                new submissions, finish in-flight jobs at batch
                boundaries, mark queued jobs preempted-resumable, exit
                75.
+``lease-grant``  ``{"cmd":"lease-grant","epoch":N,"ttl_s":S}`` — grant
+               (or heartbeat) the member's epoch lease (ISSUE 16,
+               docs/FLEET.md fencing).  The fleet router normally
+               piggybacks the same ``{"lease":{"epoch":N,"ttl_s":S}}``
+               object on its ``stats`` polls instead of spending a
+               round-trip on this verb.  A grant at an epoch LOWER
+               than the member has already seen answers ``fenced`` —
+               a stale router cannot re-arm a member the fleet moved
+               past.  An accepted grant clears a standing self-fence.
+``fence``      ``{"cmd":"fence"[,"reason":TEXT]}`` — fence the member
+               NOW: in-flight jobs are preempted at their next batch
+               boundary (valid resumable ckpt, rc 75, exactly like a
+               drain) and new ``submit``/``stream``/``stream-data``
+               frames answer the ``fenced`` error until a lease grant
+               at the current-or-newer epoch un-fences it.  The same
+               transition fires autonomously when a governed lease's
+               TTL expires unheartbeated (self-fencing: a partitioned
+               member stops writing BEFORE a sibling's ``--resume``
+               starts).
 ``ping``       liveness + protocol version.
 =============  ==========================================================
 
@@ -127,6 +146,9 @@ ERR_FRAME_TOO_LARGE = "frame_too_large"  # conn closed: stream unsynced
 ERR_BAD_REQUEST = "bad_request"      # parsed, but semantically invalid
 ERR_UNKNOWN_CMD = "unknown_cmd"
 ERR_UNKNOWN_JOB = "unknown_job"
+ERR_FENCED = "fenced"                # epoch-lease fence: member must
+#   not accept work (lost/expired lease, or a stale-epoch grant was
+#   refused).  Clients treat it like draining: go elsewhere.
 
 
 class FrameError(Exception):

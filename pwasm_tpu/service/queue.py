@@ -340,18 +340,30 @@ class JobQueue:
         resumable, never starts them."""
         with self._cond:
             self._draining = True
-            waiting: list[Job] = []
-            for sched in self._sched.values():
-                for q in sched.clients.values():
-                    waiting.extend(q)
-                sched.clients.clear()
-                sched.rr.clear()
-                sched.deficit.clear()
-            waiting.sort(key=lambda j: j.seq)
-            self._count = 0
-            self._client_counts.clear()
-            self._cond.notify_all()
-            return waiting
+            return self._empty_locked()
+
+    def preempt_all(self) -> list[Job]:
+        """Empty the queue WITHOUT latching the draining state — the
+        epoch-fence path (ISSUE 16, ``fleet/fencing.py``): queued jobs
+        are preempted now, but admission re-opens the moment a lease
+        grant un-fences the member.  A fence is a pause; a drain is an
+        exit."""
+        with self._cond:
+            return self._empty_locked()
+
+    def _empty_locked(self) -> list[Job]:
+        waiting: list[Job] = []
+        for sched in self._sched.values():
+            for q in sched.clients.values():
+                waiting.extend(q)
+            sched.clients.clear()
+            sched.rr.clear()
+            sched.deficit.clear()
+        waiting.sort(key=lambda j: j.seq)
+        self._count = 0
+        self._client_counts.clear()
+        self._cond.notify_all()
+        return waiting
 
 
 class StreamBook:

@@ -153,6 +153,39 @@ SLO_FILES = ("pwasm_tpu/obs/slo.py", "pwasm_tpu/service/canary.py")
 # hashing, fsio writes, and file serves.
 CACHE_FILES = ("pwasm_tpu/service/cache.py",)
 
+# ---- fencing-invariant gate (ISSUE 16 satellite) ----------------------
+# Failover re-admission is where split-brain corruption happens: an
+# orchestrator that re-admits a started job as a ``--resume``
+# continuation on a SIBLING member must first route the job's
+# placement epoch through fencing.readmit_epoch_guard — otherwise a
+# stale router incarnation can re-place work a newer incarnation
+# already owns, and two writers share one report file.  This gate
+# finds every line in pwasm_tpu/ that APPENDS the literal
+# ``--resume`` to an argv (the re-admission signature) and fails
+# unless the site is registered below.  Registry grammar, per module:
+#
+# - ``guard``         the site must reference FENCING_GUARD earlier
+#                     in the SAME function (the epoch check happens
+#                     before the job is re-placed);
+# - ``exempt:<why>``  deliberately unguarded — the justification is
+#                     the registry entry itself.
+FENCING_FILE = "pwasm_tpu/fleet/fencing.py"
+FENCING_GUARD = "readmit_epoch_guard"
+RESUME_APPEND_RE = re.compile(
+    r"""(?:append|extend)\s*\(\s*\[?\s*["']--resume["']"""
+    r"""|\+\s*\[\s*["']--resume["']""")
+FENCING_REGISTRY = {
+    # the daemon re-admits its OWN journal into its OWN queue at
+    # startup — one process, one writer, no sibling to race; the
+    # fleet epoch does not exist at this layer
+    "pwasm_tpu/service/daemon.py":
+        "exempt:single-process self-replay (the daemon re-admits its "
+        "own journal at startup; no sibling writer exists to fence)",
+    # the router re-places jobs on SIBLINGS after a member death —
+    # the epoch guard is mandatory here
+    "pwasm_tpu/fleet/router.py": "guard",
+}
+
 # default SLO rule names are declared in the catalog's rules region
 # (below the sentinel) as {"name": "..."} literals; each must appear
 # in docs/OBSERVABILITY.md — an undocumented rule is an alert an
@@ -417,6 +450,71 @@ def find_cache_violations(root: str = REPO) -> list[str]:
     return out
 
 
+def _enclosing_def_start(lines: list[str], hit_idx: int) -> int:
+    """0-based index of the ``def`` line opening the function that
+    contains ``lines[hit_idx]`` (nearest preceding def at strictly
+    lower indentation), or 0 when the hit is at module level."""
+    hit = lines[hit_idx]
+    hit_indent = len(hit) - len(hit.lstrip())
+    for j in range(hit_idx - 1, -1, -1):
+        stripped = lines[j].lstrip()
+        if not stripped:
+            continue
+        indent = len(lines[j]) - len(stripped)
+        if stripped.startswith("def ") and indent < hit_indent:
+            return j
+    return 0
+
+
+def find_fencing_violations(root: str = REPO) -> list[str]:
+    """Fencing-invariant gate (ISSUE 16 satellite): fleet/fencing.py
+    must exist, and every ``--resume`` re-admission site in pwasm_tpu/
+    must be registered in FENCING_REGISTRY — ``guard`` sites must
+    reference ``readmit_epoch_guard`` earlier in the same function,
+    so no failover path can re-place a job without the epoch check."""
+    out: list[str] = []
+    fpath = os.path.join(root, *FENCING_FILE.split("/"))
+    if not os.path.isfile(fpath):
+        out.append(f"{FENCING_FILE}: fencing module missing — the "
+                   "epoch-lease surface every failover re-admission "
+                   "path depends on")
+    pkg = os.path.join(root, "pwasm_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            if rel == FENCING_FILE:
+                continue
+            with open(path, encoding="utf-8") as f:
+                lines = f.readlines()
+            for i, line in enumerate(lines):
+                if line.lstrip().startswith("#"):
+                    continue
+                if not RESUME_APPEND_RE.search(line):
+                    continue
+                entry = FENCING_REGISTRY.get(rel)
+                if entry is None:
+                    out.append(
+                        f"{rel}:{i + 1}: unregistered --resume "
+                        f"re-admission site: {line.strip()} — route "
+                        f"the job's epoch through "
+                        f"{FENCING_FILE}::{FENCING_GUARD} and "
+                        "register the site in "
+                        "qa/check_supervision.py::FENCING_REGISTRY")
+                elif entry == "guard":
+                    start = _enclosing_def_start(lines, i)
+                    if FENCING_GUARD not in "".join(lines[start:i]):
+                        out.append(
+                            f"{rel}:{i + 1}: --resume re-admission "
+                            f"without the epoch fence: call "
+                            f"{FENCING_GUARD} earlier in the same "
+                            "function, before the job is re-placed")
+    return out
+
+
 def find_doc_drift(root: str = REPO) -> list[str]:
     """Catalog families missing from docs/OBSERVABILITY.md (module
     comment: the doc is the operator's catalog of record, so every
@@ -468,13 +566,14 @@ def main() -> int:
     sharding = find_sharding_violations()
     slo = find_slo_violations()
     cachev = find_cache_violations()
+    fencing = find_fencing_violations()
     for line in bad:
         print(line, file=sys.stderr)
     for rel in stale:
         print(f"{rel}: stale registry entry (no device entry points "
               "left — remove it)", file=sys.stderr)
     for line in svc + obs + stream + fleet + metric + doc_drift \
-            + sharding + slo + cachev:
+            + sharding + slo + cachev + fencing:
         print(line, file=sys.stderr)
     if bad:
         print(f"\n{len(bad)} device entry point(s) outside the "
@@ -512,9 +611,14 @@ def main() -> int:
         print(f"\n{len(cachev)} result-cache gate failure(s): "
               "service/cache.py must exist and stay jax-free "
               "(ISSUE 15).", file=sys.stderr)
+    if fencing:
+        print(f"\n{len(fencing)} fencing-invariant failure(s): "
+              "every --resume re-admission path must route the "
+              "job's epoch through fleet/fencing.py::"
+              "readmit_epoch_guard (ISSUE 16).", file=sys.stderr)
     return 1 if (bad or stale or svc or obs or stream or fleet
                  or metric or doc_drift or sharding or slo
-                 or cachev) else 0
+                 or cachev or fencing) else 0
 
 
 if __name__ == "__main__":

@@ -143,6 +143,57 @@ def test_cache_gate_detects_missing_and_jax(checker, tmp_path):
     assert all("cache.py" in b for b in bad)
 
 
+def test_fencing_gate_clean_on_this_tree(checker):
+    """ISSUE 16 satellite: fleet/fencing.py exists, and every
+    ``--resume`` re-admission site in pwasm_tpu/ either routes the
+    job's epoch through readmit_epoch_guard in the same function or
+    is a registered single-process exemption — no failover path can
+    re-place a started job without the epoch fence."""
+    bad = checker.find_fencing_violations()
+    assert bad == [], "\n".join(bad)
+
+
+def test_fencing_gate_detects_violations(checker, tmp_path):
+    # a tree without the fencing module at all: the existence half
+    bad = checker.find_fencing_violations(str(tmp_path))
+    assert len(bad) == 1 and "missing" in bad[0], bad
+    pkg = tmp_path / "pwasm_tpu"
+    (pkg / "fleet").mkdir(parents=True)
+    (pkg / "service").mkdir(parents=True)
+    (pkg / "fleet" / "fencing.py").write_text(
+        "def readmit_epoch_guard(job_epoch, fleet_epoch):\n"
+        "    return fleet_epoch\n")
+    # a guard-registered site WITHOUT the epoch check: a hit
+    (pkg / "fleet" / "router.py").write_text(
+        "def _recover(argv, resume):\n"
+        "    if resume:\n"
+        "        argv = argv + ['--resume']\n"
+        "    return argv\n")
+    # the daemon's single-process self-replay is exempt: NOT a hit
+    (pkg / "service" / "daemon.py").write_text(
+        "def _replay(run_argv, resume):\n"
+        "    if resume:\n"
+        "        run_argv.append('--resume')\n")
+    # an UNREGISTERED module growing a re-admission path: a hit
+    (pkg / "rogue.py").write_text(
+        "# argv.append('--resume') in a comment is NOT a hit\n"
+        "def readmit(argv):\n"
+        "    argv.append('--resume')\n")
+    bad = checker.find_fencing_violations(str(tmp_path))
+    assert len(bad) == 2, bad
+    assert any("router.py" in b and "epoch fence" in b for b in bad)
+    assert any("rogue.py" in b and "unregistered" in b for b in bad)
+    # calling the guard earlier in the SAME function clears the site
+    (pkg / "fleet" / "router.py").write_text(
+        "def _recover(argv, resume, job_epoch, fleet_epoch):\n"
+        "    epoch = readmit_epoch_guard(job_epoch, fleet_epoch)\n"
+        "    if resume:\n"
+        "        argv = argv + ['--resume']\n"
+        "    return argv, epoch\n")
+    bad = checker.find_fencing_violations(str(tmp_path))
+    assert len(bad) == 1 and "rogue.py" in bad[0], bad
+
+
 def test_metric_lint_clean_on_this_tree(checker):
     """ISSUE 6 satellite: every metric registration lives in
     obs/catalog.py, with snake_case pwasm_-prefixed unique names."""
