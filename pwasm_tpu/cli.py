@@ -585,6 +585,10 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None,
     cache_store = None
     cache_key_hex = None
     cache_cls = None
+    follow_cls = None      # --follow's classify-without-the-flag view
+    cache_delta = None     # (records served, records total) when the
+    #                        run was re-armed as a delta over a cached
+    #                        prefix (ISSUE 17)
     rc_dir = opts.get("result-cache")
     if rc_dir is True:
         raise CliError(f"{USAGE}\n--result-cache requires a "
@@ -605,16 +609,26 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None,
                                                  derive_key,
                                                  serve_outputs)
             cache_cls = classify(opts, positional)
+            if cache_cls is None and follow:
+                # a --follow job bypasses the exact cache (its input
+                # is still growing) but re-enters through the DELTA
+                # path: classified without the flag, the grown file's
+                # prefix may already be a cached entry — a restart on
+                # a grown file then becomes a cache hit plus a tail
+                # of new records (ISSUE 17a / docs/STREAMING.md)
+                follow_cls = classify(
+                    {k: v for k, v in opts.items() if k != "follow"},
+                    positional)
             if cache_cls is not None:
                 cache_key_hex = derive_key(cache_cls)
-            if cache_key_hex is not None:
+            if cache_key_hex is not None or follow_cls is not None:
                 try:
                     cache_store = CacheStore(rc_dir, max_bytes=rc_max)
                 except OSError as e:
                     print(f"Warning: --result-cache dir {rc_dir} "
                           f"unusable ({e}); caching disabled",
                           file=stderr)
-            if cache_store is not None:
+            if cache_store is not None and cache_key_hex is not None:
                 got = cache_store.get(cache_key_hex)
                 served = False
                 if got is not None:
@@ -629,6 +643,15 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None,
                     return _serve_cache_hit(got[0], opts, stderr,
                                             verbose=bool(
                                                 opts.get("v")))
+            if cache_store is not None:
+                # exact miss: a same-family entry whose input is a
+                # per-line PREFIX of ours serves its cached report and
+                # re-arms this run as a --resume over it — only the
+                # last cached record and the appended tail recompute
+                cache_delta = _cache_delta_serve(
+                    cache_store, follow_cls or cache_cls, opts,
+                    stderr, allow_equal=follow,
+                    verbose=bool(opts.get("v")))
         if input_stream is not None:
             if infile is not None:
                 raise PwasmError(
@@ -637,8 +660,14 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None,
             inf = input_stream
         elif infile:
             if follow:
+                import hashlib as _fhash
                 from pwasm_tpu.stream.pafstream import FollowReader
-                inf = FollowReader(infile, idle_timeout_s=follow_idle)
+                # with the result cache armed, the follow pass rides
+                # the same content hasher the block reader does: a
+                # cleanly idle-ended follow populates the cache
+                inf = FollowReader(infile, idle_timeout_s=follow_idle,
+                                   hasher=_fhash.sha256()
+                                   if cache_store is not None else None)
             else:
                 # block-scan ingest (ROADMAP item 5): the host
                 # path walks the input in 1 MiB blocks through the
@@ -966,15 +995,39 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None,
                                 resume_state=resume_state,
                                 drain=drain, warm=warm, obs=obs)
         if rc == 0 and cache_store is not None:
-            # populate on the way out: the COMPLETED run's output
-            # files become the entry an identical later job serves.
-            # The ingest reader's ride-along digest re-derives the key
-            # (no second input read) AND proves the input did not
-            # change between keying and running — a drifted key means
-            # someone rewrote the input mid-run, and inserting under
-            # the old key would poison every future hit.
-            _cache_populate(cache_store, cache_key_hex, cache_cls,
-                            inf, cfg.stats_path, stderr)
+            if cache_delta is not None:
+                # the delta run is done: stamp the stats file
+                # truthfully (cache_delta:true with computed-vs-served
+                # record counts) and account the serve FRACTIONALLY
+                _cache_delta_finish(cache_store, cfg.stats_path,
+                                    cache_delta)
+            if follow_cls is not None:
+                # a cleanly idle-ended --follow run is a one-shot run
+                # over the file's final bytes: populate under the
+                # follow-less key so the NEXT restart delta-hits (or
+                # exact-hits an unchanged file).  A rotation voided
+                # the ride-along digest — the stream no longer equals
+                # any one file's bytes — and blocks the insert.
+                if getattr(inf, "consumed", False) \
+                        and inf.hexdigest() is not None:
+                    from pwasm_tpu.service.cache import \
+                        derive_key as _derive_key
+                    fkey = _derive_key(follow_cls,
+                                       input_digest=inf.hexdigest())
+                    if fkey is not None:
+                        _cache_populate(cache_store, fkey, follow_cls,
+                                        inf, cfg.stats_path, stderr)
+            else:
+                # populate on the way out: the COMPLETED run's output
+                # files become the entry an identical later job serves.
+                # The ingest reader's ride-along digest re-derives the
+                # key (no second input read) AND proves the input did
+                # not change between keying and running — a drifted
+                # key means someone rewrote the input mid-run, and
+                # inserting under the old key would poison every
+                # future hit.
+                _cache_populate(cache_store, cache_key_hex, cache_cls,
+                                inf, cfg.stats_path, stderr)
         return rc
     except PwasmError as e:
         stderr.write(str(e))
@@ -1023,6 +1076,88 @@ def _serve_cache_hit(manifest: dict, opts: dict, stderr,
     return 0
 
 
+def _cache_delta_serve(store, cls, opts: dict, stderr,
+                       allow_equal: bool = False,
+                       verbose: bool = False
+                       ) -> tuple[int, int] | None:
+    """Near-miss delta serve (ISSUE 17a): on an exact-key miss, look
+    for a same-FAMILY entry whose recorded input is a per-line prefix
+    of this job's input.  When one exists, its CRC-verified report
+    bytes are written to this job's report path and the run is
+    re-armed as a ``--resume`` over them — the existing resume
+    machinery then drops the last cached record (its rows could not
+    be proven whole by a header alone) and fast-forwards the rest as
+    a parse-only skip, so only that record and the appended tail pay
+    compute.  Byte parity with a cold run holds because the served
+    prefix IS a completed run's bytes over the same prefix lines.
+    Returns ``(records_served, records_total)`` or None (plain
+    miss)."""
+    from pwasm_tpu.service.cache import (delta_eligible, derive_keys,
+                                         paf_line_digests)
+    if cls is None or not delta_eligible(cls):
+        return None
+    digests, _fdig = paf_line_digests(cls.input_path)
+    if not digests or len(digests) < 2:
+        return None
+    derived = derive_keys(cls)
+    if derived is None:
+        return None
+    hit = store.delta_lookup(derived[1], digests,
+                             allow_equal=allow_equal)
+    if hit is None:
+        return None
+    _key, _manifest, blobs, nl = hit
+    report_path = cls.output_paths["o"]
+    try:
+        with open(report_path, "wb") as f:
+            f.write(blobs["o"])
+    except OSError:
+        return None     # unwritable output: the real run reports the
+        #                 canonical "Cannot open file ..." diagnostic
+    # a stale checkpoint left by an unrelated earlier run on this
+    # report path would hijack the ckpt-first resume; the header-scan
+    # heuristic over the just-served prefix is the resume we want
+    _unlink_checkpoint(report_path)
+    opts["resume"] = True
+    if verbose:
+        print(f"pwasm: cache delta hit — {nl} of {len(digests)} "
+              "input records served from a cached prefix; computing "
+              "the tail", file=stderr)
+    # the resume header-scan re-pays the LAST cached record (nl - 1
+    # records actually skip); the total is the input's record count
+    return max(0, nl - 1), len(digests)
+
+
+def _cache_delta_finish(store, stats_path: str | None,
+                        served_total: tuple[int, int]) -> None:
+    """Close out a completed delta run: fold the fractional outcome
+    into the store's accounting and stamp the ``--stats`` artifact
+    truthfully — ``cache_delta: true`` with the computed-vs-served
+    record counts, never the hit-shaped ``cache_hit`` (this run DID
+    probe and compute its tail)."""
+    served, total = served_total
+    store.note_delta(served, total)
+    if not stats_path:
+        return
+    import json as _json
+    try:
+        with open(stats_path) as f:
+            st = _json.load(f)
+    except (OSError, ValueError):
+        return
+    if not isinstance(st, dict):
+        return
+    st["cache_delta"] = True
+    st["cache_records_served"] = int(served)
+    st["cache_records_total"] = int(total)
+    try:
+        with open(stats_path, "w") as f:
+            _json.dump(st, f, indent=1)
+            f.write("\n")
+    except OSError:
+        pass
+
+
 def _cache_populate(store, key_hex: str | None, cls, inf,
                     stats_path: str | None, stderr) -> None:
     """Insert a completed run's outputs into the result cache (best
@@ -1045,6 +1180,13 @@ def _cache_populate(store, key_hex: str | None, cls, inf,
                 stats = _json.load(f)
         except (OSError, ValueError):
             stats = None
+    if isinstance(stats, dict):
+        # the entry's stats describe the RESULT, not how this run got
+        # it: a future hit served from a delta-produced entry paid no
+        # delta itself
+        for k in ("cache_delta", "cache_records_served",
+                  "cache_records_total"):
+            stats.pop(k, None)
     insert_from_paths(store, key_hex, cls,
                       input_digest=input_digest, stats=stats)
 
@@ -1710,6 +1852,103 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                 raise
             note_batch_done(nrec)
 
+    # Batched native extraction: buffer parsed records and cross into C
+    # ONCE per flush (pw_extract_batch) instead of once per line — the
+    # same stop-at-the-failing-item protocol and parity contract as
+    # pw_msa_add_batch (byte-identical OUTPUT FILES; stderr is
+    # ordering-equivalent at flush boundaries).
+    # PWASM_NATIVE_EXTRACT_BATCH=0 is the per-item A/B hatch.
+    # --skip-bad-lines keeps the per-item path: its recovery
+    # bookkeeping (dedup-slot release, per-line skip warnings in input
+    # position) is per-line by construction.
+    ex_pending: list[tuple] = []
+    use_ex_batch = False
+    if not cfg.skip_bad_lines:
+        import os as _os
+        if (_os.environ.get("PWASM_NATIVE", "1") != "0"
+                and _os.environ.get(
+                    "PWASM_NATIVE_EXTRACT_BATCH", "1") != "0"):
+            from pwasm_tpu.native import native_available
+            use_ex_batch = native_available()
+
+    def consume_aln(rec, aln, refseq_b: bytes, refseq_aln: bytes,
+                    ordnum: int) -> None:
+        """Post-extraction per-alignment body (stats, report row, MSA
+        insert bookkeeping) — shared verbatim by the per-item and the
+        batched extraction paths, so their outputs cannot drift."""
+        al = rec.alninfo
+        stats.alignments += 1
+        stats.aligned_bases += al.t_alnend - al.t_alnstart
+        stats.events += len(aln.tdiffs)
+        tlabel = f"{al.t_id}:{al.t_alnstart}-{al.t_alnend}" \
+            + ("-" if al.reverse else "+")
+        rlabel = al.r_id
+        if cfg.fullgenome:
+            rlabel += f":{al.r_alnstart}-{al.r_alnend}"
+        if freport is not None:
+            if len(qfasta) == 1 and not cfg.fullgenome:
+                rlabel = ""
+            if stats.resumed_past < resume_skip:
+                # --resume cursor: this alignment's rows are already
+                # in the report from the interrupted run
+                stats.resumed_past += 1
+            else:
+                # both engines batch: the device path submits one
+                # fused program per flush, the host path runs one
+                # vectorized columnar analysis per flush — and both
+                # leave a durable checkpoint per completed batch
+                pending.append((aln, rlabel, tlabel, refseq_b))
+                if len(pending) >= cfg.batch:
+                    flush_pending()
+        if build_msa_out:
+            if cfg.realign:
+                q_seg = refseq_aln[aln.offset:
+                                   aln.offset + (al.r_alnend -
+                                                 al.r_alnstart)]
+                re_pending.append((aln, tlabel, refseq_b, ordnum,
+                                   q_seg))
+                if len(re_pending) >= cfg.batch:
+                    flush_realign()
+            else:
+                msa_add(aln, tlabel, refseq_b, ordnum)
+
+    def flush_extract() -> None:
+        """Extract the buffered records through ONE native crossing,
+        then run each alignment's consume body in input order."""
+        if not ex_pending:
+            return
+        from pwasm_tpu.native import extract_batch_native
+        items, ex_pending[:] = ex_pending[:], []
+        if len(items) == 1:
+            # a one-record flush (--batch=1 streaming, lone query-
+            # change tail) pays the single crossing either way; the
+            # direct call skips the batch marshalling so streaming's
+            # per-record latency keeps its floor
+            rec, refseq_aln, refseq_b, ordnum = items[0]
+            t_st = _pc()
+            aln = extract_alignment(rec, refseq_aln)
+            stats.host_extract_s += _pc() - t_st
+            consume_aln(rec, aln, refseq_b, refseq_aln, ordnum)
+            return
+        t_st = _pc()
+        alns, ex_err = extract_batch_native(
+            [it[0] for it in items], [it[1] for it in items])
+        stats.host_extract_s += _pc() - t_st
+        if alns is None:   # lib lost after the gate probe: per-item
+            for rec, refseq_aln, refseq_b, ordnum in items:
+                t_st = _pc()
+                aln = extract_alignment(rec, refseq_aln)
+                stats.host_extract_s += _pc() - t_st
+                consume_aln(rec, aln, refseq_b, refseq_aln, ordnum)
+            return
+        for aln, (rec, refseq_aln, refseq_b, ordnum) in zip(alns,
+                                                            items):
+            consume_aln(rec, aln, refseq_b, refseq_aln, ordnum)
+        if ex_err is not None:
+            # the failing item aborts the run exactly as per-item mode
+            # would, after the rows of the items before it landed
+            raise ex_err
+
     t_loop = obs.clock()   # the parse/extract/flush phase span
     # per-stage host walls (--stats "host" block): parse and extract
     # accumulate here on the main loop; analyze/format accumulate on
@@ -1785,10 +2024,13 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                 stats.aligned_bases += al.t_alnend - al.t_alnstart
                 continue
             if refseq_id is None or refseq_id != al.r_id:
-                # buffered re-alignments belong to the previous query's
-                # MSA: merge them before the layout state resets (and
-                # the batched native inserts with them — the add-batch
-                # buffer never spans a query boundary)
+                # buffered EXTRACTIONS may span queries (each record
+                # carries its own ref pointer), but their downstream
+                # MSA inserts may not: consume them first, THEN merge
+                # the buffered re-alignments and native inserts before
+                # the layout state resets (the add-batch buffer never
+                # spans a query boundary)
+                flush_extract()
                 flush_realign()
                 flush_msa_pending()
                 if al.r_id in ref_cache:
@@ -1811,6 +2053,14 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                     f"Error: ref seq len in this PAF line ({al.r_len}) differs "
                     f"from loaded sequence length({len(refseq)})!\n{line}\n")
             refseq_aln = refseq_rc if al.reverse else refseq
+            if use_ex_batch:
+                # batched native extraction: this record crosses into
+                # C with the rest of its flush; its consume body runs
+                # at the flush boundary, still in input order
+                ex_pending.append((rec, refseq_aln, refseq, numalns))
+                if len(ex_pending) >= cfg.batch:
+                    flush_extract()
+                continue
             try:
                 t_st = _pc()
                 aln = extract_alignment(rec, refseq_aln)
@@ -1827,49 +2077,27 @@ def _main_loop(cfg: Config, inf, freport, fmsa, fsummary, summary,
                 print(f"Warning: skipping malformed PAF line "
                       f"{file_line}", file=stderr)
                 continue
-            stats.alignments += 1
-            stats.aligned_bases += al.t_alnend - al.t_alnstart
-            stats.events += len(aln.tdiffs)
-            tlabel = f"{al.t_id}:{al.t_alnstart}-{al.t_alnend}" \
-                + ("-" if al.reverse else "+")
-            rlabel = al.r_id
-            if cfg.fullgenome:
-                rlabel += f":{al.r_alnstart}-{al.r_alnend}"
-            if freport is not None:
-                if len(qfasta) == 1 and not cfg.fullgenome:
-                    rlabel = ""
-                if stats.resumed_past < resume_skip:
-                    # --resume cursor: this alignment's rows are already
-                    # in the report from the interrupted run
-                    stats.resumed_past += 1
-                else:
-                    # both engines batch: the device path submits one
-                    # fused program per flush, the host path runs one
-                    # vectorized columnar analysis per flush — and both
-                    # leave a durable checkpoint per completed batch
-                    pending.append((aln, rlabel, tlabel, refseq))
-                    if len(pending) >= cfg.batch:
-                        flush_pending()
-            if build_msa_out:
-                if cfg.realign:
-                    q_seg = refseq_aln[aln.offset:
-                                       aln.offset + (al.r_alnend -
-                                                     al.r_alnstart)]
-                    re_pending.append((aln, tlabel, refseq, numalns,
-                                       q_seg))
-                    if len(re_pending) >= cfg.batch:
-                        flush_realign()
-                else:
-                    msa_add(aln, tlabel, refseq, numalns)
+            consume_aln(rec, aln, refseq, refseq_aln, numalns)
+        # end of input (or a drain break): extract and consume the
+        # buffered tail so its rows reach the report/MSA buffers the
+        # finally below drains (and the drain checkpoint covers them)
+        flush_extract()
     finally:
         # emit whatever the batch buffers hold — including when a later
-        # bad line raises, so earlier alignments' rows aren't dropped —
-        # then retire the host pipeline worker if this run owns it (a
-        # warm-serve run borrows the daemon's persistent worker and
-        # must leave it running for the next job; the drain above
-        # already joined every future this run submitted)
+        # bad line raises, so earlier alignments' rows aren't dropped:
+        # records buffered for batched extraction are extracted and
+        # consumed first (they preceded the failing line in input
+        # order), then the report/device buffers drain even if one of
+        # THOSE records fails extraction — then retire the host
+        # pipeline worker if this run owns it (a warm-serve run
+        # borrows the daemon's persistent worker and must leave it
+        # running for the next job; the drain above already joined
+        # every future this run submitted)
         try:
-            flush_pending(drain=True)
+            try:
+                flush_extract()
+            finally:
+                flush_pending(drain=True)
             obs.span_complete("input_loop", t_loop, lines=stats.lines,
                               alignments=stats.alignments)
         finally:

@@ -1460,8 +1460,9 @@ class Router:
         # fleet result cache (ISSUE 15): consult the shared cache dir
         # at the router's edge — a hit never reaches a member
         cache_key_hex = None
+        cache_family = None
         if self.cache is not None and not stream:
-            cache_key_hex, served = self._cache_lookup(
+            cache_key_hex, cache_family, served = self._cache_lookup(
                 frame, client, req.get("priority"), trace_id)
             if served is not None:
                 return served
@@ -1475,8 +1476,11 @@ class Router:
             # miss at the router: cache-AFFINITY placement — a member
             # whose private cache holds the key gets the job (its own
             # admission serves it), so the fleet never re-runs a job
-            # ANY member has already answered
-            order = self._cache_affinity(order, cache_key_hex)
+            # ANY member has already answered.  A member holding only
+            # the job's FAMILY (a near-repeat prefix) ranks next: its
+            # admission answers the job as a delta (ISSUE 17c)
+            order = self._cache_affinity(order, cache_key_hex,
+                                         cache_family)
         last_reject: dict | None = None
         for m in order:
             try:
@@ -1595,42 +1599,45 @@ class Router:
                          retry_after_s=2.0)
 
     def _cache_lookup(self, frame: dict, client: str, priority,
-                      trace_id) -> tuple[str | None, dict | None]:
-        """``(key, terminal-submit-response | None)``: derive the
-        content-addressed key from the cwd-absolutized argv and
+                      trace_id
+                      ) -> tuple[str | None, str | None, dict | None]:
+        """``(key, family, terminal-submit-response | None)``: derive
+        the content-addressed key from the cwd-absolutized argv and
         consult the router's shared cache dir.  A hit writes the
         verified output bytes to the job's own output paths and
         answers a terminal fleet job on the spot — zero members, zero
         queues, zero devices.  Any defect falls through to a normal
-        placement (the key, when derivable, still feeds affinity)."""
+        placement (the key and its delta FAMILY, when derivable,
+        still feed affinity)."""
         from pwasm_tpu.service.cache import (argv_stats_path,
                                              classify_argv,
-                                             derive_key,
+                                             derive_keys,
                                              serve_outputs,
                                              write_hit_stats)
         from pwasm_tpu.service.daemon import _absolutize_argv
         args = frame.get("args")
         if not isinstance(args, list) \
                 or not all(isinstance(a, str) for a in args):
-            return None, None
+            return None, None, None
         argv = list(args)
         cwd = frame.get("cwd")
         if isinstance(cwd, str) and os.path.isabs(cwd):
             argv = _absolutize_argv(argv, cwd)
         cls = classify_argv(argv)
-        key = derive_key(cls) if cls is not None else None
-        if key is None:
-            return None, None
+        derived = derive_keys(cls) if cls is not None else None
+        if derived is None:
+            return None, None, None
+        key, family = derived
         got = self.cache.get(key)
         if got is None:
-            return key, None
+            return key, family, None
         manifest, blobs = got
         try:
             if not serve_outputs(blobs, cls.output_paths):
-                return key, None
+                return key, family, None
         except OSError:
-            return key, None    # unwritable outputs: let a member
-            #                     produce the real diagnostic
+            return key, family, None   # unwritable outputs: let a
+            #                     member produce the real diagnostic
         stats = write_hit_stats(manifest, argv_stats_path(argv))
         with self._lock:
             self._next_id += 1
@@ -1666,27 +1673,35 @@ class Router:
         self.metrics["jobs"].inc(outcome="accepted")
         self.obs.event("cache_hit", job_id=fid,
                        trace_id=job.trace_id)
-        return key, protocol.ok(job_id=fid, trace_id=job.trace_id,
-                                member="cache", cache_hit=True,
-                                queue_depth=0)
+        return key, family, protocol.ok(
+            job_id=fid, trace_id=job.trace_id,
+            member="cache", cache_hit=True, queue_depth=0)
 
-    def _cache_affinity(self, order: list, key: str) -> list:
+    def _cache_affinity(self, order: list, key: str,
+                        family: str | None = None) -> list:
         """Reorder placement so the first member whose ``cache-probe``
-        answers hit=true goes first.  The probe is a placement HINT,
-        never worth stalling admission for: per-probe timeout is
-        short, the WHOLE pass is budgeted (~1s), a member that
-        answered enabled=false is skipped until it next rejoins
-        (``_member_down`` resets the verdict), and probe failures are
-        never death evidence."""
+        answers hit=true goes first; with an exact hit nowhere, the
+        first member answering family_hit=true (it holds a same-family
+        entry, so its admission can serve the job as a DELTA) fronts
+        instead — the router learns delta verdicts the same way it
+        learns exact ones.  The probe is a placement HINT, never worth
+        stalling admission for: per-probe timeout is short, the WHOLE
+        pass is budgeted (~1s), a member that answered enabled=false
+        is skipped until it next rejoins (``_member_down`` resets the
+        verdict), and probe failures are never death evidence."""
         deadline = time.monotonic() + 1.0
+        family_m = None
         for m in order:
             if m.cache_enabled is False:
                 continue
             if time.monotonic() >= deadline:
                 break            # a hint must not gate the submit
+            probe = {"cmd": "cache-probe", "key": key}
+            if family is not None:
+                probe["family"] = family
             try:
                 with ServiceClient(m.target, timeout=0.5) as c:
-                    r = c.request({"cmd": "cache-probe", "key": key})
+                    r = c.request(probe)
             except ServiceError:
                 continue
             if not r.get("ok"):
@@ -1694,6 +1709,11 @@ class Router:
             m.cache_enabled = bool(r.get("enabled"))
             if r.get("hit"):
                 return [m] + [x for x in order if x is not m]
+            if family_m is None and r.get("family_hit"):
+                family_m = m
+        if family_m is not None:
+            return [family_m] + [x for x in order
+                                 if x is not family_m]
         return order
 
     def _route_stream_frame(self, req: dict) -> dict:

@@ -114,6 +114,22 @@ def load_scale_policy(path: str) -> dict:
     return pol
 
 
+def warm_spawn_args(args) -> list:
+    """Spawn-argv policy for scaled members: a member joining a shared
+    result-cache dir gets ``--cache-prefetch=64`` appended (warm-spawn
+    replication — the hottest entries load BEFORE its socket appears,
+    so the capacity the scaler adds is fast for repeat traffic from
+    its first job).  An explicit ``--cache-prefetch`` in the policy
+    wins; cache-off members are left alone."""
+    out = list(args)
+    if any(a.startswith("--result-cache=") and not a.endswith("=off")
+           for a in out) \
+            and not any(a.startswith("--cache-prefetch")
+                        for a in out):
+        out.append("--cache-prefetch=64")
+    return out
+
+
 class FleetScaler:
     """The router's scaling loop body.  Single-threaded: only the
     router's health loop calls :meth:`tick`, so no locking of its own
@@ -216,8 +232,9 @@ class FleetScaler:
             r._say("scaler: no free socket name under "
                    f"{sdir}; not spawning")
             return
+        spawn_args = warm_spawn_args(self.policy["spawn"]["args"])
         argv = [sys.executable, "-m", "pwasm_tpu.cli", "serve",
-                f"--socket={sock}"] + self.policy["spawn"]["args"]
+                f"--socket={sock}"] + spawn_args
         try:
             proc = subprocess.Popen(
                 argv, stdout=subprocess.DEVNULL,

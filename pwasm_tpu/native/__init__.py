@@ -93,6 +93,19 @@ def get_lib():
         except OSError:
             return None
         lib.pw_extract.restype = ctypes.c_int
+        lib.pw_extract_batch.restype = ctypes.c_int
+        lib.pw_extract_batch.argtypes = [
+            ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_void_p,    # cs blob + offsets
+            ctypes.c_char_p, ctypes.c_void_p,    # cigar blob + offsets
+            ctypes.c_void_p, ctypes.c_void_p,    # ref ptrs + ref lens
+            ctypes.c_void_p,                     # params (n x 7 int32)
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,  # tseq
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,  # events
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,  # arena
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,  # gaps
+            ctypes.c_void_p, ctypes.c_void_p,    # sizes, err_info
+            ctypes.c_void_p]                     # done_out
         lib.pw_banded_gotoh.restype = ctypes.c_int32
         lib.pw_banded_gotoh_batch.restype = None
         lib.pw_consensus_vote.restype = None
@@ -346,6 +359,155 @@ def extract_native(rec, refseq_aln: bytes):
         (aln.rgaps if which == 0 else aln.tgaps).append(
             GapData(pos, length))
     return aln
+
+
+def extract_batch_native(recs, ref_alns):
+    """Batched native extraction: one ``pw_extract_batch`` crossing for
+    a whole flush of parsed records, mirroring ``pw_msa_add_batch``'s
+    stop-at-failing-item protocol.  ``ref_alns[i]`` is record *i*'s
+    alignment-orientation reference slice — items carry their own
+    reference pointer, so a flush may span queries.
+
+    Returns ``(alns, err)``: the PafAlignments for the leading items
+    that extracted cleanly, plus ``None`` or the PwasmError the FIRST
+    failing item raises (the caller consumes ``alns`` — their rows land
+    exactly as per-item mode would emit them — then raises ``err``).
+    ``(None, None)`` when the native library is unavailable.  Per-item
+    soft-clip warnings replay in input order at the flush boundary, so
+    output files stay byte-identical to the per-item path (stderr is
+    ordering-equivalent, same contract as NativeMsa.add_batch)."""
+    from pwasm_tpu.core import events as E
+    from pwasm_tpu.core.errors import PwasmError
+    from pwasm_tpu.core.events import DiffEvent, GapData, PafAlignment
+
+    lib = get_lib()
+    if lib is None:
+        return None, None
+    err = None
+    n = len(recs)
+    for i, rec in enumerate(recs):
+        try:
+            E.validate_coords(rec.alninfo, rec.line)
+            if not rec.cigar:
+                raise PwasmError(E.CIGAR_ERROR.format(rec.line, 0))
+            if rec.cs is None:
+                raise PwasmError(E.CS_ERROR.format(rec.line, 0))
+        except PwasmError as e:
+            n, err = i, e
+            break
+    if n == 0:
+        return [], err
+    cs_bs = [recs[i].cs.encode() for i in range(n)]
+    cg_bs = [recs[i].cigar.encode() for i in range(n)]
+    cs_blob = b"\0".join(cs_bs) + b"\0"
+    cg_blob = b"\0".join(cg_bs) + b"\0"
+    cs_off = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(b) + 1 for b in cs_bs], out=cs_off[1:])
+    cg_off = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(b) + 1 for b in cg_bs], out=cg_off[1:])
+    refs_keep = [bytes(r) for r in ref_alns[:n]]
+    refs = (ctypes.c_char_p * n)(*refs_keep)
+    ref_lens = np.asarray([len(r) for r in refs_keep], dtype=np.int32)
+    params = np.zeros((n, 7), dtype=np.int32)
+    offs, effs = [], []
+    for i in range(n):
+        al = recs[i].alninfo
+        off = al.r_alnstart
+        if al.reverse:
+            off = al.r_len - al.r_alnend
+        offs.append(off)
+        effs.append(al.t_alnend - al.t_alnstart)
+        params[i] = (off, int(al.reverse), al.r_len, al.t_alnstart,
+                     al.t_alnend, al.r_alnstart, al.r_alnend)
+    tseq_cap = sum(effs) + 16 * n
+    ev_cap = sum(EV_FIELDS * (len(b) + 4) for b in cs_bs)
+    arena_cap = sum(4 * (len(b) + 64) for b in cs_bs)
+    gap_cap = sum(3 * (len(b) + 4) for b in cg_bs)
+    sizes = np.zeros(5 * n, dtype=np.int32)
+    err_info = np.zeros(2, dtype=np.int32)
+    done = np.zeros(1, dtype=np.int64)
+    for _ in range(3):
+        tseq_buf = np.empty(tseq_cap, dtype=np.uint8)
+        ev_buf = np.empty(ev_cap, dtype=np.int32)
+        arena = np.empty(arena_cap, dtype=np.uint8)
+        gaps_buf = np.empty(gap_cap, dtype=np.int32)
+        tq_off = np.zeros(n + 1, dtype=np.int64)
+        ev_off = np.zeros(n + 1, dtype=np.int64)
+        ar_off = np.zeros(n + 1, dtype=np.int64)
+        gp_off = np.zeros(n + 1, dtype=np.int64)
+        rc = lib.pw_extract_batch(
+            n, cs_blob, cs_off.ctypes.data_as(ctypes.c_void_p),
+            cg_blob, cg_off.ctypes.data_as(ctypes.c_void_p),
+            ctypes.cast(refs, ctypes.c_void_p),
+            ref_lens.ctypes.data_as(ctypes.c_void_p),
+            params.ctypes.data_as(ctypes.c_void_p),
+            tseq_buf.ctypes.data_as(ctypes.c_void_p), tseq_cap,
+            tq_off.ctypes.data_as(ctypes.c_void_p),
+            ev_buf.ctypes.data_as(ctypes.c_void_p), ev_cap,
+            ev_off.ctypes.data_as(ctypes.c_void_p),
+            arena.ctypes.data_as(ctypes.c_void_p), arena_cap,
+            ar_off.ctypes.data_as(ctypes.c_void_p),
+            gaps_buf.ctypes.data_as(ctypes.c_void_p), gap_cap,
+            gp_off.ctypes.data_as(ctypes.c_void_p),
+            sizes.ctypes.data_as(ctypes.c_void_p),
+            err_info.ctypes.data_as(ctypes.c_void_p),
+            done.ctypes.data_as(ctypes.c_void_p))
+        if rc == 100:  # grow all buffers and retry the whole flush
+            tseq_cap *= 4
+            ev_cap *= 4
+            arena_cap *= 4
+            gap_cap *= 4
+            continue
+        break
+    else:
+        raise PwasmError("native extraction buffers exhausted\n")
+    n_done = int(done[0])
+    evt_map = "SID"
+    ab = arena.tobytes()
+    alns = []
+    for i in range(n_done):
+        rec = recs[i]
+        al = rec.alninfo
+        sz = sizes[5 * i:5 * i + 5]
+        for _ in range(int(sz[4])):
+            print(f"{E.SOFTCLIP_WARNING}\n{rec.line}", file=sys.stderr)
+        aln = PafAlignment(alninfo=al, seqname=al.t_id,
+                           reverse=al.reverse, edist=rec.edist,
+                           alnscore=rec.alnscore)
+        aln.offset = offs[i]
+        aln.seqlen = effs[i]
+        tq = int(tq_off[i])
+        aln.tseq = tseq_buf[tq:tq + int(sz[0])].tobytes()
+        n_ev = int(sz[1])
+        ev = int(ev_off[i])
+        rows = ev_buf[ev:ev + n_ev * EV_FIELDS] \
+            .reshape(n_ev, EV_FIELDS).tolist()
+        base = int(ar_off[i])  # arena slots are item-relative
+        tdiffs = aln.tdiffs
+        for (f0, f1, f2, f3, f4, f5, f6, f7, f8, f9) in rows:
+            tdiffs.append(DiffEvent(
+                evt=evt_map[f0], evtlen=f3,
+                evtbases=ab[base + f4:base + f4 + f5],
+                evtsub=ab[base + f6:base + f6 + f7],
+                rloc=f1, tloc=f2, tctx=ab[base + f8:base + f8 + f9]))
+        n_gap = int(sz[3])
+        g0 = int(gp_off[i])
+        for which, pos, length in \
+                gaps_buf[g0:g0 + n_gap * 3].reshape(n_gap, 3).tolist():
+            (aln.rgaps if which == 0 else aln.tgaps).append(
+                GapData(pos, length))
+        alns.append(aln)
+    if n_done < n and rc != 0:
+        # the item the C side stopped on wins over any later
+        # validation failure: translate to the exact per-item message
+        frec = recs[n_done]
+        try:
+            _raise_native_error(rc, err_info,
+                                sizes[5 * n_done:5 * n_done + 5],
+                                frec, ref_alns[n_done])
+        except PwasmError as e:
+            err = e
+    return alns, err
 
 
 def banded_gotoh_batch(q_codes: np.ndarray, ts_codes: np.ndarray,

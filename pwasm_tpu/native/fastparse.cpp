@@ -325,6 +325,67 @@ int pw_extract(const char* cs, const char* cigar,
 }
 #undef FAIL
 
+// Batched extraction (ROADMAP item 5): ONE ffi crossing extracts a
+// whole flush of alignments — the per-alignment ctypes marshalling
+// around pw_extract was the last unbatched in-loop host term.  Inputs
+// arrive as NUL-separated blobs + int64 start offsets (cs/cigar), an
+// array of per-item query pointers (items need not share one query),
+// and a 7-int32 param row per item (offset, reverse, r_len,
+// t_alnstart, t_alnend, r_alnstart, r_alnend).  Outputs pack
+// back-to-back into the shared buffers with int64 offset arrays
+// (tseq/arena in bytes, ev/gaps in int32 slots); sizes_out holds each
+// item's 5-field pw_extract out_sizes row.  Items extract strictly IN
+// ORDER and the call stops at the first failure, exactly like
+// pw_msa_add_batch: on any non-zero code *done_out is the count of
+// items fully extracted before the failing one and err_info carries
+// that item's details (ERR_GROW included — the caller re-marshals
+// with larger buffers and retries the whole flush).
+int pw_extract_batch(int64_t n,
+                     const char* cs_blob, const int64_t* cs_off,
+                     const char* cigar_blob, const int64_t* cigar_off,
+                     const uint8_t* const* refs, const int32_t* ref_lens,
+                     const int32_t* params,
+                     uint8_t* tseq_out, int64_t tseq_cap,
+                     int64_t* tseq_off_out,
+                     int32_t* ev_out, int64_t ev_cap,
+                     int64_t* ev_off_out,
+                     uint8_t* arena_out, int64_t arena_cap,
+                     int64_t* arena_off_out,
+                     int32_t* gaps_out, int64_t gap_cap,
+                     int64_t* gap_off_out,
+                     int32_t* sizes_out, int32_t* err_info,
+                     int64_t* done_out) {
+  *done_out = 0;
+  tseq_off_out[0] = 0;
+  ev_off_out[0] = 0;
+  arena_off_out[0] = 0;
+  gap_off_out[0] = 0;
+  const int64_t cap32 = 0x7fffffff;
+  for (int64_t i = 0; i < n; ++i) {
+    const int32_t* p = params + 7 * i;
+    int64_t tq = tseq_off_out[i], ev = ev_off_out[i],
+            ar = arena_off_out[i], gp = gap_off_out[i];
+    int64_t tc = tseq_cap - tq, ec = ev_cap - ev, ac = arena_cap - ar,
+            gc = gap_cap - gp;
+    if (tc <= 0 || ec <= 0 || ac <= 0 || gc <= 0) return ERR_GROW;
+    int rc = pw_extract(
+        cs_blob + cs_off[i], cigar_blob + cigar_off[i], refs[i],
+        ref_lens[i], p[0], p[1], p[2], p[3], p[4], p[5], p[6],
+        tseq_out + tq, (int32_t)(tc > cap32 ? cap32 : tc),
+        ev_out + ev, (int32_t)(ec > cap32 ? cap32 : ec),
+        arena_out + ar, (int32_t)(ac > cap32 ? cap32 : ac),
+        gaps_out + gp, (int32_t)(gc > cap32 ? cap32 : gc),
+        sizes_out + 5 * i, err_info);
+    if (rc != 0) return rc;
+    tseq_off_out[i + 1] = tq + sizes_out[5 * i];
+    ev_off_out[i + 1] = ev + (int64_t)EV_FIELDS * sizes_out[5 * i + 1];
+    arena_off_out[i + 1] = ar + sizes_out[5 * i + 2];
+    gap_off_out[i + 1] = gp + (int64_t)3 * sizes_out[5 * i + 3];
+    ++*done_out;
+  }
+  return OK;
+}
+
 // Single-core banded Gotoh over int8 base codes — the honest CPU baseline
 // for the TPU banded-DP benchmarks (same recurrence as
 // pwasm_tpu/ops/banded_dp.py, no Ix<->Iy adjacency).  Returns the global

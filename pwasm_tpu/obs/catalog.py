@@ -345,7 +345,15 @@ def build_cache_metrics(reg: MetricsRegistry) -> dict:
         "Bytes of result-cache entries currently on disk")
     m["hit_ratio"] = reg.gauge(
         "pwasm_cache_hit_ratio",
-        "Cumulative result-cache hit ratio (hits / lookups)")
+        "Cumulative result-cache hit ratio ((hits + fractional delta "
+        "serves) / lookups) — a delta serve counts records-served / "
+        "records-total of a hit, so incremental traffic moves the "
+        "ratio truthfully instead of reading as pure misses")
+    m["delta_hits"] = reg.counter(
+        "pwasm_cache_delta_hits_total",
+        "Near-miss DELTA serves (ISSUE 17): jobs whose exact lookup "
+        "missed but whose cached same-family prefix (or m2m target "
+        "subset) was spliced in, recomputing only the tail")
     return m
 
 

@@ -51,7 +51,27 @@ The PR 9 spool discipline: per entry one CRC'd manifest
 A ``kill -9`` mid-insert leaves blobs without a manifest — orphans a
 startup :meth:`CacheStore.sweep` removes; a manifest whose blob rotted
 (CRC mismatch) is a MISS and the entry is dropped, never a corrupt
-serve.  Eviction is LRU (manifest mtime = last access) under
+serve.
+
+Delta entries (incremental compute)
+-----------------------------------
+
+An appended assembly voids the exact key but not the work: report-only
+entries additionally record a **delta index** — one 16-hex digest per
+input line (``<key>.dx`` sidecar, CRC'd through the manifest ``delta``
+block) plus a **family key** (:func:`family_key`: the exact key minus
+the input digest).  A near-miss in the same family whose cached input
+is a per-line PREFIX of the new input (:meth:`CacheStore.delta_lookup`)
+serves the cached report bytes and re-enters the run as a ``--resume``
+over them, recomputing only the last cached record and the appended
+tail.  ``--many2many`` entries record per-target ``(digest, score)``
+values in the manifest (``m2m`` block), so a superset target set reuses
+every cached score and dispatches only the delta targets
+(:meth:`CacheStore.m2m_scan`).  Every delta serve reads through the
+same CRC discipline as an exact hit — a rotted index or blob is a
+plain miss, never a corrupt splice — and is accounted FRACTIONALLY
+(records served / records total, :meth:`CacheStore.note_delta`), so
+``pwasm_cache_hit_ratio`` stays truthful about work actually saved.  Eviction is LRU (manifest mtime = last access) under
 ``--result-cache-max-bytes`` plus optional TTL; all byte accounting
 runs through one lock-guarded :class:`ByteLedger` shared with the
 daemon's result spool, so ``pwasm_cache_bytes`` and
@@ -277,6 +297,29 @@ def section_key(query_digest: str, targets_digest: str,
         doc, sort_keys=True, separators=(",", ":")).encode()).hexdigest()
 
 
+def family_key(ref_digest: str, flag_items, output_kinds) -> str:
+    """The delta FAMILY key: sha256 over the exact key's document
+    minus the input digest.  Two runs in one family differ only by
+    input CONTENT — exactly the population where a prefix-preserving
+    append can be served as a delta instead of a cold run."""
+    doc = {"v": CACHE_KEY_VERSION, "family": 1, "ref": ref_digest,
+           "flags": [list(fi) for fi in flag_items],
+           "outputs": list(output_kinds)}
+    return hashlib.sha256(json.dumps(
+        doc, sort_keys=True, separators=(",", ":")).encode()).hexdigest()
+
+
+def m2m_family_key(query_digest: str, band: int) -> str:
+    """The ``--many2many`` delta family: one query record under one
+    band, whatever the target set — superset reuse matches per-target
+    digests inside the family, so the band stays keyed (a different
+    band is different scores, never spliced)."""
+    doc = {"v": CACHE_KEY_VERSION, "m2m_family": 1,
+           "q": query_digest, "band": int(band)}
+    return hashlib.sha256(json.dumps(
+        doc, sort_keys=True, separators=(",", ":")).encode()).hexdigest()
+
+
 def derive_key(cls: Classified,
                input_digest: str | None = None) -> str | None:
     """Digest the classified job's inputs and derive its cache key;
@@ -287,6 +330,15 @@ def derive_key(cls: Classified,
     (``stream.pafstream.BlockLineReader``), and the insert side uses
     it both to avoid a second read and to PROVE the input did not
     change between keying and running (key mismatch = no insert)."""
+    derived = derive_keys(cls, input_digest=input_digest)
+    return None if derived is None else derived[0]
+
+
+def derive_keys(cls: Classified,
+                input_digest: str | None = None
+                ) -> tuple[str, str] | None:
+    """:func:`derive_key` plus the entry's delta FAMILY key, from one
+    digest pass: ``(exact_key, family)`` or ``None``."""
     try:
         ref_d = fasta_digest(cls.ref_path)
         input_d = input_digest if input_digest is not None else (
@@ -299,7 +351,51 @@ def derive_key(cls: Classified,
             flag_items.sort()
     except OSError:
         return None
-    return cache_key(ref_d, input_d, flag_items, cls.output_kinds)
+    return (cache_key(ref_d, input_d, flag_items, cls.output_kinds),
+            family_key(ref_d, flag_items, cls.output_kinds))
+
+
+# a delta index over a multi-million-line assembly would cost more to
+# scan than the delta saves; entries past the cap still serve exact
+# hits, they just never delta-match
+DELTA_MAX_LINES = 100_000
+
+
+def delta_eligible(cls: Classified) -> bool:
+    """True when a near-miss for this job may be served as a delta:
+    report-only output (the ``--resume`` fast path that makes the
+    serve cheap is parse-only — MSA/summary builds need the prefix
+    records re-inserted) and strict per-line replay semantics (no
+    ``--skip-bad-lines``: the fast path does not re-validate the
+    served prefix)."""
+    return (not cls.many2many
+            and cls.output_kinds == ("o",)
+            and all(k != "skip-bad-lines" for k, _ in cls.flag_items))
+
+
+def paf_line_digests(path: str, max_lines: int = DELTA_MAX_LINES
+                     ) -> tuple[list[str] | None, str | None]:
+    """The delta index column for one PAF input: one 16-hex sha256
+    prefix per line (terminator-stripped, so a missing final newline
+    cannot split a prefix match), plus the whole-file sha256 from the
+    same pass (the caller proves the file it indexed is the file that
+    ran).  ``(None, digest)`` when the file exceeds ``max_lines``;
+    ``(None, None)`` when unreadable."""
+    out: list[str] | None = []
+    h = hashlib.sha256()
+    try:
+        with open(path, "rb") as f:
+            for raw in f:
+                h.update(raw)
+                if out is not None:
+                    if len(out) >= max_lines:
+                        out = None
+                    else:
+                        out.append(hashlib.sha256(
+                            raw.rstrip(b"\r\n")).hexdigest()[:16])
+    except OSError:
+        return None, None
+    return out, h.hexdigest()
 
 
 # ---------------------------------------------------------------------------
@@ -372,6 +468,11 @@ class CacheStore:
         self.misses = 0
         self.insertions = 0
         self.evictions = 0
+        self.delta_hits = 0
+        self.delta_records_served = 0
+        self.delta_records_total = 0
+        self._delta_fraction = 0.0   # sum of served/total per delta
+        self.prefetched = 0
         self._recounted_at = 0.0     # monotonic, last disk recount
         os.makedirs(root, exist_ok=True)
         self.sweep()
@@ -423,6 +524,10 @@ class CacheStore:
                 os.unlink(self._blob_path(key, kind))
             except OSError:
                 pass
+        try:            # the delta-index sidecar dies with its entry
+            os.unlink(self._blob_path(key, "dx"))
+        except OSError:
+            pass
 
     def _recount_locked(self) -> None:
         """Refresh the ledger's cache account from what is ACTUALLY on
@@ -455,7 +560,11 @@ class CacheStore:
         ratio = m.get("hit_ratio")
         if ratio is not None:
             total = self.hits + self.misses
-            ratio.set(round(self.hits / total, 6) if total else 0.0)
+            # delta serves count FRACTIONALLY (records served /
+            # records total); their exact lookups already sit in the
+            # miss denominator
+            ratio.set(round((self.hits + self._delta_fraction)
+                            / total, 6) if total else 0.0)
 
     def _count(self, what: str) -> None:
         setattr(self, what, getattr(self, what) + 1)
@@ -522,6 +631,23 @@ class CacheStore:
             return True
         return time.time() - created > self.ttl_s
 
+    def _read_blobs_locked(self, key: str,
+                           manifest: dict) -> dict | None:
+        """Read + CRC-verify every blob of one entry; None on any
+        defect (the caller owns the drop/accounting policy)."""
+        blobs: dict[str, bytes] = {}
+        for kind, meta in manifest["outputs"].items():
+            try:
+                with open(self._blob_path(key, kind), "rb") as f:
+                    data = f.read()
+                if len(data) != int(meta["bytes"]) \
+                        or zlib.crc32(data) != int(meta["crc"]):
+                    raise ValueError("blob CRC mismatch")
+            except (OSError, ValueError, KeyError, TypeError):
+                return None
+            blobs[kind] = data
+        return blobs
+
     def get(self, key: str) -> tuple[dict, dict] | None:
         """Serve one entry: ``(manifest, {kind: bytes})`` with every
         blob CRC-verified, or ``None`` (counted as a miss).  Any
@@ -538,24 +664,16 @@ class CacheStore:
                 self._count("evictions")
                 self._count("misses")
                 return None
-            blobs: dict[str, bytes] = {}
-            for kind, meta in manifest["outputs"].items():
-                try:
-                    with open(self._blob_path(key, kind), "rb") as f:
-                        data = f.read()
-                    if len(data) != int(meta["bytes"]) \
-                            or zlib.crc32(data) != int(meta["crc"]):
-                        raise ValueError("blob CRC mismatch")
-                except (OSError, ValueError, KeyError, TypeError):
-                    # rot destroys the entry: counted as an EVICTION
-                    # too (the metric's documented causes include CRC
-                    # rot — churn must be visible to cache_thrash)
-                    self._drop_locked(key, manifest)
-                    self._recount_locked()
-                    self._count("evictions")
-                    self._count("misses")
-                    return None
-                blobs[kind] = data
+            blobs = self._read_blobs_locked(key, manifest)
+            if blobs is None:
+                # rot destroys the entry: counted as an EVICTION
+                # too (the metric's documented causes include CRC
+                # rot — churn must be visible to cache_thrash)
+                self._drop_locked(key, manifest)
+                self._recount_locked()
+                self._count("evictions")
+                self._count("misses")
+                return None
             try:
                 # LRU clock: manifest mtime = last access
                 os.utime(self._manifest_path(key))
@@ -564,18 +682,197 @@ class CacheStore:
             self._count("hits")
             return manifest, blobs
 
+    def delta_lookup(self, family: str, digests: list[str],
+                     allow_equal: bool = False
+                     ) -> tuple[str, dict, dict, int] | None:
+        """Find the best delta candidate for a near-miss: a CRC-whole
+        entry in the same FAMILY whose recorded input is a (strict,
+        unless ``allow_equal``) per-line prefix of the new input's
+        ``digests``.  Longest prefix wins — it leaves the smallest
+        tail to recompute.  Returns ``(key, manifest, blobs,
+        cached_lines)`` with every blob CRC-verified exactly like
+        :meth:`get`, or ``None``.  A rotted delta INDEX skips the
+        candidate (the entry still serves exact hits); rotted BLOBS
+        drop the entry like a hit-path read would — either way the
+        answer degrades to a miss, never a corrupt splice.  Does not
+        count hits/misses itself: the caller's exact :meth:`get`
+        already counted the miss, and :meth:`note_delta` records the
+        fractional outcome."""
+        if not digests:
+            return None
+        blob = "".join(digests).encode("ascii")
+        with self._lock:
+            try:
+                names = os.listdir(self.root)
+            except OSError:
+                return None
+            rows = []
+            for n in sorted(names):
+                if not n.endswith(".json"):
+                    continue
+                key = n[:-5]
+                m = self._read_manifest(key)
+                if m is None or self._expired(m):
+                    continue
+                d = m.get("delta")
+                if not isinstance(d, dict) \
+                        or d.get("family") != family:
+                    continue
+                try:
+                    nl = int(d["lines"])
+                    dxb, dxc = int(d["bytes"]), int(d["crc"])
+                except (KeyError, ValueError, TypeError):
+                    continue
+                if nl < 2 or nl > len(digests) \
+                        or (nl == len(digests) and not allow_equal):
+                    continue
+                rows.append((nl, key, m, dxb, dxc))
+            rows.sort(key=lambda r: r[0], reverse=True)
+            for nl, key, m, dxb, dxc in rows:
+                try:
+                    with open(self._blob_path(key, "dx"),
+                              "rb") as f:
+                        dx = f.read()
+                    if len(dx) != dxb or zlib.crc32(dx) != dxc:
+                        raise ValueError("delta index CRC mismatch")
+                except (OSError, ValueError):
+                    continue
+                if dx != blob[:len(dx)]:
+                    continue    # same family, not a prefix append
+                blobs = self._read_blobs_locked(key, m)
+                if blobs is None:
+                    self._drop_locked(key, m)
+                    self._recount_locked()
+                    self._count("evictions")
+                    continue
+                try:
+                    os.utime(self._manifest_path(key))
+                except OSError:
+                    pass
+                return key, m, blobs, nl
+        return None
+
+    def note_delta(self, served: int, total: int) -> None:
+        """Record one completed delta serve FRACTIONALLY: a run that
+        served 90 cached records of 100 moves the hit ratio by 0.9 of
+        a hit, not 0 (the exact lookup already counted its miss) and
+        not 1 — ``cache_thrash`` and the ``top`` CACHE row stay
+        meaningful under delta traffic."""
+        with self._lock:
+            self.delta_hits += 1
+            self.delta_records_served += max(0, int(served))
+            self.delta_records_total += max(0, int(total))
+            if total > 0:
+                self._delta_fraction += min(
+                    1.0, max(0, int(served)) / int(total))
+            c = self.metrics.get("delta_hits")
+            if c is not None:
+                c.inc()
+            self._publish()
+
+    def m2m_scan(self) -> list[tuple[str, dict]]:
+        """All CRC-valid, unexpired entries carrying an ``m2m`` score
+        table — the superset-reuse candidate pool, gathered in ONE
+        directory pass per ``--many2many`` job (the caller indexes by
+        family)."""
+        out: list[tuple[str, dict]] = []
+        with self._lock:
+            try:
+                names = os.listdir(self.root)
+            except OSError:
+                return out
+            for n in sorted(names):
+                if not n.endswith(".json"):
+                    continue
+                m = self._read_manifest(n[:-5])
+                if m is None or self._expired(m):
+                    continue
+                if isinstance(m.get("m2m"), dict):
+                    out.append((n[:-5], m))
+        return out
+
+    def contains_family(self, family: str) -> bool:
+        """Cheap fleet-affinity probe (the ``cache-probe`` verb's
+        ``family`` field): does any CRC-valid, unexpired entry carry
+        this delta (report prefix) or m2m (target subset) family?
+        Manifest reads only — the member that answers true can likely
+        serve the job as a DELTA at its own admission."""
+        with self._lock:
+            try:
+                names = os.listdir(self.root)
+            except OSError:
+                return False
+            for n in names:
+                if not n.endswith(".json"):
+                    continue
+                m = self._read_manifest(n[:-5])
+                if m is None or self._expired(m):
+                    continue
+                d = m.get("delta")
+                if isinstance(d, dict) and d.get("family") == family:
+                    return True
+                d = m.get("m2m")
+                if isinstance(d, dict) and d.get("family") == family:
+                    return True
+        return False
+
+    def prefetch(self, max_entries: int) -> int:
+        """Warm-spawn cache replication: page the HOTTEST entries
+        (manifest mtime = last access, newest first) through a full
+        CRC-verified read BEFORE the member takes traffic, so a
+        scaler-spawned member's first repeat job serves from a warm
+        page cache like a long-lived sibling's.  Non-destructive —
+        a rotted entry is skipped (the serving path owns drops) —
+        and locked per entry, so a concurrent admission lookup never
+        waits behind the whole warm pass.  Returns entries warmed."""
+        rows = []
+        try:
+            for n in os.listdir(self.root):
+                if not n.endswith(".json"):
+                    continue
+                try:
+                    rows.append((os.path.getmtime(
+                        os.path.join(self.root, n)), n[:-5]))
+                except OSError:
+                    pass
+        except OSError:
+            return 0
+        rows.sort(reverse=True)
+        warmed = 0
+        for _t, key in rows[:max(0, int(max_entries))]:
+            with self._lock:
+                m = self._read_manifest(key)
+                if m is None or self._expired(m):
+                    continue
+                if self._read_blobs_locked(key, m) is not None:
+                    warmed += 1
+        with self._lock:
+            self.prefetched += warmed
+            self._publish()
+        return warmed
+
     def insert(self, key: str, outputs: dict[str, bytes],
-               stats: dict | None = None) -> bool:
+               stats: dict | None = None,
+               delta: dict | None = None,
+               extra: dict | None = None) -> bool:
         """Store one entry: blobs first, CRC'd manifest LAST (the
         commit point — a crash at any instant leaves either a whole
         entry or orphan blobs the next sweep removes), then enforce
         the byte budget.  Returns False on any write failure (a full
-        disk costs the cache, never the job)."""
+        disk costs the cache, never the job).
+
+        ``delta`` (``{"family", "lines", "dx": bytes}``) attaches the
+        per-line delta index: the ``dx`` sidecar is written with the
+        blobs — BEFORE the manifest commit, so a crash can only leave
+        a sidecar orphan the sweep reaps, never a manifest pointing at
+        a missing index.  ``extra`` merges caller facts (the ``m2m``
+        per-target score table) into the CRC'd manifest."""
         from pwasm_tpu.utils.fsio import (payload_crc,
                                           write_durable_bytes,
                                           write_durable_text)
         meta: dict[str, dict] = {}
         total = 0
+        wrote_dx = False
         with self._lock:
             try:
                 for kind, data in outputs.items():
@@ -588,13 +885,26 @@ class CacheStore:
                             "created": round(time.time(), 3),
                             "outputs": meta, "stats": stats,
                             "bytes": total}
+                if extra:
+                    manifest.update(extra)
+                if delta is not None:
+                    dx = delta["dx"]
+                    write_durable_bytes(self._blob_path(key, "dx"),
+                                        dx)
+                    wrote_dx = True
+                    total += len(dx)
+                    manifest["bytes"] = total
+                    manifest["delta"] = {
+                        "family": delta["family"],
+                        "lines": int(delta["lines"]),
+                        "bytes": len(dx), "crc": zlib.crc32(dx)}
                 manifest["crc"] = payload_crc(
                     {k: v for k, v in manifest.items() if k != "crc"})
                 write_durable_text(self._manifest_path(key),
                                    json.dumps(manifest, sort_keys=True,
                                               separators=(",", ":")))
             except OSError:
-                for kind in meta:
+                for kind in list(meta) + (["dx"] if wrote_dx else []):
                     try:
                         os.unlink(self._blob_path(key, kind))
                     except OSError:
@@ -680,8 +990,13 @@ class CacheStore:
                 "misses": self.misses,
                 "insertions": self.insertions,
                 "evictions": self.evictions,
+                "delta_hits": self.delta_hits,
+                "delta_records_served": self.delta_records_served,
+                "delta_records_total": self.delta_records_total,
+                "prefetched": self.prefetched,
                 "bytes": self.ledger.value(_ACCOUNT),
-                "hit_ratio": round(self.hits / total, 6)
+                "hit_ratio": round(
+                    (self.hits + self._delta_fraction) / total, 6)
                 if total else 0.0,
             }
 
@@ -702,16 +1017,40 @@ def insert_from_paths(store: CacheStore, key: str, cls: Classified,
     under the OLD key would poison every future hit — skipping is
     always safe.  Best-effort: False on drift or any read failure."""
     try:
-        if derive_key(cls, input_digest=input_digest) != key:
+        delta = None
+        if delta_eligible(cls):
+            # the per-line delta index, from one extra input pass —
+            # attached only when that pass reads the SAME bytes the
+            # run keyed (whole-file digest match), so a mid-flight
+            # rewrite can never bind a stale index to fresh outputs
+            digests, fdig = paf_line_digests(cls.input_path)
+            if digests is not None and len(digests) >= 2 \
+                    and input_digest in (None, fdig):
+                if input_digest is None:
+                    input_digest = fdig
+                delta = {"lines": len(digests),
+                         "dx": "".join(digests).encode("ascii")}
+        derived = derive_keys(cls, input_digest=input_digest)
+        if derived is None or derived[0] != key:
             return False
+        if delta is not None:
+            delta["family"] = derived[1]
         blobs = {}
         for kind, path in cls.output_paths.items():
             with open(path, "rb") as f:
                 blobs[kind] = f.read()
     except OSError:
         return False
-    return store.insert(
-        key, blobs, stats=stats if isinstance(stats, dict) else None)
+    if isinstance(stats, dict):
+        # the delta markers describe THIS run's serve, not the entry:
+        # a future exact hit replaying them would claim a delta that
+        # never happened
+        stats = {k: v for k, v in stats.items()
+                 if k not in ("cache_delta", "cache_records_served",
+                              "cache_records_total")}
+    else:
+        stats = None
+    return store.insert(key, blobs, stats=stats, delta=delta)
 
 
 def serve_outputs(blobs: dict[str, bytes],

@@ -73,6 +73,7 @@ _SERVE_USAGE = """Usage:
                  [--result-ttl-s=S] [--max-results=N]
                  [--result-cache=DIR|off]
                  [--result-cache-max-bytes=N]
+                 [--cache-prefetch[=N]]
                  [--canary-interval=S] [--slo-rules=FILE|off]
 
    --socket=PATH        unix socket to listen on (required)
@@ -210,6 +211,12 @@ _SERVE_USAGE = """Usage:
                         entries past N total bytes (the cache_thrash
                         SLO rule pages when a mis-sized budget makes
                         eviction keep pace with insertion)
+   --cache-prefetch[=N] before taking traffic, page the N hottest
+                        (most-recently-served) --result-cache entries
+                        through a CRC-verified read (default N: 64) —
+                        a scaler-spawned member joining a shared
+                        cache dir serves its first repeat job from a
+                        warm cache, like a long-lived sibling
    --canary-interval=S  run a synthetic canary probe every S seconds
                         (service/canary.py): the deterministic warmup
                         corpus through a free lane's normal serving
@@ -392,7 +399,8 @@ class Daemon:
                  slo_rules=None,
                  result_cache: str | None = None,
                  result_cache_max_bytes: int | None = None,
-                 result_cache_ttl_s: float | None = None):
+                 result_cache_ttl_s: float | None = None,
+                 cache_prefetch: int | None = None):
         self.socket_path = socket_path
         # fleet transport (docs/FLEET.md): an optional TCP listener
         # joining the unix socket — same protocol, token-based client
@@ -528,6 +536,8 @@ class Daemon:
                           "caching disabled")
         self.warm.result_cache_dir = result_cache \
             if self.cache is not None else None
+        self.cache_prefetch = cache_prefetch   # warm N hottest shared
+        #   entries before the socket exists (serve --cache-prefetch)
         self._cache_evict_at = 0.0    # next TTL/budget sweep (mono)
         # foldable counters only: the live run instruments (attempt
         # histogram, run breaker gauge) belong to each run's own obs
@@ -611,6 +621,17 @@ class Daemon:
         if self._runner is None:
             from pwasm_tpu.cli import run as cli_run
             self._runner = cli_run
+        if self.cache is not None and self.cache_prefetch:
+            # warm-spawn cache replication (ISSUE 17c): page the
+            # hottest shared-dir entries through a CRC-verified read
+            # BEFORE the socket exists — socket readiness then implies
+            # a warm cache, so a scaler-spawned member's first repeat
+            # job is an admission hit, not a cold-disk walk
+            warmed = self.cache.prefetch(self.cache_prefetch)
+            self._say(f"result-cache prefetch: warmed {warmed} "
+                      f"entr{'y' if warmed == 1 else 'ies'} from "
+                      f"{self.cache.root}")
+            self.obs.event("cache_prefetch", warmed=warmed)
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         try:
             if os.path.exists(self.socket_path):
@@ -1493,6 +1514,27 @@ class Daemon:
             job.drain.stderr = self.stderr
         job.errbuf = job.outbuf = None
         job.stats = self._read_job_stats(job)
+        if rc == 0 and job.delta is not None:
+            # the fractional hit lands at FINISH, not admission — a
+            # failed tail run must not count as served traffic
+            if self.cache is not None:
+                self.cache.note_delta(*job.delta)
+            if isinstance(job.stats, dict):
+                job.stats["cache_delta"] = True
+                job.stats["cache_records_served"] = job.delta[0]
+                job.stats["cache_records_total"] = job.delta[1]
+                if job.stats_path is not None \
+                        and not job.stats_injected:
+                    # the client's own --stats artifact must tell the
+                    # same truth the result frame does: the tail run
+                    # didn't know it was a delta, so stamp it here
+                    try:
+                        import json as _json
+                        with open(job.stats_path, "w") as f:
+                            _json.dump(job.stats, f, indent=1)
+                            f.write("\n")
+                    except OSError:
+                        pass
         if rc == 0:
             job.state = JOB_DONE
             self.stats.jobs_completed += 1
@@ -1681,6 +1723,7 @@ class Daemon:
         # path).  Streams bypass (their input is not a file); a miss
         # remembers the key so the finished job inserts its outputs.
         cache_row = None
+        delta_served = None
         if self.cache is not None and not stream:
             from pwasm_tpu.service.cache import classify_argv, \
                 derive_key
@@ -1703,16 +1746,28 @@ class Daemon:
                             argv, client, priority, trace_id,
                             manifest)
                 cache_row = (key, cls)
+                # exact miss (ISSUE 17a): a same-family entry whose
+                # input is a per-line PREFIX of ours serves its cached
+                # report bytes NOW and re-arms the job as a --resume
+                # over them — the worker recomputes only the last
+                # cached record and the appended tail.  The journal
+                # admit keeps the ORIGINAL argv: a crash-replay
+                # re-runs the job cold, which is always correct.
+                delta_served = self._admit_cache_delta(cls)
         base_argv = list(argv)     # what the journal records: the
         #   pre-injection argv (the injected stats tmp lives in a
         #   directory that dies with this process)
+        exec_argv = list(argv)
+        if delta_served is not None:
+            exec_argv.append("--resume")
         with self._lock:
             self._next_id += 1
-            job = Job(id=f"job-{self._next_id:04d}", argv=list(argv),
+            job = Job(id=f"job-{self._next_id:04d}", argv=exec_argv,
                       client=client, priority=priority,
                       trace_id=trace_id)
         job.cache = cache_row      # (key, classified) on a cacheable
         #   miss: _run_job inserts the finished outputs under it
+        job.delta = delta_served
         self._arm_job(job)
         if stream:
             from pwasm_tpu.stream.pafstream import StreamFeed
@@ -1740,6 +1795,18 @@ class Daemon:
                              argv=base_argv, client=client,
                              priority=priority, trace_id=trace_id,
                              **({"stream": True} if stream else {}))
+        if delta_served is not None:
+            # truthful journal shape: a delta job is NOT a pure hit —
+            # the cache_hit record carries the computed-vs-served
+            # split, and the start/finish records that follow show the
+            # real (tail-only) run
+            self._journal_append(REC_CACHE_HIT, job_id=job.id,
+                                 delta=True, served=delta_served[0],
+                                 total=delta_served[1])
+            self.obs.event("cache_delta", job_id=job.id,
+                           trace_id=job.trace_id,
+                           served=delta_served[0],
+                           total=delta_served[1])
         try:
             self.queue.submit(job)
         except (Draining, QueueFull):
@@ -1836,6 +1903,45 @@ class Daemon:
         #                          scraper's view must not go stale on
         #                          a daemon serving pure repeat traffic
         return job
+
+    def _admit_cache_delta(self, cls) -> tuple | None:
+        """Exact-miss admission (ISSUE 17a): find a cached same-family
+        entry whose input records are a strict per-line prefix of this
+        job's, write its CRC-verified report bytes to the job's output
+        path, and return ``(records served, records total)`` so the
+        caller re-arms the job with ``--resume`` — the worker's
+        header-scan resume then recomputes only the last cached record
+        plus the appended tail.  ``None`` = run cold (any rot,
+        unwritable output, or ineligible shape falls back silently:
+        delta is an optimization, never a correctness gate)."""
+        from pwasm_tpu.service.cache import (delta_eligible,
+                                             derive_keys,
+                                             paf_line_digests)
+        if cls is None or not delta_eligible(cls):
+            return None
+        digests, _fdig = paf_line_digests(cls.input_path)
+        if digests is None or len(digests) < 2:
+            return None
+        derived = derive_keys(cls)
+        if derived is None:
+            return None
+        hit = self.cache.delta_lookup(derived[1], digests)
+        if hit is None:
+            return None
+        _key, _manifest, blobs, nl = hit
+        report = cls.output_paths.get("o")
+        if report is None or "o" not in blobs:
+            return None
+        try:
+            with open(report, "wb") as f:
+                f.write(blobs["o"])
+        except OSError:
+            return None   # unwritable output: the real run below
+            #   reports the canonical diagnostic
+        from pwasm_tpu.cli import _unlink_checkpoint
+        _unlink_checkpoint(report)   # the served bytes ARE the resume
+        #   state — a stale ckpt must not hijack the header scan
+        return (max(0, nl - 1), len(digests))
 
     def _cache_insert(self, job: Job) -> None:
         """Store a cleanly finished job's output files under its
@@ -2143,10 +2249,17 @@ class Daemon:
             if not isinstance(key, str) or not key:
                 return protocol.err(protocol.ERR_BAD_REQUEST,
                                     "cache-probe needs a key field")
+            fam = req.get("family")
             return protocol.ok(
                 enabled=self.cache is not None,
                 hit=self.cache is not None
-                and self.cache.contains(key))
+                and self.cache.contains(key),
+                # delta affinity (ISSUE 17c): true when an entry of
+                # the job's FAMILY is held — this member could answer
+                # the near-repeat as an admission delta
+                family_hit=self.cache is not None
+                and isinstance(fam, str) and bool(fam)
+                and self.cache.contains_family(fam))
         if cmd == "logs":
             # the incident-query verb (ISSUE 14 satellite): filter
             # THIS daemon's --log-json (rotated .1 generation
@@ -2399,6 +2512,8 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
             opts[k] = v
         elif a == "--warmup":
             opts["warmup"] = "tpu"   # bare form: warm the device path
+        elif a == "--cache-prefetch":
+            opts["cache-prefetch"] = "64"   # bare form: default depth
         elif a in ("-h", "--help"):
             stderr.write(_SERVE_USAGE)
             return EXIT_USAGE
@@ -2480,6 +2595,15 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
         return EXIT_USAGE
     if result_cache == "off":
         result_cache = None
+    cache_prefetch = None
+    val = opts.pop("cache-prefetch", None)
+    if val is not None:
+        if val.isascii() and val.isdigit() and int(val) >= 1:
+            cache_prefetch = int(val)
+        else:
+            stderr.write(f"{_SERVE_USAGE}\nInvalid --cache-prefetch "
+                         f"value: {val}\n")
+            return EXIT_USAGE
     priority_lanes: tuple[str, ...] | None = None
     val = opts.pop("priority-lanes", None)
     if val is not None:
@@ -2587,7 +2711,8 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
                         slo_rules=slo_rules,
                         result_cache=result_cache,
                         result_cache_max_bytes=nums[
-                            "result-cache-max-bytes"])
+                            "result-cache-max-bytes"],
+                        cache_prefetch=cache_prefetch)
     except OSError:
         stderr.write(f"Cannot open file {log_json} for writing!\n")
         return EXIT_USAGE

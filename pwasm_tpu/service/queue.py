@@ -98,6 +98,11 @@ class Job:
     cache: object = field(default=None, repr=False)  # (key,
     #   classified) for a cacheable job that MISSED at admission —
     #   the finished outputs insert under it (service/cache.py)
+    delta: tuple | None = field(default=None, repr=False)  # (records
+    #   served, records total) when admission re-armed this job as a
+    #   --resume over a cached same-family input prefix (ISSUE 17):
+    #   finish notes the fractional hit and stamps the job's stats
+    #   with the truthful cache_delta counts
     submitted_s: float = field(default_factory=time.time)
     started_s: float | None = None
     finished_s: float | None = None

@@ -206,6 +206,8 @@ def many2many_main(opts: dict, positional: list, stdout, stderr,
     if not isinstance(rc_dir, str) or not rc_dir or rc_dir == "off":
         rc_dir = getattr(warm, "result_cache_dir", None) \
             if warm is not None else None
+    t_digs = None
+    q_digs = None
     if rc_dir:
         import hashlib
 
@@ -218,20 +220,73 @@ def many2many_main(opts: dict, positional: list, stdout, stderr,
             print(f"Warning: --result-cache dir {rc_dir} unusable "
                   f"({e}); caching disabled", file=stderr)
         if store is not None:
+            t_digs = [record_digest(tn, t)
+                      for tn, t in zip(tnames, ts)]
             th = hashlib.sha256()
-            for tn, t in zip(tnames, ts):
-                th.update(record_digest(tn, t).encode())
+            for d in t_digs:
+                th.update(d.encode())
             tdig = th.hexdigest()
-            for qi, (qn, q) in enumerate(zip(qnames, qs)):
-                skeys[qi] = section_key(record_digest(qn, q), tdig,
-                                        band)
+            q_digs = [record_digest(qn, q)
+                      for qn, q in zip(qnames, qs)]
+            for qi in range(len(qs)):
+                skeys[qi] = section_key(q_digs[qi], tdig, band)
                 got = store.get(skeys[qi])
                 if got is not None and "o" in got[1] \
                         and "s" in got[1]:
                     sections[qi] = got[1]["o"]
                     sums[qi] = got[1]["s"]
     miss = [qi for qi in range(len(qs)) if sections[qi] is None]
-    stats.lines = len(miss) * len(ts)
+
+    # ---- superset/near-hit reuse (ISSUE 17b): an exact-section miss
+    # whose FAMILY (query record + band) holds a cached entry with a
+    # target SUBSET of ours reuses every cached (digest, score) pair
+    # and dispatches only the delta targets.  The final section is
+    # REBUILT from the merged score values through the same formatting
+    # functions a cold run uses, so splice parity is by construction —
+    # and the band lives in the family, so a different band never
+    # donates scores.
+    partial: dict[int, dict[str, int]] = {}
+    if store is not None and miss and t_digs is not None:
+        from pwasm_tpu.service.cache import m2m_family_key
+        pool: dict[str, list] = {}
+        for _key, man in store.m2m_scan():
+            fam = man["m2m"].get("family")
+            if isinstance(fam, str):
+                pool.setdefault(fam, []).append(man)
+        cur = set(t_digs)
+        for qi in miss:
+            fam = m2m_family_key(q_digs[qi], band)
+            best = None
+            for man in pool.get(fam, ()):
+                rows = man["m2m"].get("targets")
+                if not isinstance(rows, list):
+                    continue
+                try:
+                    got_map = {str(d): int(s) for d, s in rows}
+                except (TypeError, ValueError):
+                    continue
+                if not got_map or not set(got_map) <= cur:
+                    continue     # not a subset: nothing to vouch for
+                covered = sum(1 for d in t_digs if d in got_map)
+                if best is None or covered > best[0]:
+                    best = (covered, got_map)
+            if best is not None:
+                partial[qi] = best[1]
+
+    # per-miss target indices still owed to the device; the map keys
+    # double as score-row keys (record digests with a store, plain
+    # indices without one)
+    tkey = t_digs if t_digs is not None else list(range(len(ts)))
+    need: dict[int, tuple] = {}
+    for qi in miss:
+        pm = partial.get(qi)
+        if pm is None:
+            need[qi] = tuple(range(len(ts)))
+        else:
+            need[qi] = tuple(ti for ti, d in enumerate(tkey)
+                             if d not in pm)
+    pairs = sum(len(need[qi]) for qi in miss)
+    stats.lines = pairs
 
     from pwasm_tpu.resilience import BatchSupervisor, ResiliencePolicy
     supervisor = BatchSupervisor(
@@ -241,8 +296,9 @@ def many2many_main(opts: dict, positional: list, stdout, stderr,
         supervisor.restore_state(warm.supervisor_state)
 
     from pwasm_tpu.ops.banded_dp import NEG
-    use_device = device == "tpu" and bool(miss)
-    if miss:
+    use_device = device == "tpu" and pairs > 0
+    computed: dict[int, dict] = {}
+    if pairs:
         # the one session gate: identical to cli._main_loop's — a
         # bounded probe before the first jax touch, demoting loudly to
         # cpu, with per-run probe/warm-hit accounting (the "one warm
@@ -288,41 +344,75 @@ def many2many_main(opts: dict, positional: list, stdout, stderr,
         from pwasm_tpu.parallel.many2many import \
             many2many_scores_ragged
         if verbose:
-            print(f"many2many: {len(miss)} of {len(qs)} quer"
-                  f"{'y' if len(qs) == 1 else 'ies'} x {len(ts)} "
-                  f"target(s), band {band}, one "
+            extra_note = ""
+            if len(miss) < len(qs):
+                extra_note += (f" ({len(qs) - len(miss)} section(s) "
+                               "from cache)")
+            if partial:
+                extra_note += (f" ({len(partial)} section(s) spliced "
+                               "from a cached target subset)")
+            print(f"many2many: {pairs} of {len(qs) * len(ts)} "
+                  f"pair(s), band {band}, one "
                   f"{'device' if use_device else 'cpu'} session"
-                  + (f" ({len(qs) - len(miss)} section(s) from "
-                     "cache)" if len(miss) < len(qs) else ""),
-                  file=stderr)
+                  + extra_note, file=stderr)
         # a served job holding a device lease places on ITS lane,
         # exactly like cli._main_loop jobs (the ISSUE 8
         # lane-isolation contract); inert for cold runs and
         # single-lane daemons.  (Spanning a MULTI-device lease with a
         # 2-D mesh is the ROADMAP item-3 remaining work — today the
         # session stays single-device.)
+        # queries owing the same target subset share one ragged
+        # dispatch, so a superset job costs one call for the delta
+        # column(s) plus one for any full-miss queries
+        groups: dict[tuple, list[int]] = {}
+        for qi in miss:
+            if need[qi]:
+                groups.setdefault(need[qi], []).append(qi)
         with _lane_device_scope(
                 SimpleNamespace(device="tpu" if use_device
                                 else "cpu"), warm, stderr):
-            scores = many2many_scores_ragged(
-                [qs[qi] for qi in miss], ts, band=band,
-                supervisor=supervisor)
-        for k, qi in enumerate(miss):
-            sec = format_sections(
-                [qnames[qi]], [len(qs[qi])], tnames, tlens,
-                [scores[k]], NEG).encode("utf-8")
-            sm = format_summary([qnames[qi]], tnames, [scores[k]],
-                                NEG).encode("utf-8")
-            sections[qi], sums[qi] = sec, sm
-            if store is not None and skeys[qi] is not None:
-                store.insert(skeys[qi], {"o": sec, "s": sm})
+            for idxs, qis in groups.items():
+                scores = many2many_scores_ragged(
+                    [qs[qi] for qi in qis],
+                    [ts[ti] for ti in idxs], band=band,
+                    supervisor=supervisor)
+                for k, qi in enumerate(qis):
+                    computed[qi] = {
+                        tkey[ti]: int(scores[k][j])
+                        for j, ti in enumerate(idxs)}
+    elif miss and verbose:
+        print(f"many2many: all {len(miss)} missing section(s) "
+              "spliced from cached target subsets — no device "
+              "session", file=stderr)
     elif verbose:
         print(f"many2many: all {len(qs)} section(s) served from the "
               "result cache — no device session", file=stderr)
+    for qi in miss:
+        pm = partial.get(qi, {})
+        cm = computed.get(qi, {})
+        row = [pm[d] if d in pm else cm[d] for d in tkey]
+        sec = format_sections(
+            [qnames[qi]], [len(qs[qi])], tnames, tlens,
+            [row], NEG).encode("utf-8")
+        sm = format_summary([qnames[qi]], tnames, [row],
+                            NEG).encode("utf-8")
+        sections[qi], sums[qi] = sec, sm
+        if store is not None and skeys[qi] is not None:
+            from pwasm_tpu.service.cache import m2m_family_key
+            extra = {"m2m": {
+                "family": m2m_family_key(q_digs[qi], band),
+                "targets": [[d, int(row[ti])]
+                            for ti, d in enumerate(t_digs)]}}
+            store.insert(skeys[qi], {"o": sec, "s": sm},
+                         extra=extra)
+        if store is not None and pm:
+            store.note_delta(len(ts) - len(need[qi]), len(ts))
     # honest accounting: the counters describe work this run actually
-    # DID; cached sections ride in as bytes, not as alignments
-    stats.alignments = len(miss) * len(ts)
-    stats.aligned_bases = sum(tlens) * len(miss)
+    # DID; cached sections and spliced subset rows ride in as bytes,
+    # not as alignments
+    stats.alignments = pairs
+    stats.aligned_bases = sum(
+        tlens[ti] for qi in miss for ti in need[qi])
     stats.device_batches = 0   # the ragged driver dispatches per
     #   bucket; the supervisor's site counters carry the attempt story
 

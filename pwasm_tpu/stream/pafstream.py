@@ -215,14 +215,24 @@ class FollowReader:
 
     The file may not exist yet when following starts (the writer races
     the reader); the reader waits for it like ``tail -F`` does.
+
+    ``hasher`` mirrors :class:`BlockLineReader`: it rides every raw
+    chunk consumed, so a CLEANLY idle-ended follow (everything on disk
+    was processed) carries the same whole-file content digest the
+    one-shot reader would — what lets a completed ``--follow`` run
+    populate the result cache.  A rotation/truncation invalidates it
+    (the stream no longer equals any one file's bytes): ``consumed``
+    stays False and ``hexdigest()`` returns None.
     """
 
     def __init__(self, path: str, idle_timeout_s: float | None = None,
-                 poll_s: float = 0.05):
+                 poll_s: float = 0.05, hasher=None):
         self.path = path
         self.idle_timeout_s = idle_timeout_s
         self.poll_s = max(0.005, float(poll_s))
         self.rotations = 0
+        self.hasher = hasher
+        self.consumed = False   # cleanly idle-ended, digest is whole
         self._f = None
         self._ino: int | None = None
         self._asm = LineAssembler()
@@ -230,6 +240,12 @@ class FollowReader:
         self._drain = None
         self._last_growth = time.monotonic()
         self._done = False
+
+    def hexdigest(self) -> str | None:
+        """Content digest of the consumed stream, or None (no hasher,
+        or a rotation made the stream unequal to any file)."""
+        return self.hasher.hexdigest() \
+            if self.hasher is not None and not self.rotations else None
 
     # the CLI main loop binds its SignalDrain here so a SIGTERM landing
     # while the reader is blocked between records drains at THIS record
@@ -282,6 +298,8 @@ class FollowReader:
             return False
         chunk = self._f.read(1 << 20)
         if chunk:
+            if self.hasher is not None:
+                self.hasher.update(chunk)
             self._lines.extend(self._asm.push(
                 chunk.decode("utf-8", "replace")))
             return True
@@ -316,6 +334,7 @@ class FollowReader:
                 # clean end of stream: surrender the unterminated tail
                 # exactly like a file reader at EOF would
                 self._done = True
+                self.consumed = not self.rotations
                 self._lines.extend(self._asm.flush())
                 continue
             time.sleep(self.poll_s)

@@ -153,6 +153,16 @@ SLO_FILES = ("pwasm_tpu/obs/slo.py", "pwasm_tpu/service/canary.py")
 # hashing, fsio writes, and file serves.
 CACHE_FILES = ("pwasm_tpu/service/cache.py",)
 
+# Incremental-compute surface (ISSUE 17): the delta machinery lives
+# inside the cache module and every serving tier leans on it — a
+# refactor that drops one of these entry points silently turns all
+# near-miss traffic back into cold recomputes.  Checked by
+# find_cache_violations alongside the jax-freeness scan.
+CACHE_DELTA_SYMBOLS = ("def delta_lookup", "def note_delta",
+                       "def m2m_scan", "def prefetch",
+                       "def contains_family", "def paf_line_digests",
+                       "def family_key", "def m2m_family_key")
+
 # ---- fencing-invariant gate (ISSUE 16 satellite) ----------------------
 # Failover re-admission is where split-brain corruption happens: an
 # orchestrator that re-admits a started job as a ``--resume``
@@ -438,15 +448,22 @@ def find_cache_violations(root: str = REPO) -> list[str]:
                        "(CLI/daemon/router) depends on")
             continue
         with open(path, encoding="utf-8") as f:
-            for i, line in enumerate(f, 1):
-                if line.lstrip().startswith("#"):
-                    continue
-                if SERVICE_PATTERNS.search(line):
-                    out.append(
-                        f"{rel}:{i}: result-cache module touches "
-                        f"jax directly: {line.strip()} — the cache "
-                        "hashes and serves bytes; device work stays "
-                        "behind cli.run's supervised sites")
+            text = f.read()
+        for i, line in enumerate(text.splitlines(), 1):
+            if line.lstrip().startswith("#"):
+                continue
+            if SERVICE_PATTERNS.search(line):
+                out.append(
+                    f"{rel}:{i}: result-cache module touches "
+                    f"jax directly: {line.strip()} — the cache "
+                    "hashes and serves bytes; device work stays "
+                    "behind cli.run's supervised sites")
+        for sym in CACHE_DELTA_SYMBOLS:
+            if sym not in text:
+                out.append(
+                    f"{rel}: missing `{sym}` — the incremental-"
+                    "compute (delta-serving) surface every tier's "
+                    "near-miss path depends on (ISSUE 17)")
     return out
 
 

@@ -769,3 +769,308 @@ def test_top_renders_cache_row():
     # cache off: no row, still a total render
     out = render({"uptime_s": 1.0, "cache": {"enabled": False}})
     assert "CACHE:" not in out
+
+
+# ---------------------------------------------------------------------------
+# incremental compute: per-record delta serving (ISSUE 17)
+# ---------------------------------------------------------------------------
+def _grown_corpus(tmp_path, n=30, n_prefix=27, qlen=120, seed=3):
+    """One corpus, two files: the first ``n_prefix`` lines and the
+    whole thing — byte-identical in the shared prefix, so the full
+    file is exactly 'the cached input, appended to'."""
+    rng = np.random.default_rng(seed)
+    q = "".join("ACGT"[i] for i in rng.integers(0, 4, qlen))
+    lines = []
+    for i in range(n):
+        cut = 10 + int(rng.integers(0, qlen - 40))
+        qb = q[cut]
+        tb = "ACGT"[("ACGT".index(qb) + 1) % 4]
+        ops = [("=", cut), ("*", tb, qb), ("=", 20), ("ins", "gg"),
+               ("=", qlen - cut - 21)]
+        lines.append(make_paf_line("q", q, f"asm{i}", "+", ops)[0])
+    fa = tmp_path / "dq.fa"
+    write_fasta(str(fa), [("q", q.encode())])
+    p1 = tmp_path / "prefix.paf"
+    p1.write_text("".join(ln + "\n" for ln in lines[:n_prefix]))
+    p2 = tmp_path / "full.paf"
+    p2.write_text("".join(ln + "\n" for ln in lines))
+    return str(p1), str(p2), str(fa)
+
+
+def test_cli_appended_delta_parity_and_truthful_stats(tmp_path):
+    """Tentpole (a), cold-CLI tier: a grown input exact-misses but
+    delta-hits its cached prefix — only the tail is recomputed, the
+    report is byte-identical to the cache-off cold run, and --stats
+    stays truthful (cache_delta with computed-vs-served counts)."""
+    p1, p2, fa = _grown_corpus(tmp_path)
+    cd = str(tmp_path / "cd")
+    assert run([p1, "-r", fa, "-o", str(tmp_path / "a.dfa"),
+                f"--result-cache={cd}"], stderr=io.StringIO()) == 0
+    stj = str(tmp_path / "b.json")
+    err = io.StringIO()
+    assert run([p2, "-r", fa, "-o", str(tmp_path / "b.dfa"),
+                f"--result-cache={cd}", f"--stats={stj}"],
+               stderr=err) == 0, err.getvalue()
+    st = json.load(open(stj))
+    assert st["cache_delta"] is True
+    # the LAST cached record re-runs (its durable row is the resume
+    # cursor's truncation point): 26 of 30 served, 4 computed
+    assert st["cache_records_served"] == 26
+    assert st["cache_records_total"] == 30
+    assert st["resumed_past"] == 26
+    assert "cache_hit" not in st        # a delta is not an exact hit
+    # ground truth: the cache-off cold run on the full input
+    assert run([p2, "-r", fa, "-o", str(tmp_path / "c.dfa")],
+               stderr=io.StringIO()) == 0
+    assert (tmp_path / "b.dfa").read_bytes() \
+        == (tmp_path / "c.dfa").read_bytes()
+    # the completed delta run re-populated its own exact entry (with
+    # the delta markers STRIPPED): an identical rerun is a plain hit
+    stj2 = str(tmp_path / "d.json")
+    assert run([p2, "-r", fa, "-o", str(tmp_path / "d.dfa"),
+                f"--result-cache={cd}", f"--stats={stj2}"],
+               stderr=io.StringIO()) == 0
+    st2 = json.load(open(stj2))
+    assert st2.get("cache_hit") is True
+    assert "cache_delta" not in st2
+    assert (tmp_path / "d.dfa").read_bytes() \
+        == (tmp_path / "c.dfa").read_bytes()
+
+
+def test_kill9_mid_delta_insert_sweep_consistency(tmp_path):
+    """The ``.dx`` delta index rides the blobs-then-manifest commit
+    protocol: every kill -9 window leaves either a whole entry, an
+    aged sidecar orphan the sweep reaps, or a rotted index that only
+    DISQUALIFIES delta serving (exact hits still work) — never a
+    corrupt splice; the byte ledger always matches disk truth."""
+    from pwasm_tpu.service.cache import SWEEP_GRACE_S
+    root = tmp_path / "cd"
+    store = CacheStore(str(root))
+    digs = [f"{i:016x}" for i in range(10)]
+    dx = "".join(digs).encode("ascii")
+    assert store.insert("a" * 64, {"o": b"prefix rows"},
+                        delta={"family": "famA", "lines": len(digs),
+                               "dx": dx})
+    # window 1: sidecar landed, manifest did not (kill -9 between the
+    # blob writes and the commit) -> an aged orphan the sweep reaps
+    (root / ("b" * 64 + ".dx")).write_bytes(b"orphan index")
+    old = time.time() - SWEEP_GRACE_S - 60
+    os.utime(root / ("b" * 64 + ".dx"), (old, old))
+    store2 = CacheStore(str(root))          # restart = sweep
+    assert not os.path.exists(root / ("b" * 64 + ".dx"))
+    # the committed entry still delta-serves a grown input
+    grown = digs + ["f" * 16]
+    hit = store2.delta_lookup("famA", grown)
+    assert hit is not None and hit[3] == len(digs)
+    # window 2: the index rots -> the candidate is skipped (miss),
+    # the exact path is unharmed
+    with open(root / ("a" * 64 + ".dx"), "r+b") as f:
+        f.write(b"XX")
+    store3 = CacheStore(str(root))
+    assert store3.delta_lookup("famA", grown) is None
+    assert store3.get("a" * 64) is not None
+    disk = sum(os.path.getsize(root / n) for n in os.listdir(root))
+    assert store3.stats_dict()["bytes"] == disk
+
+
+def test_serve_admission_delta_rearms_as_resume(tmp_path):
+    """Tentpole (c), daemon tier: an appended input exact-misses at
+    admission but delta-hits — the daemon writes the cached prefix,
+    re-arms the job as ``--resume``, patches its finished stats with
+    the truthful delta counts, journals a delta-flavored cache_hit
+    record, and moves svc-stats' hit ratio FRACTIONALLY."""
+    p1, p2, fa = _grown_corpus(tmp_path)
+    cd = str(tmp_path / "cd")
+    with _daemon(result_cache=cd) as h:
+        r1 = _submit_wait(h.sock, [p1, "-r", fa,
+                                   "-o", str(tmp_path / "j1.dfa")])
+        assert r1.get("rc") == 0, r1
+        r2 = _submit_wait(h.sock, [p2, "-r", fa,
+                                   "-o", str(tmp_path / "j2.dfa"),
+                                   f"--stats={tmp_path / 'j2.json'}"])
+        assert r2.get("rc") == 0, r2
+        st = r2.get("stats") or {}
+        assert st.get("cache_delta") is True
+        assert st["cache_records_served"] == 26
+        assert st["cache_records_total"] == 30
+        with ServiceClient(h.sock) as c:
+            cb = c.stats()["stats"]["cache"]
+        assert cb["delta_hits"] == 1
+        assert cb["delta_records_served"] == 26
+        assert cb["hits"] == 0 and cb["misses"] == 2
+        assert abs(cb["hit_ratio"] - (26 / 30) / 2) < 1e-6
+        rows = [json.loads(l) for l in
+                open(h.sock + ".journal").read().splitlines()]
+        drecs = [r for r in rows
+                 if r.get("rec") == "cache_hit" and r.get("delta")]
+        assert drecs and drecs[0]["served"] == 26 \
+            and drecs[0]["total"] == 30
+        # crash-replay safety: the ADMIT record keeps the ORIGINAL
+        # argv (no --resume) so an unfinished delta job re-runs cold
+        admits = [r for r in rows if r.get("rec") == "admit"
+                  and r.get("job_id") == drecs[0]["job_id"]]
+        assert admits and "--resume" not in admits[0]["argv"]
+    # byte parity vs the cache-off cold run
+    assert run([p2, "-r", fa, "-o", str(tmp_path / "cold.dfa")],
+               stderr=io.StringIO()) == 0
+    assert (tmp_path / "j2.dfa").read_bytes() \
+        == (tmp_path / "cold.dfa").read_bytes()
+
+
+def test_m2m_superset_splices_and_scores_only_new_targets(tmp_path):
+    """Tentpole (b): a --many2many job whose target set strictly
+    CONTAINS a cached section's serves the cached per-target scores
+    and dispatches only the delta targets — byte-identical splice,
+    honest pair-level stats, band-keyed isolation."""
+    rng = np.random.default_rng(17)
+
+    def seq(n):
+        return "".join("ACGT"[i] for i in rng.integers(0, 4, n))
+
+    qs = [(f"cds{k}", seq(120 + 10 * k)) for k in range(3)]
+    ts = [(f"asm{k}", seq(200 + 13 * k)) for k in range(6)]
+    qfa = _write_qfa(tmp_path, "q.fa", qs)
+    t3 = tmp_path / "t3.fa"
+    t3.write_text("".join(f">{n}\n{s}\n" for n, s in ts[:3]))
+    t6 = tmp_path / "t6.fa"
+    t6.write_text("".join(f">{n}\n{s}\n" for n, s in ts))
+    cd = str(tmp_path / "cd")
+    # ground truth: all 6 targets, cache off
+    ref = str(tmp_path / "ref.tsv")
+    assert run(["--many2many", str(t6), "-r", qfa, "-o", ref],
+               stderr=io.StringIO()) == 0
+    # populate sections over the 3-target subset
+    assert run(["--many2many", str(t3), "-r", qfa,
+                "-o", str(tmp_path / "p.tsv"),
+                f"--result-cache={cd}"], stderr=io.StringIO()) == 0
+    # the superset run: every section exact-misses (different target
+    # set) but splices its cached 3 and scores only the 3 new ones
+    stj = str(tmp_path / "s.json")
+    assert run(["--many2many", str(t6), "-r", qfa,
+                "-o", str(tmp_path / "s.tsv"),
+                f"--result-cache={cd}", f"--stats={stj}"],
+               stderr=io.StringIO()) == 0
+    assert (tmp_path / "s.tsv").read_bytes() \
+        == open(ref, "rb").read()
+    st = json.load(open(stj))
+    assert st["alignments"] == 9      # 3 queries x 3 NEW targets
+    # repeat superset run: pure section hits, nothing scored
+    stj2 = str(tmp_path / "s2.json")
+    assert run(["--many2many", str(t6), "-r", qfa,
+                "-o", str(tmp_path / "s2.tsv"),
+                f"--result-cache={cd}", f"--stats={stj2}",
+                "--device=tpu"], stderr=io.StringIO()) == 0
+    assert (tmp_path / "s2.tsv").read_bytes() \
+        == open(ref, "rb").read()
+    st2 = json.load(open(stj2))
+    assert st2["alignments"] == 0
+    assert st2["backend"]["probes"] == 0
+    # band keying: a different band never reuses those rows
+    stj3 = str(tmp_path / "s3.json")
+    assert run(["--many2many", str(t6), "-r", qfa, "--band=48",
+                "-o", str(tmp_path / "s3.tsv"),
+                f"--result-cache={cd}", f"--stats={stj3}"],
+               stderr=io.StringIO()) == 0
+    assert json.load(open(stj3))["alignments"] == 18   # all re-scored
+
+
+def test_warm_spawn_prefetch_drill(tmp_path):
+    """Tentpole (c): a member started with --cache-prefetch over an
+    already-populated shared dir warms entries BEFORE its socket
+    appears; its first repeat job is an admission hit — zero probes,
+    cache hits >= 1 — and svc-stats counts the prefetched entries.
+    The scaler injects the flag for cache-armed spawn policies."""
+    from pwasm_tpu.fleet.scaler import warm_spawn_args
+    assert warm_spawn_args(["--result-cache=/d"]) \
+        == ["--result-cache=/d", "--cache-prefetch=64"]
+    assert warm_spawn_args(["--result-cache=off"]) \
+        == ["--result-cache=off"]
+    assert warm_spawn_args(
+        ["--result-cache=/d", "--cache-prefetch=8"]) \
+        == ["--result-cache=/d", "--cache-prefetch=8"]
+    assert warm_spawn_args([]) == []
+    paf, fa = _corpus(tmp_path)
+    cd = str(tmp_path / "shared")
+    with _daemon(result_cache=cd) as h:
+        assert _submit_wait(h.sock, [
+            paf, "-r", fa,
+            "-o", str(tmp_path / "w1.dfa")]).get("rc") == 0
+    # the warm-spawned member: prefetch runs before the socket binds
+    with _daemon(result_cache=cd, cache_prefetch=8) as h2:
+        with ServiceClient(h2.sock) as c:
+            cb = c.stats()["stats"]["cache"]
+        assert cb["prefetched"] >= 1
+        r = _submit_wait(h2.sock, [
+            paf, "-r", fa, "-o", str(tmp_path / "w2.dfa"),
+            f"--stats={tmp_path / 'w2.json'}"])
+        assert r.get("rc") == 0, r
+        st = json.load(open(tmp_path / "w2.json"))
+        assert st["cache_hit"] is True
+        assert st["backend"]["probes"] == 0
+        with ServiceClient(h2.sock) as c:
+            cb = c.stats()["stats"]["cache"]
+        assert cb["hits"] >= 1
+        # prefetch happened before serving: the member's stderr says
+        # so before its "serving on" line
+        log = h2.err.getvalue()
+        assert log.index("prefetch") < log.index("serving on")
+    assert (tmp_path / "w1.dfa").read_bytes() \
+        == (tmp_path / "w2.dfa").read_bytes()
+
+
+def test_router_family_affinity_places_delta_on_warm_member(tmp_path):
+    """Tentpole (c), fleet tier: members with PRIVATE caches — the
+    router's cache probe carries the input FAMILY, so an appended
+    input (exact miss everywhere) still lands on the member holding
+    its prefix, whose admission serves the delta."""
+    p1, p2, fa = _grown_corpus(tmp_path)
+    stack, members = [], []
+    try:
+        for k in range(2):
+            cm = _daemon(result_cache=str(tmp_path / f"m{k}cd"))
+            stack.append(cm)
+            members.append(cm.__enter__())
+        rdir = tempfile.mkdtemp(prefix="pwrt")
+        rsock = os.path.join(rdir, "router.sock")
+        err = io.StringIO()
+        r = Router([m.sock for m in members], socket_path=rsock,
+                   stderr=err, poll_interval=0.1,
+                   result_cache=str(tmp_path / "router-cd"))
+        rcbox: list = []
+        t = threading.Thread(target=lambda: rcbox.append(r.serve()),
+                             daemon=True)
+        t.start()
+        assert wait_for_socket(rsock, 15), err.getvalue()
+        try:
+            a1 = [p1, "-r", fa, "-o", str(tmp_path / "r1.dfa")]
+            with ServiceClient(rsock) as c:
+                s1 = c.submit(a1)
+                assert s1.get("ok"), s1
+                assert c.result(s1["job_id"],
+                                timeout=120).get("rc") == 0
+            first = s1["member"]
+            a2 = [p2, "-r", fa, "-o", str(tmp_path / "r2.dfa"),
+                  f"--stats={tmp_path / 'r2.json'}"]
+            with ServiceClient(rsock) as c:
+                s2 = c.submit(a2)
+                assert s2.get("ok"), s2
+                r2 = c.result(s2["job_id"], timeout=120)
+            assert r2.get("rc") == 0, r2
+            # family affinity: the grown job landed on the SAME
+            # member, and its admission delta-served the prefix
+            assert s2["member"] == first, (s1, s2)
+            st = json.load(open(tmp_path / "r2.json"))
+            assert st["cache_delta"] is True
+            assert st["cache_records_served"] == 26
+        finally:
+            if not r.drain.requested:
+                r.drain.request("test teardown")
+            t.join(20)
+            shutil.rmtree(rdir, ignore_errors=True)
+    finally:
+        for cm in reversed(stack):
+            cm.__exit__(None, None, None)
+    assert run([p2, "-r", fa, "-o", str(tmp_path / "rc.dfa")],
+               stderr=io.StringIO()) == 0
+    assert (tmp_path / "r2.dfa").read_bytes() \
+        == (tmp_path / "rc.dfa").read_bytes()

@@ -357,3 +357,81 @@ def test_native_gotoh_traceback_matches_python_oracle():
                               params.gap_open, params.gap_extend)
         assert got[0] == want[0]
         np.testing.assert_array_equal(got[1], want[1])
+
+
+def test_extract_batch_parity_and_stop_at_failing_item():
+    """pw_extract_batch: one crossing for a mixed flush (both strands,
+    different queries/lengths) returns alignments identical to the
+    per-item native path; a failing mid-batch item stops the batch at
+    the items before it and surfaces the SAME per-item error."""
+    from pwasm_tpu.native import extract_batch_native
+    rng = np.random.default_rng(1234)
+    recs, refs = [], []
+    for i in range(13):
+        strand = "+" if i % 3 else "-"
+        q = "".join(rng.choice(list("ACGT"),
+                               size=int(rng.integers(60, 160))))
+        if strand == "-":
+            q_aln = revcomp(q.encode()).decode()
+        else:
+            q_aln = q
+        ops = _random_ops(rng, q_aln)
+        line, _ = make_paf_line(f"q{i}", q, f"t{i}", strand, ops)
+        rec = parse_paf_line(line)
+        recs.append(rec)
+        refs.append(revcomp(q.encode()) if rec.alninfo.reverse
+                    else q.encode())
+    alns, err = extract_batch_native(recs, refs)
+    assert err is None and len(alns) == len(recs)
+    for rec, ref, aln in zip(recs, refs, alns):
+        assert _aln_tuple(aln) == _aln_tuple(extract_native(rec, ref))
+    # poison item 7 with an unparsable cs op: items 0..6 extract, the
+    # error is byte-identical to the per-item one
+    bad_line = recs[7].line.replace("cs:Z:", "cs:Z:~zz")
+    bad = parse_paf_line(bad_line)
+    broken = recs[:7] + [bad] + recs[8:]
+    brefs = refs[:7] + [refs[7]] + refs[8:]
+    alns2, err2 = extract_batch_native(broken, brefs)
+    assert len(alns2) == 7 and err2 is not None
+    with pytest.raises(PwasmError) as ei:
+        extract_native(bad, refs[7])
+    assert str(err2) == str(ei.value)
+    for a, b in zip(alns2, alns):
+        assert _aln_tuple(a) == _aln_tuple(b)
+
+
+def test_cli_extract_batch_hatch_byte_parity(tmp_path):
+    """PWASM_NATIVE_EXTRACT_BATCH=0 is the per-item A/B hatch: both
+    modes produce byte-identical report AND MSA files (the
+    pw_msa_add_batch parity contract, extended to extraction)."""
+    from pwasm_tpu.cli import run
+    import io
+    rng = np.random.default_rng(77)
+    seqs, lines = [], []
+    for qn in range(2):
+        q = "".join(rng.choice(list("ACGT"), size=140 + 20 * qn))
+        seqs.append((f"q{qn}", q))
+        for i in range(11):     # not a multiple of --batch: tail flush
+            strand = "+" if (i + qn) % 3 else "-"
+            qa = revcomp(q.encode()).decode() if strand == "-" else q
+            ops = _random_ops(rng, qa)
+            lines.append(make_paf_line(f"q{qn}", q, f"t{qn}_{i}",
+                                       strand, ops)[0])
+    fa = tmp_path / "q.fa"
+    fa.write_text("".join(f">{n}\n{s}\n" for n, s in seqs))
+    paf = tmp_path / "in.paf"
+    paf.write_text("".join(ln + "\n" for ln in lines))
+    outs = {}
+    for hatch in ("1", "0"):
+        os.environ["PWASM_NATIVE_EXTRACT_BATCH"] = hatch
+        try:
+            out = tmp_path / f"h{hatch}.dfa"
+            msa = tmp_path / f"h{hatch}.msa"
+            err = io.StringIO()
+            rc = run([str(paf), "-r", str(fa), "-o", str(out),
+                      "-w", str(msa), "--batch=7"], stderr=err)
+            assert rc == 0, err.getvalue()
+            outs[hatch] = (out.read_bytes(), msa.read_bytes())
+        finally:
+            del os.environ["PWASM_NATIVE_EXTRACT_BATCH"]
+    assert outs["1"] == outs["0"]

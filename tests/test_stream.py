@@ -917,3 +917,39 @@ def test_many2many_as_service_job_warm_session(tmp_path):
         assert bk["probes"] == 0 and bk["warm_hits"] == 1
     finally:
         _stop(h)
+
+
+def test_follow_restart_on_grown_file_is_delta_hit(tmp_path):
+    """ISSUE 17a: a --follow run that idle-ends populates the cache
+    under its FOLLOW-LESS key; a follow restart after the file grew
+    is served as a delta — the cached prefix is written, only the
+    tail is computed — and the output is byte-identical to a cold
+    one-shot over the grown file."""
+    paf, fa, lines = _corpus(tmp_path, n=20)
+    grow = str(tmp_path / "grow.paf")
+    open(grow, "w").write("".join(ln + "\n" for ln in lines[:15]))
+    cd = str(tmp_path / "cd")
+    err = io.StringIO()
+    rc = run([grow, "-r", fa, "-o", str(tmp_path / "a.dfa"),
+              "--follow=0.3", f"--result-cache={cd}"], stderr=err)
+    assert rc == 0, err.getvalue()[:2000]
+    # the idle-ended pass populated a delta-indexed entry
+    assert any(n.endswith(".dx") for n in os.listdir(cd))
+    # the file grows between runs; the restart delta-hits + tails
+    open(grow, "a").write("".join(ln + "\n" for ln in lines[15:]))
+    stj = str(tmp_path / "b.json")
+    err = io.StringIO()
+    rc = run([grow, "-r", fa, "-o", str(tmp_path / "b.dfa"),
+              "--follow=0.3", f"--result-cache={cd}",
+              f"--stats={stj}"], stderr=err)
+    assert rc == 0, err.getvalue()[:2000]
+    st = json.load(open(stj))
+    assert st["cache_delta"] is True
+    assert st["cache_records_served"] == 14     # last record re-runs
+    assert st["cache_records_total"] == 20
+    # byte parity vs a cold one-shot over the grown file
+    err = io.StringIO()
+    assert run([grow, "-r", fa, "-o", str(tmp_path / "c.dfa")],
+               stderr=err) == 0, err.getvalue()
+    assert (tmp_path / "b.dfa").read_bytes() \
+        == (tmp_path / "c.dfa").read_bytes()

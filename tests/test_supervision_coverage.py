@@ -139,8 +139,14 @@ def test_cache_gate_detects_missing_and_jax(checker, tmp_path):
         "# import jax in a comment is NOT a hit\n"
         "def get(key):\n    return jax.device_get(key)\n")
     bad = checker.find_cache_violations(str(tmp_path))
-    assert len(bad) == 2, bad
     assert all("cache.py" in b for b in bad)
+    jax_bad = [b for b in bad if "touches jax" in b]
+    assert len(jax_bad) == 2, bad
+    # the delta-serving surface (ISSUE 17) is required alongside
+    # jax-freedom: a cache module without it can only answer exact
+    # repeats, and every missing symbol is its own violation
+    sym_bad = [b for b in bad if "missing `" in b]
+    assert len(sym_bad) == len(checker.CACHE_DELTA_SYMBOLS), bad
 
 
 def test_fencing_gate_clean_on_this_tree(checker):
