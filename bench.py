@@ -955,7 +955,19 @@ def cfg8_realistic_scale() -> int:
       ``realistic_cache_delta_ratio`` (worst tier wall / dedicated
       cache-off cold wall, the ISSUE 17 <= 0.3x acceptance) + the
       parity bool (bytes AND truthful cache_delta stats across
-      tiers)."""
+      tiers);
+    - gray drill: one of three members behind qa/fleet_chaos's delay
+      proxy (alive and answering, just slow — the failure liveness
+      polls cannot see) must be quarantined within ~3 poll rounds,
+      take no new placements while completed jobs stay byte-identical
+      and --deadline-s stays truthful mid-chaos, then probation-exit
+      once relieved (``realistic_fleet_graydrill_p99_ms``, the ISSUE
+      18 acceptance drill);
+    - shed floor: sustained queue pressure must brown out the LOWEST
+      --priority-lanes tier with a truthful overloaded +
+      retry_after_s before any member sees the job, keep admitting
+      the top tier throughout, and de-escalate back to level 0 when
+      pressure clears (``realistic_fleet_shed_floor``, ISSUE 18)."""
     import subprocess
     import tempfile
 
@@ -2537,6 +2549,217 @@ def cfg8_realistic_scale() -> int:
         _emit("realistic_fleet_scaleup_warm_first_job",
               1 if warm_first else 0, "bool",
               1.0 if warm_first else 0.0, cpu_metric=True)
+
+        # --- gray-failure drill (ISSUE 18 tentpole): three members,
+        # one behind qa/fleet_chaos's ChaosProxy — alive, polling
+        # clean, but every byte 0.8 s slow.  The router must
+        # quarantine it within ~3 poll rounds, place the chaos-window
+        # jobs only on healthy members (byte parity intact),
+        # honor --deadline-s truthfully mid-chaos, and probation-exit
+        # the member once the fault lifts.  The emitted value is the
+        # chaos-window job-wall p99; vs_baseline is the drill gate.
+        qa_dir = os.path.join(repo, "qa")
+        sys.path.insert(0, qa_dir)
+        try:
+            import fleet_chaos as chaos
+        finally:
+            try:
+                sys.path.remove(qa_dir)
+            except ValueError:
+                pass
+        from pwasm_tpu.fleet.transport import target_name
+        gsocks = [os.path.join(d, f"gry{k}.sock") for k in range(3)]
+        gprocs = [subprocess.Popen(
+            cmd + ["serve", f"--socket={s}", "--max-queue=16"],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE) for s in gsocks]
+        grouter = None
+        gproxy = None
+        grsock = os.path.join(d, "gray.sock")
+        gray_ok = False
+        gray_p99 = 0.0
+        gpoll, gdelay = 0.2, 0.8
+        try:
+            for s in gsocks:
+                if not wait_for_socket(s, 120):
+                    return _fail("realistic_fleet_gray_up")
+            gproxy = chaos.ChaosProxy(gsocks[2])
+            gaddr = gproxy.start()
+            slow_name = target_name(gaddr)
+            grouter = subprocess.Popen(
+                cmd + ["route",
+                       "--backends=" + ",".join(gsocks[:2] + [gaddr]),
+                       f"--socket={grsock}",
+                       f"--poll-interval={gpoll}", "--quarantine-x=3"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE)
+            if not wait_for_socket(grsock, 120):
+                return _fail("realistic_fleet_gray_router_up")
+            with ServiceClient(grsock, trace_id="bench-gray") as c:
+                # a healthy-wall yardstick + EWMA convergence first
+                t0 = time.perf_counter()
+                s0 = c.submit(args("gw0", []))
+                if not s0.get("ok") or c.result(
+                        s0["job_id"], timeout=600).get("rc") != 0:
+                    return _fail("realistic_fleet_gray_warm")
+                healthy_wall = time.perf_counter() - t0
+                time.sleep(6 * gpoll)
+                gproxy.delay_s = gdelay       # the gray fault, armed
+                d1 = chaos.gray_drill(grsock, slow_name,
+                                      relieve=lambda: None,
+                                      recover_timeout_s=0.0)
+                walls: list[float] = []
+                placed_ok = d1["quarantined"]
+                dd_ok = False
+                if d1["quarantined"]:
+                    for k in range(6):
+                        t0 = time.perf_counter()
+                        s0 = c.submit(args(f"gc{k}", []))
+                        if not s0.get("ok"):
+                            return _fail("realistic_fleet_gray_submit")
+                        placed_ok &= s0.get("member") != slow_name
+                        if c.result(s0["job_id"],
+                                    timeout=600).get("rc") != 0:
+                            return _fail("realistic_fleet_gray_job")
+                        walls.append(time.perf_counter() - t0)
+                        if readset(f"gc{k}") != parity_body:
+                            return _fail("realistic_fleet_gray_parity")
+                    # deadlines stay truthful mid-chaos: a generous
+                    # budget completes; an already-spent one is
+                    # refused (or expires resumable), never silently
+                    # run to completion
+                    dd = chaos.deadline_drill(grsock, args("gdl", []),
+                                              d, 120.0)
+                    dt = chaos.deadline_drill(grsock, args("gdt", []),
+                                              d, 0.001)
+                    dd_ok = (dd["done"] and not dt["done"]
+                             and (dt["refused"] or dt["expired"]))
+                # fault lifted -> probation-exit (d1 already saw the
+                # member quarantined, so d2's detect phase is instant)
+                d2 = chaos.gray_drill(
+                    grsock, slow_name,
+                    relieve=lambda: setattr(gproxy, "delay_s", 0.0))
+                c.drain()
+            if grouter.wait(timeout=120) != 0:
+                return _fail("realistic_fleet_gray_router_drain")
+            for s in gsocks:
+                with ServiceClient(s) as c:
+                    c.drain()
+            for p in gprocs:
+                if p.wait(timeout=120) != 75:
+                    return _fail("realistic_fleet_gray_member_drain")
+            gray_p99 = (sorted(walls)[-1] * 1e3) if walls else 0.0
+            gray_ok = (
+                d1["quarantined"]
+                and d1["t_detect_s"] <= 3 * (gpoll + gdelay) + 1.0
+                and placed_ok and dd_ok
+                and d2["recovered"]
+                and d1["eligible_floor_held"]
+                and d2["eligible_floor_held"]
+                # p99 recovery: quarantine keeps the chaos-window
+                # walls near the healthy yardstick — a placement on
+                # the slow member would pay >= 2 x the proxy delay
+                and walls
+                and max(walls) <= 2.0 * healthy_wall + gdelay)
+        except Exception as e:
+            sys.stderr.write(f"gray drill leg: {e}\n")
+            return _fail("realistic_fleet_graydrill")
+        finally:
+            if gproxy is not None:
+                gproxy.stop()
+            for p in gprocs + ([grouter] if grouter else []):
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait()
+        _emit("realistic_fleet_graydrill_p99_ms", gray_p99, "ms",
+              1.0 if gray_ok else 0.0, cpu_metric=True)
+
+        # --- brownout shed floor (ISSUE 18): one member behind the
+        # router, both sides configured --priority-lanes=rt,bulk.  A
+        # deep slow backlog sustains fleet_queue_pressure past its
+        # for_s, the shed controller browns out the lowest tier, and
+        # the gate checks the whole contract: bulk refused with a
+        # truthful overloaded + retry_after_s (no member asked), rt
+        # still admitted and byte-identical, level back to 0 once the
+        # backlog drains (hysteresis), nothing wedged.
+        shsock0 = os.path.join(d, "shd0.sock")
+        shm = subprocess.Popen(
+            cmd + ["serve", f"--socket={shsock0}", "--max-queue=32",
+                   "--priority-lanes=rt,bulk"],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE)
+        shrouter = None
+        shrsock = os.path.join(d, "shed.sock")
+        shed_ok = False
+        try:
+            if not wait_for_socket(shsock0, 120):
+                return _fail("realistic_fleet_shed_up")
+            shrouter = subprocess.Popen(
+                cmd + ["route", f"--backends={shsock0}",
+                       f"--socket={shrsock}", "--poll-interval=0.2",
+                       "--priority-lanes=rt,bulk"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE)
+            if not wait_for_socket(shrsock, 120):
+                return _fail("realistic_fleet_shed_router_up")
+            with ServiceClient(shrsock, trace_id="bench-shed") as c:
+                backlog = []
+                for k in range(16):
+                    s0 = c.submit(args(f"shb{k}", [slow]),
+                                  priority="bulk")
+                    if not s0.get("ok"):
+                        return _fail("realistic_fleet_shed_submit")
+                    backlog.append(s0["job_id"])
+                deadline = time.monotonic() + 60
+                level = 0
+                while time.monotonic() < deadline:
+                    sh = (c.stats()["stats"].get("ha")
+                          or {}).get("shed") or {}
+                    level = sh.get("level", 0)
+                    if level >= 1:
+                        break
+                    time.sleep(0.1)
+                if level < 1:
+                    return _fail("realistic_fleet_shed_fire")
+                bulk = c.submit(args("shx", []), priority="bulk")
+                rt = c.submit(args("shr", []), priority="rt")
+                shed_truthful = (
+                    not bulk.get("ok")
+                    and bulk.get("error") == "overloaded"
+                    and float(bulk.get("retry_after_s") or 0) > 0
+                    and bulk.get("lane") == "bulk")
+                if not rt.get("ok"):
+                    return _fail("realistic_fleet_shed_rt")
+                for jid in backlog + [rt["job_id"]]:
+                    if c.result(jid, timeout=600).get("rc") != 0:
+                        return _fail("realistic_fleet_shed_backlog")
+                deadline = time.monotonic() + 60
+                sh = {}
+                while time.monotonic() < deadline:
+                    sh = (c.stats()["stats"].get("ha")
+                          or {}).get("shed") or {}
+                    if not sh.get("level"):
+                        break
+                    time.sleep(0.1)
+                shed_ok = (shed_truthful and not sh.get("level")
+                           and readset("shr") == parity_body)
+                c.drain()
+            if shrouter.wait(timeout=120) != 0:
+                return _fail("realistic_fleet_shed_router_drain")
+            with ServiceClient(shsock0) as c:
+                c.drain()
+            if shm.wait(timeout=120) != 75:
+                return _fail("realistic_fleet_shed_member_drain")
+        except Exception as e:
+            sys.stderr.write(f"shed leg: {e}\n")
+            return _fail("realistic_fleet_shed_floor")
+        finally:
+            for p in [shm, shrouter]:
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait()
+        _emit("realistic_fleet_shed_floor", 1 if shed_ok else 0,
+              "bool", 1.0 if shed_ok else 0.0, cpu_metric=True)
 
         if on_tpu_backend():
             dev_env = dict(os.environ, PYTHONPATH=env["PYTHONPATH"])

@@ -89,6 +89,13 @@ USAGE = """Usage:
                N times (exponential backoff + jitter; default 2)
    --device-deadline=S  per-batch device deadline in seconds — a hung
                backend costs one timeout, not the run (default: none)
+   --deadline-s=S  END-TO-END wall budget for the whole run: when it
+               expires the run stops at its next batch boundary with
+               a valid resumable checkpoint, prints the truth, and
+               exits 75 (reason "deadline_exceeded" — resume with a
+               fresh budget, or don't).  The serve daemon passes the
+               REMAINING budget of a socket job down as this flag
+               (docs/RESILIENCE.md; default: none)
    --fallback=cpu|fail  what exhausted retries do: degrade the batch
                to the bit-exact host path (cpu, default) or abort the
                run loudly (fail)
@@ -726,6 +733,16 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None,
             except (TypeError, ValueError):
                 raise CliError(f"{USAGE}\nInvalid --device-deadline "
                                f"value: {opts['device-deadline']}\n")
+        if "deadline-s" in opts:
+            import math
+            try:
+                cfg.deadline_s = float(str(opts["deadline-s"]))
+                if cfg.deadline_s <= 0 \
+                        or not math.isfinite(cfg.deadline_s):
+                    raise ValueError
+            except (TypeError, ValueError):
+                raise CliError(f"{USAGE}\nInvalid --deadline-s "
+                               f"value: {opts['deadline-s']}\n")
         if "fallback" in opts:
             cfg.fallback = str(opts["fallback"])
             if cfg.fallback not in ("cpu", "fail"):
@@ -986,14 +1003,35 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None,
         if obs.enabled:
             drain_cm.obs = obs   # the drain request itself is a
             #                      lifecycle event worth logging
-        with device_trace(cfg.profile_dir, stderr), drain_cm as drain:
-            with obs.span("run", device=cfg.device), \
-                    _lane_device_scope(cfg, warm, stderr):
-                rc = _main_loop(cfg, inf, freport, fmsa, fsummary,
-                                summary, qfasta, stdout, stderr,
-                                cons_outs, resume_skip=resume_skip,
-                                resume_state=resume_state,
-                                drain=drain, warm=warm, obs=obs)
+        # ---- end-to-end deadline (ISSUE 18): --deadline-s rides the
+        # SAME graceful-drain machinery a SIGTERM uses — a timer pulls
+        # the flag when the wall budget runs out, the batch loop stops
+        # at its next boundary, a valid resumable checkpoint + partial
+        # stats land, and the exit says preempted (75) with reason
+        # "deadline_exceeded: ..." so the daemon can map the verdict
+        # truthfully.  No deadline = no timer = byte-identical runs.
+        deadline_timer = None
+        if cfg.deadline_s:
+            import threading as _threading
+            deadline_timer = _threading.Timer(
+                cfg.deadline_s, drain_cm.request,
+                args=(f"deadline_exceeded: --deadline-s="
+                      f"{cfg.deadline_s:g} budget spent",))
+            deadline_timer.daemon = True
+            deadline_timer.start()
+        try:
+            with device_trace(cfg.profile_dir, stderr), \
+                    drain_cm as drain:
+                with obs.span("run", device=cfg.device), \
+                        _lane_device_scope(cfg, warm, stderr):
+                    rc = _main_loop(cfg, inf, freport, fmsa, fsummary,
+                                    summary, qfasta, stdout, stderr,
+                                    cons_outs, resume_skip=resume_skip,
+                                    resume_state=resume_state,
+                                    drain=drain, warm=warm, obs=obs)
+        finally:
+            if deadline_timer is not None:
+                deadline_timer.cancel()
         if rc == 0 and cache_store is not None:
             if cache_delta is not None:
                 # the delta run is done: stamp the stats file

@@ -63,6 +63,13 @@ class Config:
     max_retries: int = 2            # --max-retries: device re-attempts
     device_deadline: float = 0.0    # --device-deadline: s per batch
     #                                 attempt (0 = unbounded)
+    deadline_s: float = 0.0         # --deadline-s: END-TO-END wall
+    #                                 budget for the whole run (0 =
+    #                                 unbounded).  Expiry requests a
+    #                                 graceful drain at the next batch
+    #                                 boundary: valid resumable ckpt,
+    #                                 rc 75, reason "deadline_exceeded"
+    #                                 (ISSUE 18, docs/RESILIENCE.md)
     fallback: str = "cpu"           # --fallback: cpu (degrade) | fail
     inject_faults: str = ""         # --inject-faults=SPEC (debug)
     recover: str = "auto"           # --recover: auto (re-probe an open

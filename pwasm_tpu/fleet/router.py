@@ -65,7 +65,7 @@ from pwasm_tpu.service.journal import (JOURNAL_VERSION, JobJournal,
                                        REC_ROUTE_ADMIT,
                                        REC_ROUTE_PLACE,
                                        REC_ROUTE_RETIRE, REC_SCALE,
-                                       fold_records)
+                                       REC_ROUTE_SHED, fold_records)
 from pwasm_tpu.service.queue import (JOB_CANCELLED, JOB_FAILED,
                                      JOB_PREEMPTED, QueueFull,
                                      TERMINAL_STATES, _sum_numeric)
@@ -129,6 +129,22 @@ _ROUTE_USAGE = """Usage:
                         burn-rate verdicts spawn `serve` members;
                         sustained calm drains the scaler's own
                         members back down
+   --priority-lanes=A,B brownout tier order, highest first (mirror
+                        the members' --priority-lanes): past the
+                        queue-pressure SLO threshold the router sheds
+                        admissions LOWEST tier first with a truthful
+                        `overloaded` + retry_after_s — before any
+                        member sees queue_full.  The top tier is
+                        never shed (brownout, not blackout); without
+                        this flag shedding is inert
+   --quarantine-x=K     slow-member quarantine: a member whose
+                        stats-poll latency EWMA sustains past K x the
+                        fleet median (default 4, min 1, 0 = off) is
+                        quarantined — no new placements, running jobs
+                        finish, streams keep their member
+   --quarantine-probation=N  consecutive clean polls before a
+                        quarantined member takes placements again
+                        (default 3)
    --stream-replay-bytes=N  per-stream replay window (default 4194304
                         = 4 MiB, 0 = off): un-acked stream records
                         buffered at the router so a member death
@@ -175,6 +191,18 @@ _ROUTE_USAGE = """Usage:
 # absorbing a single slow poll.
 _POLL_STRIKES = 2
 
+# gray-failure defense (ISSUE 18) tuning that is policy, not knob:
+# consecutive outlier polls before quarantine (2 = detection within
+# ~2-3 poll ticks, one slow poll absorbed), the absolute latency
+# floor below which nobody is an outlier (a local-socket fleet whose
+# polls all land under 50 ms has no gray failures worth reacting to),
+# and the pressure-free SLO evaluations required before the brownout
+# shed controller de-escalates one priority tier (hysteresis — shed
+# state must not flap with each queue-depth sample).
+_Q_STRIKES = 2
+_Q_FLOOR_MS = 50.0
+_SHED_CLEAN_EXITS = 3
+
 
 class _Member:
     """One backend serve daemon as the router sees it."""
@@ -203,6 +231,22 @@ class _Member:
         self.scaled = False         # spawned by the SLO scaler (the
         #   only members the scaler may also retire)
         self.proc = None            # the scaler's child handle
+        # ---- gray-failure detection (ISSUE 18): a member that is
+        # ALIVE but pathologically slow (half-dead disk, GC storms,
+        # a lossy NIC) passes every liveness poll while dragging the
+        # fleet p99 down.  The router EWMAs each member's stats-poll
+        # round-trip and its reported queue pressure; a sustained
+        # latency outlier vs the fleet MEDIAN is quarantined — no new
+        # placements, existing jobs finish, streams keep their member
+        # — and probation-exits after clean polls.
+        self.lat_ewma_ms = 0.0      # stats-RPC round-trip EWMA
+        self.depth_ewma = 0.0       # queued+running EWMA (queue-wait
+        #                             proxy, shown in svc-stats/top)
+        self.quarantined = False
+        self.q_strikes = 0          # consecutive outlier polls
+        self.q_clean = 0            # consecutive clean polls while
+        #                             quarantined (probation counter)
+        self.quarantines = 0        # times this member entered
 
 
 class _FleetJob:
@@ -214,7 +258,7 @@ class _FleetJob:
                  "member", "mjid", "gen", "stream", "sconn", "slock",
                  "terminal", "retired", "failovers", "submitted_s",
                  "accessed_s", "recovering", "epoch", "rbuf",
-                 "rbytes", "ended")
+                 "rbytes", "ended", "deadline_ms", "submitted_mono")
 
     def __init__(self, fid: str, client: str, priority: str,
                  trace_id: str, frame: dict, member: str, mjid: str,
@@ -238,6 +282,11 @@ class _FleetJob:
         self.failovers = 0
         self.submitted_s = time.time()
         self.accessed_s = time.time()   # LRU clock for table eviction
+        self.deadline_ms = None     # REMAINING end-to-end budget at
+        #   router admission (ISSUE 18); submitted_mono anchors the
+        #   decrement so a failover re-placement forwards only what
+        #   is genuinely left of the client's budget
+        self.submitted_mono = time.monotonic()
         self.recovering = False     # orphan-recovery once-latch
         self.epoch = 0              # fleet epoch the CURRENT placement
         #   was made under (fencing: a re-placement must carry an
@@ -330,7 +379,10 @@ class Router:
                  lease_ttl_s: float = DEFAULT_LEASE_TTL_S,
                  scale_policy: dict | None = None,
                  stream_replay_bytes: int = 4 << 20,
-                 takeover: bool = False):
+                 takeover: bool = False,
+                 priority_lanes: tuple | list | None = None,
+                 quarantine_x: float = 4.0,
+                 quarantine_probation: int = 3):
         if not backends:
             raise ValueError("route needs at least one backend")
         if not socket_path and not listen:
@@ -367,11 +419,33 @@ class Router:
         self._closing = threading.Event()
         self._next_id = 0
         self._rr = 0                 # placement tie-breaker
-        self._t0 = time.time()
+        self._t0 = time.monotonic()  # uptime anchor — monotonic, a
+        #   wall-clock step must not warp uptime_s (qa clock gate)
+        # ---- gray-failure defense (ISSUE 18): slow-member
+        # quarantine tuning + the brownout shed controller.
+        # priority_lanes mirrors the members' --priority-lanes tier
+        # order (highest first); shedding turns the LOWEST tier away
+        # first and the top tier is never shed — a brownout, not a
+        # blackout.  quarantine_x = the outlier multiple over the
+        # fleet-median poll round-trip EWMA (0 disables);
+        # quarantine_probation = clean polls before a quarantined
+        # member takes placements again.
+        self.priority_lanes = tuple(priority_lanes or ())
+        self.quarantine_x = float(quarantine_x)
+        self.quarantine_probation = max(1, int(quarantine_probation))
+        self._shed_level = 0         # how many tiers (from the
+        #   bottom) are currently turned away
+        self._shed_clean = 0         # consecutive pressure-free SLO
+        #   evaluations (hysteresis: de-escalate one tier per
+        #   _SHED_CLEAN_EXITS clean evals, never flap per-tick)
+        self._shed_last = 0.0        # the controller's own cadence
+        #   anchor — slo._last_eval is reset by stats-verb
+        #   evaluations too, so it cannot pace the shed loop
         self.failovers = 0           # member-death events handled
         self.recovered = {"resumed": 0, "requeued": 0, "restored": 0,
                           "cancelled": 0, "stream_preempted": 0,
-                          "stream_replayed": 0, "failed": 0}
+                          "stream_replayed": 0, "failed": 0,
+                          "deadline_exceeded": 0}
         # ---- router write-ahead journal (ISSUE 16): every routed
         # admission/placement/retirement + epoch bumps + member-set
         # snapshots, fsync'd per batch through the same JobJournal the
@@ -813,6 +887,10 @@ class Router:
             self._evict_jobs()
             if self.slo.due():
                 self.slo.evaluate()   # gauges fresh from the poll
+            self._shed_tick()   # every tick, self-paced: due() can
+            #   stay false forever under a fast stats-poll loop (the
+            #   stats verb evaluates directly), and the brownout must
+            #   not be starved by the operator watching the fleet
             if self.scaler is not None:
                 self.scaler.tick()
             if self.rjournal is not None \
@@ -831,6 +909,7 @@ class Router:
         a live member (the double-run corruption failover exists to
         prevent)."""
         for m in list(self.members.values()):
+            t_rpc = time.monotonic()
             try:
                 with ServiceClient(m.target, timeout=3.0) as c:
                     # the epoch lease rides the stats poll: every
@@ -843,6 +922,7 @@ class Router:
                            if self.epoch >= 1 else {})})
                 if not st.get("ok"):
                     raise ServiceError(f"stats failed: {st}")
+                lat_ms = (time.monotonic() - t_rpc) * 1000.0
                 stats = st["stats"]
                 lease = stats.get("lease")
                 lease = lease if isinstance(lease, dict) else {}
@@ -858,6 +938,15 @@ class Router:
                     # before the RPC — stop counting it as pressure
                     m.dispatched_since_poll = 0
                     m.fenced = bool(lease.get("fenced"))
+                    # gray-failure EWMAs (ISSUE 18): round-trip
+                    # latency + queue pressure, ~alpha 0.3 so one
+                    # stall neither dominates nor hides.  Only
+                    # SUCCESSFUL polls feed them — a refused connect
+                    # is death evidence (fail_streak), not latency.
+                    m.lat_ewma_ms = lat_ms if m.lat_ewma_ms <= 0.0 \
+                        else 0.3 * lat_ms + 0.7 * m.lat_ewma_ms
+                    m.depth_ewma = (0.3 * (m.queue_depth + m.running)
+                                    + 0.7 * m.depth_ewma)
                 if lease.get("accepted") is False:
                     # the member holds a NEWER epoch than ours: WE are
                     # the stale incarnation (a zombie primary racing
@@ -888,11 +977,80 @@ class Router:
                         down = True
                 if down:
                     self._member_down(m.name)
+        if count_failures:
+            # quarantine transitions only on the single-threaded
+            # health tick — a synchronous stats refresh racing the
+            # loop must not double-count one outlier poll into two
+            # strikes (the fail_streak rule, same reason)
+            self._quarantine_scan()
         self._refresh_gauges()
+
+    def _quarantine_scan(self) -> None:
+        """Slow-member quarantine (ISSUE 18): after each health tick,
+        compare every live member's poll-latency EWMA against the
+        fleet MEDIAN.  A member sustained past ``quarantine_x`` times
+        the median (with an absolute floor so microsecond-fast local
+        fleets don't quarantine noise) for ``_Q_STRIKES`` consecutive
+        polls is quarantined: no NEW placements, running jobs finish,
+        streams keep their member.  It probation-exits after
+        ``quarantine_probation`` consecutive clean polls.  The fleet
+        is never wedged: a member is only quarantined while at least
+        2 eligible members remain, and placement falls back to
+        quarantined members when nothing else is alive."""
+        if self.quarantine_x <= 0:
+            return
+        entered: list[tuple[str, float, float]] = []
+        exited: list[tuple[str, float, float]] = []
+        with self._lock:
+            sampled = [m for m in self.members.values()
+                       if m.alive and m.lat_ewma_ms > 0.0]
+            if len(sampled) < 2:
+                return      # a median of one member is the member
+            lats = sorted(m.lat_ewma_ms for m in sampled)
+            median = lats[len(lats) // 2]
+            cut = max(self.quarantine_x * median, _Q_FLOOR_MS)
+            eligible = sum(1 for m in sampled
+                           if not m.fenced and not m.quarantined)
+            for m in sampled:
+                if m.lat_ewma_ms > cut:
+                    m.q_strikes += 1
+                    m.q_clean = 0
+                    if (not m.quarantined
+                            and m.q_strikes >= _Q_STRIKES
+                            and eligible >= 2):
+                        m.quarantined = True
+                        m.quarantines += 1
+                        eligible -= 1
+                        entered.append((m.name, m.lat_ewma_ms,
+                                        median))
+                else:
+                    m.q_strikes = 0
+                    if m.quarantined:
+                        m.q_clean += 1
+                        if m.q_clean >= self.quarantine_probation:
+                            m.quarantined = False
+                            m.q_clean = 0
+                            exited.append((m.name, m.lat_ewma_ms,
+                                           median))
+        for name, lat, med in entered:
+            self.metrics["quarantines"].inc()
+            self.obs.event("member_quarantined", member=name,
+                           lat_ewma_ms=round(lat, 2),
+                           fleet_median_ms=round(med, 2))
+            self._say(f"member {name} QUARANTINED: poll latency "
+                      f"{lat:.0f} ms vs fleet median {med:.0f} ms — "
+                      "no new placements until it recovers")
+        for name, lat, med in exited:
+            self.obs.event("member_recovered", member=name,
+                           lat_ewma_ms=round(lat, 2),
+                           fleet_median_ms=round(med, 2))
+            self._say(f"member {name} left quarantine "
+                      f"({self.quarantine_probation} clean polls)")
 
     def _refresh_gauges(self) -> None:
         with self._lock:
-            rows = [(m.name, m.alive, m.queue_depth + m.running)
+            rows = [(m.name, m.alive, m.queue_depth + m.running,
+                     m.lat_ewma_ms, m.quarantined)
                     for m in self.members.values()]
             live = sum(1 for j in self.jobs.values()
                        if not j.retired and j.terminal is None)
@@ -900,10 +1058,16 @@ class Router:
                          if m.alive and m.fenced)
             scaled = sum(1 for m in self.members.values()
                          if m.alive and m.scaled)
-        for name, alive, depth in rows:
+            shed_level = self._shed_level
+        for name, alive, depth, lat, quar in rows:
             self.metrics["member_up"].set(1 if alive else 0,
                                           member=name)
             self.metrics["member_queue_depth"].set(depth, member=name)
+            self.metrics["member_latency_ewma"].set(round(lat, 2),
+                                                    member=name)
+            self.metrics["member_quarantined"].set(
+                1 if (alive and quar) else 0, member=name)
+        self.metrics["shedding"].set(shed_level)
         self.metrics["live_jobs"].set(live)
         self.metrics["epoch"].set(self.epoch)
         self.metrics["fenced_members"].set(fenced)
@@ -1003,6 +1167,92 @@ class Router:
             for j in retired[:excess]:
                 self.jobs.pop(j.fid, None)
 
+    # ---- brownout shedding (ISSUE 18) ----------------------------------
+    def _shed_tick(self) -> None:
+        """Overload controller, run every health tick on its OWN
+        cadence (``self.slo.eval_interval_s``), not gated on
+        ``slo.due()``: the stats verb evaluates the engine directly,
+        so a client polling stats faster than the eval interval would
+        keep ``due()`` false forever and starve this controller — the
+        operator watching the fleet would be the very thing stopping
+        it from shedding.  While a queue-pressure rule
+        (``fleet_queue_pressure`` or ``ledger_saturation``) is
+        firing, escalate the shed level one priority tier per tick —
+        lowest tier first, the top tier never — and de-escalate one
+        tier only after ``_SHED_CLEAN_EXITS`` consecutive clean ticks
+        (hysteresis).  Inert without ``--priority-lanes``: with one
+        implicit tier there is nothing to brown out that plain
+        queue_full doesn't already say."""
+        max_level = max(0, len(self.priority_lanes) - 1)
+        if max_level == 0:
+            return
+        now = time.monotonic()
+        if now - self._shed_last < self.slo.eval_interval_s:
+            return
+        self._shed_last = now
+        pressure = any(f.get("rule") in ("fleet_queue_pressure",
+                                         "ledger_saturation")
+                       for f in self.slo.firing())
+        level = self._shed_level
+        if pressure:
+            self._shed_clean = 0
+            if level < max_level:
+                self._shed_level = level + 1
+        elif level > 0:
+            self._shed_clean += 1
+            if self._shed_clean >= _SHED_CLEAN_EXITS:
+                self._shed_clean = 0
+                self._shed_level = level - 1
+        if self._shed_level == level:
+            return
+        shed_lanes = list(
+            self.priority_lanes[len(self.priority_lanes)
+                                - self._shed_level:])
+        self.metrics["shedding"].set(self._shed_level)
+        self.obs.event("fleet_shed_level", level=self._shed_level,
+                       was=level, lanes=shed_lanes)
+        self._journal([(REC_ROUTE_SHED,
+                        {"level": self._shed_level, "was": level,
+                         "lanes": shed_lanes})])
+        if self._shed_level > level:
+            self._say(f"OVERLOADED: shedding priority tier(s) "
+                      f"{','.join(shed_lanes) or '-'} "
+                      f"(level {self._shed_level}/{max_level}) until "
+                      "queue pressure clears")
+        else:
+            self._say(f"shed level down to {self._shed_level}"
+                      f"/{max_level}"
+                      + (f" (still shedding "
+                         f"{','.join(shed_lanes)})" if shed_lanes
+                         else " — admitting every tier again"))
+
+    def _shed_check(self, priority) -> dict | None:
+        """The admission-time half: a submit in one of the currently
+        shed tiers is turned away with a truthful ``overloaded`` +
+        ``retry_after_s`` BEFORE any member sees it.  None = admit."""
+        level = self._shed_level
+        if level <= 0 or not self.priority_lanes:
+            return None
+        lanes = self.priority_lanes      # highest tier first
+        lane = str(priority or "") or lanes[-1]
+        try:
+            rank = lanes.index(lane)
+        except ValueError:
+            rank = len(lanes) - 1   # a lane no member configured
+            #   carries no priority claim here — lowest tier
+        if rank < len(lanes) - level:
+            return None
+        self.metrics["jobs"].inc(outcome="rejected")
+        self.metrics["shed"].inc(lane=lane or "default")
+        return protocol.err(
+            protocol.ERR_OVERLOADED,
+            f"fleet is overloaded: priority tier {lane!r} is being "
+            f"shed (brownout level {level}/{len(lanes) - 1}) until "
+            "queue pressure clears; no member was asked — retry "
+            "after the suggested backoff or resubmit on a higher "
+            "tier", lane=lane or "default",
+            retry_after_s=round(1.0 + level, 1))
+
     def _members_by_depth(self) -> list[_Member]:
         """Alive members, least-loaded first: reported depth+running
         plus only the placements the member's LAST stats reply cannot
@@ -1015,6 +1265,13 @@ class Router:
             # their lease (a fence is a pause, not a death)
             alive = [m for m in self.members.values()
                      if m.alive and not m.fenced]
+            # quarantined members (gray failure, ISSUE 18) take no
+            # NEW placements — but a slow member still beats no
+            # member: with every live member quarantined, fall back
+            # to them rather than wedge the fleet
+            eligible = [m for m in alive if not m.quarantined]
+            if eligible:
+                alive = eligible
             self._rr += 1
             rr = self._rr
             order = sorted(
@@ -1127,6 +1384,16 @@ class Router:
             with self._lock:
                 job.recovering = False
 
+    def _deadline_left_ms(self, job: _FleetJob) -> int | None:
+        """Remaining end-to-end budget of a routed job (None = no
+        deadline): the budget at router arrival minus everything
+        spent since, so re-placements never hand a member more time
+        than the client has left."""
+        if job.deadline_ms is None:
+            return None
+        return job.deadline_ms - int(
+            (time.monotonic() - job.submitted_mono) * 1000.0)
+
     def _recover_job_inner(self, job: _FleetJob,
                            row: dict | None) -> None:
         dead = job.member
@@ -1201,6 +1468,23 @@ class Router:
         if resume and "--resume" not in argv:
             argv = argv + ["--resume"]
         fwd = dict(job.frame, args=argv)
+        left = self._deadline_left_ms(job)
+        if left is not None:
+            if left <= 0:
+                # the budget died with the member: land the same
+                # truthful verdict the member itself would have
+                # reached — resumable, journal-honest, no sibling
+                # burns a queue slot on an already-expired job
+                self._cache_terminal(job, JOB_PREEMPTED, 75, (
+                    "deadline_exceeded: the end-to-end budget was "
+                    "already spent when its member died; work up to "
+                    "the last durable checkpoint survives — "
+                    "resubmit with --resume and a fresh "
+                    "--deadline-s"))
+                self.recovered["deadline_exceeded"] += 1
+                self.metrics["recovered"].inc(how="deadline_exceeded")
+                return
+            fwd["deadline_ms"] = left
         placed = False
         for m in self._members_by_depth():
             if m.name == dead:
@@ -1280,6 +1564,11 @@ class Router:
             ended = job.ended
         if frames is None:
             return False
+        left = self._deadline_left_ms(job)
+        if left is not None and left <= 0:
+            return False   # budget spent: the caller's preempted-
+            #   resumable verdict is the truthful answer, and a
+            #   sibling would refuse the expired admission anyway
         epoch = readmit_epoch_guard(job.epoch, self.epoch)
         for m in self._members_by_depth():
             if m.name == dead:
@@ -1292,6 +1581,8 @@ class Router:
                 resp = c.request({
                     "cmd": "stream", **job.frame,
                     "client": job.client,
+                    **({"deadline_ms": left}
+                       if left is not None else {}),
                     **({"trace_id": job.trace_id}
                        if job.trace_id else {}),
                     **({"priority": job.priority}
@@ -1446,6 +1737,10 @@ class Router:
 
     def _route_submit(self, req: dict, peer: str | None,
                       stream: bool) -> dict:
+        t_in = time.monotonic()   # the deadline decrement anchor:
+        #   every millisecond this frame spends inside the router
+        #   (cache probe, affinity pass, placement retries) comes out
+        #   of the client's end-to-end budget before a member sees it
         if self._draining:
             return protocol.err(protocol.ERR_DRAINING,
                                 "fleet router is draining")
@@ -1453,6 +1748,15 @@ class Router:
         if not isinstance(client, str) or len(client) > 64:
             return protocol.err(protocol.ERR_BAD_REQUEST,
                                 "client must be a short identifier")
+        deadline_ms, dl_err = protocol.parse_deadline_ms(req)
+        if dl_err is not None:
+            return dl_err
+        shed = self._shed_check(req.get("priority"))
+        if shed is not None:
+            self.obs.event("route_shed", client=client or "default",
+                           lane=str(req.get("priority") or "")
+                           or "default")
+            return shed
         trace_id = req.get("trace_id")
         frame = {"args": req.get("args"), "cwd": req.get("cwd")}
         if req.get("priority") is not None:
@@ -1483,6 +1787,23 @@ class Router:
                                          cache_family)
         last_reject: dict | None = None
         for m in order:
+            fwd_deadline: dict = {}
+            if deadline_ms is not None:
+                # remaining-budget arithmetic: subtract the time this
+                # frame has already spent inside the router before
+                # handing the member what is genuinely left
+                rem = deadline_ms - int(
+                    (time.monotonic() - t_in) * 1000.0)
+                if rem <= 0:
+                    self.metrics["jobs"].inc(outcome="rejected")
+                    return protocol.err(
+                        protocol.ERR_DEADLINE_EXCEEDED,
+                        "end-to-end deadline budget "
+                        f"({deadline_ms} ms at the router) was spent "
+                        "in routing before any member admitted the "
+                        "job — nothing was admitted; resubmit with a "
+                        "fresh --deadline-s", deadline_ms=rem)
+                fwd_deadline = {"deadline_ms": rem}
             try:
                 self.ledger.admit(client, m.name)
             except QueueFull as e:
@@ -1506,7 +1827,7 @@ class Router:
             try:
                 resp = c.request({
                     "cmd": "stream" if stream else "submit",
-                    **frame, "client": client,
+                    **frame, "client": client, **fwd_deadline,
                     **({"trace_id": trace_id}
                        if isinstance(trace_id, str) and trace_id
                        else {})})
@@ -1547,6 +1868,13 @@ class Router:
                                     frame, m.name, resp["job_id"],
                                     stream=stream)
                     job.epoch = self.epoch
+                    if deadline_ms is not None:
+                        # anchor at frame ARRIVAL, not placement —
+                        # a failover re-admission must forward what
+                        # is left of the CLIENT's budget, and the
+                        # routing time above already spent some
+                        job.deadline_ms = deadline_ms
+                        job.submitted_mono = t_in
                     if stream:
                         job.sconn = c
                         if self.stream_replay_bytes <= 0:
@@ -2070,12 +2398,18 @@ class Router:
                 "journal": m.journal_path,
                 "fenced": m.fenced,
                 "scaled": m.scaled,
+                # gray-failure columns (ISSUE 18) — the fleet-aware
+                # `top` renders quarantine state from here
+                "quarantined": m.quarantined,
+                "lat_ewma_ms": round(m.lat_ewma_ms, 2),
+                "depth_ewma": round(m.depth_ewma, 2),
+                "quarantines": m.quarantines,
             })
         return {
             "stats_version": SERVICE_STATS_VERSION,
             "protocol_version": protocol.PROTOCOL_VERSION,
             "router": True,
-            "uptime_s": round(time.time() - self._t0, 3),
+            "uptime_s": round(time.monotonic() - self._t0, 3),
             "draining": self._draining,
             "queue_depth": depth,
             "running": running,
@@ -2101,6 +2435,8 @@ class Router:
                 "jobs_routed": self.ledger.admitted,
                 "jobs_recovered": dict(self.recovered),
                 "live_jobs": live,
+                "quarantined": sum(1 for m in members
+                                   if m.alive and m.quarantined),
             },
             # additive: router HA (ISSUE 16) — WAL, epoch fencing,
             # takeover provenance, and the scaler's own accounting
@@ -2121,6 +2457,23 @@ class Router:
                 },
                 "scaler": self.scaler.stats_dict()
                 if self.scaler is not None else {"enabled": False},
+                # additive: gray-failure defense (ISSUE 18) — the
+                # quarantine policy in force and the live brownout
+                # shed state (0 = admitting every tier)
+                "quarantine": {
+                    "x": self.quarantine_x,
+                    "probation": self.quarantine_probation,
+                    "members": sum(1 for m in members
+                                   if m.alive and m.quarantined),
+                },
+                "shed": {
+                    "level": self._shed_level,
+                    "priority_lanes": list(self.priority_lanes),
+                    "lanes_shed": list(
+                        self.priority_lanes[len(self.priority_lanes)
+                                            - self._shed_level:])
+                    if self._shed_level > 0 else [],
+                },
             },
             # additive: the aggregated fleet verdict (ISSUE 14) —
             # the fleet-aware `top`'s alerts pane reads it here.
@@ -2226,6 +2579,41 @@ def route_main(argv: list[str], stdout=None, stderr=None) -> int:
             stderr.write(f"{_ROUTE_USAGE}\nInvalid "
                          f"--stream-replay-bytes value: {val}\n")
             return EXIT_USAGE
+    priority_lanes: tuple[str, ...] | None = None
+    val = opts.pop("priority-lanes", None)
+    if val is not None:
+        from pwasm_tpu.service.daemon import _CLIENT_RE
+        lanes = [l.strip() for l in val.split(",")]
+        if (not lanes or any(not l or not _CLIENT_RE.match(l)
+                             for l in lanes)
+                or len(set(lanes)) != len(lanes)):
+            stderr.write(f"{_ROUTE_USAGE}\nInvalid --priority-lanes "
+                         f"value: {val}\n")
+            return EXIT_USAGE
+        priority_lanes = tuple(lanes)
+    quarantine_x = 4.0
+    val = opts.pop("quarantine-x", None)
+    if val is not None:
+        import math
+        try:
+            quarantine_x = float(val)
+            if quarantine_x < 0 or not math.isfinite(quarantine_x) \
+                    or (0 < quarantine_x < 1.0):
+                raise ValueError
+        except (TypeError, ValueError):
+            stderr.write(f"{_ROUTE_USAGE}\nInvalid --quarantine-x "
+                         f"value: {val} (a multiple >= 1, or 0 to "
+                         "disable)\n")
+            return EXIT_USAGE
+    quarantine_probation = 3
+    val = opts.pop("quarantine-probation", None)
+    if val is not None:
+        if val.isascii() and val.isdigit() and int(val) >= 1:
+            quarantine_probation = int(val)
+        else:
+            stderr.write(f"{_ROUTE_USAGE}\nInvalid "
+                         f"--quarantine-probation value: {val}\n")
+            return EXIT_USAGE
     scale_policy = None
     val = opts.pop("scale-policy", None)
     if val is not None:
@@ -2280,7 +2668,10 @@ def route_main(argv: list[str], stdout=None, stderr=None) -> int:
         result_cache=result_cache,
         result_cache_max_bytes=result_cache_max_bytes,
         lease_ttl_s=lease_ttl, scale_policy=scale_policy,
-        stream_replay_bytes=stream_replay_bytes)
+        stream_replay_bytes=stream_replay_bytes,
+        priority_lanes=priority_lanes,
+        quarantine_x=quarantine_x,
+        quarantine_probation=quarantine_probation)
     if standby_of is not None:
         from pwasm_tpu.fleet.standby import run_standby
         return run_standby(standby_of, stderr=stderr,

@@ -194,10 +194,18 @@ class FleetScaler:
         return names
 
     def _census(self):
-        """(alive member count, an idle scaler-owned member or None)."""
+        """(serving member count, an idle scaler-owned member or
+        None).  Quarantined members (gray failure, ISSUE 18) are
+        excluded from the count — they take no new placements, so
+        for capacity purposes they are missing and sustained
+        pressure can spawn a replacement; they are also never the
+        idle-retire candidate (retiring the slow member the drill is
+        watching would erase the probation-exit evidence — the
+        quarantine loop owns its fate)."""
         r = self.router
         with r._lock:
-            alive = [m for m in r.members.values() if m.alive]
+            alive = [m for m in r.members.values()
+                     if m.alive and not m.quarantined]
             idle = None
             for m in alive:
                 if m.scaled and m.queue_depth == 0 and m.running == 0:

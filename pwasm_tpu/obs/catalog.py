@@ -336,6 +336,11 @@ def build_cache_metrics(reg: MetricsRegistry) -> dict:
     m["insertions"] = reg.counter(
         "pwasm_cache_insertions_total",
         "Completed jobs whose outputs were stored in the result cache")
+    m["insert_errors"] = reg.counter(
+        "pwasm_cache_insert_errors_total",
+        "Result-cache inserts that failed and degraded to "
+        "pass-through (ENOSPC and kin): the job was served from its "
+        "real run, only the cache write was skipped")
     m["evictions"] = reg.counter(
         "pwasm_cache_evictions_total",
         "Result-cache entries dropped (LRU past "
@@ -441,6 +446,35 @@ def build_fleet_metrics(reg: MetricsRegistry) -> dict:
         "Terminal replies rejected at the router edge because the "
         "job had moved to a newer generation (a fenced zombie's "
         "completion arriving after failover re-placed the job)")
+    # gray-failure defense (ISSUE 18): latency-outlier quarantine +
+    # brownout shedding
+    m["member_latency_ewma"] = reg.gauge(
+        "pwasm_fleet_member_latency_ewma_ms",
+        "EWMA of the member's health-poll round-trip latency in "
+        "milliseconds — the slow-member outlier detector's input "
+        "(a member sustaining >K x the fleet median is quarantined)",
+        labels=("member",))
+    m["member_quarantined"] = reg.gauge(
+        "pwasm_fleet_member_quarantined",
+        "1 while the member is quarantined as a latency outlier "
+        "(alive but degraded: no new placements, existing jobs "
+        "finish, probation-exits after clean polls), else 0",
+        labels=("member",))
+    m["quarantines"] = reg.counter(
+        "pwasm_fleet_quarantines_total",
+        "Quarantine entries (a live member crossed the latency "
+        "outlier threshold) — each one is a gray failure the router "
+        "routed around without a human",)
+    m["shed"] = reg.counter(
+        "pwasm_fleet_jobs_shed_total",
+        "Admissions shed at the router edge under brownout (fleet "
+        "queue pressure past the SLO threshold): answered a truthful "
+        "overloaded + retry_after_s, lowest priority lane first, "
+        "before any member saw the frame", labels=("lane",))
+    m["shedding"] = reg.gauge(
+        "pwasm_fleet_shedding",
+        "1 while brownout shedding is active (hysteresis-damped), "
+        "else 0")
     return m
 
 
@@ -585,6 +619,29 @@ DEFAULT_FLEET_SLO_RULES = (
                 "poll re-grants the lease — if it stays fenced, the "
                 "member is heartbeating a stale router: check for a "
                 "zombie primary still holding the journal"},
+    # gray-failure defense (ISSUE 18): the brownout trigger — member
+    # queues saturated fleet-wide.  The router's shedding keys off
+    # this rule (or ledger_saturation) firing; hysteresis lives in
+    # the shed controller, the rule just states the pressure truth.
+    {"name": "fleet_queue_pressure", "severity": "warn",
+     "kind": "threshold",
+     "metric": "pwasm_fleet_member_queue_depth", "op": ">",
+     "value": 8, "for_s": 1.0,
+     "runbook": "a member's queued+running depth is sustained past "
+                "the brownout threshold; under --priority-lanes the "
+                "router sheds the lowest lane with a truthful "
+                "overloaded + retry_after_s until pressure clears — "
+                "add members (or let --scale-policy spawn them) if "
+                "it keeps firing"},
+    {"name": "member_quarantined", "severity": "warn",
+     "kind": "threshold",
+     "metric": "pwasm_fleet_member_quarantined", "op": ">",
+     "value": 0, "for_s": 0.0,
+     "runbook": "a live member is a sustained latency outlier (>K x "
+                "the fleet median poll round-trip) and is quarantined "
+                "from new placements; it probation-exits by itself "
+                "after clean polls — investigate the host (slow disk, "
+                "GC stalls, half-partition) if it cycles in and out"},
 )
 
 

@@ -129,8 +129,8 @@ EXCLUDED = frozenset((
     "v", "D",                      # verbosity (stderr only)
     "d", "p", "m",                 # parsed-but-unread reference quirks
     "device", "batch", "shard",    # placement: bytes are parity-gated
-    "max-retries", "device-deadline", "fallback", "recover",
-    "reprobe-interval", "reprobe-max",
+    "max-retries", "device-deadline", "deadline-s", "fallback",
+    "recover", "reprobe-interval", "reprobe-max",
     "profile", "stats", "trace-json", "log-json",
     "log-json-max-bytes", "trace-max-events", "metrics-textfile",
     "compile-cache-dir",
@@ -467,6 +467,9 @@ class CacheStore:
         self.hits = 0
         self.misses = 0
         self.insertions = 0
+        self.insert_errors = 0   # failed inserts (ENOSPC and kin):
+        #   the degrade-to-pass-through counter — the job was served,
+        #   only the cache write was skipped (ISSUE 18 satellite)
         self.evictions = 0
         self.delta_hits = 0
         self.delta_records_served = 0
@@ -570,6 +573,7 @@ class CacheStore:
         setattr(self, what, getattr(self, what) + 1)
         c = self.metrics.get({"hits": "hits", "misses": "misses",
                               "insertions": "insertions",
+                              "insert_errors": "insert_errors",
                               "evictions": "evictions"}[what])
         if c is not None:
             c.inc()
@@ -909,6 +913,10 @@ class CacheStore:
                         os.unlink(self._blob_path(key, kind))
                     except OSError:
                         pass
+                # degrade to pass-through (ISSUE 18 satellite): the
+                # job was served either way — count the skipped
+                # insert so a full disk is VISIBLE, never silent
+                self._count("insert_errors")
                 return False
             # re-inserts (two members racing one job on a shared dir)
             # net out here: bytes are always recounted from disk,
@@ -989,6 +997,7 @@ class CacheStore:
                 "hits": self.hits,
                 "misses": self.misses,
                 "insertions": self.insertions,
+                "insert_errors": self.insert_errors,
                 "evictions": self.evictions,
                 "delta_hits": self.delta_hits,
                 "delta_records_served": self.delta_records_served,

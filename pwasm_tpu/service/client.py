@@ -24,7 +24,8 @@ from pwasm_tpu.service import protocol
 _CLIENT_USAGE = """Usage:
  pwasm-tpu submit --socket=TARGET [--no-wait] [--timeout=S]
                   [--retry[=N]] [--client=NAME] [--priority=LANE]
-                  [--client-token=TOK] [--] <cli args...>
+                  [--client-token=TOK] [--deadline-s=S]
+                  [--] <cli args...>
 
  TARGET is a unix socket path or a HOST:PORT TCP endpoint (a `serve
  --listen` daemon or a `route` fleet router — docs/FLEET.md).  On TCP
@@ -43,9 +44,18 @@ _CLIENT_USAGE = """Usage:
      --client=NAME overrides the fair-share identity (default: the
      socket-peer uid); --priority=LANE targets a --priority-lanes
      tier on the daemon.
+     --deadline-s=S arms an END-TO-END deadline: every frame carries
+     the remaining budget (deadline_ms), each hop subtracts the time
+     it spent (router queue/spill, daemon queue + lease wait), and a
+     job that cannot finish in budget stops at its next batch
+     boundary with a valid resumable checkpoint and a
+     deadline_exceeded verdict (rc 75 — resume it with a fresh
+     budget, or don't).  The verdict JSON shows the budget
+     arithmetic (docs/RESILIENCE.md).
 
  pwasm-tpu stream --socket=PATH [--timeout=S] [--client=NAME]
-                  [--priority=LANE] [--] <cli args...>
+                  [--priority=LANE] [--deadline-s=S]
+                  [--] <cli args...>
      open a STREAM job (docs/STREAMING.md) and feed it the PAF read
      from stdin, record-at-a-time — `minimap2 --cs ... | pwasm-tpu
      stream --socket=S -- -r cds.fa -o out.dfa` is the pipe shape.
@@ -136,13 +146,23 @@ class ServiceClient:
     def __init__(self, socket_path: str, timeout: float | None = None,
                  max_frame_bytes: int = protocol.MAX_FRAME_BYTES,
                  trace_id: str | None = None,
-                 client_token: str | None = None):
+                 client_token: str | None = None,
+                 deadline_s: float | None = None):
         from pwasm_tpu.fleet.transport import connect
         from pwasm_tpu.obs.events import new_run_id
         self.socket_path = socket_path
         self.max_frame_bytes = max_frame_bytes
         self.trace_id = trace_id or new_run_id()
         self.client_token = client_token
+        # ---- end-to-end deadline (ISSUE 18): --deadline-s mints ONE
+        # monotonic deadline for this connection's jobs; every frame
+        # carries the REMAINING budget as integer deadline_ms, so each
+        # hop (router, daemon, supervisor) sees what is truly left
+        # after the time already spent upstream.  None = no deadline:
+        # frames are byte-identical to before the field existed.
+        self.deadline_s = deadline_s
+        self._deadline_mono = (time.monotonic() + deadline_s
+                               if deadline_s else None)
         try:
             self._sock = connect(socket_path, timeout=timeout)
         except (OSError, ValueError) as e:
@@ -161,7 +181,21 @@ class ServiceClient:
         obj.setdefault("trace_id", self.trace_id)
         if self.client_token:
             obj.setdefault("client_token", self.client_token)
+        if self._deadline_mono is not None:
+            # remaining budget re-read per frame (never cached): a
+            # frame sent after a long result wait must carry the truth
+            obj.setdefault("deadline_ms",
+                           max(0, int(self.deadline_remaining_s()
+                                      * 1000)))
         return self.request(obj)
+
+    def deadline_remaining_s(self) -> float:
+        """Seconds left in this connection's ``--deadline-s`` budget
+        (may be negative once spent); ``inf`` when no deadline is
+        armed — the client side of the remaining-budget arithmetic."""
+        if self._deadline_mono is None:
+            return float("inf")
+        return self._deadline_mono - time.monotonic()
 
     def request(self, obj: dict) -> dict:
         try:
@@ -457,6 +491,9 @@ def _parse_client_argv(argv: list[str],
             opts["client_token"] = a.split("=", 1)[1]
         elif a.startswith("--priority="):
             opts["priority"] = a.split("=", 1)[1]
+        elif a.startswith("--deadline-s=") and cmd in ("submit",
+                                                       "stream"):
+            opts["deadline_s"] = a.split("=", 1)[1]
         elif a.startswith("--trace-id="):
             opts["trace_id"] = a.split("=", 1)[1]
         elif a.startswith("--trace-json="):
@@ -477,11 +514,17 @@ def _parse_client_argv(argv: list[str],
     return opts, argv[i:]
 
 
-def _job_verdict(resp: dict, job_id: str, stdout, stderr) -> int:
+def _job_verdict(resp: dict, job_id: str, stdout, stderr,
+                 client=None) -> int:
     """Render a ``result`` response the way ``submit`` always has (one
     JSON verdict line, the stderr tail of a non-done job) and return
     the shell exit code — shared by the ``submit`` and ``stream``
-    verbs so the two cannot drift."""
+    verbs so the two cannot drift.  When the connection carries a
+    ``--deadline-s`` budget, the verdict shows the remaining-budget
+    arithmetic (budget granted, seconds left at verdict time) so an
+    operator can see at a glance whether a resume is worth a fresh
+    budget; without a deadline the verdict is byte-identical to
+    before the field existed."""
     if not resp.get("ok"):
         stderr.write(f"Error: result failed: {resp}\n")
         return EXIT_FATAL
@@ -491,10 +534,14 @@ def _job_verdict(resp: dict, job_id: str, stdout, stderr) -> int:
                      "--timeout\n")
         return EXIT_FATAL
     job = resp["job"]
-    json.dump({"job_id": job_id, "state": job["state"],
+    verdict = {"job_id": job_id, "state": job["state"],
                "rc": resp.get("rc"), "detail": job.get("detail"),
-               "trace_id": job.get("trace_id")},
-              stdout)
+               "trace_id": job.get("trace_id")}
+    if client is not None and client.deadline_s:
+        verdict["deadline"] = {
+            "budget_s": round(float(client.deadline_s), 3),
+            "remaining_s": round(client.deadline_remaining_s(), 3)}
+    json.dump(verdict, stdout)
     stdout.write("\n")
     tail = resp.get("stderr_tail") or ""
     if tail and job["state"] != "done":
@@ -600,6 +647,18 @@ def client_main(cmd: str, argv: list[str], stdout=None,
         except (TypeError, ValueError):
             stderr.write(f"{_CLIENT_USAGE}\nInvalid --timeout value: "
                          f"{opts['timeout']}\n")
+            return EXIT_USAGE
+    deadline_s: float | None = None
+    if "deadline_s" in opts:
+        import math
+        try:
+            deadline_s = float(opts["deadline_s"])
+            if deadline_s <= 0 or not math.isfinite(deadline_s):
+                raise ValueError
+        except (TypeError, ValueError):
+            stderr.write(f"{_CLIENT_USAGE}\nInvalid --deadline-s "
+                         f"value: {opts['deadline_s']} (need a "
+                         "positive finite number of seconds)\n")
             return EXIT_USAGE
     # --trace-json: record THIS process's side of the job (the RPC
     # spans) as a wall-anchored Chrome trace — the `trace-merge`
@@ -717,7 +776,8 @@ def client_main(cmd: str, argv: list[str], stdout=None,
                 src = iter(sys.stdin.readline, "")
             with ServiceClient(
                     sock, trace_id=opts.get("trace_id"),
-                    client_token=opts.get("client_token")) as c:
+                    client_token=opts.get("client_token"),
+                    deadline_s=deadline_s) as c:
                 t0 = tracer.now() if tracer is not None else 0.0
                 resp = c.stream(job_argv, src,
                                 client=opts.get("client"),
@@ -737,7 +797,8 @@ def client_main(cmd: str, argv: list[str], stdout=None,
                 resp = c.result(job_id, wait=True, timeout=timeout)
                 _span("result_wait", t0, c)
             _write_trace()
-            return _job_verdict(resp, job_id, stdout, stderr)
+            return _job_verdict(resp, job_id, stdout, stderr,
+                                client=c)
         # submit
         if not job_argv:
             stderr.write(f"{_CLIENT_USAGE}\nError: submit needs the "
@@ -753,7 +814,8 @@ def client_main(cmd: str, argv: list[str], stdout=None,
             retries = int(val)
         with ServiceClient(
                 sock, trace_id=opts.get("trace_id"),
-                client_token=opts.get("client_token")) as c:
+                client_token=opts.get("client_token"),
+                deadline_s=deadline_s) as c:
             for attempt in range(retries + 1):
                 t0 = tracer.now() if tracer is not None else 0.0
                 resp = c.submit(job_argv, client=opts.get("client"),
@@ -795,7 +857,7 @@ def client_main(cmd: str, argv: list[str], stdout=None,
             resp = c.result(job_id, wait=True, timeout=timeout)
             _span("result_wait", t0, c)
         _write_trace()
-        return _job_verdict(resp, job_id, stdout, stderr)
+        return _job_verdict(resp, job_id, stdout, stderr, client=c)
     except ServiceError as e:
         stderr.write(f"Error: {e}\n")
         # the client-side trace is most valuable exactly when the

@@ -463,6 +463,14 @@ class Daemon:
         self.spool_threshold_bytes = spool_threshold_bytes
         self.spool_dir = spool_dir if spool_dir is not None \
             else socket_path + ".spool"
+        # ENOSPC degradation (ISSUE 18 satellite): a full disk under
+        # the spool or cache dir degrades to pass-through — results
+        # are still served from RAM / outputs still written, only the
+        # spool/insert is skipped.  Warn ONCE per condition (a busy
+        # daemon must not log one line per job for the same full
+        # disk); the counters carry the ongoing truth.
+        self._spool_warned = False
+        self._cache_insert_warned = False
         # persistent XLA compilation cache (ROADMAP item 2b): carried
         # on the warm context so every job's device path arms it (via
         # the jaxcompat shim) before its first compile
@@ -1162,9 +1170,18 @@ class Daemon:
             os.makedirs(self.spool_dir, exist_ok=True)
             write_durable_text(path, out)
         except OSError as e:
-            self._say(f"warning: cannot spool result for {job.id}: "
-                      f"{e} (result stays in memory)")
+            if not self._spool_warned:
+                self._spool_warned = True
+                self._say(f"warning: cannot spool results "
+                          f"({type(e).__name__}: {e}, first on "
+                          f"{job.id}) — results stay in memory until "
+                          "the spool dir is writable again; warning "
+                          "once, counting every skip")
+            self.obs.event("result_spool_error", job_id=job.id,
+                           error=type(e).__name__)
             return
+        self._spool_warned = False   # a successful spool re-arms the
+        #                              warning: the NEXT outage logs
         job.spool = {"path": path, "bytes": len(out)}
         job.stats = None
         job.stderr_tail = ""
@@ -1347,7 +1364,8 @@ class Daemon:
             # flight accounting (ISSUE 11): queue wait ends at this
             # dequeue; the lease wait is its own phase — the two must
             # not overlap or the accounted sum overshoots the wall
-            queue_wait = max(0.0, time.time() - job.submitted_s)
+            queue_wait = max(0.0,
+                             time.monotonic() - job.submitted_mono)
             if job.flight is not None:
                 job.flight.note("queue_wait", queue_wait)
             t_wait = time.monotonic()
@@ -1461,7 +1479,49 @@ class Daemon:
         self.obs.event("job_preempt_leaseless", job_id=job.id)
         job.done.set()
 
+    def _deadline_remaining_s(self, job: Job) -> float | None:
+        """The job's remaining end-to-end budget in seconds (ISSUE
+        18): the admitted ``deadline_ms`` minus everything spent since
+        admission — queue wait and lease wait included, measured on
+        the monotonic clock.  None when the job carries no deadline."""
+        if job.deadline_ms is None:
+            return None
+        return (job.deadline_ms / 1000.0
+                - (time.monotonic() - job.submitted_mono))
+
+    def _finish_deadline_spent(self, job: Job) -> None:
+        """A job whose end-to-end budget ran out before exec (queue +
+        lease wait ate it): land terminal WITHOUT running — rc 75,
+        the same resumable contract a drain preemption gives, detail
+        prefixed ``deadline_exceeded`` so clients and the router can
+        tell a budget expiry from a drain.  Journaled truthfully (a
+        finish with no start record — the job never ran)."""
+        job.state = JOB_PREEMPTED
+        job.rc = EXIT_PREEMPTED
+        job.detail = ("deadline_exceeded: the end-to-end budget "
+                      f"({job.deadline_ms} ms at admission) was spent "
+                      "in queue + lease wait before exec; resubmit "
+                      "with --resume and a fresh --deadline-s")
+        job.finished_s = time.time()
+        self.stats.jobs_preempted += 1
+        self.stats.jobs_deadline_exceeded += 1
+        self.svc_metrics["jobs"].inc(outcome="deadline_exceeded")
+        self._journal_append(REC_FINISH, job_id=job.id,
+                             state=JOB_PREEMPTED, rc=EXIT_PREEMPTED,
+                             detail=job.detail)
+        self.obs.event("job_deadline_exceeded", job_id=job.id,
+                       trace_id=job.trace_id, ran=False)
+
     def _run_job(self, job: Job, lease) -> None:
+        # end-to-end deadline (ISSUE 18): subtract the queue + lease
+        # wait from the admitted budget HERE, at the exec boundary —
+        # a spent budget lands terminal without burning a device
+        # second; a live one rides into the run as --deadline-s, where
+        # the cli's drain timer enforces it at batch boundaries
+        remaining_s = self._deadline_remaining_s(job)
+        if remaining_s is not None and remaining_s <= 0:
+            self._finish_deadline_spent(job)
+            return
         job.state = JOB_RUNNING
         job.started_s = time.time()
         if job.stream:
@@ -1488,11 +1548,18 @@ class Daemon:
                         trace_id=job.trace_id, flight=job.flight)
         rc: int | None = None
         kw = {"input_stream": job.feed} if job.stream else {}
+        exec_argv = job.argv
+        if remaining_s is not None:
+            # pass the REMAINING budget down, not the original: the
+            # run's own --deadline-s timer then enforces exactly what
+            # is left after this daemon's queue + lease wait
+            exec_argv = list(job.argv) \
+                + [f"--deadline-s={max(remaining_s, 0.001):.3f}"]
         try:
             with self.obs.span("job_exec", job_id=job.id,
                                trace_id=job.trace_id,
                                lane=lease.lane):
-                rc = self._runner(job.argv, stdout=job.outbuf,
+                rc = self._runner(exec_argv, stdout=job.outbuf,
                                   stderr=job.errbuf, warm=warm, **kw)
         except BaseException as e:   # InjectedKill, stray PwasmError —
             # a dying job must never take the daemon down with it
@@ -1543,6 +1610,22 @@ class Daemon:
             job.detail = ("cancelled at a batch boundary; the partial "
                           "report is checkpointed (resumable)")
             self.stats.jobs_cancelled += 1
+        elif rc == EXIT_PREEMPTED and job.drain is not None \
+                and str(job.drain.reason
+                        or "").startswith("deadline_exceeded"):
+            # the run's own --deadline-s timer pulled the drain flag:
+            # same resumable shape as a drain preemption, but the
+            # verdict must say WHY — the client decides whether a
+            # resume deserves a fresh budget
+            job.state = JOB_PREEMPTED
+            job.detail = ("deadline_exceeded: stopped at a batch "
+                          "boundary with a valid resumable "
+                          "checkpoint; --resume with a fresh "
+                          "--deadline-s completes it")
+            self.stats.jobs_preempted += 1
+            self.stats.jobs_deadline_exceeded += 1
+            self.obs.event("job_deadline_exceeded", job_id=job.id,
+                           trace_id=job.trace_id, ran=True)
         elif rc == EXIT_PREEMPTED:
             job.state = JOB_PREEMPTED
             job.detail = ("preempted by service drain; --resume "
@@ -1634,7 +1717,8 @@ class Daemon:
                client: str | None = None,
                priority: str | None = None,
                stream: bool = False,
-               trace_id: str | None = None) -> Job:
+               trace_id: str | None = None,
+               deadline_ms: int | None = None) -> Job:
         """Validate + admit one job (raises Draining/QueueFull/
         ValueError).  Also the in-process API the tests drive.
         ``cwd`` is the CLIENT's working directory: relative paths in
@@ -1675,6 +1759,16 @@ class Daemon:
             raise ValueError(
                 "trace_id must be a short identifier "
                 "([A-Za-z0-9_.:@/-]{1,64})")
+        if deadline_ms is not None:
+            # the REMAINING end-to-end budget as of this hop (ISSUE
+            # 18); 0/negative is valid on the wire — the DISPATCH
+            # layer answers it deadline_exceeded before calling here
+            if isinstance(deadline_ms, bool) \
+                    or not isinstance(deadline_ms, int) \
+                    or deadline_ms <= 0:
+                raise ValueError(
+                    "deadline_ms must be a positive integer "
+                    "millisecond budget")
         if priority:
             lanes = [l for l in self.queue.priority_lanes if l]
             if not lanes:
@@ -1765,6 +1859,8 @@ class Daemon:
             job = Job(id=f"job-{self._next_id:04d}", argv=exec_argv,
                       client=client, priority=priority,
                       trace_id=trace_id)
+        job.deadline_ms = deadline_ms   # the monotonic anchor is
+        #   Job.submitted_mono (defaulted at construction, just now)
         job.cache = cache_row      # (key, classified) on a cacheable
         #   miss: _run_job inserts the finished outputs under it
         job.delta = delta_served
@@ -1794,7 +1890,9 @@ class Daemon:
         self._journal_append(REC_ADMIT, job_id=job.id,
                              argv=base_argv, client=client,
                              priority=priority, trace_id=trace_id,
-                             **({"stream": True} if stream else {}))
+                             **({"stream": True} if stream else {}),
+                             **({"deadline_ms": deadline_ms}
+                                if deadline_ms else {}))
         if delta_served is not None:
             # truthful journal shape: a delta job is NOT a pure hit —
             # the cache_hit record carries the computed-vs-served
@@ -1955,6 +2053,18 @@ class Daemon:
         if insert_from_paths(self.cache, key, cls, stats=job.stats):
             self.obs.event("cache_insert", job_id=job.id,
                            trace_id=job.trace_id)
+            self._cache_insert_warned = False   # writable again: the
+            #                                     next outage warns
+        elif not self._cache_insert_warned:
+            # pass-through degradation (ISSUE 18 satellite): the job
+            # was served from its real run — only the cache write was
+            # skipped (full disk, drifted key, unreadable output).
+            # One warning per outage; insert_errors counts each skip.
+            self._cache_insert_warned = True
+            self._say(f"warning: result-cache insert skipped (first "
+                      f"on {job.id}) — serving continues without "
+                      "caching; see cache.insert_errors / "
+                      "pwasm_cache_insert_errors_total")
 
     def _retry_after_s(self) -> float:
         """The queue_full backoff hint: roughly one recent job's wall
@@ -2012,12 +2122,16 @@ class Daemon:
             return protocol.ok(lease=self.epoch_lease.as_dict())
         if cmd == "submit":
             client = self._resolve_client(req, peer)
+            deadline_ms, dl_err = protocol.parse_deadline_ms(req)
+            if dl_err is not None:
+                return dl_err
             try:
                 job = self.submit(req.get("args"),
                                   cwd=req.get("cwd"),
                                   client=client,
                                   priority=req.get("priority"),
-                                  trace_id=req.get("trace_id"))
+                                  trace_id=req.get("trace_id"),
+                                  deadline_ms=deadline_ms)
             except ValueError as e:
                 return protocol.err(protocol.ERR_BAD_REQUEST, str(e))
             except Draining as e:
@@ -2049,13 +2163,17 @@ class Daemon:
             # minimap2-pipe-over-the-socket shape.  Admission control
             # is the same per-client fair-share gate as submit.
             client = self._resolve_client(req, peer)
+            deadline_ms, dl_err = protocol.parse_deadline_ms(req)
+            if dl_err is not None:
+                return dl_err
             try:
                 job = self.submit(req.get("args"),
                                   cwd=req.get("cwd"),
                                   client=client,
                                   priority=req.get("priority"),
                                   stream=True,
-                                  trace_id=req.get("trace_id"))
+                                  trace_id=req.get("trace_id"),
+                                  deadline_ms=deadline_ms)
             except ValueError as e:
                 return protocol.err(protocol.ERR_BAD_REQUEST, str(e))
             except Draining as e:

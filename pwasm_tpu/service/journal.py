@@ -80,6 +80,14 @@ REC_SCALE = "scale"                # scaler action (spawn/retire) with
 #                                    the member target + child pid, so
 #                                    a restarted router knows which
 #                                    members it owns
+REC_ROUTE_SHED = "route_shed"      # brownout shed-level transition
+#                                    (ISSUE 18): level + lane set, so
+#                                    the journal records WHEN the
+#                                    router started/stopped turning
+#                                    low-priority admissions away
+#                                    (fold_route_records skips it —
+#                                    shed state is not rebuilt, only
+#                                    auditable)
 
 
 class JobJournal:

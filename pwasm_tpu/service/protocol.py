@@ -117,6 +117,19 @@ job — journal record, event-log lines, flight record, both sides'
 Chrome traces — and echo it in the ok frame; a frame without one gets
 a daemon-minted id, so every job is trace-correlatable either way.
 
+Deadline propagation (ISSUE 18, docs/RESILIENCE.md): a ``submit``/
+``stream`` frame MAY carry ``deadline_ms`` — the REMAINING end-to-end
+budget in integer milliseconds, minted by ``ServiceClient`` from
+``--deadline-s`` and re-stamped at each hop with the time already
+spent subtracted (the router subtracts its queue/spill time before
+forwarding, the daemon subtracts queue + lease wait before exec, the
+supervisor enforces it at batch boundaries).  A frame whose budget is
+already spent answers ``deadline_exceeded`` without admission; a job
+whose budget expires mid-run stops at its next durable checkpoint and
+lands terminal ``deadline_exceeded`` (rc 75, resumable — the journal
+records the truth).  A frame WITHOUT ``deadline_ms`` behaves exactly
+as before this field existed.
+
 Transports and identity (ISSUE 13, docs/FLEET.md): the same frames
 run over the unix socket and over TCP (``serve --listen=HOST:PORT``,
 ``route``).  A frame MAY carry a ``client_token`` field: on TCP —
@@ -149,6 +162,17 @@ ERR_UNKNOWN_JOB = "unknown_job"
 ERR_FENCED = "fenced"                # epoch-lease fence: member must
 #   not accept work (lost/expired lease, or a stale-epoch grant was
 #   refused).  Clients treat it like draining: go elsewhere.
+ERR_DEADLINE_EXCEEDED = "deadline_exceeded"  # the job's end-to-end
+#   deadline budget (submit/stream --deadline-s) ran out: either
+#   refused at admission (budget already spent in queues upstream) or
+#   landed terminal mid-run at the next batch boundary — rc 75 with a
+#   valid resumable checkpoint, so the CLIENT decides whether to
+#   resume with a fresh budget or abandon.
+ERR_OVERLOADED = "overloaded"        # brownout shedding at the fleet
+#   router: fleet-wide queue pressure crossed the SLO threshold and
+#   this frame's priority lane is being shed (lowest lane first,
+#   hysteresis-damped).  The frame carries retry_after_s; back off
+#   like queue_full — but unlike queue_full, no member was asked.
 
 
 class FrameError(Exception):
@@ -178,6 +202,32 @@ def resolve_client_identity(req: dict, peer: str | None) -> str:
     if isinstance(tok, str) and tok:
         return "tok:" + tok
     return peer or ""
+
+
+def parse_deadline_ms(req: dict) -> tuple[int | None, dict | None]:
+    """Parse the optional ``deadline_ms`` admission-frame field, one
+    implementation shared by the serve daemon and the fleet router (so
+    the validation and the spent-budget refusal cannot drift): returns
+    ``(budget, None)`` — budget ``None`` when the frame carries no
+    deadline — or ``(None, error_frame)``.  A malformed budget is a
+    ``bad_request``; a present-but-spent one (``<= 0``) answers
+    ``deadline_exceeded`` WITHOUT admitting anything — the truthful
+    refusal: upstream hops already ate the whole budget."""
+    v = req.get("deadline_ms")
+    if v is None:
+        return None, None
+    if isinstance(v, bool) or not isinstance(v, int):
+        return None, err(ERR_BAD_REQUEST,
+                         "deadline_ms must be an integer "
+                         "millisecond budget")
+    if v <= 0:
+        return None, err(
+            ERR_DEADLINE_EXCEEDED,
+            f"end-to-end deadline budget already spent ({v} ms "
+            "remaining at admission) — nothing was admitted; "
+            "resubmit with a fresh --deadline-s",
+            deadline_ms=v)
+    return v, None
 
 
 def handle_logs(req: dict, log_path: str | None) -> dict:

@@ -103,7 +103,19 @@ class Job:
     #   --resume over a cached same-family input prefix (ISSUE 17):
     #   finish notes the fractional hit and stamps the job's stats
     #   with the truthful cache_delta counts
+    deadline_ms: int | None = None     # REMAINING end-to-end budget
+    #   (integer ms) as of admission, from the submit frame's
+    #   deadline_ms (ISSUE 18).  None = no deadline: behavior is
+    #   byte-identical to before the field existed.  The worker
+    #   subtracts the monotonic time since submitted_mono (queue +
+    #   lease wait) before exec; a spent budget lands terminal
+    #   deadline_exceeded without running.
     submitted_s: float = field(default_factory=time.time)
+    submitted_mono: float = field(default_factory=time.monotonic)
+    #   monotonic twin of submitted_s: queue-wait and deadline
+    #   arithmetic use THIS (a wall-clock step must never fake a
+    #   deadline expiry or an EWMA spike —
+    #   qa/check_supervision.py::find_clock_violations)
     started_s: float | None = None
     finished_s: float | None = None
     accessed_s: float = field(default_factory=time.time)  # last
@@ -517,12 +529,17 @@ class ServiceStats:
 
     def __init__(self) -> None:
         self.t0 = time.time()
+        self.t0_mono = time.monotonic()   # uptime arithmetic uses the
+        #   monotonic twin: an NTP step must not fake (or hide) uptime
         self.jobs_accepted = 0
         self.jobs_rejected = 0        # queue_full admissions
         self.jobs_rejected_draining = 0
         self.jobs_completed = 0
         self.jobs_failed = 0
         self.jobs_preempted = 0
+        self.jobs_deadline_exceeded = 0   # subset of preempted whose
+        #                                   drain reason was a spent
+        #                                   --deadline-s budget
         self.jobs_cancelled = 0
         self.jobs_evicted = 0         # terminal results dropped by
         #                               --result-ttl-s / --max-results
@@ -555,7 +572,7 @@ class ServiceStats:
         return {
             "stats_version": SERVICE_STATS_VERSION,
             "protocol_version": PROTOCOL_VERSION,
-            "uptime_s": round(time.time() - self.t0, 3),
+            "uptime_s": round(time.monotonic() - self.t0_mono, 3),
             "draining": draining,
             # queue_depth / running / breaker_state are SOURCED FROM
             # the daemon's metrics registry (the Prometheus surface):
@@ -573,6 +590,7 @@ class ServiceStats:
                 "completed": self.jobs_completed,
                 "failed": self.jobs_failed,
                 "preempted": self.jobs_preempted,
+                "deadline_exceeded": self.jobs_deadline_exceeded,
                 "cancelled": self.jobs_cancelled,
                 "evicted": self.jobs_evicted,
                 "recovered": self.jobs_recovered,

@@ -67,16 +67,35 @@ def render(st: dict) -> str:
             f"up | routed {fleet.get('jobs_routed', 0)}  live "
             f"{fleet.get('live_jobs', 0)}  failovers "
             f"{fleet.get('failovers', 0)}")
-        out.append(" MEMBER                 STATE  DEPTH  RUN  ROUTED")
+        shed = (st.get("ha") or {}).get("shed") or {}
+        if shed.get("level"):
+            # the brownout banner (ISSUE 18): the operator must see
+            # turned-away tiers before reading any member row
+            out.append(
+                " SHEDDING: tier(s) "
+                + (",".join(shed.get("lanes_shed") or []) or "?")
+                + f" turned away (level {shed.get('level')})")
+        out.append(" MEMBER                 STATE  DEPTH  RUN  ROUTED"
+                   "    LAT")
         for row in members:
             alive = row.get("alive")
+            # one word, worst condition first: a quarantined (gray)
+            # or fenced member is "up" but taking no placements —
+            # rendering it as plain up hides the exact state this
+            # view exists to surface
+            state = ("DOWN" if not alive
+                     else "QUAR" if row.get("quarantined")
+                     else "FENC" if row.get("fenced") else "up")
+            lat = row.get("lat_ewma_ms")
             out.append(
                 f"   {str(row.get('name', '?')):<20} "
-                + f"{'up' if alive else 'DOWN':>5}  "
+                + f"{state:>5}  "
                 + (f"{row.get('queue_depth', 0) or 0:>5}  "
                    f"{row.get('running', 0) or 0:>3}  "
                    if alive else "    -    -  ")
-                + f"{row.get('jobs_routed', 0):>6}")
+                + f"{row.get('jobs_routed', 0):>6}  "
+                + (f"{lat:>5.0f}" if isinstance(lat, (int, float))
+                   and alive else "    -"))
         rec = fleet.get("jobs_recovered") or {}
         recovered = {k: v for k, v in sorted(rec.items()) if v}
         if recovered:

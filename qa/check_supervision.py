@@ -532,6 +532,129 @@ def find_fencing_violations(root: str = REPO) -> list[str]:
     return out
 
 
+# ── monotonic-clock audit (ISSUE 18 satellite) ──
+# Durations and intervals must come from time.monotonic(): an NTP
+# step or a suspended laptop warps time.time() arithmetic, and the
+# places this codebase subtracts timestamps are exactly the places
+# that decide deadlines, uptimes and queue waits — a backwards wall
+# clock there turns into a spurious deadline_exceeded or a negative
+# queue_wait.  The lint is line-level: any `time.time() - x` /
+# `x - time.time()` subtraction outside the allowlist fails tier-1.
+# Wall-clock TIMESTAMPS (journal `t=` fields, submitted_s sort keys)
+# are fine — they are recorded, not subtracted.
+CLOCK_SUB_RE = re.compile(r"time\.time\(\)\s*-|-\s*time\.time\(\)")
+
+# path -> justification for a genuine wall-clock duration: values
+# PERSISTED across processes (a cache manifest's created stamp must
+# be comparable after a restart, which monotonic time is not)
+CLOCK_ALLOWLIST = {
+    "pwasm_tpu/service/cache.py":
+        "TTL over manifest `created` stamps persisted across "
+        "processes — monotonic clocks don't survive a restart",
+}
+
+
+def find_clock_violations(root: str = REPO) -> list[str]:
+    """Wall-clock duration arithmetic (CLOCK_SUB_RE) in pwasm_tpu/
+    outside CLOCK_ALLOWLIST — durations belong to time.monotonic()."""
+    out: list[str] = []
+    pkg = os.path.join(root, "pwasm_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            if rel in CLOCK_ALLOWLIST:
+                continue
+            with open(path, encoding="utf-8") as f:
+                for i, line in enumerate(f, 1):
+                    if line.lstrip().startswith("#"):
+                        continue
+                    if CLOCK_SUB_RE.search(line):
+                        out.append(
+                            f"{rel}:{i}: wall-clock duration "
+                            f"arithmetic: {line.strip()} — use "
+                            "time.monotonic() (or register a "
+                            "justified allowlist entry in "
+                            "qa/check_supervision.py::"
+                            "CLOCK_ALLOWLIST)")
+    return out
+
+
+def stale_clock_allowlist(root: str = REPO) -> list[str]:
+    """Allowlist rows whose file no longer subtracts time.time() —
+    same accuracy rule as the supervision registry."""
+    out = []
+    for rel in CLOCK_ALLOWLIST:
+        path = os.path.join(root, *rel.split("/"))
+        if not os.path.isfile(path):
+            out.append(rel)
+            continue
+        with open(path, encoding="utf-8") as f:
+            if not any(CLOCK_SUB_RE.search(l) for l in f
+                       if not l.lstrip().startswith("#")):
+                out.append(rel)
+    return out
+
+
+# ── protocol error-vocabulary coverage (ISSUE 18 satellite) ──
+# Every ERR_* code protocol.py can put on the wire is a behaviour a
+# client will branch on; an error code no test exercises is a
+# contract nobody is holding.  The gate fails when a code's constant
+# name AND its wire string are both absent from tests/ — adding a new
+# code to the vocabulary forces adding the test that emits it.
+PROTOCOL_FILE = "pwasm_tpu/service/protocol.py"
+ERR_DEF_RE = re.compile(r'^(ERR_[A-Z_]+)\s*=\s*"([a-z_]+)"')
+
+
+def protocol_error_codes(root: str = REPO) -> dict[str, tuple]:
+    """``{ERR_NAME: (lineno, wire_string)}`` parsed from the
+    top-level assignments in service/protocol.py."""
+    out: dict[str, tuple] = {}
+    path = os.path.join(root, *PROTOCOL_FILE.split("/"))
+    if not os.path.isfile(path):
+        return out
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            m = ERR_DEF_RE.match(line)
+            if m:
+                out[m.group(1)] = (i, m.group(2))
+    return out
+
+
+def find_error_vocab_gaps(root: str = REPO) -> list[str]:
+    """Protocol error codes exercised by no test: neither the ERR_*
+    constant nor its wire string appears anywhere under tests/."""
+    codes = protocol_error_codes(root)
+    if not codes:
+        return [f"{PROTOCOL_FILE}: missing or defines no ERR_* "
+                "codes — the protocol error vocabulary is gone"]
+    tests_dir = os.path.join(root, "tests")
+    corpus: list[str] = []
+    if os.path.isdir(tests_dir):
+        for dirpath, dirnames, filenames in os.walk(tests_dir):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    with open(os.path.join(dirpath, fn),
+                              encoding="utf-8") as f:
+                        corpus.append(f.read())
+    text = "\n".join(corpus)
+    out = []
+    for name, (lineno, wire) in sorted(codes.items(),
+                                       key=lambda kv: kv[1][0]):
+        if name not in text and wire not in text:
+            out.append(
+                f"{PROTOCOL_FILE}:{lineno}: error code {name} "
+                f"({wire!r}) is exercised by no test under tests/ — "
+                "an error code nobody tests is a contract nobody "
+                "holds; add a test that provokes it")
+    return out
+
+
 def find_doc_drift(root: str = REPO) -> list[str]:
     """Catalog families missing from docs/OBSERVABILITY.md (module
     comment: the doc is the operator's catalog of record, so every
@@ -584,13 +707,18 @@ def main() -> int:
     slo = find_slo_violations()
     cachev = find_cache_violations()
     fencing = find_fencing_violations()
+    clock = find_clock_violations() + [
+        f"{rel}: stale CLOCK_ALLOWLIST entry (no wall-clock "
+        "subtraction left — remove it)"
+        for rel in stale_clock_allowlist()]
+    errvocab = find_error_vocab_gaps()
     for line in bad:
         print(line, file=sys.stderr)
     for rel in stale:
         print(f"{rel}: stale registry entry (no device entry points "
               "left — remove it)", file=sys.stderr)
     for line in svc + obs + stream + fleet + metric + doc_drift \
-            + sharding + slo + cachev + fencing:
+            + sharding + slo + cachev + fencing + clock + errvocab:
         print(line, file=sys.stderr)
     if bad:
         print(f"\n{len(bad)} device entry point(s) outside the "
@@ -633,9 +761,19 @@ def main() -> int:
               "every --resume re-admission path must route the "
               "job's epoch through fleet/fencing.py::"
               "readmit_epoch_guard (ISSUE 16).", file=sys.stderr)
+    if clock:
+        print(f"\n{len(clock)} monotonic-clock failure(s): durations "
+              "come from time.monotonic(); time.time() subtraction "
+              "is only legal on the CLOCK_ALLOWLIST (ISSUE 18).",
+              file=sys.stderr)
+    if errvocab:
+        print(f"\n{len(errvocab)} error-vocabulary coverage "
+              "failure(s): every protocol ERR_* code needs at least "
+              "one test that provokes it (ISSUE 18).",
+              file=sys.stderr)
     return 1 if (bad or stale or svc or obs or stream or fleet
                  or metric or doc_drift or sharding or slo
-                 or cachev or fencing) else 0
+                 or cachev or fencing or clock or errvocab) else 0
 
 
 if __name__ == "__main__":

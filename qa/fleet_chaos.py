@@ -1,0 +1,409 @@
+#!/usr/bin/env python
+"""Fleet chaos harness (ISSUE 18): gray-failure fault injection at
+the FLEET tier, plus the drills tier-1 tests and bench cfg8 gate.
+
+Unit fault injection (``resilience/faults.py``) poisons device
+batches inside one process; this module poisons the FLEET around
+perfectly healthy members — the failures that pass every liveness
+check while dragging the fleet's tail latency down:
+
+- ``ChaosProxy``: an in-process TCP proxy in front of one member.
+  ``delay_s`` makes the member a latency outlier without killing it
+  (the canonical gray failure: every poll still succeeds, slowly);
+  ``blackhole`` swallows bytes without ever answering (a
+  half-partition — the connection opens, the reply never comes);
+  ``truncate_after`` forwards N reply bytes then closes the wire
+  (a torn NDJSON frame).
+- ``StopWindows``: a SIGSTOP/SIGCONT duty cycle on a subprocess
+  member — alive and heartbeating between stops, pathologically
+  slow under them.
+- ``deny_writes``: flips a journal/spool/cache dir unwritable so
+  every durable append fails with the OSError class ENOSPC raises —
+  the full-disk degradation path, exercised without filling a disk.
+
+Drills (each RETURNS measured facts; the caller asserts):
+
+- ``gray_drill``: watch a router until the named member is
+  quarantined, call ``relieve()``, watch until probation-exit.
+- ``deadline_drill``: submit through any tier (daemon or router)
+  with an end-to-end budget and report the truthful verdict —
+  refused-at-admission, expired-resumable (rc 75), or completed.
+
+``python qa/fleet_chaos.py`` runs a self-contained in-process drill
+(stub runners, no jax, no corpus): three members, one behind a delay
+proxy, and prints the measured quarantine/recovery timings as JSON —
+exit 0 only if the slow member was quarantined AND probation-exited.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+from contextlib import contextmanager
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+
+# ---------------------------------------------------------------------------
+# fault injectors
+# ---------------------------------------------------------------------------
+class ChaosProxy:
+    """TCP proxy in front of one member socket (unix or host:port).
+
+    The router is pointed at ``start()``'s returned ``host:port``
+    instead of the member itself; every byte then crosses this proxy,
+    and the knobs below are flipped at runtime (thread-safe — they
+    apply to the next chunk pumped):
+
+    - ``delay_s``: sleep before forwarding each client->member chunk
+      (request latency without request loss);
+    - ``blackhole``: read and DISCARD client bytes, forward nothing,
+      answer nothing — the caller's timeout is the only way out;
+    - ``truncate_after``: forward only the first N member->client
+      bytes of each connection, then close both sides (torn frame).
+    """
+
+    def __init__(self, target: str, delay_s: float = 0.0):
+        self.target = target
+        self.delay_s = float(delay_s)
+        self.blackhole = False
+        self.truncate_after: int | None = None
+        self._lsock: socket.socket | None = None
+        self._closing = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self.conns = 0
+
+    # -- lifecycle --
+    def start(self) -> str:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        s.listen(16)
+        s.settimeout(0.2)
+        self._lsock = s
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="chaos-proxy-accept")
+        t.start()
+        self._threads.append(t)
+        return f"127.0.0.1:{s.getsockname()[1]}"
+
+    def stop(self) -> None:
+        self._closing.set()
+        if self._lsock is not None:
+            try:
+                self._lsock.close()
+            except OSError:
+                pass
+
+    # -- plumbing --
+    def _upstream(self) -> socket.socket:
+        if ":" in self.target and not os.path.exists(self.target):
+            host, port = self.target.rsplit(":", 1)
+            return socket.create_connection((host, int(port)),
+                                            timeout=10)
+        u = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        u.settimeout(10)
+        u.connect(self.target)
+        return u
+
+    def _accept_loop(self) -> None:
+        while not self._closing.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            self.conns += 1
+            try:
+                up = self._upstream()
+            except OSError:
+                conn.close()
+                continue
+            for src, dst, to_member in ((conn, up, True),
+                                        (up, conn, False)):
+                t = threading.Thread(
+                    target=self._pump, args=(src, dst, to_member),
+                    daemon=True, name="chaos-proxy-pump")
+                t.start()
+                self._threads.append(t)
+
+    def _pump(self, src: socket.socket, dst: socket.socket,
+              to_member: bool) -> None:
+        sent = 0
+        try:
+            while not self._closing.is_set():
+                try:
+                    chunk = src.recv(65536)
+                except OSError:
+                    break
+                if not chunk:
+                    break
+                if to_member:
+                    if self.blackhole:
+                        continue        # swallowed, never answered
+                    d = self.delay_s
+                    if d > 0:
+                        time.sleep(d)
+                else:
+                    cut = self.truncate_after
+                    if cut is not None:
+                        chunk = chunk[:max(0, cut - sent)]
+                        if not chunk:
+                            break       # torn frame: close both ends
+                try:
+                    dst.sendall(chunk)
+                    sent += len(chunk)
+                except OSError:
+                    break
+                if not to_member and self.truncate_after is not None \
+                        and sent >= self.truncate_after:
+                    # the budget is spent THIS chunk: close both ends
+                    # now rather than blocking on a reply that will
+                    # never come (the member already answered whole)
+                    break
+        finally:
+            # shutdown BEFORE close: the sibling pump thread is
+            # blocked in recv() on these same sockets, and a bare
+            # close() neither wakes it nor sends the FIN the far end
+            # is waiting for — the torn frame must be promptly torn
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+
+class StopWindows:
+    """SIGSTOP/SIGCONT duty cycle on a subprocess member: the process
+    is alive (its socket accepts, its journal exists, its parent sees
+    no exit) but runs only ``run_s`` out of every
+    ``stop_s + run_s`` — a gray member, not a dead one.  ``stop()``
+    always leaves the victim SIGCONT'd."""
+
+    def __init__(self, pid: int, stop_s: float = 0.3,
+                 run_s: float = 0.1):
+        self.pid = pid
+        self.stop_s = float(stop_s)
+        self.run_s = float(run_s)
+        self._closing = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.windows = 0
+
+    def start(self) -> "StopWindows":
+        self._thread = threading.Thread(target=self._loop,
+                                        daemon=True,
+                                        name="chaos-stop-windows")
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._closing.is_set():
+            try:
+                os.kill(self.pid, signal.SIGSTOP)
+            except OSError:
+                return                # victim gone: nothing to chaos
+            self.windows += 1
+            self._closing.wait(self.stop_s)
+            try:
+                os.kill(self.pid, signal.SIGCONT)
+            except OSError:
+                return
+            self._closing.wait(self.run_s)
+
+    def stop(self) -> None:
+        self._closing.set()
+        if self._thread is not None:
+            self._thread.join(5)
+        try:
+            os.kill(self.pid, signal.SIGCONT)
+        except OSError:
+            pass
+
+
+@contextmanager
+def deny_writes(path: str):
+    """Make ``path`` (a journal/spool/cache dir) unwritable for the
+    duration — every durable append inside fails with the OSError
+    class a full disk raises, which is exactly the degradation
+    surface ISSUE 18's ENOSPC satellite gates.  Restores the original
+    mode on exit.  No-op (yields False) when running as root, where
+    mode bits don't bind — callers skip the assertion then."""
+    st_mode = os.stat(path).st_mode
+    os.chmod(path, 0o500)
+    effective = not os.access(path, os.W_OK)
+    try:
+        yield effective
+    finally:
+        os.chmod(path, st_mode)
+
+
+# ---------------------------------------------------------------------------
+# drill helpers
+# ---------------------------------------------------------------------------
+def wait_until(pred, timeout_s: float, interval: float = 0.05):
+    """Poll ``pred()`` until truthy or the budget runs out; returns
+    the last value (truthy = success)."""
+    deadline = time.monotonic() + timeout_s
+    val = pred()
+    while not val and time.monotonic() < deadline:
+        time.sleep(interval)
+        val = pred()
+    return val
+
+
+def member_row(stats: dict, name: str) -> dict | None:
+    """The named member's row from a router ``stats`` payload."""
+    for row in (stats.get("fleet") or {}).get("members") or []:
+        if row.get("name") == name:
+            return row
+    return None
+
+
+def gray_drill(router_sock: str, member_name: str, relieve,
+               detect_timeout_s: float = 30.0,
+               recover_timeout_s: float = 30.0) -> dict:
+    """THE gray-failure drill: with a fault already active on
+    ``member_name`` (delay proxy / stop windows — the caller armed
+    it), watch the router until the member is QUARANTINED, then call
+    ``relieve()`` and watch until probation-exit.  Returns measured
+    facts only; the caller owns the assertions:
+
+    ``{"quarantined", "t_detect_s", "recovered", "t_recover_s",
+       "quarantines_total", "eligible_floor_held"}``
+
+    ``eligible_floor_held`` is True when at every observed sample at
+    least one alive member remained unquarantined — the never-wedge
+    property the router must keep even mid-chaos."""
+    from pwasm_tpu.service.client import ServiceClient
+    floor_held = True
+
+    def _sample(c):
+        nonlocal floor_held
+        st = c.request({"cmd": "stats"})["stats"]
+        rows = (st.get("fleet") or {}).get("members") or []
+        if not any(r.get("alive") and not r.get("quarantined")
+                   for r in rows):
+            floor_held = False
+        return member_row(st, member_name) or {}
+
+    with ServiceClient(router_sock, timeout=10.0) as c:
+        t0 = time.monotonic()
+        quarantined = bool(wait_until(
+            lambda: _sample(c).get("quarantined"),
+            detect_timeout_s))
+        t_detect = time.monotonic() - t0
+        relieve()
+        t1 = time.monotonic()
+        recovered = quarantined and bool(wait_until(
+            lambda: not _sample(c).get("quarantined"),
+            recover_timeout_s))
+        t_recover = time.monotonic() - t1
+        row = _sample(c)
+    return {"quarantined": quarantined,
+            "t_detect_s": round(t_detect, 3),
+            "recovered": recovered,
+            "t_recover_s": round(t_recover, 3),
+            "quarantines_total": int(row.get("quarantines") or 0),
+            "eligible_floor_held": floor_held}
+
+
+def deadline_drill(target: str, args: list, cwd: str,
+                   deadline_s: float,
+                   result_timeout_s: float = 120.0) -> dict:
+    """Submit ``args`` through ``target`` (daemon or router socket)
+    with an end-to-end budget and report the truthful outcome:
+
+    - ``refused``: the budget was spent before admission
+      (``deadline_exceeded`` at submit, nothing ran);
+    - ``expired``: admitted, stopped at a batch boundary — state
+      preempted, rc 75, detail says deadline_exceeded (resumable);
+    - ``done``: completed inside the budget (rc 0).
+    """
+    from pwasm_tpu.service.client import ServiceClient
+    out: dict = {"refused": False, "expired": False, "done": False,
+                 "rc": None, "detail": ""}
+    with ServiceClient(target, deadline_s=deadline_s,
+                       timeout=60.0) as c:
+        sub = c.submit(args, cwd=cwd)
+        if not sub.get("ok"):
+            out["refused"] = sub.get("error") == "deadline_exceeded"
+            out["detail"] = str(sub.get("detail") or "")
+            return out
+        res = c.result(sub["job_id"], timeout=result_timeout_s)
+        job = res.get("job") or {}
+        out["rc"] = res.get("rc")
+        out["detail"] = str(job.get("detail") or "")
+        out["done"] = res.get("rc") == 0
+        out["expired"] = (job.get("state") == "preempted"
+                          and res.get("rc") == 75
+                          and "deadline_exceeded" in out["detail"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# standalone: the in-process gray drill (stub runners, no jax)
+# ---------------------------------------------------------------------------
+def main() -> int:
+    sys.path.insert(0, os.path.join(ROOT, "tests"))
+    import io
+    import shutil
+    import tempfile
+    from contextlib import ExitStack
+
+    from test_fleet import _daemon, _stub_runner
+
+    from pwasm_tpu.fleet.router import Router
+    from pwasm_tpu.fleet.transport import target_name
+    from pwasm_tpu.service.client import wait_for_socket
+
+    poll = 0.1
+    with ExitStack() as stack:
+        members = [stack.enter_context(
+            _daemon(runner=_stub_runner(sleep=0.01)))
+            for _ in range(3)]
+        proxy = ChaosProxy(members[2].sock)
+        addr = proxy.start()
+        stack.callback(proxy.stop)
+        rdir = tempfile.mkdtemp(prefix="pwchaos")
+        stack.callback(shutil.rmtree, rdir, True)
+        rsock = os.path.join(rdir, "router.sock")
+        err = io.StringIO()
+        r = Router([members[0].sock, members[1].sock, addr],
+                   socket_path=rsock, stderr=err,
+                   poll_interval=poll, quarantine_x=3.0)
+        t = threading.Thread(target=r.serve, daemon=True)
+        t.start()
+        stack.callback(lambda: (r.drain.request("chaos drill done"),
+                                t.join(20)))
+        if not wait_for_socket(rsock, 15):
+            print(err.getvalue(), file=sys.stderr)
+            return 1
+        # let the healthy EWMAs converge before injecting the fault,
+        # then make member 2 a latency outlier (alive, never down)
+        time.sleep(6 * poll)
+        proxy.delay_s = 0.5
+        res = gray_drill(rsock, target_name(addr),
+                         relieve=lambda: setattr(proxy, "delay_s",
+                                                 0.0))
+        res["poll_interval_s"] = poll
+    print(json.dumps(res, indent=2))
+    ok = (res["quarantined"] and res["recovered"]
+          and res["eligible_floor_held"])
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

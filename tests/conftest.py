@@ -37,3 +37,10 @@ try:
     getattr(_xb, "_backend_factories", {}).pop("axon", None)
 except Exception:
     pass
+
+
+def pytest_configure(config):
+    # tier-1 runs with `-m "not slow"`; the long fleet drills opt out
+    # of it explicitly rather than riding on an unregistered mark
+    config.addinivalue_line(
+        "markers", "slow: long-running drill, deselected in tier-1")

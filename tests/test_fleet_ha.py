@@ -716,3 +716,144 @@ def test_scaler_spawns_and_retires_members(tmp_path, monkeypatch):
         assert st["ha"]["scaler"]["owned"] == 0
         assert st["ha"]["scaler"]["spawned"] == 1
         assert len(st["fleet"]["members"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# the live-traffic drill (ISSUE 18 satellite): takeover is invisible to
+# clients that keep submitting through it
+# ---------------------------------------------------------------------------
+def test_standby_takeover_under_live_client_traffic(tmp_path):
+    """The kill9 drill above freezes the client during the takeover;
+    real clients do not hold still.  Here a pump thread keeps
+    submitting jobs through the whole window — at least two land
+    before the SIGKILL and at least two after the standby binds — and
+    every job that was ever acknowledged completes rc 0 with output
+    byte-identical to a cold run.  The client-side contract: retrying
+    the SAME address across OSError/ServiceError is sufficient; no
+    job is lost, none is corrupted."""
+    paf, fa = _corpus(tmp_path)
+    from pwasm_tpu.cli import run as cli_run
+    assert cli_run(_job_args(tmp_path, "cold", paf, fa, []),
+                   stderr=io.StringIO()) == 0
+    expect = (tmp_path / "cold.dfa").read_bytes()
+
+    d = tempfile.mkdtemp(prefix="pwhalv")
+    procs = []
+    stop = threading.Event()
+    done = []                 # [(tag, rc)] — every acknowledged job
+    pump_err = []
+
+    def pump(rsock):
+        k = 0
+        while not stop.is_set():
+            # a fresh tag per submit ATTEMPT: a reply lost in the
+            # takeover window must not race a retry onto the same
+            # output paths
+            tag = f"lv{k}"
+            k += 1
+            jid = None
+            try:
+                with ServiceClient(rsock, timeout=2.0) as c:
+                    s = c.submit(_job_args(tmp_path, tag, paf, fa, []),
+                                 cwd=str(tmp_path))
+                    if s.get("ok"):
+                        jid = s["job_id"]
+            except (OSError, ServiceError):
+                time.sleep(0.1)
+                continue
+            if jid is None:
+                time.sleep(0.1)
+                continue
+            # acknowledged: this job may NOT be lost, even if the
+            # router that acknowledged it is about to be SIGKILLed
+            rc = None
+            deadline = time.monotonic() + 120
+            while rc is None and time.monotonic() < deadline:
+                try:
+                    with ServiceClient(rsock, timeout=5.0) as c:
+                        rc = c.result(jid, timeout=60).get("rc")
+                except (OSError, ServiceError):
+                    time.sleep(0.1)
+            if rc is None:
+                pump_err.append(f"{tag}: result never arrived")
+                return
+            done.append((tag, rc))
+            time.sleep(0.1)
+
+    try:
+        socks = []
+        for i in range(2):
+            s = os.path.join(d, f"m{i}.sock")
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "pwasm_tpu.cli", "serve",
+                 f"--socket={s}"],
+                env=_serve_env(), stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE, text=True))
+            socks.append(s)
+        for s in socks:
+            assert wait_for_socket(s, 60)
+        rsock = os.path.join(d, "router.sock")
+        primary = subprocess.Popen(
+            [sys.executable, "-m", "pwasm_tpu.cli", "route",
+             "--backends=" + ",".join(socks), f"--socket={rsock}",
+             "--poll-interval=0.2"],
+            env=_serve_env(), stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE, text=True)
+        procs.append(primary)
+        assert wait_for_socket(rsock, 30)
+        standby = subprocess.Popen(
+            [sys.executable, "-m", "pwasm_tpu.cli", "route",
+             f"--standby-of={rsock}", "--poll-interval=0.2"],
+            env=_serve_env(), stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE, text=True)
+        procs.append(standby)
+
+        t = threading.Thread(target=pump, args=(rsock,), daemon=True)
+        t.start()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and len(done) < 2 \
+                and not pump_err:
+            time.sleep(0.05)
+        assert not pump_err, pump_err
+        assert len(done) >= 2, "traffic never established pre-kill"
+
+        primary.kill()
+        primary.wait(timeout=30)
+        pre = len(done)
+        # the pump keeps hammering the SAME address through the gap;
+        # two completions past the kill prove the takeover end-to-end
+        # from a client that never coordinated with it
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and len(done) < pre + 2 \
+                and not pump_err:
+            time.sleep(0.05)
+        assert not pump_err, pump_err
+        assert len(done) >= pre + 2, \
+            f"traffic never resumed after takeover ({len(done)}/{pre})"
+        stop.set()
+        t.join(timeout=180)
+        assert not t.is_alive(), "pump wedged"
+        assert standby.poll() is None
+
+        bad = [(tag, rc) for tag, rc in done if rc != 0]
+        assert not bad, bad
+        for tag, _ in done:
+            assert (tmp_path / f"{tag}.dfa").read_bytes() == expect, tag
+        with ServiceClient(rsock) as c:
+            st = c.stats()["stats"]
+            assert st["ha"]["takeover"] is True
+            assert st["ha"]["epoch"] >= 2
+            c.drain()
+        assert standby.wait(timeout=120) == 0
+        for i, s in enumerate(socks):
+            with ServiceClient(s) as c:
+                c.drain()
+            assert procs[i].wait(timeout=120) == EXIT_PREEMPTED
+    finally:
+        stop.set()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+            p.stderr.close()
+        shutil.rmtree(d, ignore_errors=True)

@@ -233,7 +233,7 @@ def test_rule_validation_errors():
 def test_default_rule_sets_validate():
     # the shipped defaults must themselves pass the user-rule grammar
     assert len(parse_rules(default_slo_rules())) == 8
-    assert len(parse_rules(default_fleet_slo_rules())) == 4
+    assert len(parse_rules(default_fleet_slo_rules())) == 6
 
 
 def test_merge_rules_overrides_by_name():

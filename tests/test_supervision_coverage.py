@@ -299,3 +299,82 @@ def test_sharding_rule_detects_bare_collectives(checker, tmp_path):
     bad = checker.find_sharding_violations(str(tmp_path))
     assert len(bad) == 5, bad
     assert all("rogue.py" in b for b in bad)
+
+
+# ---------------------------------------------------------------------------
+# monotonic-clock audit (ISSUE 18): duration arithmetic on time.time()
+# is a gray failure waiting for an NTP step
+# ---------------------------------------------------------------------------
+
+def test_clock_gate_clean_on_this_tree(checker):
+    bad = checker.find_clock_violations()
+    assert bad == [], "\n".join(bad)
+
+
+def test_clock_allowlist_has_no_stale_rows(checker):
+    assert checker.stale_clock_allowlist() == []
+
+
+def test_clock_rule_detects_wall_clock_durations(checker, tmp_path):
+    pkg = tmp_path / "pwasm_tpu"
+    pkg.mkdir()
+    (pkg / "rogue.py").write_text(
+        "t0 = time.time()\n"
+        "work()\n"
+        "wait_s = time.time() - t0\n"
+        "neg = t0 - time.time()\n"
+        "# elapsed = time.time() - t0 in a comment is NOT a hit\n"
+        "stamp = time.time()   # bare stamps are fine\n")
+    bad = checker.find_clock_violations(str(tmp_path))
+    assert len(bad) == 2, bad
+    assert all("rogue.py" in b for b in bad)
+    assert all("time.monotonic()" in b for b in bad)
+
+
+def test_clock_allowlist_rows_must_stay_live(checker, tmp_path):
+    # an allowlisted file with no subtraction left (or missing
+    # entirely) is a STALE row — the gate must say so, not silently
+    # keep the exemption around for the next regression to hide under
+    (tmp_path / "pwasm_tpu" / "service").mkdir(parents=True)
+    (tmp_path / "pwasm_tpu" / "service" / "cache.py").write_text(
+        "x = 1\n")
+    stale = checker.stale_clock_allowlist(str(tmp_path))
+    assert "pwasm_tpu/service/cache.py" in stale
+
+
+# ---------------------------------------------------------------------------
+# protocol error-vocabulary coverage (ISSUE 18): every ERR_* the wire
+# can speak is exercised by at least one test
+# ---------------------------------------------------------------------------
+
+def test_error_vocab_gate_clean_on_this_tree(checker):
+    bad = checker.find_error_vocab_gaps()
+    assert bad == [], "\n".join(bad)
+
+
+def test_error_vocab_gate_detects_unexercised_code(checker,
+                                                   tmp_path):
+    svc = tmp_path / "pwasm_tpu" / "service"
+    svc.mkdir(parents=True)
+    (svc / "protocol.py").write_text(
+        'ERR_COVERED = "covered_code"\n'
+        'ERR_GHOST = "ghost_code"\n')
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_x.py").write_text(
+        'def test_a(c):\n'
+        '    assert c.ping()["error"] == "covered_code"\n')
+    bad = checker.find_error_vocab_gaps(str(tmp_path))
+    assert len(bad) == 1, bad
+    assert "ERR_GHOST" in bad[0]
+    # naming the CONSTANT in a test counts as coverage too
+    (tests / "test_y.py").write_text(
+        "from pwasm_tpu.service.protocol import ERR_GHOST\n")
+    assert checker.find_error_vocab_gaps(str(tmp_path)) == []
+
+
+def test_error_vocab_gate_loud_when_protocol_missing(checker,
+                                                     tmp_path):
+    bad = checker.find_error_vocab_gaps(str(tmp_path))
+    assert len(bad) == 1
+    assert "ERR_" in bad[0]
