@@ -967,7 +967,12 @@ def cfg8_realistic_scale() -> int:
       --priority-lanes tier with a truthful overloaded +
       retry_after_s before any member sees the job, keep admitting
       the top tier throughout, and de-escalate back to level 0 when
-      pressure clears (``realistic_fleet_shed_floor``, ISSUE 18)."""
+      pressure clears (``realistic_fleet_shed_floor``, ISSUE 18);
+    - TLS overhead: the same job through an all-TLS 3-member fleet
+      (client->router TLS, router->member mTLS) vs an all-plaintext
+      fleet on the same TCP topology, byte-identical, wall ratio
+      gated <= 1.15 (``realistic_tls_overhead_ratio`` /
+      ``realistic_tls_overhead_ok``, ISSUE 19)."""
     import subprocess
     import tempfile
 
@@ -2760,6 +2765,138 @@ def cfg8_realistic_scale() -> int:
                     p.wait()
         _emit("realistic_fleet_shed_floor", 1 if shed_ok else 0,
               "bool", 1.0 if shed_ok else 0.0, cpu_metric=True)
+
+        # --- TLS overhead (ISSUE 19 tentpole): the SAME job through
+        # an ALL-TLS 3-member fleet (client->router over TLS,
+        # router->member over mTLS with client certs) vs an all-
+        # plaintext fleet on the SAME TCP topology, so the ratio
+        # isolates encryption, not unix-vs-TCP.  Bytes must stay
+        # identical and the submit->result wall ratio is gated
+        # <= 1.15 as a bool leg (interleaved arms + min-of-mins,
+        # same noise stance as the obs-overhead leg): a security
+        # layer costing more than 15% would get turned off exactly
+        # on the fleets that need it.
+        import socket as _socket
+        from pwasm_tpu.fleet.transport import ClientTLS
+
+        def _port():
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+            s.close()
+            return p
+
+        certs = os.path.join(repo, "tests", "certs")
+        tca = os.path.join(certs, "ca.pem")
+        tcrt = os.path.join(certs, "server.pem")
+        tkey = os.path.join(certs, "server.key")
+        acrt = os.path.join(certs, "fleet-admin.pem")
+        akey = os.path.join(certs, "fleet-admin.key")
+        tls_procs: list = []
+        tls_ok = False
+        tls_ratio = 0.0
+        try:
+            fleets = {}
+            for arm in ("tls", "plain"):
+                mports = [_port() for _ in range(3)]
+                rport = _port()
+                mflags = ([f"--tls-cert={tcrt}", f"--tls-key={tkey}",
+                           f"--tls-client-ca={tca}"]
+                          if arm == "tls" else [])
+                for k, mp in enumerate(mports):
+                    tls_procs.append(subprocess.Popen(
+                        cmd + ["serve",
+                               f"--socket={os.path.join(d, f'{arm}{k}.sock')}",
+                               f"--listen=127.0.0.1:{mp}",
+                               "--max-queue=16"] + mflags,
+                        env=env, stdout=subprocess.DEVNULL,
+                        stderr=subprocess.PIPE))
+                rflags = ([f"--tls-cert={tcrt}", f"--tls-key={tkey}",
+                           f"--member-tls-ca={tca}",
+                           f"--member-tls-cert={acrt}",
+                           f"--member-tls-key={akey}"]
+                          if arm == "tls" else [])
+                rs = os.path.join(d, f"{arm}r.sock")
+                tls_procs.append(subprocess.Popen(
+                    cmd + ["route",
+                           "--backends=" + ",".join(
+                               f"127.0.0.1:{mp}" for mp in mports),
+                           f"--socket={rs}",
+                           f"--listen=127.0.0.1:{rport}",
+                           "--poll-interval=0.2"] + rflags,
+                    env=env, stdout=subprocess.DEVNULL,
+                    stderr=subprocess.PIPE))
+                fleets[arm] = (rs, rport)
+            for arm in ("tls", "plain"):
+                if not wait_for_socket(fleets[arm][0], 120):
+                    return _fail("realistic_tls_fleet_up")
+
+            def tls_once(arm, tag, settle_s=0.0):
+                rs, rport = fleets[arm]
+                ctls = ClientTLS(tca) if arm == "tls" else None
+                t0 = time.perf_counter()
+                with ServiceClient(f"127.0.0.1:{rport}",
+                                   tls=ctls) as c:
+                    deadline = time.monotonic() + settle_s
+                    while True:
+                        s0 = c.submit(args(tag, []))
+                        if s0.get("ok"):
+                            break
+                        # TCP members need a first health-poll round
+                        # before the router will place — honor the
+                        # truthful retry hint during the prime only
+                        if (s0.get("error") != "queue_full"
+                                or time.monotonic() > deadline):
+                            return None
+                        time.sleep(min(0.5,
+                                       s0.get("retry_after_s", 0.5)))
+                        t0 = time.perf_counter()
+                    if c.result(s0["job_id"],
+                                timeout=600).get("rc") != 0:
+                        return None
+                return time.perf_counter() - t0
+            # prime both arms (first placement pays member discovery)
+            for arm in ("tls", "plain"):
+                if tls_once(arm, f"{arm}p", settle_s=30.0) is None:
+                    return _fail("realistic_tls_overhead")
+            tls_walls, plain_walls = [], []
+            for i in range(8):       # interleaved arms; sub-second
+                # fleet walls are noisy at the +-30% level, so the
+                # min-of-mins needs a deeper sample pool than the
+                # longer-walled legs above
+                w = tls_once("tls", f"tlsw{i}")
+                if w is None:
+                    return _fail("realistic_tls_overhead")
+                tls_walls.append(w)
+                w = tls_once("plain", f"plnw{i}")
+                if w is None:
+                    return _fail("realistic_tls_overhead")
+                plain_walls.append(w)
+            if (readset("tlsw0") != parity_body
+                    or readset("plnw0") != parity_body):
+                return _fail("realistic_tls_parity")
+            for arm in ("tls", "plain"):
+                ctls = ClientTLS(tca) if arm == "tls" else None
+                with ServiceClient(f"127.0.0.1:{fleets[arm][1]}",
+                                   tls=ctls) as c:
+                    c.drain()
+            tls_ratio = min(tls_walls) / min(plain_walls)
+            tls_ok = tls_ratio <= 1.15
+        except Exception as e:
+            sys.stderr.write(f"tls leg: {e}\n")
+            return _fail("realistic_tls_overhead")
+        finally:
+            for p in tls_procs:
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait()
+        _emit("realistic_tls_overhead_ratio", tls_ratio, "x",
+              1.0 if tls_ok else 0.0, cpu_metric=True)
+        # the <= 1.15 ceiling as a BOOL leg, same rationale as
+        # realistic_obs_overhead_ok: "x" only gates the committed
+        # trajectory, the bool fails the flip past the ceiling
+        _emit("realistic_tls_overhead_ok", 1 if tls_ok else 0,
+              "bool", 1.0 if tls_ok else 0.0, cpu_metric=True)
 
         if on_tpu_backend():
             dev_env = dict(os.environ, PYTHONPATH=env["PYTHONPATH"])

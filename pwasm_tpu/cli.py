@@ -432,7 +432,8 @@ def warmup_files(dirpath: str) -> tuple[str, str]:
     host-side text generation: no jax, no randomness."""
     import os
 
-    os.makedirs(dirpath, exist_ok=True)
+    from pwasm_tpu.utils.fsio import ensure_private_dir
+    ensure_private_dir(dirpath)
     q = "ACGT" * 30                       # 120-base query
     fa = os.path.join(dirpath, "warm.fa")
     with open(fa, "w") as f:

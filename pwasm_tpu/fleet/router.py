@@ -81,6 +81,11 @@ _ROUTE_USAGE = """Usage:
                  [--trace-json=FILE] [--slo-rules=FILE|off]
                  [--result-cache=DIR|off]
                  [--result-cache-max-bytes=N]
+                 [--tls-cert=PEM --tls-key=PEM [--tls-client-ca=PEM]]
+                 [--member-tls-ca=PEM [--member-tls-cert=PEM
+                  --member-tls-key=PEM]] [--member-token=TOKEN]
+                 [--auth-tokens=FILE] [--rate-limit=N[/s][:burst]]
+                 [--max-frame-bytes=N]
  pwasm-tpu route --standby-of=TARGET [--journal-dir=DIR]
                  [--poll-interval=S] [...primary flags inherited
                  on takeover, EXCEPT --backends/--socket/--listen]
@@ -177,6 +182,38 @@ _ROUTE_USAGE = """Usage:
                         own verdict into ONE fleet verdict on top
                         ("off" disables the router's engine).
                         docs/OBSERVABILITY.md
+   --tls-cert=PEM --tls-key=PEM  serve the router's TCP --listen
+                        endpoint over TLS (1.2+; the unix socket stays
+                        plaintext — filesystem permissions are its
+                        auth).  Clients dial with --tls-ca
+   --tls-client-ca=PEM  require mTLS client certificates signed by
+                        this CA; the verified peer CN becomes the
+                        connection's attested identity (`cn:<name>`),
+                        ranking above client_token (docs/FLEET.md
+                        Security model)
+   --member-tls-ca=PEM  dial MEMBERS over TLS, verifying their server
+                        certs against this CA (add --member-tls-cert/
+                        --member-tls-key when members demand mTLS).
+                        One config serves a mixed fleet: unix-socket
+                        members ignore it
+   --member-token=TOKEN client_token presented on every router→member
+                        frame — required when members run
+                        --auth-tokens (the stats poll carries the
+                        lease grant, an admin-scope operation)
+   --auth-tokens=FILE   scoped capability tokens (JSON, CRC-stamped,
+                        hot-reloaded — docs/FLEET.md Security model).
+                        Control verbs (drain/lease-grant/fence) demand
+                        admin scope; unauthorized frames answer
+                        `unauthorized` and touch no ledger state
+   --rate-limit=N[/s][:burst]  per-client token-bucket in front of
+                        fleet admission (edge rate limiting: a
+                        refused submit reaches no member and writes
+                        no journal) — refusals answer `overloaded`
+                        with a truthful retry_after_s
+   --max-frame-bytes=N  per-frame byte ceiling on the router edge
+                        (default 8388608 = 8 MiB, mirroring the
+                        members'); an oversized frame answers
+                        frame_too_large on BOTH transports
 
  SIGTERM (or the `drain` command) latches admission shut; in-flight
  member jobs keep running and their results stay fetchable until the
@@ -382,7 +419,12 @@ class Router:
                  takeover: bool = False,
                  priority_lanes: tuple | list | None = None,
                  quarantine_x: float = 4.0,
-                 quarantine_probation: int = 3):
+                 quarantine_probation: int = 3,
+                 max_frame_bytes: int = protocol.MAX_FRAME_BYTES,
+                 tls=None, member_tls=None,
+                 member_token: str | None = None,
+                 auth_tokens: str | None = None,
+                 rate_limit: tuple | None = None):
         if not backends:
             raise ValueError("route needs at least one backend")
         if not socket_path and not listen:
@@ -517,6 +559,30 @@ class Router:
         if scale_policy:
             from pwasm_tpu.fleet.scaler import FleetScaler
             self.scaler = FleetScaler(self, scale_policy)
+        # ---- zero-trust edge (ISSUE 19), mirroring the serve daemon:
+        # TLS on the router's own TCP listener, ClientTLS + capability
+        # token for every router->member dial (the _dial factory), a
+        # scoped-token gate on the edge, and the edge rate limiter.
+        # All opt-in; unarmed the router is byte-identical to PR 18.
+        self.max_frame_bytes = int(max_frame_bytes)
+        self.tls = tls                     # transport.ServerTLS | None
+        self.member_tls = member_tls       # transport.ClientTLS | None
+        self.member_token = member_token
+        from pwasm_tpu.obs.catalog import build_transport_metrics
+        self.transport_metrics = build_transport_metrics(self.registry)
+        self.auth = None
+        self._penalty = None
+        if auth_tokens:
+            from pwasm_tpu.service.authz import (AuthRegistry,
+                                                 PenaltyBox)
+            self.auth = AuthRegistry(auth_tokens, say=self._say)
+            self._penalty = PenaltyBox()
+        self._auth_labels: set = set()
+        self.rate_limiter = None
+        if rate_limit is not None:
+            from pwasm_tpu.service.queue import RateLimiter
+            self.rate_limiter = RateLimiter(rate_limit[0],
+                                            rate_limit[1])
 
     # ---- lifecycle -----------------------------------------------------
     def serve(self) -> int:
@@ -524,18 +590,17 @@ class Router:
         listeners: list[socket.socket] = []
         try:
             if self.socket_path:
-                from pwasm_tpu.service.daemon import _socket_alive
-                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-                if os.path.exists(self.socket_path):
-                    if _socket_alive(self.socket_path):
-                        s.close()
-                        raise PwasmError(
-                            f"Error: something is already serving on "
-                            f"{self.socket_path}\n")
-                    os.unlink(self.socket_path)
-                s.bind(self.socket_path)
-                s.listen(16)
-                listeners.append(s)
+                from pwasm_tpu.fleet.transport import (
+                    make_unix_listener, socket_alive)
+                if os.path.exists(self.socket_path) \
+                        and socket_alive(self.socket_path):
+                    raise PwasmError(
+                        f"Error: something is already serving on "
+                        f"{self.socket_path}\n")
+                # the factory chmods the socket 0600 (ISSUE 19):
+                # local clients are the serving uid; TCP is the
+                # opt-in wider audience, with TLS/auth as its gate
+                listeners.append(make_unix_listener(self.socket_path))
             if self.listen:
                 t = make_tcp_listener(self.listen)
                 self.tcp_port = t.getsockname()[1]
@@ -574,6 +639,10 @@ class Router:
             drained_at = None
             try:
                 while True:
+                    if self.auth is not None:
+                        # token rotation without a restart (same
+                        # keep-last-good reload as the members)
+                        self.auth.maybe_reload()
                     if self.drain.requested:
                         self._begin_drain(self.drain.reason
                                           or "drain requested")
@@ -911,7 +980,7 @@ class Router:
         for m in list(self.members.values()):
             t_rpc = time.monotonic()
             try:
-                with ServiceClient(m.target, timeout=3.0) as c:
+                with self._dial(m.target, timeout=3.0) as c:
                     # the epoch lease rides the stats poll: every
                     # healthy tick IS the heartbeat, so fencing needs
                     # no extra RPC round and no extra timer
@@ -1121,7 +1190,7 @@ class Router:
                 if m is None or not m.alive:
                     continue
             try:
-                with ServiceClient(m.target, timeout=3.0) as c:
+                with self._dial(m.target, timeout=3.0) as c:
                     for j in jobs:
                         st = c.status(j.mjid)
                         if st.get("ok") and st["job"]["state"] \
@@ -1315,7 +1384,7 @@ class Router:
             # best-effort synchronous fence: if the member is a
             # reachable zombie this lands instantly; a truly dead one
             # just refuses the connect
-            with ServiceClient(m.target, timeout=1.0) as c:
+            with self._dial(m.target, timeout=1.0) as c:
                 c.request({"cmd": "fence",
                            "reason": f"fleet failover epoch {epoch}: "
                            "the router declared this member dead"})
@@ -1490,7 +1559,7 @@ class Router:
             if m.name == dead:
                 continue
             try:
-                c = ServiceClient(m.target, timeout=30.0)
+                c = self._dial(m.target, timeout=30.0)
             except ServiceError:
                 continue       # connect refused: safe to try the next
             try:
@@ -1574,7 +1643,7 @@ class Router:
             if m.name == dead:
                 continue
             try:
-                c = ServiceClient(m.target, timeout=60.0)
+                c = self._dial(m.target, timeout=60.0)
             except ServiceError:
                 continue
             try:
@@ -1674,8 +1743,34 @@ class Router:
     # ---- protocol ------------------------------------------------------
     def _handle_conn(self, conn: socket.socket) -> None:
         from pwasm_tpu.service.daemon import _peer_identity
+        if self.tls is not None and conn.family != socket.AF_UNIX:
+            # handshake in THIS connection's thread; a failure is
+            # counted and answered with a loud close, never a hang
+            # or an accept-loop crash (same contract as the daemon)
+            from pwasm_tpu.fleet.transport import server_handshake
+            conn = server_handshake(conn, self.tls,
+                                    on_failure=self._tls_failed)
+            if conn is None:
+                return
         protocol.serve_connection(conn, self._dispatch,
-                                  peer=_peer_identity(conn))
+                                  peer=_peer_identity(conn),
+                                  max_frame_bytes=self.max_frame_bytes)
+
+    def _tls_failed(self, exc: Exception) -> None:
+        self.transport_metrics["tls_handshake_failures"].inc()
+        self.obs.event("tls_handshake_failed",
+                       detail=f"{type(exc).__name__}: {exc}")
+
+    def _dial(self, target: str, timeout: float | None = None,
+              **kw) -> ServiceClient:
+        """EVERY router->member connection is minted here, so the
+        member-facing TLS config and capability token cannot be
+        missed by one call site — an all-TLS fleet stays all-TLS
+        through failover, cache probes, and scaler retires."""
+        if self.member_token is not None:
+            kw.setdefault("client_token", self.member_token)
+        return ServiceClient(target, timeout=timeout,
+                             tls=self.member_tls, **kw)
 
     def _resolve_client(self, req: dict, peer: str | None) -> str:
         """protocol.resolve_client_identity — shared with the serve
@@ -1683,8 +1778,69 @@ class Router:
         drift."""
         return protocol.resolve_client_identity(req, peer)
 
+    def _auth_label(self, client: str) -> str:
+        if client in self._auth_labels or len(self._auth_labels) < 64:
+            self._auth_labels.add(client)
+            return client
+        return "other"
+
+    def _authorize(self, cmd, req: dict, peer) -> dict | None:
+        """The router-edge scoped-token gate (ISSUE 19) — the same
+        policy shape as the member's: None = proceed, else the
+        truthful `unauthorized` frame, with no ledger/journal state
+        touched and no frame forwarded to any member."""
+        from pwasm_tpu.service import authz
+        scope = authz.required_scope(cmd, req)
+        ok = False
+        if scope is None or self.auth.allows(req, peer,
+                                             authz.SCOPE_ADMIN):
+            ok = True
+        elif scope == authz.SCOPE_CANCEL_OWN:
+            if self.auth.allows(req, peer, scope):
+                job = self.jobs.get(req.get("job_id"))
+                ok = (job is None or job.client
+                      == self._resolve_client(req, peer))
+        else:
+            ok = self.auth.allows(req, peer, scope)
+        key = peer or self._resolve_client(req, peer) or "anonymous"
+        if ok:
+            self._penalty.clear(key)
+            return None
+        client = self._resolve_client(req, peer) or "anonymous"
+        self.transport_metrics["auth_failures"].inc(
+            client=self._auth_label(client))
+        self.obs.event("unauthorized", cmd=cmd, client=client)
+        time.sleep(self._penalty.fail(key))
+        return protocol.err(
+            protocol.ERR_UNAUTHORIZED,
+            f"cmd {cmd!r} requires scope {scope!r} and the presented "
+            "credentials do not grant it (token file: "
+            f"{self.auth.path})")
+
     def _dispatch(self, req: dict, peer: str | None = None) -> dict:
         cmd = req.get("cmd")
+        if self.auth is not None:
+            deny = self._authorize(cmd, req, peer)
+            if deny is not None:
+                return deny
+        if self.rate_limiter is not None \
+                and cmd in ("submit", "stream"):
+            # edge rate limiting in front of the fleet ledger: a
+            # refused frame reaches no member and writes no journal
+            client = self._resolve_client(req, peer)
+            wait = self.rate_limiter.admit(client or "default")
+            if wait > 0:
+                self.obs.event("rate_limited",
+                               client=client or "default",
+                               retry_after_s=wait)
+                return protocol.err(
+                    protocol.ERR_OVERLOADED,
+                    f"rate limit: client "
+                    f"{client or 'default'} exceeded "
+                    f"{self.rate_limiter.rate:g}/s "
+                    f"(burst {self.rate_limiter.burst:g})",
+                    client=client or "default",
+                    retry_after_s=wait)
         if cmd == "ping":
             with self._lock:
                 alive = sum(1 for m in self.members.values()
@@ -1819,7 +1975,7 @@ class Router:
             t0 = self.obs.tracer.now() \
                 if self.obs.tracer is not None else 0.0
             try:
-                c = ServiceClient(m.target, timeout=60.0)
+                c = self._dial(m.target, timeout=60.0)
             except ServiceError:
                 self.ledger.retire(client, m.name)
                 self._member_down(m.name)
@@ -2028,7 +2184,7 @@ class Router:
             if family is not None:
                 probe["family"] = family
             try:
-                with ServiceClient(m.target, timeout=0.5) as c:
+                with self._dial(m.target, timeout=0.5) as c:
                     r = c.request(probe)
             except ServiceError:
                 continue
@@ -2164,7 +2320,7 @@ class Router:
                 self._recover_job(job)
                 continue
             try:
-                with ServiceClient(m.target, timeout=30.0) as c:
+                with self._dial(m.target, timeout=30.0) as c:
                     resp = c.request({"cmd": cmd, "job_id": mjid})
             except ServiceError:
                 self._member_down(job.member)
@@ -2242,7 +2398,7 @@ class Router:
                 time.sleep(0.05)
                 continue
             try:
-                with ServiceClient(m.target, timeout=60.0) as c:
+                with self._dial(m.target, timeout=60.0) as c:
                     resp = c.result(mjid,
                                     wait=wait and not expired,
                                     timeout=slice_s)
@@ -2340,7 +2496,7 @@ class Router:
             if fresh:
                 mh = None
                 try:
-                    with ServiceClient(target, timeout=3.0) as c:
+                    with self._dial(target, timeout=3.0) as c:
                         resp = c.request({"cmd": "health"})
                     if resp.get("ok"):
                         mh = resp.get("health")
@@ -2652,6 +2808,72 @@ def route_main(argv: list[str], stdout=None, stderr=None) -> int:
             except ValueError as e:
                 stderr.write(f"{_ROUTE_USAGE}\nError: {e}\n")
                 return EXIT_USAGE
+    max_frame_bytes = protocol.MAX_FRAME_BYTES
+    val = opts.pop("max-frame-bytes", None)
+    if val is not None:
+        if val.isascii() and val.isdigit() and int(val) >= 1:
+            max_frame_bytes = int(val)
+        else:
+            stderr.write(f"{_ROUTE_USAGE}\nInvalid "
+                         f"--max-frame-bytes value: {val}\n")
+            return EXIT_USAGE
+    tls_cert = opts.pop("tls-cert", None)
+    tls_key = opts.pop("tls-key", None)
+    tls_client_ca = opts.pop("tls-client-ca", None)
+    if (tls_cert is None) != (tls_key is None):
+        stderr.write(f"{_ROUTE_USAGE}\nError: --tls-cert and "
+                     "--tls-key must be given together\n")
+        return EXIT_USAGE
+    if tls_client_ca is not None and tls_cert is None:
+        stderr.write(f"{_ROUTE_USAGE}\nError: --tls-client-ca "
+                     "requires --tls-cert/--tls-key\n")
+        return EXIT_USAGE
+    tls = None
+    if tls_cert is not None:
+        from pwasm_tpu.fleet.transport import ServerTLS
+        try:
+            tls = ServerTLS(tls_cert, tls_key,
+                            client_ca=tls_client_ca)
+        except ValueError as e:
+            stderr.write(f"{_ROUTE_USAGE}\nError: {e}\n")
+            return EXIT_USAGE
+    member_tls_ca = opts.pop("member-tls-ca", None)
+    member_tls_cert = opts.pop("member-tls-cert", None)
+    member_tls_key = opts.pop("member-tls-key", None)
+    if (member_tls_cert is None) != (member_tls_key is None):
+        stderr.write(f"{_ROUTE_USAGE}\nError: --member-tls-cert and "
+                     "--member-tls-key must be given together\n")
+        return EXIT_USAGE
+    if member_tls_cert is not None and member_tls_ca is None:
+        stderr.write(f"{_ROUTE_USAGE}\nError: --member-tls-cert/"
+                     "--member-tls-key need --member-tls-ca=PEM\n")
+        return EXIT_USAGE
+    member_tls = None
+    if member_tls_ca is not None:
+        from pwasm_tpu.fleet.transport import ClientTLS
+        try:
+            member_tls = ClientTLS(member_tls_ca,
+                                   certfile=member_tls_cert,
+                                   keyfile=member_tls_key)
+        except ValueError as e:
+            stderr.write(f"{_ROUTE_USAGE}\nError: {e}\n")
+            return EXIT_USAGE
+    member_token = opts.pop("member-token", None)
+    auth_tokens = opts.pop("auth-tokens", None)
+    if auth_tokens is not None and not auth_tokens.strip():
+        stderr.write(f"{_ROUTE_USAGE}\nInvalid --auth-tokens "
+                     "value: must name a token file\n")
+        return EXIT_USAGE
+    rate_limit = None
+    val = opts.pop("rate-limit", None)
+    if val is not None:
+        from pwasm_tpu.service.queue import parse_rate_limit
+        try:
+            rate_limit = parse_rate_limit(val)
+        except ValueError as e:
+            stderr.write(f"{_ROUTE_USAGE}\nInvalid --rate-limit "
+                         f"value: {val} ({e})\n")
+            return EXIT_USAGE
     if opts:
         stderr.write(f"{_ROUTE_USAGE}\nInvalid argument: "
                      f"--{next(iter(opts))}\n")
@@ -2671,7 +2893,10 @@ def route_main(argv: list[str], stdout=None, stderr=None) -> int:
         stream_replay_bytes=stream_replay_bytes,
         priority_lanes=priority_lanes,
         quarantine_x=quarantine_x,
-        quarantine_probation=quarantine_probation)
+        quarantine_probation=quarantine_probation,
+        max_frame_bytes=max_frame_bytes,
+        tls=tls, member_tls=member_tls, member_token=member_token,
+        auth_tokens=auth_tokens, rate_limit=rate_limit)
     if standby_of is not None:
         from pwasm_tpu.fleet.standby import run_standby
         return run_standby(standby_of, stderr=stderr,

@@ -283,7 +283,9 @@ class FleetScaler:
                                                 None)})])
         r._remove_member(m.name)
         try:
-            with ServiceClient(m.target, timeout=5.0) as c:
+            # the router's dial factory: a TLS/token-armed fleet
+            # retires members with the same credentials it polls with
+            with r._dial(m.target, timeout=5.0) as c:
                 c.request({"cmd": "drain"})
         except (ServiceError, OSError):
             pass                     # already dying is fine

@@ -519,6 +519,32 @@ def build_canary_metrics(reg: MetricsRegistry) -> dict:
     return m
 
 
+def build_transport_metrics(reg: MetricsRegistry) -> dict:
+    """Register the zero-trust edge families (ISSUE 19): TLS
+    handshake failures on the listener (downgrade probes, bad certs,
+    mid-handshake disconnects — counted, never fatal to the accept
+    loop) and per-client authorization refusals (the signal the
+    ``auth_failure_burst`` SLO rule and the connection-level penalty
+    box key on).  Registered by both the serve daemon and the fleet
+    router over their own registries."""
+    m = {}
+    m["tls_handshake_failures"] = reg.counter(
+        "pwasm_transport_tls_handshake_failures_total",
+        "TLS handshakes that failed on the listener (plaintext "
+        "probes, protocol downgrades below the TLS1.2 floor, "
+        "untrusted or missing client certs under mTLS, mid-handshake "
+        "disconnects) — each answered with a loud close, never a "
+        "hang or an accept-loop crash")
+    m["auth_failures"] = reg.counter(
+        "pwasm_transport_auth_failures_total",
+        "Frames refused with the `unauthorized` error, by resolved "
+        "client identity (distinct label values are capped; overflow "
+        "folds into `other`) — refusals change no queue/journal "
+        "state and repeated failures earn a capped-exponential "
+        "connection delay", labels=("client",))
+    return m
+
+
 # metric-name-lint: end-of-registrations (everything below REFERENCES
 # registered families — SLO rule expressions — and is excluded from
 # the registration-uniqueness scan in qa/check_supervision.py)
@@ -588,6 +614,19 @@ DEFAULT_SLO_RULES = (
                 "mis-sized --result-cache-max-bytes silently costs "
                 "every repeat job its 100x hit — raise the budget or "
                 "shrink the retained output set"},
+    # zero-trust edge (ISSUE 19): a burst of unauthorized refusals
+    # is either a misdeployed credential (a rotated token the client
+    # fleet never picked up) or someone probing the control plane —
+    # both want a human within the window.
+    {"name": "auth_failure_burst", "severity": "warn", "kind": "rate",
+     "metric": "pwasm_transport_auth_failures_total", "op": ">",
+     "value": 10, "window_s": 60.0,
+     "runbook": "more than 10 frames answered `unauthorized` within "
+                "the window; a legitimate client is holding a stale "
+                "token (rotate via --auth-tokens hot reload) or a "
+                "peer is probing scopes — the penalty box is already "
+                "damping it, check the per-client labels on "
+                "pwasm_transport_auth_failures_total"},
 )
 
 # the fleet router's default rules, over the pwasm_fleet_* families

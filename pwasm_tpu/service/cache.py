@@ -477,7 +477,8 @@ class CacheStore:
         self._delta_fraction = 0.0   # sum of served/total per delta
         self.prefetched = 0
         self._recounted_at = 0.0     # monotonic, last disk recount
-        os.makedirs(root, exist_ok=True)
+        from pwasm_tpu.utils.fsio import ensure_private_dir
+        ensure_private_dir(root)
         self.sweep()
 
     # ---- internals -----------------------------------------------------

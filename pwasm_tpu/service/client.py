@@ -147,13 +147,18 @@ class ServiceClient:
                  max_frame_bytes: int = protocol.MAX_FRAME_BYTES,
                  trace_id: str | None = None,
                  client_token: str | None = None,
-                 deadline_s: float | None = None):
+                 deadline_s: float | None = None,
+                 tls=None):
         from pwasm_tpu.fleet.transport import connect
         from pwasm_tpu.obs.events import new_run_id
         self.socket_path = socket_path
         self.max_frame_bytes = max_frame_bytes
         self.trace_id = trace_id or new_run_id()
         self.client_token = client_token
+        # TLS client config (transport.ClientTLS): applies to TCP
+        # targets only — unix-socket connects ignore it, so ONE config
+        # serves a mixed local+TCP fleet (ISSUE 19)
+        self.tls = tls
         # ---- end-to-end deadline (ISSUE 18): --deadline-s mints ONE
         # monotonic deadline for this connection's jobs; every frame
         # carries the REMAINING budget as integer deadline_ms, so each
@@ -164,7 +169,8 @@ class ServiceClient:
         self._deadline_mono = (time.monotonic() + deadline_s
                                if deadline_s else None)
         try:
-            self._sock = connect(socket_path, timeout=timeout)
+            self._sock = connect(socket_path, timeout=timeout,
+                                 tls=tls)
         except (OSError, ValueError) as e:
             raise ServiceError(
                 f"cannot connect to service target {socket_path}: "
@@ -179,8 +185,6 @@ class ServiceClient:
         correlatable in a packet capture) — and the client token when
         this connection has one (the TCP identity)."""
         obj.setdefault("trace_id", self.trace_id)
-        if self.client_token:
-            obj.setdefault("client_token", self.client_token)
         if self._deadline_mono is not None:
             # remaining budget re-read per frame (never cached): a
             # frame sent after a long result wait must carry the truth
@@ -198,6 +202,11 @@ class ServiceClient:
         return self._deadline_mono - time.monotonic()
 
     def request(self, obj: dict) -> dict:
+        # the credential is a property of the CONNECTION, not of the
+        # convenience verbs: raw frames (router→member polls, test
+        # probes) must authenticate the same way _req-built ones do
+        if self.client_token:
+            obj.setdefault("client_token", self.client_token)
         try:
             protocol.write_frame(self._wfile, obj)
             resp = protocol.read_frame(self._rfile,
@@ -338,7 +347,8 @@ class ServiceClient:
                 try:
                     with ServiceClient(self.socket_path,
                                        trace_id=self.trace_id,
-                                       client_token=self.client_token) \
+                                       client_token=self.client_token,
+                                       tls=self.tls) \
                             as kc:
                         while not stop.wait(keepalive_s):
                             if not kc.stream_data(job_id,
@@ -498,6 +508,12 @@ def _parse_client_argv(argv: list[str],
             opts["trace_id"] = a.split("=", 1)[1]
         elif a.startswith("--trace-json="):
             opts["trace_json"] = a.split("=", 1)[1]
+        elif a.startswith("--tls-ca="):
+            opts["tls_ca"] = a.split("=", 1)[1]
+        elif a.startswith("--tls-cert="):
+            opts["tls_cert"] = a.split("=", 1)[1]
+        elif a.startswith("--tls-key="):
+            opts["tls_key"] = a.split("=", 1)[1]
         elif a == "--exit-code" and cmd == "health":
             opts["exit_code"] = True
         elif a == "--exemplars" and cmd == "metrics":
@@ -551,7 +567,7 @@ def _job_verdict(resp: dict, job_id: str, stdout, stderr,
 
 
 def _logs_main(opts: dict, positional: list[str],
-               sock: str | None, stdout, stderr) -> int:
+               sock: str | None, stdout, stderr, tls=None) -> int:
     """The ``pwasm-tpu logs`` verb: socket mode asks the daemon to
     filter its own ``--log-json``; FILE mode runs the SAME filter
     (``obs/logquery.py``) over a log on disk — the two cannot
@@ -590,7 +606,7 @@ def _logs_main(opts: dict, positional: list[str],
                          "--socket OR a log FILE, not both\n")
             return EXIT_USAGE
         try:
-            with ServiceClient(sock) as c:
+            with ServiceClient(sock, tls=tls) as c:
                 resp = c.logs(trace_id=trace_id, job_id=job_id,
                               event=event, limit=limit)
         except ServiceError as e:
@@ -630,10 +646,29 @@ def client_main(cmd: str, argv: list[str], stdout=None,
     stderr = stderr if stderr is not None else sys.stderr
     opts, job_argv = _parse_client_argv(argv, cmd)
     sock = opts.get("socket")
+    # TLS client config (ISSUE 19): --tls-ca verifies the server,
+    # --tls-cert/--tls-key present a client certificate (mTLS).
+    # Applies to TCP targets; a unix-socket connect ignores it.
+    tls = None
+    if "tls_ca" in opts:
+        from pwasm_tpu.fleet.transport import ClientTLS
+        try:
+            tls = ClientTLS(opts["tls_ca"],
+                            certfile=opts.get("tls_cert"),
+                            keyfile=opts.get("tls_key"))
+        except ValueError as e:
+            stderr.write(f"Error: {e}\n")
+            return EXIT_USAGE
+    elif "tls_cert" in opts or "tls_key" in opts:
+        stderr.write(f"{_CLIENT_USAGE}\nError: --tls-cert/--tls-key "
+                     "need --tls-ca=PEM (the CA that vouches for "
+                     "the server)\n")
+        return EXIT_USAGE
     if cmd == "logs":
         # the one socket-optional verb: `logs FILE` queries a log on
         # disk directly (same filter engine the daemon runs)
-        return _logs_main(opts, job_argv, sock, stdout, stderr)
+        return _logs_main(opts, job_argv, sock, stdout, stderr,
+                          tls=tls)
     if not sock:
         stderr.write(f"{_CLIENT_USAGE}\nError: --socket=PATH is "
                      "required\n")
@@ -688,7 +723,8 @@ def client_main(cmd: str, argv: list[str], stdout=None,
         if cmd == "metrics":
             with ServiceClient(
                     sock, trace_id=opts.get("trace_id"),
-                    client_token=opts.get("client_token")) as c:
+                    client_token=opts.get("client_token"),
+                    tls=tls) as c:
                 resp = c.metrics(
                     exemplars=bool(opts.get("exemplars")))
             if not resp.get("ok"):
@@ -699,7 +735,8 @@ def client_main(cmd: str, argv: list[str], stdout=None,
         if cmd == "health":
             with ServiceClient(
                     sock, trace_id=opts.get("trace_id"),
-                    client_token=opts.get("client_token")) as c:
+                    client_token=opts.get("client_token"),
+                    tls=tls) as c:
                 resp = c.health()
             if not resp.get("ok"):
                 stderr.write(f"Error: health failed "
@@ -723,7 +760,8 @@ def client_main(cmd: str, argv: list[str], stdout=None,
                 return EXIT_USAGE
             with ServiceClient(
                     sock, trace_id=opts.get("trace_id"),
-                    client_token=opts.get("client_token")) as c:
+                    client_token=opts.get("client_token"),
+                    tls=tls) as c:
                 resp = c.inspect(job_argv[0])
             if not resp.get("ok"):
                 stderr.write(f"Error: inspect failed "
@@ -741,7 +779,8 @@ def client_main(cmd: str, argv: list[str], stdout=None,
         if cmd == "svc-stats":
             with ServiceClient(
                     sock, trace_id=opts.get("trace_id"),
-                    client_token=opts.get("client_token")) as c:
+                    client_token=opts.get("client_token"),
+                    tls=tls) as c:
                 if opts.get("drain"):
                     resp = c.drain()
                     if not resp.get("ok"):
@@ -777,7 +816,7 @@ def client_main(cmd: str, argv: list[str], stdout=None,
             with ServiceClient(
                     sock, trace_id=opts.get("trace_id"),
                     client_token=opts.get("client_token"),
-                    deadline_s=deadline_s) as c:
+                    deadline_s=deadline_s, tls=tls) as c:
                 t0 = tracer.now() if tracer is not None else 0.0
                 resp = c.stream(job_argv, src,
                                 client=opts.get("client"),
@@ -815,7 +854,7 @@ def client_main(cmd: str, argv: list[str], stdout=None,
         with ServiceClient(
                 sock, trace_id=opts.get("trace_id"),
                 client_token=opts.get("client_token"),
-                deadline_s=deadline_s) as c:
+                deadline_s=deadline_s, tls=tls) as c:
             for attempt in range(retries + 1):
                 t0 = tracer.now() if tracer is not None else 0.0
                 resp = c.submit(job_argv, client=opts.get("client"),

@@ -75,6 +75,9 @@ _SERVE_USAGE = """Usage:
                  [--result-cache-max-bytes=N]
                  [--cache-prefetch[=N]]
                  [--canary-interval=S] [--slo-rules=FILE|off]
+                 [--tls-cert=PEM --tls-key=PEM
+                  [--tls-client-ca=PEM]]
+                 [--auth-tokens=FILE] [--rate-limit=N[/s][:burst]]
 
    --socket=PATH        unix socket to listen on (required)
    --listen=HOST:PORT   ALSO serve the same protocol over TCP (the
@@ -232,6 +235,36 @@ _SERVE_USAGE = """Usage:
                         the `health` verb; "off" disables the engine
                         (the self-monitoring A/B knob).  Rule catalog:
                         docs/OBSERVABILITY.md
+   --tls-cert=PEM --tls-key=PEM  upgrade the --listen TCP listener to
+                        TLS (stdlib ssl, TLS1.2+ floor; the unix
+                        socket keeps kernel peer credentials and
+                        never wraps).  Handshake failures — plaintext
+                        probes, downgrades, bad certs — are counted
+                        (pwasm_transport_tls_handshake_failures_total)
+                        and answered with a loud close, never a hang
+   --tls-client-ca=PEM  require mTLS: client certificates verified
+                        against this CA, and the peer certificate's
+                        CN becomes the connection's ATTESTED identity
+                        (cn:<name>, ranking above client_token in the
+                        resolution order; docs/FLEET.md security
+                        model)
+   --auth-tokens=FILE   scoped capability tokens (service/authz.py):
+                        FILE maps principal (token, cn:<name>,
+                        uid:<n>, or the "*" default) -> scopes from
+                        {submit, read, cancel-own, admin}.  Control-
+                        plane verbs (drain, lease-grant, fence) need
+                        admin; cancel needs ownership or admin; an
+                        unauthorized frame answers `unauthorized`
+                        having changed no queue/journal state.  The
+                        file hot-reloads on the accept-loop tick
+                        (CRC'd, keep-last-good).  Unset = every verb
+                        open, byte-identical to the pre-auth daemon
+   --rate-limit=N[/s][:burst]  per-identity token bucket in front of
+                        admission (submit/stream): past N requests/s
+                        (bucket depth `burst`, default max(1,N)) a
+                        client's frame answers `overloaded` with a
+                        truthful retry_after_s — one hot loop cannot
+                        starve admission for everyone else
 
  SIGTERM/SIGINT (or the `drain` protocol command) drains gracefully:
  in-flight jobs finish at their next batch boundary and checkpoint,
@@ -400,7 +433,9 @@ class Daemon:
                  result_cache: str | None = None,
                  result_cache_max_bytes: int | None = None,
                  result_cache_ttl_s: float | None = None,
-                 cache_prefetch: int | None = None):
+                 cache_prefetch: int | None = None,
+                 tls=None, auth_tokens: str | None = None,
+                 rate_limit: tuple | None = None):
         self.socket_path = socket_path
         # fleet transport (docs/FLEET.md): an optional TCP listener
         # joining the unix socket — same protocol, token-based client
@@ -553,6 +588,31 @@ class Daemon:
         # pwasm_service_breaker_state gauge
         self.run_metrics = build_run_metrics(self.registry,
                                              include_live=False)
+        # ---- zero-trust edge (ISSUE 19): TLS on the TCP listener
+        # (handshake per-connection, in that connection's thread),
+        # scoped capability tokens, per-identity rate limiting.  All
+        # three are strictly opt-in — unarmed, every frame and output
+        # stays byte-identical to the open daemon.
+        from pwasm_tpu.obs.catalog import build_transport_metrics
+        self.transport_metrics = build_transport_metrics(self.registry)
+        self.tls = tls                     # transport.ServerTLS | None
+        self.auth = None
+        self._penalty = None
+        if auth_tokens:
+            from pwasm_tpu.service.authz import (AuthRegistry,
+                                                 PenaltyBox)
+            # startup is fail-fast (ValueError propagates to the CLI
+            # as a usage error): a daemon must never come up OPEN
+            # because its token file was bad
+            self.auth = AuthRegistry(auth_tokens, say=self._say)
+            self._penalty = PenaltyBox()
+        self._auth_labels: set[str] = set()   # bounded label universe
+        #   for the per-client auth-failure counter (overflow -> other)
+        self.rate_limiter = None
+        if rate_limit is not None:
+            from pwasm_tpu.service.queue import RateLimiter
+            self.rate_limiter = RateLimiter(rate_limit[0],
+                                            rate_limit[1])
         self.svc_metrics["max_queue"].set(self.queue.max_queue)
         self.svc_metrics["max_concurrent"].set(self.max_concurrent)
         self.svc_metrics["lanes"].set(self.leases.n_lanes)
@@ -640,24 +700,25 @@ class Daemon:
                       f"entr{'y' if warmed == 1 else 'ies'} from "
                       f"{self.cache.root}")
             self.obs.event("cache_prefetch", warmed=warmed)
-        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        from pwasm_tpu.fleet.transport import (make_unix_listener,
+                                               socket_alive)
+        if os.path.exists(self.socket_path):
+            # a stale socket from a dead daemon: binding over it
+            # needs the unlink; a LIVE daemon still holds the
+            # listener, so connecting first tells the two apart
+            if socket_alive(self.socket_path):
+                raise PwasmError(
+                    f"Error: a daemon is already serving on "
+                    f"{self.socket_path}\n")
         try:
-            if os.path.exists(self.socket_path):
-                # a stale socket from a dead daemon: binding over it
-                # needs the unlink; a LIVE daemon still holds the
-                # listener, so connecting first tells the two apart
-                if _socket_alive(self.socket_path):
-                    raise PwasmError(
-                        f"Error: a daemon is already serving on "
-                        f"{self.socket_path}\n")
-                os.unlink(self.socket_path)
-            sock.bind(self.socket_path)
+            # the listener factory chmods the socket 0600 (only the
+            # serving uid connects by default; TCP is the opt-in
+            # wider audience, with TLS/auth as ITS gate)
+            sock = make_unix_listener(self.socket_path)
         except OSError as e:
-            sock.close()
             raise PwasmError(
                 f"Error: cannot bind service socket "
                 f"{self.socket_path}: {e}\n")
-        sock.listen(16)
         listeners: list[socket.socket] = [sock]
         if self.listen:
             # the TCP transport (fleet federation): same protocol,
@@ -753,6 +814,10 @@ class Daemon:
                 while True:
                     self._evict_results()
                     self._selfmon_tick()
+                    if self.auth is not None:
+                        # token rotation without a restart: the file
+                        # hot-reloads on this tick (keep-last-good)
+                        self.auth.maybe_reload()
                     if self.epoch_lease.expired():
                         self._fence("lease TTL expired: heartbeats "
                                     "from the fleet router stopped")
@@ -1142,7 +1207,8 @@ class Daemon:
             return
         import json
 
-        from pwasm_tpu.utils.fsio import (payload_crc,
+        from pwasm_tpu.utils.fsio import (ensure_private_dir,
+                                          payload_crc,
                                           write_durable_text)
         flight = None
         if job.flight is not None:
@@ -1167,7 +1233,7 @@ class Daemon:
         path = os.path.join(self.spool_dir,
                             f"{job.id}.result.json")
         try:
-            os.makedirs(self.spool_dir, exist_ok=True)
+            ensure_private_dir(self.spool_dir)
             write_durable_text(path, out)
         except OSError as e:
             if not self._spool_warned:
@@ -2075,9 +2141,72 @@ class Daemon:
 
     # ---- protocol ------------------------------------------------------
     def _handle_conn(self, conn: socket.socket) -> None:
+        if self.tls is not None and conn.family != socket.AF_UNIX:
+            # TLS handshake in THIS connection's thread (never the
+            # accept loop): a failure — plaintext probe, downgrade,
+            # mid-handshake disconnect — is counted and answered
+            # with a loud close, and the daemon serves on
+            from pwasm_tpu.fleet.transport import server_handshake
+            conn = server_handshake(conn, self.tls,
+                                    on_failure=self._tls_failed)
+            if conn is None:
+                return
         protocol.serve_connection(conn, self._dispatch,
                                   peer=_peer_identity(conn),
                                   max_frame_bytes=self.max_frame_bytes)
+
+    def _tls_failed(self, exc: Exception) -> None:
+        self.transport_metrics["tls_handshake_failures"].inc()
+        self.obs.event("tls_handshake_failed",
+                       detail=f"{type(exc).__name__}: {exc}")
+
+    def _auth_label(self, client: str) -> str:
+        """Metric label for an auth failure: per-client until the
+        universe would explode (identity strings are attacker-
+        chosen), then the overflow bucket."""
+        if client in self._auth_labels or len(self._auth_labels) < 64:
+            self._auth_labels.add(client)
+            return client
+        return "other"
+
+    def _authorize(self, cmd, req: dict, peer) -> dict | None:
+        """The scoped-token gate (ISSUE 19), BEFORE any verb handler
+        runs: an unauthorized frame answers `unauthorized` having
+        touched no queue/journal/lease state.  None = proceed."""
+        from pwasm_tpu.service import authz
+        scope = authz.required_scope(cmd, req)
+        ok = False
+        if scope is None or self.auth.allows(req, peer,
+                                             authz.SCOPE_ADMIN):
+            ok = True
+        elif scope == authz.SCOPE_CANCEL_OWN:
+            if self.auth.allows(req, peer, scope):
+                job = self.jobs.get(req.get("job_id"))
+                # unknown ids fall through to the normal unknown_job
+                # answer — the auth layer must not become a job-id
+                # oracle; a KNOWN job needs ownership: its recorded
+                # fair-share identity == the caller's resolved one
+                ok = (job is None or job.client
+                      == self._resolve_client(req, peer))
+        else:
+            ok = self.auth.allows(req, peer, scope)
+        key = peer or self._resolve_client(req, peer) or "anonymous"
+        if ok:
+            self._penalty.clear(key)
+            return None
+        client = self._resolve_client(req, peer) or "anonymous"
+        self.transport_metrics["auth_failures"].inc(
+            client=self._auth_label(client))
+        self.obs.event("unauthorized", cmd=cmd, client=client)
+        # brute-force damping: consecutive failures from this peer
+        # earn a capped-exponential hold, served on this connection's
+        # own thread — the accept loop and other clients never wait
+        time.sleep(self._penalty.fail(key))
+        return protocol.err(
+            protocol.ERR_UNAUTHORIZED,
+            f"cmd {cmd!r} requires scope {scope!r} and the presented "
+            "credentials do not grant it (token file: "
+            f"{self.auth.path})")
 
     def _resolve_client(self, req: dict, peer: str | None) -> str:
         """protocol.resolve_client_identity — shared with the fleet
@@ -2086,6 +2215,30 @@ class Daemon:
 
     def _dispatch(self, req: dict, peer: str | None = None) -> dict:
         cmd = req.get("cmd")
+        if self.auth is not None:
+            deny = self._authorize(cmd, req, peer)
+            if deny is not None:
+                return deny
+        if self.rate_limiter is not None \
+                and cmd in ("submit", "stream"):
+            # per-identity token bucket in FRONT of admission: a
+            # refused frame never reaches the queue or the journal,
+            # and the hint is the truthful instant the bucket next
+            # holds a whole token
+            client = self._resolve_client(req, peer)
+            wait = self.rate_limiter.admit(client or "default")
+            if wait > 0:
+                self.obs.event("rate_limited",
+                               client=client or "default",
+                               retry_after_s=wait)
+                return protocol.err(
+                    protocol.ERR_OVERLOADED,
+                    f"rate limit: client "
+                    f"{client or 'default'} exceeded "
+                    f"{self.rate_limiter.rate:g}/s "
+                    f"(burst {self.rate_limiter.burst:g})",
+                    client=client or "default",
+                    retry_after_s=wait)
         # eviction runs on every request (plus the accept-loop tick
         # and each admission), so reads observe a deterministic
         # post-eviction view: an id past its TTL/LRU budget answers
@@ -2582,14 +2735,21 @@ def _absolutize_argv(argv: list[str], cwd: str) -> list[str]:
 
 
 def _peer_identity(conn: socket.socket) -> str | None:
-    """The connection's DEFAULT fair-share identity: the unix-socket
-    peer uid via ``SO_PEERCRED`` (kernel-attested — a client cannot
-    spoof it the way a free-form field could), rendered ``uid:<n>``.
-    An explicit ``client=`` submit field overrides it: one uid fronting
-    many logical tenants (a scheduler submitting for users) needs the
+    """The connection's DEFAULT fair-share identity, attested by the
+    transport: an mTLS client certificate's CN (``cn:<name>`` — the
+    listener verified the chain against --tls-client-ca, so the name
+    is as trustworthy as the CA), else the unix-socket peer uid via
+    ``SO_PEERCRED`` (kernel-attested — a client cannot spoof it the
+    way a free-form field could), rendered ``uid:<n>``.  An explicit
+    ``client=`` submit field overrides it: one uid fronting many
+    logical tenants (a scheduler submitting for users) needs the
     finer identity, and admission quotas are a fairness device here,
     not a security boundary.  None when the platform has no peer
     credentials — those submits share the anonymous bucket."""
+    from pwasm_tpu.fleet.transport import peer_common_name
+    cn = peer_common_name(conn)
+    if cn:
+        return f"cn:{cn}"
     peercred = getattr(socket, "SO_PEERCRED", None)
     if peercred is None:
         return None
@@ -2609,15 +2769,10 @@ def _peer_identity(conn: socket.socket) -> str | None:
 
 
 def _socket_alive(path: str) -> bool:
-    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-    s.settimeout(0.5)
-    try:
-        s.connect(path)
-        return True
-    except OSError:
-        return False
-    finally:
-        s.close()
+    # kept as an alias: the probe itself moved to fleet/transport.py
+    # (the single socket factory the find_tls_violations gate allows)
+    from pwasm_tpu.fleet.transport import socket_alive
+    return socket_alive(path)
 
 
 def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
@@ -2771,6 +2926,43 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
             except ValueError as e:
                 stderr.write(f"{_SERVE_USAGE}\nError: {e}\n")
                 return EXIT_USAGE
+    # zero-trust edge (ISSUE 19): TLS/mTLS on the TCP listener,
+    # scoped capability tokens, per-identity rate limiting — each
+    # strictly opt-in
+    tls_cert = opts.pop("tls-cert", None)
+    tls_key = opts.pop("tls-key", None)
+    tls_client_ca = opts.pop("tls-client-ca", None)
+    if (tls_cert is None) != (tls_key is None):
+        stderr.write(f"{_SERVE_USAGE}\nError: --tls-cert and "
+                     "--tls-key must be given together\n")
+        return EXIT_USAGE
+    if tls_client_ca is not None and tls_cert is None:
+        stderr.write(f"{_SERVE_USAGE}\nError: --tls-client-ca "
+                     "requires --tls-cert/--tls-key\n")
+        return EXIT_USAGE
+    tls = None
+    if tls_cert is not None:
+        from pwasm_tpu.fleet.transport import ServerTLS
+        try:
+            tls = ServerTLS(tls_cert, tls_key,
+                            client_ca=tls_client_ca)
+        except ValueError as e:
+            stderr.write(f"Error: {e}\n")
+            return EXIT_USAGE
+    auth_tokens = opts.pop("auth-tokens", None)
+    if auth_tokens is not None and not auth_tokens.strip():
+        stderr.write(f"{_SERVE_USAGE}\nInvalid --auth-tokens value\n")
+        return EXIT_USAGE
+    rate_limit = None
+    val = opts.pop("rate-limit", None)
+    if val is not None:
+        from pwasm_tpu.service.queue import parse_rate_limit
+        try:
+            rate_limit = parse_rate_limit(val)
+        except ValueError as e:
+            stderr.write(f"{_SERVE_USAGE}\nInvalid --rate-limit "
+                         f"value: {val} ({e})\n")
+            return EXIT_USAGE
     metrics_textfile = opts.pop("metrics-textfile", None)
     log_json = opts.pop("log-json", None)
     trace_json = opts.pop("trace-json", None)
@@ -2830,7 +3022,14 @@ def serve_main(argv: list[str], stdout=None, stderr=None) -> int:
                         result_cache=result_cache,
                         result_cache_max_bytes=nums[
                             "result-cache-max-bytes"],
-                        cache_prefetch=cache_prefetch)
+                        cache_prefetch=cache_prefetch,
+                        tls=tls, auth_tokens=auth_tokens,
+                        rate_limit=rate_limit)
+    except ValueError as e:
+        # fail-fast --auth-tokens load: never come up OPEN because
+        # the policy file was bad
+        stderr.write(f"Error: {e}\n")
+        return EXIT_USAGE
     except OSError:
         stderr.write(f"Cannot open file {log_json} for writing!\n")
         return EXIT_USAGE

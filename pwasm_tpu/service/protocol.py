@@ -137,7 +137,17 @@ where no kernel-attested ``SO_PEERCRED`` identity exists — the
 daemon buckets the submit under ``tok:<token>`` for fair share, so
 identities stay attested-or-explicit on both transports (an explicit
 ``client`` field still wins; an untokened TCP frame shares the
-anonymous bucket).
+anonymous bucket).  On an mTLS listener (ISSUE 19,
+``--tls-client-ca``) the verified client certificate's CN arrives as
+the connection's ``cn:<name>`` peer identity and outranks
+``client_token`` — attested cryptography beats a free-form field.
+
+Authorization (ISSUE 19, ``--auth-tokens``): when a scoped-token file
+is configured, each frame's credentials (its ``client_token``, or the
+connection's mTLS CN principal) must carry the scope its verb
+requires; a refused frame answers the ``unauthorized`` error having
+changed NO queue/journal/lease state.  Without the flag every verb
+stays open — byte-identical to the pre-auth protocol.
 """
 
 from __future__ import annotations
@@ -173,6 +183,15 @@ ERR_OVERLOADED = "overloaded"        # brownout shedding at the fleet
 #   this frame's priority lane is being shed (lowest lane first,
 #   hysteresis-damped).  The frame carries retry_after_s; back off
 #   like queue_full — but unlike queue_full, no member was asked.
+#   Per-client rate limiting (ISSUE 19, --rate-limit) answers the
+#   same code with a truthful retry_after_s: to the client the two
+#   are the same instruction — slow down.
+ERR_UNAUTHORIZED = "unauthorized"    # scoped capability tokens
+#   (ISSUE 19, --auth-tokens): the frame's credentials do not carry
+#   the scope its verb requires (admin for drain/lease-grant/fence,
+#   ownership-or-admin for cancel, submit/read for the data plane).
+#   The refusal happens BEFORE admission: no queue, journal or lease
+#   state changed.  Not retryable with the same credentials.
 
 
 class FrameError(Exception):
@@ -191,13 +210,17 @@ def resolve_client_identity(req: dict, peer: str | None) -> str:
     """The fair-share identity resolution order, attested-or-explicit
     on BOTH transports (one function shared by the serve daemon and
     the fleet router, so their quota/DRR bucketing can never drift):
-    an explicit ``client`` field wins; else a ``client_token`` frame
-    field buckets as ``tok:<token>`` (the TCP identity — AF_INET has
-    no SO_PEERCRED); else the kernel-attested unix peer uid; else the
-    anonymous bucket."""
+    an explicit ``client`` field wins; else an mTLS-attested peer
+    certificate CN (the connection's ``cn:<name>`` peer string —
+    verified cryptography outranks any free-form frame field); else a
+    ``client_token`` frame field buckets as ``tok:<token>`` (the
+    plaintext-TCP identity — AF_INET has no SO_PEERCRED); else the
+    kernel-attested unix peer uid; else the anonymous bucket."""
     client = req.get("client")
     if client is not None:
         return client
+    if isinstance(peer, str) and peer.startswith("cn:"):
+        return peer
     tok = req.get("client_token")
     if isinstance(tok, str) and tok:
         return "tok:" + tok
@@ -341,6 +364,14 @@ def read_frame(rfile, max_bytes: int = MAX_FRAME_BYTES) -> dict | None:
                          fatal=True)
     try:
         obj = json.loads(line)
+    except RecursionError:
+        # a JSON bomb (thousands of nested containers) overflows the
+        # parser's stack with RecursionError, not ValueError — found
+        # by qa/protocol_fuzz.py; without this clause the bomb kills
+        # the connection THREAD with a traceback instead of costing
+        # the client an error frame
+        raise FrameError(ERR_BAD_JSON,
+                         "frame nesting exceeds the parser's depth")
     except ValueError as e:
         raise FrameError(ERR_BAD_JSON, f"unparseable frame: {e}")
     if not isinstance(obj, dict):

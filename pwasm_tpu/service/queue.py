@@ -625,3 +625,92 @@ def _sum_numeric(dst: dict, src: dict, skip: tuple = ()) -> None:
 def _copy_tree(d: dict) -> dict:
     return {k: _copy_tree(v) if isinstance(v, dict) else v
             for k, v in d.items()}
+
+
+def parse_rate_limit(spec: str) -> tuple[float, float]:
+    """Parse ``--rate-limit=N[/s][:burst]`` → ``(rate_per_s, burst)``.
+
+    ``N`` is requests per second (float, > 0); ``burst`` is the bucket
+    depth (>= 1, default ``max(1, rate)`` so a limit below 1/s still
+    admits single requests).  Raises ValueError on anything else — the
+    CLI turns that into the usual usage error."""
+    s = spec.strip()
+    burst_s = None
+    if ":" in s:
+        s, burst_s = s.split(":", 1)
+    if s.endswith("/s"):
+        s = s[:-2]
+    try:
+        rate = float(s)
+    except ValueError:
+        raise ValueError(f"rate-limit rate {s!r} is not a number")
+    if not (rate > 0) or rate != rate or rate == float("inf"):
+        raise ValueError("rate-limit rate must be a finite number > 0")
+    if burst_s is None:
+        burst = max(1.0, rate)
+    else:
+        try:
+            burst = float(burst_s)
+        except ValueError:
+            raise ValueError(
+                f"rate-limit burst {burst_s!r} is not a number")
+        if not (burst >= 1) or burst == float("inf"):
+            raise ValueError("rate-limit burst must be finite and >= 1")
+    return rate, burst
+
+
+class RateLimiter:
+    """Per-identity token bucket in front of admission (ISSUE 19).
+
+    One bucket per *resolved* client identity (the same string DRR
+    fair-share uses), refilled continuously at ``rate_per_s`` up to
+    ``burst``.  A refusal is truthful like brownout shedding: it
+    reports the ``retry_after_s`` at which the bucket will actually
+    hold a whole token, so a well-behaved client that honors it is
+    admitted on its next try.
+
+    Monotonic clock only (the clock-discipline gate bans wall-clock
+    deltas); the table is bounded at ``max_clients`` — at the cap,
+    full (idle) buckets are swept first since they carry no state an
+    attacker could launder by eviction, then oldest-inserted."""
+
+    def __init__(self, rate_per_s: float, burst: float,
+                 max_clients: int = 4096):
+        self.rate = float(rate_per_s)
+        self.burst = float(burst)
+        self.max_clients = max_clients
+        # identity -> [tokens, last_refill_mono]
+        self._buckets: dict[str, list] = {}
+        self._lock = threading.Lock()
+        self.refusals = 0
+
+    def admit(self, client: str, now: float | None = None) -> float:
+        """Take one token for ``client``.  Returns 0.0 on admission,
+        else the truthful retry_after_s of the refusal."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            b = self._buckets.get(client)
+            if b is None:
+                if len(self._buckets) >= self.max_clients:
+                    self._evict(now)
+                b = self._buckets[client] = [self.burst, now]
+            tokens = min(self.burst, b[0] + (now - b[1]) * self.rate)
+            b[1] = now
+            if tokens >= 1.0:
+                b[0] = tokens - 1.0
+                return 0.0
+            b[0] = tokens
+            self.refusals += 1
+            return max(0.001, round((1.0 - tokens) / self.rate, 3))
+
+    def _evict(self, now: float) -> None:
+        # caller holds the lock
+        full = [k for k, b in self._buckets.items()
+                if min(self.burst, b[0] + (now - b[1]) * self.rate)
+                >= self.burst]
+        if full:
+            for k in full:
+                del self._buckets[k]
+            return
+        self._buckets.pop(next(iter(self._buckets)))

@@ -77,6 +77,34 @@ def write_durable_text(dest: str, text: str,
     write_durable_bytes(dest, text.encode("utf-8"), tmp_suffix)
 
 
+def ensure_private_dir(path: str) -> str:
+    """Create ``path`` (parents included) OWNER-ONLY (0700) and return
+    it.  The service tree's state directories — result spool, result
+    cache, journal dirs — hold job payloads, results and capability
+    material; a default-umask 0755 directory leaks every other local
+    user read access to all of it.  Mode is applied *at creation*: a
+    PRE-EXISTING directory keeps whatever mode the operator gave it
+    (deliberately widened shared storage stays shared — we refuse to
+    silently chmod a directory we did not make).  The static gate
+    (``qa/check_supervision.py::find_perm_violations``) fails any bare
+    ``os.makedirs`` call site elsewhere in the package so a new state
+    dir cannot quietly ship world-readable."""
+    try:
+        os.makedirs(path, mode=0o700)
+    except FileExistsError:
+        if os.path.isdir(path):
+            return path
+        raise
+    try:
+        # makedirs' mode is filtered through the umask; re-assert the
+        # exact bits on the leaf we just created so the contract is
+        # deterministic, not umask-dependent
+        os.chmod(path, 0o700)
+    except OSError:
+        pass
+    return path
+
+
 def payload_crc(payload) -> int:
     """CRC32 over a JSON payload in canonical form (sorted keys, no
     whitespace) — THE self-validating-state checksum, shared by the

@@ -89,7 +89,8 @@ def enable_compilation_cache(path: str) -> bool:
 
     ok = False
     try:
-        os.makedirs(path, exist_ok=True)
+        from pwasm_tpu.utils.fsio import ensure_private_dir
+        ensure_private_dir(path)
     except OSError:
         return False
     for key, val in (

@@ -655,6 +655,115 @@ def find_error_vocab_gaps(root: str = REPO) -> list[str]:
     return out
 
 
+# ── transport-confinement gate (ISSUE 19 tentpole) ──
+# fleet/transport.py is the ONE place sockets are minted and TLS is
+# configured: the TLS floor (1.2+), the mTLS peer-CN extraction, the
+# handshake-failure accounting and the unix-socket 0600 chmod all live
+# there, so a module that constructs its own socket.socket or touches
+# ssl directly ships a listener/dialer OUTSIDE the zero-trust surface
+# — no TLS upgrade path, no handshake metric, no permission contract.
+# Line-level, pwasm_tpu/ only: qa/fleet_chaos.py's ChaosProxy and the
+# fuzzer are deliberate ATTACKER tooling and stay out of scope.
+TRANSPORT_FILE = "pwasm_tpu/fleet/transport.py"
+TLS_PATTERNS = re.compile(
+    r"socket\.socket\s*\(|socket\.create_connection\s*\("
+    r"|socket\.socketpair\s*\(|socket\.fromfd\s*\("
+    r"|^\s*import\s+ssl\b|^\s*from\s+ssl\s+import\b|\bssl\.")
+
+
+def find_tls_violations(root: str = REPO) -> list[str]:
+    """Raw socket construction or ssl use outside fleet/transport.py
+    (module comment above: the transport module is the zero-trust
+    choke point; everything else dials/binds through it)."""
+    out: list[str] = []
+    pkg = os.path.join(root, "pwasm_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            if rel == TRANSPORT_FILE:
+                continue
+            with open(path, encoding="utf-8") as f:
+                for i, line in enumerate(f, 1):
+                    if line.lstrip().startswith("#"):
+                        continue
+                    if TLS_PATTERNS.search(line):
+                        out.append(
+                            f"{rel}:{i}: socket/ssl use outside the "
+                            f"transport module: {line.strip()} — "
+                            f"mint connections and listeners through "
+                            f"{TRANSPORT_FILE} so TLS, mTLS identity "
+                            "and the 0600 socket contract cannot be "
+                            "bypassed")
+    return out
+
+
+# ── private-directory gate (ISSUE 19 satellite) ──
+# State directories (result spool, result cache, journals, compile
+# cache) hold job payloads and capability material; a bare
+# os.makedirs ships them default-umask world-readable.  Every
+# directory-creation site in pwasm_tpu/ goes through
+# utils/fsio.py::ensure_private_dir (0700 at creation) or registers a
+# justified allowlist entry here.
+FSIO_FILE = "pwasm_tpu/utils/fsio.py"
+MAKEDIRS_RE = re.compile(r"\bos\.makedirs\s*\(|\bos\.mkdir\s*\(")
+
+# path -> justification for a bare makedirs
+PERM_ALLOWLIST = {
+    "pwasm_tpu/utils/backend.py":
+        "already makedirs(mode=0o700) WITH an owner/squat check — "
+        "the probe-cache dir predates ensure_private_dir and needs "
+        "the lstat validation inline",
+}
+
+
+def find_perm_violations(root: str = REPO) -> list[str]:
+    """Bare os.makedirs/os.mkdir in pwasm_tpu/ outside fsio.py and
+    PERM_ALLOWLIST — state dirs are created 0700 via
+    ensure_private_dir."""
+    out: list[str] = []
+    pkg = os.path.join(root, "pwasm_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            if rel == FSIO_FILE or rel in PERM_ALLOWLIST:
+                continue
+            with open(path, encoding="utf-8") as f:
+                for i, line in enumerate(f, 1):
+                    if line.lstrip().startswith("#"):
+                        continue
+                    if MAKEDIRS_RE.search(line):
+                        out.append(
+                            f"{rel}:{i}: bare directory creation: "
+                            f"{line.strip()} — use {FSIO_FILE}::"
+                            "ensure_private_dir (0700) or register "
+                            "a justified PERM_ALLOWLIST entry")
+    return out
+
+
+def stale_perm_allowlist(root: str = REPO) -> list[str]:
+    """PERM_ALLOWLIST rows whose file no longer creates directories —
+    same accuracy rule as the other registries."""
+    out = []
+    for rel in PERM_ALLOWLIST:
+        path = os.path.join(root, *rel.split("/"))
+        if not os.path.isfile(path):
+            out.append(rel)
+            continue
+        with open(path, encoding="utf-8") as f:
+            if not any(MAKEDIRS_RE.search(l) for l in f
+                       if not l.lstrip().startswith("#")):
+                out.append(rel)
+    return out
+
+
 def find_doc_drift(root: str = REPO) -> list[str]:
     """Catalog families missing from docs/OBSERVABILITY.md (module
     comment: the doc is the operator's catalog of record, so every
@@ -712,13 +821,18 @@ def main() -> int:
         "subtraction left — remove it)"
         for rel in stale_clock_allowlist()]
     errvocab = find_error_vocab_gaps()
+    tlsv = find_tls_violations()
+    perm = find_perm_violations() + [
+        f"{rel}: stale PERM_ALLOWLIST entry (no directory creation "
+        "left — remove it)" for rel in stale_perm_allowlist()]
     for line in bad:
         print(line, file=sys.stderr)
     for rel in stale:
         print(f"{rel}: stale registry entry (no device entry points "
               "left — remove it)", file=sys.stderr)
     for line in svc + obs + stream + fleet + metric + doc_drift \
-            + sharding + slo + cachev + fencing + clock + errvocab:
+            + sharding + slo + cachev + fencing + clock + errvocab \
+            + tlsv + perm:
         print(line, file=sys.stderr)
     if bad:
         print(f"\n{len(bad)} device entry point(s) outside the "
@@ -771,9 +885,19 @@ def main() -> int:
               "failure(s): every protocol ERR_* code needs at least "
               "one test that provokes it (ISSUE 18).",
               file=sys.stderr)
+    if tlsv:
+        print(f"\n{len(tlsv)} transport-confinement failure(s): "
+              f"sockets and ssl are minted only in {TRANSPORT_FILE} "
+              "(ISSUE 19).", file=sys.stderr)
+    if perm:
+        print(f"\n{len(perm)} private-directory failure(s): state "
+              "dirs are created 0700 via "
+              "utils/fsio.py::ensure_private_dir (ISSUE 19).",
+              file=sys.stderr)
     return 1 if (bad or stale or svc or obs or stream or fleet
                  or metric or doc_drift or sharding or slo
-                 or cachev or fencing or clock or errvocab) else 0
+                 or cachev or fencing or clock or errvocab
+                 or tlsv or perm) else 0
 
 
 if __name__ == "__main__":

@@ -357,6 +357,15 @@ def deadline_drill(target: str, args: list, cwd: str,
 # standalone: the in-process gray drill (stub runners, no jax)
 # ---------------------------------------------------------------------------
 def main() -> int:
+    if "--fuzz" in sys.argv[1:]:
+        # the long protocol-fuzz campaign (ISSUE 19): same in-process
+        # fleet shape, hostile bytes instead of latency faults —
+        # qa/protocol_fuzz.py owns the mutation engine and the
+        # survival contracts; extra --n=/--seed= flags pass through
+        if HERE not in sys.path:
+            sys.path.insert(0, HERE)
+        from protocol_fuzz import main as fuzz_main
+        return fuzz_main([a for a in sys.argv[1:] if a != "--fuzz"])
     sys.path.insert(0, os.path.join(ROOT, "tests"))
     import io
     import shutil

@@ -1,0 +1,384 @@
+#!/usr/bin/env python
+"""Protocol fuzz harness (ISSUE 19 tentpole): seeded frame-level
+mutation against a LIVE daemon and router.
+
+The NDJSON protocol's whole attack surface is one line-framed reader
+(``service/protocol.py::read_frame``) shared by every tier, so the
+fuzzer's job is narrow and deep: throw every shape of hostile bytes
+at a real accept loop — bit flips of valid frames, truncations,
+length lies (lines past the server's frame ceiling), NUL and
+UTF-8-invalid garbage, JSON non-objects, JSON bombs, pipelined
+batches, mid-handshake aborts, slow-loris partial frames — and hold
+the server to three survival contracts:
+
+1. **liveness**: a control ``ping`` on a fresh connection answers
+   ``ok`` after every mutation batch (and concurrently DURING the
+   slow-loris hold — one wedged reader thread must never wedge the
+   accept loop);
+2. **truthful rejection**: every in-band answer to a hostile frame is
+   a well-formed JSON error frame whose code is in the DOCUMENTED
+   error vocabulary (protocol.py ``ERR_*``) — never a traceback,
+   never a half-written line;
+3. **no leaks**: file descriptors (``/proc/self/fd``) and thread
+   counts return to their pre-campaign census (slack for the
+   momentary accept) once the connections close.
+
+Everything is DETERMINISTIC: a campaign is a pure function of
+``(seed, n)`` via ``random.Random`` — a failure reproduces exactly.
+
+Library use (tier-1 smoke, ``tests/test_protocol_fuzz.py``)::
+
+    stats = fuzz_target(sock_path, n=500, seed=7)
+
+``python qa/protocol_fuzz.py [--n=N] [--seed=S]`` runs the long
+self-contained campaign (in-process daemon over unix AND tcp + an
+in-process router, stub runners, no jax) and prints the stats as
+JSON; ``qa/fleet_chaos.py --fuzz`` invokes the same entry point.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from random import Random
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from pwasm_tpu.fleet.transport import connect  # noqa: E402
+from pwasm_tpu.service import protocol  # noqa: E402
+
+# the documented rejection vocabulary: every in-band answer to a
+# hostile frame must carry one of these codes (survival contract 2)
+ERROR_VOCAB = frozenset(
+    v for k, v in vars(protocol).items()
+    if k.startswith("ERR_") and isinstance(v, str))
+
+# valid baseline frames the mutators start from — a mix of open verbs
+# and verbs that hit admission/auth/lookup paths
+BASE_FRAMES = (
+    b'{"cmd":"ping"}',
+    b'{"cmd":"stats"}',
+    b'{"cmd":"status","id":"fz-0"}',
+    b'{"cmd":"result","id":"fz-0"}',
+    b'{"cmd":"cancel","id":"fz-0"}',
+    b'{"cmd":"inspect","id":"fz-0"}',
+    b'{"cmd":"submit","argv":["x"],"client":"fz"}',
+    b'{"cmd":"health"}',
+    b'{"cmd":"nonesuch"}',
+)
+
+# a mutation is (payload_bytes, expect_read): expect_read=False means
+# the mutator deliberately aborts mid-frame (truncation/slow-loris
+# seed) and no answer is owed
+N_MUTATION_KINDS = 9
+
+
+def mutate(rng: Random, ceiling: int) -> tuple[bytes, bool]:
+    """One deterministic hostile payload.  ``ceiling`` is the
+    server's frame limit, so length-lie mutations can overshoot it
+    cheaply (the harness runs servers with a small ceiling)."""
+    kind = rng.randrange(N_MUTATION_KINDS)
+    base = bytearray(rng.choice(BASE_FRAMES))
+    if kind == 0:                     # bit flips in a valid frame
+        for _ in range(rng.randrange(1, 9)):
+            i = rng.randrange(len(base))
+            base[i] ^= 1 << rng.randrange(8)
+        return bytes(base).replace(b"\n", b" ") + b"\n", True
+    if kind == 1:                     # truncation: abort mid-frame
+        return bytes(base[: rng.randrange(1, len(base))]), False
+    if kind == 2:                     # length lie: past the ceiling
+        pad = b"A" * (ceiling + rng.randrange(1, 4096))
+        return b'{"cmd":"ping","pad":"' + pad + b'"}\n', True
+    if kind == 3:                     # NUL-riddled garbage
+        raw = bytes(rng.randrange(256)
+                    for _ in range(rng.randrange(1, 200)))
+        return raw.replace(b"\n", b"\x00") + b"\n", True
+    if kind == 4:                     # UTF-8-invalid JSON-ish line
+        return (b'{"cmd":"\xff\xfe\xc0' +
+                bytes([rng.randrange(0x80, 0x100)]) + b'"}\n'), True
+    if kind == 5:                     # valid JSON, not an object
+        return rng.choice(
+            (b"[1,2,3]\n", b'"frame"\n', b"42\n", b"null\n",
+             b"true\n")), True
+    if kind == 6:                     # hostile field types
+        return rng.choice((
+            b'{"cmd":123}\n',
+            b'{"cmd":["ping"]}\n',
+            b'{"cmd":"submit","argv":"not-a-list"}\n',
+            b'{"cmd":"status","id":{}}\n',
+            b'{"cmd":"submit","argv":[],"deadline_ms":"soon"}\n',
+            b'{"cmd":"logs","limit":-5}\n',
+        )), True
+    if kind == 7:                     # JSON bomb: deep nesting
+        depth = rng.randrange(64, 2048)
+        return (b'{"cmd":"ping","b":' + b"[" * depth
+                + b"0" + b"]" * depth + b"}\n"), True
+    # kind == 8: pipelined batch — several frames in one write, some
+    # broken; the reader must stay line-synced across them
+    parts = []
+    for _ in range(rng.randrange(2, 6)):
+        f = bytearray(rng.choice(BASE_FRAMES))
+        if rng.random() < 0.5 and f:
+            f[rng.randrange(len(f))] ^= 0xFF
+        parts.append(bytes(f).replace(b"\n", b" "))
+    return b"\n".join(parts) + b"\n", True
+
+
+def fd_count() -> int:
+    return len(os.listdir("/proc/self/fd"))
+
+
+def census() -> tuple[int, int]:
+    """(open fds, live threads) for the CURRENT process — the drill
+    harnesses run their servers in-process, so a leaked server-side
+    conn/thread shows up here too."""
+    return fd_count(), threading.active_count()
+
+
+def settle(before: tuple[int, int], slack: int = 4,
+           timeout_s: float = 10.0) -> tuple[int, int]:
+    """Wait for the census to return to within ``slack`` of
+    ``before`` (connection threads exit asynchronously after close)
+    and return the final census."""
+    deadline = time.monotonic() + timeout_s
+    now = census()
+    while time.monotonic() < deadline:
+        now = census()
+        if now[0] <= before[0] + slack and now[1] <= before[1] + slack:
+            break
+        time.sleep(0.05)
+    return now
+
+
+def ping_ok(target: str, tls=None, timeout: float = 5.0) -> bool:
+    """One control ping on a fresh connection (liveness contract)."""
+    try:
+        conn = connect(target, timeout=timeout, tls=tls)
+    except OSError:
+        return False
+    try:
+        conn.sendall(b'{"cmd":"ping"}\n')
+        line = conn.makefile("rb").readline(1 << 16)
+        return bool(line) and json.loads(line).get("ok") is True
+    except (OSError, ValueError):
+        return False
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+
+def fuzz_target(target: str, n: int = 500, seed: int = 0,
+                tls=None, ceiling: int = protocol.MAX_FRAME_BYTES,
+                control_every: int = 50) -> dict:
+    """Run ``n`` seeded mutations against ``target`` and return the
+    measured facts; raises AssertionError the moment a survival
+    contract breaks (with the seed in the message — reproduce with
+    it).  ``tls`` is a transport ClientTLS for TLS targets."""
+    rng = Random(seed)
+    before = census()
+    stats = {"target": target, "n": n, "seed": seed,
+             "responses": 0, "aborts": 0, "closes": 0,
+             "codes": {}, "control_pings": 0}
+    assert ping_ok(target, tls), \
+        f"target {target} not answering ping before the campaign"
+    for i in range(n):
+        payload, expect_read = mutate(rng, ceiling)
+        try:
+            conn = connect(target, timeout=5.0, tls=tls)
+        except OSError as e:
+            raise AssertionError(
+                f"[seed={seed} mutation={i}] connect refused mid-"
+                f"campaign: {e} — accept loop wedged or dead")
+        try:
+            try:
+                conn.sendall(payload)
+            except OSError:
+                # server closed on us mid-send (fatal frame on a
+                # pipelined batch): a loud close is a legal answer
+                stats["closes"] += 1
+                continue
+            if not expect_read:
+                stats["aborts"] += 1
+                continue
+            conn.settimeout(5.0)
+            try:
+                line = conn.makefile("rb").readline(1 << 16)
+            except OSError:
+                stats["closes"] += 1
+                continue
+            if not line:
+                stats["closes"] += 1    # loud close: legal
+                continue
+            try:
+                resp = json.loads(line)
+            except ValueError:
+                raise AssertionError(
+                    f"[seed={seed} mutation={i}] non-JSON answer "
+                    f"to a hostile frame: {line[:200]!r}")
+            assert isinstance(resp, dict) and resp.get("ok") in \
+                (True, False), \
+                f"[seed={seed} mutation={i}] malformed frame {resp!r}"
+            stats["responses"] += 1
+            if resp.get("ok") is False:
+                code = resp.get("error")
+                assert code in ERROR_VOCAB, \
+                    (f"[seed={seed} mutation={i}] undocumented "
+                     f"error code {code!r} (vocabulary: "
+                     f"{sorted(ERROR_VOCAB)})")
+                stats["codes"][code] = stats["codes"].get(code, 0) + 1
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if (i + 1) % control_every == 0:
+            assert ping_ok(target, tls), \
+                (f"[seed={seed} mutation={i}] control ping failed "
+                 "mid-campaign — server wedged")
+            stats["control_pings"] += 1
+    assert ping_ok(target, tls), \
+        f"[seed={seed}] target dead after the campaign"
+    stats["control_pings"] += 1
+    after = settle(before)
+    assert after[0] <= before[0] + 4, \
+        (f"[seed={seed}] fd leak: {before[0]} -> {after[0]} "
+         "open descriptors after the campaign settled")
+    assert after[1] <= before[1] + 4, \
+        (f"[seed={seed}] thread leak: {before[1]} -> {after[1]} "
+         "live threads after the campaign settled")
+    stats["fd_before"], stats["fd_after"] = before[0], after[0]
+    stats["threads_before"] = before[1]
+    stats["threads_after"] = after[1]
+    return stats
+
+
+def slow_loris_drill(target: str, tls=None, holders: int = 6,
+                     hold_s: float = 1.0) -> dict:
+    """Open ``holders`` connections, send HALF a frame on each, hold
+    them open, and prove fresh control pings answer concurrently —
+    a parked reader thread must cost one thread, not the accept
+    loop.  Returns measured facts."""
+    before = census()
+    held = []
+    try:
+        for _ in range(holders):
+            c = connect(target, timeout=5.0, tls=tls)
+            c.sendall(b'{"cmd":"ping","slow":"lo')   # no newline
+            held.append(c)
+        t0 = time.monotonic()
+        alive = ping_ok(target, tls)
+        ping_latency = time.monotonic() - t0
+        time.sleep(hold_s)
+        alive_after_hold = ping_ok(target, tls)
+    finally:
+        for c in held:
+            try:
+                c.close()
+            except OSError:
+                pass
+    after = settle(before)
+    return {"holders": holders, "alive_during_hold": alive,
+            "alive_after_hold": alive_after_hold,
+            "ping_latency_s": round(ping_latency, 3),
+            "fd_before": before[0], "fd_after": after[0],
+            "threads_before": before[1], "threads_after": after[1]}
+
+
+def tls_garbage_drill(target: str, n: int = 50, seed: int = 0) -> dict:
+    """Plaintext/garbage probes against a TLS port: dial WITHOUT tls,
+    send seeded garbage (or nothing — a mid-handshake abort), and
+    require a loud close, never a hang.  The server counts each as a
+    handshake failure, not a crash."""
+    rng = Random(seed)
+    closed = 0
+    for i in range(n):
+        conn = connect(target, timeout=5.0, tls=None)
+        try:
+            if rng.random() < 0.3:
+                pass                        # connect-then-abort
+            else:
+                conn.sendall(bytes(rng.randrange(256) for _ in
+                                   range(rng.randrange(1, 128))))
+            conn.settimeout(5.0)
+            try:
+                data = conn.recv(4096)
+            except OSError:
+                data = b""                  # reset: loud enough
+            # a TLS server answers a plaintext probe with at most an
+            # alert record then closes — crucially, recv() RETURNS
+            # instead of hanging until the client gives up
+            closed += 1 if len(data) < 4096 else 0
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+    return {"probes": n, "loud_closes": closed}
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    n, seed = 2000, 0
+    for a in argv:
+        if a.startswith("--n="):
+            n = int(a.split("=", 1)[1])
+        elif a.startswith("--seed="):
+            seed = int(a.split("=", 1)[1])
+        else:
+            print(__doc__, file=sys.stderr)
+            return 2
+    sys.path.insert(0, os.path.join(ROOT, "tests"))
+    import io
+    import shutil
+    import tempfile
+    from contextlib import ExitStack
+
+    from test_fleet import _daemon, _stub_runner
+
+    from pwasm_tpu.fleet.router import Router
+    from pwasm_tpu.service.client import wait_for_socket
+
+    ceiling = 4096
+    out = {}
+    with ExitStack() as stack:
+        m = stack.enter_context(_daemon(
+            runner=_stub_runner(), listen="127.0.0.1:0",
+            max_frame_bytes=ceiling))
+        rdir = tempfile.mkdtemp(prefix="pwfuzz")
+        stack.callback(shutil.rmtree, rdir, True)
+        rsock = os.path.join(rdir, "router.sock")
+        err = io.StringIO()
+        r = Router([m.sock], socket_path=rsock, stderr=err,
+                   poll_interval=0.1, max_frame_bytes=ceiling)
+        t = threading.Thread(target=r.serve, daemon=True)
+        t.start()
+        stack.callback(lambda: (r.drain.request("fuzz done"),
+                                t.join(20)))
+        if not wait_for_socket(rsock, 15):
+            print(err.getvalue(), file=sys.stderr)
+            return 1
+        tcp = f"127.0.0.1:{m.daemon.tcp_port}"
+        out["daemon_unix"] = fuzz_target(m.sock, n=n, seed=seed,
+                                         ceiling=ceiling)
+        out["daemon_tcp"] = fuzz_target(tcp, n=n, seed=seed + 1,
+                                        ceiling=ceiling)
+        out["router_unix"] = fuzz_target(rsock, n=n, seed=seed + 2,
+                                         ceiling=ceiling)
+        out["slow_loris"] = slow_loris_drill(m.sock)
+    print(json.dumps(out, indent=2))
+    ok = all(v.get("control_pings", 1) > 0 for v in out.values()) \
+        and out["slow_loris"]["alive_during_hold"] \
+        and out["slow_loris"]["alive_after_hold"]
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

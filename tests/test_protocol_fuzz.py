@@ -1,0 +1,170 @@
+"""Protocol fuzz smoke (ISSUE 19 tentpole): the bounded tier-1 slice
+of ``qa/protocol_fuzz.py``.
+
+Contracts held here, per transport (unix AND tcp, daemon AND router):
+
+- **survival**: >=500 seeded mutations (bit flips, truncations,
+  length lies, NUL/UTF-8-invalid garbage, JSON bombs, pipelined
+  batches) against a live accept loop — control pings answer
+  throughout, and the fd/thread census returns to baseline (no
+  leaks);
+- **truthful rejection**: every in-band answer carries a documented
+  ``ERR_*`` code — the fuzzer asserts this internally per response;
+- **bounded memory** (ISSUE 19 satellite): a never-terminated
+  multi-MiB line cannot balloon the server — ``read_frame`` buffers
+  at most ``max_frame_bytes + 1`` before answering
+  ``frame_too_large`` and closing, measured here as an RSS delta
+  bound while streaming far more than the ceiling;
+- **slow-loris**: parked half-frame connections cost threads, never
+  the accept loop.
+
+The long campaign lives in ``qa/fleet_chaos.py --fuzz``.
+"""
+
+import io
+import json
+import os
+import shutil
+import socket
+import sys
+import tempfile
+import threading
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "qa"))
+from protocol_fuzz import (fuzz_target, ping_ok,  # noqa: E402
+                           slow_loris_drill)
+
+from pwasm_tpu.fleet.router import Router  # noqa: E402
+from pwasm_tpu.fleet.transport import connect  # noqa: E402
+from pwasm_tpu.service import protocol  # noqa: E402
+from pwasm_tpu.service.client import wait_for_socket  # noqa: E402
+
+from test_fleet import _daemon, _stub_runner  # noqa: E402
+
+# a small ceiling makes length-lie mutations (and the bounded-memory
+# drill) cheap without changing the code path they exercise
+CEILING = 4096
+
+
+def test_fuzz_daemon_both_transports():
+    """>=500 mutations per transport against one live daemon; the
+    fuzzer raises on any survival-contract breach (crash, hang,
+    undocumented code, fd/thread leak)."""
+    with _daemon(runner=_stub_runner(), listen="127.0.0.1:0",
+                 max_frame_bytes=CEILING) as h:
+        s1 = fuzz_target(h.sock, n=500, seed=11, ceiling=CEILING)
+        s2 = fuzz_target(f"127.0.0.1:{h.daemon.tcp_port}", n=500,
+                         seed=12, ceiling=CEILING)
+    for s in (s1, s2):
+        assert s["responses"] > 0 and s["control_pings"] > 0
+        # the rejection vocabulary actually fired (not all closes)
+        assert s["codes"].get("bad_json", 0) > 0
+        assert s["codes"].get("frame_too_large", 0) > 0
+
+
+def test_fuzz_router_and_slow_loris():
+    with _daemon(runner=_stub_runner()) as m:
+        rdir = tempfile.mkdtemp(prefix="pwfz")
+        rsock = os.path.join(rdir, "router.sock")
+        err = io.StringIO()
+        r = Router([m.sock], socket_path=rsock, stderr=err,
+                   poll_interval=0.1, max_frame_bytes=CEILING)
+        t = threading.Thread(target=r.serve, daemon=True)
+        t.start()
+        try:
+            assert wait_for_socket(rsock, 15), err.getvalue()
+            s = fuzz_target(rsock, n=500, seed=13, ceiling=CEILING)
+            assert s["responses"] > 0 and s["control_pings"] > 0
+            assert s["codes"].get("frame_too_large", 0) > 0
+            loris = slow_loris_drill(rsock, holders=4, hold_s=0.3)
+            assert loris["alive_during_hold"]
+            assert loris["alive_after_hold"]
+        finally:
+            r.drain.request("test done")
+            t.join(20)
+            shutil.rmtree(rdir, ignore_errors=True)
+
+
+def _rss_bytes() -> int:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) * 1024
+    raise RuntimeError("no VmRSS")
+
+
+def test_never_terminated_line_bounded_memory():
+    """ISSUE 19 satellite: a client streaming a newline-free line far
+    past the frame ceiling costs the server AT MOST ceiling+1 bytes
+    of buffer — the connection answers frame_too_large (or closes
+    loudly mid-stream) and the process RSS moves by a bounded amount,
+    not by the bytes sent."""
+    ceiling = 1 << 20                       # 1 MiB ceiling
+    send_total = 64 << 20                   # stream 64x past it
+    with _daemon(runner=_stub_runner(), listen="127.0.0.1:0",
+                 max_frame_bytes=ceiling) as h:
+        before = _rss_bytes()
+        conn = connect(f"127.0.0.1:{h.daemon.tcp_port}", timeout=10)
+        chunk = b"A" * (1 << 20)
+        sent = 0
+        closed_early = False
+        try:
+            while sent < send_total:
+                try:
+                    conn.sendall(chunk)
+                except OSError:
+                    closed_early = True     # server hung up: loud
+                    break
+                sent += len(chunk)
+            if not closed_early:
+                conn.settimeout(10)
+                try:
+                    line = conn.makefile("rb").readline(1 << 16)
+                except OSError:
+                    line = b""
+                if line:
+                    resp = json.loads(line)
+                    assert resp["error"] == \
+                        protocol.ERR_FRAME_TOO_LARGE, resp
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        after = _rss_bytes()
+        # the server buffered <= ceiling+1; anything near the 64 MiB
+        # sent means readline stopped honouring its bound.  32 MiB of
+        # slack absorbs allocator noise from the rest of the process.
+        assert after - before < 32 << 20, \
+            f"RSS grew {after - before} bytes on a {sent}-byte line"
+        # the daemon survived and still serves
+        assert ping_ok(h.sock)
+
+
+def test_json_bomb_answered_in_band():
+    """Regression for the fuzzer-found RecursionError: a deeply
+    nested JSON frame answers bad_json on the wire instead of
+    killing the connection thread with a traceback."""
+    bomb = b'{"cmd":"ping","b":' + b"[" * 4000 + b"0" \
+        + b"]" * 4000 + b"}\n"
+    rf = io.BytesIO(bomb)
+    with pytest.raises(protocol.FrameError) as ei:
+        protocol.read_frame(rf)
+    assert ei.value.code == protocol.ERR_BAD_JSON
+    assert not ei.value.fatal               # next line = fresh frame
+    with _daemon(runner=_stub_runner()) as h:
+        conn = connect(h.sock, timeout=10)
+        try:
+            conn.sendall(bomb + b'{"cmd":"ping"}\n')
+            rfile = conn.makefile("rb")
+            first = json.loads(rfile.readline(1 << 16))
+            assert first["ok"] is False
+            assert first["error"] == protocol.ERR_BAD_JSON
+            second = json.loads(rfile.readline(1 << 16))
+            assert second["ok"] is True     # line-sync survived
+        finally:
+            conn.close()

@@ -232,7 +232,7 @@ def test_rule_validation_errors():
 
 def test_default_rule_sets_validate():
     # the shipped defaults must themselves pass the user-rule grammar
-    assert len(parse_rules(default_slo_rules())) == 8
+    assert len(parse_rules(default_slo_rules())) == 9
     assert len(parse_rules(default_fleet_slo_rules())) == 6
 
 
@@ -447,7 +447,7 @@ def test_canary_probes_and_health_verb():
         can = health["canary"]
         assert can["runs"] >= 2 and can["fails"] == 0
         assert can["last_ok"] is True
-        assert health["rules"] == 8          # the default set
+        assert health["rules"] == 9          # the default set
         # canary runs never enter the job table or the journal
         assert h.daemon.jobs == {}
         # canary families are live

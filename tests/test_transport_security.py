@@ -1,0 +1,726 @@
+"""Zero-trust fleet edge (ISSUE 19): TLS/mTLS transport, scoped
+capability tokens, adaptive per-client rate limiting.
+
+Acceptance contracts drilled here:
+
+- **TLS floor**: ``serve --tls-cert/--tls-key`` upgrades the TCP
+  listener (TLS 1.2+); a plaintext probe against the TLS port gets a
+  LOUD close (never a hang) and increments the handshake-failure
+  counter; the unix socket stays plaintext behind its 0600 mode;
+- **mTLS identity**: with ``--tls-client-ca`` the verified peer CN is
+  the connection's attested identity (``cn:<name>``), outranking
+  ``client_token`` in the fair-share resolution order; an untrusted
+  client cert never completes the handshake;
+- **scoped tokens**: ``--auth-tokens`` maps credentials to
+  {submit, read, cancel-own, admin}; control verbs (drain /
+  lease-grant / fence, and the stats-borne lease grant) demand admin;
+  cancel demands ownership-or-admin; every refusal answers
+  ``unauthorized`` having written NOTHING to queue/journal state;
+  the file hot-reloads keep-last-good on the accept-loop tick;
+- **rate limiting**: ``--rate-limit`` is a per-identity token bucket
+  in FRONT of admission on both tiers, refusing with a truthful
+  ``retry_after_s``; repeated auth failures earn a capped-exponential
+  penalty and feed the auth-failure counter + SLO rule;
+- **fleet drill**: an all-mTLS fleet (TCP members with client-cert
+  verification, router dialing with its own cert, warm standby
+  riding the same config) survives primary-router death AND a
+  member SIGKILL with byte-identical reports vs the uncrashed arm;
+- **byte identity**: with none of the new flags, behavior is
+  unchanged — anonymous submit/drain still serve.
+"""
+
+import io
+import json
+import os
+import shutil
+import socket as socket_mod
+import stat
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+from pwasm_tpu.fleet.router import Router
+from pwasm_tpu.fleet.transport import (ClientTLS, ServerTLS, connect,
+                                       router_journal_path,
+                                       target_name)
+from pwasm_tpu.service import authz
+from pwasm_tpu.service.authz import AuthRegistry, PenaltyBox
+from pwasm_tpu.service.client import (ServiceClient, ServiceError,
+                                      wait_for_socket)
+from pwasm_tpu.service.queue import RateLimiter, parse_rate_limit
+from pwasm_tpu.utils.fsio import ensure_private_dir
+
+from test_fleet import (_corpus, _daemon, _job_args, _serve_env,
+                        _stub_runner)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+CERTS = os.path.join(HERE, "certs")
+CA = os.path.join(CERTS, "ca.pem")
+SRV_CERT = os.path.join(CERTS, "server.pem")
+SRV_KEY = os.path.join(CERTS, "server.key")
+ADMIN_CERT = os.path.join(CERTS, "fleet-admin.pem")
+ADMIN_KEY = os.path.join(CERTS, "fleet-admin.key")
+ALICE_CERT = os.path.join(CERTS, "alice.pem")
+ALICE_KEY = os.path.join(CERTS, "alice.key")
+ROGUE_CERT = os.path.join(CERTS, "rogue.pem")
+ROGUE_KEY = os.path.join(CERTS, "rogue.key")
+
+SLOW = "--inject-faults=seed=1,rate=1,kinds=hang,hang_s=0.25"
+
+
+def _server_tls(client_ca=None):
+    return ServerTLS(SRV_CERT, SRV_KEY, client_ca=client_ca)
+
+
+def _client_tls(cert=None, key=None):
+    return ClientTLS(CA, certfile=cert, keyfile=key)
+
+
+def _journal_bytes(path):
+    try:
+        return open(path, "rb").read()
+    except OSError:
+        return b""
+
+
+# ---------------------------------------------------------------------------
+# primitives: private dirs, rate limiter, penalty box, token file
+# ---------------------------------------------------------------------------
+def test_ensure_private_dir(tmp_path):
+    d = str(tmp_path / "a" / "b")
+    assert ensure_private_dir(d) == d
+    assert stat.S_IMODE(os.stat(d).st_mode) == 0o700
+    # idempotent, and a PRE-EXISTING dir keeps its operator-given
+    # mode (shared storage stays shared)
+    wide = str(tmp_path / "wide")
+    os.makedirs(wide, mode=0o755)
+    os.chmod(wide, 0o755)
+    ensure_private_dir(wide)
+    assert stat.S_IMODE(os.stat(wide).st_mode) == 0o755
+    # a file squatting the path is an error, not a silent pass
+    f = tmp_path / "f"
+    f.write_text("x")
+    with pytest.raises(FileExistsError):
+        ensure_private_dir(str(f))
+
+
+def test_parse_rate_limit_grammar():
+    assert parse_rate_limit("10") == (10.0, 10.0)
+    assert parse_rate_limit("10/s") == (10.0, 10.0)
+    assert parse_rate_limit("2.5/s:8") == (2.5, 8.0)
+    assert parse_rate_limit("0.5") == (0.5, 1.0)   # floor burst 1
+    for bad in ("0", "-1", "nope", "1:-2", "1:0.5", "inf", "1:inf"):
+        with pytest.raises(ValueError):
+            parse_rate_limit(bad)
+
+
+def test_rate_limiter_truthful_and_bounded():
+    rl = RateLimiter(2.0, 3.0)
+    t0 = 1000.0
+    got = [rl.admit("a", now=t0) for _ in range(5)]
+    assert got[:3] == [0.0, 0.0, 0.0]       # burst admits
+    assert got[3] == got[4] == 0.5          # truthful: 1 token / 2 per s
+    # honoring the hint readmits exactly then
+    assert rl.admit("a", now=t0 + 0.5) == 0.0
+    # identities are independent
+    assert rl.admit("b", now=t0) == 0.0
+    assert rl.refusals == 2
+    # bounded table: full (idle) buckets are swept first at the cap
+    small = RateLimiter(1.0, 1.0, max_clients=4)
+    for i in range(4):
+        small.admit(f"c{i}", now=t0)
+    small.admit("c0", now=t0 + 100)         # c0 refilled = idle
+    small.admit("fresh", now=t0 + 100)
+    assert len(small._buckets) <= 4
+
+
+def test_penalty_box_caps_and_clears():
+    pb = PenaltyBox(base_s=0.05, cap_s=2.0, max_peers=3)
+    assert pb.fail("x") == pytest.approx(0.05)
+    assert pb.fail("x") == pytest.approx(0.10)
+    for _ in range(10):
+        d = pb.fail("x")
+    assert d == 2.0                          # capped
+    pb.clear("x")
+    assert pb.fail("x") == pytest.approx(0.05)
+    # bounded: a 4th peer evicts the oldest, never grows the table
+    for k in ("a", "b", "c", "d"):
+        pb.fail(k)
+    assert len(pb._counts) <= 3
+
+
+def test_token_file_roundtrip_and_integrity(tmp_path):
+    p = str(tmp_path / "tokens.json")
+    authz.write_auth_tokens(p, {"sekrit": ["submit", "read"],
+                                "cn:fleet-admin": ["admin"]})
+    reg = AuthRegistry(p)
+    assert reg.scopes_for("sekrit", None) == {"submit", "read"}
+    assert reg.scopes_for(None, "cn:fleet-admin") == {"admin"}
+    assert reg.scopes_for("nope", "uid:12") == frozenset()
+    # admin implies everything
+    assert reg.allows({"client_token": None}, "cn:fleet-admin",
+                      authz.SCOPE_SUBMIT)
+    # CRC integrity: a hand-edited byte refuses to load
+    raw = open(p).read()
+    open(p, "w").write(raw.replace("submit", "sudmit"))
+    with pytest.raises(ValueError):
+        AuthRegistry(p)
+    # unknown scope refuses at mint-validation time too
+    obj = {"tokens": {"t": ["root"]}}
+    from pwasm_tpu.utils.fsio import payload_crc
+    obj["crc"] = payload_crc(obj)
+    open(p, "w").write(json.dumps(obj))
+    with pytest.raises(ValueError) as ei:
+        AuthRegistry(p)
+    assert "unknown scope" in str(ei.value)
+
+
+def test_required_scope_map():
+    assert authz.required_scope("ping", {}) is None
+    assert authz.required_scope("nonesuch", {}) is None  # unknown_cmd
+    assert authz.required_scope("submit", {}) == authz.SCOPE_SUBMIT
+    assert authz.required_scope("cancel", {}) == authz.SCOPE_CANCEL_OWN
+    assert authz.required_scope("drain", {}) == authz.SCOPE_ADMIN
+    assert authz.required_scope("stats", {}) == authz.SCOPE_READ
+    # a stats frame carrying a lease is a lease GRANT: admin
+    assert authz.required_scope(
+        "stats", {"lease": {"epoch": 1}}) == authz.SCOPE_ADMIN
+
+
+# ---------------------------------------------------------------------------
+# TLS transport on the daemon
+# ---------------------------------------------------------------------------
+def test_tls_roundtrip_and_plaintext_probe(tmp_path):
+    with _daemon(runner=_stub_runner(), listen="127.0.0.1:0",
+                 tls=_server_tls()) as h:
+        tcp = f"127.0.0.1:{h.daemon.tcp_port}"
+        out = str(tmp_path / "o.dfa")
+        # the same protocol, now under TLS
+        with ServiceClient(tcp, tls=_client_tls()) as c:
+            assert c.ping()["ok"]
+            r = c.result(c.submit(["in.paf", "-o", out],
+                                  cwd=str(tmp_path))["job_id"],
+                         timeout=30)
+            assert r["rc"] == 0
+        # a client WITHOUT tls config speaks plaintext at a TLS port:
+        # loud close (or an alert blob), never a hang, never a serve
+        conn = connect(tcp, timeout=5)
+        try:
+            conn.sendall(b'{"cmd":"ping"}\n')
+            conn.settimeout(5)
+            try:
+                data = conn.recv(1 << 16)
+            except OSError:
+                data = b""
+            assert b'"ok"' not in data   # nothing was served plain
+        finally:
+            conn.close()
+        # the failure was COUNTED (observable, not swallowed)
+        deadline = time.monotonic() + 5
+        seen = 0
+        while time.monotonic() < deadline:
+            with ServiceClient(h.sock) as c:   # unix side: plaintext
+                body = c.metrics()["metrics"]
+            m = [l for l in body.splitlines()
+                 if l.startswith(
+                     "pwasm_transport_tls_handshake_failures_total")]
+            seen = float(m[0].split()[-1]) if m else 0
+            if seen >= 1:
+                break
+            time.sleep(0.05)
+        assert seen >= 1
+        # the unix socket itself is 0600 (satellite: perm contract)
+        assert stat.S_IMODE(os.stat(h.sock).st_mode) == 0o600
+
+
+def test_mtls_peer_cn_is_attested_identity(tmp_path):
+    with _daemon(runner=_stub_runner(), listen="127.0.0.1:0",
+                 tls=_server_tls(client_ca=CA)) as h:
+        tcp = f"127.0.0.1:{h.daemon.tcp_port}"
+        out = str(tmp_path / "o.dfa")
+        # verified CN becomes the fair-share identity, OUTRANKING a
+        # client_token on the same frame
+        with ServiceClient(tcp, client_token="spoof",
+                           tls=_client_tls(ALICE_CERT,
+                                           ALICE_KEY)) as c:
+            r = c.result(c.submit(["in.paf", "-o", out],
+                                  cwd=str(tmp_path))["job_id"],
+                         timeout=30)
+            assert r["job"]["client"] == "cn:alice"
+        # an explicit client= still wins (resolution order intact)
+        with ServiceClient(tcp, tls=_client_tls(ALICE_CERT,
+                                                ALICE_KEY)) as c:
+            r = c.result(c.submit(["in.paf", "-o", out],
+                                  cwd=str(tmp_path),
+                                  client="tenant9")["job_id"],
+                         timeout=30)
+            assert r["job"]["client"] == "tenant9"
+        # an untrusted (self-signed) client cert never completes the
+        # handshake — refused at the transport, not at a verb
+        with pytest.raises((ServiceError, OSError)):
+            with ServiceClient(tcp, timeout=5,
+                               tls=ClientTLS(CA, certfile=ROGUE_CERT,
+                                             keyfile=ROGUE_KEY)) as c:
+                c.ping()
+        # and the daemon still serves afterwards
+        with ServiceClient(tcp, tls=_client_tls(ALICE_CERT,
+                                                ALICE_KEY)) as c:
+            assert c.ping()["ok"]
+
+
+def test_state_dirs_created_private(tmp_path):
+    """Result-cache and spool dirs land 0700 at creation."""
+    cache = str(tmp_path / "cache")
+    spool = str(tmp_path / "spool")
+    with _daemon(runner=_stub_runner(), result_cache=cache,
+                 spool_threshold_bytes=1, spool_dir=spool) as h:
+        with ServiceClient(h.sock) as c:
+            r = c.result(c.submit(["in.paf", "-o",
+                                   str(tmp_path / "o.dfa")],
+                                  cwd=str(tmp_path))["job_id"],
+                         timeout=30)
+            assert r["rc"] == 0
+    assert stat.S_IMODE(os.stat(cache).st_mode) == 0o700
+    assert stat.S_IMODE(os.stat(spool).st_mode) == 0o700
+
+
+# ---------------------------------------------------------------------------
+# scoped capability tokens on the daemon
+# ---------------------------------------------------------------------------
+def _mint(tmp_path, tokens):
+    p = str(tmp_path / "tokens.json")
+    authz.write_auth_tokens(p, tokens)
+    return p
+
+
+def test_scoped_tokens_matrix_and_zero_state_on_refusal(tmp_path):
+    tok = _mint(tmp_path, {
+        "writer": ["submit", "read"],
+        "reader": ["read"],
+        "alice-t": ["submit", "read", "cancel-own"],
+        "bob-t": ["submit", "read", "cancel-own"],
+        "boss": ["admin"],
+    })
+    with _daemon(runner=_stub_runner(sleep=0.3),
+                 auth_tokens=tok) as h:
+        journal = h.sock + ".journal"
+        out = str(tmp_path / "o.dfa")
+
+        def deny(client, req):
+            r = client._req(req)
+            assert r["ok"] is False and r["error"] == "unauthorized", r
+            return r
+
+        with ServiceClient(h.sock, client_token="writer") as c:
+            assert c.ping()["ok"]            # ping stays open
+            j = c.submit(["in.paf", "-o", out], cwd=str(tmp_path))
+            assert j["ok"], j
+            assert c.result(j["job_id"], timeout=30)["rc"] == 0
+            before = _journal_bytes(journal)
+            # control plane demands admin — and a refusal writes
+            # NOTHING (journal byte-identical, daemon not draining)
+            deny(c, {"cmd": "drain"})
+            deny(c, {"cmd": "fence", "reason": "test"})
+            deny(c, {"cmd": "lease-grant",
+                     "lease": {"epoch": 99, "ttl_s": 5}})
+            deny(c, {"cmd": "stats", "lease": {"epoch": 99,
+                                               "ttl_s": 5}})
+            assert _journal_bytes(journal) == before
+            assert c.ping()["draining"] is False
+        with ServiceClient(h.sock, client_token="reader") as c:
+            deny(c, {"cmd": "submit", "argv": ["x"]})   # read-only
+            assert c._req({"cmd": "stats"})["ok"]
+        with ServiceClient(h.sock) as c:     # anonymous unix peer:
+            deny(c, {"cmd": "submit", "argv": ["x"]})   # no grant
+        # cancel-own: ownership follows the resolved identity
+        with ServiceClient(h.sock, client_token="alice-t") as ca, \
+                ServiceClient(h.sock, client_token="bob-t") as cb, \
+                ServiceClient(h.sock, client_token="boss") as cboss:
+            j1 = ca.submit(["in.paf", "-o", out], cwd=str(tmp_path))
+            deny(cb, {"cmd": "cancel", "job_id": j1["job_id"]})
+            assert ca.cancel(j1["job_id"])["ok"]        # owner may
+            j2 = ca.submit(["in.paf", "-o", out], cwd=str(tmp_path))
+            assert cboss.cancel(j2["job_id"])["ok"]     # admin may
+            # unknown ids pass the gate and answer unknown_job — the
+            # auth layer is not a job-id oracle
+            r = ca._req({"cmd": "cancel", "job_id": "job-9999"})
+            assert r["error"] == "unknown_job"
+            # admin can drain (and that DOES latch)
+            assert cboss.drain()["ok"]
+
+
+def test_auth_hot_reload_keep_last_good(tmp_path):
+    tok = _mint(tmp_path, {"old-tok": ["submit", "read"]})
+    with _daemon(runner=_stub_runner(), auth_tokens=tok) as h:
+        out = str(tmp_path / "o.dfa")
+        with ServiceClient(h.sock, client_token="old-tok") as c:
+            assert c.submit(["in.paf", "-o", out],
+                            cwd=str(tmp_path))["ok"]
+        # rotate LIVE: old credential out, new one in
+        time.sleep(0.02)                     # distinct mtime_ns
+        authz.write_auth_tokens(tok, {"new-tok": ["submit", "read"]})
+        deadline = time.monotonic() + 10
+        admitted = False
+        while time.monotonic() < deadline and not admitted:
+            with ServiceClient(h.sock, client_token="new-tok") as c:
+                admitted = c.submit(["in.paf", "-o", out],
+                                    cwd=str(tmp_path)).get("ok", False)
+            time.sleep(0.05)
+        assert admitted, "rotated token never became valid"
+        with ServiceClient(h.sock, client_token="old-tok") as c:
+            r = c._req({"cmd": "submit", "argv": ["x"]})
+            assert r["error"] == "unauthorized"
+        # corrupt rotation: keep-last-good (new-tok still serves)
+        time.sleep(0.02)
+        open(tok, "w").write("{not json")
+        time.sleep(0.5)                      # a few accept ticks
+        with ServiceClient(h.sock, client_token="new-tok") as c:
+            assert c.submit(["in.paf", "-o", out],
+                            cwd=str(tmp_path))["ok"]
+        assert "reload refused" in h.err.getvalue()
+
+
+def test_auth_failures_metered_and_penalized(tmp_path):
+    tok = _mint(tmp_path, {"boss": ["admin"]})
+    with _daemon(runner=_stub_runner(), auth_tokens=tok) as h:
+        with ServiceClient(h.sock, client_token="intruder") as c:
+            t0 = time.monotonic()
+            for _ in range(4):
+                r = c._req({"cmd": "submit", "argv": ["x"]})
+                assert r["error"] == "unauthorized"
+            held = time.monotonic() - t0
+        # capped-exponential penalty: 0.05+0.1+0.2+0.4 = 0.75s floor
+        assert held >= 0.5, held
+        with ServiceClient(h.sock, client_token="boss") as c:
+            body = c.metrics()["metrics"]
+        m = [l for l in body.splitlines()
+             if l.startswith("pwasm_transport_auth_failures_total")
+             and "intruder" in l]
+        assert m and float(m[0].split()[-1]) >= 4
+        # the default SLO rule set watches this counter
+        from pwasm_tpu.obs.catalog import default_slo_rules
+        assert any(r["name"] == "auth_failure_burst"
+                   for r in default_slo_rules())
+
+
+def test_daemon_rate_limit_truthful_retry(tmp_path):
+    with _daemon(runner=_stub_runner(),
+                 rate_limit=(2.0, 2.0)) as h:
+        out = str(tmp_path / "o.dfa")
+        with ServiceClient(h.sock, client_token="burst") as c:
+            a = c.submit(["in.paf", "-o", out], cwd=str(tmp_path))
+            b = c.submit(["in.paf", "-o", out], cwd=str(tmp_path))
+            assert a["ok"] and b["ok"]
+            r = c.submit(["in.paf", "-o", out], cwd=str(tmp_path))
+            assert r["ok"] is False and r["error"] == "overloaded", r
+            assert r["retry_after_s"] > 0
+            # reads are NOT rate limited (only admission verbs)
+            assert c.request({"cmd": "stats"})["ok"]
+            # honoring the truthful hint admits
+            time.sleep(r["retry_after_s"] + 0.05)
+            assert c.submit(["in.paf", "-o", out],
+                            cwd=str(tmp_path))["ok"]
+        # identities are independent buckets
+        with ServiceClient(h.sock, client_token="other") as c:
+            assert c.submit(["in.paf", "-o", out],
+                            cwd=str(tmp_path))["ok"]
+
+
+def test_no_new_flags_byte_identical_behavior(tmp_path):
+    """The whole zero-trust edge is strictly opt-in: without the
+    flags, anonymous clients submit, cancel and drain exactly as
+    before (the rest of the suite is the wider regression net)."""
+    with _daemon(runner=_stub_runner()) as h:
+        assert h.daemon.auth is None
+        assert h.daemon.rate_limiter is None
+        assert h.daemon.tls is None
+        with ServiceClient(h.sock) as c:
+            j = c.submit(["in.paf", "-o", str(tmp_path / "o.dfa")],
+                         cwd=str(tmp_path))
+            assert j["ok"]
+            assert c.result(j["job_id"], timeout=30)["rc"] == 0
+            assert c.request({"cmd": "stats",
+                              "lease": {"epoch": 1,
+                                        "ttl_s": 5.0}})["ok"]
+            assert c.drain()["ok"]
+
+
+# ---------------------------------------------------------------------------
+# router edge
+# ---------------------------------------------------------------------------
+def test_router_edge_auth_rate_and_frame_ceiling(tmp_path):
+    tok = _mint(tmp_path, {"writer": ["submit", "read"],
+                           "boss": ["admin"]})
+    with _daemon(runner=_stub_runner()) as m:
+        rdir = tempfile.mkdtemp(prefix="pwsec")
+        rsock = os.path.join(rdir, "router.sock")
+        err = io.StringIO()
+        r = Router([m.sock], socket_path=rsock,
+                   listen="127.0.0.1:0", stderr=err,
+                   poll_interval=0.1, auth_tokens=tok,
+                   rate_limit=(1.0, 1.0), max_frame_bytes=4096)
+        t = threading.Thread(target=r.serve, daemon=True)
+        t.start()
+        try:
+            assert wait_for_socket(rsock, 15), err.getvalue()
+            journal = router_journal_path(rsock, None, None)
+            out = str(tmp_path / "o.dfa")
+            with ServiceClient(rsock, client_token="writer") as c:
+                j = c.submit(["in.paf", "-o", out],
+                             cwd=str(tmp_path))
+                assert j["ok"], j
+                assert c.result(j["job_id"], timeout=30)["rc"] == 0
+                # rate limit at the EDGE: refused frames reach no
+                # member and write no journal
+                before = _journal_bytes(journal)
+                rr = c.submit(["in.paf", "-o", out],
+                              cwd=str(tmp_path))
+                assert rr["error"] == "overloaded", rr
+                assert rr["retry_after_s"] > 0
+                # unauthorized control verbs: zero ledger writes
+                for req in ({"cmd": "drain"},
+                            {"cmd": "fence"},
+                            {"cmd": "lease-grant",
+                             "lease": {"epoch": 9}}):
+                    resp = c._req(req)
+                    assert resp["error"] == "unauthorized", resp
+                assert _journal_bytes(journal) == before
+                assert c.ping()["draining"] is False
+            # frame ceiling parity on BOTH router transports
+            for target in (rsock, f"127.0.0.1:{r.tcp_port}"):
+                conn = connect(target, timeout=5)
+                try:
+                    conn.sendall(b'{"pad":"' + b"A" * 8192 + b'"}\n')
+                    line = conn.makefile("rb").readline(1 << 16)
+                    resp = json.loads(line)
+                    assert resp["error"] == "frame_too_large", \
+                        (target, resp)
+                finally:
+                    conn.close()
+            with ServiceClient(rsock, client_token="boss") as c:
+                assert c.drain()["ok"]       # admin drains for real
+        finally:
+            if not r.drain.requested:
+                r.drain.request("test done")
+            t.join(20)
+            shutil.rmtree(rdir, ignore_errors=True)
+
+
+def test_router_member_token_reaches_auth_armed_member(tmp_path):
+    """Members running --auth-tokens demand admin for the stats-borne
+    lease grant: a router armed with --member-token polls, places and
+    fetches as normal — the token rides every router→member frame."""
+    tok = _mint(tmp_path, {"fleet-svc": ["admin"]})
+    with _daemon(runner=_stub_runner(), auth_tokens=tok) as m:
+        rdir = tempfile.mkdtemp(prefix="pwsec")
+        rsock = os.path.join(rdir, "router.sock")
+        err = io.StringIO()
+        r = Router([m.sock], socket_path=rsock, stderr=err,
+                   poll_interval=0.1, member_token="fleet-svc")
+        t = threading.Thread(target=r.serve, daemon=True)
+        t.start()
+        try:
+            assert wait_for_socket(rsock, 15), err.getvalue()
+            out = str(tmp_path / "o.dfa")
+            with ServiceClient(rsock) as c:
+                j = c.submit(["in.paf", "-o", out],
+                             cwd=str(tmp_path))
+                assert j["ok"], j
+                assert c.result(j["job_id"], timeout=30)["rc"] == 0
+                st = c.stats()["stats"]
+                assert len(st["fleet"]["members"]) == 1
+        finally:
+            if not r.drain.requested:
+                r.drain.request("test done")
+            t.join(20)
+            shutil.rmtree(rdir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# the all-mTLS fleet acceptance drill
+# ---------------------------------------------------------------------------
+def _free_port():
+    s = socket_mod.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_mtls_fleet_standby_takeover_and_member_kill(tmp_path):
+    """THE ISSUE 19 acceptance drill: three TCP members demanding
+    client certificates, a primary router (subprocess, full CLI
+    flags) dialing them with its own cert, a warm standby riding the
+    SAME zero-trust config.  SIGKILL the primary → the standby
+    promotes and keeps dialing members over mTLS; SIGKILL a member
+    mid-job → the job resumes on a sibling with the report
+    byte-identical to the uncrashed plaintext arm and the trace_id
+    intact."""
+    paf, fa = _corpus(tmp_path)
+    from pwasm_tpu.cli import run as cli_run
+    assert cli_run(_job_args(tmp_path, "colda", paf, fa, [SLOW]),
+                   stderr=io.StringIO()) == 0
+    assert cli_run(_job_args(tmp_path, "coldb", paf, fa),
+                   stderr=io.StringIO()) == 0
+    expect_a = (tmp_path / "colda.dfa").read_bytes()
+    expect_b = (tmp_path / "coldb.dfa").read_bytes()
+
+    d = tempfile.mkdtemp(prefix="pwmtls")
+    jd = os.path.join(d, "journals")       # shared durable storage:
+    os.makedirs(jd)                        # TCP members journal here
+    procs = []
+    try:
+        ports, targets = [], []
+        for i in range(3):
+            port = _free_port()
+            ports.append(port)
+            targets.append(f"127.0.0.1:{port}")
+            p = subprocess.Popen(
+                [sys.executable, "-m", "pwasm_tpu.cli", "serve",
+                 f"--socket={os.path.join(d, f'm{i}.sock')}",
+                 f"--listen=127.0.0.1:{port}",
+                 f"--tls-cert={SRV_CERT}", f"--tls-key={SRV_KEY}",
+                 f"--tls-client-ca={CA}", f"--journal-dir={jd}"],
+                env=_serve_env(), stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE, text=True)
+            procs.append(p)
+        for i in range(3):
+            assert wait_for_socket(os.path.join(d, f"m{i}.sock"), 60)
+        # members verify client certs: a bare TCP client can't speak
+        with pytest.raises((ServiceError, OSError)):
+            with ServiceClient(targets[0], timeout=5,
+                               tls=_client_tls()) as c:
+                c.ping()
+        # PRIMARY router: the full zero-trust CLI surface
+        rsock = os.path.join(d, "router.sock")
+        rp = subprocess.Popen(
+            [sys.executable, "-m", "pwasm_tpu.cli", "route",
+             f"--backends={','.join(targets)}",
+             f"--socket={rsock}", f"--journal-dir={jd}",
+             "--poll-interval=0.1",
+             f"--member-tls-ca={CA}",
+             f"--member-tls-cert={ADMIN_CERT}",
+             f"--member-tls-key={ADMIN_KEY}"],
+            env=_serve_env(), stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE, text=True)
+        procs.append(rp)
+        assert wait_for_socket(rsock, 60)
+        with ServiceClient(rsock) as c:      # members reachable via
+            stats = c.stats()["stats"]       # mTLS dialing
+            assert len(stats["fleet"]["members"]) == 3, stats
+        # warm STANDBY rides the SAME zero-trust flag surface —
+        # member_tls must survive the promotion or takeover strands
+        # every TLS member
+        sb = subprocess.Popen(
+            [sys.executable, "-m", "pwasm_tpu.cli", "route",
+             f"--standby-of={rsock}", f"--journal-dir={jd}",
+             "--poll-interval=0.2",
+             f"--member-tls-ca={CA}",
+             f"--member-tls-cert={ADMIN_CERT}",
+             f"--member-tls-key={ADMIN_KEY}"],
+            env=_serve_env(), stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE, text=True)
+        procs.append(sb)
+        time.sleep(1.5)                      # let it see the primary
+        rp.kill()                            # murder the PRIMARY
+        rp.wait(timeout=30)
+        deadline = time.monotonic() + 60     # standby binds + serves
+        promoted = False
+        while time.monotonic() < deadline and not promoted:
+            try:
+                with ServiceClient(rsock, timeout=2.0) as c:
+                    promoted = c.request({"cmd": "ping"}).get("ok",
+                                                              False)
+            except (ServiceError, OSError):
+                time.sleep(0.1)
+        assert promoted, "standby never took over the socket"
+        assert sb.poll() is None
+        # the PROMOTED router dials members over the inherited mTLS
+        with ServiceClient(rsock, trace_id="mtls-drill") as c:
+            ja = c.submit(_job_args(tmp_path, "a", paf, fa, [SLOW]),
+                          cwd=str(tmp_path))
+            jb = c.submit(_job_args(tmp_path, "b", paf, fa),
+                          cwd=str(tmp_path))
+            assert ja["ok"] and jb["ok"], (ja, jb)
+            ck = str(tmp_path / "a.dfa.ckpt")
+            deadline = time.monotonic() + 60
+            mid = False
+            while time.monotonic() < deadline:
+                s = c.status(ja["job_id"])["job"]["state"]
+                if s == "running" and os.path.exists(ck):
+                    mid = True
+                    break
+                assert s in ("queued", "running"), s
+                time.sleep(0.02)
+            assert mid, "job never reached mid-run with a ckpt"
+            victim = ja["member"]
+            vi = next(i for i, t in enumerate(targets)
+                      if target_name(t) == victim)
+            procs[vi].kill()                 # SIGKILL mid-job
+            procs[vi].wait(timeout=30)
+            ra = c.result(ja["job_id"], timeout=300)
+            rb = c.result(jb["job_id"], timeout=300)
+            assert ra.get("rc") == 0, ra
+            assert rb.get("rc") == 0, rb
+            assert ra["job"]["trace_id"] == "mtls-drill"
+            assert ra["job"]["member"] != victim
+            assert ra["job"]["failovers"] == 1
+            st = c.stats()["stats"]
+            assert st["ha"]["takeover"] is True
+            c.drain()
+        assert sb.wait(timeout=120) == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+            p.stderr.close()
+        shutil.rmtree(d, ignore_errors=True)
+    # byte parity with the uncrashed plaintext arm, both jobs
+    assert (tmp_path / "a.dfa").read_bytes() == expect_a
+    assert (tmp_path / "b.dfa").read_bytes() == expect_b
+
+
+# ---------------------------------------------------------------------------
+# CLI flag surfaces
+# ---------------------------------------------------------------------------
+def test_serve_and_route_flag_validation(tmp_path):
+    from pwasm_tpu.fleet.router import route_main
+    from pwasm_tpu.service.daemon import serve_main
+
+    def run_serve(extra):
+        err = io.StringIO()
+        rc = serve_main([f"--socket={tmp_path / 's.sock'}"] + extra,
+                        stderr=err)
+        return rc, err.getvalue()
+
+    rc, out = run_serve(["--tls-cert=/x"])
+    assert rc != 0 and "must be given together" in out
+    rc, out = run_serve(["--tls-client-ca=/x"])
+    assert rc != 0 and "requires --tls-cert" in out
+    rc, out = run_serve(["--rate-limit=banana"])
+    assert rc != 0 and "rate-limit" in out
+    rc, out = run_serve([f"--auth-tokens={tmp_path / 'nope.json'}"])
+    assert rc != 0                      # fail-fast: unreadable policy
+
+    base = [f"--backends={tmp_path / 'm.sock'}",
+            f"--socket={tmp_path / 'r.sock'}"]
+
+    def run_route(extra):
+        err = io.StringIO()
+        rc = route_main(base + extra, stderr=err)
+        return rc, err.getvalue()
+
+    rc, out = run_route(["--tls-key=/x"])
+    assert rc != 0 and "must be given together" in out
+    rc, out = run_route(["--member-tls-cert=/x",
+                         "--member-tls-key=/y"])
+    assert rc != 0 and "need --member-tls-ca" in out
+    rc, out = run_route(["--max-frame-bytes=zero"])
+    assert rc != 0 and "max-frame-bytes" in out
+    rc, out = run_route(["--rate-limit=0"])
+    assert rc != 0 and "rate-limit" in out
