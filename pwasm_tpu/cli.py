@@ -506,6 +506,20 @@ def run(argv: list[str], stdout=None, stderr=None, warm=None,
         except PwasmError as e:
             stderr.write(str(e))
             return e.exit_code
+    if opts.get("m2m-stream"):
+        # continuous many2many (ROADMAP item 3): targets arrive
+        # incrementally — over the stream verbs when served
+        # (input_stream), from a FASTA replayed as a stream when cold
+        # — and score against the resident -r query set with
+        # incremental per-CDS section emission (pwasm_tpu/surveil/)
+        from pwasm_tpu.surveil.session import m2m_stream_main
+        try:
+            return m2m_stream_main(opts, positional, stdout, stderr,
+                                   warm=warm,
+                                   input_stream=input_stream)
+        except PwasmError as e:
+            stderr.write(str(e))
+            return e.exit_code
 
     cfg = Config()
     cfg.debug = bool(opts.get("D"))

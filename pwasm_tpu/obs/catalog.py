@@ -315,6 +315,57 @@ def build_stream_metrics(reg: MetricsRegistry) -> dict:
     return m
 
 
+def build_m2m_metrics(reg: MetricsRegistry) -> dict:
+    """Register the continuous-surveillance families (ISSUE 20,
+    ``--m2m-stream``): counters fold each FINISHED session's flow
+    (the daemon reads them from the session's ``--stats`` m2m block),
+    gauges describe the live ones — the svc-stats ``m2m`` block and
+    the ``top`` M2M pane read the same numbers."""
+    m = {}
+    m["sessions"] = reg.counter(
+        "pwasm_m2m_sessions_total",
+        "Finished --m2m-stream surveillance sessions")
+    m["targets_in"] = reg.counter(
+        "pwasm_m2m_targets_total",
+        "Target records admitted by finished m2m-stream sessions")
+    m["targets_scored"] = reg.counter(
+        "pwasm_m2m_targets_scored_total",
+        "Targets that needed at least one device dispatch (some "
+        "resident pair was not in the section cache)")
+    m["targets_reused"] = reg.counter(
+        "pwasm_m2m_targets_reused_total",
+        "Targets served ENTIRELY from the section cache's family "
+        "pool — zero device work")
+    m["pairs_dispatched"] = reg.counter(
+        "pwasm_m2m_pairs_dispatched_total",
+        "(query, target) pairs scored on the device by m2m-stream "
+        "sessions")
+    m["pairs_reused"] = reg.counter(
+        "pwasm_m2m_pairs_reused_total",
+        "(query, target) pairs spliced verbatim from cached section "
+        "scores instead of dispatched")
+    m["batches"] = reg.counter(
+        "pwasm_m2m_batches_total",
+        "Arrival batches dispatched by m2m-stream sessions")
+    m["sections"] = reg.counter(
+        "pwasm_m2m_sections_emitted_total",
+        "Per-CDS report sections emitted by finished m2m-stream "
+        "sessions")
+    m["active"] = reg.gauge(
+        "pwasm_m2m_active_sessions",
+        "Live m2m-stream sessions currently feeding or scoring")
+    m["live_targets"] = reg.gauge(
+        "pwasm_m2m_live_targets",
+        "Targets admitted so far by the LIVE sessions (in-flight "
+        "progress, not yet folded into the counters)")
+    m["reuse_ratio"] = reg.gauge(
+        "pwasm_m2m_reuse_ratio",
+        "Cumulative fraction of (query, target) pairs served from "
+        "the section cache across finished AND live sessions — the "
+        "incremental-surveillance win in one number")
+    return m
+
+
 def build_cache_metrics(reg: MetricsRegistry) -> dict:
     """Register the content-addressed result-cache families (ISSUE
     15, ``service/cache.py``): flow counters (hits/misses/insertions/

@@ -398,6 +398,51 @@ def paf_line_digests(path: str, max_lines: int = DELTA_MAX_LINES
     return out, h.hexdigest()
 
 
+def classify_stream(opts: dict) -> Classified | None:
+    """:func:`classify` for a SOCKET-fed stream job (ROADMAP 4c) —
+    same flag walk, no positional: the input arrives as frames, so its
+    identity is the per-line digest column, not a file digest."""
+    cls = classify(opts, ["<stream>"])
+    if cls is None:
+        return None
+    cls.input_path = None
+    return cls
+
+
+def line_digest(line: str) -> str:
+    """One stream line's delta-index digest — the same 16-hex column
+    :func:`paf_line_digests` derives from a file, so stream and file
+    entries of one family delta-match each other (terminator-stripped
+    on both sides)."""
+    return hashlib.sha256(
+        line.rstrip("\r\n").encode("utf-8")).hexdigest()[:16]
+
+
+def stream_keys(cls: Classified,
+                digests: list) -> tuple[str, str] | None:
+    """``(exact_key, family)`` for a stream job whose input is the
+    given line-digest column.  The FAMILY is byte-identical to the
+    file-side :func:`derive_keys` family for the same ref/flags/
+    outputs — that shared namespace is what lets a re-opened stream
+    delta-hit an entry a file run inserted, and vice versa.  The exact
+    key hashes the digest column itself (there is no input file to
+    digest), so stream entries still exact-collide with byte-identical
+    stream replays."""
+    try:
+        ref_d = fasta_digest(cls.ref_path)
+        flag_items = list(cls.flag_items)
+        if cls.motif_path is not None:
+            flag_items.append(("motifs#sha256",
+                               digest_file(cls.motif_path)))
+            flag_items.sort()
+    except OSError:
+        return None
+    input_d = "stream:" + hashlib.sha256(
+        "".join(digests).encode("ascii")).hexdigest()
+    return (cache_key(ref_d, input_d, flag_items, cls.output_kinds),
+            family_key(ref_d, flag_items, cls.output_kinds))
+
+
 # ---------------------------------------------------------------------------
 # the unified byte ledger (spool + cache accounting)
 # ---------------------------------------------------------------------------
@@ -756,6 +801,45 @@ class CacheStore:
                     pass
                 return key, m, blobs, nl
         return None
+
+    def delta_index(self, family: str) -> list[tuple[int, str]]:
+        """Snapshot the family's delta candidates as ``(lines,
+        digest_column)`` rows — the stream-delta HOLD path's in-memory
+        oracle: per arriving frame it needs to know whether any
+        candidate could still prefix-match once more lines arrive,
+        without re-walking the store per frame.  CRC-checked dx only
+        (a rotted index is simply absent from the snapshot); serving
+        still goes through :meth:`delta_lookup`, which re-verifies."""
+        out: list[tuple[int, str]] = []
+        with self._lock:
+            try:
+                names = os.listdir(self.root)
+            except OSError:
+                return out
+            for n in sorted(names):
+                if not n.endswith(".json"):
+                    continue
+                key = n[:-5]
+                m = self._read_manifest(key)
+                if m is None or self._expired(m):
+                    continue
+                d = m.get("delta")
+                if not isinstance(d, dict) \
+                        or d.get("family") != family:
+                    continue
+                try:
+                    nl = int(d["lines"])
+                    dxb, dxc = int(d["bytes"]), int(d["crc"])
+                    with open(self._blob_path(key, "dx"),
+                              "rb") as f:
+                        dx = f.read()
+                    if len(dx) != dxb or zlib.crc32(dx) != dxc:
+                        continue
+                except (KeyError, ValueError, TypeError, OSError):
+                    continue
+                if nl >= 2:
+                    out.append((nl, dx.decode("ascii", "replace")))
+        return out
 
     def note_delta(self, served: int, total: int) -> None:
         """Record one completed delta serve FRACTIONALLY: a run that

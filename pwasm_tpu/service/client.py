@@ -280,10 +280,16 @@ class ServiceClient:
     # ---- streaming ingestion (docs/STREAMING.md) -----------------------
     def stream_open(self, argv: list[str], cwd: str | None = None,
                     client: str | None = None,
-                    priority: str | None = None) -> dict:
+                    priority: str | None = None,
+                    delta: bool = False) -> dict:
         """Admit a stream job: ``argv`` is a submit-shaped job argv
         WITHOUT a positional PAF (the records arrive over
-        ``stream_data``)."""
+        ``stream_data``).  ``delta=True`` opts the stream into cache
+        delta classification (docs/STREAMING.md): the daemon holds
+        early frames against its result cache's per-line digest
+        columns, and a re-opened stream whose records extend a cached
+        run is served that run's report and re-armed as a --resume —
+        the file-side delta contract, over the socket."""
         import os
         req: dict = {"cmd": "stream", "args": list(argv),
                      "cwd": cwd if cwd is not None else os.getcwd()}
@@ -291,13 +297,22 @@ class ServiceClient:
             req["client"] = client
         if priority is not None:
             req["priority"] = priority
+        if delta:
+            req["delta"] = True
         return self._req(req)
 
-    def stream_data(self, job_id: str, data: str) -> dict:
+    def stream_data(self, job_id: str, data: str,
+                    digests: list[str] | None = None) -> dict:
         """Feed one chunk of PAF text (any byte split — the daemon
-        reassembles records across frames)."""
-        return self._req({"cmd": "stream-data", "job_id": job_id,
-                          "data": data})
+        reassembles records across frames).  ``digests`` (optional,
+        delta streams) carries the 16-hex per-line digests of the
+        lines this chunk completes — advisory: the daemon recomputes
+        its own column and refuses the frame on disagreement."""
+        req: dict = {"cmd": "stream-data", "job_id": job_id,
+                     "data": data}
+        if digests is not None:
+            req["digests"] = digests
+        return self._req(req)
 
     def stream_end(self, job_id: str) -> dict:
         return self._req({"cmd": "stream-end", "job_id": job_id})
@@ -306,7 +321,8 @@ class ServiceClient:
                cwd: str | None = None, client: str | None = None,
                priority: str | None = None, max_retries: int = 8,
                sleep=time.sleep,
-               keepalive_s: float | None = None) -> dict:
+               keepalive_s: float | None = None,
+               delta: bool = False) -> dict:
         """Open a stream job, feed every chunk from ``chunks``, and
         end the stream — with the backpressure dance built in: a
         ``queue_full`` mid-stream (the stream's buffer quota or fair
@@ -331,10 +347,19 @@ class ServiceClient:
         stream activity, so the daemon's ``--stream-idle-s`` reaper
         never mistakes a slow producer for a vanished client."""
         resp = self.stream_open(argv, cwd=cwd, client=client,
-                                priority=priority)
+                                priority=priority, delta=delta)
         if not resp.get("ok"):
             return resp
         job_id = resp["job_id"]
+        masm = None
+        if delta:
+            # mirror the daemon's line assembly so each frame carries
+            # the digests of exactly the lines it completes (the
+            # daemon cross-checks; state advances once per chunk, so
+            # a backpressure resend repeats identical digests)
+            from pwasm_tpu.service.cache import line_digest
+            from pwasm_tpu.stream.pafstream import LineAssembler
+            masm = LineAssembler()
         stop = beat = None
         if keepalive_s:
             import threading
@@ -362,9 +387,11 @@ class ServiceClient:
         waits = 0
         try:
             for chunk in chunks:
+                digs = [line_digest(ln) for ln in masm.push(chunk)] \
+                    if masm is not None else None
                 attempt = 0
                 while True:
-                    r = self.stream_data(job_id, chunk)
+                    r = self.stream_data(job_id, chunk, digests=digs)
                     if r.get("ok"):
                         break
                     if r.get("error") != protocol.ERR_QUEUE_FULL:
@@ -383,9 +410,20 @@ class ServiceClient:
             if stop is not None:
                 stop.set()
                 beat.join(5)
-        end = self.stream_end(job_id)
-        if not end.get("ok"):
-            raise ServiceError(f"stream-end rejected: {end}")
+        attempt = 0
+        while True:
+            # a delta-held stream resolves AT stream-end (late queue
+            # entry), so even the end frame can answer queue_full —
+            # same backoff-and-resend dance as a data frame
+            end = self.stream_end(job_id)
+            if end.get("ok"):
+                break
+            if end.get("error") != protocol.ERR_QUEUE_FULL \
+                    or attempt >= max_retries:
+                raise ServiceError(f"stream-end rejected: {end}")
+            sleep(retry_backoff_s(attempt, end.get("retry_after_s")))
+            waits += 1
+            attempt += 1
         resp["records"] = end.get("records")
         resp["backpressure_waits"] = waits
         return resp

@@ -537,6 +537,13 @@ class Daemon:
         self.epoch_lease = EpochLease()
         self._lock = threading.Lock()
         self._running: dict[str, Job] = {}
+        # retired m2m-stream flow counters (surveillance sessions,
+        # ISSUE 20): live sessions are read off their feeds, finished
+        # ones fold here so svc-stats "m2m" stays cumulative
+        self._m2m_done = {"sessions": 0, "targets_in": 0,
+                          "targets_scored": 0, "targets_reused": 0,
+                          "pairs_dispatched": 0, "pairs_reused": 0,
+                          "batches": 0, "sections_emitted": 0}
         self._draining = False
         self._closing = threading.Event()
         self._next_id = 0
@@ -554,12 +561,14 @@ class Daemon:
         from pwasm_tpu.obs import (EventLog, MetricsRegistry,
                                    Observability, TraceRecorder)
         from pwasm_tpu.obs.catalog import (build_cache_metrics,
+                                           build_m2m_metrics,
                                            build_run_metrics,
                                            build_service_metrics,
                                            build_stream_metrics)
         self.registry = MetricsRegistry()
         self.svc_metrics = build_service_metrics(self.registry)
         self.stream_metrics = build_stream_metrics(self.registry)
+        self.m2m_metrics = build_m2m_metrics(self.registry)
         self.cache_metrics = build_cache_metrics(self.registry)
         # ---- content-addressed result cache (ISSUE 15): lookup at
         # admission, insert at job finish — the repeat-traffic fast
@@ -953,6 +962,15 @@ class Daemon:
         for c, age in self.streams.client_lag_age().items():
             self.stream_metrics["lag_age"].set(round(age, 3),
                                                client=c or "default")
+        mm = self._m2m_stats()
+        g = self.m2m_metrics
+        g["active"].set(mm.get("active", 0))
+        with self._lock:
+            done_in = self._m2m_done["targets_in"]
+        g["live_targets"].set(max(0, mm["targets_in"] - done_in))
+        pairs = mm["pairs_dispatched"] + mm["pairs_reused"]
+        g["reuse_ratio"].set(
+            round(mm["pairs_reused"] / pairs, 6) if pairs else 0.0)
         depths = self.queue.client_depths()
         for c in clients_seen | set(depths):
             # every client ever admitted keeps a series: a drained
@@ -1324,7 +1342,15 @@ class Daemon:
         self.leases.drain()    # wake lease-waiters empty-handed: their
         #                        jobs are preempted below by the worker
         waiting = self.queue.drain()
-        for job in waiting:
+        # delta-HELD streams are queued-but-not-in-the-queue: the
+        # drain must preempt them too or they hang forever
+        with self._lock:
+            held = [j for j in self.jobs.values()
+                    if j.state == JOB_QUEUED and j.dstate is not None
+                    and j.dstate.get("mode") == "holding"]
+        for j in held:
+            j.dstate["mode"] = "off"
+        for job in waiting + held:
             self._retire_stream(job)
             job.state = JOB_PREEMPTED
             job.rc = EXIT_PREEMPTED
@@ -1647,6 +1673,40 @@ class Daemon:
             job.drain.stderr = self.stderr
         job.errbuf = job.outbuf = None
         job.stats = self._read_job_stats(job)
+        if isinstance(job.stats, dict) \
+                and isinstance(job.stats.get("m2m"), dict):
+            # fold a finished surveillance session's flow into the
+            # cumulative svc-stats "m2m" block (ISSUE 20)
+            m = job.stats["m2m"]
+            with self._lock:
+                self._m2m_done["sessions"] += 1
+                for k in self._m2m_done:
+                    if k != "sessions":
+                        try:
+                            self._m2m_done[k] += int(m.get(k, 0) or 0)
+                        except (TypeError, ValueError):
+                            pass
+            self.m2m_metrics["sessions"].inc()
+            for k, fam in (("targets_in", "targets_in"),
+                           ("targets_scored", "targets_scored"),
+                           ("targets_reused", "targets_reused"),
+                           ("pairs_dispatched", "pairs_dispatched"),
+                           ("pairs_reused", "pairs_reused"),
+                           ("batches", "batches"),
+                           ("sections_emitted", "sections")):
+                try:
+                    v = int(m.get(k, 0) or 0)
+                except (TypeError, ValueError):
+                    continue
+                if v > 0:
+                    self.m2m_metrics[fam].inc(v)
+        if rc == 0 and job.delta is not None and job.stream \
+                and job.feed is not None:
+            # a held stream's promote fixed its served count when only
+            # part of the input had arrived: the truthful TOTAL is the
+            # whole stream, known at finish
+            job.delta = (job.delta[0],
+                         max(job.delta[1], job.feed.records_in))
         if rc == 0 and job.delta is not None:
             # the fractional hit lands at FINISH, not admission — a
             # failed tail run must not count as served traffic
@@ -1725,6 +1785,12 @@ class Daemon:
             # just wrote become the entry an identical later submit
             # is answered from at admission
             self._cache_insert(job)
+        elif job.state == JOB_DONE and job.dstate is not None \
+                and self.cache is not None:
+            # a delta-mirrored stream inserts too (ROADMAP 4c): its
+            # digest column is the delta index a later stream or file
+            # job in the same family extends
+            self._stream_cache_insert(job)
         # past every RAM consumer of job.stats: big results move to
         # the spool (index-only in RAM), then the terminal verdict —
         # with its spool pointer — lands durably in the journal
@@ -1784,7 +1850,8 @@ class Daemon:
                priority: str | None = None,
                stream: bool = False,
                trace_id: str | None = None,
-               deadline_ms: int | None = None) -> Job:
+               deadline_ms: int | None = None,
+               delta: bool = False) -> Job:
         """Validate + admit one job (raises Draining/QueueFull/
         ValueError).  Also the in-process API the tests drive.
         ``cwd`` is the CLIENT's working directory: relative paths in
@@ -1946,6 +2013,16 @@ class Daemon:
             with self._lock:
                 job.prefer_lane = self._client_lanes.get(client)
             self.streams.register(job.id, client, job.feed)
+            if delta and self.cache is not None:
+                # delta over the SOCKET (ROADMAP 4c): the client
+                # volunteered per-line digests, so this stream can be
+                # classified against the cache's digest columns like a
+                # file input.  While same-family candidates exist the
+                # job is HELD out of the queue and its frames parked;
+                # a strict-prefix match serves the cached report and
+                # re-arms the job as a --resume over it, exactly the
+                # file-side _admit_cache_delta shape.
+                job.dstate = self._delta_stream_open(job_opts)
         # write-ahead order: the admit record lands BEFORE the queue
         # can hand the job to a worker — a worker only journals start
         # after a successful dequeue, so the file order admit < start
@@ -1972,7 +2049,12 @@ class Daemon:
                            served=delta_served[0],
                            total=delta_served[1])
         try:
-            self.queue.submit(job)
+            # a delta-HELD stream defers its queue entry: it either
+            # promotes to a --resume (frames decide) or goes cold at
+            # the viability/cap/end boundary — _delta_stream_queue
+            if not (job.dstate is not None
+                    and job.dstate.get("mode") == "holding"):
+                self.queue.submit(job)
         except (Draining, QueueFull):
             # the admission never happened: retract the id so replay
             # cannot resurrect a job the client was told was rejected
@@ -2107,6 +2189,271 @@ class Daemon:
         #   state — a stale ckpt must not hijack the header scan
         return (max(0, nl - 1), len(digests))
 
+    # ---- delta over socket streams (ROADMAP 4c) ------------------------
+    #
+    # A file job's delta admission has the whole input in hand; a
+    # stream's input arrives one frame at a time.  So the stream-delta
+    # admission is a small state machine on Job.dstate:
+    #
+    #   holding  — frames are digested and PARKED (not fed, not
+    #              queued) while any same-family cache entry could
+    #              still prefix-match the growing digest column;
+    #   resolved — the job is queued (as a --resume over served
+    #              cached bytes, or cold); parked frames were
+    #              replayed into the feed, and the daemon keeps
+    #              mirroring the digest column so a clean finish
+    #              inserts a delta-indexed entry of its own;
+    #   off      — bookkeeping abandoned (cancel/drain while held).
+    #
+    # Digests are SERVER-authoritative: the client's advisory column
+    # (stream-data "digests") is cross-checked, never trusted — a
+    # disagreement is a loud bad_request, not a wrong serve.
+
+    def _delta_stream_open(self, job_opts: dict) -> dict | None:
+        """Classify a delta-opted stream against the cache; ``None``
+        when the shape can never delta-match (bypass flag, non-report
+        output, unreadable ref) — the stream then runs exactly as a
+        non-delta stream."""
+        from pwasm_tpu.service.cache import (DELTA_MAX_LINES,
+                                             classify_stream,
+                                             delta_eligible,
+                                             stream_keys)
+        from pwasm_tpu.stream.pafstream import LineAssembler
+        cls = classify_stream(job_opts)
+        if cls is None or not delta_eligible(cls):
+            return None
+        keys = stream_keys(cls, [])
+        if keys is None:
+            return None
+        cands = self.cache.delta_index(keys[1])
+        return {
+            # no candidates = nothing to wait for: queue now, mirror
+            # only (this stream still INSERTS a delta entry at finish)
+            "mode": "holding" if cands else "resolved",
+            "cls": cls, "family": keys[1],
+            "digests": [], "held": [],
+            "asm": LineAssembler(),
+            "cands": cands,
+            # parked lines stay under the per-stream buffer quota the
+            # feed itself would have enforced
+            "cap": min(self.streams.max_buffer, DELTA_MAX_LINES),
+        }
+
+    def _delta_stream_queue(self, job: Job) -> dict | None:
+        """Late queue entry for a held stream; an error response means
+        the hold state is UNCHANGED and the triggering frame (or
+        stream-end) resends after backoff — the same all-or-nothing
+        contract every stream frame already has."""
+        try:
+            self.queue.submit(job)
+        except Draining as e:
+            return protocol.err(protocol.ERR_DRAINING, str(e))
+        except QueueFull as e:
+            return protocol.err(
+                protocol.ERR_QUEUE_FULL, str(e),
+                queue_depth=self.queue.depth(),
+                max_queue=self.queue.max_queue,
+                retry_after_s=self._retry_after_s())
+        return None
+
+    def _delta_stream_replay(self, job: Job, extra: list,
+                             end: bool = False) -> None:
+        """Feed the parked frames (plus the triggering frame) into the
+        now-queued job's StreamFeed, committing the digest mirror for
+        the triggering frame as the feed commits its lines."""
+        from pwasm_tpu.service.cache import line_digest
+        ds = job.dstate
+        feed = job.feed
+        for fr in ds["held"] + list(extra):
+            n = feed.completed(fr)
+            if n:
+                try:
+                    self.streams.admit(job.id, n)
+                except QueueFull:
+                    # the hold cap bounded parked lines under the
+                    # per-stream quota; a shared-total squeeze here is
+                    # transient — backpressure resumes on the next
+                    # LIVE frame, and dropping parked frames is not an
+                    # option (they were acked)
+                    pass
+            fed = feed.feed(fr)
+            if fed:
+                self.stream_metrics["records"].inc(
+                    fed, client=job.client or "default")
+        for fr in extra:
+            for ln in ds["asm"].push(fr):
+                ds["digests"].append(line_digest(ln))
+        ds["held"] = []
+        if end:
+            for tail in ds["asm"].flush():
+                ds["digests"].append(line_digest(tail))
+            feed.end()
+
+    def _delta_stream_go_cold(self, job: Job, extra: list,
+                              end: bool = False) -> dict | None:
+        ds = job.dstate
+        err = self._delta_stream_queue(job)
+        if err is not None:
+            return err
+        self._delta_stream_replay(job, extra, end=end)
+        ds["mode"] = "resolved"
+        return None
+
+    def _delta_stream_promote(self, job: Job, hit: tuple,
+                              digests: list, extra: list,
+                              end: bool = False) -> dict | None:
+        """Serve a delta hit to a held stream: cached report bytes out,
+        job re-armed as a --resume, queued, parked frames replayed.
+        Falls back to a cold run on any write failure — delta is an
+        optimization, never a correctness gate."""
+        ds = job.dstate
+        _key, _manifest, blobs, nl = hit
+        report = ds["cls"].output_paths.get("o")
+        served = None
+        if report is not None and "o" in blobs:
+            try:
+                with open(report, "wb") as f:
+                    f.write(blobs["o"])
+                from pwasm_tpu.cli import _unlink_checkpoint
+                _unlink_checkpoint(report)
+                served = (max(0, nl - 1), len(digests))
+            except OSError:
+                served = None
+        if served is None:
+            return self._delta_stream_go_cold(job, extra, end=end)
+        # arm BEFORE queueing — a worker may dequeue instantly, and it
+        # must see the --resume and the served report
+        job.argv.append("--resume")
+        job.delta = served
+        err = self._delta_stream_queue(job)
+        if err is not None:
+            # unwind so the client's verbatim resend re-resolves
+            # cleanly (the rewritten report file is re-written then)
+            job.argv.pop()
+            job.delta = None
+            return err
+        self._journal_append(REC_CACHE_HIT, job_id=job.id,
+                             delta=True, served=served[0],
+                             total=served[1])
+        self.obs.event("cache_delta", job_id=job.id,
+                       trace_id=job.trace_id,
+                       served=served[0], total=served[1])
+        self._delta_stream_replay(job, extra, end=end)
+        ds["digests"] = list(digests)
+        ds["mode"] = "resolved"
+        return None
+
+    def _delta_stream_data(self, job: Job, req: dict,
+                           data: str) -> dict:
+        """One stream-data frame while HELD: digest its lines, decide
+        hit / keep-holding / go-cold, answer the client."""
+        from pwasm_tpu.service.cache import line_digest
+        ds = job.dstate
+        feed = job.feed
+        asm = ds["asm"]
+        lines = asm.preview(data)
+        if not lines and data:
+            from pwasm_tpu.stream.pafstream import MAX_RECORD_BYTES
+            if len(asm.pending) + len(data) > MAX_RECORD_BYTES:
+                return protocol.err(
+                    protocol.ERR_BAD_REQUEST,
+                    f"unterminated PAF record exceeds "
+                    f"{MAX_RECORD_BYTES} bytes — stream-data frames "
+                    "must eventually carry a newline")
+        digs = [line_digest(ln) for ln in lines]
+        cdigs = req.get("digests")
+        if cdigs is not None and list(cdigs) != digs:
+            return protocol.err(
+                protocol.ERR_BAD_REQUEST,
+                "stream-data digests disagree with the server's own "
+                "line digests — refusing to classify this stream "
+                "against the cache (client-side assembler bug?)")
+        new = ds["digests"] + digs
+        joined = "".join(new)
+        # a candidate fully inside our column decides NOW
+        if any(nl < len(new) and dx == joined[:len(dx)]
+               for nl, dx in ds["cands"]):
+            hit = self.cache.delta_lookup(ds["family"], new)
+            if hit is not None:
+                err = self._delta_stream_promote(job, hit, new,
+                                                 extra=[data])
+                if err is not None:
+                    return err
+                return protocol.ok(buffered=feed.buffered,
+                                   records=feed.records_in)
+            # snapshot rotted under us: refresh and fall through
+            ds["cands"] = self.cache.delta_index(ds["family"])
+        # still worth holding?  some candidate our column prefixes
+        # (longer = future strict hit; equal = stream-end exact-length
+        # hit) and the parked lines stay under the buffer quota
+        viable = any(nl >= len(new) and dx[:len(joined)] == joined
+                     for nl, dx in ds["cands"])
+        if viable and len(new) <= ds["cap"]:
+            ds["held"].append(data)
+            ds["digests"] = new
+            asm.push(data)
+            return protocol.ok(buffered=len(new), records=len(new))
+        err = self._delta_stream_go_cold(job, extra=[data])
+        if err is not None:
+            return err
+        return protocol.ok(buffered=feed.buffered,
+                           records=feed.records_in)
+
+    def _delta_stream_finish(self, job: Job) -> dict:
+        """stream-end while HELD: the column is final — one last
+        lookup with exact-length matches allowed, then promote or run
+        cold over the replayed frames."""
+        from pwasm_tpu.service.cache import line_digest
+        ds = job.dstate
+        feed = job.feed
+        tail = ds["asm"].pending
+        final = ds["digests"] + ([line_digest(tail)] if tail else [])
+        hit = self.cache.delta_lookup(ds["family"], final,
+                                      allow_equal=True) \
+            if len(final) >= 2 else None
+        if hit is not None:
+            err = self._delta_stream_promote(job, hit, final,
+                                             extra=[], end=True)
+        else:
+            err = self._delta_stream_go_cold(job, extra=[], end=True)
+        if err is not None:
+            return err
+        return protocol.ok(records=feed.records_in,
+                           buffered=feed.buffered)
+
+    def _stream_cache_insert(self, job: Job) -> None:
+        """A cleanly finished delta-mirrored stream becomes a cache
+        entry with a per-line delta index — the next stream (or FILE
+        job: the family namespace is shared) that extends this one is
+        served as a delta.  Every guard degrades to 'no insert'."""
+        from pwasm_tpu.service.cache import (DELTA_MAX_LINES,
+                                             stream_keys)
+        ds = job.dstate
+        feed = job.feed
+        digests = ds.get("digests") or []
+        if ds.get("mode") != "resolved" or feed is None \
+                or not feed.ended \
+                or feed.records_in != len(digests) \
+                or len(digests) < 2 or len(digests) > DELTA_MAX_LINES:
+            return
+        keys = stream_keys(ds["cls"], digests)
+        if keys is None:
+            return
+        report = ds["cls"].output_paths.get("o")
+        if report is None:
+            return
+        try:
+            with open(report, "rb") as f:
+                blob = f.read()
+        except OSError:
+            return
+        if self.cache.insert(
+                keys[0], {"o": blob}, stats=job.stats,
+                delta={"family": keys[1], "lines": len(digests),
+                       "dx": "".join(digests).encode("ascii")}):
+            self.obs.event("cache_insert", job_id=job.id,
+                           trace_id=job.trace_id)
+
     def _cache_insert(self, job: Job) -> None:
         """Store a cleanly finished job's output files under its
         admission-time key via the shared ``insert_from_paths`` (one
@@ -2131,6 +2478,33 @@ class Daemon:
                       f"on {job.id}) — serving continues without "
                       "caching; see cache.insert_errors / "
                       "pwasm_cache_insert_errors_total")
+
+    def _m2m_stats(self) -> dict:
+        """The svc-stats ``m2m`` block (ISSUE 20): live surveillance
+        sessions read off their feeds' published progress, finished
+        ones from the cumulative fold — `top`'s M2M pane and the
+        fleet roll-up consume the same shape."""
+        with self._lock:
+            out = dict(self._m2m_done)
+            jobs = [j for j in self.jobs.values()
+                    if j.stream and j.feed is not None
+                    and j.state not in TERMINAL_STATES]
+        live = 0
+        for j in jobs:
+            prog = getattr(j.feed, "m2m_progress", None)
+            if not isinstance(prog, dict):
+                continue
+            live += 1
+            for k in out:
+                if k == "sessions":
+                    continue
+                try:
+                    out[k] += int(prog.get(k, 0) or 0)
+                except (TypeError, ValueError):
+                    pass
+        out["sessions"] += live
+        out["active"] = live
+        return out
 
     def _retry_after_s(self) -> float:
         """The queue_full backoff hint: roughly one recent job's wall
@@ -2326,7 +2700,8 @@ class Daemon:
                                   priority=req.get("priority"),
                                   stream=True,
                                   trace_id=req.get("trace_id"),
-                                  deadline_ms=deadline_ms)
+                                  deadline_ms=deadline_ms,
+                                  delta=bool(req.get("delta")))
             except ValueError as e:
                 return protocol.err(protocol.ERR_BAD_REQUEST, str(e))
             except Draining as e:
@@ -2368,7 +2743,18 @@ class Daemon:
                     + ("; re-open a stream with --resume to complete "
                        "it" if job.state == JOB_PREEMPTED else ""))
             if cmd == "stream-end":
+                ds = job.dstate
+                if ds is not None and ds.get("mode") == "holding" \
+                        and feed is not None and not feed.ended:
+                    return self._delta_stream_finish(job)
                 if feed is not None:
+                    if ds is not None \
+                            and ds.get("mode") == "resolved" \
+                            and not feed.ended:
+                        from pwasm_tpu.service.cache import \
+                            line_digest
+                        for tail in ds["asm"].flush():
+                            ds["digests"].append(line_digest(tail))
                     feed.end()
                 return protocol.ok(
                     records=feed.records_in if feed else 0,
@@ -2378,6 +2764,12 @@ class Daemon:
                 return protocol.err(
                     protocol.ERR_BAD_REQUEST,
                     "stream-data needs a string data field")
+            ds = job.dstate
+            if ds is not None and ds.get("mode") == "holding":
+                # delta hold (ROADMAP 4c): this frame is digested and
+                # parked/promoted instead of fed — the job is not in
+                # the queue yet
+                return self._delta_stream_data(job, req, data)
             n = feed.completed(data)
             if not n and data:
                 # the record quota counts complete lines, so
@@ -2411,6 +2803,13 @@ class Daemon:
             if fed:
                 self.stream_metrics["records"].inc(
                     fed, client=job.client or "default")
+            if ds is not None and ds.get("mode") == "resolved":
+                # keep the digest mirror current for the finish-time
+                # insert — AFTER the commit, so a rejected frame's
+                # verbatim resend cannot double-digest
+                from pwasm_tpu.service.cache import line_digest
+                for ln in ds["asm"].push(data):
+                    ds["digests"].append(line_digest(ln))
             return protocol.ok(buffered=feed.buffered,
                                records=feed.records_in)
         if cmd == "stats":
@@ -2478,6 +2877,10 @@ class Daemon:
                 "max_buffer": self.streams.max_buffer,
                 "max_buffer_total": self.streams.max_total,
             }
+            # additive (stats_version unchanged): continuous
+            # surveillance m2m sessions (ISSUE 20) — arrival/dispatch
+            # flow, incremental reuse, section emission
+            st["m2m"] = self._m2m_stats()
             # additive (stats_version unchanged): the self-monitoring
             # verdict (ISSUE 14) — `top`'s alerts pane reads it from
             # the same surface as the JSON verbs
@@ -2609,6 +3012,27 @@ class Daemon:
                             f"unknown cmd {cmd!r}")
 
     def _cancel(self, job: Job) -> dict:
+        if job.state == JOB_QUEUED and job.dstate is not None \
+                and job.dstate.get("mode") == "holding":
+            # a delta-HELD stream is not in the queue (queue.remove
+            # below would miss it and the running branch would wait
+            # forever on a job that never starts): retire it directly
+            job.dstate["mode"] = "off"
+            self._retire_stream(job)
+            job.state = JOB_CANCELLED
+            job.rc = None
+            job.detail = ("cancelled while held for stream-delta "
+                          "classification (never started)")
+            job.finished_s = time.time()
+            self.stats.jobs_cancelled += 1
+            self.svc_metrics["jobs"].inc(outcome="cancelled")
+            self._journal_append(REC_FINISH, job_id=job.id,
+                                 state=JOB_CANCELLED, rc=None,
+                                 detail=job.detail)
+            self.obs.event("job_cancel", job_id=job.id, was="held",
+                           trace_id=job.trace_id)
+            job.done.set()
+            return protocol.ok(state=JOB_CANCELLED, was="held")
         if job.state == JOB_QUEUED and self.queue.remove(job):
             self._retire_stream(job)
             job.state = JOB_CANCELLED

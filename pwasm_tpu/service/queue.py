@@ -103,6 +103,13 @@ class Job:
     #   --resume over a cached same-family input prefix (ISSUE 17):
     #   finish notes the fractional hit and stamps the job's stats
     #   with the truthful cache_delta counts
+    dstate: dict | None = field(default=None, repr=False)  # stream
+    #   delta state (ROADMAP 4c): while "holding", stream-data frames
+    #   are classified against the cache's per-line digest column
+    #   BEFORE the job enters the queue (a re-opened stream delta-hits
+    #   like a file input); once "resolved" the daemon keeps mirroring
+    #   the server-authoritative digest column so a cleanly finished
+    #   stream inserts a delta-indexed entry of its own
     deadline_ms: int | None = None     # REMAINING end-to-end budget
     #   (integer ms) as of admission, from the submit frame's
     #   deadline_ms (ISSUE 18).  None = no deadline: behavior is

@@ -185,6 +185,25 @@ def render(st: dict) -> str:
             f"batches {streams.get('batches', 0)})")
     else:
         out.append(" STREAMS: none")
+    m2m = st.get("m2m") or {}
+    if m2m.get("active") or m2m.get("sessions"):
+        # continuous surveillance (ISSUE 20): live session flow plus
+        # the incremental win — how much of the pair matrix the
+        # section cache spliced instead of re-scoring
+        pairs = (m2m.get("pairs_dispatched", 0)
+                 + m2m.get("pairs_reused", 0))
+        ratio = 100.0 * m2m.get("pairs_reused", 0) / pairs \
+            if pairs else 0.0
+        out.append(
+            f" M2M: {m2m.get('active', 0)} live / "
+            f"{m2m.get('sessions', 0)} session(s), "
+            f"targets {m2m.get('targets_scored', 0)} scored + "
+            f"{m2m.get('targets_reused', 0)} reused of "
+            f"{m2m.get('targets_in', 0)} | pairs "
+            f"{m2m.get('pairs_dispatched', 0)} dispatched, "
+            f"{m2m.get('pairs_reused', 0)} spliced "
+            f"({ratio:.0f}% reuse), "
+            f"{m2m.get('sections_emitted', 0)} section(s)")
     cache = st.get("cache") or {}
     if cache.get("enabled"):
         # the result cache (ISSUE 15): hit flow + on-disk footprint —

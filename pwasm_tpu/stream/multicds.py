@@ -76,6 +76,150 @@ def _usage_err(msg: str) -> M2mUsageError:
     return M2mUsageError(f"{M2M_USAGE}\n{msg}\n")
 
 
+def load_fasta(path, what):
+    """Load a FASTA into parallel (names, upper-cased seqs) lists —
+    shared by the one-shot driver and the surveil stream session so
+    both parse targets identically (the byte-parity precondition)."""
+    from pwasm_tpu.core.fasta import FastaFile
+    try:
+        fa = FastaFile(str(path))
+    except (OSError, PwasmError):
+        raise PwasmError(
+            f"Error: invalid FASTA file {path} !\n")
+    if not len(fa):
+        raise PwasmError(
+            f"Error: invalid FASTA file {path} !\n")
+    seqs = []
+    for name in fa.names:
+        s = fa.fetch(name)
+        if not s:
+            raise PwasmError(
+                f"Error: could not retrieve sequence for {name} "
+                f"({what})!\n")
+        seqs.append(s.upper())
+    return fa.names, seqs
+
+
+def parse_m2m_opts(opts: dict):
+    """Validate the option surface shared by ``--many2many`` and the
+    surveil ``--m2m-stream`` session (device/band/retries/fallback/
+    result-cache/deadline).  Returns a plain namespace; raises
+    :class:`M2mUsageError` with the usage text on bad values."""
+    from types import SimpleNamespace
+
+    for bad, why in (("w", "builds an MSA"), ("ace", "builds an MSA"),
+                     ("info", "builds an MSA"), ("cons", "builds an "
+                      "MSA"), ("realign", "rewrites PAF gaps"),
+                     ("follow", "tails a PAF"), ("resume", "resumes a "
+                      "report"), ("shard", "is a report-path knob")):
+        if bad in opts:
+            raise _usage_err(f"Error: --many2many scores sequences; "
+                             f"-{'-' if len(bad) > 1 else ''}{bad} "
+                             f"{why} and does not apply")
+    rpath = opts.get("r")
+    if not rpath or rpath is True:
+        raise _usage_err("Error: query FASTA file (-r) is required!")
+    device = str(opts.get("device", "cpu"))
+    if device not in ("cpu", "tpu"):
+        raise _usage_err(f"Error: invalid --device value: {device}")
+    band = 64
+    if "band" in opts:
+        val = opts["band"]
+        if val is True or not str(val).isascii() \
+                or not str(val).isdigit() or int(val) < 1:
+            raise _usage_err(f"Error: invalid --band value: {val}")
+        band = int(val)
+    max_retries = 2
+    if "max-retries" in opts:
+        val = opts["max-retries"]
+        if val is True or not str(val).isascii() \
+                or not str(val).isdigit():
+            raise _usage_err(
+                f"Error: invalid --max-retries value: {val}")
+        max_retries = int(val)
+    fallback = str(opts.get("fallback", "cpu"))
+    if fallback not in ("cpu", "fail"):
+        raise _usage_err(f"Error: invalid --fallback value: {fallback}")
+    deadline_s = None
+    if "deadline-s" in opts:
+        val = opts["deadline-s"]
+        try:
+            deadline_s = float(str(val))
+        except (TypeError, ValueError):
+            deadline_s = None
+        import math
+        if deadline_s is None or not math.isfinite(deadline_s) \
+                or deadline_s <= 0:
+            raise _usage_err(
+                f"Error: invalid --deadline-s value: {val}")
+    rc_dir = opts.get("result-cache")
+    if rc_dir is True:
+        raise _usage_err("Error: --result-cache requires a directory "
+                         "(or off)")
+    rc_max = None
+    if "result-cache-max-bytes" in opts:
+        val = opts["result-cache-max-bytes"]
+        if val is True or not str(val).isascii() \
+                or not str(val).isdigit() or int(val) < 1:
+            raise _usage_err("Error: invalid "
+                             f"--result-cache-max-bytes value: {val}")
+        rc_max = int(val)
+    return SimpleNamespace(
+        rpath=rpath, device=device, band=band,
+        max_retries=max_retries, fallback=fallback,
+        deadline_s=deadline_s, rc_dir=rc_dir, rc_max=rc_max,
+        verbose=bool(opts.get("v")) or bool(opts.get("D")))
+
+
+def open_section_store(rc_dir, rc_max, warm, stderr):
+    """Resolve and open the per-CDS section cache (flag first, warm
+    context second); ``None`` when caching is off or the dir is
+    unusable."""
+    if not isinstance(rc_dir, str) or not rc_dir or rc_dir == "off":
+        rc_dir = getattr(warm, "result_cache_dir", None) \
+            if warm is not None else None
+    if not rc_dir:
+        return None
+    from pwasm_tpu.service.cache import CacheStore
+    try:
+        return CacheStore(rc_dir, max_bytes=rc_max)
+    except OSError as e:
+        print(f"Warning: --result-cache dir {rc_dir} unusable "
+              f"({e}); caching disabled", file=stderr)
+        return None
+
+
+def lane_span_mesh(use_device, warm, stderr, verbose=False):
+    """ROADMAP item 3: a leased m2m session spans its WHOLE lane —
+    when the device lease covers more than one chip, build the 2-D
+    tile mesh over exactly that device span (`make_mesh2d(devices=)`
+    via jaxcompat, the ISSUE 8 placement pattern) instead of scoring
+    on the lane's first device only.  Returns ``None`` (single-device
+    session, the pre-existing behavior) for cold runs, cpu jobs, and
+    single-device leases."""
+    if not use_device or warm is None:
+        return None
+    from pwasm_tpu.cli import _lane_device_pool, _lane_devices
+    span = _lane_devices(warm)
+    if not span or span[1] - span[0] <= 1:
+        return None
+    pool = _lane_device_pool(span, stderr, warn=False)
+    if pool is None or len(pool) <= 1:
+        return None
+    from pwasm_tpu.parallel.many2many import make_mesh2d
+    try:
+        mesh = make_mesh2d(devices=pool)
+    except Exception as e:       # mesh shape/backend quirks demote,
+        print(f"Warning: lane-span mesh over {len(pool)} device(s) "
+              f"unavailable ({e}); session stays single-device",
+              file=stderr)      # never kill the job
+        return None
+    if verbose:
+        print(f"many2many: lane-span mesh over {len(pool)} "
+              "device(s)", file=stderr)
+    return mesh
+
+
 def format_sections(qnames, qlens, tnames, tlens, scores, neg) -> str:
     """Render the per-CDS report sections (pure, unit-testable).  One
     query's section reads only its own score row, so multi-vs-single
@@ -115,65 +259,18 @@ def many2many_main(opts: dict, positional: list, stdout, stderr,
     is submittable to the serve daemon like any other job and shares
     the warm-context contract: one probe, inherited supervisor state,
     per-lane placement under a device lease)."""
-    from pwasm_tpu.core.fasta import FastaFile
+    import time
+
     from pwasm_tpu.utils import RunStats
 
-    for bad, why in (("w", "builds an MSA"), ("ace", "builds an MSA"),
-                     ("info", "builds an MSA"), ("cons", "builds an "
-                      "MSA"), ("realign", "rewrites PAF gaps"),
-                     ("follow", "tails a PAF"), ("resume", "resumes a "
-                      "report"), ("shard", "is a report-path knob")):
-        if bad in opts:
-            raise _usage_err(f"Error: --many2many scores sequences; "
-                             f"-{'-' if len(bad) > 1 else ''}{bad} "
-                             f"{why} and does not apply")
+    cfg = parse_m2m_opts(opts)
     if len(positional) != 1:
         raise _usage_err("Error: --many2many takes exactly one "
                          "<targets.fa> argument")
-    rpath = opts.get("r")
-    if not rpath or rpath is True:
-        raise _usage_err("Error: query FASTA file (-r) is required!")
-    device = str(opts.get("device", "cpu"))
-    if device not in ("cpu", "tpu"):
-        raise _usage_err(f"Error: invalid --device value: {device}")
-    band = 64
-    if "band" in opts:
-        val = opts["band"]
-        if val is True or not str(val).isascii() \
-                or not str(val).isdigit() or int(val) < 1:
-            raise _usage_err(f"Error: invalid --band value: {val}")
-        band = int(val)
-    max_retries = 2
-    if "max-retries" in opts:
-        val = opts["max-retries"]
-        if val is True or not str(val).isascii() \
-                or not str(val).isdigit():
-            raise _usage_err(
-                f"Error: invalid --max-retries value: {val}")
-        max_retries = int(val)
-    fallback = str(opts.get("fallback", "cpu"))
-    if fallback not in ("cpu", "fail"):
-        raise _usage_err(f"Error: invalid --fallback value: {fallback}")
-    verbose = bool(opts.get("v")) or bool(opts.get("D"))
-
-    def load_fasta(path, what):
-        try:
-            fa = FastaFile(str(path))
-        except (OSError, PwasmError):
-            raise PwasmError(
-                f"Error: invalid FASTA file {path} !\n")
-        if not len(fa):
-            raise PwasmError(
-                f"Error: invalid FASTA file {path} !\n")
-        seqs = []
-        for name in fa.names:
-            s = fa.fetch(name)
-            if not s:
-                raise PwasmError(
-                    f"Error: could not retrieve sequence for {name} "
-                    f"({what})!\n")
-            seqs.append(s.upper())
-        return fa.names, seqs
+    rpath, device, band = cfg.rpath, cfg.device, cfg.band
+    max_retries, fallback = cfg.max_retries, cfg.fallback
+    verbose, deadline_s = cfg.verbose, cfg.deadline_s
+    t0_mono = time.monotonic()
 
     qnames, qs = load_fasta(rpath, "-r query")
     tnames, ts = load_fasta(positional[0], "target")
@@ -187,54 +284,31 @@ def many2many_main(opts: dict, positional: list, stdout, stderr,
     # dispatches only the new one and splices byte-identical stored
     # sections around it.  Flag first (a cold --many2many run),
     # warm-context second (a served job under `serve --result-cache`).
-    store = None
     skeys: list = [None] * len(qs)
     sections: list = [None] * len(qs)
     sums: list = [None] * len(qs)
-    rc_dir = opts.get("result-cache")
-    if rc_dir is True:
-        raise _usage_err("Error: --result-cache requires a directory "
-                         "(or off)")
-    rc_max = None
-    if "result-cache-max-bytes" in opts:
-        val = opts["result-cache-max-bytes"]
-        if val is True or not str(val).isascii() \
-                or not str(val).isdigit() or int(val) < 1:
-            raise _usage_err("Error: invalid "
-                             f"--result-cache-max-bytes value: {val}")
-        rc_max = int(val)
-    if not isinstance(rc_dir, str) or not rc_dir or rc_dir == "off":
-        rc_dir = getattr(warm, "result_cache_dir", None) \
-            if warm is not None else None
+    store = open_section_store(cfg.rc_dir, cfg.rc_max, warm, stderr)
     t_digs = None
     q_digs = None
-    if rc_dir:
+    if store is not None:
         import hashlib
 
-        from pwasm_tpu.service.cache import (CacheStore,
-                                             record_digest,
-                                             section_key)
-        try:
-            store = CacheStore(rc_dir, max_bytes=rc_max)
-        except OSError as e:
-            print(f"Warning: --result-cache dir {rc_dir} unusable "
-                  f"({e}); caching disabled", file=stderr)
-        if store is not None:
-            t_digs = [record_digest(tn, t)
-                      for tn, t in zip(tnames, ts)]
-            th = hashlib.sha256()
-            for d in t_digs:
-                th.update(d.encode())
-            tdig = th.hexdigest()
-            q_digs = [record_digest(qn, q)
-                      for qn, q in zip(qnames, qs)]
-            for qi in range(len(qs)):
-                skeys[qi] = section_key(q_digs[qi], tdig, band)
-                got = store.get(skeys[qi])
-                if got is not None and "o" in got[1] \
-                        and "s" in got[1]:
-                    sections[qi] = got[1]["o"]
-                    sums[qi] = got[1]["s"]
+        from pwasm_tpu.service.cache import record_digest, section_key
+        t_digs = [record_digest(tn, t)
+                  for tn, t in zip(tnames, ts)]
+        th = hashlib.sha256()
+        for d in t_digs:
+            th.update(d.encode())
+        tdig = th.hexdigest()
+        q_digs = [record_digest(qn, q)
+                  for qn, q in zip(qnames, qs)]
+        for qi in range(len(qs)):
+            skeys[qi] = section_key(q_digs[qi], tdig, band)
+            got = store.get(skeys[qi])
+            if got is not None and "o" in got[1] \
+                    and "s" in got[1]:
+                sections[qi] = got[1]["o"]
+                sums[qi] = got[1]["s"]
     miss = [qi for qi in range(len(qs)) if sections[qi] is None]
 
     # ---- superset/near-hit reuse (ISSUE 17b): an exact-section miss
@@ -298,6 +372,36 @@ def many2many_main(opts: dict, positional: list, stdout, stderr,
     from pwasm_tpu.ops.banded_dp import NEG
     use_device = device == "tpu" and pairs > 0
     computed: dict[int, dict] = {}
+    done_pairs = 0
+    done_bases = 0
+    preempted = False
+
+    def finalize(qi):
+        # per-CDS section emission: format + cache-insert ONE query's
+        # section as soon as its scores are complete, so a deadline
+        # preemption keeps every finished section (the cache IS the
+        # resume mechanism — a re-run splices them and dispatches only
+        # the unfinished remainder)
+        pm = partial.get(qi, {})
+        cm = computed.get(qi, {})
+        row = [pm[d] if d in pm else cm[d] for d in tkey]
+        sec = format_sections(
+            [qnames[qi]], [len(qs[qi])], tnames, tlens,
+            [row], NEG).encode("utf-8")
+        sm = format_summary([qnames[qi]], tnames, [row],
+                            NEG).encode("utf-8")
+        sections[qi], sums[qi] = sec, sm
+        if store is not None and skeys[qi] is not None:
+            from pwasm_tpu.service.cache import m2m_family_key
+            extra = {"m2m": {
+                "family": m2m_family_key(q_digs[qi], band),
+                "targets": [[d, int(row[ti])]
+                            for ti, d in enumerate(t_digs)]}}
+            store.insert(skeys[qi], {"o": sec, "s": sm},
+                         extra=extra)
+        if store is not None and pm:
+            store.note_delta(len(ts) - len(need[qi]), len(ts))
+
     if pairs:
         # the one session gate: identical to cli._main_loop's — a
         # bounded probe before the first jax touch, demoting loudly to
@@ -358,9 +462,8 @@ def many2many_main(opts: dict, positional: list, stdout, stderr,
         # a served job holding a device lease places on ITS lane,
         # exactly like cli._main_loop jobs (the ISSUE 8
         # lane-isolation contract); inert for cold runs and
-        # single-lane daemons.  (Spanning a MULTI-device lease with a
-        # 2-D mesh is the ROADMAP item-3 remaining work — today the
-        # session stays single-device.)
+        # single-lane daemons.  A MULTI-device lease additionally
+        # spans the whole lane with a 2-D tile mesh (lane_span_mesh).
         # queries owing the same target subset share one ragged
         # dispatch, so a superset job costs one call for the delta
         # column(s) plus one for any full-miss queries
@@ -371,15 +474,29 @@ def many2many_main(opts: dict, positional: list, stdout, stderr,
         with _lane_device_scope(
                 SimpleNamespace(device="tpu" if use_device
                                 else "cpu"), warm, stderr):
+            mesh = lane_span_mesh(use_device, warm, stderr, verbose)
             for idxs, qis in groups.items():
+                # the end-to-end deadline is enforced at the per-CDS
+                # dispatch boundary (the report-batch contract, rc 75
+                # + resumable): never start a group the budget can't
+                # see, and every group that DID finish is already
+                # cached by finalize() below
+                if deadline_s is not None and \
+                        time.monotonic() - t0_mono >= deadline_s:
+                    preempted = True
+                    break
                 scores = many2many_scores_ragged(
                     [qs[qi] for qi in qis],
-                    [ts[ti] for ti in idxs], band=band,
+                    [ts[ti] for ti in idxs], band=band, mesh=mesh,
                     supervisor=supervisor)
                 for k, qi in enumerate(qis):
                     computed[qi] = {
                         tkey[ti]: int(scores[k][j])
                         for j, ti in enumerate(idxs)}
+                    finalize(qi)
+                done_pairs += len(qis) * len(idxs)
+                done_bases += sum(tlens[ti]
+                                  for ti in idxs) * len(qis)
     elif miss and verbose:
         print(f"many2many: all {len(miss)} missing section(s) "
               "spliced from cached target subsets — no device "
@@ -388,33 +505,47 @@ def many2many_main(opts: dict, positional: list, stdout, stderr,
         print(f"many2many: all {len(qs)} section(s) served from the "
               "result cache — no device session", file=stderr)
     for qi in miss:
-        pm = partial.get(qi, {})
-        cm = computed.get(qi, {})
-        row = [pm[d] if d in pm else cm[d] for d in tkey]
-        sec = format_sections(
-            [qnames[qi]], [len(qs[qi])], tnames, tlens,
-            [row], NEG).encode("utf-8")
-        sm = format_summary([qnames[qi]], tnames, [row],
-                            NEG).encode("utf-8")
-        sections[qi], sums[qi] = sec, sm
-        if store is not None and skeys[qi] is not None:
-            from pwasm_tpu.service.cache import m2m_family_key
-            extra = {"m2m": {
-                "family": m2m_family_key(q_digs[qi], band),
-                "targets": [[d, int(row[ti])]
-                            for ti, d in enumerate(t_digs)]}}
-            store.insert(skeys[qi], {"o": sec, "s": sm},
-                         extra=extra)
-        if store is not None and pm:
-            store.note_delta(len(ts) - len(need[qi]), len(ts))
+        if sections[qi] is None and not need[qi]:
+            finalize(qi)     # pure splice — no device work owed
     # honest accounting: the counters describe work this run actually
     # DID; cached sections and spliced subset rows ride in as bytes,
-    # not as alignments
-    stats.alignments = pairs
-    stats.aligned_bases = sum(
-        tlens[ti] for qi in miss for ti in need[qi])
+    # not as alignments — and a preempted run reports only the pairs
+    # it dispatched before the budget ran out
+    stats.lines = done_pairs
+    stats.alignments = done_pairs
+    stats.aligned_bases = done_bases
     stats.device_batches = 0   # the ragged driver dispatches per
     #   bucket; the supervisor's site counters carry the attempt story
+
+    if preempted:
+        from pwasm_tpu.core.errors import EXIT_PREEMPTED
+        stats.preempted = True
+        reason = (f"deadline_exceeded: --deadline-s={deadline_s:g} "
+                  "budget spent")
+        drain = getattr(warm, "drain", None) if warm is not None \
+            else None
+        if drain is not None and not drain.requested:
+            drain.request(reason)
+        print(f"Warning: many2many preempted at a per-CDS dispatch "
+              f"boundary ({reason}); "
+              f"{sum(1 for s in sections if s is not None)} of "
+              f"{len(qs)} section(s) finished"
+              + (" and cached — resubmit to continue"
+                 if store is not None else ""), file=stderr)
+        supervisor.finalize_stats()
+        if warm is not None:
+            warm.supervisor_state = {
+                k: v for k, v in supervisor.export_state().items()
+                if k != "fault_calls"}
+        if "stats" in opts:
+            try:
+                with open(str(opts["stats"]), "w") as f:
+                    stats.write(f)
+            except OSError:
+                raise PwasmError(
+                    f"Cannot open file {opts['stats']} for "
+                    "writing!\n")
+        return EXIT_PREEMPTED
 
     body = b"".join(sections)
     if "o" in opts:

@@ -100,6 +100,22 @@ class LineAssembler:
         body, self._tail = (self._tail + data).rsplit("\n", 1)
         return [ln + "\n" for ln in body.split("\n")]
 
+    def preview(self, data: str) -> list[str]:
+        """The lines ``push(data)`` WOULD yield, without committing —
+        the stream-delta hold path digests a frame's lines before
+        deciding whether to commit it (a queue-full reject must leave
+        the assembler resendable-verbatim, same contract as
+        ``completed``)."""
+        if self._held_cr:
+            data = "\r" + data
+        if data.endswith("\r"):
+            data = data[:-1]
+        data = data.replace("\r\n", "\n").replace("\r", "\n")
+        if "\n" not in data:
+            return []
+        body, _rest = (self._tail + data).rsplit("\n", 1)
+        return [ln + "\n" for ln in body.split("\n")]
+
     def flush(self) -> list[str]:
         # a held final "\r" is a line terminator in text mode; the
         # main loop rstrips "\n" anyway, so the bare tail matches what

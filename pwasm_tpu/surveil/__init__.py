@@ -1,0 +1,18 @@
+"""Continuous fleet-wide many2many — the surveillance pipeline
+(ROADMAP item 3, docs/SURVEIL.md).
+
+jax-free coordination layer (``qa/check_supervision.py::
+find_surveil_violations``): target FASTAs arrive incrementally over
+the stream verbs, are scored against a resident query set with
+incremental per-CDS section emission (``session.py``), and — behind
+the fleet router — are partitioned across members and merged back
+into one byte-identical report (``partition.py``).  All device work
+stays behind ``stream/multicds.py`` and ``parallel/many2many.py``.
+"""
+
+from pwasm_tpu.surveil.records import FastaAssembler, parse_record
+from pwasm_tpu.surveil.partition import (ScatterState, merge_fragments,
+                                         rewrite_out_args)
+
+__all__ = ["FastaAssembler", "parse_record", "ScatterState",
+           "merge_fragments", "rewrite_out_args"]

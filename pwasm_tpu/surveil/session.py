@@ -1,0 +1,432 @@
+"""The ``--m2m-stream`` job type: continuous many2many.
+
+The deployment shape the paper actually describes (PAPER.md §0,
+ROADMAP item 3): a resident CDS query set (``-r``) stays loaded while
+target assemblies arrive *incrementally* — over the daemon's stream
+verbs when served (``input_stream`` is the job's
+:class:`~pwasm_tpu.stream.pafstream.StreamFeed`), or from a target
+FASTA replayed as one arrival when run cold.  Every arriving target is
+scored against every resident query through the same supervised
+``many2many`` site the one-shot driver uses, with *incremental per-CDS
+emission*: each arrival batch dispatches only the (query, target)
+pairs the section cache has never seen — any cached
+``(query record, target record, band)`` score from the family pool
+splices in verbatim, because banded-DP scores are pure in the pair —
+and the final report is byte-identical to one ``--many2many`` run over
+the accumulated targets in arrival order (the parity gate).
+
+Deadlines follow the report-batch contract: ``--deadline-s`` is
+checked at every per-CDS dispatch boundary; on expiry the session
+cache-inserts every fully-scored partial section (the cache IS the
+resume mechanism), requests the warm drain with a
+``deadline_exceeded`` reason, and exits 75.
+
+jax-free at module level (the ``find_surveil_violations`` gate): the
+device stack loads lazily at the first dispatch, exactly like
+``stream/multicds.py``.
+"""
+
+from __future__ import annotations
+
+from pwasm_tpu.core.errors import EXIT_PREEMPTED, PwasmError
+from pwasm_tpu.stream.multicds import (_usage_err, format_sections,
+                                       format_summary, lane_span_mesh,
+                                       load_fasta, open_section_store,
+                                       parse_m2m_opts)
+from pwasm_tpu.surveil.records import FastaAssembler, parse_record
+
+# targets per dispatch when the stream runs hot (arrivals outpace the
+# device): bounds per-batch latency without giving up batching when
+# the feed has drained
+MAX_ARRIVAL_BATCH = 64
+
+
+def m2m_stream_main(opts: dict, positional: list, stdout, stderr,
+                    warm=None, input_stream=None) -> int:
+    import contextlib
+    import time
+    from types import SimpleNamespace
+
+    from pwasm_tpu.utils import RunStats
+
+    cfg = parse_m2m_opts(opts)
+    if opts.get("many2many"):
+        raise _usage_err("Error: --m2m-stream and --many2many are "
+                         "mutually exclusive job types")
+    if input_stream is None and len(positional) != 1:
+        raise _usage_err("Error: --m2m-stream takes exactly one "
+                         "<targets.fa> argument when not served over "
+                         "the stream verbs")
+    if input_stream is not None and positional:
+        raise _usage_err("Error: a served --m2m-stream job takes its "
+                         "targets from the stream, not a positional")
+    t0_mono = time.monotonic()
+
+    qnames, qs = load_fasta(cfg.rpath, "-r query")
+    stats = RunStats()
+
+    store = open_section_store(cfg.rc_dir, cfg.rc_max, warm, stderr)
+    q_digs: list = []
+    # the resident family pool: every cached (query record, target
+    # record, band) score in the store is a valid splice — the banded
+    # DP score is pure in the pair — so an arriving target re-scores
+    # only what the store has never seen
+    known: list[dict] = [dict() for _ in qs]
+    if store is not None:
+        from pwasm_tpu.service.cache import (m2m_family_key,
+                                             record_digest)
+        q_digs = [record_digest(qn, q)
+                  for qn, q in zip(qnames, qs)]
+        fams = {m2m_family_key(q_digs[qi], cfg.band): qi
+                for qi in range(len(qs))}
+        for _key, man in store.m2m_scan():
+            fam = man["m2m"].get("family")
+            qi = fams.get(fam) if isinstance(fam, str) else None
+            rows_man = man["m2m"].get("targets")
+            if qi is None or not isinstance(rows_man, list):
+                continue
+            try:
+                for d, s in rows_man:
+                    known[qi].setdefault(str(d), int(s))
+            except (TypeError, ValueError):
+                continue
+
+    from pwasm_tpu.resilience import BatchSupervisor, ResiliencePolicy
+    supervisor = BatchSupervisor(
+        ResiliencePolicy(max_retries=cfg.max_retries,
+                         fallback=cfg.fallback),
+        stats=stats, stderr=stderr)
+    if warm is not None and getattr(warm, "supervisor_state", None):
+        supervisor.restore_state(warm.supervisor_state)
+
+    # ---- arrival state: targets indexed by GLOBAL arrival order
+    tnames: list[str] = []
+    ts: list[str] = []
+    tlens: list[int] = []
+    t_digs: list = []
+    rows: list[dict] = [dict() for _ in qs]  # qi -> {gidx: score}
+    pending: list[int] = []
+    prog = {"resident_queries": len(qs), "targets_in": 0,
+            "targets_scored": 0, "targets_reused": 0,
+            "pairs_dispatched": 0, "pairs_reused": 0,
+            "sections_emitted": 0, "batches": 0, "done": False}
+
+    def publish():
+        # live progress for the svc-stats `m2m` block / top pane; the
+        # feed carries no __slots__, so the attribute rides along
+        if input_stream is not None:
+            try:
+                input_stream.m2m_progress = dict(prog)
+            except Exception:
+                pass
+
+    state = SimpleNamespace(ready=False,
+                            use_device=cfg.device == "tpu",
+                            mesh=None, preempted=False)
+    stack = contextlib.ExitStack()
+
+    def ensure_engine():
+        # one probe / one pin / one lane scope for the whole session,
+        # deferred to the FIRST dispatch: an all-reused stream never
+        # touches the device stack at all
+        if state.ready:
+            return
+        state.ready = True
+        if state.use_device:
+            from pwasm_tpu.utils import backend as _backend
+            from pwasm_tpu.utils.backend import \
+                device_backend_reachable
+            _p0 = _backend.probe_counters["probes"]
+            _w0 = _backend.probe_counters["warm_hits"]
+            ok, why = device_backend_reachable()
+            stats.backend_probes += \
+                _backend.probe_counters["probes"] - _p0
+            stats.backend_warm_hits += \
+                _backend.probe_counters["warm_hits"] - _w0
+            if not ok:
+                print(f"Warning: jax backend unreachable "
+                      f"({why.strip()}); running with --device=cpu",
+                      file=stderr)
+                state.use_device = False
+                stats.engine_fallbacks += 1
+        if not state.use_device:
+            from pwasm_tpu.utils.jaxcompat import pin_cpu_platform
+            pin_cpu_platform()
+        else:
+            from pwasm_tpu.ops import enable_compilation_cache
+            cache_dir = opts.get("compile-cache-dir")
+            if not isinstance(cache_dir, str) or not cache_dir:
+                cache_dir = getattr(warm, "compile_cache_dir", None) \
+                    if warm is not None else None
+            enable_compilation_cache(cache_dir)
+        from pwasm_tpu.cli import _lane_device_scope
+        stack.enter_context(_lane_device_scope(
+            SimpleNamespace(device="tpu" if state.use_device
+                            else "cpu"), warm, stderr))
+        state.mesh = lane_span_mesh(state.use_device, warm, stderr,
+                                    cfg.verbose)
+
+    def admit(rec_text: str):
+        try:
+            name, seq = parse_record(rec_text)
+        except ValueError as e:
+            raise PwasmError(f"Error: {e} (streamed target)!\n")
+        seq = seq.upper()
+        if not seq:
+            raise PwasmError(
+                f"Error: could not retrieve sequence for {name} "
+                "(target)!\n")
+        tnames.append(name)
+        ts.append(seq)
+        tlens.append(len(seq))
+        if store is not None:
+            t_digs.append(record_digest(name, seq))
+        pending.append(len(tnames) - 1)
+        prog["targets_in"] += 1
+
+    def score_batch(batch: list) -> bool:
+        """Score one arrival batch; False when the deadline preempts
+        mid-batch (whole per-CDS groups stay atomic either way)."""
+        need: dict[int, tuple] = {}
+        for qi in range(len(qs)):
+            if store is not None:
+                owed = []
+                for i, g in enumerate(batch):
+                    got = known[qi].get(t_digs[g])
+                    if got is None:
+                        owed.append(i)
+                    else:
+                        rows[qi][g] = got
+                owed = tuple(owed)
+            else:
+                owed = tuple(range(len(batch)))
+            if owed:
+                need[qi] = owed
+        owed_sets = [need.get(qi, ()) for qi in range(len(qs))]
+        prog["targets_reused"] += sum(
+            1 for i in range(len(batch))
+            if all(i not in o for o in owed_sets))
+        prog["pairs_reused"] += len(batch) * len(qs) \
+            - sum(len(o) for o in owed_sets)
+        groups: dict[tuple, list] = {}
+        for qi, owed in need.items():
+            groups.setdefault(owed, []).append(qi)
+        from pwasm_tpu.parallel.many2many import \
+            many2many_scores_ragged
+        for idxs, qis in groups.items():
+            if cfg.deadline_s is not None and \
+                    time.monotonic() - t0_mono >= cfg.deadline_s:
+                state.preempted = True
+                return False
+            ensure_engine()
+            scores = many2many_scores_ragged(
+                [qs[qi] for qi in qis],
+                [ts[batch[i]] for i in idxs], band=cfg.band,
+                mesh=state.mesh, supervisor=supervisor)
+            for k, qi in enumerate(qis):
+                for j, i in enumerate(idxs):
+                    g = batch[i]
+                    sc = int(scores[k][j])
+                    rows[qi][g] = sc
+                    if store is not None:
+                        known[qi][t_digs[g]] = sc
+            prog["pairs_dispatched"] += len(qis) * len(idxs)
+            stats.aligned_bases += sum(
+                tlens[batch[i]] for i in idxs) * len(qis)
+        prog["targets_scored"] += len(batch)
+        prog["batches"] += 1
+        publish()
+        return True
+
+    def flush_pending() -> bool:
+        if not pending:
+            return True
+        batch = list(pending)
+        del pending[:]
+        return score_batch(batch)
+
+    asm = FastaAssembler()
+    drained_early = False
+    try:
+        if input_stream is not None:
+            publish()
+            for line in input_stream:
+                for rec in asm.feed(line + "\n"):
+                    admit(rec)
+                # dispatch boundary: feed drained (the arrival batch
+                # is whatever accumulated) or the hot-stream cap hit
+                if pending and (
+                        getattr(input_stream, "buffered", 0) == 0
+                        or len(pending) >= MAX_ARRIVAL_BATCH):
+                    if not flush_pending():
+                        break
+                publish()
+            drain = getattr(input_stream, "_drain", None)
+            if drain is not None and drain.requested \
+                    and not getattr(input_stream, "ended", True):
+                drained_early = True    # idle/drain preemption: the
+                #   stream path's resumable-75 contract
+        else:
+            try:
+                with open(str(positional[0]), "r",
+                          encoding="utf-8",
+                          errors="replace") as f:
+                    while True:
+                        chunk = f.read(1 << 20)
+                        if not chunk:
+                            break
+                        for rec in asm.feed(chunk):
+                            admit(rec)
+                        if len(pending) >= MAX_ARRIVAL_BATCH:
+                            if not flush_pending():
+                                break
+            except OSError:
+                raise PwasmError(
+                    f"Error: invalid FASTA file {positional[0]} !\n")
+        if not state.preempted and not drained_early:
+            for rec in asm.finish():
+                admit(rec)
+            flush_pending()
+        if input_stream is None and not tnames:
+            raise PwasmError(
+                f"Error: invalid FASTA file {positional[0]} !\n")
+    finally:
+        stack.close()
+
+    # honest accounting: only dispatched pairs count as alignments;
+    # family-pool splices ride in as bytes
+    stats.lines = prog["pairs_dispatched"]
+    stats.alignments = prog["pairs_dispatched"]
+    stats.device_batches = 0
+
+    def insert_sections(final: bool) -> None:
+        # cache insert at per-CDS granularity over whatever subset of
+        # targets each query has fully scored: the entry's key is
+        # EXACTLY the one-shot section key for that target (sub)set,
+        # and the m2m family extras donate every (digest, score) pair
+        # to future sessions — this is both the incremental skip pool
+        # and the deadline resume mechanism
+        if store is None:
+            return
+        import hashlib
+
+        from pwasm_tpu.service.cache import (m2m_family_key,
+                                             section_key)
+        for qi in range(len(qs)):
+            gs = sorted(rows[qi])
+            if not gs or (final and len(gs) != len(tnames)):
+                continue
+            th = hashlib.sha256()
+            for g in gs:
+                th.update(t_digs[g].encode())
+            skey = section_key(q_digs[qi], th.hexdigest(), cfg.band)
+            row = [rows[qi][g] for g in gs]
+            sec = format_sections(
+                [qnames[qi]], [len(qs[qi])],
+                [tnames[g] for g in gs], [tlens[g] for g in gs],
+                [row], NEG).encode("utf-8")
+            sm = format_summary(
+                [qnames[qi]], [tnames[g] for g in gs], [row],
+                NEG).encode("utf-8")
+            extra = {"m2m": {
+                "family": m2m_family_key(q_digs[qi], cfg.band),
+                "targets": [[t_digs[g], rows[qi][g]] for g in gs]}}
+            store.insert(skey, {"o": sec, "s": sm}, extra=extra)
+        if prog["pairs_reused"]:
+            store.note_delta(
+                prog["pairs_reused"],
+                prog["pairs_reused"] + prog["pairs_dispatched"])
+
+    from pwasm_tpu.ops.banded_dp import NEG
+
+    if state.preempted or drained_early:
+        stats.preempted = True
+        insert_sections(final=False)
+        if state.preempted:
+            reason = (f"deadline_exceeded: --deadline-s="
+                      f"{cfg.deadline_s:g} budget spent")
+            drain = getattr(warm, "drain", None) \
+                if warm is not None else None
+            if drain is not None and not drain.requested:
+                drain.request(reason)
+        else:
+            reason = "stream drained before stream-end"
+        print(f"Warning: m2m-stream preempted ({reason}); "
+              f"{prog['targets_scored']} of {prog['targets_in']} "
+              "target(s) scored"
+              + (" and cached — resubmit to continue"
+                 if store is not None else ""), file=stderr)
+        supervisor.finalize_stats()
+        if warm is not None:
+            warm.supervisor_state = {
+                k: v for k, v in supervisor.export_state().items()
+                if k != "fault_calls"}
+        publish()
+        _write_stats(opts, stats, prog)
+        return EXIT_PREEMPTED
+
+    if cfg.verbose:
+        print(f"m2m-stream: {prog['targets_in']} target(s) in "
+              f"{prog['batches']} arrival batch(es), "
+              f"{prog['pairs_dispatched']} pair(s) dispatched, "
+              f"{prog['pairs_reused']} spliced from the family pool",
+              file=stderr)
+
+    sections: list = []
+    sums: list = []
+    for qi in range(len(qs)):
+        row = [rows[qi][g] for g in range(len(tnames))]
+        sections.append(format_sections(
+            [qnames[qi]], [len(qs[qi])], tnames, tlens, [row],
+            NEG).encode("utf-8"))
+        sums.append(format_summary([qnames[qi]], tnames, [row],
+                                   NEG).encode("utf-8"))
+        prog["sections_emitted"] += 1
+    insert_sections(final=True)
+
+    body = b"".join(sections)
+    if "o" in opts:
+        try:
+            with open(str(opts["o"]), "wb") as f:
+                f.write(body)
+        except OSError:
+            raise PwasmError(
+                f"Cannot open file {opts['o']} for writing!\n")
+    else:
+        stdout.write(body.decode("utf-8"))
+    if "s" in opts:
+        try:
+            with open(str(opts["s"]), "wb") as f:
+                f.write(b"".join(sums))
+        except OSError:
+            raise PwasmError(
+                f"Cannot open file {opts['s']} for writing!\n")
+    supervisor.finalize_stats()
+    if warm is not None:
+        warm.supervisor_state = {
+            k: v for k, v in supervisor.export_state().items()
+            if k != "fault_calls"}
+    prog["done"] = True
+    publish()
+    _write_stats(opts, stats, prog)
+    if cfg.verbose:
+        print(stats.brief(), file=stderr)
+    return 0
+
+
+def _write_stats(opts: dict, stats, prog: dict) -> None:
+    """The versioned ``--stats`` JSON plus an additive ``m2m`` block
+    (`fold_run_stats` ignores unknown keys by contract) — the bench
+    incremental-ratio leg and the scatter merge read it."""
+    if "stats" not in opts:
+        return
+    import json
+    d = stats.as_dict()
+    d["m2m"] = {k: v for k, v in prog.items() if k != "done"}
+    try:
+        with open(str(opts["stats"]), "w") as f:
+            json.dump(d, f)
+            f.write("\n")
+    except OSError:
+        raise PwasmError(
+            f"Cannot open file {opts['stats']} for writing!\n")

@@ -87,6 +87,15 @@ STREAM_DIR = "pwasm_tpu/stream"
 # stay device-free by design (the router fronts N daemons that each
 # own their devices).
 FLEET_DIR = "pwasm_tpu/fleet"
+# pwasm_tpu/surveil/ (ISSUE 20): the continuous-m2m coordination
+# layer — stream partitioning, fragment merge, the session driver —
+# runs inside the daemon and the (device-free) router.  Its only
+# device reach is the lazy supervised many2many site in parallel/.
+SURVEIL_DIR = "pwasm_tpu/surveil"
+SURVEIL_FILES = ("pwasm_tpu/surveil/__init__.py",
+                 "pwasm_tpu/surveil/records.py",
+                 "pwasm_tpu/surveil/partition.py",
+                 "pwasm_tpu/surveil/session.py")
 SERVICE_PATTERNS = re.compile(
     r"^\s*(?:import\s+jax\b|from\s+jax[.\s])|jax\.jit|jax\.device_put"
     r"|jax\.device_get|\.block_until_ready\s*\(")
@@ -286,6 +295,26 @@ def find_fleet_violations(root: str = REPO) -> list[str]:
     every device touch in the fleet happens inside a member daemon's
     cli.run, behind the supervised sites."""
     return _find_jaxfree_violations(root, FLEET_DIR, "fleet")
+
+
+def find_surveil_violations(root: str = REPO) -> list[str]:
+    """Surveillance-pipeline gate (ISSUE 20): pwasm_tpu/surveil/ must
+    exist AND stay jax-free — the stream partitioner, fragment
+    merger and session driver run inside the daemon and the
+    device-free router; device work is reached only through the
+    lazy supervised many2many site in pwasm_tpu/parallel/.
+    ``_find_jaxfree_violations`` returns [] for a missing directory,
+    so the existence of the core modules is asserted first."""
+    out: list[str] = []
+    for rel in SURVEIL_FILES:
+        path = os.path.join(root, *rel.split("/"))
+        if not os.path.isfile(path):
+            out.append(f"{rel}: surveillance-pipeline module missing "
+                       "— the continuous-m2m coordination layer the "
+                       "--m2m-stream job type and the fleet scatter "
+                       "path depend on")
+    out.extend(_find_jaxfree_violations(root, SURVEIL_DIR, "surveil"))
+    return out
 
 
 def find_sharding_violations(root: str = REPO) -> list[str]:
@@ -810,6 +839,7 @@ def main() -> int:
     obs = find_obs_violations()
     stream = find_stream_violations()
     fleet = find_fleet_violations()
+    surveil = find_surveil_violations()
     metric = find_metric_lint()
     doc_drift = find_doc_drift()
     sharding = find_sharding_violations()
@@ -830,9 +860,9 @@ def main() -> int:
     for rel in stale:
         print(f"{rel}: stale registry entry (no device entry points "
               "left — remove it)", file=sys.stderr)
-    for line in svc + obs + stream + fleet + metric + doc_drift \
-            + sharding + slo + cachev + fencing + clock + errvocab \
-            + tlsv + perm:
+    for line in svc + obs + stream + fleet + surveil + metric \
+            + doc_drift + sharding + slo + cachev + fencing + clock \
+            + errvocab + tlsv + perm:
         print(line, file=sys.stderr)
     if bad:
         print(f"\n{len(bad)} device entry point(s) outside the "
@@ -847,6 +877,10 @@ def main() -> int:
               "  These layers reach the device only through "
               "supervised sites — move the device work there.",
               file=sys.stderr)
+    if surveil:
+        print(f"\n{len(surveil)} surveillance-pipeline gate "
+              "failure(s): pwasm_tpu/surveil/ must exist and stay "
+              "jax-free (ISSUE 20).", file=sys.stderr)
     if metric:
         print(f"\n{len(metric)} metric-name lint failure(s): all "
               "registrations live in pwasm_tpu/obs/catalog.py with "
@@ -895,8 +929,8 @@ def main() -> int:
               "utils/fsio.py::ensure_private_dir (ISSUE 19).",
               file=sys.stderr)
     return 1 if (bad or stale or svc or obs or stream or fleet
-                 or metric or doc_drift or sharding or slo
-                 or cachev or fencing or clock or errvocab
+                 or surveil or metric or doc_drift or sharding
+                 or slo or cachev or fencing or clock or errvocab
                  or tlsv or perm) else 0
 
 

@@ -1,0 +1,395 @@
+"""Continuous fleet-wide many2many (ISSUE 20): the surveillance
+pipeline — streamed target arrival, resident section scoring, the
+per-CDS section cache, and the router-partitioned scatter/merge.
+
+Acceptance contracts:
+
+- **one stream, one report**: a ``--m2m-stream`` job fed record-at-a-
+  time over the service socket lands byte-identical to one one-shot
+  run over the same records in the same arrival order;
+- **arriving-target economics**: with ``--result-cache``, an arriving
+  target re-scores ONLY the pairs the section store has never seen
+  (``pairs_dispatched``/``pairs_reused`` counters are truthful) and
+  the spliced report stays byte-identical to a cache-off run;
+- **deadline honesty**: ``--deadline-s`` preempts at the per-CDS
+  dispatch boundary with exit 75 and a cache-resumable session — a
+  fully-primed session never touches the dispatch boundary at all;
+- **the scatter drill**: a 3-member fleet scatter (any arrival order)
+  merges byte-identical to one un-scattered run, and a member
+  SIGKILLed mid-stream is re-partitioned invisibly — same bytes, one
+  failover in the stats.
+"""
+
+import io
+import json
+import os
+import random
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+from pwasm_tpu.cli import run as cli_run
+from pwasm_tpu.core.errors import EXIT_PREEMPTED
+from pwasm_tpu.fleet.router import Router
+from pwasm_tpu.service.client import ServiceClient, wait_for_socket
+from pwasm_tpu.service.top import render
+from pwasm_tpu.surveil.partition import (ScatterState, merge_fragments,
+                                         rewrite_out_args)
+from pwasm_tpu.surveil.records import FastaAssembler, parse_record
+
+from test_fleet import _daemon, _fleet, _serve_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# corpus helpers (tiny: seconds, not minutes, on cpu jax)
+# ---------------------------------------------------------------------------
+def _seq(rng, n):
+    return "".join(rng.choice("ACGT") for _ in range(n))
+
+
+def _corpus(tmp_path, nq=2, nt=7, seed=11):
+    rng = random.Random(seed)
+    qs = [(f"cds{i}", _seq(rng, 50 + 10 * i)) for i in range(nq)]
+    ts = [(f"asm{i}", _seq(rng, 120 + 15 * i)) for i in range(nt)]
+    qfa = str(tmp_path / "q.fa")
+    with open(qfa, "w") as f:
+        for n, s in qs:
+            f.write(f">{n}\n{s}\n")
+    return qfa, [f">{n}\n{s}\n" for n, s in ts]
+
+
+def _write_targets(tmp_path, recs, name="t.fa"):
+    tfa = str(tmp_path / name)
+    with open(tfa, "w") as f:
+        f.write("".join(recs))
+    return tfa
+
+
+def _one_shot(tmp_path, qfa, recs, tag, extra=()):
+    """Ground truth: one un-streamed, un-scattered run."""
+    tfa = _write_targets(tmp_path, recs, f"{tag}.fa")
+    o = str(tmp_path / f"{tag}.tsv")
+    s = str(tmp_path / f"{tag}.sum")
+    rc = cli_run(["--m2m-stream", tfa, "-r", qfa, "-o", o, "-s", s]
+                 + list(extra), stderr=io.StringIO())
+    assert rc == 0
+    return open(o, "rb").read(), open(s, "rb").read()
+
+
+# ---------------------------------------------------------------------------
+# record assembly units
+# ---------------------------------------------------------------------------
+def test_fasta_assembler_reassembles_any_byte_split():
+    text = ">a desc\nACGT\nAC\n\n>b\r\nGGTT\r\n>c\nTT"
+    # one char per frame: records complete only when the NEXT header
+    # arrives; finish() flushes the trailing one
+    asm = FastaAssembler()
+    got = []
+    for ch in text:
+        got.extend(asm.feed(ch))
+    got.extend(asm.finish())
+    assert got == [">a desc\nACGT\nAC\n", ">b\nGGTT\n", ">c\nTT\n"]
+    # identical to one big frame
+    asm2 = FastaAssembler()
+    assert asm2.feed(text) + asm2.finish() == got
+    assert parse_record(got[0]) == ("a", "ACGTAC")
+    with pytest.raises(ValueError):
+        parse_record("no header\nACGT\n")
+    with pytest.raises(ValueError):
+        parse_record(">\nACGT\n")
+
+
+def test_scatter_state_roundrobin_kill_adopt():
+    st = ScatterState()
+    for _ in range(3):
+        st.add_sub()
+    assigned = [st.assign() for _ in range(7)]
+    assert [g for g, _ in assigned] == list(range(7))
+    assert [k for _, k in assigned] == [0, 1, 2, 0, 1, 2, 0]
+    assert st.orders[0] == [0, 3, 6]
+    # death: the dead sub's records replay wholesale into a fresh sub
+    order = st.kill(1)
+    assert order == [1, 4]
+    assert st.live_subs() == [0, 2]
+    k = st.add_sub()
+    st.adopt(k, order)
+    assert st.orders[k] == [1, 4]
+    with pytest.raises(ValueError):
+        st.adopt(k, [9])                # already owns records
+    # post-death arrivals round-robin over the CURRENT live set
+    assert [st.assign()[1] for _ in range(3)] == [2, 3, 0]
+    st.kill(0)
+    st.kill(2)
+    st.kill(3)
+    with pytest.raises(ValueError):
+        st.assign()                     # no live subs
+
+
+def test_rewrite_out_args_fragments_and_strips_stats():
+    args = ["--m2m-stream", "-r", "q.fa", "-o", "out.tsv",
+            "-s", "out.sum", "--stats=x.json", "--band=16"]
+    got = rewrite_out_args(args, o="f.frag00", s="s.frag00")
+    assert got == ["--m2m-stream", "-r", "q.fa", "-o", "f.frag00",
+                   "-s", "s.frag00", "--band=16"]
+
+
+def test_merge_fragments_global_order_and_summary():
+    # two subs over 5 records: sub0 owns 0,2,4 / sub1 owns 1,3
+    f0 = b">q1\t60\t3\nt0\t100\t7\nt2\t110\t.\nt4\t130\t9\n"
+    f1 = b">q1\t60\t2\nt1\t105\t9\nt3\t120\t3\n"
+    rep, summ = merge_fragments([f0, f1], [[0, 2, 4], [1, 3]], 5,
+                                summary=True)
+    assert rep == (b">q1\t60\t5\n"
+                   b"t0\t100\t7\nt1\t105\t9\nt2\t110\t.\n"
+                   b"t3\t120\t3\nt4\t130\t9\n")
+    # best ties break to ARRIVAL order: t1 (gidx 1) beats t4 (gidx 4)
+    assert summ == b"q1\t5\tt1\t9\t28\n"
+    with pytest.raises(ValueError):
+        merge_fragments([f0, f1], [[0, 2, 4], [1]], 5)   # row count
+    with pytest.raises(ValueError):
+        merge_fragments([f0, f1], [[0, 2, 4], [1, 3]], 6)  # missing
+    f1_bad = f1.replace(b">q1", b">qX")
+    with pytest.raises(ValueError):
+        merge_fragments([f0, f1_bad], [[0, 2, 4], [1, 3]], 5)
+
+
+# ---------------------------------------------------------------------------
+# streamed session vs one-shot (real runner, in-process daemon)
+# ---------------------------------------------------------------------------
+def test_streamed_session_byte_identical_and_observable(tmp_path):
+    """One daemon, records chunked at arbitrary byte splits: the
+    streamed report/summary land byte-identical to one one-shot run,
+    the result carries the m2m stats block, and the retired session
+    feeds svc-stats, the top M2M pane, and the pwasm_m2m_* metric
+    families."""
+    qfa, recs = _corpus(tmp_path)
+    expect_o, expect_s = _one_shot(tmp_path, qfa, recs, "cold")
+    text = "".join(recs)
+    o = str(tmp_path / "st.tsv")
+    s = str(tmp_path / "st.sum")
+    with _daemon() as h:
+        with ServiceClient(h.sock) as c:
+            r = c.stream(["--m2m-stream", "-r", qfa, "-o", o,
+                          "-s", s],
+                         [text[i:i + 61]
+                          for i in range(0, len(text), 61)],
+                         cwd=str(tmp_path))
+            assert r.get("ok"), r
+            res = c.result(r["job_id"], timeout=180)
+            assert res.get("ok") and res.get("rc") == 0, res
+            m2m = (res.get("stats") or {}).get("m2m")
+            assert m2m and m2m["targets_in"] == len(recs), m2m
+            assert m2m["pairs_dispatched"] == 2 * len(recs), m2m
+            st = c.stats()["stats"]
+            mt = c.metrics()
+        assert open(o, "rb").read() == expect_o
+        assert open(s, "rb").read() == expect_s
+        # the additive svc-stats block folds the retired session
+        blk = st.get("m2m")
+        assert blk and blk["sessions"] == 1 \
+            and blk["targets_in"] == len(recs), blk
+        pane = render(st)
+        m2m_lines = [ln for ln in pane.splitlines()
+                     if ln.startswith(" M2M:")]
+        assert m2m_lines and "1 session(s)" in m2m_lines[0], pane
+        text_m = mt.get("metrics") or ""
+        assert "pwasm_m2m_sessions_total 1" in text_m
+        assert f"pwasm_m2m_targets_total {len(recs)}" in text_m
+
+
+def test_incremental_arrivals_splice_from_section_cache(tmp_path):
+    """The arriving-target contract: a --result-cache primed with 5
+    targets re-scores ONLY the 2 arrivals on the grown input — the
+    counters say so — and the spliced bytes equal the cache-off run."""
+    qfa, recs = _corpus(tmp_path)
+    rc_dir = str(tmp_path / "rc")
+    stats_p = str(tmp_path / "inc.json")
+    _one_shot(tmp_path, qfa, recs[:5], "prime",
+              [f"--result-cache={rc_dir}"])
+    expect_o, expect_s = _one_shot(tmp_path, qfa, recs, "full")
+    o = str(tmp_path / "inc.tsv")
+    s = str(tmp_path / "inc.sum")
+    tfa = _write_targets(tmp_path, recs, "grown.fa")
+    rc = cli_run(["--m2m-stream", tfa, "-r", qfa, "-o", o, "-s", s,
+                  f"--result-cache={rc_dir}", f"--stats={stats_p}"],
+                 stderr=io.StringIO())
+    assert rc == 0
+    m2m = json.load(open(stats_p))["m2m"]
+    assert m2m["targets_reused"] == 5, m2m
+    assert m2m["pairs_dispatched"] == 2 * 2, m2m   # 2 arrivals x 2 CDS
+    assert m2m["pairs_reused"] == 2 * 5, m2m
+    assert open(o, "rb").read() == expect_o
+    assert open(s, "rb").read() == expect_s
+
+
+def test_deadline_preempts_resumable_and_primed_run_completes(
+        tmp_path):
+    """--deadline-s at the per-CDS dispatch boundary: a cold session
+    with a microscopic budget exits 75 (preempted, cache-resumable);
+    the SAME budget over a fully-primed cache completes rc 0 — an
+    all-splice session never reaches the dispatch boundary at all."""
+    qfa, recs = _corpus(tmp_path)
+    rc_dir = str(tmp_path / "rc")
+    tfa = _write_targets(tmp_path, recs)
+    o = str(tmp_path / "dl.tsv")
+    err = io.StringIO()
+    rc = cli_run(["--m2m-stream", tfa, "-r", qfa, "-o", o,
+                  "--deadline-s=0.000001",
+                  f"--result-cache={rc_dir}"], stderr=err)
+    assert rc == EXIT_PREEMPTED, err.getvalue()
+    assert "deadline_exceeded" in err.getvalue()
+    assert not os.path.exists(o)       # no partial report
+    # prime, then the same impossible budget completes from splices
+    expect_o, expect_s = _one_shot(tmp_path, qfa, recs, "cold",
+                                   [f"--result-cache={rc_dir}"])
+    s = str(tmp_path / "dl.sum")
+    rc = cli_run(["--m2m-stream", tfa, "-r", qfa, "-o", o, "-s", s,
+                  "--deadline-s=0.000001",
+                  f"--result-cache={rc_dir}"], stderr=io.StringIO())
+    assert rc == 0
+    assert open(o, "rb").read() == expect_o
+    assert open(s, "rb").read() == expect_s
+    err = io.StringIO()
+    rc = cli_run(["--m2m-stream", tfa, "-r", qfa, "-o", o,
+                  "--deadline-s=0"], stderr=err)
+    assert rc == 1 and "--deadline-s" in err.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# fleet scatter (in-process 3-member fleet, real runner)
+# ---------------------------------------------------------------------------
+def test_scatter_three_members_shuffled_arrival_parity(tmp_path):
+    """Arrival-order determinism: the SAME records in a shuffled
+    order, scattered across 3 members, merge byte-identical to one
+    un-scattered run over that same shuffled order — the partition
+    never reorders, whatever the member interleaving does."""
+    qfa, recs = _corpus(tmp_path, nt=9)
+    shuffled = list(recs)
+    random.Random(4).shuffle(shuffled)
+    expect_o, expect_s = _one_shot(tmp_path, qfa, shuffled, "shuf")
+    o = str(tmp_path / "sc.tsv")
+    s = str(tmp_path / "sc.sum")
+    text = "".join(shuffled)
+    with _fleet(3) as f:
+        with ServiceClient(f.sock) as c:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if c.stats()["stats"]["fleet"]["alive"] == 3:
+                    break
+                time.sleep(0.05)
+            r = c.stream(["--m2m-stream", "-r", qfa, "-o", o,
+                          "-s", s],
+                         [text[i:i + 73]
+                          for i in range(0, len(text), 73)],
+                         cwd=str(tmp_path))
+            assert r.get("ok"), r
+            assert len(r.get("scatter", [])) == 3, r
+            res = c.result(r["job_id"], timeout=180)
+            assert res.get("ok") and res.get("rc") == 0, res
+            sc = (res.get("stats") or {}).get("scatter")
+            assert sc == {"subs": 3, "records": 9, "failovers": 0}, sc
+            m2m = (res.get("stats") or {}).get("m2m")
+            assert m2m and m2m["targets_in"] == 9, m2m
+    assert open(o, "rb").read() == expect_o
+    assert open(s, "rb").read() == expect_s
+    # no fragment litter after the merge
+    assert not [p for p in os.listdir(tmp_path) if ".frag" in p]
+
+
+def test_scatter_kill_member_midstream_repartitions_to_parity(
+        tmp_path):
+    """THE ISSUE 20 drill: SIGKILL one of three members mid-stream.
+    The router re-partitions the dead member's sub-stream onto a
+    survivor (replaying its buffered records in order), the client
+    never sees the death, and the merged report is byte-identical to
+    an un-scattered run — failovers == 1 in the scatter stats."""
+    qfa, recs = _corpus(tmp_path, nt=9, seed=23)
+    expect_o, expect_s = _one_shot(tmp_path, qfa, recs, "cold")
+    d = tempfile.mkdtemp(prefix="pwsurv")
+    socks, procs = [], []
+    o = str(tmp_path / "kd.tsv")
+    s = str(tmp_path / "kd.sum")
+    try:
+        for i in range(3):
+            sk = os.path.join(d, f"m{i}.sock")
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "pwasm_tpu.cli", "serve",
+                 f"--socket={sk}"],
+                env=_serve_env(), stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE, text=True))
+            socks.append(sk)
+        for sk in socks:
+            assert wait_for_socket(sk, 60)
+        rsock = os.path.join(d, "router.sock")
+        rerr = io.StringIO()
+        router = Router(socks, socket_path=rsock, stderr=rerr,
+                        poll_interval=0.2)
+        rt = threading.Thread(target=router.serve, daemon=True)
+        rt.start()
+        assert wait_for_socket(rsock, 15)
+        with ServiceClient(rsock) as c:
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if c.stats()["stats"]["fleet"]["alive"] == 3:
+                    break
+                time.sleep(0.1)
+            r = c.stream_open(["--m2m-stream", "-r", qfa, "-o", o,
+                               "-s", s], cwd=str(tmp_path))
+            assert r.get("ok") and r.get("scatter"), r
+            jid = r["job_id"]
+            for t in recs[:5]:
+                assert c.stream_data(jid, t).get("ok")
+            # SIGKILL the member hosting sub 0 (the ledger anchor)
+            victim = r["scatter"][0]
+            vi = socks.index(router.members[victim].target)
+            procs[vi].kill()
+            procs[vi].wait(timeout=30)
+            for t in recs[5:]:
+                assert c.stream_data(jid, t).get("ok")
+            assert c.stream_end(jid).get("ok")
+            res = c.result(jid, timeout=300)
+            assert res.get("ok") and res.get("rc") == 0, res
+            sc = (res.get("stats") or {}).get("scatter")
+            assert sc and sc["failovers"] == 1 \
+                and sc["records"] == 9, sc
+            st = c.stats()["stats"]
+            assert st["fleet"]["jobs_recovered"]["stream_replayed"] \
+                == 1, st["fleet"]
+            c.drain()
+        rt.join(20)
+        assert any("re-partitioned" in ln
+                   for ln in rerr.getvalue().splitlines()), \
+            rerr.getvalue()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+            p.stderr.close()
+        import shutil
+        shutil.rmtree(d, ignore_errors=True)
+    assert open(o, "rb").read() == expect_o
+    assert open(s, "rb").read() == expect_s
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 jax-freeness gate
+# ---------------------------------------------------------------------------
+def test_surveil_qa_gate_clean_and_detects_loss():
+    sys.path.insert(0, os.path.join(REPO, "qa"))
+    try:
+        import check_supervision as cs
+    finally:
+        sys.path.pop(0)
+    assert cs.find_surveil_violations() == []
+    # the gate must FAIL when the subsystem goes missing — the
+    # jax-free walk alone returns [] for an absent directory
+    with tempfile.TemporaryDirectory() as fake:
+        missing = cs.find_surveil_violations(fake)
+        assert missing and all("missing" in m for m in missing)
